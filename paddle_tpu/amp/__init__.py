@@ -1,0 +1,7 @@
+from paddle_tpu.amp.auto_cast import (auto_cast, autocast, decorate,
+                                      amp_guard, white_list, black_list,
+                                      get_amp_dtype, cast_model_to)
+from paddle_tpu.amp.grad_scaler import GradScaler
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "amp_guard",
+           "white_list", "black_list", "get_amp_dtype", "cast_model_to"]

@@ -1,0 +1,95 @@
+"""AMP autocast (ref: python/paddle/amp/auto_cast.py:21 decorate:83; op lists
+in python/paddle/fluid/dygraph/amp/auto_cast.py WHITE_LIST:44 BLACK_LIST:55).
+
+TPU-first policy: bf16 is the native fast dtype (no loss scaling needed, MXU
+natively consumes bf16), so:
+
+- O1 ≙ ``auto_cast(level='O1')``: inputs to matmul-class ops cast to bf16 via
+  a context flag consulted by Linear/Conv/attention layers; reductions, norms
+  and softmax-CE stay fp32 (the reference's black list).
+- O2 ≙ ``decorate(model, level='O2')``: parameters cast to bf16 wholesale,
+  master fp32 weights kept by the optimizer (multi_precision=True default).
+
+fp16 with dynamic loss scaling (GradScaler) is provided for parity, but bf16
+is the default on TPU.
+"""
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+_state = threading.local()
+
+# Reference O1 lists (fluid/dygraph/amp/auto_cast.py:44,55) adapted: names of
+# our functional ops.
+WHITE_LIST = {"matmul", "mm", "bmm", "einsum", "conv1d", "conv2d", "conv3d",
+              "linear", "attention"}
+BLACK_LIST = {"log", "exp", "mean", "sum", "cross_entropy", "softmax",
+              "layer_norm", "batch_norm", "cosine_similarity", "norm"}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+def get_amp_dtype():
+    return getattr(_state, "dtype", None)
+
+
+def amp_enabled():
+    return getattr(_state, "enabled", False)
+
+
+def amp_cast(x, op_class="white"):
+    """Called by layers on their inputs: casts to the amp dtype when inside
+    an auto_cast region and the op class is white-listed."""
+    dt = get_amp_dtype()
+    if dt is None or op_class != "white":
+        return x
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(dt)
+    return x
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """ref: paddle.amp.auto_cast (amp/auto_cast.py:21)."""
+    prev_dtype = getattr(_state, "dtype", None)
+    prev_enabled = getattr(_state, "enabled", False)
+    if enable:
+        _state.dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+        _state.enabled = True
+    try:
+        yield
+    finally:
+        _state.dtype = prev_dtype
+        _state.enabled = prev_enabled
+
+
+autocast = auto_cast
+amp_guard = auto_cast
+
+
+def cast_model_to(model, dtype="bfloat16"):
+    """Cast floating parameters of a Module (O2 path)."""
+    return model.astype(dtype)
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """ref: paddle.amp.decorate (amp/auto_cast.py:83). O2: cast model params
+    to bf16/fp16; master weights live in the optimizer (multi_precision)."""
+    if level == "O2":
+        if isinstance(models, (list, tuple)):
+            models = type(models)(cast_model_to(m, dtype) for m in models)
+        else:
+            models = cast_model_to(models, dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,106 @@
+"""Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py:26 GradScaler;
+C++ ops operators/amp/{check_finite_and_unscale,update_loss_scaling}_op).
+
+Functional: ``scale``/``unscale_and_check``/``update`` compose into the train
+step so the whole thing compiles. In the hybrid-parallel case the found_inf
+flag must be psum'd across mesh axes before the optimizer step (ref:
+hybrid_parallel_optimizer.py:135-149); distributed.fleet wires that up.
+"""
+
+import jax
+import jax.numpy as jnp
+
+tree_map = jax.tree_util.tree_map
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self.enable = enable
+        self.init_loss_scaling = init_loss_scaling
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n = decr_every_n_nan_or_inf
+        self.use_dynamic = use_dynamic_loss_scaling
+        # host-visible mirror for the eager-style API
+        self._scale = jnp.asarray(init_loss_scaling, jnp.float32)
+        self._good = jnp.zeros((), jnp.int32)
+        self._bad = jnp.zeros((), jnp.int32)
+
+    # -- functional API (use inside jit) --------------------------------------
+    def init_state(self):
+        return {"scale": jnp.asarray(self.init_loss_scaling, jnp.float32),
+                "good": jnp.zeros((), jnp.int32),
+                "bad": jnp.zeros((), jnp.int32)}
+
+    def scale_loss(self, loss, state):
+        if not self.enable:
+            return loss
+        return loss * state["scale"]
+
+    def unscale_and_check(self, grads, state):
+        """Returns (unscaled_grads, found_inf)."""
+        if not self.enable:
+            return grads, jnp.zeros((), jnp.bool_)
+        inv = 1.0 / state["scale"]
+        grads = tree_map(lambda g: g * inv, grads)
+        leaves = jax.tree_util.tree_leaves(grads)
+        found = jnp.zeros((), jnp.bool_)
+        for g in leaves:
+            found = found | ~jnp.all(jnp.isfinite(g))
+        return grads, found
+
+    def update_state(self, state, found_inf):
+        if not self.enable or not self.use_dynamic:
+            return state
+        good = jnp.where(found_inf, 0, state["good"] + 1)
+        bad = jnp.where(found_inf, state["bad"] + 1, 0)
+        scale = state["scale"]
+        scale = jnp.where(found_inf & (bad >= self.decr_every_n),
+                          jnp.maximum(scale * self.decr_ratio, 1.0), scale)
+        bad = jnp.where(bad >= self.decr_every_n, 0, bad)
+        scale = jnp.where(~found_inf & (good >= self.incr_every_n_steps),
+                          scale * self.incr_ratio, scale)
+        good = jnp.where(good >= self.incr_every_n_steps, 0, good)
+        return {"scale": scale, "good": good, "bad": bad}
+
+    def apply_or_skip(self, new_params, new_opt_state, params, opt_state,
+                      found_inf):
+        """Select updated or original params depending on found_inf — every
+        rank skips together once found_inf has been psum'd."""
+        sel = lambda new, old: tree_map(
+            lambda a, b: jnp.where(found_inf, b, a), new, old)
+        return sel(new_params, params), sel(new_opt_state, opt_state)
+
+    # -- eager-style parity API ------------------------------------------------
+    def scale(self, loss):
+        return loss * self._scale if self.enable else loss
+
+    def unscale_(self, grads):
+        state = {"scale": self._scale, "good": self._good, "bad": self._bad}
+        grads, self._found = self.unscale_and_check(grads, state)
+        return grads
+
+    def update(self):
+        state = {"scale": self._scale, "good": self._good, "bad": self._bad}
+        state = self.update_state(state, getattr(self, "_found",
+                                                 jnp.zeros((), jnp.bool_)))
+        self._scale = state["scale"]
+        self._good = state["good"]
+        self._bad = state["bad"]
+
+    def is_enable(self):
+        return self.enable
+
+    def get_loss_scaling(self):
+        return float(self._scale)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+
+    def load_state_dict(self, d):
+        self._scale = jnp.asarray(d["scale"])
+        self._good = jnp.asarray(d["good"])
+        self._bad = jnp.asarray(d["bad"])

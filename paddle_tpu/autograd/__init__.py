@@ -1,0 +1,126 @@
+"""Autograd surface (ref: python/paddle/autograd/ — paddle.grad, PyLayer,
+functional vjp/jvp; C++ engine paddle/fluid/eager/backward.cc:393).
+
+On TPU, AD is tracing-based: there is no tape, no GradNode graph, no
+TensorWrapper saved-activation machinery — jax traces the function and
+transposes it. What remains framework-level:
+
+- ``PyLayer``: user-defined forward/backward → jax.custom_vjp wrapper;
+- ``grad``/``vjp``/``jvp``/``hessian``/``jacobian`` functional API;
+- ``saved_tensors_hooks`` analog is subsumed by jax.checkpoint policies
+  (see paddle_tpu.distributed.recompute).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["grad", "value_and_grad", "vjp", "jvp", "jacobian", "hessian",
+           "PyLayer", "PyLayerContext", "no_grad", "backward"]
+
+grad = jax.grad
+value_and_grad = jax.value_and_grad
+
+
+def vjp(func, xs, v=None):
+    """ref: paddle.incubate.autograd.vjp."""
+    out, pullback = jax.vjp(func, *(xs if isinstance(xs, (list, tuple))
+                                    else (xs,)))
+    if v is None:
+        v = jnp.ones_like(out)
+    return out, pullback(v)
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else (xs,)
+    if v is None:
+        v = tuple(jnp.ones_like(x) for x in xs)
+    v = v if isinstance(v, (list, tuple)) else (v,)
+    return jax.jvp(func, tuple(xs), tuple(v))
+
+
+def jacobian(func, xs, create_graph=False):
+    return jax.jacrev(func)(xs)
+
+
+def hessian(func, xs, create_graph=False):
+    return jax.hessian(func)(xs)
+
+
+class PyLayerContext:
+    """ref: paddle.autograd.PyLayerContext — save_for_backward surface."""
+
+    def __init__(self):
+        self._saved = ()
+        self.extra = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+
+class PyLayer:
+    """User-defined differentiable function (ref: paddle.autograd.PyLayer,
+    C++ pylayer/ in eager/). Implemented over jax.custom_vjp::
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x ** 3
+            @staticmethod
+            def backward(ctx, dy):
+                x, = ctx.saved_tensor
+                return 3 * x ** 2 * dy
+
+        y = Cube.apply(x)
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        if not hasattr(cls, "_jax_fn"):
+            @jax.custom_vjp
+            def fn(*fargs):
+                ctx = PyLayerContext()
+                return cls.forward(ctx, *fargs)
+
+            def fwd(*fargs):
+                ctx = PyLayerContext()
+                out = cls.forward(ctx, *fargs)
+                return out, (ctx, fargs)
+
+            def bwd(res, g):
+                ctx, fargs = res
+                grads = cls.backward(ctx, g)
+                if not isinstance(grads, tuple):
+                    grads = (grads,)
+                # pad Nones for non-differentiable args
+                out = []
+                gi = iter(grads)
+                for a in fargs:
+                    try:
+                        out.append(next(gi))
+                    except StopIteration:
+                        out.append(jnp.zeros_like(a))
+                return tuple(
+                    jnp.zeros_like(a) if g is None else g
+                    for g, a in zip(out, fargs))
+
+            fn.defvjp(fwd, bwd)
+            cls._jax_fn = fn
+        return cls._jax_fn(*args, **kwargs)
+
+
+from paddle_tpu.framework import no_grad  # noqa: E402
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    raise RuntimeError(
+        "paddle_tpu is functional: use paddle_tpu.grad/value_and_grad on a "
+        "loss function instead of tensor.backward() "
+        "(ref eager Backward, paddle/fluid/eager/backward.cc:393 — replaced "
+        "by tracing-based AD).")

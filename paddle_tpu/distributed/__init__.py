@@ -1,0 +1,32 @@
+"""paddle_tpu.distributed (ref: python/paddle/distributed/ — 101.6k LoC).
+
+Layer map (SURVEY §5.8 mapping):
+  ProcessGroup/NCCL        → collective.py (jax.lax collectives over mesh axes)
+  TCPStore/gen_comm_id     → env.init_parallel_env (jax coordination service)
+  HybridCommunicateGroup   → mesh.init_mesh (named-axis jax Mesh)
+  fleet meta_parallel      → fleet/ (TP layers, sharding, pipeline, MoE)
+  launch CLI               → launch.py
+  auto_parallel            → GSPMD itself; shard/reshard helpers in api.py
+"""
+
+from paddle_tpu.distributed import env
+from paddle_tpu.distributed.env import (init_parallel_env, get_rank,
+                                        get_world_size, ParallelEnv,
+                                        is_initialized)
+from paddle_tpu.distributed import mesh
+from paddle_tpu.distributed.mesh import (init_mesh, get_mesh, get_topology,
+                                         HybridTopology)
+from paddle_tpu.distributed import collective
+from paddle_tpu.distributed.collective import (
+    ReduceOp, all_reduce, all_gather, all_to_all, reduce_scatter, broadcast,
+    psum, pmean, pmax, pmin, ppermute, barrier, send_recv_ring)
+from paddle_tpu.distributed.api import (shard_tensor, shard_module,
+                                        reshard, replicate)
+
+__all__ = ["env", "mesh", "collective", "init_parallel_env", "get_rank",
+           "get_world_size", "ParallelEnv", "is_initialized", "init_mesh",
+           "get_mesh", "get_topology", "HybridTopology", "ReduceOp",
+           "all_reduce", "all_gather", "all_to_all", "reduce_scatter",
+           "broadcast", "psum", "pmean", "pmax", "pmin", "ppermute",
+           "barrier", "send_recv_ring", "shard_tensor", "shard_module",
+           "reshard", "replicate"]

@@ -1,0 +1,69 @@
+"""Sharding annotation API — the GSPMD face of the reference's auto_parallel
+(``shard_tensor``/``DistAttr``, python/paddle/distributed/auto_parallel/;
+C++ mirror paddle/fluid/distributed/auto_parallel/dist_attr.h).
+
+The reference's Completer/Partitioner/Resharder pipeline (completion.py:964,
+partitioner.py:66, reshard.py:926) is replaced wholesale by XLA's sharding
+propagation: annotate a few tensors, the compiler completes the rest and
+inserts collectives.
+"""
+
+from typing import Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.mesh import get_mesh
+
+
+def _mesh_or_global(mesh):
+    m = mesh if mesh is not None else get_mesh()
+    if m is None:
+        raise RuntimeError("no mesh: call distributed.init_mesh() first")
+    return m
+
+
+def shard_tensor(x, spec, mesh: Optional[Mesh] = None):
+    """Place/annotate array with a PartitionSpec (≙ shard_tensor +
+    dims_mapping in the reference's DistAttr).
+
+    Inside jit: a sharding constraint. Outside: device_put.
+    """
+    m = _mesh_or_global(mesh)
+    sharding = NamedSharding(m, P(*spec) if isinstance(spec, (list, tuple))
+                             else spec)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
+
+
+def replicate(x, mesh: Optional[Mesh] = None):
+    m = _mesh_or_global(mesh)
+    sharding = NamedSharding(m, P())
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
+
+
+def reshard(x, spec, mesh: Optional[Mesh] = None):
+    """≙ Resharder (reshard.py:2503): move an array to a new sharding. XLA
+    emits the minimal collective (all-gather / all-to-all / slice)."""
+    return shard_tensor(x, spec, mesh)
+
+
+def shard_module(module, rules, mesh: Optional[Mesh] = None):
+    """Apply {param-path-regex: PartitionSpec} rules to a Module's params
+    (≙ the reference's per-op DistributedOperatorImpl sharding registry,
+    auto_parallel/operators/common.py:54)."""
+    import re
+    m = _mesh_or_global(mesh)
+    state = module.state_dict()
+    new_state = {}
+    for name, value in state.items():
+        spec = P()
+        for pattern, s in rules.items():
+            if re.search(pattern, name):
+                spec = P(*s) if isinstance(s, (list, tuple)) else s
+                break
+        new_state[name] = jax.device_put(value, NamedSharding(m, spec))
+    return module.merge_params(new_state)

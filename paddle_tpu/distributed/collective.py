@@ -1,0 +1,103 @@
+"""Collective communication API.
+
+Reference analog: ``paddle.distributed.{all_reduce, all_gather, …}`` backed
+by ProcessGroupNCCL (paddle/fluid/distributed/collective/ProcessGroupNCCL.cc
+— explicit comm streams, Task futures, c_sync_* ordering ops).
+
+TPU-native: collectives are *program* constructs — jax.lax primitives over
+named mesh axes inside jit/shard_map; XLA schedules them on ICI and the whole
+stream-ordering layer (c_sync_calc_stream etc., SURVEY §5.8) has no
+equivalent. These wrappers exist to (a) give reference users the same
+vocabulary, (b) centralize axis-name defaults.
+
+Inside shard_map-ed functions, `axis` accepts a mesh axis name or tuple.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_to_all",
+           "reduce_scatter", "broadcast", "psum", "pmean", "pmax", "pmin",
+           "ppermute", "axis_index", "axis_size", "send_recv_ring",
+           "barrier"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def all_reduce(x, op=ReduceOp.SUM, axis="dp"):
+    """ref: paddle.distributed.all_reduce → c_allreduce_{sum,max,min,prod}
+    (operators/collective/c_allreduce_*). Must run inside shard_map/pjit."""
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, axis)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(x), axis))
+    raise ValueError(op)
+
+
+psum = lax.psum
+pmean = lax.pmean
+pmax = lax.pmax
+pmin = lax.pmin
+ppermute = lax.ppermute
+
+
+def all_gather(x, axis="dp", tiled_axis=0):
+    """ref: c_allgather (operators/collective/c_allgather_op.cc)."""
+    return lax.all_gather(x, axis, axis=tiled_axis, tiled=True)
+
+
+def reduce_scatter(x, axis="dp", scatter_axis=0):
+    """ref: c_reducescatter."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def all_to_all(x, axis="ep", split_axis=0, concat_axis=0):
+    """ref: alltoall op / global_scatter+global_gather MoE dispatch
+    (operators/collective/global_scatter_op.cc)."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def broadcast(x, src=0, axis="dp"):
+    """ref: c_broadcast. Select src's shard and replicate."""
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def axis_index(axis):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis):
+    return lax.axis_size(axis)
+
+
+def send_recv_ring(x, axis="pp", shift=1):
+    """Neighbor exchange on a ring (ref: send_v2/recv_v2 micro-batch P2P;
+    on TPU a collective-permute rides ICI)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def barrier(axis=None):
+    """ref: barrier op. Inside SPMD programs ordering is data-flow-driven;
+    host-level barrier syncs all host processes."""
+    if axis is None:
+        import jax.experimental.multihost_utils as mhu
+        mhu.sync_global_devices("paddle_tpu_barrier")

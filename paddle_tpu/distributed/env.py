@@ -1,0 +1,83 @@
+"""Process-level distributed environment.
+
+Reference analog: the launch env contract (PADDLE_TRAINER_ID,
+PADDLE_TRAINER_ENDPOINTS set by python/paddle/distributed/launch/main.py:18)
+plus TCPStore rendezvous (paddle/fluid/distributed/store/tcp_store.cc).
+
+TPU-native: ``jax.distributed.initialize`` is the coordination service (it
+replaces TCPStore + gen_comm_id entirely); one *process* per host drives all
+local chips, and in-program communication is XLA collectives — so "rank"
+here is the host-process index, not a per-chip rank.
+"""
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """ref: paddle.distributed.init_parallel_env (distributed/parallel.py:98).
+
+    Single-process (the common TPU case — all local chips visible): no-op.
+    Multi-host: wires jax.distributed.initialize from args or the
+    PT_COORDINATOR/PT_NUM_PROCESSES/PT_PROCESS_ID env contract set by
+    ``paddle_tpu.distributed.launch``.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "PT_COORDINATOR")
+    if coordinator_address:
+        num_processes = num_processes or int(os.environ["PT_NUM_PROCESSES"])
+        process_id = process_id if process_id is not None else int(
+            os.environ["PT_PROCESS_ID"])
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    _initialized = True
+
+
+def get_rank():
+    """Host-process index (ref: paddle.distributed.get_rank)."""
+    return jax.process_index()
+
+
+def get_world_size():
+    """Number of host processes (ref: paddle.distributed.get_world_size
+    counts chips; here chips-per-process × process_count = chip world)."""
+    return jax.process_count()
+
+
+def get_chip_count():
+    return jax.device_count()
+
+
+def is_initialized():
+    return _initialized
+
+
+class ParallelEnv:
+    """ref: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

@@ -1,0 +1,342 @@
+"""Probability distributions (ref: python/paddle/distribution/ — Normal,
+Uniform, Beta, Categorical, Dirichlet, …, kl_divergence). Built on
+jax.random + jax.scipy.stats."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from paddle_tpu import random as pt_random
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
+           "Dirichlet", "Exponential", "Gamma", "Laplace", "Bernoulli",
+           "Gumbel", "LogNormal", "Multinomial", "kl_divergence"]
+
+
+def _key(key):
+    return key if key is not None else pt_random.next_key()
+
+
+class Distribution:
+    def sample(self, shape=(), key=None):
+        raise NotImplementedError
+
+    def rsample(self, shape=(), key=None):
+        return self.sample(shape, key)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.normal(_key(key), shape)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        var = self.scale ** 2
+        return -((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) \
+            - 0.5 * math.log(2 * math.pi)
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+
+class LogNormal(Normal):
+    def sample(self, shape=(), key=None):
+        return jnp.exp(super().sample(shape, key))
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        return super().log_prob(jnp.log(v)) - jnp.log(v)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        return jax.random.uniform(_key(key), shape) * (
+            self.high - self.low) + self.low
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        inside = (v >= self.low) & (v < self.high)
+        return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs = jnp.asarray(probs, jnp.float32)
+        else:
+            self.probs = jax.nn.sigmoid(jnp.asarray(logits, jnp.float32))
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.probs.shape
+        return jax.random.bernoulli(_key(key), self.probs,
+                                    shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = jnp.asarray(logits, jnp.float32)
+        else:
+            self.logits = jnp.log(jnp.asarray(probs, jnp.float32) + 1e-12)
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.categorical(_key(key), self.logits,
+                                      shape=tuple(shape)
+                                      + self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        idx = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = jnp.asarray(alpha, jnp.float32)
+        self.beta = jnp.asarray(beta, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                    self.beta.shape)
+        return jax.random.beta(_key(key), self.alpha, self.beta, shape)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        return ((self.alpha - 1) * jnp.log(v)
+                + (self.beta - 1) * jnp.log1p(-v)
+                - (jsp.gammaln(self.alpha) + jsp.gammaln(self.beta)
+                   - jsp.gammaln(self.alpha + self.beta)))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s ** 2 * (s + 1))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        return jax.random.dirichlet(_key(key), self.concentration,
+                                    tuple(shape))
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        a = self.concentration
+        return (jnp.sum((a - 1) * jnp.log(v), axis=-1)
+                + jsp.gammaln(jnp.sum(a, -1))
+                - jnp.sum(jsp.gammaln(a), axis=-1))
+
+    @property
+    def mean(self):
+        return self.concentration / jnp.sum(self.concentration, -1,
+                                            keepdims=True)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.rate.shape
+        return jax.random.exponential(_key(key), shape) / self.rate
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        return jnp.log(self.rate) - self.rate * v
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / self.rate ** 2
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape)
+        return jax.random.gamma(_key(key), self.concentration,
+                                shape) / self.rate
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        a, b = self.concentration, self.rate
+        return a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jsp.gammaln(a)
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.laplace(_key(key), shape)
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        return -jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2 * self.scale ** 2
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.gumbel(_key(key), shape)
+
+    def log_prob(self, value):
+        z = (jnp.asarray(value) - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.5772156649015329
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = total_count
+        self.probs = jnp.asarray(probs, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        logits = jnp.log(self.probs + 1e-12)
+        draws = jax.random.categorical(
+            _key(key), logits,
+            shape=tuple(shape) + (self.total_count,)
+            + self.probs.shape[:-1])
+        n = self.probs.shape[-1]
+        onehot = jax.nn.one_hot(draws, n)
+        return jnp.sum(onehot, axis=len(shape))
+
+    def log_prob(self, value):
+        v = jnp.asarray(value)
+        return (jsp.gammaln(jnp.asarray(self.total_count + 1.0))
+                - jnp.sum(jsp.gammaln(v + 1.0), -1)
+                + jnp.sum(v * jnp.log(self.probs + 1e-12), -1))
+
+
+def kl_divergence(p, q):
+    """ref: paddle.distribution.kl_divergence (kl.py registry)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp = jax.nn.log_softmax(p.logits, -1)
+        lq = jax.nn.log_softmax(q.logits, -1)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+        return pp * jnp.log(pp / qq) + (1 - pp) * jnp.log((1 - pp) / (1 - qq))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return jnp.log((q.high - q.low) / (p.high - p.low))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,55 @@
+"""Dtype aliases and the default-dtype policy.
+
+Reference analog: phi::DataType (paddle/phi/common/data_type.h) and
+paddle.set_default_dtype (python/paddle/framework/framework.py).
+On TPU the preferred compute dtype is bfloat16; float32 stays the default
+for parameter math unless the user opts in via AMP (paddle_tpu.amp).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import flags
+
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME2DTYPE = {
+    "bfloat16": bfloat16, "float16": float16, "float32": float32,
+    "float64": float64, "int8": int8, "int16": int16, "int32": int32,
+    "int64": int64, "uint8": uint8, "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+
+def to_dtype(d):
+    """Normalize a dtype-ish (str, np.dtype, jnp dtype) to a numpy dtype."""
+    if isinstance(d, str):
+        d = _NAME2DTYPE[d]
+    return np.dtype(d)
+
+
+def set_default_dtype(d) -> None:
+    d = to_dtype(d)
+    if d not in (np.dtype(np.float32), np.dtype(np.float64),
+                 np.dtype(jnp.bfloat16), np.dtype(np.float16)):
+        raise ValueError(f"default dtype must be floating, got {d}")
+    flags.set_flags({"default_dtype": d.name})
+
+
+def get_default_dtype():
+    return to_dtype(flags.get_flag("default_dtype"))
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(to_dtype(d), jnp.floating)

@@ -1,0 +1,29 @@
+"""FFT ops (ref: python/paddle/fft.py → phi fft kernels over cuFFT; here
+jnp.fft over XLA's FFT HLO)."""
+
+import jax.numpy as jnp
+
+_j = jnp.fft
+
+fft = _j.fft
+ifft = _j.ifft
+fft2 = _j.fft2
+ifft2 = _j.ifft2
+fftn = _j.fftn
+ifftn = _j.ifftn
+rfft = _j.rfft
+irfft = _j.irfft
+rfft2 = _j.rfft2
+irfft2 = _j.irfft2
+rfftn = _j.rfftn
+irfftn = _j.irfftn
+hfft = _j.hfft
+ihfft = _j.ihfft
+fftfreq = _j.fftfreq
+rfftfreq = _j.rfftfreq
+fftshift = _j.fftshift
+ifftshift = _j.ifftshift
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]

@@ -1,0 +1,105 @@
+"""Typed runtime flag system.
+
+Reference analog: gflags + `PADDLE_DEFINE_EXPORTED_*`
+(paddle/fluid/platform/flags.cc, 74 definitions) exposed to Python via
+`paddle.set_flags/get_flags` (paddle/fluid/pybind/global_value_getter_setter.cc:212).
+
+Here flags are plain typed Python registrations, overridable via environment
+variables ``PT_FLAGS_<NAME>`` at import time and ``set_flags`` at runtime.
+"""
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    validator: Optional[Callable[[Any], bool]] = None
+    value: Any = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _coerce(ftype: type, raw: Any) -> Any:
+    if ftype is bool and isinstance(raw, str):
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ftype(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                ftype: Optional[type] = None,
+                validator: Optional[Callable[[Any], bool]] = None) -> None:
+    """Register a flag. Environment ``PT_FLAGS_<NAME>`` overrides the default."""
+    ftype = ftype or type(default)
+    env = os.environ.get("PT_FLAGS_" + name.upper())
+    value = _coerce(ftype, env) if env is not None else default
+    with _lock:
+        _REGISTRY[name] = _Flag(name, default, ftype, help, validator, value)
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    """Read flag values. ``names`` may be a str, list of str, or None (=all)."""
+    if names is None:
+        names = list(_REGISTRY)
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        if n not in _REGISTRY:
+            raise KeyError(f"unknown flag {n!r}")
+        out[n] = _REGISTRY[n].value
+    return out
+
+
+def get_flag(name: str) -> Any:
+    return get_flags(name)[name]
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    with _lock:
+        for name, val in flags.items():
+            if name not in _REGISTRY:
+                raise KeyError(f"unknown flag {name!r}")
+            f = _REGISTRY[name]
+            val = _coerce(f.type, val)
+            if f.validator is not None and not f.validator(val):
+                raise ValueError(f"invalid value {val!r} for flag {name!r}")
+            f.value = val
+
+
+def describe_flags() -> str:
+    lines = []
+    for f in sorted(_REGISTRY.values(), key=lambda f: f.name):
+        lines.append(f"{f.name} (={f.value!r}, default {f.default!r}): {f.help}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Core framework flags (analogs of paddle/fluid/platform/flags.cc entries).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Sweep op outputs for NaN/Inf during training "
+            "(ref: FLAGS_check_nan_inf, framework/details/nan_inf_utils_detail.cc).")
+define_flag("benchmark", False, "Synchronize and time each step.")
+define_flag("matmul_precision", "default",
+            "Precision for matmul/conv on TPU: default|high|highest "
+            "(maps to jax.lax.Precision).")
+define_flag("default_dtype", "float32", "Default floating dtype for creation ops.")
+define_flag("conv_workspace_limit_mb", 512,
+            "Kept for API parity; XLA manages conv scratch itself.")
+define_flag("use_pallas_kernels", True,
+            "Use Pallas TPU kernels for fused ops (flash attention etc.) "
+            "when running on TPU; falls back to XLA-fused reference impls.")
+define_flag("log_level", "warning", "Framework log level.")
+define_flag("allocator_strategy", "xla",
+            "Kept for API parity (ref auto_growth/naive_best_fit); on TPU the "
+            "XLA/PJRT runtime owns HBM allocation.")

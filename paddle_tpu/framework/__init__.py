@@ -1,0 +1,89 @@
+"""Core framework surface: Tensor type, jit, autodiff entry points, devices.
+
+Reference analog: python/paddle/framework/ + the dygraph/static dichotomy.
+Here there is one execution model — trace to XLA — so `jit` is jax.jit with
+framework policy applied, and eager execution is jax op-by-op dispatch (which
+is itself compiled per-op, ref contrast: per-op KernelFactory dispatch,
+paddle/phi/core/kernel_factory.h:268).
+"""
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tensor.creation import to_tensor
+from paddle_tpu.tensor.logic import is_tensor
+
+Tensor = jax.Array
+
+
+def jit(fn=None, *, static_argnums=None, static_argnames=None, donate_argnums=None,
+        **kwargs):
+    """Compile a function to a single TPU executable (ref ambition:
+    static-graph mode / @to_static, python/paddle/fluid/dygraph/jit.py).
+    Thin policy wrapper over jax.jit."""
+    if fn is None:
+        return functools.partial(jit, static_argnums=static_argnums,
+                                 static_argnames=static_argnames,
+                                 donate_argnums=donate_argnums, **kwargs)
+    return jax.jit(fn, static_argnums=static_argnums,
+                   static_argnames=static_argnames,
+                   donate_argnums=donate_argnums, **kwargs)
+
+
+def stop_gradient(x):
+    """ref: Tensor.stop_gradient attribute; functional here."""
+    return jax.lax.stop_gradient(x)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """API-parity context (ref: paddle.no_grad). In a functional-AD framework
+    gradients only flow where jax.grad is applied, so this is a no-op marker
+    kept so reference code ports cleanly."""
+    yield
+
+
+def grad(fn, argnums=0, has_aux=False):
+    """Functional gradient (ref: paddle.grad / eager Backward,
+    paddle/fluid/eager/backward.cc:393 — replaced by tracing-based AD)."""
+    return jax.grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def value_and_grad(fn, argnums=0, has_aux=False):
+    return jax.value_and_grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def devices():
+    return jax.devices()
+
+
+def device_count():
+    return jax.device_count()
+
+
+_current_device = None
+
+
+def set_device(device):
+    """ref: paddle.set_device. Accepts 'tpu', 'cpu', 'tpu:0' etc."""
+    global _current_device
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    plat = {"gpu": "tpu", "xpu": "tpu", "npu": "tpu"}.get(name, name)
+    _current_device = jax.devices(plat)[idx]
+    return _current_device
+
+
+def get_device():
+    if _current_device is not None:
+        d = _current_device
+    else:
+        d = jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'id', 0)}"
+
+
+def default_device():
+    return _current_device or jax.devices()[0]

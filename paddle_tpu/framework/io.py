@@ -1,0 +1,59 @@
+"""Object save/load (ref: paddle.save/load, python/paddle/framework/io.py:640
+/870 — pickle of state_dict structures; phi save/load kernels for static
+tensors).
+
+Format: a msgpack-free, numpy-based pickle with jax arrays converted to host
+numpy on save and restored as jnp arrays on load. Distributed/sharded
+checkpointing (per-shard save + resharding on load) lives in
+paddle_tpu.distributed.checkpoint.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["save", "load"]
+
+_MAGIC = b"PTPU1\n"
+
+
+def _to_host(obj):
+    def cvt(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        return x
+    return jax.tree_util.tree_map(cvt, obj)
+
+
+def _to_device(obj):
+    def cvt(x):
+        if isinstance(x, np.ndarray):
+            return jnp.asarray(x)
+        return x
+    return jax.tree_util.tree_map(cvt, obj)
+
+
+def save(obj, path, protocol=4):
+    """ref: paddle.save. Saves any pytree (state_dicts, opt state, ...)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _to_host(obj)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path, return_numpy=False):
+    """ref: paddle.load."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return _to_device(obj)

@@ -1,0 +1,64 @@
+"""Graph learning ops (ref: python/paddle/geometric/ — message passing
+send_u_recv etc.; phi graph_send_recv kernels). On TPU these are
+segment-reduction ops."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_sum(jnp.asarray(data), jnp.asarray(segment_ids),
+                               num_segments=n)
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    s = jax.ops.segment_sum(jnp.asarray(data), jnp.asarray(segment_ids),
+                            num_segments=n)
+    c = jax.ops.segment_sum(jnp.ones_like(jnp.asarray(data)),
+                            jnp.asarray(segment_ids), num_segments=n)
+    return s / jnp.maximum(c, 1)
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_max(jnp.asarray(data), jnp.asarray(segment_ids),
+                               num_segments=n)
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    n = num_segments or int(jnp.max(segment_ids)) + 1
+    return jax.ops.segment_min(jnp.asarray(data), jnp.asarray(segment_ids),
+                               num_segments=n)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    """ref: paddle.geometric.send_u_recv (graph_send_recv kernel)."""
+    x = jnp.asarray(x)
+    gathered = x[jnp.asarray(src_index)]
+    n = out_size or x.shape[0]
+    red = {"sum": segment_sum, "mean": segment_mean, "max": segment_max,
+           "min": segment_min}[reduce_op]
+    return red(gathered, dst_index, n)
+
+
+def send_ue_recv(x, e, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None):
+    x = jnp.asarray(x)
+    e = jnp.asarray(e)
+    msg = x[jnp.asarray(src_index)]
+    msg = msg + e if message_op == "add" else msg * e
+    n = out_size or x.shape[0]
+    red = {"sum": segment_sum, "mean": segment_mean, "max": segment_max,
+           "min": segment_min}[reduce_op]
+    return red(msg, dst_index, n)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add"):
+    x = jnp.asarray(x)[jnp.asarray(src_index)]
+    y = jnp.asarray(y)[jnp.asarray(dst_index)]
+    return x + y if message_op == "add" else x * y

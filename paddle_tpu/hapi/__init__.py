@@ -1,0 +1,5 @@
+from paddle_tpu.hapi.model import Model
+from paddle_tpu.hapi.summary import summary, flops
+from paddle_tpu.hapi import callbacks
+
+__all__ = ["Model", "summary", "flops", "callbacks"]

@@ -1,0 +1,240 @@
+"""High-level Model API (ref: python/paddle/hapi/model.py — paddle.Model
+:1009, fit:1686 with Static/Dynamic adapters :306/:776).
+
+One adapter only: everything compiles through jit. ``prepare`` builds the
+jitted train/eval steps (donating params/opt-state so updates are in-place
+in HBM); ``fit`` runs the loop with callbacks/metrics.
+"""
+
+import time
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import callbacks as cbks_mod
+from paddle_tpu.metric import Metric
+
+
+class Model:
+    """ref: paddle.Model."""
+
+    def __init__(self, network: nn.Module, inputs=None, labels=None):
+        self.network = network.tag_paths()
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_step = None
+        self._params = None
+        self._opt_state = None
+        self.stop_training = False
+
+    # -- prepare ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        self._params, _ = self.network.split_params()
+        if optimizer is not None:
+            self._opt_state = optimizer.init(self._params)
+        self._build_steps()
+
+    def _build_steps(self):
+        net = self.network
+        loss_fn = self._loss
+        opt = self._optimizer
+
+        def forward_loss(params, buffers, x, y, key):
+            model = net.merge_params({**buffers, **params})
+            with nn.stateful(training=True, rng=key) as ctx:
+                out = model(x)
+                loss = loss_fn(out, y)
+            return loss, (out, ctx.updates)
+
+        def train_step(params, opt_state, buffers, x, y, key):
+            (loss, (out, updates)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(params, buffers, x, y, key)
+            new_params, new_opt_state = opt.update(grads, opt_state, params)
+            return loss, out, new_params, new_opt_state, updates
+
+        def eval_step(params, buffers, x, y):
+            model = net.merge_params({**buffers, **params})
+            with nn.stateful(training=False):
+                out = model(x)
+                loss = loss_fn(out, y) if loss_fn is not None else jnp.zeros(())
+            return loss, out
+
+        def predict_step(params, buffers, x):
+            model = net.merge_params({**buffers, **params})
+            with nn.stateful(training=False):
+                return model(x)
+
+        self._train_step = jax.jit(train_step) if opt is not None else None
+        self._eval_step = jax.jit(eval_step)
+        self._predict_step = jax.jit(predict_step)
+
+    def _buffers(self):
+        return dict(self.network.named_buffers())
+
+    def _sync_network(self):
+        """Write current params back into the Module (checkpoint/state_dict)."""
+        if self._params is not None:
+            self.network = self.network.merge_params(self._params)
+
+    # -- loops -----------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        from paddle_tpu import random as pt_random
+        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple))
+                        else inputs)
+        y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple))
+                        else labels)
+        key = pt_random.next_key()
+        loss, out, new_p, new_s, updates = self._train_step(
+            self._params, self._opt_state, self._buffers(), x, y, key)
+        if update:
+            self._params, self._opt_state = new_p, new_s
+            if updates:
+                self.network = self.network.apply_updates(updates)
+        metrics = [float(loss)]
+        for m in self._metrics:
+            res = m.compute(np.asarray(out), np.asarray(y))
+            m.update(*[np.asarray(r) for r in (res if isinstance(res, tuple)
+                                               else (res,))])
+            metrics.append(m.accumulate())
+        return metrics[0] if len(metrics) == 1 else metrics
+
+    def eval_batch(self, inputs, labels=None):
+        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple))
+                        else inputs)
+        y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple))
+                        else labels)
+        loss, out = self._eval_step(self._params, self._buffers(), x, y)
+        return float(loss), out
+
+    def predict_batch(self, inputs):
+        x = jnp.asarray(inputs[0] if isinstance(inputs, (list, tuple))
+                        else inputs)
+        return self._predict_step(self._params, self._buffers(), x)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks: Optional[List] = None, accumulate_grad_batches=1,
+            num_iters=None):
+        """ref: Model.fit (hapi/model.py:1686)."""
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = DataLoader(eval_data, batch_size=batch_size) \
+                if isinstance(eval_data, Dataset) else eval_data
+
+        cbks = cbks_mod.CallbackList(callbacks or
+                                     [cbks_mod.ProgBarLogger(log_freq,
+                                                             verbose)])
+        cbks.set_model(self)
+        cbks.on_begin("train")
+        history = []
+        it_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(train_loader):
+                x, y = batch[0], batch[1]
+                cbks.on_batch_begin("train", step, {})
+                res = self.train_batch(x, y)
+                loss = res[0] if isinstance(res, list) else res
+                logs = {"loss": loss, "step": step}
+                cbks.on_batch_end("train", step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            train_logs = {"loss": loss}
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_res = self.evaluate(eval_loader, verbose=0)
+                train_logs.update({f"val_{k}": v
+                                   for k, v in eval_res.items()})
+            history.append(train_logs)
+            cbks.on_epoch_end(epoch, train_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if self.stop_training or (num_iters is not None
+                                      and it_count >= num_iters):
+                break
+        cbks.on_end("train")
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1]
+            loss, out = self.eval_batch(x, y)
+            losses.append(loss)
+            for m in self._metrics:
+                res = m.compute(np.asarray(out), np.asarray(y))
+                m.update(*[np.asarray(r)
+                           for r in (res if isinstance(res, tuple)
+                                     else (res,))])
+        out_logs = {"loss": float(np.mean(losses))}
+        for m in self._metrics:
+            out_logs[m.name()] = m.accumulate()
+        return out_logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.io.dataset import Dataset
+        loader = DataLoader(test_data, batch_size=batch_size) \
+            if isinstance(test_data, Dataset) else test_data
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(np.asarray(self.predict_batch(x)))
+        if stack_outputs:
+            return np.concatenate(outs, axis=0)
+        return outs
+
+    # -- persistence -----------------------------------------------------------
+    def save(self, path, training=True):
+        from paddle_tpu.framework.io import save as obj_save
+        self._sync_network()
+        obj_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._opt_state is not None:
+            obj_save({"opt": self._opt_state}, path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from paddle_tpu.framework.io import load as obj_load
+        state = obj_load(path + ".pdparams")
+        self.network.set_state_dict(state, strict=not skip_mismatch)
+        self._params, _ = self.network.split_params()
+        import os
+        if not reset_optimizer and os.path.exists(path + ".pdopt") and \
+                self._optimizer is not None:
+            self._opt_state = obj_load(path + ".pdopt")["opt"]
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from paddle_tpu.hapi.summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,29 @@
+"""paddle.hub parity (ref: python/paddle/hapi/hub.py). Zero-egress build:
+local-directory sources only."""
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(repo_dir)
+    return [k for k in dir(mod) if callable(getattr(mod, k))
+            and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    return getattr(_load_hubconf(repo_dir), model)(**kwargs)

@@ -1,0 +1,10 @@
+"""Incubate (ref: python/paddle/incubate/ — MoE, fused transformer layers,
+ASP sparsity, LookAhead/ModelAverage, DistributedFusedLamb).
+
+MoE lives in paddle_tpu.distributed.moe (first-class, not incubating, on
+TPU); fused layers in incubate.nn map onto the Pallas kernel inventory."""
+
+from paddle_tpu.incubate import nn
+from paddle_tpu.incubate import asp
+
+__all__ = ["nn", "asp"]

@@ -1,0 +1,41 @@
+"""2:4 structured sparsity (ref: python/paddle/incubate/asp/ — ASP pruning
+masks). Functional mask computation; TPU kernels consume the dense masked
+weights (XLA has no sparse MMA like Ampere; the mask still gives model-size
+and regularization parity)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["calculate_density", "create_mask", "check_mask_2d",
+           "prune_model"]
+
+
+def calculate_density(x):
+    x = np.asarray(x)
+    return float((x != 0).sum() / x.size)
+
+
+def create_mask(tensor, func_name="mask_2d_best", n=2, m=4):
+    """2:4 mask along the last axis groups of m."""
+    t = np.asarray(tensor)
+    shape = t.shape
+    flat = t.reshape(-1, m)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=1)
+    return jnp.asarray(mask.reshape(shape))
+
+
+def check_mask_2d(mask, n=2, m=4):
+    mk = np.asarray(mask).reshape(-1, m)
+    return bool((mk.sum(1) <= n).all())
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_2d_best", with_mask=True):
+    """Apply 2:4 masks to all 2-D+ params of a Module."""
+    new_state = {}
+    for name, p in model.state_dict().items():
+        if hasattr(p, "ndim") and p.ndim >= 2:
+            mask = create_mask(p, mask_algo, n, m)
+            new_state[name] = jnp.asarray(p) * mask
+    return model.merge_params(new_state)

@@ -1,0 +1,82 @@
+"""Fused layers (ref: python/paddle/incubate/nn/layer/fused_transformer.py —
+FusedMultiHeadAttention:191, FusedFeedForward:478,
+FusedTransformerEncoderLayer:706, FusedMultiTransformer:997; CUDA kernels
+operators/fused/). On TPU "fused" means: flash-attention Pallas kernel +
+XLA-fused epilogues; these classes provide the reference API shape."""
+
+from paddle_tpu.nn.layer.transformer import (MultiHeadAttention,
+                                             TransformerEncoderLayer)
+from paddle_tpu.nn.module import Module, LayerList
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+
+
+class FusedMultiHeadAttention(MultiHeadAttention):
+    """ref: incubate FusedMultiHeadAttention:191 → fused_attention_op.cu.
+    Same math; the TPU fusion is the Pallas flash-attention path inside
+    scaled_dot_product_attention."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 **kwargs):
+        super().__init__(embed_dim, num_heads, dropout=attn_dropout_rate,
+                         kdim=kdim, vdim=vdim, need_weights=need_weights)
+
+
+class FusedFeedForward(Module):
+    """ref: incubate FusedFeedForward:478 → fused_feedforward_op.cu."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", act_dropout_rate=None,
+                 normalize_before=False, **kwargs):
+        super().__init__()
+        from paddle_tpu.nn.layer.common import Linear, Dropout
+        from paddle_tpu.nn.layer.norm import LayerNorm
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm = LayerNorm(d_model)
+        self.dropout = Dropout(dropout_rate)
+        self.act_dropout = Dropout(act_dropout_rate if act_dropout_rate
+                                   is not None else dropout_rate)
+        self.activation = activation
+
+    def forward(self, src):
+        from paddle_tpu.nn import functional as F
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        act = getattr(F, self.activation)
+        out = self.linear2(self.act_dropout(act(self.linear1(src))))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(TransformerEncoderLayer):
+    """ref: incubate FusedTransformerEncoderLayer:706."""
+
+
+class FusedMultiTransformer(Module):
+    """ref: incubate FusedMultiTransformer:997 → fused_multi_transformer_op.cu
+    (the inference hot path). Stacked pre-LN decoder blocks sharing one
+    weight layout, compiled as one XLA program."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1,
+                 **kwargs):
+        super().__init__()
+        self.blocks = LayerList([
+            TransformerEncoderLayer(embed_dim, num_heads, dim_feedforward,
+                                    dropout_rate, activation,
+                                    normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None):
+        out = src
+        for blk in self.blocks:
+            out = blk(out, src_mask=attn_mask)
+        return out

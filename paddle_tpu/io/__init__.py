@@ -1,0 +1,13 @@
+from paddle_tpu.io.dataset import (Dataset, IterableDataset, TensorDataset,
+                                   ComposeDataset, ChainDataset, Subset,
+                                   random_split)
+from paddle_tpu.io.sampler import (Sampler, SequenceSampler, RandomSampler,
+                                   WeightedRandomSampler, BatchSampler,
+                                   DistributedBatchSampler)
+from paddle_tpu.io.dataloader import DataLoader, get_worker_info
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+           "ChainDataset", "Subset", "random_split", "Sampler",
+           "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+           "BatchSampler", "DistributedBatchSampler", "DataLoader",
+           "get_worker_info"]

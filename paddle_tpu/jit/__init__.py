@@ -1,0 +1,97 @@
+"""paddle_tpu.jit — dynamic-to-static (ref: @paddle.jit.to_static,
+python/paddle/fluid/dygraph/dygraph_to_static/ ~20 AST transformers +
+program_translator cache + partial_program run_program_op).
+
+None of that machinery is needed on TPU: Python *is* the tracer. ``to_static``
+is jax.jit with InputSpec-driven AOT lowering; ``save``/``load`` export
+StableHLO via jax.export (≙ save_inference_model + C++ jit::Layer,
+paddle/fluid/jit/layer.h:44). The module itself is callable:
+``paddle_tpu.jit(fn)`` == ``to_static(fn)``.
+"""
+
+import os
+import pickle
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_static", "save", "load", "not_to_static", "TranslatedLayer"]
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """ref: paddle.jit.to_static (dygraph/jit.py). Returns a compiled
+    callable; with input_spec, lowering happens eagerly (AOT)."""
+    def decorate(fn):
+        target = fn.forward if hasattr(fn, "forward") else fn
+        jitted = jax.jit(target)
+        if input_spec is not None:
+            from paddle_tpu.static import InputSpec
+            structs = [s.to_shape_struct() if isinstance(s, InputSpec) else s
+                       for s in input_spec]
+            jitted.lower(*structs)  # warm the AOT cache
+        return jitted
+    if function is None:
+        return decorate
+    return decorate(function)
+
+
+def not_to_static(fn):
+    return fn
+
+
+class TranslatedLayer:
+    """Loaded exported model (≙ C++ jit::Layer / TranslatedLayer)."""
+
+    def __init__(self, exported, extra=None):
+        self._exported = exported
+        self.extra = extra or {}
+
+    def __call__(self, *args):
+        return self._exported.call(*[jnp.asarray(a) for a in args])
+
+    @property
+    def stablehlo(self):
+        return self._exported.mlir_module()
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export a function/Module to .ptexport (serialized StableHLO +
+    metadata). ref: paddle.jit.save → __model__ + params files."""
+    from jax import export as jax_export
+    from paddle_tpu.static import InputSpec
+
+    fn = layer.forward if hasattr(layer, "forward") else layer
+    if input_spec is None:
+        raise ValueError("input_spec is required for AOT export")
+    structs = [s.to_shape_struct() if isinstance(s, InputSpec) else s
+               for s in input_spec]
+    exported = jax_export.export(jax.jit(fn))(*structs)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".ptexport", "wb") as f:
+        pickle.dump({"stablehlo": bytes(blob)}, f)
+    # params saved separately when layer is a Module
+    if hasattr(layer, "state_dict"):
+        from paddle_tpu.framework.io import save as obj_save
+        obj_save(layer.state_dict(), path + ".pdparams")
+    return path + ".ptexport"
+
+
+def load(path, **configs):
+    from jax import export as jax_export
+    p = path if path.endswith(".ptexport") else path + ".ptexport"
+    with open(p, "rb") as f:
+        data = pickle.load(f)
+    exported = jax_export.deserialize(bytearray(data["stablehlo"]))
+    return TranslatedLayer(exported)
+
+
+class _CallableModule(types.ModuleType):
+    def __call__(self, fn=None, **kwargs):
+        return to_static(fn, **kwargs)
+
+
+sys.modules[__name__].__class__ = _CallableModule

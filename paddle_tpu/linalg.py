@@ -1,0 +1,14 @@
+"""paddle_tpu.linalg namespace (ref: paddle.linalg re-exporting
+tensor/linalg.py functions)."""
+
+from paddle_tpu.tensor.linalg import (  # noqa: F401
+    matmul, mm, bmm, dot, mv, t, norm, cond, det, slogdet, inv, pinv, solve,
+    triangular_solve, cholesky, cholesky_solve, lu, qr, svd, eig, eigh,
+    eigvals, eigvalsh, matrix_power, matrix_rank, multi_dot, cross,
+    histogram, bincount, einsum, lstsq, corrcoef, cov)
+
+__all__ = ["matmul", "mm", "bmm", "dot", "mv", "t", "norm", "cond", "det",
+           "slogdet", "inv", "pinv", "solve", "triangular_solve", "cholesky",
+           "cholesky_solve", "lu", "qr", "svd", "eig", "eigh", "eigvals",
+           "eigvalsh", "matrix_power", "matrix_rank", "multi_dot", "cross",
+           "histogram", "bincount", "einsum", "lstsq", "corrcoef", "cov"]

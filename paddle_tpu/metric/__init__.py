@@ -1,0 +1,164 @@
+"""Metrics (ref: python/paddle/metric/metrics.py — Metric base, Accuracy,
+Precision, Recall, Auc)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1):  # noqa: A002
+    """ref: paddle.metric.accuracy."""
+    x = jnp.asarray(input)
+    label = jnp.asarray(label)
+    if label.ndim == x.ndim:
+        label = label[..., 0]
+    topk_idx = jnp.argsort(-x, axis=-1)[..., :k]
+    correct = jnp.any(topk_idx == label[..., None], axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return type(self).__name__.lower()
+
+    def compute(self, pred, label):
+        return pred, label
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name="acc"):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.correct = np.zeros(len(self.topk))
+        self.total = 0
+
+    def compute(self, pred, label):
+        pred = np.asarray(pred)
+        label = np.asarray(label)
+        if label.ndim == pred.ndim:
+            label = label[..., 0]
+        order = np.argsort(-pred, axis=-1)
+        return order, label
+
+    def update(self, correct_or_order, label=None):
+        if label is None:
+            # pre-computed correctness matrix
+            c = np.asarray(correct_or_order)
+            self.correct[0] += c.sum()
+            self.total += c.shape[0]
+            return c.mean()
+        order = np.asarray(correct_or_order)
+        label = np.asarray(label)
+        for i, k in enumerate(self.topk):
+            hit = (order[..., :k] == label[..., None]).any(-1)
+            self.correct[i] += hit.sum()
+        self.total += label.shape[0]
+        return self.correct / max(self.total, 1)
+
+    def accumulate(self):
+        res = (self.correct / max(self.total, 1)).tolist()
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds) > 0.5
+        labels = np.asarray(labels).astype(bool)
+        self.tp += int(np.sum(preds & labels))
+        self.fp += int(np.sum(preds & ~labels))
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds) > 0.5
+        labels = np.asarray(labels).astype(bool)
+        self.tp += int(np.sum(preds & labels))
+        self.fn += int(np.sum(~preds & labels))
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """Streaming AUC via thresholded confusion bins (ref: metrics.py Auc /
+    framework/fleet/metrics.cc)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.stat_pos = np.zeros(self.num_thresholds + 1)
+        self.stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2:
+            preds = preds[:, -1]
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(int), 0,
+                      self.num_thresholds)
+        for i, l in zip(idx, labels):
+            if l:
+                self.stat_pos[i] += 1
+            else:
+                self.stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self.stat_pos.sum()
+        tot_neg = self.stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # integrate from highest threshold down
+        tp = np.cumsum(self.stat_pos[::-1])
+        fp = np.cumsum(self.stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name

@@ -1,0 +1,24 @@
+"""paddle_tpu.nn — layers (ref: python/paddle/nn/, ~31k LoC layer zoo).
+
+The Module base is a JAX pytree (see module.py) so models pass directly
+through jit/grad/vmap/pjit; layers mirror the reference's class surface.
+"""
+
+from paddle_tpu.nn.module import (Buffer, Context, LayerDict, LayerList,
+                                  Module, Parameter, Sequential,
+                                  current_context, is_training, stateful)
+
+Layer = Module  # reference name (paddle.nn.Layer)
+
+from paddle_tpu.nn import functional  # noqa: E402
+from paddle_tpu.nn import initializer  # noqa: E402
+from paddle_tpu.nn import utils  # noqa: E402
+
+from paddle_tpu.nn.layer.common import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.layer.conv import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.layer.norm import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.layer.activation import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.layer.pooling import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.layer.loss import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.layer.transformer import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.layer.rnn import *  # noqa: F401,F403,E402

@@ -1,0 +1,203 @@
+"""Activation functions (ref: python/paddle/nn/functional/activation.py, 28
+classes' functional mirrors). All fuse into surrounding XLA computations."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor._gen import _sample
+
+__all__ = ["celu", "elu", "gelu", "glu", "hardshrink", "hardsigmoid",
+           "hardswish", "hardtanh", "leaky_relu", "log_sigmoid", "log_softmax",
+           "maxout", "mish", "prelu", "relu", "relu6", "rrelu", "selu", "silu",
+           "sigmoid", "softmax", "softplus", "softshrink", "softsign",
+           "swish", "tanhshrink", "thresholded_relu", "gumbel_softmax",
+           "tanh"]
+
+
+def celu(x, alpha=1.0):
+    return jax.nn.celu(jnp.asarray(x), alpha)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(jnp.asarray(x), alpha)
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(jnp.asarray(x), approximate=approximate)
+
+
+def glu(x, axis=-1):
+    return jax.nn.glu(jnp.asarray(x), axis=axis)
+
+
+def hardshrink(x, threshold=0.5):
+    x = jnp.asarray(x)
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    x = jnp.asarray(x)
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardswish(x):
+    x = jnp.asarray(x)
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(jnp.asarray(x), min, max)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(jnp.asarray(x), negative_slope)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(jnp.asarray(x))
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(jnp.asarray(x), axis=axis)
+
+
+def maxout(x, groups, axis=1):
+    x = jnp.asarray(x)
+    c = x.shape[axis]
+    assert c % groups == 0
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def mish(x):
+    x = jnp.asarray(x)
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def prelu(x, weight, data_format="NCHW"):
+    x = jnp.asarray(x)
+    w = jnp.asarray(weight)
+    if w.size > 1:
+        axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[axis] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+def relu(x):
+    return jax.nn.relu(jnp.asarray(x))
+
+
+def relu6(x):
+    return jax.nn.relu6(jnp.asarray(x))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, key=None):
+    x = jnp.asarray(x)
+    if training:
+        from paddle_tpu import random as pt_random
+        k = key if key is not None else pt_random.next_key()
+        a = jax.random.uniform(k, x.shape, x.dtype, lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    x = jnp.asarray(x)
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def silu(x):
+    return jax.nn.silu(jnp.asarray(x))
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(jnp.asarray(x))
+
+
+def softmax(x, axis=-1, dtype=None):
+    x = jnp.asarray(x)
+    if dtype is not None:
+        from paddle_tpu.dtypes import to_dtype
+        x = x.astype(to_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    x = jnp.asarray(x)
+    return jnp.where(x * beta > threshold, x,
+                     jax.nn.softplus(x * beta) / beta)
+
+
+def softshrink(x, threshold=0.5):
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softsign(x):
+    return jax.nn.soft_sign(jnp.asarray(x))
+
+
+def tanh(x):
+    return jnp.tanh(jnp.asarray(x))
+
+
+def tanhshrink(x):
+    x = jnp.asarray(x)
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0):
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, key=None):
+    x = jnp.asarray(x)
+    from paddle_tpu import random as pt_random
+    k = key if key is not None else pt_random.next_key()
+    g = jax.random.gumbel(k, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = jax.lax.stop_gradient(y_hard - y) + y  # straight-through estimator
+    return y
+
+
+# register numpy-oracled activations for OpTest sweeps
+def _np_softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+for _name, _np in [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("softmax", _np_softmax),
+        ("hardswish", lambda x: x * np.clip(x + 3, 0, 6) / 6),
+        ("mish", lambda x: x * np.tanh(np.log1p(np.exp(x)))),
+        ("silu", lambda x: x / (1 + np.exp(-x))),
+        ("relu6", lambda x: np.clip(x, 0, 6)),
+        ("log_softmax", lambda x: np.log(_np_softmax(x))),
+        ("softsign", lambda x: x / (1 + np.abs(x))),
+        ("tanhshrink", lambda x: x - np.tanh(x)),
+        ("hardsigmoid", lambda x: np.clip(x / 6 + 0.5, 0, 1)),
+        ("leaky_relu", lambda x: np.where(x >= 0, x, 0.01 * x)),
+        ("elu", lambda x: np.where(x > 0, x, np.expm1(x))),
+        ("selu", lambda x: 1.0507009873554805 * np.where(
+            x > 0, x, 1.6732632423543772 * np.expm1(x)))]:
+    register_op(f"nn.{_name}", globals()[_name], "activation", np_ref=_np,
+                sample_args=(lambda: ((_sample("nonzero"),), {})))

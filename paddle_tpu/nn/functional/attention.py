@@ -1,0 +1,76 @@
+"""Attention functionals.
+
+Reference analog: the fused attention CUDA inventory —
+paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h,
+fused_softmax_mask.cu.h. Here the hot path is a Pallas flash-attention TPU
+kernel (paddle_tpu.ops.pallas.flash_attention) with an XLA reference path for
+CPU/debugging; selection via the ``use_pallas_kernels`` flag.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import flags
+
+__all__ = ["scaled_dot_product_attention", "attention_reference"]
+
+
+def attention_reference(q, k, v, mask=None, is_causal=False, scale=None,
+                        dropout_p=0.0, key=None):
+    """Plain XLA attention. q/k/v: (B, S, H, D) like the reference's
+    fused_attention layout."""
+    q = jnp.asarray(q)
+    k = jnp.asarray(k)
+    v = jnp.asarray(v)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # (B, H, Sq, Sk)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None,
+                                 rng_key: Optional[jax.Array] = None):
+    """Flash attention on TPU (Pallas) or XLA fallback.
+
+    Layout (B, S, H, D) matching paddle.nn.functional.scaled_dot_product_attention.
+    """
+    q = jnp.asarray(query)
+    use_pallas = (flags.get_flag("use_pallas_kernels")
+                  and q.ndim == 4
+                  and attn_mask is None
+                  and dropout_p == 0.0
+                  and jax.default_backend() == "tpu"
+                  and q.shape[-1] % 128 == 0
+                  and q.shape[1] % 128 == 0)
+    if use_pallas:
+        try:
+            from paddle_tpu.ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, jnp.asarray(key), jnp.asarray(value),
+                                   causal=is_causal, scale=scale)
+        except Exception:
+            pass
+    return attention_reference(q, key, value, mask=attn_mask,
+                               is_causal=is_causal, scale=scale,
+                               dropout_p=dropout_p if training else 0.0,
+                               key=rng_key)

@@ -1,0 +1,198 @@
+"""Common functionals: linear, dropout, embedding, interpolate, pad…
+(ref: python/paddle/nn/functional/common.py, input.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.module import current_context, is_training
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "interpolate", "upsample", "pad",
+           "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
+           "channel_shuffle", "label_smooth", "zeropad2d", "fold_ctx_key"]
+
+
+def linear(x, weight, bias=None):
+    """ref: nn.functional.linear → phi matmul+add; weight layout
+    (in_features, out_features) as in the reference."""
+    x = jnp.asarray(x)
+    out = x @ jnp.asarray(weight)
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+def fold_ctx_key(salt=0, key=None):
+    if key is not None:
+        return key
+    ctx = current_context()
+    if ctx is not None:
+        return ctx.next_key(salt)
+    from paddle_tpu import random as pt_random
+    return pt_random.next_key()
+
+
+def dropout(x, p=0.5, axis=None, training=None, mode="upscale_in_train",
+            key=None):
+    x = jnp.asarray(x)
+    if training is None:
+        training = is_training()
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    k = fold_ctx_key(key=key)
+    shape = list(x.shape)
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else axis
+        shape = [s if i in axes else 1 for i, s in enumerate(x.shape)]
+    keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=None, data_format="NCHW", key=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training, key=key)
+
+
+def dropout3d(x, p=0.5, training=None, data_format="NCDHW", key=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training, key=key)
+
+
+def alpha_dropout(x, p=0.5, training=None, key=None):
+    x = jnp.asarray(x)
+    if training is None:
+        training = is_training()
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    k = fold_ctx_key(key=key)
+    keep = jax.random.bernoulli(k, 1.0 - p, x.shape)
+    a = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    """ref: nn.functional.embedding → phi embedding kernel. On TPU this is a
+    gather feeding the MXU; ``sparse`` (SelectedRows grads) has no analog —
+    XLA produces dense scatter-add grads."""
+    w = jnp.asarray(weight)
+    idx = jnp.asarray(x)
+    out = jnp.take(w, idx, axis=0)
+    if padding_idx is not None:
+        mask = (idx == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(jnp.asarray(x), num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    label = jnp.asarray(label)
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * jnp.asarray(prior_dist)
+    return (1 - epsilon) * label + epsilon / n
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1 = jnp.asarray(x1)
+    x2 = jnp.asarray(x2)
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    from paddle_tpu.tensor.manipulation import pad as _tensor_pad
+    return _tensor_pad(x, pad, mode=mode, value=value,
+                       data_format=data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def _resize_nearest(x, out_hw):
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    ridx = (jnp.arange(oh) * h // oh).astype(jnp.int32)
+    cidx = (jnp.arange(ow) * w // ow).astype(jnp.int32)
+    return x[:, :, ridx[:, None], cidx[None, :]]
+
+
+def _resize_linear(x, out_hw, align_corners=False):
+    # jax.image.resize implements bilinear with half-pixel centers
+    n, c, h, w = x.shape
+    method = "bilinear"
+    return jax.image.resize(x, (n, c) + tuple(out_hw), method=method)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    x = jnp.asarray(x)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    spatial = x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = tuple(int(s) for s in np.asarray(size).reshape(-1))
+    if mode == "nearest":
+        assert len(size) == 2, "nearest resize supports 4-D input"
+        out = _resize_nearest(x, size)
+    else:
+        method = {"bilinear": "bilinear", "linear": "linear",
+                  "trilinear": "trilinear", "bicubic": "bicubic",
+                  "area": "linear"}[mode]
+        out = jax.image.resize(x, x.shape[:2] + size, method=method)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    x = jnp.asarray(x)
+    r = upscale_factor
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    x = x.reshape(n, oc, r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, oc, h * r, w * r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    x = jnp.asarray(x)
+    r = downscale_factor
+    n, c, h, w = x.shape
+    oh, ow = h // r, w // r
+    x = x.reshape(n, c, oh, r, ow, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, c * r * r, oh, ow)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(n, c, h, w)

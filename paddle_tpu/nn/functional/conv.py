@@ -1,0 +1,184 @@
+"""Convolutions (ref: python/paddle/nn/functional/conv.py → phi conv kernels
+/ cuDNN). On TPU these lower to XLA ``conv_general_dilated`` which tiles onto
+the MXU; NCHW in the API for reference parity, transposed internally when it
+helps XLA (XLA handles layout assignment itself)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose", "unfold", "fold"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _norm_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format):
+    x = jnp.asarray(x)
+    w = jnp.asarray(weight)  # (out_c, in_c/groups, *k) reference layout
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW"[:n + 2] if n == 2 else
+         ("NCH" if n == 1 else "NCDHW"),
+         "OIHW"[:n + 2] if n == 2 else ("OIH" if n == 1 else "OIDHW"),
+         "NCHW"[:n + 2] if n == 2 else ("NCH" if n == 1 else "NCDHW")))
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=_norm_tuple(stride, n),
+        padding=_norm_padding(padding, n),
+        rhs_dilation=_norm_tuple(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        b = jnp.asarray(bias).reshape((1, -1) + (1,) * n)
+        out = out + b
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format):
+    x = jnp.asarray(x)
+    w = jnp.asarray(weight)  # reference layout: (in_c, out_c/groups, *k)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    strides = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    if isinstance(pad, str):
+        pad = [(0, 0)] * n if pad == "VALID" else None
+        assert pad is not None, "SAME padding unsupported for transpose conv"
+    out_pad = _norm_tuple(output_padding, n)
+    k = w.shape[2:]
+    # grad-of-conv formulation: lhs_dilation = stride
+    pads = []
+    for i in range(n):
+        eff_k = (k[i] - 1) * dilation[i] + 1
+        lo = eff_k - 1 - pad[i][0]
+        hi = eff_k - 1 - pad[i][1] + out_pad[i]
+        pads.append((lo, hi))
+    if groups > 1:
+        ws = jnp.split(w, groups, axis=0)
+        w = jnp.concatenate([jnp.swapaxes(t, 0, 1) for t in ws], axis=0)
+    else:
+        w = jnp.swapaxes(w, 0, 1)  # → (out_c, in_c, *k)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    dn_str = ("NCH", "OIH", "NCH") if n == 1 else (
+        ("NCHW", "OIHW", "NCHW") if n == 2 else ("NCDHW", "OIDHW", "NCDHW"))
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * n, padding=pads,
+        lhs_dilation=strides, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape((1, -1) + (1,) * n)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (ref: paddle.nn.functional.unfold)."""
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    p = _norm_padding(paddings, 2)
+    x = jnp.pad(x, [(0, 0), (0, 0), p[0], p[1]])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)],
+        rhs_dilation=d, dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, (1, c) + k, ("NCHW", "OIHW", "NCHW")))
+    nn, cc, oh, ow = patches.shape
+    return patches.reshape(nn, cc, oh * ow)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — inverse of unfold via scatter-add."""
+    x = jnp.asarray(x)
+    n, ckk, l = x.shape
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    p = _norm_padding(paddings, 2)
+    oh, ow = output_sizes
+    c = ckk // (k[0] * k[1])
+    ph = oh + p[0][0] + p[0][1]
+    pw = ow + p[1][0] + p[1][1]
+    nh = (ph - (k[0] - 1) * d[0] - 1) // s[0] + 1
+    nw = (pw - (k[1] - 1) * d[1] - 1) // s[1] + 1
+    cols = x.reshape(n, c, k[0], k[1], nh, nw)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            hi = i * d[0]
+            wj = j * d[1]
+            out = out.at[:, :, hi:hi + nh * s[0]:s[0],
+                         wj:wj + nw * s[1]:s[1]].add(cols[:, :, i, j])
+    return out[:, :, p[0][0]:ph - p[0][1], p[1][0]:pw - p[1][1]]

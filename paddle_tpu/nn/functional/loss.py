@@ -1,0 +1,320 @@
+"""Loss functionals (ref: python/paddle/nn/functional/loss.py, 26 loss
+classes' functional mirrors)."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+           "binary_cross_entropy", "binary_cross_entropy_with_logits",
+           "mse_loss", "l1_loss", "smooth_l1_loss", "huber_loss", "kl_div",
+           "margin_ranking_loss", "cosine_embedding_loss", "ctc_loss",
+           "hinge_embedding_loss", "log_loss", "square_error_cost",
+           "triplet_margin_loss", "sigmoid_focal_loss", "dice_loss",
+           "npair_loss", "soft_margin_loss", "multi_label_soft_margin_loss",
+           "poisson_nll_loss"]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    """ref: nn.functional.cross_entropy → c_softmax_with_cross_entropy for
+    the TP-sharded variant (see paddle_tpu.distributed.parallel_cross_entropy)."""
+    x = jnp.asarray(input)
+    label_arr = jnp.asarray(label)
+    logp = jax.nn.log_softmax(x, axis=axis) if use_softmax else jnp.log(x)
+    if soft_label:
+        target = label_arr
+        if label_smoothing > 0:
+            n = x.shape[axis]
+            target = (1 - label_smoothing) * target + label_smoothing / n
+        loss = -jnp.sum(target * logp, axis=axis)
+    else:
+        if label_arr.ndim == x.ndim:
+            label_arr = jnp.squeeze(label_arr, axis=axis)
+        label_arr = label_arr.astype(jnp.int32)
+        valid = label_arr != ignore_index
+        safe = jnp.where(valid, label_arr, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            n = x.shape[axis]
+            smooth = jnp.mean(logp, axis=axis)
+            loss = -((1 - label_smoothing) * picked + label_smoothing * smooth)
+        else:
+            loss = -picked
+        if weight is not None:
+            w = jnp.take(jnp.asarray(weight), safe)
+            loss = loss * w
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+        else:
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    loss = cross_entropy(logits, label, reduction="none",
+                         soft_label=soft_label, ignore_index=ignore_index,
+                         axis=axis)
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, jax.nn.softmax(jnp.asarray(logits), axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean"):
+    x = jnp.asarray(input)  # log-probabilities
+    label_arr = jnp.asarray(label).astype(jnp.int32)
+    valid = label_arr != ignore_index
+    safe = jnp.where(valid, label_arr, 0)
+    picked = jnp.take_along_axis(x, safe[..., None], axis=-1)[..., 0]
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight), safe)
+        loss = loss * w
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(jnp.take(jnp.asarray(weight), safe) * valid) \
+            if weight is not None else jnp.sum(valid)
+        return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    x = jnp.clip(jnp.asarray(input), 1e-12, 1.0 - 1e-7)
+    y = jnp.asarray(label)
+    loss = -(y * jnp.log(x) + (1 - y) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * jnp.asarray(weight)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    z = jnp.asarray(logit)
+    y = jnp.asarray(label)
+    # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+    base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    if pos_weight is not None:
+        pw = jnp.asarray(pos_weight)
+        log_w = (pw - 1) * y + 1
+        base = base * log_w
+    if weight is not None:
+        base = base * jnp.asarray(weight)
+    return _reduce(base, reduction)
+
+
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    loss = jnp.square(jnp.asarray(input) - jnp.asarray(label))
+    return _reduce(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    loss = jnp.abs(jnp.asarray(input) - jnp.asarray(label))
+    return _reduce(loss, reduction)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(jnp.asarray(input) - jnp.asarray(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    d = jnp.asarray(input) - jnp.asarray(label)
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):  # noqa: A002
+    return smooth_l1_loss(input, label, reduction, delta)
+
+
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    x = jnp.asarray(input)  # log-probs
+    y = jnp.asarray(label)
+    loss = jnp.where(y > 0, y * (jnp.log(jnp.maximum(y, 1e-12)) - x), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    return -y * jnp.log(x + epsilon) - (1 - y) * jnp.log(1 - x + epsilon)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    loss = jnp.maximum(
+        0.0, -jnp.asarray(label) * (jnp.asarray(input) - jnp.asarray(other))
+        + margin)
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    from paddle_tpu.nn.functional.common import cosine_similarity
+    cos = cosine_similarity(input1, input2, axis=-1)
+    y = jnp.asarray(label)
+    loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):  # noqa: A002
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    a = jnp.asarray(input)
+    pos = jnp.asarray(positive)
+    neg = jnp.asarray(negative)
+
+    def dist(u, v):
+        return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+
+    d_pos = dist(a, pos)
+    d_neg = dist(a, neg)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(pos, neg))
+    loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    z = jnp.asarray(logit)
+    y = jnp.asarray(label)
+    p = jax.nn.sigmoid(z)
+    ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / jnp.asarray(normalizer)
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    x = jnp.asarray(input)
+    y = jax.nn.one_hot(jnp.asarray(label)[..., 0], x.shape[-1])
+    red = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * y, axis=red)
+    union = jnp.sum(x, axis=red) + jnp.sum(y, axis=red)
+    return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    a = jnp.asarray(anchor)
+    p = jnp.asarray(positive)
+    y = jnp.asarray(labels).reshape(-1, 1)
+    same = (y == y.T).astype(a.dtype)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    logits = a @ p.T
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    xent = jnp.mean(-jnp.sum(same * logp, axis=-1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), -1))
+                    + jnp.mean(jnp.sum(jnp.square(p), -1))) * 0.25
+    return xent + reg
+
+
+def soft_margin_loss(input, label, reduction="mean"):  # noqa: A002
+    loss = jnp.log1p(jnp.exp(-jnp.asarray(label) * jnp.asarray(input)))
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean"):
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    loss = jnp.mean(loss, axis=-1)
+    if weight is not None:
+        loss = loss * jnp.asarray(weight)
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean"):
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    if log_input:
+        loss = jnp.exp(x) - y * x
+    else:
+        loss = x - y * jnp.log(x + epsilon)
+    if full:
+        stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+            2 * jnp.pi * (y + epsilon))
+        loss = loss + jnp.where(y > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha-recursion expressed with lax.scan
+    (ref: warpctc binding, paddle/fluid/operators/warpctc_op.*)."""
+    lp = jnp.asarray(log_probs)  # (T, B, C) log-softmax already applied? ref takes logits
+    lp = jax.nn.log_softmax(lp, axis=-1)
+    labels = jnp.asarray(labels).astype(jnp.int32)  # (B, S)
+    T, B, C = lp.shape
+    S = labels.shape[1]
+    # extended label sequence with blanks: length 2S+1
+    ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_len = 2 * jnp.asarray(label_lengths) + 1
+
+    neg_inf = -1e30
+    alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(S > 0, lp[0, jnp.arange(B), ext[:, 1]], neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, lp_t):
+        a_shift1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        return merged + emit, None
+
+    def scan_collect(alpha, lp_t):
+        new, _ = step(alpha, lp_t)
+        return new, new
+
+    _, alphas = jax.lax.scan(scan_collect, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, B, 2S+1)
+    t_idx = jnp.asarray(input_lengths) - 1
+    final = alphas[t_idx, jnp.arange(B)]  # (B, 2S+1)
+    last = jnp.take_along_axis(final, (ext_len - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(
+        final, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(last, last2)
+    loss = -ll
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(jnp.asarray(label_lengths), 1))
+    return _reduce(loss, reduction)

@@ -1,0 +1,132 @@
+"""Normalization functionals (ref: python/paddle/nn/functional/norm.py).
+XLA fuses these into neighbouring ops; a Pallas fused layer-norm is provided
+for the cases XLA's fusion misses (paddle_tpu.ops.pallas.layer_norm)."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["normalize", "batch_norm", "layer_norm", "instance_norm",
+           "group_norm", "local_response_norm", "rms_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    x = jnp.asarray(x)
+    if p == 2:
+        denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        denom = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(denom, epsilon)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW"):
+    """Returns (out, new_mean, new_var) in training mode, out otherwise.
+    ref semantics: phi batch_norm kernel; running stats use
+    ``momentum * old + (1-momentum) * batch`` like the reference."""
+    x = jnp.asarray(x)
+    c_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+
+    if training:
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        n = x.size // x.shape[c_axis]
+        unbiased = var * n / max(n - 1, 1)
+        new_var = momentum * running_var + (1 - momentum) * unbiased
+    else:
+        mean, var = jnp.asarray(running_mean), jnp.asarray(running_var)
+        new_mean, new_var = mean, var
+
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * jnp.asarray(weight).reshape(shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(shape)
+    if training:
+        return out, new_mean, new_var
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05):
+    x = jnp.asarray(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * jnp.asarray(weight)
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-06, axis=-1):
+    """RMSNorm — not in the reference snapshot but required by modern LLM
+    blocks; normalizes by root-mean-square without centering."""
+    x = jnp.asarray(x)
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis,
+                   keepdims=True)
+    out = (x * jax.lax.rsqrt(var + epsilon).astype(x.dtype))
+    if weight is not None:
+        out = out * jnp.asarray(weight)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, training=True, momentum=0.9, epsilon=1e-05,
+                  data_format="NCHW"):
+    x = jnp.asarray(x)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        out = out * jnp.asarray(weight).reshape(shape)
+    if bias is not None:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        out = out + jnp.asarray(bias).reshape(shape)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW"):
+    x = jnp.asarray(x)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    g = x.reshape((n, num_groups, c // num_groups) + spatial)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    g = (g - mean) * jax.lax.rsqrt(var + epsilon)
+    out = g.reshape(x.shape)
+    shape = [1, c] + [1] * len(spatial)
+    if weight is not None:
+        out = out * jnp.asarray(weight).reshape(shape)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(shape)
+    return out
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    x = jnp.asarray(x)
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, size - half - 1)
+    sq = jnp.pad(sq, pads)
+    window = [1] * x.ndim
+    window[1] = size
+    summed = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                   (1,) * x.ndim, [(0, 0)] * x.ndim)
+    div = (k + alpha * summed / size) ** beta
+    return x / div

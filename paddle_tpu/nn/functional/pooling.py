@@ -1,0 +1,184 @@
+"""Pooling (ref: python/paddle/nn/functional/pooling.py, 15 classes).
+All lower to XLA reduce_window."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d", "max_unpool2d"]
+
+
+def _t(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    return [tuple(p) for p in padding]
+
+
+def _pool(x, n, kernel, stride, padding, init, op, avg=False,
+          exclusive=True, ceil_mode=False):
+    x = jnp.asarray(x)
+    kernel = _t(kernel, n)
+    stride = _t(stride if stride is not None else kernel, n)
+    pads = _pads(padding, n)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    full_pads = [(0, 0), (0, 0)] + pads
+    if avg:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                       full_pads)
+        if exclusive and any(p != (0, 0) for p in pads):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, full_pads)
+            return summed / counts
+        return summed / np.prod(kernel)
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, neg_inf, jax.lax.max, window, strides,
+                                 full_pads)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    return _pool(x, 1, kernel_size, stride, padding, 0.0, jax.lax.add,
+                 avg=True, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    return _pool(x, 2, kernel_size, stride, padding, 0.0, jax.lax.add,
+                 avg=True, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    return _pool(x, 3, kernel_size, stride, padding, 0.0, jax.lax.add,
+                 avg=True, exclusive=exclusive)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False):
+    return _pool(x, 1, kernel_size, stride, padding, -jnp.inf, jax.lax.max)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW"):
+    out = _pool(x, 2, kernel_size, stride, padding, -jnp.inf, jax.lax.max)
+    if return_mask:
+        mask = _argmax_pool2d(x, kernel_size, stride, padding)
+        return out, mask
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW"):
+    return _pool(x, 3, kernel_size, stride, padding, -jnp.inf, jax.lax.max)
+
+
+def _argmax_pool2d(x, kernel, stride, padding):
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    idx = jnp.broadcast_to(idx, x.shape)
+    k = _t(kernel, 2)
+    s = _t(stride if stride is not None else kernel, 2)
+    pads = [(0, 0), (0, 0)] + _pads(padding, 2)
+
+    def select(a, b):
+        av, ai = a
+        bv, bi = b
+        pick = av >= bv
+        return jnp.where(pick, av, bv), jnp.where(pick, ai, bi)
+
+    # reduce_window over pairs via two passes (value already computed); use
+    # a single pass with variadic reduce_window
+    init = (-jnp.inf, jnp.float32(-1))
+    vals, idxs = jax.lax.reduce_window(
+        (x.astype(jnp.float32), idx), init,
+        lambda a, b: select(a, b),
+        (1, 1) + k, (1, 1) + s, pads)
+    return idxs.astype(jnp.int64)
+
+
+def _adaptive_start_end(out_size, in_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-(np.arange(1, out_size + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, reduce_fn):
+    x = jnp.asarray(x)
+    out_sizes = _t(output_size, n)
+    spatial = x.shape[-n:]
+    # uniform case → plain strided pooling
+    if all(s % o == 0 for s, o in zip(spatial, out_sizes)):
+        kernel = tuple(s // o for s, o in zip(spatial, out_sizes))
+        return _pool(x, n, kernel, kernel, 0, None,
+                     jax.lax.max if reduce_fn == "max" else jax.lax.add,
+                     avg=(reduce_fn == "avg"), exclusive=False)
+    # general case: gather per output cell (static python loop, small sizes)
+    out = x
+    for dim in range(n):
+        axis = x.ndim - n + dim
+        starts, ends = _adaptive_start_end(out_sizes[dim], out.shape[axis])
+        slices = []
+        for s0, e0 in zip(starts, ends):
+            sl = jax.lax.slice_in_dim(out, int(s0), int(e0), axis=axis)
+            red = jnp.max(sl, axis=axis, keepdims=True) if reduce_fn == "max" \
+                else jnp.mean(sl, axis=axis, keepdims=True)
+            slices.append(red)
+        out = jnp.concatenate(slices, axis=axis)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_pool(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 1, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 3, "max")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    x = jnp.asarray(x)
+    indices = jnp.asarray(indices)
+    n, c, h, w = x.shape
+    if output_size is None:
+        k = _t(kernel_size, 2)
+        s = _t(stride if stride is not None else kernel_size, 2)
+        oh = (h - 1) * s[0] + k[0] - 2 * (padding if isinstance(padding, int) else 0)
+        ow = (w - 1) * s[1] + k[1] - 2 * (padding if isinstance(padding, int) else 0)
+    else:
+        oh, ow = output_size[-2:]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype).at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+        indices.reshape(n, c, -1)].set(x.reshape(n, c, -1))
+    return flat.reshape(n, c, oh, ow)

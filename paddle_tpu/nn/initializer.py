@@ -1,0 +1,172 @@
+"""Weight initializers (ref: python/paddle/nn/initializer/ — Constant,
+Normal, Uniform, XavierNormal/Uniform, KaimingNormal/Uniform, TruncatedNormal,
+Assign, Orthogonal, Dirac)."""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import random as pt_random
+
+__all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+           "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+           "Assign", "Orthogonal", "calculate_gain", "set_global_initializer"]
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: (out_c, in_c, *k) reference layout or (..., in, out)
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+             "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+             "selu": 3.0 / 4.0}
+    return gains[nonlinearity]
+
+
+class Initializer:
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        raise NotImplementedError
+
+    def _key(self, key):
+        return key if key is not None else pt_random.next_key()
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        return self.mean + self.std * jax.random.normal(
+            self._key(key), shape, jnp.float32).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        r = jax.random.truncated_normal(self._key(key), -2.0, 2.0, shape,
+                                        jnp.float32)
+        return (self.mean + self.std * r).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        return jax.random.uniform(self._key(key), shape, jnp.float32,
+                                  self.low, self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        fan_in, fan_out = _fans(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(self._key(key), shape,
+                                       jnp.float32).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        fan_in, fan_out = _fans(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(self._key(key), shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        fan_in, _ = _fans(shape)
+        fan_in = self.fan_in or fan_in
+        std = self.gain / math.sqrt(fan_in)
+        return std * jax.random.normal(self._key(key), shape,
+                                       jnp.float32).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        fan_in, _ = _fans(shape)
+        fan_in = self.fan_in or fan_in
+        limit = self.gain * math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(self._key(key), shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        arr = jnp.asarray(self.value, dtype)
+        assert tuple(arr.shape) == tuple(shape), \
+            f"Assign shape {arr.shape} != {shape}"
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.normal(self._key(key), (rows, cols), jnp.float32)
+        q, r = jnp.linalg.qr(flat.T if rows < cols else flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init
+
+
+def default_weight_init():
+    return _global_weight_init or XavierUniform()
+
+
+def default_bias_init():
+    return _global_bias_init or Constant(0.0)

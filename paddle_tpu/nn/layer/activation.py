@@ -1,0 +1,68 @@
+"""Activation layer classes (ref: python/paddle/nn/layer/activation.py — 28
+classes)."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.module import Module, Parameter
+
+__all__ = ["CELU", "ELU", "GELU", "GLU", "Hardshrink", "Hardsigmoid",
+           "Hardswish", "Hardtanh", "LeakyReLU", "LogSigmoid", "LogSoftmax",
+           "Maxout", "Mish", "PReLU", "ReLU", "ReLU6", "RReLU", "SELU",
+           "Sigmoid", "Silu", "Softmax", "Softplus", "Softshrink",
+           "Softsign", "Swish", "Tanh", "Tanhshrink", "ThresholdedReLU"]
+
+
+def _mk(name, fname, params=()):
+    def __init__(self, *args, **kwargs):
+        Module.__init__(self)
+        kwargs.pop("name", None)
+        self._args = args
+        self._kwargs = kwargs
+
+    def forward(self, x):
+        return getattr(F, fname)(x, *self._args, **self._kwargs)
+
+    cls = type(name, (Module,), {"__init__": __init__, "forward": forward,
+                                 "__doc__": f"ref: paddle.nn.{name}"})
+    return cls
+
+
+CELU = _mk("CELU", "celu")
+ELU = _mk("ELU", "elu")
+GELU = _mk("GELU", "gelu")
+GLU = _mk("GLU", "glu")
+Hardshrink = _mk("Hardshrink", "hardshrink")
+Hardsigmoid = _mk("Hardsigmoid", "hardsigmoid")
+Hardswish = _mk("Hardswish", "hardswish")
+Hardtanh = _mk("Hardtanh", "hardtanh")
+LeakyReLU = _mk("LeakyReLU", "leaky_relu")
+LogSigmoid = _mk("LogSigmoid", "log_sigmoid")
+LogSoftmax = _mk("LogSoftmax", "log_softmax")
+Maxout = _mk("Maxout", "maxout")
+Mish = _mk("Mish", "mish")
+ReLU = _mk("ReLU", "relu")
+ReLU6 = _mk("ReLU6", "relu6")
+RReLU = _mk("RReLU", "rrelu")
+SELU = _mk("SELU", "selu")
+Sigmoid = _mk("Sigmoid", "sigmoid")
+Silu = _mk("Silu", "silu")
+Softmax = _mk("Softmax", "softmax")
+Softplus = _mk("Softplus", "softplus")
+Softshrink = _mk("Softshrink", "softshrink")
+Softsign = _mk("Softsign", "softsign")
+Swish = _mk("Swish", "swish")
+Tanh = _mk("Tanh", "tanh")
+Tanhshrink = _mk("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _mk("ThresholdedReLU", "thresholded_relu")
+
+
+class PReLU(Module):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = Parameter(jnp.full((num_parameters,), init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)

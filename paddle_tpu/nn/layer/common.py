@@ -1,0 +1,283 @@
+"""Common layers (ref: python/paddle/nn/layer/common.py — 18 classes)."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.module import Module, Parameter, current_context
+
+__all__ = ["Linear", "Identity", "Dropout", "Dropout2D", "Dropout3D",
+           "AlphaDropout", "Embedding", "Flatten", "Upsample",
+           "UpsamplingNearest2D", "UpsamplingBilinear2D", "Pad1D", "Pad2D",
+           "Pad3D", "ZeroPad2D", "CosineSimilarity", "Bilinear", "Unfold",
+           "Fold", "PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
+           "LinearLowRank"]
+
+
+class Linear(Module):
+    """ref: paddle.nn.Linear (weight layout (in, out))."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None, dtype=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        winit = weight_attr if isinstance(weight_attr, I.Initializer) else \
+            I.default_weight_init()
+        self.weight = Parameter(winit((in_features, out_features),
+                                      dtype or jnp.float32))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            binit = bias_attr if isinstance(bias_attr, I.Initializer) else \
+                I.default_bias_init()
+            self.bias = Parameter(binit((out_features,), dtype or jnp.float32))
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class LinearLowRank(Module):
+    """LoRA-style factored linear — TPU-native extra (no reference analog)."""
+
+    def __init__(self, in_features, out_features, rank, alpha=1.0):
+        super().__init__()
+        self.alpha = alpha
+        self.rank = rank
+        self.a = Parameter(I.KaimingUniform()((in_features, rank)))
+        self.b = Parameter(I.Constant(0.0)((rank, out_features)))
+
+    def forward(self, x):
+        return (x @ self.a) @ self.b * (self.alpha / self.rank)
+
+
+class Identity(Module):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Dropout(Module):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, axis=self.axis, mode=self.mode)
+
+
+class Dropout2D(Module):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, self.p, data_format=self.data_format)
+
+
+class Dropout3D(Module):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, data_format=self.data_format)
+
+
+class AlphaDropout(Module):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p)
+
+
+class Embedding(Module):
+    """ref: paddle.nn.Embedding → phi embedding kernel (gather on TPU)."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        winit = weight_attr if isinstance(weight_attr, I.Initializer) else \
+            I.Normal(0.0, 1.0)
+        w = winit((num_embeddings, embedding_dim))
+        if padding_idx is not None:
+            w = w.at[padding_idx].set(0.0)
+        self.weight = Parameter(w)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+
+class Flatten(Module):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        from paddle_tpu.tensor.manipulation import flatten
+        return flatten(x, self.start_axis, self.stop_axis)
+
+
+class Upsample(Module):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+        self.align_mode = align_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.align_corners, self.align_mode,
+                             self.data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size, scale_factor, "nearest",
+                         data_format=data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size, scale_factor, "bilinear", True,
+                         data_format=data_format)
+
+
+class _PadNd(Module):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     self.data_format)
+
+
+class Pad1D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad2D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad2D(_PadNd):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class CosineSimilarity(Module):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class Bilinear(Module):
+    """ref: paddle.nn.Bilinear — out = x1 @ W @ x2 + b."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        winit = weight_attr if isinstance(weight_attr, I.Initializer) else \
+            I.default_weight_init()
+        self.weight = Parameter(
+            winit((out_features, in1_features, in2_features)))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = Parameter(I.Constant(0.0)((out_features,)))
+
+    def forward(self, x1, x2):
+        out = jnp.einsum("bi,oij,bj->bo", jnp.asarray(x1), self.weight,
+                         jnp.asarray(x2))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class Unfold(Module):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.args)
+
+
+class Fold(Module):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, *self.args)
+
+
+class PixelShuffle(Module):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class PixelUnshuffle(Module):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class ChannelShuffle(Module):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)

@@ -1,0 +1,90 @@
+"""Loss layer classes (ref: python/paddle/nn/layer/loss.py — 26 classes)."""
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.module import Module
+
+__all__ = ["CrossEntropyLoss", "NLLLoss", "BCELoss", "BCEWithLogitsLoss",
+           "MSELoss", "L1Loss", "SmoothL1Loss", "HuberLoss", "KLDivLoss",
+           "MarginRankingLoss", "CosineEmbeddingLoss", "CTCLoss",
+           "HingeEmbeddingLoss", "TripletMarginLoss", "SoftMarginLoss",
+           "MultiLabelSoftMarginLoss", "PoissonNLLLoss"]
+
+
+class _Loss(Module):
+    fn = None
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        kwargs.pop("name", None)
+        self.kwargs = kwargs
+
+    def forward(self, *args):
+        return getattr(F, self.fn)(*args, **self.kwargs)
+
+
+class CrossEntropyLoss(_Loss):
+    fn = "cross_entropy"
+
+
+class NLLLoss(_Loss):
+    fn = "nll_loss"
+
+
+class BCELoss(_Loss):
+    fn = "binary_cross_entropy"
+
+
+class BCEWithLogitsLoss(_Loss):
+    fn = "binary_cross_entropy_with_logits"
+
+
+class MSELoss(_Loss):
+    fn = "mse_loss"
+
+
+class L1Loss(_Loss):
+    fn = "l1_loss"
+
+
+class SmoothL1Loss(_Loss):
+    fn = "smooth_l1_loss"
+
+
+class HuberLoss(_Loss):
+    fn = "huber_loss"
+
+
+class KLDivLoss(_Loss):
+    fn = "kl_div"
+
+
+class MarginRankingLoss(_Loss):
+    fn = "margin_ranking_loss"
+
+
+class CosineEmbeddingLoss(_Loss):
+    fn = "cosine_embedding_loss"
+
+
+class CTCLoss(_Loss):
+    fn = "ctc_loss"
+
+
+class HingeEmbeddingLoss(_Loss):
+    fn = "hinge_embedding_loss"
+
+
+class TripletMarginLoss(_Loss):
+    fn = "triplet_margin_loss"
+
+
+class SoftMarginLoss(_Loss):
+    fn = "soft_margin_loss"
+
+
+class MultiLabelSoftMarginLoss(_Loss):
+    fn = "multi_label_soft_margin_loss"
+
+
+class PoissonNLLLoss(_Loss):
+    fn = "poisson_nll_loss"

@@ -1,0 +1,227 @@
+"""Norm layers (ref: python/paddle/nn/layer/norm.py — 13 classes).
+
+BatchNorm running stats flow through the nn.Context (see module.py):
+in a stateful training context the layer records its new running stats into
+``ctx.updates`` and the caller applies them functionally — the XLA-visible
+equivalent of the reference's in-place mutation."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.module import (Buffer, Module, Parameter, current_context,
+                                  is_training)
+
+__all__ = ["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+           "SyncBatchNorm", "LayerNorm", "RMSNorm", "InstanceNorm1D",
+           "InstanceNorm2D", "InstanceNorm3D", "GroupNorm",
+           "LocalResponseNorm", "SpectralNorm"]
+
+
+class _BatchNormBase(Module):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            winit = weight_attr if isinstance(weight_attr, I.Initializer) \
+                else I.Constant(1.0)
+            self.weight = Parameter(winit((num_features,)))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            binit = bias_attr if isinstance(bias_attr, I.Initializer) else \
+                I.Constant(0.0)
+            self.bias = Parameter(binit((num_features,)))
+        self.register_buffer("_mean", jnp.zeros((num_features,)))
+        self.register_buffer("_variance", jnp.ones((num_features,)))
+        # path of this module inside the root model, filled lazily by
+        # Context bookkeeping through named_modules at update-collection time
+        self._stat_tag = name
+
+    def forward(self, x):
+        training = is_training() and not self.use_global_stats
+        res = F.batch_norm(x, self._mean, self._variance, self.weight,
+                           self.bias, training=training,
+                           momentum=self.momentum, epsilon=self.epsilon,
+                           data_format=self.data_format)
+        if training:
+            out, new_mean, new_var = res
+            ctx = current_context()
+            if ctx is not None:
+                tag = self._stat_tag
+                if tag is None:
+                    tag = f"id{id(self) % 10**9}"  # untagged: call tag_paths()
+                prefix = f"{tag}." if tag else ""
+                ctx.record_update(f"{prefix}_mean", new_mean)
+                ctx.record_update(f"{prefix}_variance", new_var)
+            return out
+        return res
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCHW" if data_format == "NCL" else
+                         data_format, use_global_stats, name)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """ref: paddle.nn.SyncBatchNorm (cross-rank stats via NCCL allreduce).
+    Under GSPMD, batch statistics computed inside a sharded jit program are
+    already global — XLA inserts the cross-chip reductions — so SyncBatchNorm
+    is BatchNorm; kept as a distinct class for API parity.
+
+    convert_sync_batchnorm mirrors the reference helper."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class LayerNorm(Module):
+    """ref: paddle.nn.LayerNorm → Pallas fused layer-norm on the TPU hot path."""
+
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = Parameter(jnp.ones(self.normalized_shape))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = Parameter(jnp.zeros(self.normalized_shape))
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+
+class RMSNorm(Module):
+    """TPU-native extra (modern LLM block); see functional.rms_norm."""
+
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = Parameter(jnp.ones((hidden_size,)))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class _InstanceNormBase(Module):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = Parameter(jnp.ones((num_features,)))
+            self.bias = Parameter(jnp.zeros((num_features,)))
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               epsilon=self.epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class GroupNorm(Module):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = Parameter(jnp.ones((num_channels,)))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = Parameter(jnp.zeros((num_channels,)))
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.epsilon, self.weight,
+                            self.bias)
+
+
+class LocalResponseNorm(Module):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Module):
+    """ref: paddle.nn.SpectralNorm — power-iteration weight normalization."""
+
+    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
+                 name=None):
+        super().__init__()
+        self.axis = axis
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = weight_shape[axis]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != axis:
+                w *= s
+        self.register_buffer("weight_u", jnp.ones((h,)) / jnp.sqrt(h))
+        self.register_buffer("weight_v", jnp.ones((w,)) / jnp.sqrt(w))
+
+    def forward(self, weight):
+        w = jnp.asarray(weight)
+        w_mat = jnp.moveaxis(w, self.axis, 0).reshape(w.shape[self.axis], -1)
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self.power_iters):
+            v = w_mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.epsilon)
+            u = w_mat @ v
+            u = u / (jnp.linalg.norm(u) + self.epsilon)
+        sigma = u @ w_mat @ v
+        return w / sigma

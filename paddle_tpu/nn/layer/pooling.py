@@ -1,0 +1,97 @@
+"""Pooling layer classes (ref: python/paddle/nn/layer/pooling.py — 15
+classes)."""
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.module import Module
+
+__all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D", "MaxUnPool2D"]
+
+
+class _Pool(Module):
+    fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+        super().__init__()
+        kwargs.pop("name", None)
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.kwargs = kwargs
+
+    def forward(self, x):
+        return getattr(F, self.fn)(x, self.kernel_size, self.stride,
+                                   self.padding, **self.kwargs)
+
+
+class AvgPool1D(_Pool):
+    fn = "avg_pool1d"
+
+
+class AvgPool2D(_Pool):
+    fn = "avg_pool2d"
+
+
+class AvgPool3D(_Pool):
+    fn = "avg_pool3d"
+
+
+class MaxPool1D(_Pool):
+    fn = "max_pool1d"
+
+
+class MaxPool2D(_Pool):
+    fn = "max_pool2d"
+
+
+class MaxPool3D(_Pool):
+    fn = "max_pool3d"
+
+
+class _AdaptivePool(Module):
+    fn = None
+
+    def __init__(self, output_size, **kwargs):
+        super().__init__()
+        kwargs.pop("name", None)
+        self.output_size = output_size
+        self.kwargs = kwargs
+
+    def forward(self, x):
+        return getattr(F, self.fn)(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    fn = "adaptive_avg_pool1d"
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    fn = "adaptive_avg_pool2d"
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    fn = "adaptive_avg_pool3d"
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    fn = "adaptive_max_pool1d"
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    fn = "adaptive_max_pool2d"
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    fn = "adaptive_max_pool3d"
+
+
+class MaxUnPool2D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0, output_size=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, *self.args)

@@ -1,0 +1,227 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py — 15 classes).
+
+The reference dispatches to cuDNN RNN kernels; on TPU recurrence is a
+``lax.scan`` over time whose per-step matmuls batch onto the MXU, and the
+input projection (x @ W_ih for all timesteps) is hoisted out of the scan —
+one big matmul instead of T small ones."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.module import Module, Parameter, LayerList
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Module):
+    def get_initial_states(self, batch, state_shape=None):
+        raise NotImplementedError
+
+
+def _uniform_std(hidden_size):
+    return 1.0 / jnp.sqrt(hidden_size)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = float(1.0 / (hidden_size ** 0.5))
+        init = I.Uniform(-std, std)
+        self.weight_ih = Parameter(init((hidden_size, input_size)))
+        self.weight_hh = Parameter(init((hidden_size, hidden_size)))
+        self.bias_ih = Parameter(init((hidden_size,)))
+        self.bias_hh = Parameter(init((hidden_size,)))
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else jnp.zeros(
+            (inputs.shape[0], self.hidden_size), inputs.dtype)
+        pre = inputs @ self.weight_ih.T + self.bias_ih + \
+            h @ self.weight_hh.T + self.bias_hh
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        h = act(pre)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = float(1.0 / (hidden_size ** 0.5))
+        init = I.Uniform(-std, std)
+        self.weight_ih = Parameter(init((4 * hidden_size, input_size)))
+        self.weight_hh = Parameter(init((4 * hidden_size, hidden_size)))
+        self.bias_ih = Parameter(init((4 * hidden_size,)))
+        self.bias_hh = Parameter(init((4 * hidden_size,)))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            b = inputs.shape[0]
+            states = (jnp.zeros((b, self.hidden_size), inputs.dtype),
+                      jnp.zeros((b, self.hidden_size), inputs.dtype))
+        h, c = states
+        gates = inputs @ self.weight_ih.T + self.bias_ih + \
+            h @ self.weight_hh.T + self.bias_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = float(1.0 / (hidden_size ** 0.5))
+        init = I.Uniform(-std, std)
+        self.weight_ih = Parameter(init((3 * hidden_size, input_size)))
+        self.weight_hh = Parameter(init((3 * hidden_size, hidden_size)))
+        self.bias_ih = Parameter(init((3 * hidden_size,)))
+        self.bias_hh = Parameter(init((3 * hidden_size,)))
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else jnp.zeros(
+            (inputs.shape[0], self.hidden_size), inputs.dtype)
+        gi = inputs @ self.weight_ih.T + self.bias_ih
+        gh = h @ self.weight_hh.T + self.bias_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h = (1 - z) * n + z * h
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Module):
+    """Runs a cell over time with lax.scan (ref: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = jnp.asarray(inputs)
+        if not self.time_major:
+            x = jnp.swapaxes(x, 0, 1)  # (T, B, F)
+        if self.is_reverse:
+            x = jnp.flip(x, axis=0)
+        b = x.shape[1]
+        if initial_states is None:
+            if isinstance(self.cell, LSTMCell):
+                initial_states = (
+                    jnp.zeros((b, self.cell.hidden_size), x.dtype),
+                    jnp.zeros((b, self.cell.hidden_size), x.dtype))
+            else:
+                initial_states = jnp.zeros((b, self.cell.hidden_size),
+                                           x.dtype)
+        cell = self.cell
+
+        def step(carry, x_t):
+            out, new_states = cell(x_t, carry)
+            return new_states, out
+
+        final, outs = jax.lax.scan(step, initial_states, x)
+        if self.is_reverse:
+            outs = jnp.flip(outs, axis=0)
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, final
+
+
+class BiRNN(Module):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states = initial_states or (None, None)
+        out_f, st_f = self.fw(inputs, states[0])
+        out_b, st_b = self.bw(inputs, states[1])
+        return jnp.concatenate([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _RNNBase(Module):
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        layers = []
+        for l in range(num_layers):
+            in_size = input_size if l == 0 else hidden_size * num_dir
+            kwargs = {}
+            if activation is not None and self.CELL is SimpleRNNCell:
+                kwargs["activation"] = activation
+            if self.bidirectional:
+                layers.append(BiRNN(self.CELL(in_size, hidden_size, **kwargs),
+                                    self.CELL(in_size, hidden_size, **kwargs),
+                                    time_major))
+            else:
+                layers.append(RNN(self.CELL(in_size, hidden_size, **kwargs),
+                                  time_major=time_major))
+        self.layers = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        finals = []
+        for i, layer in enumerate(self.layers):
+            init_i = None
+            if initial_states is not None:
+                init_i = jax.tree_util.tree_map(
+                    lambda s: s[i], initial_states)
+            out, st = layer(out, init_i)
+            finals.append(st)
+            if self.dropout and i < len(self.layers) - 1:
+                from paddle_tpu.nn import functional as F
+                out = F.dropout(out, self.dropout)
+        states = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *finals)
+        return out, states
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

@@ -1,0 +1,515 @@
+"""Module system: pytree-registered layers with torch/paddle ergonomics and
+pure-functional semantics.
+
+Reference analog: ``paddle.nn.Layer`` (python/paddle/fluid/dygraph/layers.py)
+— attribute registration of parameters/sub-layers, named traversal,
+state_dict. Differences forced (and enabled) by TPU/XLA:
+
+- A Module IS a pytree: ``jax.jit``/``grad``/``vmap`` consume it directly.
+  Arrays (parameters/buffers) are leaves; everything else is static aux data
+  that keys the jit cache.
+- Forward is pure. Stateful bits (dropout RNG, batch-norm running stats,
+  training flag) thread through an explicit :class:`Context` entered with
+  ``nn.stateful(...)``; updated buffers are collected functionally instead of
+  mutated in place (the reference mutates, which XLA tracing cannot see).
+- ``split_params``/``merge_params`` give the canonical train-step pattern:
+  optimizers operate on a flat dict of trainable arrays.
+"""
+
+import contextlib
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Parameter:
+    """Marker wrapper used at assignment time: ``self.w = Parameter(arr)``
+    registers ``w`` as trainable and stores the raw array. (ref:
+    fluid/dygraph/layers.py parameter registration via ParamBase)."""
+
+    __slots__ = ("value", "trainable")
+
+    def __init__(self, value, trainable: bool = True):
+        self.value = jnp.asarray(value)
+        self.trainable = trainable
+
+
+class Buffer:
+    """Non-trainable state (running stats etc.); ref: Layer.register_buffer."""
+
+    __slots__ = ("value", "persistable")
+
+    def __init__(self, value, persistable: bool = True):
+        self.value = jnp.asarray(value)
+        self.persistable = persistable
+
+
+def _is_array(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+class _Static:
+    """Hashable wrapper for a module's static attributes (jit cache key)."""
+
+    __slots__ = ("items", "_hash")
+
+    def __init__(self, items: Tuple[Tuple[str, Any], ...]):
+        self.items = items
+        try:
+            self._hash = hash(items)
+        except TypeError:
+            self._hash = hash(repr(items))
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        if not isinstance(other, _Static):
+            return False
+        if len(self.items) != len(other.items):
+            return False
+        for (ka, va), (kb, vb) in zip(self.items, other.items):
+            if ka != kb:
+                return False
+            eq = va == vb
+            if isinstance(eq, (np.ndarray, jax.Array)):
+                eq = bool(np.all(eq))
+            if not eq:
+                return False
+        return True
+
+
+class Module:
+    """Base layer class. Subclasses define ``__init__`` (register params via
+    ``Parameter``/``create_parameter`` and sub-modules by attribute
+    assignment) and ``forward``."""
+
+    def __init__(self):
+        d = object.__setattr__
+        d(self, "_params", set())
+        d(self, "_buffers", set())
+        d(self, "_non_trainable", set())
+        d(self, "_non_persistable", set())
+        d(self, "_modules", set())
+
+    # -- attribute registration ------------------------------------------------
+    def __setattr__(self, name, value):
+        if not hasattr(self, "_params"):
+            # subclass forgot super().__init__; bootstrap silently
+            Module.__init__(self)
+        self._params.discard(name)
+        self._buffers.discard(name)
+        self._modules.discard(name)
+        self._non_trainable.discard(name)
+        self._non_persistable.discard(name)
+        if isinstance(value, Parameter):
+            self._params.add(name)
+            if not value.trainable:
+                self._non_trainable.add(name)
+            value = value.value
+        elif isinstance(value, Buffer):
+            self._buffers.add(name)
+            if not value.persistable:
+                self._non_persistable.add(name)
+            value = value.value
+        elif isinstance(value, Module):
+            self._modules.add(name)
+        elif isinstance(value, (list, tuple)) and value and all(
+                isinstance(v, Module) for v in value):
+            # bare list of modules → auto-wrap is intrusive; register names
+            self._modules.add(name)
+        object.__setattr__(self, name, value)
+
+    def __delattr__(self, name):
+        self._params.discard(name)
+        self._buffers.discard(name)
+        self._modules.discard(name)
+        object.__delattr__(self, name)
+
+    # -- torch/paddle-style helpers -------------------------------------------
+    def create_parameter(self, shape, dtype=None, init=None,
+                         trainable: bool = True):
+        from paddle_tpu.dtypes import get_default_dtype
+        from paddle_tpu.nn import initializer
+        dtype = dtype or get_default_dtype()
+        init = init or initializer.XavierUniform()
+        return Parameter(init(shape, dtype), trainable=trainable)
+
+    def register_buffer(self, name, value, persistable=True):
+        setattr(self, name, Buffer(value, persistable))
+
+    def add_sublayer(self, name, layer):
+        setattr(self, name, layer)
+        return layer
+
+    # -- traversal -------------------------------------------------------------
+    def named_children(self) -> Iterator[Tuple[str, "Module"]]:
+        for name in sorted(self._modules):
+            v = getattr(self, name)
+            if isinstance(v, Module):
+                yield name, v
+            else:  # list/tuple of modules
+                for i, m in enumerate(v):
+                    yield f"{name}.{i}", m
+
+    def children(self):
+        for _, m in self.named_children():
+            yield m
+
+    def named_modules(self, prefix="") -> Iterator[Tuple[str, "Module"]]:
+        yield prefix, self
+        for name, child in self.named_children():
+            sub = f"{prefix}.{name}" if prefix else name
+            yield from child.named_modules(sub)
+
+    def sublayers(self, include_self=False):
+        mods = [m for _, m in self.named_modules()]
+        return mods if include_self else mods[1:]
+
+    def named_parameters(self, prefix="", include_non_trainable=True):
+        for path, mod in self.named_modules(prefix):
+            for name in sorted(mod._params):
+                if not include_non_trainable and name in mod._non_trainable:
+                    continue
+                full = f"{path}.{name}" if path else name
+                yield full, getattr(mod, name)
+
+    def parameters(self, include_non_trainable=True):
+        return [v for _, v in
+                self.named_parameters(include_non_trainable=include_non_trainable)]
+
+    def named_buffers(self, prefix="", include_non_persistable=True):
+        for path, mod in self.named_modules(prefix):
+            for name in sorted(mod._buffers):
+                if not include_non_persistable and \
+                        name in getattr(mod, "_non_persistable", ()):
+                    continue
+                full = f"{path}.{name}" if path else name
+                yield full, getattr(mod, name)
+
+    def buffers(self):
+        return [v for _, v in self.named_buffers()]
+
+    # -- state dict ------------------------------------------------------------
+    def state_dict(self) -> Dict[str, jax.Array]:
+        out = dict(self.named_parameters())
+        out.update(dict(self.named_buffers(include_non_persistable=False)))
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any], strict: bool = True):
+        own = self.state_dict()
+        missing = [k for k in own if k not in state]
+        unexpected = [k for k in state if k not in own]
+        if strict and (missing or unexpected):
+            raise KeyError(f"state_dict mismatch: missing={missing}, "
+                           f"unexpected={unexpected}")
+        for k, v in state.items():
+            if k in own:
+                self._set_by_path(k, jnp.asarray(v))
+        return self
+
+    load_dict = set_state_dict
+    load_state_dict = set_state_dict
+
+    def _get_module_by_path(self, path: str):
+        mod = self
+        parts = path.split(".")
+        for p in parts:
+            v = getattr(mod, p) if not p.isdigit() else mod[int(p)]
+            mod = v
+        return mod
+
+    def _set_by_path(self, path: str, value):
+        parts = path.split(".")
+        mod = self
+        for p in parts[:-1]:
+            mod = getattr(mod, p) if not p.isdigit() else mod[int(p)]
+        leaf = parts[-1]
+        # bypass re-registration (kind is already recorded)
+        object.__setattr__(mod, leaf, value)
+
+    # -- functional split/merge ------------------------------------------------
+    def split_params(self):
+        """Return (trainable_params, everything_else_dict). The canonical
+        train-step pattern:
+
+            params, _ = model.split_params()
+            def loss_fn(params, batch):
+                m = model.merge_params(params)
+                ...
+        """
+        params = dict(self.named_parameters(include_non_trainable=False))
+        return params, None
+
+    def merge_params(self, params: Dict[str, jax.Array]) -> "Module":
+        """Return a copy of self with ``params`` swapped in (pure)."""
+        new = jax.tree_util.tree_map(lambda x: x, self)  # structural copy
+        for k, v in params.items():
+            new._set_by_path(k, v)
+        return new
+
+    def apply_updates(self, updates: Dict[str, jax.Array]) -> "Module":
+        """Pure buffer update (e.g. BN running stats collected by Context)."""
+        return self.merge_params(updates)
+
+    # -- train/eval flags (thread through Context) ----------------------------
+    def train(self):
+        _default_mode.training = True
+        return self
+
+    def eval(self):
+        _default_mode.training = False
+        return self
+
+    def tag_paths(self):
+        """Stamp each submodule with its dotted path (used by layers that
+        record functional buffer updates into the Context, e.g. BatchNorm).
+        Called automatically by the high-level Trainer/Model APIs; call once
+        after construction when using raw nn.stateful contexts."""
+        for path, mod in self.named_modules():
+            object.__setattr__(mod, "_stat_tag", path)
+        return self
+
+    def apply(self, fn):
+        for m in self.sublayers(include_self=True):
+            fn(m)
+        return self
+
+    def astype(self, dtype):
+        """Cast all floating params/buffers (ref: Layer.to / amp O2 cast)."""
+        from paddle_tpu.dtypes import to_dtype, is_floating
+        dt = to_dtype(dtype)
+        new_state = {}
+        for k, v in self.state_dict().items():
+            if is_floating(v.dtype):
+                new_state[k] = jnp.asarray(v, dt)
+        return self.merge_params(new_state)
+
+    to = astype
+
+    # -- call ------------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    # -- pytree protocol -------------------------------------------------------
+    def _tree_keys(self):
+        dyn = sorted(self._params | self._buffers | self._modules)
+        return dyn
+
+    def tree_flatten(self):
+        dyn_keys = self._tree_keys()
+        children = tuple(getattr(self, k) for k in dyn_keys)
+        reserved = set(dyn_keys) | {"_params", "_buffers", "_modules",
+                                    "_non_trainable", "_non_persistable"}
+        static_items = tuple(sorted(
+            (k, v) for k, v in self.__dict__.items() if k not in reserved))
+        meta = (tuple(dyn_keys), tuple(sorted(self._params)),
+                tuple(sorted(self._buffers)), tuple(sorted(self._modules)),
+                tuple(sorted(self._non_trainable)),
+                tuple(sorted(self._non_persistable)))
+        return children, (meta, _Static(static_items))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        meta, static = aux
+        (dyn_keys, params, buffers, modules, non_trainable,
+         non_persistable) = meta
+        obj = object.__new__(cls)
+        object.__setattr__(obj, "_params", set(params))
+        object.__setattr__(obj, "_buffers", set(buffers))
+        object.__setattr__(obj, "_modules", set(modules))
+        object.__setattr__(obj, "_non_trainable", set(non_trainable))
+        object.__setattr__(obj, "_non_persistable", set(non_persistable))
+        for k, v in zip(dyn_keys, children):
+            object.__setattr__(obj, k, v)
+        for k, v in static.items:
+            object.__setattr__(obj, k, v)
+        return obj
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        jax.tree_util.register_pytree_node(
+            cls,
+            lambda m: m.tree_flatten(),
+            lambda aux, ch, _cls=cls: _cls.tree_unflatten(aux, ch))
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, child in self.named_children():
+            head = repr(child).splitlines()
+            body = "\n".join("  " + h for h in head)
+            lines.append(f"  ({name}): {body.strip()}")
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else type(self).__name__ + "()"
+
+
+jax.tree_util.register_pytree_node(
+    Module, lambda m: m.tree_flatten(),
+    lambda aux, ch: Module.tree_unflatten(aux, ch))
+
+
+# ---------------------------------------------------------------------------
+# Execution context: training flag, RNG, functional buffer updates.
+# ---------------------------------------------------------------------------
+
+class _Mode(threading.local):
+    training = False
+
+
+_default_mode = _Mode()
+_ctx_stack = threading.local()
+
+
+class Context:
+    """Threaded execution state for one forward pass (ref contrast: the
+    reference mutates layer attributes / global tracer state; under XLA
+    tracing state must flow functionally)."""
+
+    def __init__(self, training: bool = False, rng: Optional[jax.Array] = None):
+        self.training = training
+        self._rng = rng
+        self._rng_counter = 0
+        self.updates: Dict[str, jax.Array] = {}
+        self._path_stack: List[str] = []
+
+    def next_key(self, salt: int = 0) -> jax.Array:
+        if self._rng is None:
+            from paddle_tpu import random as pt_random
+            return pt_random.next_key()
+        self._rng_counter += 1
+        return jax.random.fold_in(self._rng, self._rng_counter * 1000003 + salt)
+
+    def record_update(self, path: str, value):
+        self.updates[path] = value
+
+
+def current_context() -> Optional[Context]:
+    return getattr(_ctx_stack, "ctx", None)
+
+
+def is_training() -> bool:
+    ctx = current_context()
+    if ctx is not None:
+        return ctx.training
+    return _default_mode.training
+
+
+@contextlib.contextmanager
+def stateful(training: bool = False, rng: Optional[jax.Array] = None):
+    """Enter an execution context::
+
+        with nn.stateful(training=True, rng=key) as ctx:
+            loss = loss_fn(model(x), y)
+        model = model.apply_updates(ctx.updates)
+    """
+    ctx = Context(training=training, rng=rng)
+    prev = getattr(_ctx_stack, "ctx", None)
+    _ctx_stack.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _ctx_stack.ctx = prev
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+class Sequential(Module):
+    """ref: paddle.nn.Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], tuple):
+            layers = [m for _, m in layers[0]]
+        self._n = len(layers)
+        for i, l in enumerate(layers):
+            setattr(self, f"layer_{i}", l)
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return Sequential(*[self[j] for j in range(*i.indices(self._n))])
+        if not -self._n <= i < self._n:
+            raise IndexError(f"index {i} out of range for Sequential of "
+                             f"length {self._n}")
+        return getattr(self, f"layer_{i % self._n}")
+
+    def __iter__(self):
+        return (self[i] for i in range(self._n))
+
+    def forward(self, x):
+        for i in range(self._n):
+            x = self[i](x)
+        return x
+
+
+class LayerList(Module):
+    """ref: paddle.nn.LayerList."""
+
+    def __init__(self, layers=None):
+        super().__init__()
+        self._n = 0
+        for l in (layers or []):
+            self.append(l)
+
+    def append(self, layer):
+        setattr(self, f"item_{self._n}", layer)
+        self._n += 1
+        return self
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return LayerList([self[j] for j in range(*i.indices(self._n))])
+        if not -self._n <= i < self._n:
+            raise IndexError(f"index {i} out of range for LayerList of "
+                             f"length {self._n}")
+        return getattr(self, f"item_{i % self._n}")
+
+    def __iter__(self):
+        return (self[i] for i in range(self._n))
+
+
+class LayerDict(Module):
+    """ref: paddle.nn.LayerDict."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        self._keys: Tuple[str, ...] = ()
+        for k, v in (sublayers or {}).items():
+            self[k] = v
+
+    def __setitem__(self, key, layer):
+        setattr(self, f"kv_{key}", layer)
+        if key not in self._keys:
+            object.__setattr__(self, "_keys", self._keys + (key,))
+
+    def __getitem__(self, key):
+        return getattr(self, f"kv_{key}")
+
+    def keys(self):
+        return list(self._keys)
+
+    def items(self):
+        return [(k, self[k]) for k in self._keys]
+
+    def values(self):
+        return [self[k] for k in self._keys]
+
+    def __len__(self):
+        return len(self._keys)
+
+    def __iter__(self):
+        return iter(self._keys)

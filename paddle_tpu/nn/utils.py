@@ -1,0 +1,40 @@
+"""nn.utils (ref: python/paddle/nn/utils/ — weight_norm, spectral_norm,
+clip helpers, parameters_to_vector)."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "clip_grad_norm_",
+           "clip_grad_value_"]
+
+
+def parameters_to_vector(parameters):
+    return jnp.concatenate([jnp.ravel(p) for p in parameters])
+
+
+def vector_to_parameters(vec, parameters):
+    out = []
+    offset = 0
+    for p in parameters:
+        n = p.size
+        out.append(vec[offset:offset + n].reshape(p.shape))
+        offset += n
+    return out
+
+
+def clip_grad_norm_(grads, max_norm, norm_type=2.0):
+    """Functional grad clipping over a pytree; returns (clipped, total_norm)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in leaves])) ** (
+                1.0 / norm_type)
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads), total
+
+
+def clip_grad_value_(grads, clip_value):
+    return jax.tree_util.tree_map(
+        lambda g: jnp.clip(g, -clip_value, clip_value), grads)

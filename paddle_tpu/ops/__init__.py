@@ -1,0 +1,1 @@
+from paddle_tpu.ops.registry import OpSpec, register_op, get_op, all_ops

@@ -1,0 +1,45 @@
+"""Single-source-of-truth op registry.
+
+Reference analog: the YAML op specs (paddle/phi/api/yaml/ops.yaml) from which
+the reference generates its C++ API, grad nodes, and Python bindings
+(api_gen.py / eager_gen.py / python_c_gen.py). Here there is no codegen to do —
+ops are pure jax functions and autodiff comes from tracing — so the registry's
+job is metadata: a numpy oracle per op for the OpTest harness
+(ref: python/paddle/fluid/tests/unittests/op_test.py:333), a category, and the
+reference citation. Tests iterate ``all_ops()`` and check eager vs jit vs the
+numpy oracle on every op that declares one.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class OpSpec:
+    name: str
+    fn: Callable
+    category: str
+    np_ref: Optional[Callable] = None       # numpy oracle
+    sample_args: Optional[Callable] = None  # () -> (args, kwargs) for OpTest
+    ref: str = ""                           # reference file:line citation
+    differentiable: bool = True
+
+
+_OPS: Dict[str, OpSpec] = {}
+
+
+def register_op(name: str, fn: Callable, category: str,
+                np_ref: Optional[Callable] = None,
+                sample_args: Optional[Callable] = None,
+                ref: str = "", differentiable: bool = True) -> Callable:
+    _OPS[name] = OpSpec(name, fn, category, np_ref, sample_args, ref,
+                        differentiable)
+    return fn
+
+
+def get_op(name: str) -> OpSpec:
+    return _OPS[name]
+
+
+def all_ops() -> List[OpSpec]:
+    return list(_OPS.values())

@@ -1,0 +1,10 @@
+from paddle_tpu.optimizer.optimizer import (Optimizer, SGD, Momentum, Adam,
+                                            AdamW, Adamax, Adagrad, Adadelta,
+                                            RMSProp, Lamb, Lars)
+from paddle_tpu.optimizer import lr
+from paddle_tpu.optimizer.clip import (ClipGradByValue, ClipGradByNorm,
+                                       ClipGradByGlobalNorm)
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "lr",
+           "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]

@@ -1,0 +1,52 @@
+"""Gradient clipping (ref: python/paddle/fluid/clip.py — ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Functional over pytrees; the hybrid
+distributed variant that reduces the global norm across mesh axes lives in
+paddle_tpu.distributed.fleet (HybridParallelClipGrad analog)."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+tree_map = jax.tree_util.tree_map
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return tree_map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm:
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return g * jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+        return tree_map(clip, grads)
+
+
+class ClipGradByGlobalNorm:
+    """Global-norm clip (the hybrid-parallel variant psums the squared norm
+    over mesh axes first — see distributed.fleet.HybridParallelClipGrad;
+    ref: hybrid_parallel_optimizer.py:45)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def global_norm(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in leaves))
+
+    def __call__(self, grads):
+        norm = self.global_norm(grads)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+        return tree_map(lambda g: (g * scale).astype(g.dtype), grads)

@@ -1,0 +1,326 @@
+"""LR schedulers (ref: python/paddle/optimizer/lr.py — 16 classes).
+
+Each scheduler implements ``value_at(step)`` with jnp math so the learning
+rate is computed *inside* the compiled train step from the integer step
+counter (no host↔device sync per step, unlike the reference's Python-side
+``scheduler.step()``); the paddle-style stateful ``step()/get_lr()`` API is
+kept for parity."""
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["LRScheduler", "NoamDecay", "ExponentialDecay", "NaturalExpDecay",
+           "InverseTimeDecay", "PolynomialDecay", "LinearWarmup",
+           "PiecewiseDecay", "CosineAnnealingDecay", "MultiStepDecay",
+           "StepDecay", "LambdaDecay", "ReduceOnPlateau", "MultiplicativeDecay",
+           "OneCycleLR", "CyclicLR", "CosineAnnealingWarmRestarts"]
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.step()  # initialize
+
+    # -- functional (used inside jit) -----------------------------------------
+    def value_at(self, step):
+        raise NotImplementedError
+
+    # -- stateful parity API ---------------------------------------------------
+    def step(self, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+
+    def get_lr(self):
+        return float(self.value_at(jnp.asarray(max(self.last_epoch, 0))))
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch}
+
+    def set_state_dict(self, d):
+        self.last_epoch = d["last_epoch"]
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0,
+                 last_epoch=-1, verbose=False):
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        s = jnp.maximum(step.astype(jnp.float32), 1.0)
+        return self.base_lr * self.d_model ** -0.5 * jnp.minimum(
+            s ** -0.5, s * self.warmup_steps ** -1.5)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr * self.gamma ** step.astype(jnp.float32)
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * step.astype(jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr / (1 + self.gamma * step.astype(jnp.float32))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        s = step.astype(jnp.float32)
+        if self.cycle:
+            div = jnp.ceil(jnp.maximum(s / self.decay_steps, 1.0))
+            decay_steps = self.decay_steps * div
+        else:
+            decay_steps = self.decay_steps
+            s = jnp.minimum(s, decay_steps)
+        return (self.base_lr - self.end_lr) * (
+            1 - s / decay_steps) ** self.power + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 last_epoch=-1, verbose=False):
+        self.lr_after = learning_rate  # scheduler or float
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        super().__init__(end_lr, last_epoch, verbose)
+
+    def value_at(self, step):
+        s = step.astype(jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            s / self.warmup_steps, 1.0)
+        if isinstance(self.lr_after, LRScheduler):
+            after = self.lr_after.value_at(
+                jnp.maximum(step - self.warmup_steps, 0))
+        else:
+            after = jnp.asarray(self.lr_after, jnp.float32)
+        return jnp.where(s < self.warmup_steps, warm, after)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def value_at(self, step):
+        b = jnp.asarray(self.boundaries)
+        idx = jnp.sum(step >= b)
+        return jnp.asarray(self.values)[idx]
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0.0, last_epoch=-1,
+                 verbose=False):
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        s = step.astype(jnp.float32)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (
+            1 + jnp.cos(jnp.pi * jnp.minimum(s, self.T_max) / self.T_max))
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0.0,
+                 last_epoch=-1, verbose=False):
+        assert T_mult == 1, "T_mult != 1 not supported in compiled mode"
+        self.T_0 = T_0
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        s = jnp.mod(step.astype(jnp.float32), self.T_0)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (
+            1 + jnp.cos(jnp.pi * s / self.T_0))
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        m = jnp.asarray(self.milestones)
+        n = jnp.sum(step >= m).astype(jnp.float32)
+        return self.base_lr * self.gamma ** n
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1,
+                 verbose=False):
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        n = (step // self.step_size).astype(jnp.float32)
+        return self.base_lr * self.gamma ** n
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr = base_lr * prod_{i=1..step} lambda(i) — cumulative, host-tracked
+    (the product over a traced step count is not expressible in one closed
+    form for arbitrary lambdas, so this scheduler is stateful like
+    ReduceOnPlateau; value_at returns the current host value)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        self.current_factor = 1.0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        return jnp.asarray(self.base_lr * self.current_factor, jnp.float32)
+
+    def step(self, epoch=None):
+        prev = getattr(self, "last_epoch", -1)
+        self.last_epoch = epoch if epoch is not None else prev + 1
+        if self.last_epoch > 0:
+            self.current_factor *= float(self.lr_lambda(self.last_epoch))
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch,
+                "current_factor": self.current_factor}
+
+    def set_state_dict(self, d):
+        self.last_epoch = d["last_epoch"]
+        self.current_factor = d.get("current_factor", 1.0)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven (host-side) scheduler — inherently eager; value_at
+    returns the current host value (ref: lr.py ReduceOnPlateau)."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0,
+                 epsilon=1e-8, verbose=False):
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+        self.current_lr = learning_rate
+        super().__init__(learning_rate, -1, verbose)
+
+    def value_at(self, step):
+        return jnp.asarray(self.current_lr, jnp.float32)
+
+    def step(self, metrics=None, epoch=None):
+        self.last_epoch += 1
+        if metrics is None:
+            return
+        m = float(metrics)
+        better = (self.best is None or
+                  (self.mode == "min" and m < self.best - self.threshold) or
+                  (self.mode == "max" and m > self.best + self.threshold))
+        if better:
+            self.best = m
+            self.num_bad = 0
+        elif self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.current_lr = max(self.current_lr * self.factor,
+                                      self.min_lr)
+                self.cooldown_counter = self.cooldown
+                self.num_bad = 0
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
+                 end_learning_rate=0.0001, phase_pct=0.3,
+                 anneal_strategy="cos", three_phase=False, last_epoch=-1,
+                 verbose=False):
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.phase_pct = phase_pct
+        super().__init__(max_learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        s = step.astype(jnp.float32)
+        up = self.phase_pct * self.total_steps
+        down = self.total_steps - up
+
+        def cos_interp(a, b, pct):
+            return b + (a - b) * 0.5 * (1 + jnp.cos(jnp.pi * pct))
+
+        pct_up = jnp.clip(s / jnp.maximum(up, 1.0), 0.0, 1.0)
+        pct_down = jnp.clip((s - up) / jnp.maximum(down, 1.0), 0.0, 1.0)
+        lr_up = cos_interp(self.initial_lr, self.max_lr, pct_up)
+        lr_down = cos_interp(self.max_lr, self.end_lr, pct_down)
+        return jnp.where(s < up, lr_up, lr_down)
+
+
+class CyclicLR(LRScheduler):
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up=2000, step_size_down=None, mode="triangular",
+                 exp_gamma=1.0, scale_fn=None, scale_mode="cycle",
+                 last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.step_up = step_size_up
+        self.step_down = step_size_down or step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def value_at(self, step):
+        s = step.astype(jnp.float32)
+        total = self.step_up + self.step_down
+        cycle = jnp.floor(1 + s / total)
+        pos = s - (cycle - 1) * total
+        frac = jnp.where(pos < self.step_up, pos / self.step_up,
+                         1 - (pos - self.step_up) / self.step_down)
+        amp = self.max_lr - self.base_lr
+        if self.mode == "triangular2":
+            amp = amp / (2.0 ** (cycle - 1))
+        elif self.mode == "exp_range":
+            amp = amp * self.exp_gamma ** s
+        return self.base_lr + amp * frac

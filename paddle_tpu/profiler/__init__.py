@@ -1,0 +1,125 @@
+"""Profiler (ref: paddle/fluid/platform/profiler/ two-generation tracer +
+python/paddle/profiler/profiler.py:339 Profiler with scheduler states and
+chrome-trace export).
+
+TPU equivalent: jax.profiler (XLA/TPU trace → TensorBoard/Perfetto) plus
+RecordEvent-style host annotations and the IPS/MFU benchmark timer
+(≙ python/paddle/profiler/timer.py).
+"""
+
+import contextlib
+import time
+from typing import Callable, Optional
+
+import jax
+
+from paddle_tpu.profiler.timer import Benchmark, benchmark
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+           "export_chrome_tracing", "Benchmark", "benchmark",
+           "start_server"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    TPU = "tpu"
+    GPU = "tpu"  # parity alias
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class RecordEvent:
+    """Host-side named range (ref: platform/profiler/event_tracing.h
+    RecordEvent) — shows up in the XLA trace via jax.profiler.TraceAnnotation."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """Returns an on_trace_ready callback (ref: profiler.py:210)."""
+    def handle(prof):
+        pass  # trace already written by jax.profiler to dir_name
+    handle.dir_name = dir_name
+    return handle
+
+
+class Profiler:
+    """ref: paddle.profiler.Profiler (profiler.py:339). Wraps
+    jax.profiler.start_trace/stop_trace; scheduler(state machine) reduced to
+    explicit start/stop + step marks."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, log_dir="./profiler_log"):
+        self.log_dir = getattr(on_trace_ready, "dir_name", None) or log_dir
+        self.timer_only = timer_only
+        self._running = False
+        self._step = 0
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        if not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+        self._running = True
+        self._last = time.perf_counter()
+
+    def stop(self):
+        if self._running and not self.timer_only:
+            jax.profiler.stop_trace()
+        self._running = False
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        avg = sum(self._step_times[-10:]) / len(self._step_times[-10:])
+        return f"avg step {avg * 1000:.2f} ms ({1.0 / avg:.2f} steps/s)"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return self.step_info()
+
+    def export(self, path=None, format=None):  # noqa: A002
+        pass  # jax.profiler already wrote the trace to log_dir
+
+
+def start_server(port: int = 9012):
+    """On-demand profiling server (≙ the reference's remote profiler)."""
+    return jax.profiler.start_server(port)

@@ -1,0 +1,85 @@
+"""IPS / MFU benchmark timer (ref: python/paddle/profiler/timer.py —
+``benchmark()`` hooks reporting ips during training; extended here with MFU
+as BASELINE.md requires: MFU = model_flops / (chips × peak_flops))."""
+
+import time
+
+__all__ = ["Benchmark", "benchmark"]
+
+# Peak dense BF16 FLOP/s per chip by TPU generation (public figures).
+PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def detect_peak_flops(default=197e12):
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+        for name, peak in PEAK_BF16_FLOPS.items():
+            if name in kind:
+                return peak
+    except Exception:
+        pass
+    return default
+
+
+class Benchmark:
+    """Step timer with ips/MFU reporting."""
+
+    def __init__(self, flops_per_step=None, num_chips=1, peak_flops=None):
+        self.flops_per_step = flops_per_step
+        self.num_chips = num_chips
+        self.peak_flops = peak_flops or detect_peak_flops()
+        self.reset()
+
+    def reset(self):
+        self.times = []
+        self._last = None
+
+    def begin(self):
+        self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self.times.append((now - self._last, num_samples))
+        self._last = now
+
+    def end(self):
+        self._last = None
+
+    @property
+    def avg_step_time(self):
+        if not self.times:
+            return float("nan")
+        # skip warmup step
+        ts = [t for t, _ in self.times[1:]] or [self.times[0][0]]
+        return sum(ts) / len(ts)
+
+    def ips(self):
+        ts = self.times[1:] or self.times
+        total_t = sum(t for t, _ in ts)
+        total_n = sum(n or 0 for _, n in ts)
+        return total_n / total_t if total_t > 0 else float("nan")
+
+    def mfu(self):
+        if self.flops_per_step is None:
+            return float("nan")
+        return self.flops_per_step / (
+            self.avg_step_time * self.num_chips * self.peak_flops)
+
+    def report(self):
+        return {"step_time_s": self.avg_step_time, "ips": self.ips(),
+                "mfu": self.mfu()}
+
+
+_global_benchmark = Benchmark()
+
+
+def benchmark():
+    return _global_benchmark

@@ -1,0 +1,111 @@
+"""Explicit-key RNG with named streams.
+
+Reference analogs:
+- phi::Generator per-device engines + paddle.seed
+  (paddle/phi/core/generator.h:23);
+- model-parallel determinism via ``RNGStatesTracker``
+  (python/paddle/distributed/fleet/layers/mpu/random.py:32) — named seed
+  streams so tensor-parallel dropout draws per-rank-distinct or replicated
+  noise by choice.
+
+JAX already gives deterministic splittable keys; this module layers on top:
+a global seed, a monotone draw counter per *named stream*, and a context
+manager to switch streams (TP layers use stream "model_parallel").
+"""
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+
+_state = threading.local()
+
+
+class _Stream:
+    __slots__ = ("seed", "counter")
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.counter = 0
+
+
+class RNGStatesTracker:
+    """Named RNG streams (ref: mpu/random.py:32 RNGStatesTracker)."""
+
+    def __init__(self):
+        self._streams: Dict[str, _Stream] = {}
+        self._current = "global"
+        self._streams["global"] = _Stream(0)
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self._streams and self._streams[name].seed != seed:
+            raise ValueError(f"stream {name!r} already added with a different seed")
+        self._streams.setdefault(name, _Stream(seed))
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model_parallel"):
+        if name not in self._streams:
+            raise KeyError(f"unknown rng stream {name!r}; call add() first")
+        prev, self._current = self._current, name
+        try:
+            yield
+        finally:
+            self._current = prev
+
+    def next_key(self, stream: Optional[str] = None) -> jax.Array:
+        s = self._streams[stream or self._current]
+        s.counter += 1
+        return jax.random.fold_in(jax.random.key(s.seed), s.counter)
+
+    def state_dict(self):
+        return {k: (v.seed, v.counter) for k, v in self._streams.items()}
+
+    def load_state_dict(self, state):
+        for k, (seed, counter) in state.items():
+            st = self._streams.setdefault(k, _Stream(seed))
+            st.seed, st.counter = seed, counter
+
+
+_tracker = RNGStatesTracker()
+
+
+def default_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def seed(s: int) -> None:
+    """Set the global seed (ref: paddle.seed). Resets all stream counters."""
+    _tracker._streams["global"] = _Stream(int(s))
+    for name, st in _tracker._streams.items():
+        if name != "global":
+            st.counter = 0
+
+
+def next_key(stream: Optional[str] = None) -> jax.Array:
+    """Draw the next PRNG key from a named stream (default: current)."""
+    return _tracker.next_key(stream)
+
+
+def split_key(key: Optional[jax.Array] = None, num: int = 2):
+    key = key if key is not None else next_key()
+    return jax.random.split(key, num)
+
+
+def get_rng_state():
+    return _tracker.state_dict()
+
+
+def set_rng_state(state):
+    _tracker.load_state_dict(state)
+
+
+@contextlib.contextmanager
+def rng_state(name: str):
+    """Switch the active named stream (ref: RNGStatesTracker.rng_state)."""
+    with _tracker.rng_state(name):
+        yield
+
+
+def add_rng_stream(name: str, seed_: int) -> None:
+    _tracker.add(name, seed_)

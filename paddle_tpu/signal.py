@@ -1,0 +1,88 @@
+"""Signal ops (ref: python/paddle/signal.py — frame, overlap_add, stft,
+istft)."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    x = jnp.asarray(x)
+    assert axis in (-1, x.ndim - 1), "frame: axis must be last"
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])
+    out = x[..., idx]  # (..., num_frames, frame_length)
+    return jnp.swapaxes(out, -1, -2)
+
+
+def overlap_add(x, hop_length, axis=-1):
+    x = jnp.asarray(x)
+    # (..., frame_length, num_frames)
+    frame_length = x.shape[-2]
+    num_frames = x.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    for i in range(num_frames):
+        out = out.at[..., i * hop_length:i * hop_length + frame_length].add(
+            x[..., i])
+    return out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,))
+    window = jnp.asarray(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if center:
+        pads = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pads, mode=pad_mode)
+    frames = frame(x, n_fft, hop_length)  # (..., n_fft, num_frames)
+    frames = frames * window[:, None]
+    spec = jnp.fft.fft(frames, axis=-2)
+    if onesided:
+        spec = spec[..., :n_fft // 2 + 1, :]
+    if normalized:
+        spec = spec / jnp.sqrt(n_fft)
+    return spec
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    x = jnp.asarray(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,))
+    window = jnp.asarray(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    if normalized:
+        x = x * jnp.sqrt(n_fft)
+    if onesided:
+        full = jnp.concatenate(
+            [x, jnp.conj(jnp.flip(x[..., 1:-1, :], axis=-2))], axis=-2)
+    else:
+        full = x
+    frames = jnp.fft.ifft(full, axis=-2).real  # (..., n_fft, num_frames)
+    frames = frames * window[:, None]
+    out = overlap_add(frames, hop_length)
+    wsq = overlap_add(
+        jnp.broadcast_to((window ** 2)[:, None],
+                         (n_fft, x.shape[-1])), hop_length)
+    out = out / jnp.maximum(wsq, 1e-11)
+    if center:
+        out = out[..., n_fft // 2:-(n_fft // 2)]
+    if length is not None:
+        out = out[..., :length]
+    return out

@@ -1,0 +1,79 @@
+"""Sparse tensors (ref: paddle/phi/core/sparse_coo_tensor.h +
+python/paddle/sparse/). XLA:TPU has no native sparse kernels; SparseCooTensor
+is a (indices, values, shape) triple with dense bridging — the pattern that
+matters for TPU (embedding-style scatter/gather) is expressed densely via
+segment_sum, which tiles well on the MXU/VPU."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+           "to_dense", "to_sparse_coo", "add", "matmul", "masked_matmul"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices = jnp.asarray(indices)  # (ndim, nnz)
+        self.values = jnp.asarray(values)    # (nnz, ...)
+        self.shape = tuple(shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape + self.values.shape[1:],
+                        self.values.dtype)
+        return out.at[tuple(self.indices)].add(self.values)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.values.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None):
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values)
+    if shape is None:
+        shape = tuple(int(i) for i in np.asarray(indices).max(axis=1) + 1)
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape):
+    crows = np.asarray(crows)
+    cols = np.asarray(cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return SparseCooTensor(np.stack([rows, cols]), values, shape)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else jnp.asarray(x)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    arr = np.asarray(jax.device_get(x))
+    idx = np.stack(np.nonzero(arr))
+    vals = arr[tuple(idx)]
+    return SparseCooTensor(idx, vals, arr.shape)
+
+
+def add(a, b):
+    return sparse_coo_tensor(
+        jnp.concatenate([a.indices, b.indices], axis=1),
+        jnp.concatenate([a.values, b.values]), a.shape)
+
+
+def matmul(a, b):
+    """SpMM as gather + segment-sum (dense-friendly on TPU)."""
+    b = jnp.asarray(b)
+    rows, cols = a.indices[0], a.indices[1]
+    contrib = a.values[:, None] * b[cols]
+    return jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0])
+
+
+def masked_matmul(x, y, mask: "SparseCooTensor"):
+    """Compute (x@y) only at mask positions."""
+    rows, cols = mask.indices[0], mask.indices[1]
+    vals = jnp.sum(jnp.asarray(x)[rows] * jnp.asarray(y).T[cols], axis=-1)
+    return SparseCooTensor(mask.indices, vals,
+                           (x.shape[0], jnp.asarray(y).shape[1]))

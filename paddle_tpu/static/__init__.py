@@ -1,0 +1,102 @@
+"""Static-graph surface (ref: python/paddle/static/ — Program/Executor/
+CompiledProgram, fluid/framework.py:5220, executor.py:912).
+
+The reference maintains a protobuf IR + interpreter (InterpreterCore). Here
+"static mode" IS the jit path: an InputSpec-described function traced once
+and compiled by XLA to a single TPU executable — realizing the reference's
+infrt/CINN ambition (SURVEY §7.1b item 4). This module provides the
+Program-style API shell over jax.jit + AOT lowering so reference code
+ports, plus save/load_inference_model via jax.export StableHLO.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["InputSpec", "CompiledFunction", "compile_fn", "Executor",
+           "save_inference_model", "load_inference_model", "default_main_program"]
+
+
+@dataclass(frozen=True)
+class InputSpec:
+    """ref: paddle.static.InputSpec."""
+
+    shape: tuple
+    dtype: Any = jnp.float32
+    name: Optional[str] = None
+
+    def to_shape_struct(self, batch=1):
+        shape = tuple(batch if (s is None or s == -1) else s
+                      for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+
+class CompiledFunction:
+    """AOT-compiled function (≙ CompiledProgram + InterpreterCore: build
+    once, run many; XLA owns scheduling/GC that the interpreter did)."""
+
+    def __init__(self, fn, input_specs: Sequence[InputSpec], batch=1):
+        self.fn = fn
+        self.input_specs = list(input_specs)
+        structs = [s.to_shape_struct(batch) for s in self.input_specs]
+        self.lowered = jax.jit(fn).lower(*structs)
+        self.executable = self.lowered.compile()
+
+    def __call__(self, *args):
+        return self.executable(*[jnp.asarray(a) for a in args])
+
+    def stablehlo(self):
+        return self.lowered.as_text()
+
+    def cost_analysis(self):
+        return self.executable.cost_analysis()
+
+
+def compile_fn(fn, input_specs, batch=1):
+    return CompiledFunction(fn, input_specs, batch)
+
+
+class Executor:
+    """API-parity Executor (ref: fluid/executor.py:912). ``run`` executes a
+    compiled function with a feed dict."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        if not callable(program):
+            raise TypeError(
+                "paddle_tpu Executor runs compiled functions; build one with "
+                "paddle_tpu.static.compile_fn(fn, input_specs)")
+        feed = feed or {}
+        args = list(feed.values())
+        out = program(*args)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o) for o in out]
+        return [np.asarray(out)]
+
+
+def default_main_program():
+    raise RuntimeError(
+        "paddle_tpu has no mutable global Program; trace a function with "
+        "paddle_tpu.jit.to_static / static.compile_fn instead "
+        "(ref Program IR: paddle/fluid/framework/framework.proto — replaced "
+        "by XLA HLO from tracing).")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export to StableHLO (≙ save_inference_model, python/paddle/static/
+    io.py:459; realizes the infrt MLIR ambition via jax.export)."""
+    from paddle_tpu.jit import save as jit_save
+    if program is None or not callable(program):
+        raise TypeError("pass the traced function as `program=`")
+    return jit_save(program, path_prefix, input_spec=feed_vars)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from paddle_tpu.jit import load as jit_load
+    return jit_load(path_prefix)

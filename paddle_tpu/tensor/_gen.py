@@ -1,0 +1,84 @@
+"""Table-driven generation of the elementwise/reduction op surface.
+
+Reference analog: python/paddle/tensor/{math,logic}.py — ~200 of the 314
+tensor functions are thin per-op dispatch wrappers there; here they are
+generated from one table, each with a numpy oracle registered for the OpTest
+harness (SURVEY.md §4).
+
+Input domains drive sample generation for gradient/oracle tests:
+  real      — N(0,1)
+  positive  — |N(0,1)| + 0.5
+  unit      — uniform(-0.9, 0.9)
+  ge1       — |N(0,1)| + 1.5
+  nonzero   — N(0,1) pushed away from 0
+  int       — random int32 in [0, 10)
+  bool      — random bool
+"""
+
+import functools
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+
+_RNG = np.random.RandomState(20240613)
+
+
+def _sample(domain, shape=(4, 5)):
+    if domain == "real":
+        return _RNG.randn(*shape).astype(np.float32)
+    if domain == "positive":
+        return (np.abs(_RNG.randn(*shape)) + 0.5).astype(np.float32)
+    if domain == "unit":
+        return _RNG.uniform(-0.9, 0.9, shape).astype(np.float32)
+    if domain == "unit01":
+        return _RNG.uniform(0.05, 0.95, shape).astype(np.float32)
+    if domain == "ge1":
+        return (np.abs(_RNG.randn(*shape)) + 1.5).astype(np.float32)
+    if domain == "nonzero":
+        x = _RNG.randn(*shape).astype(np.float32)
+        return x + np.sign(x) * 0.5
+    if domain == "int":
+        return _RNG.randint(0, 10, shape).astype(np.int32)
+    if domain == "bool":
+        return _RNG.rand(*shape) > 0.5
+    raise ValueError(domain)
+
+
+def make_unary(module_all, module_ns, table, category):
+    for name, (jfn, nfn, domain, diff) in table.items():
+        def fn(x, *, name=None, _jfn=jfn):
+            return _jfn(jnp.asarray(x))
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__doc__ = (f"Elementwise ``{name}``. Ref: python/paddle/tensor/ "
+                      f"op of the same name; TPU impl: XLA HLO.")
+        register_op(name, fn, category, np_ref=nfn,
+                    sample_args=functools.partial(_make_unary_sample, domain),
+                    differentiable=diff)
+        module_ns[name] = fn
+        module_all.append(name)
+
+
+def _make_unary_sample(domain):
+    return (_sample(domain),), {}
+
+
+def make_binary(module_all, module_ns, table, category):
+    for name, (jfn, nfn, domain, diff) in table.items():
+        def fn(x, y, *, name=None, _jfn=jfn):
+            return _jfn(jnp.asarray(x), jnp.asarray(y))
+        fn.__name__ = name
+        fn.__qualname__ = name
+        fn.__doc__ = (f"Elementwise binary ``{name}`` with numpy-style "
+                      f"broadcasting. Ref: python/paddle/tensor/.")
+        register_op(name, fn, category, np_ref=nfn,
+                    sample_args=functools.partial(_make_binary_sample, domain),
+                    differentiable=diff)
+        module_ns[name] = fn
+        module_all.append(name)
+
+
+def _make_binary_sample(domain):
+    return (_sample(domain), _sample(domain)), {}

@@ -1,0 +1,125 @@
+"""Creation ops (ref: python/paddle/tensor/creation.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.dtypes import get_default_dtype, to_dtype
+from paddle_tpu.ops.registry import register_op
+
+__all__ = []
+
+
+def _reg(name, fn):
+    register_op(name, fn, "creation", differentiable=False)
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _dt(dtype, floating=True):
+    if dtype is None:
+        return get_default_dtype() if floating else np.int64
+    return to_dtype(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    arr = jnp.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(to_dtype(dtype))
+    return arr
+
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, _dt(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    if dtype is None:
+        return jnp.full(shape, fill_value)
+    return jnp.full(shape, fill_value, to_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(jnp.asarray(x), dtype=None if dtype is None else to_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(jnp.asarray(x), dtype=None if dtype is None else to_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(jnp.asarray(x), fill_value,
+                         dtype=None if dtype is None else to_dtype(dtype))
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step,
+                      dtype=None if dtype is None else to_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_dt(dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, num, base=base, dtype=_dt(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype))
+
+
+def tril_indices(row, col=None, offset=0):
+    r = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack(r, axis=0)
+
+
+def triu_indices(row, col=None, offset=0):
+    r = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack(r, axis=0)
+
+
+def clone(x):
+    return jnp.asarray(x) + 0  # functional copy
+
+
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def complex(real, imag):  # noqa: A001
+    import jax
+    return jax.lax.complex(jnp.asarray(real), jnp.asarray(imag))
+
+
+def polar(abs, angle):  # noqa: A002
+    import jax
+    a = jnp.asarray(abs)
+    t = jnp.asarray(angle)
+    return jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t))
+
+
+def one_hot(x, num_classes):
+    import jax
+    return jax.nn.one_hot(jnp.asarray(x), num_classes)
+
+
+for _n in ["to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+           "full_like", "empty", "empty_like", "arange", "linspace",
+           "logspace", "eye", "tril_indices", "triu_indices", "clone",
+           "assign", "complex", "polar", "one_hot"]:
+    _reg(_n, globals()[_n])

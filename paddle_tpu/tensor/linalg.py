@@ -1,0 +1,245 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py — e.g. ``matmul``
+at linalg.py:142 — and the phi matmul/blas kernels,
+paddle/phi/kernels/funcs/blas/). On TPU every matmul lowers to the MXU via
+XLA dot_general; precision is controlled by the ``matmul_precision`` flag."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import flags
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor._gen import _sample
+
+__all__ = []
+
+
+def _reg(name, fn, np_ref=None, sample=None, diff=True):
+    register_op(name, fn, "linalg", np_ref=np_ref, sample_args=sample,
+                differentiable=diff)
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _precision():
+    return {"default": jax.lax.Precision.DEFAULT,
+            "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}[
+        flags.get_flag("matmul_precision")]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    """Batched matmul on the MXU (ref: python/paddle/tensor/linalg.py:142 →
+    phi MatmulKernel). Transposes fold into XLA's dot_general dimension
+    numbers rather than materializing."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=_precision())
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(jnp.asarray(x), jnp.asarray(y), precision=_precision())
+
+
+def dot(x, y):
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    return jnp.sum(x * y, axis=-1)
+
+
+def mv(x, vec):
+    return matmul(x, vec)
+
+
+def t(x):
+    x = jnp.asarray(x)
+    return x if x.ndim < 2 else jnp.swapaxes(x, -1, -2)
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    x = jnp.asarray(x)
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (tuple, list))
+                               else None, axis=axis, keepdims=keepdim)
+    if p == np.inf or p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf or p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def cond(x, p=None):
+    return jnp.linalg.cond(jnp.asarray(x), p=p)
+
+
+def det(x):
+    return jnp.linalg.det(jnp.asarray(x))
+
+
+def slogdet(x):
+    s, l = jnp.linalg.slogdet(jnp.asarray(x))
+    return jnp.stack([s, l])
+
+
+def inv(x):
+    return jnp.linalg.inv(jnp.asarray(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(jnp.asarray(x), rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(jnp.asarray(x), jnp.asarray(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        jnp.asarray(x), jnp.asarray(y), lower=not upper,
+        trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+
+def cholesky(x, upper=False):
+    c = jnp.linalg.cholesky(jnp.asarray(x))
+    return jnp.swapaxes(c, -1, -2) if upper else c
+
+
+def cholesky_solve(x, y, upper=False):
+    y_ = jnp.asarray(y)
+    return jax.scipy.linalg.cho_solve((jnp.asarray(y_), not upper),
+                                      jnp.asarray(x))
+
+
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(jnp.asarray(x))
+    return lu_, piv
+
+
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(jnp.asarray(x), mode=mode)
+
+
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(jnp.asarray(x), full_matrices=full_matrices)
+
+
+def eig(x):
+    """Not supported on TPU backends (no complex eigensolver in XLA:TPU);
+    computed on host CPU like the reference's CPU-only Eig kernel."""
+    w, v = np.linalg.eig(np.asarray(jax.device_get(x)))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(jnp.asarray(x), UPLO=UPLO)
+
+
+def eigvals(x):
+    w = np.linalg.eigvals(np.asarray(jax.device_get(x)))
+    return jnp.asarray(w)
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(jnp.asarray(x), UPLO=UPLO)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(jnp.asarray(x), n)
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(jnp.asarray(x), rtol=tol)
+
+
+def multi_dot(xs):
+    return jnp.linalg.multi_dot([jnp.asarray(x) for x in xs])
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(jnp.asarray(x), jnp.asarray(y), axis=axis)
+
+
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    x = jnp.asarray(x)
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(jnp.asarray(x), weights=weights, minlength=minlength,
+                        length=None)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *[jnp.asarray(o) for o in operands],
+                      precision=_precision())
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank_, sv = jnp.linalg.lstsq(jnp.asarray(x), jnp.asarray(y),
+                                           rcond=rcond)
+    return sol, res, rank_, sv
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(jnp.asarray(x), rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(jnp.asarray(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+_reg("matmul", matmul, np.matmul,
+     lambda: ((_sample("real", (4, 5)), _sample("real", (5, 3))), {}))
+_reg("mm", mm, None)
+_reg("bmm", bmm, np.matmul,
+     lambda: ((_sample("real", (2, 4, 5)), _sample("real", (2, 5, 3))), {}))
+_reg("dot", dot, None)
+_reg("mv", mv, None)
+_reg("t", t, np.transpose, lambda: ((_sample("real"),), {}))
+_reg("norm", norm, np.linalg.norm, lambda: ((_sample("real"),), {}))
+_reg("cond", cond, None, diff=False)
+_reg("det", det, np.linalg.det, lambda: ((_sample("real", (3, 3)),), {}))
+_reg("slogdet", slogdet, None)
+_reg("inv", inv, np.linalg.inv, lambda: ((_sample("real", (3, 3)),), {}))
+_reg("pinv", pinv, None)
+_reg("solve", solve, None)
+_reg("triangular_solve", triangular_solve, None)
+_reg("cholesky", cholesky, None)
+_reg("cholesky_solve", cholesky_solve, None)
+_reg("lu", lu, None, diff=False)
+_reg("qr", qr, None, diff=False)
+_reg("svd", svd, None, diff=False)
+_reg("eig", eig, None, diff=False)
+_reg("eigh", eigh, None, diff=False)
+_reg("eigvals", eigvals, None, diff=False)
+_reg("eigvalsh", eigvalsh, None, diff=False)
+_reg("matrix_power", matrix_power, None)
+_reg("matrix_rank", matrix_rank, None, diff=False)
+_reg("multi_dot", multi_dot, None)
+_reg("cross", cross, np.cross,
+     lambda: ((_sample("real", (4, 3)), _sample("real", (4, 3))), {}))
+_reg("histogram", histogram, None, diff=False)
+_reg("bincount", bincount, None, diff=False)
+_reg("einsum", einsum, None)
+_reg("lstsq", lstsq, None, diff=False)
+_reg("corrcoef", corrcoef, None)
+_reg("cov", cov, None)

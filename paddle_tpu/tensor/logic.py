@@ -1,0 +1,74 @@
+"""Comparison / logic ops (ref: python/paddle/tensor/logic.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor._gen import _sample
+
+__all__ = []
+
+
+def _reg(name, fn, np_ref=None):
+    register_op(name, fn, "logic", np_ref=np_ref,
+                sample_args=(lambda: ((_sample("real"), _sample("real")), {}))
+                if np_ref is not None else None,
+                differentiable=False)
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def equal(x, y):
+    return jnp.equal(jnp.asarray(x), jnp.asarray(y))
+
+
+def not_equal(x, y):
+    return jnp.not_equal(jnp.asarray(x), jnp.asarray(y))
+
+
+def greater_than(x, y):
+    return jnp.greater(jnp.asarray(x), jnp.asarray(y))
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(jnp.asarray(x), jnp.asarray(y))
+
+
+def less_than(x, y):
+    return jnp.less(jnp.asarray(x), jnp.asarray(y))
+
+
+def less_equal(x, y):
+    return jnp.less_equal(jnp.asarray(x), jnp.asarray(y))
+
+
+def equal_all(x, y):
+    return jnp.array_equal(jnp.asarray(x), jnp.asarray(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(jnp.asarray(x), jnp.asarray(y), rtol=rtol, atol=atol,
+                        equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(jnp.asarray(x), jnp.asarray(y), rtol=rtol, atol=atol,
+                       equal_nan=equal_nan)
+
+
+def is_tensor(x):
+    import jax
+    return isinstance(x, jax.Array)
+
+
+_reg("equal", equal, np.equal)
+_reg("not_equal", not_equal, np.not_equal)
+_reg("greater_than", greater_than, np.greater)
+_reg("greater_equal", greater_equal, np.greater_equal)
+_reg("less_than", less_than, np.less)
+_reg("less_equal", less_equal, np.less_equal)
+_reg("equal_all", equal_all)
+_reg("allclose", allclose)
+_reg("isclose", isclose, np.isclose)
+_reg("is_tensor", is_tensor)

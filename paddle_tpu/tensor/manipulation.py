@@ -1,0 +1,417 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py).
+
+All are pure views/copies in XLA; there is no LoD machinery (the reference's
+LoDTensor ragged-sequence legacy, framework/lod_tensor.h, is replaced by
+explicit masks/segment-ids as is idiomatic for static-shape TPU programs).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor._gen import _sample
+
+__all__ = []
+
+_slice = slice  # builtin, before the `slice` op below shadows it
+
+
+def _reg(name, fn, np_ref=None, sample=None, diff=True):
+    register_op(name, fn, "manipulation", np_ref=np_ref, sample_args=sample,
+                differentiable=diff)
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def reshape(x, shape):
+    return jnp.reshape(jnp.asarray(x), shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    x = jnp.asarray(x)
+    nd = x.ndim
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(jnp.asarray(x), perm)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(jnp.asarray(x), source, destination)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(jnp.asarray(x), axis1, axis2)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(jnp.asarray(x), axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(jnp.asarray(x), axis)
+
+
+def concat(x, axis=0):
+    return jnp.concatenate([jnp.asarray(t) for t in x], axis=axis)
+
+
+def stack(x, axis=0):
+    return jnp.stack([jnp.asarray(t) for t in x], axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    x = jnp.asarray(x)
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(t, axis=axis)
+            for t in jnp.split(x, n, axis=axis)]
+
+
+def split(x, num_or_sections, axis=0):
+    x = jnp.asarray(x)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    # sections list → cumulative indices; -1 means "the rest"
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    idx = np.cumsum(sections)[:-1]
+    return jnp.split(x, idx, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.array_split(jnp.asarray(x), chunks, axis=axis)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(jnp.asarray(x), repeat_times)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(jnp.asarray(x), repeats, axis=axis)
+
+
+def expand(x, shape):
+    x = jnp.asarray(x)
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(jnp.asarray(x), jnp.asarray(y).shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(jnp.asarray(x), shape)
+
+
+def broadcast_tensors(inputs):
+    return list(jnp.broadcast_arrays(*[jnp.asarray(t) for t in inputs]))
+
+
+def flip(x, axis):
+    return jnp.flip(jnp.asarray(x), axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(jnp.asarray(x), shifts, axis=axis)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(jnp.asarray(x), jnp.asarray(index), axis=axis)
+
+
+def gather_nd(x, index):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite=True):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index).reshape(-1)
+    updates = jnp.asarray(updates)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(shape, jnp.asarray(updates).dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def scatter_nd_add(x, index, updates):
+    x = jnp.asarray(x)
+    index = jnp.asarray(index)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def put_along_axis(x, index, value, axis, reduce="assign"):  # noqa: A002
+    x = jnp.asarray(x)
+    if reduce == "assign":
+        return jnp.put_along_axis(x, jnp.asarray(index), value, axis=axis,
+                                  inplace=False)
+    mode = {"add": "add", "multiply": "multiply", "mul": "multiply"}[reduce]
+    idx = jnp.asarray(index)
+    full = [jnp.broadcast_to(jnp.arange(s).reshape(
+        [-1 if d == i else 1 for d in range(x.ndim)]), idx.shape)
+        for i, s in enumerate(x.shape)]
+    full[axis] = idx
+    if mode == "add":
+        return x.at[tuple(full)].add(value)
+    return x.at[tuple(full)].multiply(value)
+
+
+def take_along_axis(x, index, axis):
+    return jnp.take_along_axis(jnp.asarray(x), jnp.asarray(index), axis=axis)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(jnp.asarray(x), jnp.asarray(index), axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(jnp.asarray(x), jnp.asarray(index), axis=1)
+
+
+def index_add(x, index, axis, value):
+    x = jnp.asarray(x)
+    idx = [_slice(None)] * x.ndim
+    idx[axis] = jnp.asarray(index)
+    return x.at[tuple(idx)].add(jnp.asarray(value))
+
+
+def masked_select(x, mask):
+    """Dynamic-shape op: returns a host-side compacted array (not jittable on
+    TPU by design; use ``where``/mask arithmetic inside compiled code)."""
+    x = np.asarray(x)
+    mask = np.asarray(mask)
+    return jnp.asarray(x[mask])
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(jnp.asarray(mask), value, jnp.asarray(x))
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(jnp.asarray(condition), jnp.asarray(x), jnp.asarray(y))
+
+
+def nonzero(x, as_tuple=False):
+    x = np.asarray(x)
+    res = np.nonzero(x)
+    if as_tuple:
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(np.stack(res, axis=1))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    x = jnp.asarray(x)
+    if len(pad) == 2 * x.ndim:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle convention: pad applies to the last len(pad)//2 spatial dims,
+        # ordered innermost-last, e.g. NCHW with pad=[l,r,t,b]
+        n_spatial = len(pad) // 2
+        pairs = [(0, 0)] * (x.ndim - n_spatial)
+        spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        pairs += spatial[::-1]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pairs, mode=jmode, constant_values=value)
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    """Dynamic-shape op — host-side like the reference's CPU fallback."""
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    x_np = np.asarray(x)
+    if axis is None:
+        flat = x_np.reshape(-1)
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+    else:
+        moved = np.moveaxis(x_np, axis, 0)
+        flat2d = moved.reshape(moved.shape[0], -1)
+        diff = (flat2d[1:] != flat2d[:-1]).any(axis=1)
+        keep = np.concatenate([[True], diff])
+        flat = moved
+    out = np.moveaxis(flat[keep], 0, axis) if axis is not None else flat[keep]
+    res = [jnp.asarray(out)]
+    if return_inverse:
+        res.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        res.append(jnp.asarray(np.diff(np.append(idx, len(keep)))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def as_complex(x):
+    x = jnp.asarray(x)
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    x = jnp.asarray(x)
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def real(x):
+    return jnp.real(jnp.asarray(x))
+
+
+def imag(x):
+    return jnp.imag(jnp.asarray(x))
+
+
+def cast(x, dtype):
+    from paddle_tpu.dtypes import to_dtype
+    return jnp.asarray(x).astype(to_dtype(dtype))
+
+
+def crop(x, shape=None, offsets=None):
+    x = jnp.asarray(x)
+    offsets = offsets or [0] * x.ndim
+    shape = shape or x.shape
+    slices = tuple(_slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    x = jnp.asarray(x)
+    slices = [_slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = _slice(st, en, sd)
+    return x[tuple(slices)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    return strided_slice(x, axes, starts, ends, [1] * len(axes))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    x = jnp.asarray(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (x >= lo) & (x < hi)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(jnp.asarray(x), jnp.asarray(y), axes=axes)
+
+
+def diag(x, offset=0, padding_value=0.0):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, x.dtype)
+        return base + jnp.diag(x, k=offset) - jnp.diag(
+            jnp.full((x.shape[0],), padding_value, x.dtype), k=offset)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(jnp.asarray(x), k=offset)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    x = jnp.asarray(x)
+    out = jnp.zeros(x.shape + (x.shape[-1] + abs(offset),), x.dtype)
+    out = jnp.vectorize(lambda v: jnp.diag(v, k=offset),
+                        signature="(n)->(m,m)")(x)
+    return out
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(jnp.asarray(x), k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(jnp.asarray(x), k=diagonal)
+
+
+def meshgrid(*args, indexing="ij"):
+    return list(jnp.meshgrid(*[jnp.asarray(a) for a in args],
+                             indexing=indexing))
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)
+
+
+def numel(x):
+    return jnp.asarray(jnp.size(jnp.asarray(x)))
+
+
+def shape(x):
+    return jnp.asarray(jnp.asarray(x).shape, dtype=jnp.int32)
+
+
+def rank(x):
+    return jnp.asarray(jnp.asarray(x).ndim, dtype=jnp.int32)
+
+
+def is_empty(x):
+    return jnp.asarray(jnp.size(jnp.asarray(x)) == 0)
+
+
+def view(x, shape_or_dtype):
+    x = jnp.asarray(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, shape_or_dtype)
+    return x.view(shape_or_dtype)
+
+
+def view_as(x, other):
+    return jnp.reshape(jnp.asarray(x), jnp.asarray(other).shape)
+
+
+def atleast_1d(*xs):
+    r = jnp.atleast_1d(*[jnp.asarray(x) for x in xs])
+    return r
+
+
+def atleast_2d(*xs):
+    return jnp.atleast_2d(*[jnp.asarray(x) for x in xs])
+
+
+def atleast_3d(*xs):
+    return jnp.atleast_3d(*[jnp.asarray(x) for x in xs])
+
+
+for _n in ["reshape", "flatten", "transpose", "moveaxis", "swapaxes",
+           "squeeze", "unsqueeze", "concat", "stack", "unstack", "split",
+           "chunk", "tile", "repeat_interleave", "expand", "expand_as",
+           "broadcast_to", "broadcast_tensors", "flip", "roll", "gather",
+           "gather_nd", "scatter", "scatter_nd", "scatter_nd_add",
+           "put_along_axis", "take_along_axis", "index_select",
+           "index_sample", "masked_select", "masked_fill", "where", "nonzero",
+           "pad", "unique", "unique_consecutive", "as_complex", "as_real",
+           "real", "imag", "cast", "crop", "strided_slice", "slice",
+           "shard_index", "tensordot", "diag", "diagflat", "index_add", "tril",
+           "triu", "meshgrid", "unbind", "numel", "shape", "rank", "is_empty",
+           "view", "view_as", "atleast_1d", "atleast_2d", "atleast_3d"]:
+    _reg(_n, globals()[_n])

@@ -1,0 +1,332 @@
+"""Math ops (ref: python/paddle/tensor/math.py — largest of the tensor-op
+modules). All functions take/return jax Arrays; autodiff, broadcasting and
+fusion come from tracing into XLA, so there is no per-op kernel or grad-node
+codegen (contrast eager_gen.py in the reference)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp_special
+
+import scipy.special as sp_special
+
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor._gen import make_unary, make_binary, _sample
+
+__all__ = []
+
+# name: (jax_fn, numpy_oracle, input_domain, differentiable)
+_UNARY = {
+    "abs": (jnp.abs, np.abs, "nonzero", True),
+    "acos": (jnp.arccos, np.arccos, "unit", True),
+    "acosh": (jnp.arccosh, np.arccosh, "ge1", True),
+    "asin": (jnp.arcsin, np.arcsin, "unit", True),
+    "asinh": (jnp.arcsinh, np.arcsinh, "real", True),
+    "atan": (jnp.arctan, np.arctan, "real", True),
+    "atanh": (jnp.arctanh, np.arctanh, "unit", True),
+    "ceil": (jnp.ceil, np.ceil, "real", False),
+    "cos": (jnp.cos, np.cos, "real", True),
+    "cosh": (jnp.cosh, np.cosh, "real", True),
+    "deg2rad": (jnp.deg2rad, np.deg2rad, "real", True),
+    "digamma": (jsp_special.digamma, sp_special.digamma, "positive", True),
+    "erf": (jax.lax.erf, sp_special.erf, "real", True),
+    "erfinv": (jax.lax.erf_inv, sp_special.erfinv, "unit", True),
+    "exp": (jnp.exp, np.exp, "real", True),
+    "expm1": (jnp.expm1, np.expm1, "real", True),
+    "floor": (jnp.floor, np.floor, "real", False),
+    "frac": (lambda x: x - jnp.trunc(x), lambda x: x - np.trunc(x), "real", True),
+    "i0": (jsp_special.i0, sp_special.i0, "real", True),
+    "i0e": (jsp_special.i0e, sp_special.i0e, "real", True),
+    "i1": (jsp_special.i1, sp_special.i1, "real", True),
+    "i1e": (jsp_special.i1e, sp_special.i1e, "real", True),
+    "lgamma": (jsp_special.gammaln, sp_special.gammaln, "positive", True),
+    "log": (jnp.log, np.log, "positive", True),
+    "log10": (jnp.log10, np.log10, "positive", True),
+    "log1p": (jnp.log1p, np.log1p, "positive", True),
+    "log2": (jnp.log2, np.log2, "positive", True),
+    "neg": (jnp.negative, np.negative, "real", True),
+    "rad2deg": (jnp.rad2deg, np.rad2deg, "real", True),
+    "reciprocal": (jnp.reciprocal, np.reciprocal, "nonzero", True),
+    "round": (jnp.round, np.round, "real", False),
+    "rsqrt": (jax.lax.rsqrt, lambda x: 1.0 / np.sqrt(x), "positive", True),
+    "sigmoid": (jax.nn.sigmoid, lambda x: 1 / (1 + np.exp(-x)), "real", True),
+    "sign": (jnp.sign, np.sign, "nonzero", False),
+    "sgn": (jnp.sign, np.sign, "nonzero", False),
+    "sin": (jnp.sin, np.sin, "real", True),
+    "sinh": (jnp.sinh, np.sinh, "real", True),
+    "sqrt": (jnp.sqrt, np.sqrt, "positive", True),
+    "square": (jnp.square, np.square, "real", True),
+    "tan": (jnp.tan, np.tan, "unit", True),
+    "tanh": (jnp.tanh, np.tanh, "real", True),
+    "trunc": (jnp.trunc, np.trunc, "real", False),
+    "angle": (jnp.angle, np.angle, "nonzero", False),
+    "conj": (jnp.conj, np.conj, "real", True),
+    "isfinite": (jnp.isfinite, np.isfinite, "real", False),
+    "isinf": (jnp.isinf, np.isinf, "real", False),
+    "isnan": (jnp.isnan, np.isnan, "real", False),
+    "logit": (jsp_special.logit, sp_special.logit, "unit01", True),
+    "exp2": (jnp.exp2, np.exp2, "real", True),
+}
+
+_BINARY = {
+    "add": (jnp.add, np.add, "real", True),
+    "subtract": (jnp.subtract, np.subtract, "real", True),
+    "multiply": (jnp.multiply, np.multiply, "real", True),
+    "divide": (jnp.divide, np.divide, "nonzero", True),
+    "floor_divide": (jnp.floor_divide, np.floor_divide, "positive", False),
+    "mod": (jnp.mod, np.mod, "positive", False),
+    "remainder": (jnp.remainder, np.remainder, "positive", False),
+    "pow": (jnp.power, np.power, "positive", True),
+    "maximum": (jnp.maximum, np.maximum, "real", True),
+    "minimum": (jnp.minimum, np.minimum, "real", True),
+    "fmax": (jnp.fmax, np.fmax, "real", True),
+    "fmin": (jnp.fmin, np.fmin, "real", True),
+    "atan2": (jnp.arctan2, np.arctan2, "nonzero", True),
+    "hypot": (jnp.hypot, np.hypot, "real", True),
+    "logaddexp": (jnp.logaddexp, np.logaddexp, "real", True),
+    "heaviside": (jnp.heaviside, np.heaviside, "nonzero", False),
+    "copysign": (jnp.copysign, np.copysign, "nonzero", False),
+    "nextafter": (jnp.nextafter, np.nextafter, "real", False),
+    "ldexp": (lambda x, y: x * jnp.exp2(jnp.floor(y)),
+              lambda x, y: x * np.exp2(np.floor(y)), "real", True),
+    "gcd": (jnp.gcd, np.gcd, "int", False),
+    "lcm": (jnp.lcm, np.lcm, "int", False),
+    "bitwise_and": (jnp.bitwise_and, np.bitwise_and, "int", False),
+    "bitwise_or": (jnp.bitwise_or, np.bitwise_or, "int", False),
+    "bitwise_xor": (jnp.bitwise_xor, np.bitwise_xor, "int", False),
+    "logical_and": (jnp.logical_and, np.logical_and, "bool", False),
+    "logical_or": (jnp.logical_or, np.logical_or, "bool", False),
+    "logical_xor": (jnp.logical_xor, np.logical_xor, "bool", False),
+}
+
+make_unary(__all__, globals(), _UNARY, "math.unary")
+make_binary(__all__, globals(), _BINARY, "math.binary")
+
+
+def _reg(name, fn, np_ref=None, sample=None, category="math", diff=True):
+    register_op(name, fn, category, np_ref=np_ref, sample_args=sample,
+                differentiable=diff)
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(jnp.asarray(x))
+
+
+def logical_not(x):
+    return jnp.logical_not(jnp.asarray(x))
+
+
+_reg("bitwise_not", bitwise_not, np.bitwise_not,
+     lambda: ((_sample("int"),), {}), diff=False)
+_reg("logical_not", logical_not, np.logical_not,
+     lambda: ((_sample("bool"),), {}), diff=False)
+
+
+# -------------------- reductions --------------------
+
+def _axis_kw(axis, keepdim):
+    return dict(axis=axis, keepdims=keepdim)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return jnp.sum(jnp.asarray(x), axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(jnp.asarray(x), axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jsp_special.logsumexp(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(jnp.asarray(x), axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+for _name, _np in [("sum", np.sum), ("mean", np.mean), ("max", np.max),
+                   ("min", np.min), ("prod", np.prod), ("amax", np.amax),
+                   ("amin", np.amin), ("nansum", np.nansum),
+                   ("nanmean", np.nanmean)]:
+    _reg(_name, globals()[_name], _np, lambda: ((_sample("real"),), {}),
+         category="math.reduce")
+_reg("logsumexp", logsumexp, sp_special.logsumexp,
+     lambda: ((_sample("real"),), {}), category="math.reduce")
+_reg("count_nonzero", count_nonzero, np.count_nonzero,
+     lambda: ((_sample("int"),), {}), category="math.reduce", diff=False)
+_reg("all", globals()["all"], np.all, lambda: ((_sample("bool"),), {}),
+     category="math.reduce", diff=False)
+_reg("any", globals()["any"], np.any, lambda: ((_sample("bool"),), {}),
+     category="math.reduce", diff=False)
+
+
+# -------------------- scans / cumulative --------------------
+
+def cumsum(x, axis=None, dtype=None):
+    x = jnp.asarray(x)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(jnp.asarray(x), axis=dim, dtype=dtype)
+
+
+def cummax(x, axis=-1):
+    x = jnp.asarray(x)
+    vals = jax.lax.associative_scan(jax.lax.max, x, axis=axis)
+    return vals
+
+
+def cummin(x, axis=-1):
+    return jax.lax.associative_scan(jax.lax.min, jnp.asarray(x), axis=axis)
+
+
+def logcumsumexp(x, axis=-1):
+    return jax.lax.cumlogsumexp(jnp.asarray(x), axis=axis)
+
+
+_reg("cumsum", cumsum, lambda x: np.cumsum(x.reshape(-1)),
+     lambda: ((_sample("real"),), {}))
+_reg("cumprod", cumprod, None)
+_reg("cummax", cummax, lambda x: np.maximum.accumulate(x, -1),
+     lambda: ((_sample("real"),), {}), diff=False)
+_reg("cummin", cummin, lambda x: np.minimum.accumulate(x, -1),
+     lambda: ((_sample("real"),), {}), diff=False)
+_reg("logcumsumexp", logcumsumexp, None)
+
+
+# -------------------- misc math --------------------
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(jnp.asarray(x), min, max)
+
+
+def lerp(x, y, weight):
+    return x + weight * (jnp.asarray(y) - x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * (jnp.asarray(x) @ jnp.asarray(y))
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = jnp.asarray(index).reshape(-1)
+    return jnp.take_along_axis(
+        stacked, idx[None, :, None].astype(jnp.int32), axis=0)[0]
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):  # noqa: A002
+    x = jnp.asarray(x)
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * jnp.asarray(x))
+
+
+def softplus_op(x, beta=1.0, threshold=20.0):
+    x = jnp.asarray(x)
+    return jnp.where(x * beta > threshold, x,
+                     jnp.log1p(jnp.exp(beta * x)) / beta)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(jnp.asarray(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(jnp.asarray(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def kron(x, y):
+    return jnp.kron(jnp.asarray(x), jnp.asarray(y))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(jnp.asarray(x), n=n, axis=axis, prepend=prepend,
+                    append=append)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(jnp.asarray(x), k=k, axes=axes)
+
+
+def inner(x, y):
+    return jnp.inner(jnp.asarray(x), jnp.asarray(y))
+
+
+def outer(x, y):
+    return jnp.outer(jnp.asarray(x), jnp.asarray(y))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(jnp.asarray(x), nan=nan, posinf=posinf,
+                          neginf=neginf)
+
+
+def take(x, index, mode="raise"):
+    return jnp.take(jnp.asarray(x).reshape(-1), jnp.asarray(index),
+                    mode="clip" if mode == "raise" else mode)
+
+
+_reg("clip", clip, lambda x: x, lambda: ((_sample("real"),), {}))
+_reg("lerp", lerp, None)
+_reg("addmm", addmm, None)
+_reg("multiplex", multiplex, None)
+_reg("scale", scale, lambda x: x, lambda: ((_sample("real"),), {}))
+_reg("stanh", stanh, lambda x: 1.7159 * np.tanh(0.67 * x),
+     lambda: ((_sample("real"),), {}))
+_reg("trace", trace, np.trace, lambda: ((_sample("real"),), {}))
+_reg("diagonal", diagonal, np.diagonal, lambda: ((_sample("real"),), {}))
+_reg("kron", kron, np.kron, lambda: ((_sample("real"), _sample("real")), {}))
+_reg("diff", diff, np.diff, lambda: ((_sample("real"),), {}))
+_reg("rot90", rot90, np.rot90, lambda: ((_sample("real"),), {}))
+_reg("inner", inner, np.inner, lambda: ((_sample("real"), _sample("real")), {}))
+_reg("outer", outer, None)
+_reg("nan_to_num", nan_to_num, np.nan_to_num, lambda: ((_sample("real"),), {}))
+_reg("take", take, None, diff=False)

@@ -1,0 +1,111 @@
+"""Random sampling ops (ref: python/paddle/tensor/random.py).
+
+Unlike the reference's stateful per-device Generator (phi/core/generator.h:23),
+these draw keys from the named-stream tracker in paddle_tpu.random — explicit
+JAX keys under the hood, so the same program is reproducible across chips and
+meshes, and TP layers can opt into per-rank-distinct streams
+(rng_state("model_parallel"))."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import random as pt_random
+from paddle_tpu.dtypes import get_default_dtype, to_dtype
+from paddle_tpu.ops.registry import register_op
+
+__all__ = []
+
+
+def _reg(name, fn):
+    register_op(name, fn, "random", differentiable=False)
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _key(key):
+    return key if key is not None else pt_random.next_key()
+
+
+def _dt(dtype):
+    return get_default_dtype() if dtype is None else to_dtype(dtype)
+
+
+def rand(shape, dtype=None, key=None):
+    return jax.random.uniform(_key(key), shape, _dt(dtype))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, key=None):  # noqa: A002
+    return jax.random.uniform(_key(key), shape, _dt(dtype), min, max)
+
+
+def randn(shape, dtype=None, key=None):
+    return jax.random.normal(_key(key), shape, _dt(dtype))
+
+
+def normal(mean=0.0, std=1.0, shape=None, key=None):
+    shape = shape if shape is not None else ()
+    return mean + std * jax.random.normal(_key(key), shape, get_default_dtype())
+
+
+def standard_normal(shape, dtype=None, key=None):
+    return jax.random.normal(_key(key), shape, _dt(dtype))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), shape, low, high, to_dtype(dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, key=None):
+    x = jnp.asarray(x)
+    return randint(low, high, x.shape, dtype or x.dtype, key)
+
+
+def randperm(n, dtype="int64", key=None):
+    return jax.random.permutation(_key(key), n).astype(to_dtype(dtype))
+
+
+def shuffle(x, axis=0, key=None):
+    return jax.random.permutation(_key(key), jnp.asarray(x), axis=axis)
+
+
+def multinomial(x, num_samples=1, replacement=False, key=None):
+    x = jnp.asarray(x)
+    logits = jnp.log(x / jnp.sum(x, axis=-1, keepdims=True))
+    if replacement:
+        return jax.random.categorical(_key(key), logits,
+                                      shape=x.shape[:-1] + (num_samples,),
+                                      axis=-1)
+    k = _key(key)
+    # Gumbel top-k trick for sampling without replacement
+    g = jax.random.gumbel(k, x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx
+
+
+def bernoulli(x, key=None):
+    x = jnp.asarray(x)
+    return jax.random.bernoulli(_key(key), x).astype(x.dtype)
+
+
+def poisson(x, key=None):
+    x = jnp.asarray(x)
+    return jax.random.poisson(_key(key), x).astype(x.dtype)
+
+
+def exponential_(x, lam=1.0, key=None):
+    x = jnp.asarray(x)
+    return (jax.random.exponential(_key(key), x.shape) / lam).astype(x.dtype)
+
+
+def binomial(count, prob, key=None):
+    return jax.random.binomial(_key(key), jnp.asarray(count),
+                               jnp.asarray(prob))
+
+
+for _n in ["rand", "uniform", "randn", "normal", "standard_normal", "randint",
+           "randint_like", "randperm", "shuffle", "multinomial", "bernoulli",
+           "poisson", "exponential_", "binomial"]:
+    _reg(_n, globals()[_n])

@@ -1,0 +1,100 @@
+"""Search / sort ops (ref: python/paddle/tensor/search.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor._gen import _sample
+
+__all__ = []
+
+
+def _reg(name, fn, np_ref=None, sample=None, diff=False):
+    register_op(name, fn, "search", np_ref=np_ref, sample_args=sample,
+                differentiable=diff)
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    r = jnp.argmax(jnp.asarray(x), axis=axis, keepdims=keepdim if axis is not None else False)
+    return r
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(jnp.asarray(x), axis=axis,
+                      keepdims=keepdim if axis is not None else False)
+
+
+def argsort(x, axis=-1, descending=False, stable=True):
+    x = jnp.asarray(x)
+    idx = jnp.argsort(x, axis=axis, stable=stable,
+                      descending=descending)
+    return idx
+
+
+def sort(x, axis=-1, descending=False, stable=True):
+    x = jnp.asarray(x)
+    return jnp.sort(x, axis=axis, stable=stable, descending=descending)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    x = jnp.asarray(x)
+    if axis not in (-1, x.ndim - 1):
+        x_m = jnp.moveaxis(x, axis, -1)
+        v, i = topk(x_m, k, -1, largest, sorted)
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    if largest:
+        return jax.lax.top_k(x, k)
+    v, i = jax.lax.top_k(-x, k)
+    return -v, i
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    r = jnp.searchsorted(jnp.asarray(sorted_sequence), jnp.asarray(values),
+                         side="right" if right else "left")
+    return r.astype(jnp.int32) if out_int32 else r
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    x = jnp.asarray(x)
+    s = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis)
+    v = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return v, idx
+
+
+def mode(x, axis=-1, keepdim=False):
+    x_np = np.asarray(jax.device_get(x))
+    import scipy.stats
+    m = scipy.stats.mode(x_np, axis=axis, keepdims=keepdim)
+    return jnp.asarray(m.mode), jnp.asarray(m.count)
+
+
+def index_fill(x, index, axis, value):
+    x = jnp.asarray(x)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = jnp.asarray(index)
+    return x.at[tuple(idx)].set(value)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+_reg("argmax", argmax, np.argmax, lambda: ((_sample("real"),), {}))
+_reg("argmin", argmin, np.argmin, lambda: ((_sample("real"),), {}))
+_reg("argsort", argsort, np.argsort, lambda: ((_sample("real"),), {}))
+_reg("sort", sort, np.sort, lambda: ((_sample("real"),), {}), diff=True)
+_reg("topk", topk)
+_reg("searchsorted", searchsorted)
+_reg("kthvalue", kthvalue)
+_reg("mode", mode)
+_reg("index_fill", index_fill)
+_reg("bucketize", bucketize)

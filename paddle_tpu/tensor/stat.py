@@ -1,0 +1,53 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+from paddle_tpu.tensor._gen import _sample
+
+__all__ = []
+
+
+def _reg(name, fn, np_ref=None, sample=None, diff=True):
+    register_op(name, fn, "stat", np_ref=np_ref, sample_args=sample,
+                differentiable=diff)
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(jnp.asarray(x), axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(jnp.asarray(x), axis=axis, ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(jnp.asarray(x), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(jnp.asarray(x), jnp.asarray(q), axis=axis,
+                        keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(jnp.asarray(x), jnp.asarray(q), axis=axis,
+                           keepdims=keepdim)
+
+
+_reg("std", std, lambda x: np.std(x, ddof=1), lambda: ((_sample("real"),), {}))
+_reg("var", var, lambda x: np.var(x, ddof=1), lambda: ((_sample("real"),), {}))
+_reg("median", median, np.median, lambda: ((_sample("real"),), {}))
+_reg("nanmedian", nanmedian, np.nanmedian, lambda: ((_sample("real"),), {}))
+_reg("quantile", quantile, None)
+_reg("nanquantile", nanquantile, None)

@@ -1,0 +1,62 @@
+"""Text domain (ref: python/paddle/text/ — dataset loaders). Provides
+viterbi_decode (ref: paddle.text.viterbi_decode phi kernel) and synthetic
+datasets for hermetic tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["viterbi_decode", "SyntheticTextDataset"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """CRF Viterbi decode via lax.scan (ref: phi viterbi_decode kernel)."""
+    pots = jnp.asarray(potentials)  # (B, T, N)
+    trans = jnp.asarray(transition_params)  # (N, N)
+    B, T, N = pots.shape
+
+    def step(carry, emit_t):
+        score = carry  # (B, N)
+        # score[b, i] + trans[i, j] + emit[b, j]
+        cand = score[:, :, None] + trans[None, :, :]
+        best = jnp.max(cand, axis=1)
+        idx = jnp.argmax(cand, axis=1)
+        return best + emit_t, idx
+
+    init = pots[:, 0]
+    emits = jnp.moveaxis(pots[:, 1:], 1, 0)  # (T-1, B, N)
+    final, backptrs = jax.lax.scan(step, init, emits)
+    scores = jnp.max(final, axis=-1)
+    last = jnp.argmax(final, axis=-1)
+
+    def backtrack(carry, ptr_t):
+        tag = carry
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(backtrack, last, jnp.flip(backptrs, axis=0))
+    path = jnp.concatenate(
+        [jnp.flip(path_rev, axis=0), last[None]], axis=0)
+    return scores, jnp.moveaxis(path, 0, 1)
+
+
+class SyntheticTextDataset(Dataset):
+    """Deterministic token-sequence dataset for LM tests/benches."""
+
+    def __init__(self, num_samples=1024, seq_len=128, vocab_size=1000,
+                 seed=0):
+        rng = np.random.RandomState(seed)
+        # markov-ish structure so models can learn
+        self.tokens = rng.randint(0, vocab_size,
+                                  (num_samples, seq_len + 1)).astype(np.int64)
+        self.tokens[:, 1::2] = (self.tokens[:, 0::2][:, :self.tokens[:, 1::2].shape[1]]
+                                + 1) % vocab_size
+
+    def __getitem__(self, idx):
+        return self.tokens[idx, :-1], self.tokens[idx, 1:]
+
+    def __len__(self):
+        return len(self.tokens)

@@ -1,0 +1,9 @@
+"""Vision domain (ref: python/paddle/vision/ — datasets, transforms, 12
+model families). Models land incrementally in paddle_tpu.vision.models."""
+
+from paddle_tpu.vision import transforms
+from paddle_tpu.vision import datasets
+from paddle_tpu.vision import models
+from paddle_tpu.vision import ops
+
+__all__ = ["transforms", "datasets", "models", "ops"]

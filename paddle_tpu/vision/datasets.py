@@ -1,0 +1,121 @@
+"""Vision datasets (ref: python/paddle/vision/datasets/ — MNIST, Cifar,
+Flowers, VOC…). Network download is environment-gated; synthetic fallbacks
+keep tests/benchmarks hermetic."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "SyntheticImages"]
+
+
+class SyntheticImages(Dataset):
+    """Deterministic fake image classification data (for tests/benches)."""
+
+    def __init__(self, num_samples=256, image_shape=(1, 28, 28),
+                 num_classes=10, seed=0, transform=None):
+        self.n = num_samples
+        rng = np.random.RandomState(seed)
+        self.images = rng.randn(num_samples, *image_shape).astype(np.float32)
+        self.labels = rng.randint(0, num_classes,
+                                  num_samples).astype(np.int64)
+        # plant a learnable signal: mean offset per class
+        for i in range(num_samples):
+            self.images[i] += 0.5 * self.labels[i] / num_classes
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.n
+
+
+class MNIST(Dataset):
+    """ref: paddle.vision.datasets.MNIST. Reads IDX files from
+    ``data_dir``; falls back to synthetic data when files are absent
+    (zero-egress environments)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 data_dir=None):
+        self.transform = transform
+        data_dir = data_dir or os.environ.get("PT_DATA_DIR", "")
+        img_f = image_path or os.path.join(
+            data_dir, f"{'train' if mode == 'train' else 't10k'}-images-idx3-ubyte.gz")
+        lbl_f = label_path or os.path.join(
+            data_dir, f"{'train' if mode == 'train' else 't10k'}-labels-idx1-ubyte.gz")
+        if os.path.exists(img_f) and os.path.exists(lbl_f):
+            self.images = self._read_images(img_f)
+            self.labels = self._read_labels(lbl_f)
+        else:
+            synth = SyntheticImages(4096 if mode == "train" else 512,
+                                    (28, 28), 10,
+                                    seed=0 if mode == "train" else 1)
+            self.images = (synth.images * 64 + 128).clip(0, 255).astype(
+                np.uint8)
+            self.labels = synth.labels
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        synth = SyntheticImages(4096 if mode == "train" else 512,
+                                (3, 32, 32), self.NUM_CLASSES,
+                                seed=2 if mode == "train" else 3)
+        self.images = synth.images
+        self.labels = synth.labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar10(_CifarBase):
+    NUM_CLASSES = 10
+
+
+class Cifar100(_CifarBase):
+    NUM_CLASSES = 100

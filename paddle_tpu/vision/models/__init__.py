@@ -1,0 +1,15 @@
+"""Vision model zoo (ref: python/paddle/vision/models/ — 12 families)."""
+
+from paddle_tpu.vision.models.lenet import LeNet
+from paddle_tpu.vision.models.resnet import (ResNet, resnet18, resnet34,
+                                             resnet50, resnet101, resnet152,
+                                             BasicBlock, BottleneckBlock)
+from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from paddle_tpu.vision.models.mobilenet import (MobileNetV1, MobileNetV2,
+                                                mobilenet_v1, mobilenet_v2)
+from paddle_tpu.vision.models.alexnet import AlexNet, alexnet
+
+__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
+           "resnet101", "resnet152", "BasicBlock", "BottleneckBlock", "VGG",
+           "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV1", "MobileNetV2",
+           "mobilenet_v1", "mobilenet_v2", "AlexNet", "alexnet"]

@@ -1,0 +1,111 @@
+"""MobileNet v1/v2 (ref: python/paddle/vision/models/mobilenet{v1,v2}.py)."""
+
+import paddle_tpu.nn as nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def _conv_bn(in_c, out_c, k, s=1, p=0, groups=1, act=True):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=s, padding=p, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act:
+        layers.append(nn.ReLU6())
+    return nn.Sequential(*layers)
+
+
+class MobileNetV1(nn.Module):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+            [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_conv_bn(3, c(32), 3, 2, 1)]
+        for in_c, out_c, s in cfg:
+            layers.append(_conv_bn(c(in_c), c(in_c), 3, s, 1,
+                                   groups=c(in_c)))
+            layers.append(_conv_bn(c(in_c), c(out_c), 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from paddle_tpu.tensor.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+class _InvertedResidual(nn.Module):
+    def __init__(self, in_c, out_c, stride, expand):
+        super().__init__()
+        hidden = int(round(in_c * expand))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand != 1:
+            layers.append(_conv_bn(in_c, hidden, 1))
+        layers += [_conv_bn(hidden, hidden, 3, stride, 1, groups=hidden),
+                   _conv_bn(hidden, out_c, 1, act=False)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Module):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = c(32)
+        layers = [_conv_bn(3, in_c, 3, 2, 1)]
+        for t, ch, n, s in cfg:
+            out_c = c(ch)
+            for i in range(n):
+                layers.append(_InvertedResidual(in_c, out_c,
+                                                s if i == 0 else 1, t))
+                in_c = out_c
+        self.last_c = c(1280)
+        layers.append(_conv_bn(in_c, self.last_c, 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(self.last_c,
+                                                      num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from paddle_tpu.tensor.manipulation import flatten
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,169 @@
+"""Vision ops (ref: python/paddle/vision/ops.py — yolo_box, nms, roi_align,
+deform_conv, DetectionOutput helpers)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["nms", "box_coder", "yolo_box", "roi_align", "distribute_fpn_proposals"]
+
+
+def _iou_matrix(boxes1, boxes2):
+    area1 = (boxes1[:, 2] - boxes1[:, 0]) * (boxes1[:, 3] - boxes1[:, 1])
+    area2 = (boxes2[:, 2] - boxes2[:, 0]) * (boxes2[:, 3] - boxes2[:, 1])
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area1[:, None] + area2[None, :] - inter + 1e-9)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """ref: paddle.vision.ops.nms. Static-shape greedy NMS via lax loop:
+    returns keep mask indices (host-compacted)."""
+    boxes = jnp.asarray(boxes)
+    n = boxes.shape[0]
+    if scores is None:
+        scores = jnp.ones((n,))
+    scores = jnp.asarray(scores)
+    order = jnp.argsort(-scores)
+    boxes_sorted = boxes[order]
+    iou = _iou_matrix(boxes_sorted, boxes_sorted)
+
+    def body(i, keep):
+        # suppress j>i with iou>thr if i kept
+        sup = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    kept_sorted = np.nonzero(np.asarray(jax.device_get(keep)))[0]
+    result = np.asarray(jax.device_get(order))[kept_sorted]
+    if top_k is not None:
+        result = result[:top_k]
+    return jnp.asarray(result)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0):
+    pb = jnp.asarray(prior_box)
+    tb = jnp.asarray(target_box)
+    var = jnp.asarray(prior_box_var) if prior_box_var is not None else 1.0
+    pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+    ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+    px = pb[:, 0] + pw / 2
+    py = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+        th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+        tx = tb[:, 0] + tw / 2
+        ty = tb[:, 1] + th / 2
+        out = jnp.stack([(tx - px) / pw, (ty - py) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+        return out / var
+    # decode
+    d = tb * var
+    ox = d[..., 0] * pw + px
+    oy = d[..., 1] * ph + py
+    ow = jnp.exp(d[..., 2]) * pw
+    oh = jnp.exp(d[..., 3]) * ph
+    return jnp.stack([ox - ow / 2, oy - oh / 2, ox + ow / 2, oy + oh / 2],
+                     axis=-1)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """ref: paddle.vision.ops.yolo_box (phi yolo_box kernel) — decode YOLO
+    head predictions to boxes+scores."""
+    x = jnp.asarray(x)
+    b, c, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(b, na, -1, h, w)  # (B, A, 5+cls, H, W)
+    grid_x = jnp.arange(w, dtype=jnp.float32)
+    grid_y = jnp.arange(h, dtype=jnp.float32)
+    cx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_x[None, None, None, :]) / w
+    cy = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_y[None, None, :, None]) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    obj = jax.nn.sigmoid(x[:, :, 4])
+    cls = jax.nn.sigmoid(x[:, :, 5:5 + class_num])
+    img_size = jnp.asarray(img_size, jnp.float32)  # (B, 2) h,w
+    img_h = img_size[:, 0][:, None, None, None]
+    img_w = img_size[:, 1][:, None, None, None]
+    x0 = (cx - bw / 2) * img_w
+    y0 = (cy - bh / 2) * img_h
+    x1 = (cx + bw / 2) * img_w
+    y1 = (cy + bh / 2) * img_h
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, img_w - 1)
+        y0 = jnp.clip(y0, 0, img_h - 1)
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(b, -1, 4)
+    scores = (obj[:, :, None] * cls).transpose(0, 1, 3, 4, 2).reshape(
+        b, -1, class_num)
+    mask = (obj > conf_thresh).reshape(b, -1)
+    scores = scores * mask[..., None]
+    return boxes, scores
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """ref: paddle.vision.ops.roi_align — bilinear pooled ROI features."""
+    x = jnp.asarray(x)  # (N, C, H, W)
+    boxes = jnp.asarray(boxes)  # (R, 4)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    offset = 0.5 if aligned else 0.0
+
+    # assume single image (N=1) or boxes_num maps rois → image 0; general
+    # batched variant handled by vmapping over images upstream
+    feat = x[0]
+
+    def one_roi(box):
+        x0, y0, x1, y1 = box * spatial_scale - offset
+        rw = jnp.maximum(x1 - x0, 1e-3)
+        rh = jnp.maximum(y1 - y0, 1e-3)
+        ys = y0 + (jnp.arange(oh) + 0.5) * rh / oh
+        xs = x0 + (jnp.arange(ow) + 0.5) * rw / ow
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        y0i = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+        x0i = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        wy = yy - y0i
+        wx = xx - x0i
+        v00 = feat[:, y0i, x0i]
+        v01 = feat[:, y0i, x1i]
+        v10 = feat[:, y1i, x0i]
+        v11 = feat[:, y1i, x1i]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    return jax.vmap(one_roi)(boxes)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False):
+    rois = np.asarray(jax.device_get(fpn_rois))
+    ws = rois[:, 2] - rois[:, 0]
+    hs = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.maximum(ws * hs, 1e-6))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs = []
+    restore = np.argsort(
+        np.concatenate([np.nonzero(lvl == l)[0]
+                        for l in range(min_level, max_level + 1)]))
+    for l in range(min_level, max_level + 1):
+        outs.append(jnp.asarray(rois[lvl == l]))
+    counts = [int((lvl == l).sum()) for l in range(min_level, max_level + 1)]
+    return outs, jnp.asarray(restore), [jnp.asarray([c]) for c in counts]

@@ -1,0 +1,36 @@
+"""Test harness config: force an 8-virtual-device CPU platform BEFORE jax
+initializes, so sharding/mesh tests run without TPU hardware (SURVEY §7 test
+strategy — the reference's analog is multi-process localhost NCCL tests,
+test_collective_api_base.py; here a virtual mesh in one process suffices
+because collectives are compiler constructs)."""
+
+import os
+
+# The environment pins JAX_PLATFORMS=axon (TPU tunnel) via sitecustomize;
+# tests must run on a virtual 8-device CPU platform, so override forcibly.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as pt
+    pt.seed(1234)
+    np.random.seed(1234)
+    yield
+
+
+@pytest.fixture
+def mesh8():
+    import paddle_tpu.distributed as dist
+    return dist.init_mesh(dp=2, tp=2, fsdp=2)

@@ -1,0 +1,122 @@
+"""Module system tests: pytree behavior, state_dict, containers, Context."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def test_parameters_and_state_dict():
+    m = MLP()
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["fc1.bias", "fc1.weight", "fc2.bias", "fc2.weight"]
+    sd = m.state_dict()
+    assert sd["fc1.weight"].shape == (4, 8)
+
+
+def test_module_is_pytree():
+    m = MLP()
+    leaves = jax.tree_util.tree_leaves(m)
+    assert len(leaves) == 4
+    m2 = jax.tree_util.tree_map(lambda x: x * 0, m)
+    assert float(jnp.sum(jnp.abs(m2.fc1.weight))) == 0.0
+    # original untouched
+    assert float(jnp.sum(jnp.abs(m.fc1.weight))) > 0.0
+
+
+def test_jit_over_module():
+    m = MLP()
+
+    @jax.jit
+    def f(model, x):
+        return model(x)
+
+    x = jnp.ones((3, 4))
+    np.testing.assert_allclose(np.asarray(f(m, x)), np.asarray(m(x)),
+                               rtol=1e-6)
+
+
+def test_split_merge_params():
+    m = MLP()
+    params, _ = m.split_params()
+    params2 = jax.tree_util.tree_map(lambda p: p + 1.0, params)
+    m2 = m.merge_params(params2)
+    np.testing.assert_allclose(np.asarray(m2.fc1.weight),
+                               np.asarray(m.fc1.weight) + 1.0)
+
+
+def test_grad_through_module():
+    m = MLP()
+    params, _ = m.split_params()
+    x = jnp.ones((5, 4))
+    y = jnp.zeros((5,), jnp.int32)
+
+    def loss_fn(p):
+        out = m.merge_params(p)(x)
+        return nn.functional.cross_entropy(out, y)
+
+    g = jax.grad(loss_fn)(params)
+    assert set(g) == set(params)
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+    assert len(s) == 3
+    out = s(jnp.ones((2, 4)))
+    assert out.shape == (2, 2)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.named_parameters())) == 6
+
+
+def test_dropout_training_vs_eval():
+    d = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    with nn.stateful(training=True, rng=jax.random.key(0)):
+        out_t = d(x)
+    with nn.stateful(training=False):
+        out_e = d(x)
+    assert float(jnp.mean((out_t == 0).astype(jnp.float32))) > 0.3
+    np.testing.assert_array_equal(np.asarray(out_e), np.asarray(x))
+
+
+def test_batchnorm_context_updates():
+    bn = nn.BatchNorm2D(3).tag_paths()
+    x = jax.random.normal(jax.random.key(0), (8, 3, 4, 4)) * 2 + 1
+    with nn.stateful(training=True) as ctx:
+        out = bn(x)
+    assert "_mean" in "".join(ctx.updates)
+    bn2 = bn.apply_updates(ctx.updates)
+    # running mean moved toward batch mean
+    assert float(jnp.sum(jnp.abs(bn2._mean))) > 0
+    # eval mode uses running stats, no updates recorded
+    with nn.stateful(training=False) as ctx2:
+        bn2(x)
+    assert not ctx2.updates
+
+
+def test_state_dict_roundtrip(tmp_path):
+    m = MLP()
+    pt.save(m.state_dict(), str(tmp_path / "m.pdparams"))
+    m2 = MLP()
+    m2.set_state_dict(pt.load(str(tmp_path / "m.pdparams")))
+    np.testing.assert_allclose(np.asarray(m2.fc1.weight),
+                               np.asarray(m.fc1.weight))
+
+
+def test_astype_casts_params():
+    m = MLP().astype("bfloat16")
+    assert m.fc1.weight.dtype == jnp.bfloat16

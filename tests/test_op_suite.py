@@ -1,0 +1,68 @@
+"""OpTest harness (ref: python/paddle/fluid/tests/unittests/op_test.py:333 —
+one numpy oracle × N execution modes). Here the modes are eager (op-by-op
+XLA) and jit (traced), checked against the registered numpy reference;
+gradients checked against finite differences for differentiable ops."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu  # noqa: F401  (populates the registry)
+from paddle_tpu.ops.registry import all_ops
+
+ORACLE_OPS = [op for op in all_ops()
+              if op.np_ref is not None and op.sample_args is not None]
+
+
+@pytest.mark.parametrize("op", ORACLE_OPS, ids=lambda o: o.name)
+def test_eager_matches_numpy(op):
+    args, kwargs = op.sample_args()
+    got = op.fn(*args, **kwargs)
+    want = op.np_ref(*[np.asarray(a) for a in args])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("op", ORACLE_OPS, ids=lambda o: o.name)
+def test_jit_matches_eager(op):
+    args, kwargs = op.sample_args()
+    eager = op.fn(*args, **kwargs)
+    jitted = jax.jit(lambda *a: op.fn(*a, **kwargs))(*args)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager),
+                               rtol=1e-6, atol=1e-6)
+
+
+GRAD_OPS = [op for op in ORACLE_OPS if op.differentiable]
+
+
+@pytest.mark.parametrize("op", GRAD_OPS, ids=lambda o: o.name)
+def test_grad_matches_finite_difference(op):
+    """≙ OpTest.check_grad (op_test.py:2131): analytic vs numeric grads."""
+    args, kwargs = op.sample_args()
+    if not args or not np.issubdtype(np.asarray(args[0]).dtype,
+                                     np.floating):
+        pytest.skip("non-float primary input")
+
+    def scalar_fn(x0):
+        out = op.fn(x0, *args[1:], **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return jnp.sum(jnp.asarray(out) ** 2) / 2
+
+    analytic = np.asarray(jax.grad(scalar_fn)(jnp.asarray(args[0])))
+    x = np.asarray(args[0], np.float32)
+    eps = 1e-3
+    flat = x.reshape(-1)
+    # probe a handful of coordinates (full FD is O(n) evaluations)
+    idxs = np.linspace(0, flat.size - 1, min(5, flat.size)).astype(int)
+    for i in idxs:
+        xp = flat.copy()
+        xm = flat.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        fp = float(scalar_fn(jnp.asarray(xp.reshape(x.shape))))
+        fm = float(scalar_fn(jnp.asarray(xm.reshape(x.shape))))
+        numeric = (fp - fm) / (2 * eps)
+        got = analytic.reshape(-1)[i]
+        np.testing.assert_allclose(got, numeric, rtol=3e-2, atol=3e-3,
+                                   err_msg=f"op={op.name} coord={i}")
