@@ -1,0 +1,12 @@
+"""Model zoo (ref: PaddleNLP model families + python/paddle/vision/models).
+
+The flagship pretrain family is GPT (ref: PaddleNLP gpt-3, the BASELINE
+north-star config); vision models live in paddle_tpu.vision.models.
+"""
+
+from paddle_tpu.models import gpt
+from paddle_tpu.models.gpt import (GPT, GPTConfig, gpt_tiny, gpt3_125m,
+                                   gpt3_350m, gpt3_1p3b)
+
+__all__ = ["gpt", "GPT", "GPTConfig", "gpt_tiny", "gpt3_125m", "gpt3_350m",
+           "gpt3_1p3b"]

@@ -1,0 +1,471 @@
+"""GPT decoder-only LM — the flagship pretrain model family.
+
+Reference analog: PaddleNLP gpt-3 trained with fleet hybrid parallel
+(SURVEY §2.2, §3.4; BASELINE north-star "GPT-3 1.3B pretrain, DP×MP×PP").
+The reference expresses parallelism as wrapper modules + NCCL ops
+(mp_layers.py ColumnParallelLinear:173 / RowParallelLinear:327,
+pipeline_parallel.py:117 1F1B); here the same strategies are sharding
+annotations on one jitted program over a named mesh:
+
+- TP  ≙ megatron Column/Row parallel: qkv/up weights sharded P('fsdp','tp'),
+  out/down weights P('tp','fsdp'); XLA inserts the reduce-scatter/all-reduce
+  the reference codes by hand (c_identity / mp_allreduce, mpu/mp_ops.py).
+- FSDP ≙ sharding stage 3: every weight additionally sharded over 'fsdp';
+  XLA all-gathers at use and reduce-scatters grads (ZeRO-3 semantics without
+  the reference's gather/release hooks, group_sharded_stage3.py:59).
+- SP: activation seq axis sharded over 'sp' (capability absent in the
+  reference, SURVEY §5.7).
+- PP ≙ GPipe/1F1B: see `pipelined_apply` — stage-stacked weights sharded
+  P('pp') with a rolling activation buffer; XLA compiles the roll into a
+  collective-permute ring over ICI. (ref contrast: FleetExecutor/interceptor
+  runtime + send_v2/recv_v2 ops.)
+"""
+
+import dataclasses
+import math
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu import nn
+from paddle_tpu.nn.module import Module, Parameter, LayerList
+from paddle_tpu.nn import functional as F
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    max_seq_len: int = 1024
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = True
+    tie_embeddings: bool = True
+    # remat ≙ reference recompute (fleet/recompute/recompute.py:386)
+    remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def d_ffn(self):
+        return self.d_model * self.ffn_mult
+
+    def flops_per_token(self) -> float:
+        """Model FLOPs per token (fwd+bwd), 6*N + attention term."""
+        n = self.num_params(non_embedding=True)
+        attn = 12 * self.n_layers * self.d_model * self.max_seq_len
+        return 6 * n + attn
+
+    def num_params(self, non_embedding: bool = False) -> int:
+        d, L = self.d_model, self.n_layers
+        per_layer = 4 * d * d + 2 * d * self.d_ffn + 4 * d
+        if self.use_bias:
+            per_layer += 5 * d + self.d_ffn  # bqkv(3d)+bo(d)+bup(ffn)+bdown(d)
+        n = L * per_layer + 2 * d  # + final ln
+        if not non_embedding:
+            n += self.vocab_size * d + self.max_seq_len * d
+            if not self.tie_embeddings:
+                n += self.vocab_size * d
+        return n
+
+
+def _normal(key, shape, std, dtype):
+    return (std * jax.random.normal(key, shape)).astype(dtype)
+
+
+class GPTBlock(Module):
+    """Pre-LN transformer decoder block with fused qkv (one (d,3d) matmul
+    keeps the MXU busy vs three thin ones)."""
+
+    def __init__(self, cfg: GPTConfig, key: jax.Array):
+        super().__init__()
+        d, h = cfg.d_model, cfg.n_heads
+        self.n_heads = h
+        self.head_dim = cfg.head_dim
+        self.dropout = cfg.dropout
+        ks = jax.random.split(key, 4)
+        std = 0.02
+        resid_std = std / math.sqrt(2 * cfg.n_layers)
+        dt = cfg.dtype
+        self.ln1_scale = Parameter(jnp.ones((d,), jnp.float32))
+        self.ln1_bias = Parameter(jnp.zeros((d,), jnp.float32))
+        self.ln2_scale = Parameter(jnp.ones((d,), jnp.float32))
+        self.ln2_bias = Parameter(jnp.zeros((d,), jnp.float32))
+        self.wqkv = Parameter(_normal(ks[0], (d, 3 * d), std, dt))
+        self.wo = Parameter(_normal(ks[1], (d, d), resid_std, dt))
+        self.wup = Parameter(_normal(ks[2], (d, cfg.d_ffn), std, dt))
+        self.wdown = Parameter(_normal(ks[3], (cfg.d_ffn, d), resid_std, dt))
+        if cfg.use_bias:
+            self.bqkv = Parameter(jnp.zeros((3 * d,), dt))
+            self.bo = Parameter(jnp.zeros((d,), dt))
+            self.bup = Parameter(jnp.zeros((cfg.d_ffn,), dt))
+            self.bdown = Parameter(jnp.zeros((d,), dt))
+        else:
+            self.bqkv = self.bo = self.bup = self.bdown = None
+
+    def _ln(self, x, scale, bias):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.var(x32, -1, keepdims=True)
+        y = (x32 - mu) * lax.rsqrt(var + 1e-5) * scale + bias
+        return y.astype(x.dtype)
+
+    def forward(self, x, rng_key=None):
+        b, s, d = x.shape
+        h = self._ln(x, self.ln1_scale, self.ln1_bias)
+        qkv = h @ self.wqkv
+        if self.bqkv is not None:
+            qkv = qkv + self.bqkv
+        qkv = qkv.reshape(b, s, 3, self.n_heads, self.head_dim)
+        qkv = _shard_act(qkv, P(_BATCH_AXES, "sp", None, "tp", None))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              dropout_p=0.0)
+        attn = attn.reshape(b, s, d)
+        o = attn @ self.wo
+        if self.bo is not None:
+            o = o + self.bo
+        x = x + _maybe_dropout(o, self.dropout, rng_key, 1)
+        h = self._ln(x, self.ln2_scale, self.ln2_bias)
+        h = jax.nn.gelu(h @ self.wup + (self.bup if self.bup is not None
+                                        else 0.0))
+        h = _shard_act(h, P(_BATCH_AXES, "sp", "tp"))
+        h = h @ self.wdown
+        if self.bdown is not None:
+            h = h + self.bdown
+        x = x + _maybe_dropout(h, self.dropout, rng_key, 2)
+        return _shard_act(x, P(_BATCH_AXES, "sp", None))
+
+
+_BATCH_AXES = ("dp", "fsdp")
+
+
+def _maybe_dropout(x, p, key, salt):
+    if p == 0.0 or key is None:
+        return x
+    k = jax.random.fold_in(key, salt)
+    keep = jax.random.bernoulli(k, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def _shard_act(x, spec: P):
+    """Constrain activation sharding when a global mesh is installed and we
+    are under its trace; no-op otherwise (single-chip / no mesh)."""
+    from paddle_tpu.distributed.mesh import get_mesh
+    mesh = get_mesh()
+    if mesh is None or mesh.size == 1:
+        return x
+    try:
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except Exception:
+        return x
+
+
+class GPT(Module):
+    """≙ PaddleNLP GPTForPretraining (decoder-only, learned positions)."""
+
+    def __init__(self, cfg: GPTConfig, seed: int = 0):
+        super().__init__()
+        self.cfg = cfg
+        key = jax.random.PRNGKey(seed)
+        kw, kp, kh, kb = jax.random.split(key, 4)
+        dt = cfg.dtype
+        self.wte = Parameter(_normal(kw, (cfg.vocab_size, cfg.d_model),
+                                     0.02, dt))
+        self.wpe = Parameter(_normal(kp, (cfg.max_seq_len, cfg.d_model),
+                                     0.01, dt))
+        self.blocks = LayerList([
+            GPTBlock(cfg, jax.random.fold_in(kb, i))
+            for i in range(cfg.n_layers)])
+        self.lnf_scale = Parameter(jnp.ones((cfg.d_model,), jnp.float32))
+        self.lnf_bias = Parameter(jnp.zeros((cfg.d_model,), jnp.float32))
+        if not cfg.tie_embeddings:
+            self.lm_head = Parameter(_normal(kh, (cfg.d_model,
+                                                  cfg.vocab_size), 0.02, dt))
+        else:
+            self.lm_head = None
+
+    def embed(self, tokens):
+        s = tokens.shape[-1]
+        x = jnp.take(self.wte, tokens, axis=0) + self.wpe[:s]
+        return _shard_act(x, P(_BATCH_AXES, "sp", None))
+
+    def head(self, x):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.var(x32, -1, keepdims=True)
+        x = ((x32 - mu) * lax.rsqrt(var + 1e-5) * self.lnf_scale
+             + self.lnf_bias).astype(x.dtype)
+        w = self.wte.T if self.lm_head is None else self.lm_head
+        logits = x @ w
+        return _shard_act(logits, P(_BATCH_AXES, "sp", "tp"))
+
+    def forward(self, tokens, rng_key=None):
+        x = self.embed(tokens)
+        blk_fn = (jax.checkpoint(lambda b, h, k: b(h, k),
+                                 static_argnums=())
+                  if self.cfg.remat else (lambda b, h, k: b(h, k)))
+        for i in range(self.cfg.n_layers):
+            k = (jax.random.fold_in(rng_key, i)
+                 if rng_key is not None else None)
+            x = blk_fn(self.blocks[i], x, k)
+        return self.head(x)
+
+
+# ---------------------------------------------------------------------------
+# Loss & sharding rules
+# ---------------------------------------------------------------------------
+
+def lm_loss(logits, labels):
+    """Causal LM next-token loss; logits (B,S,V) fp32-softmaxed."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    labels = labels[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None],
+                                 axis=-1)[..., 0]
+    return jnp.mean(logz - picked)
+
+
+# (regex on param path → PartitionSpec). Megatron-style TP composed with
+# ZeRO-3-style fsdp (ref: mp_layers.py + group_sharded_stage3.py).
+PARTITION_RULES = (
+    (r"wte$", P("tp", "fsdp")),
+    (r"wpe$", P(None, "fsdp")),
+    (r"lm_head$", P("fsdp", "tp")),
+    (r"wqkv$", P("fsdp", "tp")),
+    (r"bqkv$", P("tp")),
+    (r"wo$", P("tp", "fsdp")),
+    (r"wup$", P("fsdp", "tp")),
+    (r"bup$", P("tp")),
+    (r"wdown$", P("tp", "fsdp")),
+    (r"(bo|bdown)$", P(None)),
+    (r"(ln1|ln2|lnf)_(scale|bias)$", P(None)),
+)
+
+
+def partition_spec(path: str) -> P:
+    for pat, spec in PARTITION_RULES:
+        if re.search(pat, path):
+            return spec
+    return P()
+
+
+def param_shardings(params: Dict[str, jax.Array], mesh: Mesh):
+    return {k: NamedSharding(mesh, partition_spec(k)) for k in params}
+
+
+def shard_params(params: Dict[str, jax.Array], mesh: Mesh):
+    """Place a param dict onto the mesh per PARTITION_RULES (≙ the moment
+    fleet.distributed_model() scatters weights)."""
+    shardings = param_shardings(params, mesh)
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+# ---------------------------------------------------------------------------
+# Train step builders
+# ---------------------------------------------------------------------------
+
+def build_train_step(model: GPT, optimizer, mesh: Optional[Mesh] = None,
+                     donate: bool = True):
+    """One jitted SPMD train step: fwd → loss → bwd → optimizer update.
+
+    Parallelism (dp/fsdp/tp/sp) comes entirely from operand shardings +
+    the activation constraints inside the model — XLA inserts all
+    collectives (SURVEY §5.8 mapping). ≙ the reference's
+    HybridParallelOptimizer.step + EagerReducer allreduce path.
+    """
+
+    def step(params, opt_state, tokens, rng):
+        def loss_fn(p):
+            m = model.merge_params(p)
+            logits = m(tokens, rng_key=rng)
+            return lm_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_state = optimizer.update(grads, opt_state, params)
+        return new_params, new_state, loss
+
+    kw = {}
+    if donate:
+        kw["donate_argnums"] = (0, 1)
+    return jax.jit(step, **kw)
+
+
+def init_train_state(model: GPT, optimizer, mesh: Optional[Mesh] = None):
+    """Params + optimizer state, sharded onto the mesh if given."""
+    params, _ = model.split_params()
+    if mesh is not None and mesh.size > 1:
+        params = shard_params(params, mesh)
+        opt_state = jax.jit(optimizer.init)(params)
+    else:
+        # copy: the jitted step donates its inputs, and split_params aliases
+        # the module's own arrays — donation must not delete those.
+        params = {k: jnp.copy(v) for k, v in params.items()}
+        opt_state = optimizer.init(params)
+    return params, opt_state
+
+
+# ---------------------------------------------------------------------------
+# SPMD pipeline parallelism (GPipe schedule in one XLA program)
+# ---------------------------------------------------------------------------
+
+def stack_blocks(model: GPT, n_stages: int):
+    """Stack the per-layer block pytrees into one pytree with leading axes
+    (n_stages, layers_per_stage, ...). The stage axis is sharded over 'pp'.
+    ≙ PipelineLayer._segment_network (parallel_layers/pp_layers.py:550)."""
+    L = model.cfg.n_layers
+    assert L % n_stages == 0, f"{L} layers not divisible by {n_stages} stages"
+    lps = L // n_stages
+    blocks = [model.blocks[i] for i in range(L)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    # reshape leading (L,...) → (S, L/S, ...)
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((n_stages, lps) + x.shape[1:]), stacked)
+
+
+def unstack_blocks(stacked, n_layers: int):
+    """Inverse of stack_blocks → list of per-layer block pytrees."""
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((n_layers,) + x.shape[2:]), stacked)
+    return [jax.tree_util.tree_map(lambda x: x[i], flat)
+            for i in range(n_layers)]
+
+
+def pipelined_apply(stacked_blocks, x_mb, n_stages: int):
+    """GPipe schedule as a rolling buffer over a 'pp'-sharded stage axis.
+
+    x_mb: (n_micro, mb, seq, d) microbatched activations (post-embedding).
+    Returns (n_micro, mb, seq, d) outputs of the last stage.
+
+    Stage i's current input lives in row i of `state` (sharded P('pp')); one
+    schedule tick = vmapped stage compute (each pp rank runs its own stage —
+    rows are independent) + roll(+1) of the buffer, which XLA lowers to a
+    collective-permute ring over ICI. Total ticks = n_micro + n_stages - 1;
+    the bubble is the same as the reference's 1F1B warmup/cooldown
+    (pipeline_parallel.py:117). Backward is jax.grad through the scan — the
+    reversed schedule the reference hand-codes.
+    """
+    n_micro = x_mb.shape[0]
+    S = n_stages
+
+    def stage_fn(blocks_one_stage, h):
+        def body(hh, blk):
+            return blk(hh), None
+        h, _ = lax.scan(body, h, blocks_one_stage)
+        return h
+
+    vstage = jax.vmap(stage_fn)
+
+    state = jnp.zeros((S,) + x_mb.shape[1:], x_mb.dtype)
+    outputs = jnp.zeros_like(x_mb)
+
+    def tick(carry, t):
+        state, outputs = carry
+        inp = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        state = lax.dynamic_update_index_in_dim(state, inp, 0, 0)
+        state = _shard_act(state, P("pp", _BATCH_AXES, "sp", None))
+        processed = vstage(stacked_blocks, state)
+        out_t = processed[-1]
+        outputs = lax.cond(
+            t >= S - 1,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, out_t, jnp.clip(t - (S - 1), 0, n_micro - 1), 0),
+            lambda o: o, outputs)
+        state = jnp.roll(processed, 1, axis=0)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(tick, (state, outputs),
+                                   jnp.arange(n_micro + S - 1))
+    return outputs
+
+
+def pipeline_partition_spec(path: str) -> P:
+    """Partition spec for a stacked-block param (two leading stage axes)."""
+    base = partition_spec(path.split(".")[-1])
+    return P(*(("pp", None) + tuple(base)))
+
+
+def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,
+                               n_stages: int, n_micro: int):
+    """Full hybrid dp×fsdp×tp×sp×pp train step (≙ §3.4 call stack:
+    fleet.distributed_model + train_batch + HybridParallelOptimizer.step,
+    all fused into one XLA program)."""
+    cfg = model.cfg
+
+    def step(emb_params, stacked_blocks, opt_state, tokens, rng):
+        # tokens: (n_micro, mb, seq)
+        nm, mb, s = tokens.shape
+        def loss_fn(emb_p, blocks_p):
+            m = model.merge_params(emb_p)
+            x = m.embed(tokens.reshape(nm * mb, s))
+            x = x.reshape(nm, mb, s, -1)
+            x = pipelined_apply(blocks_p, x, n_stages)
+            logits = m.head(x.reshape(nm * mb, s, -1))
+            return lm_loss(logits, tokens.reshape(nm * mb, s))
+
+        loss, (g_emb, g_blocks) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(emb_params, stacked_blocks)
+        (new_emb, new_blocks), new_state = optimizer.update(
+            (g_emb, g_blocks), opt_state, (emb_params, stacked_blocks))
+        return new_emb, new_blocks, new_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def init_pipelined_state(model: GPT, optimizer, mesh: Mesh, n_stages: int):
+    """Split params into (embedding/head dict, pp-stacked blocks) and place
+    them on the mesh."""
+    params, _ = model.split_params()
+    emb_params = {k: v for k, v in params.items()
+                  if not k.startswith("blocks.")}
+    emb_params = {k: jax.device_put(
+        v, NamedSharding(mesh, partition_spec(k))) for k, v in
+        emb_params.items()}
+    stacked = stack_blocks(model, n_stages)
+    # `stacked` is itself a GPTBlock pytree (leaves have two extra leading
+    # axes); place each named param per the pipeline rules.
+    for name in sorted(stacked._params):
+        arr = getattr(stacked, name)
+        object.__setattr__(stacked, name, jax.device_put(
+            arr, NamedSharding(mesh, pipeline_partition_spec(name))))
+    opt_state = jax.jit(optimizer.init)((emb_params, stacked))
+    return emb_params, stacked, opt_state
+
+
+# ---------------------------------------------------------------------------
+# Presets (PaddleNLP gpt-3 family sizes)
+# ---------------------------------------------------------------------------
+
+def gpt_tiny(**kw):
+    d = dict(vocab_size=256, max_seq_len=64, d_model=64, n_layers=2,
+             n_heads=2, dtype=jnp.float32)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt3_125m(**kw):
+    d = dict(d_model=768, n_layers=12, n_heads=12)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt3_350m(**kw):
+    d = dict(d_model=1024, n_layers=24, n_heads=16)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def gpt3_1p3b(**kw):
+    d = dict(d_model=2048, n_layers=24, n_heads=16, max_seq_len=2048)
+    d.update(kw)
+    return GPTConfig(**d)

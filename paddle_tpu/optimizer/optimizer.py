@@ -215,12 +215,10 @@ class AdamW(Adam):
             return new_params, {"step": step, "slots": new_slots}
 
         out = tree_map(upd, params, grads, state["slots"])
-        new_params = tree_map(lambda pair: pair[0], out,
-                              is_leaf=lambda x: isinstance(x, tuple)
-                              and len(x) == 2)
-        new_slots = tree_map(lambda pair: pair[1], out,
-                             is_leaf=lambda x: isinstance(x, tuple)
-                             and len(x) == 2)
+        is_pair = (lambda x: isinstance(x, tuple) and len(x) == 2
+                   and isinstance(x[0], jax.Array))
+        new_params = tree_map(lambda pair: pair[0], out, is_leaf=is_pair)
+        new_slots = tree_map(lambda pair: pair[1], out, is_leaf=is_pair)
         return new_params, {"step": step, "slots": new_slots}
 
 

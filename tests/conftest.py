@@ -25,8 +25,10 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as pt
+    from paddle_tpu.distributed import mesh as mesh_lib
     pt.seed(1234)
     np.random.seed(1234)
+    mesh_lib.set_topology(None)  # no cross-test global-mesh leakage
     yield
 
 
