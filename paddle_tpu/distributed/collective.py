@@ -43,7 +43,9 @@ def all_reduce(x, op=ReduceOp.SUM, axis="dp"):
     if op == ReduceOp.AVG:
         return lax.pmean(x, axis)
     if op == ReduceOp.PROD:
-        return jnp.exp(lax.psum(jnp.log(x), axis))
+        # gather-then-multiply: sign-correct for negatives/zeros (an
+        # exp(psum(log)) trick would NaN on non-positive elements)
+        return jnp.prod(lax.all_gather(x, axis), axis=0)
     raise ValueError(op)
 
 

@@ -40,7 +40,10 @@ class Model:
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
-        self._params, _ = self.network.split_params()
+        params, _ = self.network.split_params()
+        # copy: the jitted train step donates params, which must not delete
+        # the network's own (aliased) arrays
+        self._params = {k: jnp.copy(v) for k, v in params.items()}
         if optimizer is not None:
             self._opt_state = optimizer.init(self._params)
         self._build_steps()
@@ -75,7 +78,10 @@ class Model:
             with nn.stateful(training=False):
                 return model(x)
 
-        self._train_step = jax.jit(train_step) if opt is not None else None
+        # donate: old params/opt-state buffers are dead after each step —
+        # without donation peak HBM doubles on the largest training arrays
+        self._train_step = (jax.jit(train_step, donate_argnums=(0, 1))
+                            if opt is not None else None)
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(predict_step)
 
@@ -148,6 +154,7 @@ class Model:
         cbks.on_begin("train")
         history = []
         it_count = 0
+        loss = float("nan")
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -162,7 +169,7 @@ class Model:
                 it_count += 1
                 if num_iters is not None and it_count >= num_iters:
                     break
-            train_logs = {"loss": loss}
+            train_logs = {"loss": loss}  # nan if the loader was empty
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_res = self.evaluate(eval_loader, verbose=0)
                 train_logs.update({f"val_{k}": v

@@ -54,9 +54,12 @@ def default_collate_fn(batch):
 
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
                  num_workers, seed):
-    """ref: fluid/dataloader/worker.py:266 _worker_loop."""
+    """ref: fluid/dataloader/worker.py:266 _worker_loop. ``seed`` already
+    incorporates the epoch so re-forked workers draw fresh augmentation
+    randomness each epoch (ref derives a per-epoch base seed the same way).
+    """
     _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed)
-    np.random.seed(seed + wid)
+    np.random.seed((seed + wid) % (2**32))
     while True:
         item = index_queue.get()
         if item is None:
@@ -83,6 +86,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self.seed = seed
+        self._epoch = 0
         self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -125,7 +129,8 @@ class DataLoader:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queues[wid], result_queue,
-                      self.collate_fn, wid, self.num_workers, self.seed),
+                      self.collate_fn, wid, self.num_workers,
+                      self.seed + self._epoch * 7919),
                 daemon=True)
             w.start()
             workers.append(w)
@@ -175,6 +180,7 @@ class DataLoader:
             shutdown()
 
     def __iter__(self):
+        self._epoch += 1
         if self._iterable:
             return self._iter_iterable()
         if self.num_workers == 0:

@@ -25,18 +25,39 @@ def _pads(padding, n):
     return [tuple(p) for p in padding]
 
 
+def _ceil_extra(in_sizes, kernel, stride, pads, ceil_mode):
+    """Per-dim extra right-padding so reduce_window emits ceil-mode output
+    sizes (ref semantics: pooling ceil_mode — the last, partial window is
+    kept iff it starts inside input+left-pad)."""
+    extras = []
+    for i in range(len(kernel)):
+        size = in_sizes[i] + pads[i][0] + pads[i][1]
+        if ceil_mode:
+            out = -(-(size - kernel[i]) // stride[i]) + 1
+            if (out - 1) * stride[i] >= in_sizes[i] + pads[i][0]:
+                out -= 1
+        else:
+            out = (size - kernel[i]) // stride[i] + 1
+        extras.append(max(0, (out - 1) * stride[i] + kernel[i] - size))
+    return extras
+
+
 def _pool(x, n, kernel, stride, padding, init, op, avg=False,
-          exclusive=True, ceil_mode=False):
+          exclusive=True, ceil_mode=False, divisor_override=None):
     x = jnp.asarray(x)
     kernel = _t(kernel, n)
     stride = _t(stride if stride is not None else kernel, n)
     pads = _pads(padding, n)
+    extras = _ceil_extra(x.shape[-n:], kernel, stride, pads, ceil_mode)
+    pads = [(pl, pr + e) for (pl, pr), e in zip(pads, extras)]
     window = (1, 1) + kernel
     strides = (1, 1) + stride
     full_pads = [(0, 0), (0, 0)] + pads
     if avg:
         summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
                                        full_pads)
+        if divisor_override is not None:
+            return summed / divisor_override
         if exclusive and any(p != (0, 0) for p in pads):
             ones = jnp.ones_like(x)
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
@@ -52,48 +73,66 @@ def _pool(x, n, kernel, stride, padding, init, op, avg=False,
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False):
     return _pool(x, 1, kernel_size, stride, padding, 0.0, jax.lax.add,
-                 avg=True, exclusive=exclusive)
+                 avg=True, exclusive=exclusive, ceil_mode=ceil_mode)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW"):
     return _pool(x, 2, kernel_size, stride, padding, 0.0, jax.lax.add,
-                 avg=True, exclusive=exclusive)
+                 avg=True, exclusive=exclusive, ceil_mode=ceil_mode,
+                 divisor_override=divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW"):
     return _pool(x, 3, kernel_size, stride, padding, 0.0, jax.lax.add,
-                 avg=True, exclusive=exclusive)
+                 avg=True, exclusive=exclusive, ceil_mode=ceil_mode,
+                 divisor_override=divisor_override)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False):
-    return _pool(x, 1, kernel_size, stride, padding, -jnp.inf, jax.lax.max)
+    out = _pool(x, 1, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
+                ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _argmax_pool(x, 1, kernel_size, stride, padding,
+                                 ceil_mode)
+    return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW"):
-    out = _pool(x, 2, kernel_size, stride, padding, -jnp.inf, jax.lax.max)
+    out = _pool(x, 2, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
+                ceil_mode=ceil_mode)
     if return_mask:
-        mask = _argmax_pool2d(x, kernel_size, stride, padding)
-        return out, mask
+        return out, _argmax_pool(x, 2, kernel_size, stride, padding,
+                                 ceil_mode)
     return out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW"):
-    return _pool(x, 3, kernel_size, stride, padding, -jnp.inf, jax.lax.max)
+    out = _pool(x, 3, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
+                ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _argmax_pool(x, 3, kernel_size, stride, padding,
+                                 ceil_mode)
+    return out
 
 
-def _argmax_pool2d(x, kernel, stride, padding):
+def _argmax_pool(x, n, kernel, stride, padding, ceil_mode=False):
+    """Flat spatial index of each window max (consumed by max_unpool*)."""
     x = jnp.asarray(x)
-    n, c, h, w = x.shape
-    idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    spatial = x.shape[-n:]
+    idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.float32).reshape(
+        (1, 1) + spatial)
     idx = jnp.broadcast_to(idx, x.shape)
-    k = _t(kernel, 2)
-    s = _t(stride if stride is not None else kernel, 2)
-    pads = [(0, 0), (0, 0)] + _pads(padding, 2)
+    k = _t(kernel, n)
+    s = _t(stride if stride is not None else kernel, n)
+    pads = _pads(padding, n)
+    extras = _ceil_extra(spatial, k, s, pads, ceil_mode)
+    pads = [(0, 0), (0, 0)] + [(pl, pr + e)
+                               for (pl, pr), e in zip(pads, extras)]
 
     def select(a, b):
         av, ai = a
@@ -101,14 +140,12 @@ def _argmax_pool2d(x, kernel, stride, padding):
         pick = av >= bv
         return jnp.where(pick, av, bv), jnp.where(pick, ai, bi)
 
-    # reduce_window over pairs via two passes (value already computed); use
-    # a single pass with variadic reduce_window
     init = (-jnp.inf, jnp.float32(-1))
     vals, idxs = jax.lax.reduce_window(
         (x.astype(jnp.float32), idx), init,
         lambda a, b: select(a, b),
         (1, 1) + k, (1, 1) + s, pads)
-    return idxs.astype(jnp.int64)
+    return idxs.astype(jnp.int32)
 
 
 def _adaptive_start_end(out_size, in_size):

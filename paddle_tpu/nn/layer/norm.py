@@ -213,8 +213,10 @@ class SpectralNorm(Module):
                 w *= s
         self.register_buffer("weight_u", jnp.ones((h,)) / jnp.sqrt(h))
         self.register_buffer("weight_v", jnp.ones((w,)) / jnp.sqrt(w))
+        self._stat_tag = name
 
     def forward(self, weight):
+        from paddle_tpu.nn.module import current_context
         w = jnp.asarray(weight)
         w_mat = jnp.moveaxis(w, self.axis, 0).reshape(w.shape[self.axis], -1)
         u, v = self.weight_u, self.weight_v
@@ -224,4 +226,11 @@ class SpectralNorm(Module):
             u = w_mat @ v
             u = u / (jnp.linalg.norm(u) + self.epsilon)
         sigma = u @ w_mat @ v
+        # persist power iteration across steps (ref mutates u/v in place;
+        # here they flow out functionally like BatchNorm running stats)
+        ctx = current_context()
+        if ctx is not None:
+            tag = self._stat_tag or f"id{id(self) % 10**9}"
+            ctx.record_update(f"{tag}.weight_u", u)
+            ctx.record_update(f"{tag}.weight_v", v)
         return w / sigma

@@ -339,9 +339,18 @@ def diagflat(x, offset=0):
 
 def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     x = jnp.asarray(x)
-    out = jnp.zeros(x.shape + (x.shape[-1] + abs(offset),), x.dtype)
     out = jnp.vectorize(lambda v: jnp.diag(v, k=offset),
                         signature="(n)->(m,m)")(x)
+    # vectorize leaves the diagonal planes in the last two axes; move them
+    # to the requested (dim1, dim2) of the output
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [None] * nd
+        perm[d1], perm[d2] = nd - 2, nd - 1
+        batch = iter(range(nd - 2))
+        perm = [p if p is not None else next(batch) for p in perm]
+        out = jnp.transpose(out, perm)
     return out
 
 
@@ -411,7 +420,8 @@ for _n in ["reshape", "flatten", "transpose", "moveaxis", "swapaxes",
            "index_sample", "masked_select", "masked_fill", "where", "nonzero",
            "pad", "unique", "unique_consecutive", "as_complex", "as_real",
            "real", "imag", "cast", "crop", "strided_slice", "slice",
-           "shard_index", "tensordot", "diag", "diagflat", "index_add", "tril",
+           "shard_index", "tensordot", "diag", "diagflat", "diag_embed",
+           "index_add", "tril",
            "triu", "meshgrid", "unbind", "numel", "shape", "rank", "is_empty",
            "view", "view_as", "atleast_1d", "atleast_2d", "atleast_3d"]:
     _reg(_n, globals()[_n])
