@@ -27,6 +27,8 @@ class Model:
         self._optimizer = None
         self._metrics = []
         self._train_step = None
+        self._forward_loss_fn = None
+        self._train_fwd_only = None
         self._eval_step = None
         self._params = None
         self._opt_state = None
@@ -79,9 +81,13 @@ class Model:
                 return model(x)
 
         # donate: old params/opt-state buffers are dead after each step —
-        # without donation peak HBM doubles on the largest training arrays
+        # without donation peak HBM doubles on the largest training arrays.
+        # train_batch(update=False) must NOT donate (the old buffers stay
+        # live), so a non-donating variant is compiled lazily on first use.
         self._train_step = (jax.jit(train_step, donate_argnums=(0, 1))
                             if opt is not None else None)
+        self._forward_loss_fn = forward_loss
+        self._train_fwd_only = None
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(predict_step)
 
@@ -101,12 +107,19 @@ class Model:
         y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple))
                         else labels)
         key = pt_random.next_key()
-        loss, out, new_p, new_s, updates = self._train_step(
-            self._params, self._opt_state, self._buffers(), x, y, key)
         if update:
+            loss, out, new_p, new_s, updates = self._train_step(
+                self._params, self._opt_state, self._buffers(), x, y, key)
             self._params, self._opt_state = new_p, new_s
             if updates:
                 self.network = self.network.apply_updates(updates)
+        else:
+            # forward-only (training mode): no grads/optimizer math and no
+            # donation — the live params/opt-state buffers must survive
+            if self._train_fwd_only is None:
+                self._train_fwd_only = jax.jit(self._forward_loss_fn)
+            loss, (out, _) = self._train_fwd_only(
+                self._params, self._buffers(), x, y, key)
         metrics = [float(loss)]
         for m in self._metrics:
             res = m.compute(np.asarray(out), np.asarray(y))
@@ -233,7 +246,9 @@ class Model:
         from paddle_tpu.framework.io import load as obj_load
         state = obj_load(path + ".pdparams")
         self.network.set_state_dict(state, strict=not skip_mismatch)
-        self._params, _ = self.network.split_params()
+        params, _ = self.network.split_params()
+        # copy: the donating train step must not delete the network's arrays
+        self._params = {k: jnp.copy(v) for k, v in params.items()}
         import os
         if not reset_optimizer and os.path.exists(path + ".pdopt") and \
                 self._optimizer is not None:

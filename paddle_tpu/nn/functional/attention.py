@@ -61,8 +61,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                   and attn_mask is None
                   and dropout_p == 0.0
                   and jax.default_backend() == "tpu"
-                  and q.shape[-1] % 128 == 0
-                  and q.shape[1] % 128 == 0)
+                  and q.shape[1] >= 128)
     if use_pallas:
         try:
             from paddle_tpu.ops.pallas.flash_attention import flash_attention

@@ -92,39 +92,45 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False):
-    out = _pool(x, 1, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
-                ceil_mode=ceil_mode)
     if return_mask:
-        return out, _argmax_pool(x, 1, kernel_size, stride, padding,
-                                 ceil_mode)
-    return out
+        return _max_pool_with_mask(x, 1, kernel_size, stride, padding,
+                                   ceil_mode)
+    return _pool(x, 1, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
+                 ceil_mode=ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW"):
-    out = _pool(x, 2, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
-                ceil_mode=ceil_mode)
     if return_mask:
-        return out, _argmax_pool(x, 2, kernel_size, stride, padding,
-                                 ceil_mode)
-    return out
+        return _max_pool_with_mask(x, 2, kernel_size, stride, padding,
+                                   ceil_mode)
+    return _pool(x, 2, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
+                 ceil_mode=ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW"):
-    out = _pool(x, 3, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
-                ceil_mode=ceil_mode)
     if return_mask:
-        return out, _argmax_pool(x, 3, kernel_size, stride, padding,
-                                 ceil_mode)
-    return out
+        return _max_pool_with_mask(x, 3, kernel_size, stride, padding,
+                                   ceil_mode)
+    return _pool(x, 3, kernel_size, stride, padding, -jnp.inf, jax.lax.max,
+                 ceil_mode=ceil_mode)
 
 
-def _argmax_pool(x, n, kernel, stride, padding, ceil_mode=False):
-    """Flat spatial index of each window max (consumed by max_unpool*)."""
+def _max_pool_with_mask(x, n, kernel, stride, padding, ceil_mode=False):
+    """(pooled values, flat spatial index of each window max).
+
+    Values come from the plain reduce_window max (differentiable, exact for
+    ints); indices from a variadic argmax pass under stop_gradient — JAX
+    cannot differentiate a variadic custom combiner, and the float32 detour
+    it needs would corrupt int values above 2**24. Indices are int32 for the
+    same 2**24 reason (reachable on 3D volumes)."""
     x = jnp.asarray(x)
+    out = _pool(x, n, kernel, stride, padding, -jnp.inf, jax.lax.max,
+                ceil_mode=ceil_mode)
+    x = jax.lax.stop_gradient(x)
     spatial = x.shape[-n:]
-    idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.float32).reshape(
+    idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(
         (1, 1) + spatial)
     idx = jnp.broadcast_to(idx, x.shape)
     k = _t(kernel, n)
@@ -140,12 +146,12 @@ def _argmax_pool(x, n, kernel, stride, padding, ceil_mode=False):
         pick = av >= bv
         return jnp.where(pick, av, bv), jnp.where(pick, ai, bi)
 
-    init = (-jnp.inf, jnp.float32(-1))
-    vals, idxs = jax.lax.reduce_window(
+    init = (-jnp.inf, jnp.int32(-1))
+    _, idxs = jax.lax.reduce_window(
         (x.astype(jnp.float32), idx), init,
         lambda a, b: select(a, b),
         (1, 1) + k, (1, 1) + s, pads)
-    return idxs.astype(jnp.int32)
+    return out, idxs
 
 
 def _adaptive_start_end(out_size, in_size):

@@ -230,7 +230,9 @@ class SpectralNorm(Module):
         # here they flow out functionally like BatchNorm running stats)
         ctx = current_context()
         if ctx is not None:
-            tag = self._stat_tag or f"id{id(self) % 10**9}"
-            ctx.record_update(f"{tag}.weight_u", u)
-            ctx.record_update(f"{tag}.weight_v", v)
+            tag = self._stat_tag if self._stat_tag is not None \
+                else f"id{id(self) % 10**9}"
+            prefix = f"{tag}." if tag else ""
+            ctx.record_update(f"{prefix}weight_u", u)
+            ctx.record_update(f"{prefix}weight_v", v)
         return w / sigma

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels — the framework's native-kernel layer.
+
+Reference analog: paddle/fluid/operators/fused/ (110 hand-written CUDA
+fusions, e.g. fused_attention_op.cu, fmha_ref.h) and the PHI kernel library's
+GPU backends. On TPU the equivalent of a hand-written CUDA kernel is a Pallas
+(Mosaic) kernel; everything else is left to XLA fusion.
+"""
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,100 @@
+"""Flash-attention Pallas kernel vs the XLA reference path.
+
+Mirrors the reference's fused-attention op tests
+(python/paddle/fluid/tests/unittests/test_fused_attention_op.py pattern: a
+numpy/naive oracle checked against the fused kernel for output AND grads).
+Runs in Pallas interpret mode on the CPU test platform.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.nn.functional.attention import attention_reference
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _rand_qkv(b, s, h, d, dtype=jnp.float32, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.normal(size=(b, s, h, d)), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(1, 128, 2, 64), (2, 256, 2, 32)])
+def test_forward_matches_reference(causal, shape):
+    q, k, v = _rand_qkv(*shape)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = attention_reference(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_unpadded_seq():
+    # seq not a multiple of the block: exercises KV-padding masking
+    q, k, v = _rand_qkv(1, 100, 2, 64, seed=3)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = attention_reference(q, k, v, is_causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_cross_attention_different_kv_len():
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.normal(size=(1, 64, 2, 64)), jnp.float32)
+    k = jnp.asarray(rs.normal(size=(1, 200, 2, 64)), jnp.float32)
+    v = jnp.asarray(rs.normal(size=(1, 200, 2, 64)), jnp.float32)
+    out = flash_attention(q, k, v, causal=False, interpret=True)
+    ref = attention_reference(q, k, v, is_causal=False)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_reference(causal):
+    q, k, v = _rand_qkv(1, 128, 2, 64, seed=1)
+    cot = jnp.asarray(np.random.RandomState(2).normal(size=q.shape),
+                      jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       interpret=True) * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, is_causal=causal) * cot)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(gf, gr, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_grads_unpadded_seq():
+    q, k, v = _rand_qkv(1, 100, 1, 32, seed=4)
+    cot = jnp.asarray(np.random.RandomState(5).normal(size=q.shape),
+                      jnp.float32)
+    gf = jax.grad(lambda *a: jnp.sum(
+        flash_attention(*a, causal=True, interpret=True) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(
+        attention_reference(*a, is_causal=True) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+
+def test_bfloat16_forward():
+    q, k, v = _rand_qkv(1, 128, 2, 64, dtype=jnp.bfloat16, seed=6)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = attention_reference(q, k, v, is_causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_jit_compiles():
+    q, k, v = _rand_qkv(1, 128, 1, 64, seed=8)
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                interpret=True))
+    out = f(q, k, v)
+    assert out.shape == q.shape
