@@ -27,8 +27,11 @@ class Model:
         self._optimizer = None
         self._metrics = []
         self._train_step = None
-        self._forward_loss_fn = None
-        self._train_fwd_only = None
+        self._grad_step_fn = None
+        self._grad_step = None
+        self._apply_grads = None
+        self._accum_grads = None
+        self._accum_count = 0
         self._eval_step = None
         self._params = None
         self._opt_state = None
@@ -68,6 +71,14 @@ class Model:
             new_params, new_opt_state = opt.update(grads, opt_state, params)
             return loss, out, new_params, new_opt_state, updates
 
+        def grad_step(params, buffers, x, y, key):
+            (loss, (out, updates)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(params, buffers, x, y, key)
+            return loss, out, grads, updates
+
+        def apply_grads(grads, opt_state, params):
+            return opt.update(grads, opt_state, params)
+
         def eval_step(params, buffers, x, y):
             model = net.merge_params({**buffers, **params})
             with nn.stateful(training=False):
@@ -86,8 +97,14 @@ class Model:
         # live), so a non-donating variant is compiled lazily on first use.
         self._train_step = (jax.jit(train_step, donate_argnums=(0, 1))
                             if opt is not None else None)
-        self._forward_loss_fn = forward_loss
-        self._train_fwd_only = None
+        # gradient accumulation (≙ dygraph .grad accumulation: backward runs
+        # every batch, update=True gates the optimizer step): compiled lazily
+        self._grad_step_fn = grad_step if opt is not None else None
+        self._grad_step = None
+        self._apply_grads = (jax.jit(apply_grads, donate_argnums=(0, 1, 2))
+                             if opt is not None else None)
+        self._accum_grads = None
+        self._accum_count = 0
         self._eval_step = jax.jit(eval_step)
         self._predict_step = jax.jit(predict_step)
 
@@ -107,19 +124,34 @@ class Model:
         y = jnp.asarray(labels[0] if isinstance(labels, (list, tuple))
                         else labels)
         key = pt_random.next_key()
-        if update:
+        if update and self._accum_grads is None:
+            # fast path: fused grad+update step with donated params/opt-state
             loss, out, new_p, new_s, updates = self._train_step(
                 self._params, self._opt_state, self._buffers(), x, y, key)
             self._params, self._opt_state = new_p, new_s
-            if updates:
-                self.network = self.network.apply_updates(updates)
         else:
-            # forward-only (training mode): no grads/optimizer math and no
-            # donation — the live params/opt-state buffers must survive
-            if self._train_fwd_only is None:
-                self._train_fwd_only = jax.jit(self._forward_loss_fn)
-            loss, (out, _) = self._train_fwd_only(
+            # accumulation path (≙ reference dygraph .grad accumulation,
+            # update only gates the optimizer step): grads are summed across
+            # update=False calls and averaged at the update=True step
+            if self._grad_step is None:
+                self._grad_step = jax.jit(self._grad_step_fn)
+            loss, out, grads, updates = self._grad_step(
                 self._params, self._buffers(), x, y, key)
+            if self._accum_grads is None:
+                self._accum_grads = grads
+            else:
+                self._accum_grads = jax.tree_util.tree_map(
+                    jnp.add, self._accum_grads, grads)
+            self._accum_count += 1
+            if update:
+                n = self._accum_count
+                total = jax.tree_util.tree_map(
+                    lambda g: g / n, self._accum_grads)
+                self._accum_grads, self._accum_count = None, 0
+                self._params, self._opt_state = self._apply_grads(
+                    total, self._opt_state, self._params)
+        if updates:
+            self.network = self.network.apply_updates(updates)
         metrics = [float(loss)]
         for m in self._metrics:
             res = m.compute(np.asarray(out), np.asarray(y))
@@ -175,7 +207,9 @@ class Model:
             for step, batch in enumerate(train_loader):
                 x, y = batch[0], batch[1]
                 cbks.on_batch_begin("train", step, {})
-                res = self.train_batch(x, y)
+                res = self.train_batch(
+                    x, y,
+                    update=(step + 1) % accumulate_grad_batches == 0)
                 loss = res[0] if isinstance(res, list) else res
                 logs = {"loss": loss, "step": step}
                 cbks.on_batch_end("train", step, logs)

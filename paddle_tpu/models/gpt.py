@@ -112,6 +112,29 @@ class GPTBlock(Module):
         else:
             self.bqkv = self.bo = self.bup = self.bdown = None
 
+    def _attention(self, q, k, v, s):
+        """Ring attention over the 'sp' axis when the global mesh shards the
+        sequence (SURVEY §5.7 gap — new capability); otherwise the flash /
+        XLA path. Disabled inside the vmapped pipeline stages (shard_map
+        does not nest under that vmap), where GSPMD handles 'sp'."""
+        from paddle_tpu.distributed.mesh import get_mesh
+        mesh = get_mesh()
+        shape = dict(mesh.shape) if mesh is not None else {}
+        sp = shape.get("sp", 1)
+        # shard_map needs every spec'd dim divisible by its mesh axes
+        # (unlike with_sharding_constraint, which tolerates odd shapes)
+        divisible = (s % sp == 0
+                     and q.shape[0] % (shape.get("dp", 1)
+                                       * shape.get("fsdp", 1)) == 0
+                     and self.n_heads % shape.get("tp", 1) == 0)
+        if sp > 1 and not _in_pipeline() and divisible:
+            from paddle_tpu.distributed.ring_attention import (
+                sequence_parallel_attention)
+            return sequence_parallel_attention(q, k, v, mesh, causal=True,
+                                               mode="ring")
+        return F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              dropout_p=0.0)
+
     def _ln(self, x, scale, bias):
         x32 = x.astype(jnp.float32)
         mu = jnp.mean(x32, -1, keepdims=True)
@@ -128,8 +151,7 @@ class GPTBlock(Module):
         qkv = qkv.reshape(b, s, 3, self.n_heads, self.head_dim)
         qkv = _shard_act(qkv, P(_BATCH_AXES, "sp", None, "tp", None))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                              dropout_p=0.0)
+        attn = self._attention(q, k, v, s)
         attn = attn.reshape(b, s, d)
         o = attn @ self.wo
         if self.bo is not None:
@@ -147,6 +169,12 @@ class GPTBlock(Module):
 
 
 _BATCH_AXES = ("dp", "fsdp")
+
+_PIPELINE_DEPTH = 0
+
+
+def _in_pipeline() -> bool:
+    return _PIPELINE_DEPTH > 0
 
 
 def _maybe_dropout(x, p, key, salt):
@@ -265,9 +293,13 @@ def param_shardings(params: Dict[str, jax.Array], mesh: Mesh):
 
 def shard_params(params: Dict[str, jax.Array], mesh: Mesh):
     """Place a param dict onto the mesh per PARTITION_RULES (≙ the moment
-    fleet.distributed_model() scatters weights)."""
+    fleet.distributed_model() scatters weights).
+
+    Always copies: device_put may alias when the sharding already matches,
+    and the donating train steps would then delete the caller's arrays."""
     shardings = param_shardings(params, mesh)
-    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+    return {k: jax.device_put(jnp.copy(v), shardings[k])
+            for k, v in params.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -354,6 +386,7 @@ def pipelined_apply(stacked_blocks, x_mb, n_stages: int):
     (pipeline_parallel.py:117). Backward is jax.grad through the scan — the
     reversed schedule the reference hand-codes.
     """
+    global _PIPELINE_DEPTH
     n_micro = x_mb.shape[0]
     S = n_stages
 
@@ -384,8 +417,12 @@ def pipelined_apply(stacked_blocks, x_mb, n_stages: int):
         state = jnp.roll(processed, 1, axis=0)
         return (state, outputs), None
 
-    (state, outputs), _ = lax.scan(tick, (state, outputs),
-                                   jnp.arange(n_micro + S - 1))
+    _PIPELINE_DEPTH += 1
+    try:
+        (state, outputs), _ = lax.scan(tick, (state, outputs),
+                                       jnp.arange(n_micro + S - 1))
+    finally:
+        _PIPELINE_DEPTH -= 1
     return outputs
 
 
@@ -428,8 +465,10 @@ def init_pipelined_state(model: GPT, optimizer, mesh: Mesh, n_stages: int):
     params, _ = model.split_params()
     emb_params = {k: v for k, v in params.items()
                   if not k.startswith("blocks.")}
+    # jnp.copy: donation in the train step must not delete module arrays
+    # (device_put aliases when the sharding already matches)
     emb_params = {k: jax.device_put(
-        v, NamedSharding(mesh, partition_spec(k))) for k, v in
+        jnp.copy(v), NamedSharding(mesh, partition_spec(k))) for k, v in
         emb_params.items()}
     stacked = stack_blocks(model, n_stages)
     # `stacked` is itself a GPTBlock pytree (leaves have two extra leading

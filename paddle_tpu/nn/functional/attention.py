@@ -56,12 +56,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     Layout (B, S, H, D) matching paddle.nn.functional.scaled_dot_product_attention.
     """
     q = jnp.asarray(query)
+    # head_dim % 8: Mosaic-lowerable without a sublane-misaligned layout
+    # (failures there surface at jit-compile time, outside the try/except)
     use_pallas = (flags.get_flag("use_pallas_kernels")
                   and q.ndim == 4
                   and attn_mask is None
                   and dropout_p == 0.0
                   and jax.default_backend() == "tpu"
-                  and q.shape[1] >= 128)
+                  and q.shape[1] >= 128
+                  and q.shape[-1] % 8 == 0)
     if use_pallas:
         try:
             from paddle_tpu.ops.pallas.flash_attention import flash_attention

@@ -325,6 +325,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
 
+    # clamp blocks for short sequences — padding 128 rows up to a 256/512
+    # block would multiply the real work
+    block_q = min(block_q, _round_up(sq, _LANES))
+    block_k = min(block_k, _round_up(sk, _LANES))
     sq_p = _round_up(max(sq, block_q), block_q)
     sk_p = _round_up(max(sk, block_k), block_k)
     # D is NOT padded: Mosaic accepts a block dim equal to the full array

@@ -1,0 +1,89 @@
+"""Sequence/context parallelism tests on the 8-virtual-device CPU mesh.
+
+The reference has NO sequence parallelism (SURVEY §5.7) — oracle here is the
+single-device attention_reference, the same numpy-oracle-×-execution-modes
+pattern as the reference's collective tests
+(test_collective_api_base.py:292 check_with_place)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.ring_attention import (
+    ring_attention, sequence_parallel_attention, ulysses_attention)
+from paddle_tpu.nn.functional.attention import attention_reference
+
+
+def _qkv(b, s, h, d, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.normal(size=(b, s, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_sp8_matches_reference(mode, causal):
+    topo = dist.init_mesh(sp=8)
+    q, k, v = _qkv(2, 64, 8, 16)
+    out = sequence_parallel_attention(q, k, v, topo.mesh, causal=causal,
+                                      mode=mode)
+    ref = attention_reference(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_hybrid_mesh_sp_with_dp_tp(mode):
+    topo = dist.init_mesh(dp=2, sp=2, tp=2)
+    q, k, v = _qkv(2, 32, 4, 8, seed=1)
+    out = sequence_parallel_attention(q, k, v, topo.mesh, causal=True,
+                                      mode=mode)
+    ref = attention_reference(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_grads_match_reference(mode):
+    topo = dist.init_mesh(dp=2, sp=4)
+    q, k, v = _qkv(2, 32, 4, 8, seed=2)
+    cot = jnp.asarray(np.random.RandomState(3).normal(size=q.shape),
+                      jnp.float32)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(sequence_parallel_attention(
+            q, k, v, topo.mesh, causal=True, mode=mode) * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, is_causal=True) * cot)
+
+    g_sp = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_sp, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
+def test_ring_inside_jitted_train_like_step():
+    """ring attention composes with jit + other sharded computation."""
+    topo = dist.init_mesh(sp=8)
+    q, k, v = _qkv(1, 64, 2, 8, seed=4)
+
+    @jax.jit
+    def f(q, k, v):
+        o = sequence_parallel_attention(q, k, v, topo.mesh, causal=True)
+        return jnp.mean(o * o)
+
+    val = f(q, k, v)
+    ref = jnp.mean(attention_reference(q, k, v, is_causal=True) ** 2)
+    np.testing.assert_allclose(float(val), float(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_rejects_bad_heads():
+    topo = dist.init_mesh(sp=8)
+    q, k, v = _qkv(1, 64, 4, 8)  # 4 heads not divisible by sp=8
+    with pytest.raises(ValueError, match="not divisible"):
+        sequence_parallel_attention(q, k, v, topo.mesh, causal=False,
+                                    mode="ulysses")
