@@ -22,6 +22,11 @@ from paddle_tpu.distributed.collective import (
     psum, pmean, pmax, pmin, ppermute, barrier, send_recv_ring)
 from paddle_tpu.distributed.api import (shard_tensor, shard_module,
                                         reshard, replicate)
+from paddle_tpu.distributed.ring_attention import (
+    ring_attention, ulysses_attention, sequence_parallel_attention)
+from paddle_tpu.distributed.sharding import (
+    group_sharded_parallel, group_sharded_specs, build_group_sharded_step,
+    init_group_sharded_state, GroupShardedSpecs)
 
 __all__ = ["env", "mesh", "collective", "init_parallel_env", "get_rank",
            "get_world_size", "ParallelEnv", "is_initialized", "init_mesh",
@@ -29,4 +34,7 @@ __all__ = ["env", "mesh", "collective", "init_parallel_env", "get_rank",
            "all_reduce", "all_gather", "all_to_all", "reduce_scatter",
            "broadcast", "psum", "pmean", "pmax", "pmin", "ppermute",
            "barrier", "send_recv_ring", "shard_tensor", "shard_module",
-           "reshard", "replicate"]
+           "reshard", "replicate", "ring_attention", "ulysses_attention",
+           "sequence_parallel_attention", "group_sharded_parallel",
+           "group_sharded_specs", "build_group_sharded_step",
+           "init_group_sharded_state", "GroupShardedSpecs"]

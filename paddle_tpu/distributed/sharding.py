@@ -1,0 +1,188 @@
+"""GroupSharded / ZeRO stages 1-3 as GSPMD sharding policies.
+
+Reference analog (SURVEY §2.2): the dygraph GroupSharded stack —
+- stage 1 (os):     dygraph_sharding_optimizer.py:28 (optimizer-state shards)
+- stage 2 (os_g):   group_sharded_stage2.py:45 + GroupShardedOptimizerStage2
+  (grad buckets reduced to owner ranks, see Addendum E "ZeRO-2 grad path")
+- stage 3 (p_g_os): group_sharded_stage3.py:59 (param gather/release hooks,
+  TaskFlow prefetch) — user API group_sharded.py group_sharded_parallel().
+
+TPU-native design: no hooks, buckets, or rank-ownership bookkeeping. Each
+stage is a *sharding policy* over one mesh axis (default 'fsdp'):
+
+  level    params        grads            optimizer slots
+  os       replicated    replicated       sharded
+  os_g     replicated    reduce-scattered sharded
+  p_g_os   sharded       reduce-scattered sharded
+
+The policy is expressed as PartitionSpecs; XLA then emits exactly the
+collectives the reference hand-codes: stage-2's grad `reduce()` to owner
+becomes a reduce-scatter, stage-3's pre-forward param gather becomes an
+all-gather at first use (with XLA's scheduler prefetching it — the
+reference's TaskFlow:838), and the post-update param broadcast becomes an
+all-gather of the updated shard. The reference's HybridParallelClipGrad
+(global-norm across all groups, hybrid_parallel_optimizer.py:45) needs no
+special code at all: a ClipGradByGlobalNorm inside the jitted step reduces
+over the full (sharded) grad tree and XLA produces the global norm.
+"""
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["GroupShardedSpecs", "group_sharded_specs",
+           "init_group_sharded_state", "build_group_sharded_step",
+           "group_sharded_parallel", "LEVELS"]
+
+LEVELS = ("os", "os_g", "p_g_os")
+
+
+def _spec_axes(spec: P):
+    """Flatten a PartitionSpec into per-dim tuples of axis names."""
+    out = []
+    for e in spec:
+        if e is None:
+            out.append(())
+        elif isinstance(e, (tuple, list)):
+            out.append(tuple(e))
+        else:
+            out.append((e,))
+    return out
+
+
+def _strip_axis(spec: P, axis: str) -> P:
+    """Remove `axis` from every dim of the spec (→ replicated over it)."""
+    dims = []
+    for axes in _spec_axes(spec):
+        kept = tuple(a for a in axes if a != axis)
+        dims.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return P(*dims)
+
+
+def _ensure_axis(spec: P, shape, axis: str, axis_size: int) -> P:
+    """Add `axis` to the largest divisible unsharded dim if absent (so small
+    replicated params — ln scales, biases — still spread their optimizer
+    state, like the reference's rank-assignment _trainable_param2rank)."""
+    dims = _spec_axes(spec)
+    if any(axis in axes for axes in dims):
+        return spec
+    best, best_len = None, 0
+    for i, (axes, n) in enumerate(zip(dims, shape)):
+        if not axes and n % axis_size == 0 and n >= axis_size and \
+                n > best_len:
+            best, best_len = i, n
+    if best is None:
+        return spec
+    dims[best] = (axis,)
+    return P(*[(d if len(d) > 1 else (d[0] if d else None)) for d in dims])
+
+
+@dataclasses.dataclass
+class GroupShardedSpecs:
+    """Per-parameter PartitionSpecs for params, grads, optimizer slots."""
+    param: Dict[str, P]
+    grad: Dict[str, P]
+    opt_slot: Dict[str, P]
+    mesh: Mesh
+
+    def param_shardings(self):
+        return {k: NamedSharding(self.mesh, s)
+                for k, s in self.param.items()}
+
+
+def group_sharded_specs(params: Dict[str, jax.Array], mesh: Mesh,
+                        level: str = "p_g_os", axis: str = "fsdp",
+                        rules: Optional[Callable[[str], P]] = None
+                        ) -> GroupShardedSpecs:
+    """Derive the stage-1/2/3 spec sets from a base partition-rule function.
+
+    `rules(path) -> P` gives the fully-sharded (stage-3 + TP) spec of each
+    param — e.g. models.gpt.partition_spec. Stages 1/2 strip `axis` from the
+    param (and stage 1 from the grad) spec; optimizer slots always keep it.
+    """
+    if level not in LEVELS:
+        raise ValueError(f"level must be one of {LEVELS}, got {level!r}")
+    if rules is None:
+        rules = lambda path: P()
+    axis_size = dict(mesh.shape).get(axis, 1)
+    param, grad, opt_slot = {}, {}, {}
+    for k, v in params.items():
+        base = _ensure_axis(rules(k), v.shape, axis, axis_size)
+        param[k] = base if level == "p_g_os" else _strip_axis(base, axis)
+        grad[k] = base if level in ("os_g", "p_g_os") else \
+            _strip_axis(base, axis)
+        opt_slot[k] = base
+    return GroupShardedSpecs(param, grad, opt_slot, mesh)
+
+
+def _constrain_tree(tree, specs: Dict[str, P], mesh: Mesh):
+    """Apply with_sharding_constraint per named entry (leaves may be tuples
+    of same-shaped slot arrays, e.g. Adam's (m, v))."""
+    out = {}
+    for k, v in tree.items():
+        sh = NamedSharding(mesh, specs[k])
+        out[k] = jax.tree_util.tree_map(
+            lambda x: lax.with_sharding_constraint(x, sh), v)
+    return out
+
+
+def init_group_sharded_state(params, optimizer, specs: GroupShardedSpecs):
+    """Place params per the level's param specs and build sharded optimizer
+    state (slots land directly in their shards — no full-size materialize)."""
+    mesh = specs.mesh
+    shardings = specs.param_shardings()
+    params = {k: jax.device_put(jnp.copy(v), shardings[k])
+              for k, v in params.items()}
+
+    def init(p):
+        st = optimizer.init(p)
+        return {"step": st["step"],
+                "slots": _constrain_tree(st["slots"], specs.opt_slot, mesh)}
+
+    return params, jax.jit(init)(params)
+
+
+def build_group_sharded_step(loss_fn, optimizer, specs: GroupShardedSpecs,
+                             donate: bool = True):
+    """Jitted train step under the group-sharded policy.
+
+    loss_fn(params, *batch) -> scalar. The grad constraint is what turns the
+    backward's allreduce into reduce-scatter (stage 2+); the param/slot
+    constraints keep the update math sharded so each device updates only its
+    shard (≙ GroupShardedOptimizerStage2 updating owned shards then
+    broadcasting — the broadcast being XLA's all-gather at next use).
+    """
+    mesh = specs.mesh
+
+    def step(params, opt_state, *batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, *batch))(params)
+        grads = _constrain_tree(grads, specs.grad, mesh)
+        new_p, new_s = optimizer.update(grads, opt_state, params)
+        new_p = _constrain_tree(new_p, specs.param, mesh)
+        new_s = {"step": new_s["step"],
+                 "slots": _constrain_tree(new_s["slots"], specs.opt_slot,
+                                          mesh)}
+        return new_p, new_s, loss
+
+    kw = {"donate_argnums": (0, 1)} if donate else {}
+    return jax.jit(step, **kw)
+
+
+def group_sharded_parallel(params, optimizer, loss_fn, mesh: Mesh,
+                           level: str = "p_g_os", axis: str = "fsdp",
+                           rules: Optional[Callable[[str], P]] = None):
+    """One-call API ≙ paddle.distributed.sharding.group_sharded_parallel
+    (group_sharded.py: level "os" / "os_g" / "p_g_os").
+
+    Returns (sharded_params, sharded_opt_state, jitted_train_step).
+    """
+    specs = group_sharded_specs(params, mesh, level=level, axis=axis,
+                                rules=rules)
+    params, opt_state = init_group_sharded_state(params, optimizer, specs)
+    step = build_group_sharded_step(loss_fn, optimizer, specs)
+    return params, opt_state, step

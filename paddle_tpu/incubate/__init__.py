@@ -1,10 +1,14 @@
 """Incubate (ref: python/paddle/incubate/ — MoE, fused transformer layers,
 ASP sparsity, LookAhead/ModelAverage, DistributedFusedLamb).
 
-MoE lives in paddle_tpu.distributed.moe (first-class, not incubating, on
-TPU); fused layers in incubate.nn map onto the Pallas kernel inventory."""
+MoE: paddle_tpu.incubate.moe (≙ incubate/distributed/models/moe) — GShard
+dispatch einsums sharded over the 'ep' mesh axis instead of
+global_scatter/global_gather NCCL ops; fused layers in incubate.nn map onto
+the Pallas kernel inventory."""
 
 from paddle_tpu.incubate import nn
 from paddle_tpu.incubate import asp
+from paddle_tpu.incubate import moe
+from paddle_tpu.incubate.moe import MoELayer
 
-__all__ = ["nn", "asp"]
+__all__ = ["nn", "asp", "moe", "MoELayer"]

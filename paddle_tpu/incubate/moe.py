@@ -1,0 +1,191 @@
+"""Mixture-of-Experts with expert parallelism over the 'ep' mesh axis.
+
+Reference analog: python/paddle/incubate/distributed/models/moe/
+moe_layer.py:259 (MoELayer), gates gshard_gate.py / switch_gate.py, and the
+C++ all-to-all dispatch ops operators/collective/global_scatter_op.cc /
+global_gather_op.cc (SURVEY §2.2 "EP").
+
+TPU-native design (GShard/mesh-tensorflow style): token routing is expressed
+as dense dispatch/combine einsums against a (tokens, experts, capacity)
+one-hot tensor; the expert dimension of the stacked FFN weights and of the
+dispatched activations is sharded over 'ep', so XLA lowers the dispatch
+einsum to exactly the all-to-all that global_scatter implements by hand —
+no ownership bookkeeping, and the backward all-to-all comes from jax.grad.
+Capacity overflow drops tokens (their combine weight is zero → residual
+passthrough in the caller), matching the reference's capacity semantics.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.nn.module import Module, Parameter
+
+__all__ = ["MoELayer", "top_k_gating", "EXPERT_PARTITION_RULES"]
+
+# regex → spec; the single source of truth for expert-weight sharding
+# (models.gpt composes these into its PARTITION_RULES)
+EXPERT_PARTITION_RULES = (
+    (r"moe_w1$", P("ep", "fsdp", "tp")),
+    (r"moe_b1$", P("ep", None, "tp")),
+    (r"moe_w2$", P("ep", "tp", "fsdp")),
+    (r"moe_b2$", P("ep", None, None)),
+    (r"gate_w$", P(None, None)),
+)
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def top_k_gating(logits, k: int, capacity: int, rng_key=None,
+                 jitter_eps: float = 0.0):
+    """GShard top-k gating with capacity. logits: (N, E).
+
+    Returns (combine (N,E,C), dispatch bool (N,E,C), aux_loss scalar).
+    aux = E * Σ_e mean_n(probs_e) * mean_n(top1_mask_e)  (GShard eq. (4),
+    the same form the reference's gshard_gate computes).
+    """
+    n, e = logits.shape
+    if jitter_eps > 0.0 and rng_key is not None:
+        logits = logits + jitter_eps * jax.random.uniform(
+            rng_key, logits.shape, logits.dtype, -1.0, 1.0)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    masked_probs = probs
+    position_base = jnp.zeros((e,), jnp.int32)  # tokens already in expert
+    aux = jnp.zeros((), jnp.float32)
+    gates_sum = jnp.zeros((n,), jnp.float32)
+    chosen = []
+    for i in range(k):
+        idx = jnp.argmax(masked_probs, axis=-1)                  # (N,)
+        mask = _one_hot(idx, e)                                  # (N,E)
+        if i == 0:
+            # load-balance aux uses the top-1 assignment only
+            aux = e * jnp.sum(jnp.mean(probs, axis=0)
+                              * jnp.mean(mask, axis=0))
+        pos = (jnp.cumsum(mask, axis=0) - 1.0) + position_base   # (N,E)
+        keep = (pos < capacity) & (mask > 0)
+        position_base = position_base + jnp.sum(
+            mask, axis=0).astype(jnp.int32)
+        gate_i = jnp.sum(probs * mask, axis=-1)                  # (N,)
+        in_cap = jnp.any(keep, axis=-1)
+        gates_sum = gates_sum + gate_i * in_cap
+        slot = jnp.sum(jnp.where(keep, pos, 0.0),
+                       axis=-1).astype(jnp.int32)                # (N,)
+        oh_slot = _one_hot(slot, capacity)                       # (N,C)
+        contrib = (mask * jnp.any(keep, -1, keepdims=True))[..., None] \
+            * oh_slot[:, None, :] * gate_i[:, None, None]
+        combine = combine + contrib
+        chosen.append((idx, gate_i))
+        masked_probs = masked_probs * (1.0 - mask)
+    if k > 1:
+        # renormalize the selected gates to sum to 1 per token (gshard);
+        # top-1 (switch) keeps the raw gate probability as the scale
+        combine = combine / jnp.maximum(gates_sum, 1e-9)[:, None, None]
+    dispatch = combine > 0.0
+    return combine, dispatch, aux
+
+
+class MoELayer(Module):
+    """Expert-parallel FFN MoE (≙ MoELayer moe_layer.py:259).
+
+    gate: "gshard" (top-2) or "switch" (top-1, jittered). The expert axis of
+    the stacked weights is sharded over 'ep' when a global mesh is
+    installed. forward returns (output, aux_loss); add
+    ``aux_weight * aux_loss`` to the train loss (the reference folds it in
+    via the gate object)."""
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 gate: str = "gshard", k: Optional[int] = None,
+                 capacity_factor: float = 1.25, jitter_eps: float = 0.01,
+                 tokens_per_group: int = 1024,
+                 dtype=jnp.float32, seed: int = 0):
+        super().__init__()
+        if gate not in ("gshard", "switch"):
+            raise ValueError(f"unknown gate {gate!r}")
+        self.num_experts = num_experts
+        self.k = k if k is not None else (2 if gate == "gshard" else 1)
+        self.gate_type = gate
+        self.capacity_factor = capacity_factor
+        self.jitter_eps = jitter_eps if gate == "switch" else 0.0
+        self.tokens_per_group = tokens_per_group
+        key = jax.random.PRNGKey(seed)
+        k1, k2, kg = jax.random.split(key, 3)
+        E, d, h = num_experts, d_model, d_hidden
+        std = 0.02
+        self.gate_w = Parameter(
+            (std * jax.random.normal(kg, (d, E))).astype(jnp.float32))
+        self.moe_w1 = Parameter(
+            (std * jax.random.normal(k1, (E, d, h))).astype(dtype))
+        self.moe_b1 = Parameter(jnp.zeros((E, 1, h), dtype))
+        self.moe_w2 = Parameter(
+            (std * jax.random.normal(k2, (E, h, d))).astype(dtype))
+        self.moe_b2 = Parameter(jnp.zeros((E, 1, d), dtype))
+
+    def _shard(self, x, spec):
+        from paddle_tpu.distributed.mesh import get_mesh
+        mesh = get_mesh()
+        if mesh is None or mesh.size == 1 or \
+                dict(mesh.shape).get("ep", 1) == 1:
+            return x
+        try:
+            return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        except Exception:
+            return x
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(4, int(math.ceil(
+            self.k * n_tokens * self.capacity_factor / self.num_experts)))
+
+    def forward(self, x, rng_key=None):
+        """Tokens are routed within fixed-size groups (GShard-style: the
+        dispatch tensor is (G, T, E, C) with C ∝ T/E, so total routing
+        memory stays LINEAR in token count — a single global group would be
+        quadratic). The trailing partial group is zero-padded; padded slots
+        carry zero combine weight into the output, which is sliced off."""
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        xt = x.reshape(-1, d)
+        n = xt.shape[0]
+        t = min(self.tokens_per_group, n)
+        g = -(-n // t)
+        pad = g * t - n
+        if pad:
+            xt = jnp.pad(xt, ((0, pad), (0, 0)))
+        xg = xt.reshape(g, t, d)
+        cap = self.capacity(t)
+        logits = xg.astype(jnp.float32) @ self.gate_w        # (G,T,E)
+        gate_keys = (jax.random.split(rng_key, g)
+                     if (rng_key is not None and self.jitter_eps > 0.0)
+                     else None)
+        gating = lambda lg, kk: top_k_gating(
+            lg, self.k, cap, rng_key=kk, jitter_eps=self.jitter_eps)
+        if gate_keys is None:
+            combine, dispatch, aux = jax.vmap(
+                lambda lg: gating(lg, None))(logits)
+        else:
+            combine, dispatch, aux = jax.vmap(gating)(logits, gate_keys)
+        aux = jnp.mean(aux)
+        # dispatch: (G,T,E,C) x (G,T,D) -> (G,E,C,D); sharded over 'ep'
+        # this is the global_scatter all-to-all
+        expert_in = jnp.einsum("gtec,gtd->gecd",
+                               dispatch.astype(xt.dtype), xg)
+        expert_in = self._shard(expert_in, P(None, "ep", None, None))
+        h = jnp.einsum("gecd,edh->gech", expert_in, self.moe_w1) \
+            + self.moe_b1[None]
+        h = jax.nn.gelu(h)
+        out = jnp.einsum("gech,ehd->gecd", h, self.moe_w2) \
+            + self.moe_b2[None]
+        out = self._shard(out, P(None, "ep", None, None))
+        # combine: back to tokens — the global_gather direction
+        y = jnp.einsum("gtec,gecd->gtd", combine.astype(xt.dtype), out)
+        y = y.reshape(g * t, d)
+        if pad:
+            y = y[:n]
+        return y.reshape(orig_shape), aux

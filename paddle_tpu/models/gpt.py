@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu import nn
+from paddle_tpu.incubate.moe import EXPERT_PARTITION_RULES
 from paddle_tpu.nn.module import Module, Parameter, LayerList
 from paddle_tpu.nn import functional as F
 
@@ -50,6 +51,12 @@ class GPTConfig:
     tie_embeddings: bool = True
     # remat ≙ reference recompute (fleet/recompute/recompute.py:386)
     remat: bool = False
+    # MoE (≙ incubate MoE GPT): every `moe_every`-th block swaps its FFN for
+    # an expert-parallel MoELayer; 0 experts = dense
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_aux_weight: float = 0.01
+    moe_gate: str = "gshard"
 
     @property
     def head_dim(self):
@@ -86,7 +93,7 @@ class GPTBlock(Module):
     """Pre-LN transformer decoder block with fused qkv (one (d,3d) matmul
     keeps the MXU busy vs three thin ones)."""
 
-    def __init__(self, cfg: GPTConfig, key: jax.Array):
+    def __init__(self, cfg: GPTConfig, key: jax.Array, use_moe=False):
         super().__init__()
         d, h = cfg.d_model, cfg.n_heads
         self.n_heads = h
@@ -96,19 +103,33 @@ class GPTBlock(Module):
         std = 0.02
         resid_std = std / math.sqrt(2 * cfg.n_layers)
         dt = cfg.dtype
+        self.use_moe = use_moe
         self.ln1_scale = Parameter(jnp.ones((d,), jnp.float32))
         self.ln1_bias = Parameter(jnp.zeros((d,), jnp.float32))
         self.ln2_scale = Parameter(jnp.ones((d,), jnp.float32))
         self.ln2_bias = Parameter(jnp.zeros((d,), jnp.float32))
         self.wqkv = Parameter(_normal(ks[0], (d, 3 * d), std, dt))
         self.wo = Parameter(_normal(ks[1], (d, d), resid_std, dt))
-        self.wup = Parameter(_normal(ks[2], (d, cfg.d_ffn), std, dt))
-        self.wdown = Parameter(_normal(ks[3], (cfg.d_ffn, d), resid_std, dt))
+        if use_moe:
+            from paddle_tpu.incubate.moe import MoELayer
+            self.moe = MoELayer(d, cfg.d_ffn, cfg.moe_experts,
+                                gate=cfg.moe_gate, dtype=dt,
+                                seed=int(jax.random.randint(
+                                    ks[2], (), 0, 2**31 - 1)))
+            self.wup = self.wdown = None
+        else:
+            self.moe = None
+            self.wup = Parameter(_normal(ks[2], (d, cfg.d_ffn), std, dt))
+            self.wdown = Parameter(_normal(ks[3], (cfg.d_ffn, d),
+                                           resid_std, dt))
         if cfg.use_bias:
             self.bqkv = Parameter(jnp.zeros((3 * d,), dt))
             self.bo = Parameter(jnp.zeros((d,), dt))
-            self.bup = Parameter(jnp.zeros((cfg.d_ffn,), dt))
-            self.bdown = Parameter(jnp.zeros((d,), dt))
+            if not use_moe:
+                self.bup = Parameter(jnp.zeros((cfg.d_ffn,), dt))
+                self.bdown = Parameter(jnp.zeros((d,), dt))
+            else:
+                self.bup = self.bdown = None
         else:
             self.bqkv = self.bo = self.bup = self.bdown = None
 
@@ -142,7 +163,7 @@ class GPTBlock(Module):
         y = (x32 - mu) * lax.rsqrt(var + 1e-5) * scale + bias
         return y.astype(x.dtype)
 
-    def forward(self, x, rng_key=None):
+    def forward(self, x, rng_key=None, aux_acc=None):
         b, s, d = x.shape
         h = self._ln(x, self.ln1_scale, self.ln1_bias)
         qkv = h @ self.wqkv
@@ -158,12 +179,17 @@ class GPTBlock(Module):
             o = o + self.bo
         x = x + _maybe_dropout(o, self.dropout, rng_key, 1)
         h = self._ln(x, self.ln2_scale, self.ln2_bias)
-        h = jax.nn.gelu(h @ self.wup + (self.bup if self.bup is not None
-                                        else 0.0))
-        h = _shard_act(h, P(_BATCH_AXES, "sp", "tp"))
-        h = h @ self.wdown
-        if self.bdown is not None:
-            h = h + self.bdown
+        if self.moe is not None:
+            h, aux = self.moe(h, rng_key)
+            if aux_acc is not None:
+                aux_acc.append(aux)
+        else:
+            h = jax.nn.gelu(h @ self.wup + (self.bup if self.bup is not None
+                                            else 0.0))
+            h = _shard_act(h, P(_BATCH_AXES, "sp", "tp"))
+            h = h @ self.wdown
+            if self.bdown is not None:
+                h = h + self.bdown
         x = x + _maybe_dropout(h, self.dropout, rng_key, 2)
         return _shard_act(x, P(_BATCH_AXES, "sp", None))
 
@@ -211,8 +237,17 @@ class GPT(Module):
                                      0.02, dt))
         self.wpe = Parameter(_normal(kp, (cfg.max_seq_len, cfg.d_model),
                                      0.01, dt))
+        if cfg.moe_experts > 0 and cfg.moe_every < 1:
+            raise ValueError(
+                f"moe_every must be >= 1, got {cfg.moe_every}")
+        if cfg.moe_experts > 0 and cfg.remat:
+            raise ValueError("moe_experts with remat is unsupported (the "
+                             "aux-loss accumulator cannot cross a "
+                             "jax.checkpoint boundary)")
         self.blocks = LayerList([
-            GPTBlock(cfg, jax.random.fold_in(kb, i))
+            GPTBlock(cfg, jax.random.fold_in(kb, i),
+                     use_moe=(cfg.moe_experts > 0
+                              and (i + 1) % cfg.moe_every == 0))
             for i in range(cfg.n_layers)])
         self.lnf_scale = Parameter(jnp.ones((cfg.d_model,), jnp.float32))
         self.lnf_bias = Parameter(jnp.zeros((cfg.d_model,), jnp.float32))
@@ -237,16 +272,29 @@ class GPT(Module):
         logits = x @ w
         return _shard_act(logits, P(_BATCH_AXES, "sp", "tp"))
 
-    def forward(self, tokens, rng_key=None):
+    def forward(self, tokens, rng_key=None, return_aux=False):
+        """return_aux=True additionally returns the summed MoE load-balance
+        aux loss (zeros for dense configs); threaded explicitly — no
+        global state, safe across multiple forwards per trace."""
+        aux_acc = []
         x = self.embed(tokens)
+        # remat never coexists with MoE (enforced in __init__), so the
+        # checkpointed closure does not capture aux_acc
         blk_fn = (jax.checkpoint(lambda b, h, k: b(h, k),
                                  static_argnums=())
-                  if self.cfg.remat else (lambda b, h, k: b(h, k)))
+                  if self.cfg.remat
+                  else (lambda b, h, k: b(h, k, aux_acc=aux_acc)))
         for i in range(self.cfg.n_layers):
             k = (jax.random.fold_in(rng_key, i)
                  if rng_key is not None else None)
             x = blk_fn(self.blocks[i], x, k)
-        return self.head(x)
+        logits = self.head(x)
+        if return_aux:
+            aux = jnp.zeros((), jnp.float32)
+            for a in aux_acc:
+                aux = aux + a
+            return logits, aux
+        return logits
 
 
 # ---------------------------------------------------------------------------
@@ -277,7 +325,7 @@ PARTITION_RULES = (
     (r"wdown$", P("tp", "fsdp")),
     (r"(bo|bdown)$", P(None)),
     (r"(ln1|ln2|lnf)_(scale|bias)$", P(None)),
-)
+) + EXPERT_PARTITION_RULES
 
 
 def partition_spec(path: str) -> P:
@@ -319,6 +367,10 @@ def build_train_step(model: GPT, optimizer, mesh: Optional[Mesh] = None,
     def step(params, opt_state, tokens, rng):
         def loss_fn(p):
             m = model.merge_params(p)
+            if model.cfg.moe_experts > 0:
+                logits, aux = m(tokens, rng_key=rng, return_aux=True)
+                return lm_loss(logits, tokens) \
+                    + model.cfg.moe_aux_weight * aux
             logits = m(tokens, rng_key=rng)
             return lm_loss(logits, tokens)
 
@@ -355,6 +407,9 @@ def stack_blocks(model: GPT, n_stages: int):
     (n_stages, layers_per_stage, ...). The stage axis is sharded over 'pp'.
     ≙ PipelineLayer._segment_network (parallel_layers/pp_layers.py:550)."""
     L = model.cfg.n_layers
+    if model.cfg.moe_experts > 0:
+        raise ValueError("pipeline stacking needs homogeneous blocks; "
+                         "MoE GPT uses dp/fsdp/tp/sp/ep instead of pp")
     assert L % n_stages == 0, f"{L} layers not divisible by {n_stages} stages"
     lps = L // n_stages
     blocks = [model.blocks[i] for i in range(L)]
