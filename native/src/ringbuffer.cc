@@ -1,0 +1,237 @@
+// Shared-memory ring buffer for the data-loader pipeline.
+//
+// Reference analog: the multiprocess DataLoader's shared-memory tensor
+// transport (python/paddle/fluid/dataloader/dataloader_iter.py:114,611
+// _use_shared_memory + paddle/fluid/memory/allocation/mmap_allocator.cc)
+// and the C++ feed path paddle/fluid/framework/data_feed.cc. Worker
+// processes serialize batches straight into POSIX shared memory; the
+// consumer pops without pickling through a multiprocessing.Queue.
+//
+// Fixed-size slots, MPMC, blocking push/pop with timeout, process-shared
+// pthread mutex/condvars. C ABI only (consumed via ctypes — no pybind11).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x50545055524221ULL;  // "PTPURB!"
+
+struct RBHeader {
+  uint64_t magic;
+  uint32_t nslots;
+  uint64_t slot_size;
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+  uint32_t head;   // next slot to pop
+  uint32_t count;  // filled slots
+  uint32_t closed; // producer-side close: pops drain then return -3
+  // followed by: uint64_t lens[nslots]; then payload slots
+};
+
+struct RB {
+  RBHeader* h;
+  uint64_t* lens;
+  char* slots;
+  uint64_t map_size;
+  char name[256];
+};
+
+uint64_t total_size(uint32_t nslots, uint64_t slot_size) {
+  return sizeof(RBHeader) + nslots * sizeof(uint64_t) +
+         static_cast<uint64_t>(nslots) * slot_size;
+}
+
+RB* attach(void* mem, uint64_t map_size, const char* name) {
+  RB* rb = new RB();
+  rb->h = reinterpret_cast<RBHeader*>(mem);
+  rb->lens = reinterpret_cast<uint64_t*>(static_cast<char*>(mem) +
+                                         sizeof(RBHeader));
+  rb->slots = static_cast<char*>(mem) + sizeof(RBHeader) +
+              rb->h->nslots * sizeof(uint64_t);
+  rb->map_size = map_size;
+  snprintf(rb->name, sizeof(rb->name), "%s", name);
+  return rb;
+}
+
+void abs_deadline(double timeout_s, timespec* ts) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  time_t sec = static_cast<time_t>(timeout_s);
+  long nsec = static_cast<long>((timeout_s - sec) * 1e9);
+  ts->tv_sec += sec;
+  ts->tv_nsec += nsec;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (and initialize) a named ring. Returns opaque handle or null.
+void* ptrb_create(const char* name, uint32_t nslots, uint64_t slot_size) {
+  shm_unlink(name);  // stale ring from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t size = total_size(nslots, slot_size);
+  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  RBHeader* h = reinterpret_cast<RBHeader*>(mem);
+  h->nslots = nslots;
+  h->slot_size = slot_size;
+  h->head = 0;
+  h->count = 0;
+  h->closed = 0;
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_full, &ca);
+  pthread_cond_init(&h->not_empty, &ca);
+  h->magic = kMagic;  // last: marks fully initialized
+  return attach(mem, size, name);
+}
+
+// Open an existing ring (worker side).
+void* ptrb_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<uint64_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  RBHeader* h = reinterpret_cast<RBHeader*>(mem);
+  if (h->magic != kMagic) {
+    munmap(mem, static_cast<uint64_t>(st.st_size));
+    return nullptr;
+  }
+  return attach(mem, static_cast<uint64_t>(st.st_size), name);
+}
+
+uint64_t ptrb_slot_size(void* handle) {
+  return static_cast<RB*>(handle)->h->slot_size;
+}
+
+static int lock_robust(RBHeader* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {  // a worker died holding the lock
+    pthread_mutex_consistent(&h->mu);
+    return 0;
+  }
+  return rc;
+}
+
+// 0 ok; -1 timeout; -2 payload too large; -3 ring closed.
+int ptrb_push(void* handle, const void* data, uint64_t len,
+              double timeout_s) {
+  RB* rb = static_cast<RB*>(handle);
+  RBHeader* h = rb->h;
+  if (len > h->slot_size) return -2;
+  timespec dl;
+  abs_deadline(timeout_s, &dl);
+  if (lock_robust(h) != 0) return -4;
+  while (h->count == h->nslots && !h->closed) {
+    if (pthread_cond_timedwait(&h->not_full, &h->mu, &dl) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -3;
+  }
+  uint32_t slot = (h->head + h->count) % h->nslots;
+  memcpy(rb->slots + static_cast<uint64_t>(slot) * h->slot_size, data, len);
+  rb->lens[slot] = len;
+  h->count += 1;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// >=0: payload length; -1 timeout; -2 out buffer too small; -3 closed+empty.
+int64_t ptrb_pop(void* handle, void* out, uint64_t out_cap,
+                 double timeout_s) {
+  RB* rb = static_cast<RB*>(handle);
+  RBHeader* h = rb->h;
+  timespec dl;
+  abs_deadline(timeout_s, &dl);
+  if (lock_robust(h) != 0) return -4;
+  while (h->count == 0) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -3;
+    }
+    if (pthread_cond_timedwait(&h->not_empty, &h->mu, &dl) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  uint32_t slot = h->head;
+  uint64_t len = rb->lens[slot];
+  if (len > out_cap) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  memcpy(out, rb->slots + static_cast<uint64_t>(slot) * h->slot_size, len);
+  h->head = (h->head + 1) % h->nslots;
+  h->count -= 1;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(len);
+}
+
+// Mark closed: blocked pushes fail, pops drain remaining then return -3.
+void ptrb_close_producer(void* handle) {
+  RB* rb = static_cast<RB*>(handle);
+  if (lock_robust(rb->h) != 0) return;
+  rb->h->closed = 1;
+  pthread_cond_broadcast(&rb->h->not_empty);
+  pthread_cond_broadcast(&rb->h->not_full);
+  pthread_mutex_unlock(&rb->h->mu);
+}
+
+int ptrb_size(void* handle) {
+  return static_cast<int>(static_cast<RB*>(handle)->h->count);
+}
+
+void ptrb_close(void* handle, int unlink_shm) {
+  RB* rb = static_cast<RB*>(handle);
+  char name[256];
+  snprintf(name, sizeof(name), "%s", rb->name);
+  munmap(rb->h, rb->map_size);
+  if (unlink_shm) shm_unlink(name);
+  delete rb;
+}
+
+}  // extern "C"

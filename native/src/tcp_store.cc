@@ -1,0 +1,314 @@
+// TCP key-value rendezvous store.
+//
+// Reference analog: paddle/fluid/distributed/store/tcp_store.cc +
+// socket.cpp — the bootstrap KV store behind init_parallel_env()
+// (SURVEY §3.5: trainers rendezvous via TCPStore before forming the
+// communicator). Same surface: set / get-with-wait / add / delete, a
+// thread-per-connection server and a simple length-prefixed binary
+// protocol. C ABI only (ctypes bindings, no pybind11).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kDel = 4, kPing = 5 };
+enum Status : uint8_t { kOk = 0, kTimeout = 1, kError = 2 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::vector<int> conn_fds;  // closed in ptts_server_stop after join
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+
+  void handle(int fd) {
+    for (;;) {
+      uint8_t cmd;
+      if (!read_full(fd, &cmd, 1)) break;
+      uint32_t klen;
+      if (!read_full(fd, &klen, 4)) break;
+      std::string key(klen, '\0');
+      if (klen && !read_full(fd, &key[0], klen)) break;
+      if (cmd == kSet) {
+        uint64_t vlen;
+        if (!read_full(fd, &vlen, 8)) break;
+        std::string val(vlen, '\0');
+        if (vlen && !read_full(fd, &val[0], vlen)) break;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = std::move(val);
+        }
+        cv.notify_all();
+        uint8_t st = kOk;
+        uint64_t zero = 0;
+        if (!write_full(fd, &st, 1) || !write_full(fd, &zero, 8)) break;
+      } else if (cmd == kGet) {
+        double timeout_s;
+        if (!read_full(fd, &timeout_s, 8)) break;
+        std::string val;
+        uint8_t st = kOk;
+        {
+          std::unique_lock<std::mutex> g(mu);
+          bool ok = cv.wait_for(
+              g, std::chrono::duration<double>(timeout_s),
+              [&] { return stop.load() || kv.count(key) > 0; });
+          if (!ok || stop.load()) {
+            st = kTimeout;
+          } else {
+            val = kv[key];
+          }
+        }
+        uint64_t vlen = val.size();
+        if (!write_full(fd, &st, 1) || !write_full(fd, &vlen, 8)) break;
+        if (vlen && !write_full(fd, val.data(), vlen)) break;
+      } else if (cmd == kAdd) {
+        int64_t delta;
+        if (!read_full(fd, &delta, 8)) break;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8) {
+            memcpy(&cur, it->second.data(), 8);
+          }
+          result = cur + delta;
+          std::string v(8, '\0');
+          memcpy(&v[0], &result, 8);
+          kv[key] = std::move(v);
+        }
+        cv.notify_all();
+        uint8_t st = kOk;
+        uint64_t vlen = 8;
+        if (!write_full(fd, &st, 1) || !write_full(fd, &vlen, 8) ||
+            !write_full(fd, &result, 8))
+          break;
+      } else if (cmd == kDel) {
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv.erase(key);
+        }
+        uint8_t st = kOk;
+        uint64_t zero = 0;
+        if (!write_full(fd, &st, 1) || !write_full(fd, &zero, 8)) break;
+      } else if (cmd == kPing) {
+        uint8_t st = kOk;
+        uint64_t zero = 0;
+        if (!write_full(fd, &st, 1) || !write_full(fd, &zero, 8)) break;
+      } else {
+        break;
+      }
+    }
+    // fd is closed by ptts_server_stop after joining this thread — closing
+    // here would let the kernel reuse the fd number while stop still tracks
+    // it (shutdown on a reused fd would hit an unrelated socket)
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) return;
+        continue;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(mu);
+      conn_fds.push_back(fd);
+      conns.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client
+};
+
+}  // namespace
+
+extern "C" {
+
+// Start a server on `port` (0 = ephemeral). Returns handle or null.
+void* ptts_server_start(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 128) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  Server* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int ptts_server_port(void* handle) {
+  return static_cast<Server*>(handle)->port;
+}
+
+void ptts_server_stop(void* handle) {
+  Server* s = static_cast<Server*>(handle);
+  s->stop.store(true);
+  s->cv.notify_all();
+  shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // unblock handlers stuck in recv(), then JOIN them — detaching would
+    // leave threads touching the Server after delete (use-after-free)
+    std::lock_guard<std::mutex> g(s->mu);
+    for (int fd : s->conn_fds) shutdown(fd, SHUT_RDWR);
+  }
+  s->cv.notify_all();
+  for (auto& t : s->conns)
+    if (t.joinable()) t.join();
+  for (int fd : s->conn_fds) close(fd);
+  delete s;
+}
+
+void* ptts_connect(const char* host, int port, double timeout_s) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return nullptr;
+  }
+  // bounded retry: the server may not be up yet (rendezvous races)
+  double waited = 0.0;
+  while (connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                 sizeof(addr)) != 0) {
+    close(fd);
+    if (waited >= timeout_s) return nullptr;
+    usleep(100000);
+    waited += 0.1;
+    fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Client* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+static int64_t roundtrip(Client* c, uint8_t cmd, const char* key,
+                         const void* payload, uint64_t plen, void* out,
+                         uint64_t out_cap) {
+  std::lock_guard<std::mutex> g(c->mu);
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  if (!write_full(c->fd, &cmd, 1) || !write_full(c->fd, &klen, 4) ||
+      !write_full(c->fd, key, klen))
+    return -2;
+  if (plen && !write_full(c->fd, payload, plen)) return -2;
+  uint8_t st;
+  uint64_t vlen;
+  if (!read_full(c->fd, &st, 1) || !read_full(c->fd, &vlen, 8)) return -2;
+  if (vlen > out_cap) {
+    // drain to keep the stream aligned
+    std::string sink(vlen, '\0');
+    read_full(c->fd, &sink[0], vlen);
+    return (st == kOk) ? -3 : -1;
+  }
+  if (vlen && !read_full(c->fd, out, vlen)) return -2;
+  if (st == kTimeout) return -1;
+  if (st != kOk) return -2;
+  return static_cast<int64_t>(vlen);
+}
+
+int ptts_set(void* handle, const char* key, const void* val, uint64_t len) {
+  Client* c = static_cast<Client*>(handle);
+  struct {
+    uint64_t len;
+  } hdr{len};
+  std::string payload(8 + len, '\0');
+  memcpy(&payload[0], &hdr.len, 8);
+  if (len) memcpy(&payload[8], val, len);
+  char dummy[8];
+  int64_t r = roundtrip(c, kSet, key, payload.data(), payload.size(), dummy,
+                        sizeof(dummy));
+  return r >= 0 ? 0 : static_cast<int>(r);
+}
+
+// >=0 value length; -1 timeout; -2 io error; -3 out buffer too small.
+int64_t ptts_get(void* handle, const char* key, void* out, uint64_t cap,
+                 double timeout_s) {
+  Client* c = static_cast<Client*>(handle);
+  return roundtrip(c, kGet, key, &timeout_s, 8, out, cap);
+}
+
+// Atomic add; returns the new value (or INT64_MIN on error).
+int64_t ptts_add(void* handle, const char* key, int64_t delta) {
+  Client* c = static_cast<Client*>(handle);
+  int64_t result;
+  int64_t r = roundtrip(c, kAdd, key, &delta, 8, &result, 8);
+  return r == 8 ? result : INT64_MIN;
+}
+
+int ptts_del(void* handle, const char* key) {
+  char dummy[8];
+  int64_t r = roundtrip(static_cast<Client*>(handle), kDel, key, nullptr, 0,
+                        dummy, sizeof(dummy));
+  return r >= 0 ? 0 : static_cast<int>(r);
+}
+
+void ptts_close(void* handle) {
+  Client* c = static_cast<Client*>(handle);
+  close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

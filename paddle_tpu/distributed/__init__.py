@@ -27,6 +27,9 @@ from paddle_tpu.distributed.ring_attention import (
 from paddle_tpu.distributed.sharding import (
     group_sharded_parallel, group_sharded_specs, build_group_sharded_step,
     init_group_sharded_state, GroupShardedSpecs)
+from paddle_tpu.distributed.checkpoint import (
+    save_state, load_state, AutoCheckpoint)
+from paddle_tpu.native import TCPStore  # ≙ fluid.core.TCPStore (C++)
 
 __all__ = ["env", "mesh", "collective", "init_parallel_env", "get_rank",
            "get_world_size", "ParallelEnv", "is_initialized", "init_mesh",
@@ -37,4 +40,5 @@ __all__ = ["env", "mesh", "collective", "init_parallel_env", "get_rank",
            "reshard", "replicate", "ring_attention", "ulysses_attention",
            "sequence_parallel_attention", "group_sharded_parallel",
            "group_sharded_specs", "build_group_sharded_step",
-           "init_group_sharded_state", "GroupShardedSpecs"]
+           "init_group_sharded_state", "GroupShardedSpecs", "save_state",
+           "load_state", "AutoCheckpoint", "TCPStore"]

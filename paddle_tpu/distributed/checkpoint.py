@@ -1,0 +1,252 @@
+"""Distributed (sharded) checkpointing with resharding-on-load.
+
+Reference analog (SURVEY §5.4): sharding optimizers' rank-local
+state_dicts + auto_parallel dist_saver.py / converter.py (per-rank
+programs+params with dist attrs, resharded on load), and the op-version
+registry (framework/op_version_registry.h:397) → the format_version field.
+
+Format (one directory per checkpoint):
+    meta.json             format_version, per-array {shape, dtype, shards}
+    skeleton.pkl          pytree structure with ARRAY_n placeholders
+    data/ARRAY_n.s{k}.npy one file per saved shard (its global index range
+                          recorded in meta) — only ONE copy of each distinct
+                          shard is written (replicated arrays write once)
+
+Resharding on load: the loader assembles each *needed* slice from whichever
+saved shard files overlap it via jax.make_array_from_callback, so a
+checkpoint written on mesh A (e.g. fsdp=8) restores onto mesh B (e.g.
+dp=2×fsdp=4, or a single chip) reading each byte once. The reference can
+only restart on the same topology unless the auto-parallel converter
+rewrites states (SURVEY §7.3 hard-part 5); here resharding is native.
+"""
+
+import dataclasses
+import json
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["save_state", "load_state", "AutoCheckpoint"]
+
+FORMAT_VERSION = 1
+_MIN_READABLE_VERSION = 1
+
+
+class _Py:
+    """Skeleton marker for non-array leaves (opaque to tree flattening —
+    a bare tuple marker would be descended into as a pytree)."""
+
+    def __init__(self, v):
+        self.v = v
+
+
+def _flatten(state):
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    return leaves, treedef
+
+
+def _shard_ranges(arr: jax.Array):
+    """Distinct addressable shards as (index-ranges, numpy data)."""
+    seen = {}
+    for sh in arr.addressable_shards:
+        key = tuple((s.start or 0, s.stop if s.stop is not None else dim)
+                    for s, dim in zip(sh.index, arr.shape))
+        if key not in seen:
+            seen[key] = np.asarray(sh.data)
+    return seen
+
+
+def save_state(state, path: str):
+    """Save any pytree of jax/numpy arrays (+ json-able scalars). Each
+    distinct device shard is written once; replicated arrays write one
+    copy. Works on any mesh, including a single device."""
+    os.makedirs(os.path.join(path, "data"), exist_ok=True)
+    leaves, treedef = _flatten(state)
+    meta = {"format_version": FORMAT_VERSION, "arrays": {}}
+    skeleton = []
+    for i, leaf in enumerate(leaves):
+        name = f"ARRAY_{i}"
+        if isinstance(leaf, jax.Array) and not isinstance(leaf, jax.core.Tracer):
+            shards = _shard_ranges(leaf)
+            entry = {"shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                     "shards": []}
+            for k, (ranges, data) in enumerate(shards.items()):
+                fn = f"{name}.s{k}.npy"
+                np.save(os.path.join(path, "data", fn),
+                        data, allow_pickle=False)
+                entry["shards"].append({"file": fn,
+                                        "range": [list(r) for r in ranges]})
+            meta["arrays"][name] = entry
+            skeleton.append(name)
+        elif isinstance(leaf, np.ndarray):
+            fn = f"{name}.s0.npy"
+            np.save(os.path.join(path, "data", fn), leaf,
+                    allow_pickle=False)
+            meta["arrays"][name] = {
+                "shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                "shards": [{"file": fn,
+                            "range": [[0, d] for d in leaf.shape]}]}
+            skeleton.append(name)
+        else:
+            skeleton.append(_Py(leaf))
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    with open(os.path.join(path, "skeleton.pkl"), "wb") as f:
+        pickle.dump(jax.tree_util.tree_unflatten(treedef, skeleton), f)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _read_slice(path, entry, index, shape, dtype):
+    """Assemble the requested global slice from overlapping saved shards."""
+    starts = [s.start or 0 for s in index]
+    stops = [s.stop if s.stop is not None else dim
+             for s, dim in zip(index, shape)]
+    out = np.empty([b - a for a, b in zip(starts, stops)], dtype)
+    for sh in entry["shards"]:
+        r = sh["range"]
+        inter = [(max(a, ra), min(b, rb))
+                 for (a, b), (ra, rb) in zip(zip(starts, stops), r)]
+        if any(a >= b for a, b in inter):
+            continue
+        data = np.load(os.path.join(path, "data", sh["file"]),
+                       mmap_mode="r")
+        if data.dtype.kind == "V":  # ml_dtypes (bf16/fp8) round-trip raw
+            data = data.view(dtype)
+        src = tuple(slice(a - ra, b - ra)
+                    for (a, b), (ra, rb) in zip(inter, r))
+        dst = tuple(slice(a - s, b - s)
+                    for (a, b), s in zip(inter, starts))
+        out[dst] = data[src]
+    return out
+
+
+def load_state(path: str,
+               shardings: Optional[Union[Dict[str, Any],
+                                         Callable[[str], Any]]] = None,
+               template=None):
+    """Load a checkpoint directory.
+
+    shardings: None → jnp arrays on the default device;
+    a pytree matching the saved structure (leaves NamedSharding / None), or
+    a callable mapping the flattened leaf position name ("ARRAY_i") — use
+    `template` instead for name-free placement: a pytree of shardings with
+    the same structure as the saved state.
+    """
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    ver = meta.get("format_version", 0)
+    if not (_MIN_READABLE_VERSION <= ver <= FORMAT_VERSION):
+        raise ValueError(
+            f"checkpoint format_version {ver} unsupported "
+            f"(readable: {_MIN_READABLE_VERSION}..{FORMAT_VERSION})")
+    with open(os.path.join(path, "skeleton.pkl"), "rb") as f:
+        skeleton = pickle.load(f)
+
+    is_sh_leaf = lambda x: x is None or isinstance(x, NamedSharding)
+    t_leaves = None
+    if template is not None:
+        t_leaves = jax.tree_util.tree_leaves(template, is_leaf=is_sh_leaf)
+    s_leaves = None
+    if shardings is not None and not callable(shardings):
+        s_leaves = jax.tree_util.tree_leaves(shardings, is_leaf=is_sh_leaf)
+
+    leaves, treedef = jax.tree_util.tree_flatten(skeleton)
+    out = []
+    for li, leaf in enumerate(leaves):
+        if isinstance(leaf, _Py):
+            out.append(leaf.v)
+            continue
+        name = leaf
+        entry = meta["arrays"][name]
+        shape = tuple(entry["shape"])
+        np_dtype = _np_dtype(entry["dtype"])
+        # indexed by overall leaf position: the shardings/template pytree
+        # mirrors the SAVED structure, so its non-array positions (None
+        # placeholders) keep array positions aligned
+        sharding = None
+        if callable(shardings):
+            sharding = shardings(name)
+        elif s_leaves is not None:
+            sharding = s_leaves[li]
+        elif t_leaves is not None:
+            sharding = t_leaves[li]
+        if sharding is None:
+            arr = jnp.asarray(_read_slice(
+                path, entry, tuple(slice(0, d) for d in shape), shape,
+                np_dtype))
+        else:
+            def cb(index, entry=entry, shape=shape, np_dtype=np_dtype):
+                return _read_slice(path, entry, index, shape, np_dtype)
+
+            arr = jax.make_array_from_callback(shape, sharding, cb)
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+@dataclasses.dataclass
+class AutoCheckpoint:
+    """Epoch-range auto checkpoint ≙ the reference's TrainEpochRange
+    (fluid/incubate/checkpoint/auto_checkpoint.py:284): snapshot state each
+    epoch under a job directory, transparently resume after preemption.
+
+        ck = AutoCheckpoint("/ckpts", job_id="gpt-run-1", keep=2)
+        state = ck.restore() or init_state()
+        for epoch in ck.epochs(start=ck.next_epoch, end=100):
+            state = train_one_epoch(state)
+            ck.save(state, epoch)
+    """
+    root: str
+    job_id: str
+    keep: int = 2
+
+    def __post_init__(self):
+        self.dir = os.path.join(self.root, self.job_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _epochs_on_disk(self):
+        eps = []
+        for d in os.listdir(self.dir):
+            if d.startswith("epoch_") and os.path.exists(
+                    os.path.join(self.dir, d, "meta.json")):
+                eps.append(int(d.split("_")[1]))
+        return sorted(eps)
+
+    @property
+    def next_epoch(self) -> int:
+        eps = self._epochs_on_disk()
+        return (eps[-1] + 1) if eps else 0
+
+    def restore(self, shardings=None, template=None):
+        """Latest epoch's state, or None if nothing saved yet."""
+        eps = self._epochs_on_disk()
+        if not eps:
+            return None
+        return load_state(os.path.join(self.dir, f"epoch_{eps[-1]}"),
+                          shardings=shardings, template=template)
+
+    def save(self, state, epoch: int):
+        tmp = os.path.join(self.dir, f".tmp_epoch_{epoch}")
+        final = os.path.join(self.dir, f"epoch_{epoch}")
+        save_state(state, tmp)
+        if os.path.exists(final):
+            import shutil
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        for e in self._epochs_on_disk()[:-self.keep]:
+            import shutil
+            shutil.rmtree(os.path.join(self.dir, f"epoch_{e}"))
+
+    def epochs(self, start: int, end: int):
+        return range(start, end)

@@ -108,10 +108,18 @@ def group_sharded_specs(params: Dict[str, jax.Array], mesh: Mesh,
         raise ValueError(f"level must be one of {LEVELS}, got {level!r}")
     if rules is None:
         rules = lambda path: P()
-    axis_size = dict(mesh.shape).get(axis, 1)
+    mesh_shape = dict(mesh.shape)
+    if axis not in mesh_shape:
+        raise ValueError(
+            f"sharding axis {axis!r} not in mesh axes "
+            f"{tuple(mesh_shape)}; build the mesh with an {axis!r} "
+            f"dimension (e.g. init_mesh({axis}=N))")
+    axis_size = mesh_shape[axis]
     param, grad, opt_slot = {}, {}, {}
     for k, v in params.items():
-        base = _ensure_axis(rules(k), v.shape, axis, axis_size)
+        base = rules(k)
+        if axis_size > 1:
+            base = _ensure_axis(base, v.shape, axis, axis_size)
         param[k] = base if level == "p_g_os" else _strip_axis(base, axis)
         grad[k] = base if level in ("os_g", "p_g_os") else \
             _strip_axis(base, axis)

@@ -24,6 +24,7 @@ from paddle_tpu.io.sampler import BatchSampler
 __all__ = ["DataLoader", "get_worker_info", "default_collate_fn"]
 
 _worker_info = threading.local()
+_RING_SEQ = 0
 
 
 class WorkerInfo:
@@ -53,13 +54,43 @@ def default_collate_fn(batch):
 
 
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
-                 num_workers, seed):
+                 num_workers, seed, ring_name=None):
     """ref: fluid/dataloader/worker.py:266 _worker_loop. ``seed`` already
     incorporates the epoch so re-forked workers draw fresh augmentation
     randomness each epoch (ref derives a per-epoch base seed the same way).
-    """
+    With ``ring_name``, results go through the native shared-memory ring
+    (≙ _use_shared_memory) instead of the mp.Queue pipe."""
     _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed)
     np.random.seed((seed + wid) % (2**32))
+    ring = None
+    if ring_name is not None:
+        from paddle_tpu import native
+        from paddle_tpu.io.shm_transport import encode_msg
+        ring = native.ShmRingBuffer(ring_name, create=False)
+
+    def emit(batch_id, data, err):
+        if ring is None:
+            result_queue.put((batch_id, data, err))
+            return
+        msg = encode_msg(batch_id, data, err)
+        if len(msg) > ring.slot_size:
+            msg = encode_msg(
+                batch_id, None,
+                f"batch of {len(msg)} bytes exceeds shm slot "
+                f"({ring.slot_size}); raise DataLoader(shm_slot_bytes=...) "
+                f"or pass use_shared_memory=False")
+        # retry while the consumer stalls (first-step jit compilation can
+        # exceed any single timeout); BrokenPipeError = consumer closed the
+        # ring, daemon workers die with the parent if it crashes outright
+        while True:
+            try:
+                ring.push(msg, timeout=60.0)
+                return
+            except TimeoutError:
+                continue
+            except BrokenPipeError:
+                return
+
     while True:
         item = index_queue.get()
         if item is None:
@@ -67,9 +98,9 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
         batch_id, indices = item
         try:
             samples = [dataset[i] for i in indices]
-            result_queue.put((batch_id, collate_fn(samples), None))
+            emit(batch_id, collate_fn(samples), None)
         except Exception as e:  # propagate worker errors to the main proc
-            result_queue.put((batch_id, None, repr(e)))
+            emit(batch_id, None, repr(e))
 
 
 class DataLoader:
@@ -80,10 +111,12 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, seed=0):
+                 persistent_workers=False, seed=0, shm_slot_bytes=16 << 20):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.shm_slot_bytes = shm_slot_bytes
         self.prefetch_factor = max(prefetch_factor, 2)
         self.seed = seed
         self._epoch = 0
@@ -120,20 +153,45 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def _iter_multiprocess(self):
-        """ref: _DataLoaderIterMultiProcess (dataloader_iter.py:381)."""
+        """ref: _DataLoaderIterMultiProcess (dataloader_iter.py:381).
+        Results cross back via the native shared-memory ring when available
+        (≙ _use_shared_memory), else the mp.Queue pipe."""
+        import os
         ctx = mp.get_context("fork")
         index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         result_queue = ctx.Queue()
+        depth = self.num_workers * self.prefetch_factor
+
+        ring = None
+        ring_name = None
+        if self.use_shared_memory:
+            from paddle_tpu import native
+            if native.is_available():
+                global _RING_SEQ
+                _RING_SEQ += 1
+                # unique per iterator instance — two live iterators of one
+                # loader must not collide (create unlinks the old name)
+                ring_name = f"/ptdl_{os.getpid()}_{_RING_SEQ}"
+                ring = native.ShmRingBuffer(
+                    ring_name, nslots=max(4, min(depth, 8)),
+                    slot_size=self.shm_slot_bytes)
+
         workers = []
         for wid in range(self.num_workers):
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, index_queues[wid], result_queue,
                       self.collate_fn, wid, self.num_workers,
-                      self.seed + self._epoch * 7919),
+                      self.seed + self._epoch * 7919, ring_name),
                 daemon=True)
             w.start()
             workers.append(w)
+
+        def recv():
+            if ring is None:
+                return result_queue.get()
+            from paddle_tpu.io.shm_transport import decode_msg
+            return decode_msg(ring.pop(timeout=300.0))
 
         def shutdown():
             for q in index_queues:
@@ -145,33 +203,29 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            if ring is not None:
+                ring.close()
 
         atexit.register(shutdown)
         try:
             batches = list(self.batch_sampler)
             n = len(batches)
-            inflight = 0
             next_send = 0
-            # pre-fill
-            depth = self.num_workers * self.prefetch_factor
             reorder = {}
             next_yield = 0
             while next_send < min(depth, n):
                 index_queues[next_send % self.num_workers].put(
                     (next_send, batches[next_send]))
                 next_send += 1
-                inflight += 1
             while next_yield < n:
-                bid, data, err = result_queue.get()
+                bid, data, err = recv()
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed: {err}")
                 reorder[bid] = data
-                inflight -= 1
                 if next_send < n:
                     index_queues[next_send % self.num_workers].put(
                         (next_send, batches[next_send]))
                     next_send += 1
-                    inflight += 1
                 while next_yield in reorder:
                     yield reorder.pop(next_yield)
                     next_yield += 1

@@ -1,0 +1,49 @@
+"""Worker→loader batch transport over the native shared-memory ring.
+
+Reference analog: _use_shared_memory in
+fluid/dataloader/dataloader_iter.py:114,611 — batches cross the process
+boundary through shared memory instead of being pickled through a
+multiprocessing.Queue pipe. Arrays ride as pickle-5 out-of-band buffers, so
+encode is one memcpy into the ring slot and decode is zero-copy views over
+the popped bytes.
+"""
+
+import pickle
+import struct
+
+__all__ = ["encode_msg", "decode_msg"]
+
+_HDR = struct.Struct("<qI")  # batch_id, n_buffers
+
+
+def encode_msg(batch_id: int, payload, error: str = None) -> bytes:
+    buffers = []
+    body = pickle.dumps((payload, error), protocol=5,
+                        buffer_callback=buffers.append)
+    parts = [_HDR.pack(batch_id, len(buffers)),
+             struct.pack("<Q", len(body)), body]
+    for b in buffers:
+        raw = b.raw()
+        parts.append(struct.pack("<Q", raw.nbytes))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode_msg(data: bytes):
+    mv = memoryview(data)
+    batch_id, n_buffers = _HDR.unpack_from(mv, 0)
+    off = _HDR.size
+    (body_len,) = struct.unpack_from("<Q", mv, off)
+    off += 8
+    body = mv[off:off + body_len]
+    off += body_len
+    buffers = []
+    for _ in range(n_buffers):
+        (blen,) = struct.unpack_from("<Q", mv, off)
+        off += 8
+        # bytearray copy: arrays rebuilt over immutable bytes would be
+        # read-only, diverging from the (writable) mp.Queue path
+        buffers.append(bytearray(mv[off:off + blen]))
+        off += blen
+    payload, error = pickle.loads(body, buffers=buffers)
+    return batch_id, payload, error

@@ -1,0 +1,165 @@
+"""Native C++ runtime tests: shm ring buffer, TCP store, DataLoader shm
+transport (≙ reference C++ unit tests for mmap_allocator / tcp_store and
+the multiprocess DataLoader suites)."""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.is_available(),
+                                reason="native toolchain unavailable")
+
+
+def test_ring_roundtrip_and_order():
+    rb = native.ShmRingBuffer(f"/ptt_{os.getpid()}_a", nslots=4,
+                              slot_size=1 << 16)
+    try:
+        for i in range(10):  # wraps the 4-slot ring
+            rb.push(f"msg{i}".encode())
+            assert rb.pop() == f"msg{i}".encode()
+        rb.push(b"x" * 100)
+        rb.push(b"y" * 200)
+        assert len(rb) == 2
+        assert rb.pop() == b"x" * 100
+        assert rb.pop() == b"y" * 200
+    finally:
+        rb.close()
+
+
+def test_ring_rejects_oversize():
+    rb = native.ShmRingBuffer(f"/ptt_{os.getpid()}_b", nslots=2,
+                              slot_size=64)
+    try:
+        with pytest.raises(ValueError, match="slot"):
+            rb.push(b"z" * 100)
+    finally:
+        rb.close()
+
+
+def _producer(name, n):
+    rb = native.ShmRingBuffer(name, create=False)
+    for i in range(n):
+        rb.push(np.full((100,), i, np.int64).tobytes())
+    rb.close_producer()
+
+
+def test_ring_cross_process():
+    name = f"/ptt_{os.getpid()}_c"
+    rb = native.ShmRingBuffer(name, nslots=4, slot_size=1 << 13)
+    try:
+        p = mp.get_context("fork").Process(target=_producer, args=(name, 20))
+        p.start()
+        got = []
+        while True:
+            try:
+                data = rb.pop(timeout=10.0)
+            except EOFError:
+                break
+            got.append(int(np.frombuffer(data, np.int64)[0]))
+        p.join()
+        assert got == list(range(20))
+    finally:
+        rb.close()
+
+
+def test_tcp_store_rendezvous():
+    master = native.TCPStore(is_master=True)
+    try:
+        c1 = native.TCPStore(port=master.port)
+        c2 = native.TCPStore(port=master.port)
+        # barrier via add
+        assert c1.add("arrived", 1) == 1
+        assert c2.add("arrived", 1) == 2
+        c1.set("rank0/addr", b"10.0.0.1:1234")
+        assert c2.get("rank0/addr") == b"10.0.0.1:1234"
+        with pytest.raises(TimeoutError):
+            c2.get("never", timeout=0.3)
+        c2.delete_key("rank0/addr")
+        with pytest.raises(TimeoutError):
+            c1.get("rank0/addr", timeout=0.3)
+        c1.close()
+        c2.close()
+    finally:
+        master.close()
+
+
+def _store_rank(port, rank, results):
+    s = native.TCPStore(port=port)
+    s.set(f"rank{rank}", str(rank).encode())
+    # wait for the other rank's key (cross-process blocking get)
+    other = 1 - rank
+    results.put((rank, s.get(f"rank{other}", timeout=10.0)))
+    s.close()
+
+
+def test_tcp_store_cross_process_wait():
+    master = native.TCPStore(is_master=True)
+    try:
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        ps = [ctx.Process(target=_store_rank, args=(master.port, r, q))
+              for r in range(2)]
+        for p in ps:
+            p.start()
+        got = dict(q.get(timeout=15) for _ in range(2))
+        for p in ps:
+            p.join()
+        assert got == {0: b"1", 1: b"0"}
+    finally:
+        master.close()
+
+
+def test_dataloader_shm_transport_matches_queue():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Ds(Dataset):
+        def __len__(self):
+            return 37
+
+        def __getitem__(self, i):
+            return (np.full((4, 5), i, np.float32), np.int64(i % 7))
+
+    def collect(**kw):
+        dl = DataLoader(Ds(), batch_size=5, num_workers=2, shuffle=False,
+                        **kw)
+        return [(x.copy(), y.copy()) for x, y in dl]
+
+    shm = collect(use_shared_memory=True)
+    q = collect(use_shared_memory=False)
+    assert len(shm) == len(q) == 8
+    for (xa, ya), (xb, yb) in zip(shm, q):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_dataloader_shm_oversize_batch_errors_cleanly():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Big(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.zeros((1 << 16,), np.float32)  # 256KB each
+
+    dl = DataLoader(Big(), batch_size=4, num_workers=1,
+                    use_shared_memory=True, shm_slot_bytes=1 << 16)
+    with pytest.raises(RuntimeError, match="shm slot"):
+        list(dl)
+
+
+def test_shm_transport_codec():
+    from paddle_tpu.io.shm_transport import decode_msg, encode_msg
+    payload = {"x": np.arange(12).reshape(3, 4),
+               "y": [np.ones(3, np.float16), "label"]}
+    bid, out, err = decode_msg(encode_msg(7, payload))
+    assert bid == 7 and err is None
+    np.testing.assert_array_equal(out["x"], payload["x"])
+    np.testing.assert_array_equal(out["y"][0], payload["y"][0])
+    assert out["y"][1] == "label"
