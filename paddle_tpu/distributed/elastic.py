@@ -1,0 +1,89 @@
+"""Elastic membership manager over the native TCPStore.
+
+Reference analog: fleet/elastic/manager.py:128 ElasticManager — ranks
+register in etcd with a TTL'd heartbeat; when a node joins/leaves, the
+manager kills the local trainer group (SIGTERM, manager.py:66) and the
+launcher relaunches with the new membership. Here the etcd plane is the
+framework's own C++ TCPStore and the relaunch is
+``launch.py --max_restarts`` / a user callback.
+"""
+
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["ElasticManager", "ELASTIC_TTL"]
+
+ELASTIC_TTL = 60  # seconds, ≙ manager.py:39
+
+
+class ElasticManager:
+    """Heartbeat + peer-liveness watcher.
+
+    store: a connected paddle_tpu.native.TCPStore client.
+    on_change(dead_ranks) fires (once per membership change) when a peer's
+    heartbeat goes stale — typically: kill local workers and exit with
+    ELASTIC_EXIT_CODE so the launcher relaunches.
+    """
+
+    def __init__(self, store, rank: int, world_size: int,
+                 ttl: float = ELASTIC_TTL, interval: Optional[float] = None,
+                 on_change: Optional[Callable] = None):
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.ttl = ttl
+        self.interval = interval if interval is not None else max(
+            0.05, ttl / 3)
+        self.on_change = on_change
+        self._stop = threading.Event()
+        self._threads = []
+        self._reported = set()
+
+    def _hb_key(self, rank):
+        return f"elastic/hb/{rank}"
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self.store.set(self._hb_key(self.rank), str(time.time()))
+            self._stop.wait(self.interval)
+
+    def _watch_loop(self):
+        # wait for everyone to register once before judging liveness
+        for r in range(self.world_size):
+            if self._stop.is_set():
+                return
+            try:
+                self.store.get(self._hb_key(r), timeout=self.ttl)
+            except TimeoutError:
+                pass
+        while not self._stop.is_set():
+            now = time.time()
+            dead = []
+            for r in range(self.world_size):
+                if r == self.rank:
+                    continue
+                try:
+                    ts = float(self.store.get(self._hb_key(r), timeout=1.0))
+                except (TimeoutError, ValueError):
+                    ts = 0.0
+                if now - ts > self.ttl:
+                    dead.append(r)
+            fresh = [r for r in dead if r not in self._reported]
+            if fresh and self.on_change is not None:
+                self._reported.update(fresh)
+                self.on_change(sorted(fresh))
+            self._stop.wait(self.interval)
+
+    def start(self):
+        for fn in (self._heartbeat_loop, self._watch_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads = []

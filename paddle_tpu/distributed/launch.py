@@ -1,0 +1,144 @@
+"""Multi-process / multi-host job launcher CLI.
+
+Reference analog: ``python -m paddle.distributed.launch``
+(launch/main.py:18 → CollectiveController controllers/collective.py:21):
+build the env contract per rank, spawn workers, tail logs, watch children,
+relaunch on failure (elastic, ≙ CollectiveElasticController :184 /
+ElasticManager fleet/elastic/manager.py:128 — etcd replaced by the native
+TCPStore).
+
+Usage:
+    python -m paddle_tpu.distributed.launch \
+        --nproc_per_node 1 --nnodes 2 --node_rank 0 \
+        --master 10.0.0.1:8765 train.py --lr 1e-4
+
+Env contract written for each worker (read by env.init_parallel_env):
+    PT_COORDINATOR     jax.distributed coordinator "host:port"
+    PT_NUM_PROCESSES   total worker processes across nodes
+    PT_PROCESS_ID      global rank of this worker
+    PT_LOCAL_RANK      rank within this node
+    PT_NNODES          node count
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+__all__ = ["launch", "main"]
+
+ELASTIC_EXIT_CODE = 101  # ≙ fleet/elastic/manager.py:32
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a (multi-host) training job")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", default="127.0.0.1:8765",
+                   help="host:port of the jax.distributed coordinator "
+                        "(process 0)")
+    p.add_argument("--log_dir", default=None,
+                   help="write per-rank workerlog.N files here")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="relaunch the local group this many times on "
+                        "worker failure (elastic)")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn(args, local_rank):
+    world = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env = dict(os.environ)
+    env.update({
+        "PT_COORDINATOR": args.master,
+        "PT_NUM_PROCESSES": str(world),
+        "PT_PROCESS_ID": str(rank),
+        "PT_LOCAL_RANK": str(local_rank),
+        "PT_NNODES": str(args.nnodes),
+    })
+    cmd = [sys.executable, args.training_script,
+           *args.training_script_args]
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        logf = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "ab")
+        stdout = stderr = logf
+    elif local_rank == 0:
+        logf = None
+        stdout = stderr = None  # inherit: rank 0 streams to console
+    else:
+        logf = open(os.devnull, "wb")
+        stdout = stderr = logf
+    proc = subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr,
+                            start_new_session=True)
+    proc._pt_logf = logf
+    proc._pt_rank = rank
+    return proc
+
+
+def _kill_group(procs):
+    for p in procs:
+        if p.poll() is None:
+            try:
+                os.killpg(p.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+    deadline = time.time() + 5
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+    for p in procs:
+        if p._pt_logf:
+            p._pt_logf.close()
+
+
+def _watch(procs, poll_s=0.2):
+    """Block until all exit 0 (return 0) or any fails (kill rest, return
+    its code). ≙ ControllerBase.watch (launch/controllers/controller.py:34)."""
+    while True:
+        alive = False
+        for p in procs:
+            rc = p.poll()
+            if rc is None:
+                alive = True
+            elif rc != 0:
+                _kill_group(procs)
+                return rc
+        if not alive:
+            return 0
+        time.sleep(poll_s)
+
+
+def launch(argv):
+    args = _parse(argv)
+    attempt = 0
+    while True:
+        procs = [_spawn(args, i) for i in range(args.nproc_per_node)]
+        rc = _watch(procs)
+        if rc == 0:
+            return 0
+        attempt += 1
+        if attempt > args.max_restarts:
+            return rc
+        print(f"[launch] worker failed rc={rc}; restart "
+              f"{attempt}/{args.max_restarts}", file=sys.stderr)
+
+
+def main():
+    sys.exit(launch(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

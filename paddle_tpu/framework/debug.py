@@ -1,0 +1,61 @@
+"""Numeric debugging: NaN/Inf sweeps (SURVEY §5.2).
+
+Reference analog: FLAGS_check_nan_inf sweeping op outputs —
+framework/details/nan_inf_utils_detail.{cc,cu} (static graph) and
+eager/nan_inf_utils.cc (eager). Under XLA there is no per-op boundary to
+hook, so the sweep runs at the program boundary (loss/grads/params after a
+step): enable with ``pt.set_flags({"check_nan_inf": True})`` — hapi's
+train_batch sweeps automatically — or call ``check_nan_inf`` directly.
+"""
+
+from typing import Any, Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["nan_inf_stats", "check_nan_inf", "enabled"]
+
+
+def enabled() -> bool:
+    from paddle_tpu import flags
+    return bool(flags.get_flag("check_nan_inf"))
+
+
+def _named_leaves(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _named_leaves(tree[k], f"{prefix}{k}.")
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _named_leaves(v, f"{prefix}{i}.")
+    elif tree is None:
+        return
+    else:
+        yield prefix.rstrip("."), tree
+
+
+def nan_inf_stats(tree) -> Dict[str, Any]:
+    """jit-safe: {leaf name: count of non-finite values} (all names; zeros
+    mean clean). Floating leaves only."""
+    out = {}
+    for name, leaf in _named_leaves(tree):
+        x = jnp.asarray(leaf)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            continue
+        out[name] = jnp.sum(~jnp.isfinite(x.astype(jnp.float32)))
+    return out
+
+
+def check_nan_inf(tree, label: str = "tensors"):
+    """Eager sweep; raises FloatingPointError naming the offending leaves
+    (≙ the reference's enforce on first NaN/Inf op output)."""
+    stats = jax.device_get(nan_inf_stats(tree))
+    bad = {k: int(v) for k, v in stats.items() if v > 0}
+    if bad:
+        detail = ", ".join(f"{k} ({v} non-finite)"
+                           for k, v in sorted(bad.items())[:8])
+        more = f" and {len(bad) - 8} more" if len(bad) > 8 else ""
+        raise FloatingPointError(
+            f"NaN/Inf detected in {label}: {detail}{more}")
+    return tree

@@ -152,6 +152,10 @@ class Model:
                     total, self._opt_state, self._params)
         if updates:
             self.network = self.network.apply_updates(updates)
+        from paddle_tpu.framework import debug as _dbg
+        if _dbg.enabled():  # ≙ FLAGS_check_nan_inf per-step sweep
+            _dbg.check_nan_inf({"loss": loss, "params": self._params},
+                               label="train step outputs")
         metrics = [float(loss)]
         for m in self._metrics:
             res = m.compute(np.asarray(out), np.asarray(y))

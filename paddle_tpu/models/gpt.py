@@ -427,7 +427,8 @@ def unstack_blocks(stacked, n_layers: int):
             for i in range(n_layers)]
 
 
-def pipelined_apply(stacked_blocks, x_mb, n_stages: int):
+def pipelined_apply(stacked_blocks, x_mb, n_stages: int,
+                    remat_stages: bool = False):
     """GPipe schedule as a rolling buffer over a 'pp'-sharded stage axis.
 
     x_mb: (n_micro, mb, seq, d) microbatched activations (post-embedding).
@@ -440,6 +441,12 @@ def pipelined_apply(stacked_blocks, x_mb, n_stages: int):
     the bubble is the same as the reference's 1F1B warmup/cooldown
     (pipeline_parallel.py:117). Backward is jax.grad through the scan — the
     reversed schedule the reference hand-codes.
+
+    remat_stages=True checkpoints each stage's compute, so the backward
+    holds only per-tick stage BOUNDARY activations instead of every
+    intermediate — the memory profile that motivates the reference's 1F1B
+    over GPipe, achieved here with rematerialization instead of schedule
+    reordering (in one XLA program the compiler owns the schedule).
     """
     global _PIPELINE_DEPTH
     n_micro = x_mb.shape[0]
@@ -450,6 +457,9 @@ def pipelined_apply(stacked_blocks, x_mb, n_stages: int):
             return blk(hh), None
         h, _ = lax.scan(body, h, blocks_one_stage)
         return h
+
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
 
     vstage = jax.vmap(stage_fn)
 
@@ -488,7 +498,8 @@ def pipeline_partition_spec(path: str) -> P:
 
 
 def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,
-                               n_stages: int, n_micro: int):
+                               n_stages: int, n_micro: int,
+                               remat_stages: bool = False):
     """Full hybrid dp×fsdp×tp×sp×pp train step (≙ §3.4 call stack:
     fleet.distributed_model + train_batch + HybridParallelOptimizer.step,
     all fused into one XLA program)."""
@@ -501,7 +512,8 @@ def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,
             m = model.merge_params(emb_p)
             x = m.embed(tokens.reshape(nm * mb, s))
             x = x.reshape(nm, mb, s, -1)
-            x = pipelined_apply(blocks_p, x, n_stages)
+            x = pipelined_apply(blocks_p, x, n_stages,
+                                remat_stages=remat_stages)
             logits = m.head(x.reshape(nm * mb, s, -1))
             return lm_loss(logits, tokens.reshape(nm * mb, s))
 

@@ -156,3 +156,33 @@ class TestShardedTrainStep:
         _, _, loss_sharded = step2(params2, st2, toks, rng)
         np.testing.assert_allclose(float(loss_single),
                                    float(loss_sharded), rtol=1e-5)
+
+
+def test_pipelined_remat_stages_matches_no_remat():
+    """remat_stages changes memory, not math: identical loss trajectory."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.models import gpt
+
+    topo = dist.init_mesh(pp=2, dp=4)
+    cfg = gpt.gpt_tiny(max_seq_len=16, n_layers=4, dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (4, 4, 16)), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+
+    losses = {}
+    for remat in (False, True):
+        model = gpt.GPT(cfg, seed=0)
+        opt = optim.AdamW(learning_rate=1e-3)
+        emb_p, stacked, st = gpt.init_pipelined_state(model, opt,
+                                                      topo.mesh, 2)
+        step = gpt.build_pipelined_train_step(model, opt, topo.mesh, 2, 4,
+                                              remat_stages=remat)
+        for i in range(2):
+            emb_p, stacked, st, loss = step(emb_p, stacked, st, tokens,
+                                            jax.random.fold_in(rng, i))
+        losses[remat] = float(loss)
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
