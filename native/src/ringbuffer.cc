@@ -161,7 +161,10 @@ int ptrb_push(void* handle, const void* data, uint64_t len,
   abs_deadline(timeout_s, &dl);
   if (lock_robust(h) != 0) return -4;
   while (h->count == h->nslots && !h->closed) {
-    if (pthread_cond_timedwait(&h->not_full, &h->mu, &dl) == ETIMEDOUT) {
+    int rc = pthread_cond_timedwait(&h->not_full, &h->mu, &dl);
+    if (rc == EOWNERDEAD) {  // lock reacquired after owner died mid-wait
+      pthread_mutex_consistent(&h->mu);
+    } else if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
     }
@@ -192,7 +195,10 @@ int64_t ptrb_pop(void* handle, void* out, uint64_t out_cap,
       pthread_mutex_unlock(&h->mu);
       return -3;
     }
-    if (pthread_cond_timedwait(&h->not_empty, &h->mu, &dl) == ETIMEDOUT) {
+    int rc = pthread_cond_timedwait(&h->not_empty, &h->mu, &dl);
+    if (rc == EOWNERDEAD) {  // lock reacquired after owner died mid-wait
+      pthread_mutex_consistent(&h->mu);
+    } else if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
     }

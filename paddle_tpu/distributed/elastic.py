@@ -44,9 +44,26 @@ class ElasticManager:
         return f"elastic/hb/{rank}"
 
     def _heartbeat_loop(self):
+        # monotonically increasing counter (store.add), NOT a wall-clock
+        # timestamp: peers judge staleness by lack of counter *progress*
+        # against their own local clock, so cross-host clock skew cannot
+        # produce false dead-peer events (ADVICE r1).
         while not self._stop.is_set():
-            self.store.set(self._hb_key(self.rank), str(time.time()))
+            self.store.add(self._hb_key(self.rank), 1)
             self._stop.wait(self.interval)
+
+    def _counter(self, rank):
+        try:
+            raw = self.store.get(self._hb_key(rank), timeout=1.0)
+        except (TimeoutError, ValueError):
+            return None
+        # store.add keeps counters as raw little-endian int64
+        if len(raw) == 8:
+            return int.from_bytes(raw, "little", signed=True)
+        try:
+            return int(raw)
+        except ValueError:
+            return None
 
     def _watch_loop(self):
         # wait for everyone to register once before judging liveness
@@ -57,17 +74,24 @@ class ElasticManager:
                 self.store.get(self._hb_key(r), timeout=self.ttl)
             except TimeoutError:
                 pass
+        # last observed (counter, local time of last progress) per rank
+        seen = {}
         while not self._stop.is_set():
-            now = time.time()
+            now = time.monotonic()
             dead = []
             for r in range(self.world_size):
                 if r == self.rank:
                     continue
-                try:
-                    ts = float(self.store.get(self._hb_key(r), timeout=1.0))
-                except (TimeoutError, ValueError):
-                    ts = 0.0
-                if now - ts > self.ttl:
+                c = self._counter(r)
+                prev = seen.get(r)
+                if prev is None or (c is not None and c != prev[0]):
+                    seen[r] = (c, now)
+                    # heartbeat resumed → eligible for re-reporting if it
+                    # dies again after a recovery (ADVICE r1)
+                    if c is not None:
+                        self._reported.discard(r)
+                    continue
+                if now - prev[1] > self.ttl:
                     dead.append(r)
             fresh = [r for r in dead if r not in self._reported]
             if fresh and self.on_change is not None:

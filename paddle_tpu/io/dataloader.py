@@ -12,6 +12,7 @@ blocking-queue reader ops play. A C++ shared-memory transport
 import atexit
 import itertools
 import multiprocessing as mp
+import os
 import queue
 import threading
 from typing import Any, Callable, Optional
@@ -62,6 +63,7 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
     (≙ _use_shared_memory) instead of the mp.Queue pipe."""
     _worker_info.info = WorkerInfo(wid, num_workers, dataset, seed)
     np.random.seed((seed + wid) % (2**32))
+    _parent_pid = os.getppid()  # the consumer process that forked us
     ring = None
     if ring_name is not None:
         from paddle_tpu import native
@@ -81,18 +83,33 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
                 f"or pass use_shared_memory=False")
         # retry while the consumer stalls (first-step jit compilation can
         # exceed any single timeout); BrokenPipeError = consumer closed the
-        # ring, daemon workers die with the parent if it crashes outright
+        # ring. If the consumer was SIGKILLed its atexit/finally never ran
+        # and we are reparented — unlink the shm segment and die rather than
+        # spin forever leaking /dev/shm until reboot (ADVICE r1).
         while True:
             try:
                 ring.push(msg, timeout=60.0)
                 return
             except TimeoutError:
+                if os.getppid() != _parent_pid:  # consumer died (SIGKILL)
+                    ring.close(unlink=True)
+                    os._exit(1)
                 continue
             except BrokenPipeError:
                 return
 
     while True:
-        item = index_queue.get()
+        # bounded get + reparent check: an idle worker whose consumer was
+        # SIGKILLed must notice (fork-inherited queue write-ends keep the
+        # blocking get alive forever) and release the shm ring.
+        try:
+            item = index_queue.get(timeout=5.0)
+        except queue.Empty:
+            if os.getppid() != _parent_pid:
+                if ring is not None:
+                    ring.close(unlink=True)
+                os._exit(1)
+            continue
         if item is None:
             break
         batch_id, indices = item
@@ -156,7 +173,6 @@ class DataLoader:
         """ref: _DataLoaderIterMultiProcess (dataloader_iter.py:381).
         Results cross back via the native shared-memory ring when available
         (≙ _use_shared_memory), else the mp.Queue pipe."""
-        import os
         ctx = mp.get_context("fork")
         index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         result_queue = ctx.Queue()

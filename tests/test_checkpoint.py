@@ -128,3 +128,40 @@ def test_auto_checkpoint_resume(tmp_path):
     assert restored["epoch"] == 2
     # keep=2 pruned epoch_0
     assert sorted(ck2._epochs_on_disk()) == [1, 2]
+
+
+def test_missing_shard_raises(tmp_path):
+    """ADVICE r1: a deleted/partial shard file must raise, never restore
+    uninitialized-memory garbage."""
+    import os
+    save_state({"x": jnp.arange(8.0)}, str(tmp_path / "ck"))
+    data_dir = tmp_path / "ck" / "data"
+    for f in os.listdir(data_dir):
+        os.unlink(data_dir / f)
+    with pytest.raises(ValueError, match="missing"):
+        load_state(str(tmp_path / "ck"))
+
+
+def test_incomplete_coverage_raises(tmp_path):
+    """Shards present but not covering the full array must raise."""
+    import json
+    save_state({"x": jnp.arange(8.0)}, str(tmp_path / "ck"))
+    mp = tmp_path / "ck" / "meta.json"
+    meta = json.loads(mp.read_text())
+    (name, entry), = meta["arrays"].items()
+    # shrink the recorded range so the saved shard no longer covers [0,8)
+    entry["shards"][0]["range"] = [[0, 4]]
+    mp.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="do not cover"):
+        load_state(str(tmp_path / "ck"))
+
+
+def test_boxes_cover_unit():
+    from paddle_tpu.distributed.checkpoint import _boxes_cover
+    t = [(0, 8), (0, 4)]
+    assert _boxes_cover([((0, 8), (0, 4))], t)
+    assert _boxes_cover([((0, 4), (0, 4)), ((4, 8), (0, 4))], t)
+    assert not _boxes_cover([((0, 4), (0, 4))], t)
+    # partial overlap → coordinate-compression path
+    assert _boxes_cover([((0, 6), (0, 4)), ((3, 8), (0, 4))], t)
+    assert not _boxes_cover([((0, 6), (0, 4)), ((3, 8), (0, 3))], t)
