@@ -1,8 +1,13 @@
-"""Driver benchmark: flagship GPT train-step throughput on one chip.
+"""Driver benchmark: flagship model train-step throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 The reference publishes no in-repo numbers (BASELINE.md), so vs_baseline is
 achieved MFU / 0.35 — the BASELINE.json north-star MFU target.
+
+MFU accounting (VERDICT r1 item 1): model FLOPs = analytic 6N + attention
+(GPTConfig.flops_per_token) with NO remat credit — recomputed FLOPs are not
+useful work. The XLA cost-analysis FLOPs (which DO include rematerialized
+compute) are reported alongside in "extra" as hardware utilization.
 """
 
 import json
@@ -22,28 +27,37 @@ def _peak_flops(device) -> float:
     return 197e12  # default: v5e bf16 peak
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
+def _sync(x):
+    # NB: fetch a scalar to synchronize — on the tunneled PJRT backend
+    # block_until_ready does not actually block.
+    return float(x)
+
+
+def bench_gpt(jax, jnp, peak):
+    """GPT-3 1.3B (north-star config) single-chip train step; falls back to
+    350M when HBM is too small."""
     from paddle_tpu import optimizer as optim
     from paddle_tpu.models import gpt
 
-    backend = jax.default_backend()
-    on_tpu = backend not in ("cpu",)
+    on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
-        trials = [(gpt.gpt3_350m(max_seq_len=1024, remat=True), 16),
-                  (gpt.gpt3_350m(max_seq_len=1024, remat=True), 8),
-                  (gpt.gpt3_125m(max_seq_len=1024, remat=True), 8)]
+        # 1.3B on 16GB HBM: bf16 Adam moments + remat + donation
+        trials = [("gpt_1p3b", gpt.gpt3_1p3b(remat=True), 4),
+                  ("gpt_350m", gpt.gpt3_350m(max_seq_len=1024, remat=True),
+                   16),
+                  ("gpt_125m", gpt.gpt3_125m(max_seq_len=1024, remat=True),
+                   8)]
         warmup, iters = 3, 10
     else:
-        trials = [(gpt.gpt_tiny(), 4)]
-        warmup, iters = 2, 5
+        trials = [("gpt_tiny", gpt.gpt_tiny(), 4)]
+        warmup, iters = 2, 3
 
     last_err = None
-    for cfg, batch in trials:
+    for name, cfg, batch in trials:
         try:
             model = gpt.GPT(cfg, seed=0)
-            opt = optim.AdamW(learning_rate=1e-4, weight_decay=0.01)
+            opt = optim.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                              moment_dtype=jnp.bfloat16)
             params, opt_state = gpt.init_train_state(model, opt)
             step = gpt.build_train_step(model, opt)
             tokens = jnp.asarray(
@@ -51,42 +65,208 @@ def main():
                     0, cfg.vocab_size, (batch, cfg.max_seq_len)), jnp.int32)
             rng = jax.random.PRNGKey(0)
 
+            # AOT-compile once; the same executable serves cost analysis
+            # and the timed loop (a second trace/compile would double the
+            # tunnel-side compile cost)
+            compiled = step.lower(params, opt_state, tokens, rng).compile()
+            try:
+                hw_flops = compiled.cost_analysis().get("flops", 0.0)
+            except Exception:
+                hw_flops = 0.0
+            step = compiled
+
             for _ in range(warmup):
                 params, opt_state, loss = step(params, opt_state, tokens,
                                                rng)
-            # NB: fetch a scalar to synchronize — on the tunneled PJRT
-            # backend block_until_ready does not actually block.
-            float(loss)
+            _sync(loss)
 
             t0 = time.perf_counter()
             for _ in range(iters):
                 params, opt_state, loss = step(params, opt_state, tokens,
                                                rng)
-            float(loss)
+            _sync(loss)
             dt = (time.perf_counter() - t0) / iters
 
             tokens_per_sec = batch * cfg.max_seq_len / dt
-            flops = cfg.flops_per_token() * tokens_per_sec
-            if cfg.remat:
-                flops *= 8.0 / 6.0  # recompute adds ~1 extra forward
-            mfu = flops / _peak_flops(jax.devices()[0])
-            print(json.dumps({
-                "metric": "gpt_350m_tokens_per_sec_per_chip"
-                          if cfg.d_model >= 1024 else
-                          ("gpt_125m_tokens_per_sec_per_chip"
-                           if cfg.d_model >= 768 else
-                           "gpt_tiny_tokens_per_sec_cpu"),
+            mfu = cfg.flops_per_token() * tokens_per_sec / peak
+            bench_gpt.model = model  # reused by bench_decode (params
+            # already resident on the chip — the tunnel transfer is slow)
+            return {
+                "metric": f"{name}_tokens_per_sec_per_chip",
                 "value": round(tokens_per_sec, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / 0.35, 4),
-            }))
-            return 0
+                "extra": {
+                    "mfu_model_flops": round(mfu, 4),
+                    "hw_util_cost_analysis": round(hw_flops / dt / peak, 4)
+                    if hw_flops else None,
+                    "step_ms": round(dt * 1e3, 2),
+                    "batch": batch,
+                    "seq": cfg.max_seq_len,
+                },
+            }
         except Exception as e:  # OOM etc. → try next config
             last_err = e
             continue
-    print(json.dumps({"metric": "bench_failed", "value": 0, "unit": "",
-                      "vs_baseline": 0, "error": str(last_err)[:200]}))
-    return 1
+    return {"metric": "bench_failed", "value": 0, "unit": "",
+            "vs_baseline": 0, "error": str(last_err)[:200]}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    t_start = time.perf_counter()
+
+    def mark(msg):
+        print(f"[bench +{time.perf_counter() - t_start:.0f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    peak = _peak_flops(jax.devices()[0])
+    mark("start gpt")
+    result = bench_gpt(jax, jnp, peak)
+    mark(f"gpt done: {result.get('metric')}")
+
+    # stay inside the driver's bench budget: skip sub-benches once the
+    # clock runs long (the headline metric is already secured)
+    budget = float(__import__("os").environ.get("PT_BENCH_BUDGET_S", 480))
+    extra = result.setdefault("extra", {})
+    for sub in (bench_decode, bench_bert, bench_resnet50):
+        if time.perf_counter() - t_start > budget:
+            extra[sub.__name__ + "_skipped"] = "bench budget exhausted"
+            continue
+        try:
+            extra.update(sub(jax, jnp, peak))
+        except Exception as e:
+            extra[sub.__name__ + "_error"] = str(e)[:120]
+        mark(f"{sub.__name__} done")
+
+    print(json.dumps(result))
+    return 0 if result["metric"] != "bench_failed" else 1
+
+
+def bench_resnet50(jax, jnp, peak):
+    """ResNet50 train step: imgs/sec + hardware utilization (BASELINE.md
+    conv/BN row). BN buffers update through the stateful context."""
+    if jax.default_backend() in ("cpu",):
+        return {}
+    from paddle_tpu import nn, optimizer as optim
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    net = resnet50(num_classes=1000).tag_paths()
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                         weight_decay=1e-4)
+    params, buffers = net.split_params()
+    params = {k: v.astype(jnp.bfloat16)
+              if jnp.issubdtype(v.dtype, jnp.floating) and v.ndim == 4
+              else v for k, v in params.items()}
+    opt_state = opt.init(params)
+
+    def step(params, opt_state, buffers, x, y, key):
+        def loss_fn(p):
+            model = net.merge_params({**buffers, **p})
+            with nn.stateful(training=True, rng=key) as ctx:
+                out = model(x)
+                loss = F.cross_entropy(out.astype(jnp.float32), y)
+            return loss, ctx.updates
+        (loss, updates), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_state = opt.update(grads, opt_state, params)
+        return new_params, new_state, updates, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    batch = 256
+    x = jnp.asarray(np.random.RandomState(0).rand(
+        batch, 3, 224, 224), jnp.bfloat16)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)),
+                    jnp.int32)
+    key = jax.random.PRNGKey(0)
+    compiled = jstep.lower(params, opt_state, buffers, x, y, key).compile()
+    try:
+        hw_flops = compiled.cost_analysis().get("flops", 0.0)
+    except Exception:
+        hw_flops = 0.0
+    for _ in range(2):
+        params, opt_state, buffers_u, loss = compiled(
+            params, opt_state, buffers, x, y, key)
+        buffers = {**buffers, **buffers_u}
+    _sync(loss)
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        params, opt_state, buffers_u, loss = compiled(
+            params, opt_state, buffers, x, y, key)
+    _sync(loss)
+    dt = (time.perf_counter() - t0) / iters
+    return {"resnet50_imgs_per_sec": round(batch / dt, 1),
+            "resnet50_hw_util": round(hw_flops / dt / peak, 4)
+            if hw_flops else None,
+            "resnet50_batch": batch}
+
+
+def bench_bert(jax, jnp, peak):
+    """BERT-base MLM pretrain step tokens/s/chip + MFU (BASELINE.md
+    transformer/AMP row)."""
+    if jax.default_backend() in ("cpu",):
+        return {}
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.models import bert
+
+    cfg = bert.bert_base(max_position=512, dropout=0.0)
+    model = bert.BertForPretraining(cfg, seed=0)
+    opt = optim.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                      moment_dtype=jnp.bfloat16)
+    params, opt_state = bert.init_train_state(model, opt)
+    step = bert.build_pretrain_step(model, opt)
+    b, s = 32, 512
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+    type_ids = jnp.zeros((b, s), jnp.int32)
+    attn = jnp.ones((b, s), jnp.int32)
+    labels = jnp.asarray(
+        np.where(rs.rand(b, s) < 0.15,
+                 rs.randint(0, cfg.vocab_size, (b, s)), -100), jnp.int32)
+    nsp = jnp.asarray(rs.randint(0, 2, (b,)), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    args = (tokens, type_ids, attn, labels, nsp, rng)
+    compiled = step.lower(params, opt_state, *args).compile()
+    for _ in range(2):
+        params, opt_state, loss = compiled(params, opt_state, *args)
+    _sync(loss)
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        params, opt_state, loss = compiled(params, opt_state, *args)
+    _sync(loss)
+    dt = (time.perf_counter() - t0) / iters
+    tps = b * s / dt
+    mfu = cfg.flops_per_token() * tps / peak
+    return {"bert_base_tokens_per_sec_per_chip": round(tps, 1),
+            "bert_base_mfu": round(mfu, 4)}
+
+
+def bench_decode(jax, jnp, peak):
+    """KV-cache autoregressive decode throughput (serving path). Reuses the
+    train bench's model so the 2.6GB param transfer over the tunnel is not
+    paid twice."""
+    model = getattr(bench_gpt, "model", None)
+    if model is None or jax.default_backend() in ("cpu",):
+        return {}
+    cfg = model.cfg
+    b, s0, new = 8, 128, 64
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (b, s0)),
+        jnp.int32)
+    out = model.generate(tokens, max_new_tokens=new, max_len=s0 + new)
+    _sync(out[0, -1])  # warm/compile
+    t0 = time.perf_counter()
+    out = model.generate(tokens, max_new_tokens=new, max_len=s0 + new)
+    _sync(out[0, -1])
+    dt = time.perf_counter() - t0
+    name = "1p3b" if cfg.d_model >= 2048 else "gpt"
+    return {f"decode_{name}_tokens_per_sec": round(b * new / dt, 1),
+            "decode_batch": b, "decode_prefill": s0, "decode_new": new}
 
 
 if __name__ == "__main__":

@@ -29,6 +29,10 @@ from paddle_tpu.distributed.sharding import (
     init_group_sharded_state, GroupShardedSpecs)
 from paddle_tpu.distributed.checkpoint import (
     save_state, load_state, AutoCheckpoint)
+from paddle_tpu.distributed.mp_ops import (
+    parallel_cross_entropy, vocab_parallel_embedding, axis_rng_key)
+from paddle_tpu.distributed.recompute import (
+    recompute, recompute_sequential, checkpoint_name)
 from paddle_tpu.native import TCPStore  # ≙ fluid.core.TCPStore (C++)
 
 __all__ = ["env", "mesh", "collective", "init_parallel_env", "get_rank",
@@ -41,4 +45,7 @@ __all__ = ["env", "mesh", "collective", "init_parallel_env", "get_rank",
            "sequence_parallel_attention", "group_sharded_parallel",
            "group_sharded_specs", "build_group_sharded_step",
            "init_group_sharded_state", "GroupShardedSpecs", "save_state",
-           "load_state", "AutoCheckpoint", "TCPStore"]
+           "load_state", "AutoCheckpoint", "TCPStore",
+           "parallel_cross_entropy", "vocab_parallel_embedding",
+           "axis_rng_key", "recompute", "recompute_sequential",
+           "checkpoint_name"]

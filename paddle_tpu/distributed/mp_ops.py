@@ -1,0 +1,124 @@
+"""Tensor/model-parallel ops: vocab-parallel embedding and cross-entropy.
+
+Reference analogs:
+- parallel_cross_entropy ≙ c_softmax_with_cross_entropy
+  (paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu:38,
+  134-192; python surface mpu/mp_ops.py:405 _c_softmax_with_cross_entropy,
+  mp_layers.py:491 ParallelCrossEntropy): per-rank row max → allreduce(max)
+  → exp/sum → allreduce(sum) → masked pick of the label logit on the rank
+  owning that vocab range → allreduce(pick). The full-vocab logit row is
+  NEVER materialized on any device — the memory dominator at 1.3B+ scale.
+- vocab_parallel_embedding ≙ VocabParallelEmbedding (mp_layers.py:37):
+  each rank looks up tokens falling in its vocab range, zeros elsewhere,
+  allreduce combines.
+
+Here both are jax.shard_map bodies with explicit lax.psum over the 'tp'
+mesh axis (SURVEY §5.8 mapping: NCCL allreduce → psum on ICI), fully
+differentiable (shard_map AD; psum's VJP is psum).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["parallel_cross_entropy", "vocab_parallel_embedding",
+           "axis_rng_key"]
+
+
+def _token_ce_local(logits, labels, axis, ignore_index):
+    """shard_map body: per-token CE over a vocab axis sharded on `axis`.
+
+    logits: (..., V_local) LOCAL shard, labels: (...) GLOBAL vocab ids.
+    Returns per-token loss (...), 0 where label == ignore_index.
+    """
+    vloc = logits.shape[-1]
+    start = lax.axis_index(axis) * vloc
+    x = logits.astype(jnp.float32)
+    # stop_gradient BEFORE pmax: the max term's gradient contribution
+    # cancels (standard logsumexp stabilization) and pmax has no AD rule,
+    # so tangents must never reach it
+    mx = lax.pmax(jnp.max(lax.stop_gradient(x), axis=-1), axis)
+    x = x - mx[..., None]
+    denom = lax.psum(jnp.sum(jnp.exp(x), axis=-1), axis)
+    valid = labels != ignore_index
+    local = labels.astype(jnp.int32) - start
+    in_range = (local >= 0) & (local < vloc) & valid
+    safe = jnp.clip(local, 0, vloc - 1)
+    pick = jnp.take_along_axis(x, safe[..., None], axis=-1)[..., 0]
+    pick = lax.psum(jnp.where(in_range, pick, 0.0), axis)
+    return jnp.where(valid, jnp.log(denom) - pick, 0.0)
+
+
+def parallel_cross_entropy(logits, labels, mesh: Mesh = None,
+                           axis: str = "tp", batch_axes=("dp", "fsdp"),
+                           seq_axis: str = "sp", ignore_index: int = -100):
+    """TP-sharded softmax cross-entropy over GLOBAL (B, S, V) logits whose
+    vocab axis is sharded over mesh axis `axis`.
+
+    Returns per-token loss (B, S) — 0 at ignore_index positions — sharded
+    (batch_axes, seq_axis). Reduce it yourself (mean over valid tokens).
+    Falls back to a dense computation when no mesh / axis degree 1.
+    """
+    if mesh is None:
+        from paddle_tpu.distributed.mesh import get_mesh
+        mesh = get_mesh()
+    if mesh is None or dict(mesh.shape).get(axis, 1) == 1:
+        x = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(x, axis=-1)
+        valid = labels != ignore_index
+        safe = jnp.where(valid, labels, 0).astype(jnp.int32)
+        pick = jnp.take_along_axis(x, safe[..., None], axis=-1)[..., 0]
+        return jnp.where(valid, logz - pick, 0.0)
+    lspec = P(batch_axes, seq_axis, axis)
+    yspec = P(batch_axes, seq_axis)
+    body = lambda lg, lb: _token_ce_local(lg, lb, axis, ignore_index)
+    return jax.shard_map(body, mesh=mesh, in_specs=(lspec, yspec),
+                         out_specs=yspec)(logits, labels)
+
+
+def vocab_parallel_embedding(table, tokens, mesh: Mesh = None,
+                             axis: str = "tp", shard_axes=(),
+                             batch_axes=("dp", "fsdp"),
+                             seq_axis: str = "sp"):
+    """Embedding lookup with the vocab (row) axis of `table` sharded over
+    mesh axis `axis` — each rank looks up only tokens in its own vocab range
+    and a psum combines, so the (V, d) table is never all-gathered over the
+    VOCAB axis (≙ VocabParallelEmbedding, mp_layers.py:37). Any fsdp
+    sharding of the d column is gathered at shard_map entry — exactly
+    ZeRO-3's gather-param-at-use, and only V/tp × d per device.
+
+    table: GLOBAL (V, d), rows sharded over `axis`; pass shard_axes=(ax,)
+    to KEEP the d column sharded (only legal when ax is not in batch_axes).
+    tokens: GLOBAL (B, S) int ids. Returns (B, S, d) sharded
+    (batch_axes, seq_axis, shard_axes[0] or None).
+    """
+    if mesh is None:
+        from paddle_tpu.distributed.mesh import get_mesh
+        mesh = get_mesh()
+    if mesh is None or dict(mesh.shape).get(axis, 1) == 1:
+        return jnp.take(table, tokens, axis=0)
+    col = shard_axes[0] if shard_axes else None
+
+    def body(tbl, tok):
+        vloc = tbl.shape[0]
+        start = lax.axis_index(axis) * vloc
+        local = tok.astype(jnp.int32) - start
+        in_range = (local >= 0) & (local < vloc)
+        safe = jnp.clip(local, 0, vloc - 1)
+        out = jnp.take(tbl, safe, axis=0)
+        out = jnp.where(in_range[..., None], out, 0)
+        return lax.psum(out, axis)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, col), P(batch_axes, seq_axis)),
+        out_specs=P(batch_axes, seq_axis, col))(table, tokens)
+
+
+def axis_rng_key(key, axis: str):
+    """Per-mesh-axis-index PRNG key (≙ RNGStatesTracker, mpu/random.py:32:
+    model-parallel regions need DIFFERENT dropout masks per tp rank for
+    sharded activations; JAX's explicit keys make this a fold_in). Call
+    inside shard_map."""
+    return jax.random.fold_in(key, lax.axis_index(axis))

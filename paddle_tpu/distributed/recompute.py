@@ -1,0 +1,123 @@
+"""Recompute (activation checkpointing) API.
+
+Reference analog: fleet/recompute/recompute.py (RecomputeFunction:224,
+recompute():386 — a PyLayer that re-runs the forward under tracked RNG
+during backward) and recompute_hybrid.py:69 (_HPRecomputeFunction — the
+MP-aware variant with optional CPU offload of checkpointed activations).
+
+On TPU the mechanism is jax.checkpoint: the compiler re-runs the forward
+inside the transposed program, RNG correctness falls out of explicit PRNG
+keys (no RNGStatesTracker state machine needed), and *what* is saved is a
+first-class policy instead of PyLayer bookkeeping:
+
+- recompute(fn, *args)                       ≙ fleet.utils.recompute
+- recompute(..., policy="dots_saveable")     ≙ selective-save; policies map
+  onto jax.checkpoint_policies (the saved_tensors_hooks analog)
+- recompute(..., offload=True)               ≙ recompute_hybrid offload —
+  jax's offloadable policies move residuals to host memory
+- recompute_sequential(fns, x, segments=k)   ≙ fleet.utils
+  .recompute_sequential: split a layer stack into k segments, checkpoint
+  each boundary
+- checkpoint_name(x, "name") + save_only_these_names ≙ per-tensor
+  selective save lists
+"""
+
+import functools
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+from jax import ad_checkpoint
+
+__all__ = ["recompute", "recompute_sequential", "checkpoint_name",
+           "POLICIES"]
+
+checkpoint_name = ad_checkpoint.checkpoint_name
+
+# name → jax checkpoint policy (jax.checkpoint_policies.*); the reference's
+# single recompute mode corresponds to "nothing_saveable"
+POLICIES = {
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def _resolve_policy(policy, offload: bool):
+    if policy is None:
+        if offload:
+            # ≙ recompute_hybrid CPU offload: save residuals to host memory
+            return jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=[],
+                offload_src="device", offload_dst="pinned_host")
+        return None  # jax default: save nothing across the boundary
+    if callable(policy):
+        return policy
+    if isinstance(policy, str):
+        if policy in POLICIES:
+            return POLICIES[policy]
+        raise ValueError(
+            f"unknown recompute policy {policy!r}; one of {list(POLICIES)} "
+            f"or a jax.checkpoint_policies callable")
+    if isinstance(policy, (list, tuple)):
+        # selective save list of checkpoint_name strings
+        # (≙ saved_tensors_hooks keeping only chosen activations)
+        return jax.checkpoint_policies.save_only_these_names(*policy)
+    raise TypeError(f"bad policy: {policy!r}")
+
+
+def recompute(function: Callable, *args,
+              policy=None, offload: bool = False,
+              prevent_cse: bool = True, static_argnums=(),
+              preserve_rng_state: bool = True, use_reentrant: bool = True,
+              **kwargs):
+    """Run ``function(*args)`` now; rematerialize its intermediates during
+    backward instead of saving them (≙ fleet.utils.recompute,
+    recompute.py:386).
+
+    policy: None (save nothing), a POLICIES name, a list of
+    checkpoint_name strings to save, or any jax.checkpoint_policies
+    callable. preserve_rng_state/use_reentrant are accepted for reference
+    API parity (both are inherent to tracing: PRNG keys are explicit
+    operands, and there is no autograd tape to re-enter).
+    """
+    fn = jax.checkpoint(function, policy=_resolve_policy(policy, offload),
+                        prevent_cse=prevent_cse,
+                        static_argnums=static_argnums)
+    return fn(*args, **kwargs)
+
+
+def recompute_wrapper(function: Callable = None, **ckpt_kwargs):
+    """Decorator form: ``@recompute_wrapper(policy=...)``."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            return recompute(fn, *a, **ckpt_kwargs, **k)
+        return wrapped
+    return deco(function) if function is not None else deco
+
+
+def recompute_sequential(functions: Sequence[Callable], x,
+                         segments: int = 1, policy=None, **kwargs):
+    """Apply a layer list in ``segments`` checkpointed chunks
+    (≙ fleet.utils.recompute_sequential): only segment-boundary
+    activations survive to the backward pass, intermediates within a
+    segment re-run."""
+    fns = list(functions)
+    n = len(fns)
+    segments = max(1, min(segments, n))
+    bounds = [round(i * n / segments) for i in range(segments + 1)]
+
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        if lo == hi:
+            continue
+
+        def seg(h, _fns=fns[lo:hi]):
+            for f in _fns:
+                h = f(h)
+            return h
+
+        x = recompute(seg, x, policy=policy, **kwargs)
+    return x

@@ -1,0 +1,124 @@
+"""paddle_tpu.inference — serving runtime (≙ paddle/fluid/inference/, the
+90.3k-LoC AnalysisPredictor subsystem, api/analysis_predictor.h:95).
+
+What the reference spends that subsystem on — IR pass pipelines, TRT/Lite
+subgraph offload, memory-optimize passes — XLA does at compile time; what
+remains to build natively is the serving surface:
+
+- Config  ≙ AnalysisConfig (api/analysis_config.cc): model path + run opts.
+- Predictor ≙ AnalysisPredictor: owns a loaded StableHLO artifact
+  (paddle_tpu.jit.save export) or a jitted callable, pads request batches
+  to the compiled batch size, runs, unpads.
+- create_predictor ≙ paddle_infer::CreatePredictor.
+
+Decode serving for LM models is models.gpt.generate (KV-cache loop in one
+jit); Predictor serves the per-request batched forward case.
+"""
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """≙ paddle_infer.Config (api/analysis_config.cc). Collects the model
+    path and execution options; device/IR-opt toggles that configure
+    CUDA/TRT in the reference are accepted for API parity and ignored
+    (XLA owns compilation)."""
+
+    def __init__(self, model_path: Optional[str] = None):
+        self.model_path = model_path
+        self.batch_size: Optional[int] = None
+        self._switches = {}
+
+    def set_model(self, path: str):
+        self.model_path = path
+
+    def enable_memory_optim(self, *a, **k):
+        self._switches["memory_optim"] = True
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._switches["ir_optim"] = flag
+
+    def __getattr__(self, name):  # absorb the reference's long option list
+        if name.startswith(("enable_", "switch_", "set_", "disable_")):
+            return lambda *a, **k: self._switches.__setitem__(name, a)
+        raise AttributeError(name)
+
+
+class Predictor:
+    """Batched predictor over an exported StableHLO artifact or callable
+    (≙ AnalysisPredictor::Run, api/analysis_predictor.h:95).
+
+    The exported program has static shapes; `run` accepts any number of
+    requests, pads the stacked batch up to the compiled batch size (running
+    multiple sub-batches when more arrive), and strips padding from the
+    outputs. Thread-safe: a lock serializes device execution.
+    """
+
+    def __init__(self, model: Union[str, Callable, "Config"],
+                 batch_size: Optional[int] = None):
+        if isinstance(model, Config):
+            batch_size = batch_size or model.batch_size
+            model = model.model_path
+        if isinstance(model, str):
+            from paddle_tpu import jit as ptjit
+            self._fn = ptjit.load(model)
+            shapes = getattr(self._fn, "_exported", None)
+            if batch_size is None and shapes is not None:
+                in_avals = shapes.in_avals
+                if in_avals and in_avals[0].shape:
+                    batch_size = in_avals[0].shape[0]
+        else:
+            self._fn = model
+        self._batch = batch_size
+        self._lock = threading.Lock()
+
+    def _run_padded(self, arrays: Sequence[np.ndarray]):
+        n = arrays[0].shape[0]
+        bs = self._batch or n
+        outs = []
+        for lo in range(0, n, bs):
+            chunk = [a[lo:lo + bs] for a in arrays]
+            pad = bs - chunk[0].shape[0]
+            if pad > 0:
+                chunk = [np.concatenate(
+                    [c, np.repeat(c[-1:], pad, axis=0)], axis=0)
+                    for c in chunk]
+            with self._lock:
+                res = self._fn(*[jnp.asarray(c) for c in chunk])
+            multi = isinstance(res, (tuple, list))
+            rs = list(res) if multi else [res]
+            rs = [np.asarray(r)[:bs - pad] if pad > 0 else np.asarray(r)
+                  for r in rs]
+            outs.append(rs if multi else rs[0])
+        if not isinstance(outs[0], list):
+            return np.concatenate(outs, axis=0)
+        return [np.concatenate([o[i] for o in outs], axis=0)
+                for i in range(len(outs[0]))]
+
+    def run(self, inputs: Union[Sequence[np.ndarray], np.ndarray],
+            batched: Optional[bool] = None):
+        """inputs: one array or a sequence of per-feed arrays, leading dim =
+        requests. Returns outputs with the same leading dim."""
+        if isinstance(inputs, np.ndarray) or hasattr(inputs, "shape"):
+            inputs = [inputs]
+        arrays = [np.asarray(a) for a in inputs]
+        return self._run_padded(arrays)
+
+    # convenience single-request form
+    def predict(self, *feeds):
+        out = self.run([np.asarray(f)[None] for f in feeds])
+        if isinstance(out, list):
+            return [o[0] for o in out]
+        return out[0]
+
+
+def create_predictor(config: Config) -> Predictor:
+    """≙ paddle_infer::CreatePredictor(config)."""
+    return Predictor(config)

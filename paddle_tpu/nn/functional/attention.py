@@ -55,7 +55,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     Layout (B, S, H, D) matching paddle.nn.functional.scaled_dot_product_attention.
     """
-    q = jnp.asarray(query)
+    # attention matmuls are O1-white-listed (amp/auto_cast WHITE_LIST:44)
+    from paddle_tpu.amp.auto_cast import amp_cast
+    q = amp_cast(jnp.asarray(query))
+    key = amp_cast(jnp.asarray(key))
+    value = amp_cast(jnp.asarray(value))
     # head_dim % 8: Mosaic-lowerable without a sublane-misaligned layout
     # (failures there surface at jit-compile time, outside the try/except)
     use_pallas = (flags.get_flag("use_pallas_kernels")

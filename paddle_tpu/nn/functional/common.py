@@ -15,11 +15,15 @@ __all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
 
 def linear(x, weight, bias=None):
     """ref: nn.functional.linear → phi matmul+add; weight layout
-    (in_features, out_features) as in the reference."""
-    x = jnp.asarray(x)
-    out = x @ jnp.asarray(weight)
+    (in_features, out_features) as in the reference. Inside an
+    amp.auto_cast region (O1) the matmul inputs are cast to the amp dtype
+    (matmul is on the reference white list, fluid/dygraph/amp/auto_cast
+    WHITE_LIST:44)."""
+    from paddle_tpu.amp.auto_cast import amp_cast
+    x = amp_cast(jnp.asarray(x))
+    out = x @ amp_cast(jnp.asarray(weight))
     if bias is not None:
-        out = out + jnp.asarray(bias)
+        out = out + amp_cast(jnp.asarray(bias))
     return out
 
 

@@ -32,8 +32,10 @@ def _norm_padding(padding, n):
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, n,
           data_format):
-    x = jnp.asarray(x)
-    w = jnp.asarray(weight)  # (out_c, in_c/groups, *k) reference layout
+    # conv is on the reference O1 white list (amp/auto_cast WHITE_LIST:44)
+    from paddle_tpu.amp.auto_cast import amp_cast
+    x = amp_cast(jnp.asarray(x))
+    w = amp_cast(jnp.asarray(weight))  # (out_c, in_c/groups, *k) ref layout
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     if channel_last:
         x = jnp.moveaxis(x, -1, 1)

@@ -149,26 +149,34 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
-    """ref: paddle.optimizer.Adam (phi adam kernel)."""
+    """ref: paddle.optimizer.Adam (phi adam kernel).
+
+    moment_dtype: storage dtype for the m/v slots (default fp32). bf16
+    halves-again optimizer memory (1.3B Adam state: 10.4GB fp32 → 5.2GB) at
+    a small quality cost — the update itself always computes in fp32."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, lazy_mode=False, **kw):
+                 epsilon=1e-8, lazy_mode=False, moment_dtype=jnp.float32,
+                 **kw):
         super().__init__(learning_rate, **kw)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.moment_dtype = moment_dtype
 
     def init_param(self, p):
-        return (jnp.zeros_like(p, jnp.float32),
-                jnp.zeros_like(p, jnp.float32))
+        return (jnp.zeros_like(p, self.moment_dtype),
+                jnp.zeros_like(p, self.moment_dtype))
 
     def update_param(self, p, g, s, lr, step):
         m, v = s
         b1, b2 = self.beta1, self.beta2
-        m = b1 * m + (1 - b1) * g
-        v = b2 * v + (1 - b2) * jnp.square(g)
+        m = b1 * m.astype(jnp.float32) + (1 - b1) * g
+        v = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g)
         t = step.astype(jnp.float32)
         mhat = m / (1 - b1 ** t)
         vhat = v / (1 - b2 ** t)
-        return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return new_p, (m.astype(self.moment_dtype),
+                       v.astype(self.moment_dtype))
 
 
 class AdamW(Adam):

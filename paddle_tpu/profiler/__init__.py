@@ -14,10 +14,15 @@ from typing import Callable, Optional
 import jax
 
 from paddle_tpu.profiler.timer import Benchmark, benchmark
+from paddle_tpu.profiler import statistic
+from paddle_tpu.profiler.statistic import (SpanCollector, StatRegistry,
+                                           stat_registry, stat_add,
+                                           stat_get, format_table)
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "export_chrome_tracing", "Benchmark", "benchmark",
-           "start_server"]
+           "start_server", "SpanCollector", "StatRegistry", "stat_registry",
+           "stat_add", "stat_get", "format_table"]
 
 
 class ProfilerTarget:
@@ -40,6 +45,7 @@ class RecordEvent:
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ann = None
+        self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -51,11 +57,16 @@ class RecordEvent:
     def begin(self):
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
+        self._t0 = time.perf_counter()
 
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
+        if self._t0 is not None:
+            # feeds the host-side span table (≙ profiler_statistic.py)
+            statistic.record_span(self.name, time.perf_counter() - self._t0)
+            self._t0 = None
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
@@ -80,16 +91,20 @@ class Profiler:
         self._step = 0
         self._step_times = []
         self._last = None
+        self._collector = None
 
     def start(self):
         if not self.timer_only:
             jax.profiler.start_trace(self.log_dir)
+        self._collector = statistic.SpanCollector()
+        statistic._set_active(self._collector)
         self._running = True
         self._last = time.perf_counter()
 
     def stop(self):
         if self._running and not self.timer_only:
             jax.profiler.stop_trace()
+        statistic._set_active(None)
         self._running = False
 
     def step(self, num_samples: Optional[int] = None):
@@ -114,7 +129,13 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        return self.step_info()
+        """Host-span table + step-time breakdown (≙ the reference's
+        profiler_statistic.py tables printed after Profiler.stop)."""
+        if self._collector is None:
+            return self.step_info()
+        return statistic.format_table(
+            self._collector, step_times=self._step_times,
+            sorted_by=sorted_by or "total", time_unit=time_unit)
 
     def export(self, path=None, format=None):  # noqa: A002
         pass  # jax.profiler already wrote the trace to log_dir

@@ -1,0 +1,168 @@
+"""Profiler statistics + monitor counters.
+
+Reference analogs:
+- python/paddle/profiler/profiler_statistic.py — per-op time tables
+  (calls / total / avg / max / min / ratio) rendered after a profiling
+  session;
+- paddle/fluid/platform/monitor.h:35-139 — StatRegistry + STAT_ADD named
+  int64 counters exported to Python.
+
+Host-side spans come from RecordEvent (device-side timing lives in the XLA
+trace; these tables cover the host orchestration the reference's host
+tracer covers). Collection is active while a Profiler is running.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["SpanStats", "SpanCollector", "StatRegistry", "stat_registry",
+           "stat_add", "stat_get", "format_table"]
+
+
+class SpanStats:
+    __slots__ = ("name", "count", "total", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, dt: float):
+        self.count += 1
+        self.total += dt
+        self.min = min(self.min, dt)
+        self.max = max(self.max, dt)
+
+    @property
+    def avg(self):
+        return self.total / self.count if self.count else 0.0
+
+
+class SpanCollector:
+    """Aggregates RecordEvent spans by name (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: Dict[str, SpanStats] = {}
+        self._t0 = time.perf_counter()
+
+    def add(self, name: str, dt: float):
+        with self._lock:
+            s = self._spans.get(name)
+            if s is None:
+                s = self._spans[name] = SpanStats(name)
+            s.add(dt)
+
+    def spans(self) -> List[SpanStats]:
+        with self._lock:
+            return list(self._spans.values())
+
+    @property
+    def wall(self) -> float:
+        return time.perf_counter() - self._t0
+
+
+_active_lock = threading.Lock()
+_active: Optional[SpanCollector] = None
+
+
+def _set_active(c: Optional[SpanCollector]):
+    global _active
+    with _active_lock:
+        _active = c
+
+
+def _get_active() -> Optional[SpanCollector]:
+    return _active
+
+
+def record_span(name: str, dt: float):
+    c = _active
+    if c is not None:
+        c.add(name, dt)
+
+
+def format_table(collector: SpanCollector, step_times=None,
+                 sorted_by: str = "total", time_unit: str = "ms") -> str:
+    """Render the profiler_statistic.py-style table."""
+    unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+    spans = collector.spans()
+    key = {"total": lambda s: -s.total, "avg": lambda s: -s.avg,
+           "count": lambda s: -s.count, "name": lambda s: s.name,
+           "max": lambda s: -s.max}[sorted_by]
+    spans.sort(key=key)
+    wall = max(collector.wall, 1e-12)
+    lines = []
+    hdr = (f"{'Name':<32} {'Calls':>7} {'Total(' + time_unit + ')':>12} "
+           f"{'Avg(' + time_unit + ')':>12} {'Max(' + time_unit + ')':>12} "
+           f"{'Min(' + time_unit + ')':>12} {'Ratio%':>7}")
+    lines.append("-" * len(hdr))
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for s in spans:
+        lines.append(
+            f"{s.name[:32]:<32} {s.count:>7} {s.total * unit:>12.3f} "
+            f"{s.avg * unit:>12.3f} {s.max * unit:>12.3f} "
+            f"{(0.0 if s.count == 0 else s.min) * unit:>12.3f} "
+            f"{100.0 * s.total / wall:>7.2f}")
+    if step_times:
+        import numpy as np
+        st = np.asarray(step_times)
+        lines.append("-" * len(hdr))
+        lines.append(
+            f"steps: {len(st)}  avg {st.mean() * unit:.3f}{time_unit}  "
+            f"p50 {np.percentile(st, 50) * unit:.3f}  "
+            f"p95 {np.percentile(st, 95) * unit:.3f}  "
+            f"max {st.max() * unit:.3f}")
+    lines.append("-" * len(hdr))
+    return "\n".join(lines)
+
+
+class StatRegistry:
+    """Named int64 counters (≙ platform/monitor.h StatRegistry + STAT_ADD).
+    The reference exports GPU memory stats through this surface; here any
+    subsystem can bump counters (dataloader batches, collective calls,
+    checkpoint bytes) and tooling reads them in one place."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, int] = {}
+
+    def add(self, name: str, value: int = 1) -> int:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + int(value)
+            return self._stats[name]
+
+    def set(self, name: str, value: int):
+        with self._lock:
+            self._stats[name] = int(value)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def reset(self, name: Optional[str] = None):
+        with self._lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+
+stat_registry = StatRegistry()
+
+
+def stat_add(name: str, value: int = 1) -> int:
+    """≙ STAT_ADD(name, value) (monitor.h:121)."""
+    return stat_registry.add(name, value)
+
+
+def stat_get(name: str) -> int:
+    return stat_registry.get(name)

