@@ -145,16 +145,25 @@ def main():
     return 0 if result["metric"] != "bench_failed" else 1
 
 
-def bench_resnet50(jax, jnp, peak):
+def bench_resnet50(jax, jnp, peak, smoke=False):
     """ResNet50 train step: imgs/sec + hardware utilization (BASELINE.md
-    conv/BN row). BN buffers update through the stateful context."""
-    if jax.default_backend() in ("cpu",):
+    conv/BN row). BN buffers update through the stateful context.
+
+    smoke=True runs the SAME code path on tiny shapes (CPU-friendly) so
+    tests catch API drift before the driver's TPU run (VERDICT r2 weak 1).
+    """
+    if jax.default_backend() in ("cpu",) and not smoke:
         return {}
     from paddle_tpu import nn, optimizer as optim
     from paddle_tpu.nn import functional as F
-    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu.vision.models import resnet18, resnet50
 
-    net = resnet50(num_classes=1000).tag_paths()
+    if smoke:
+        net = resnet18(num_classes=10).tag_paths()
+        batch, img, classes, warmup, iters = 2, 32, 10, 1, 1
+    else:
+        net = resnet50(num_classes=1000).tag_paths()
+        batch, img, classes, warmup, iters = 256, 224, 1000, 2, 5
     opt = optim.Momentum(learning_rate=0.1, momentum=0.9,
                          weight_decay=1e-4)
     params, buffers = net.split_params()
@@ -176,10 +185,9 @@ def bench_resnet50(jax, jnp, peak):
         return new_params, new_state, updates, loss
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
-    batch = 256
     x = jnp.asarray(np.random.RandomState(0).rand(
-        batch, 3, 224, 224), jnp.bfloat16)
-    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, (batch,)),
+        batch, 3, img, img), jnp.bfloat16)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, classes, (batch,)),
                     jnp.int32)
     key = jax.random.PRNGKey(0)
     compiled = jstep.lower(params, opt_state, buffers, x, y, key).compile()
@@ -187,13 +195,12 @@ def bench_resnet50(jax, jnp, peak):
         hw_flops = compiled.cost_analysis().get("flops", 0.0)
     except Exception:
         hw_flops = 0.0
-    for _ in range(2):
+    for _ in range(warmup):
         params, opt_state, buffers_u, loss = compiled(
             params, opt_state, buffers, x, y, key)
         buffers = {**buffers, **buffers_u}
     _sync(loss)
     t0 = time.perf_counter()
-    iters = 5
     for _ in range(iters):
         params, opt_state, buffers_u, loss = compiled(
             params, opt_state, buffers, x, y, key)
@@ -205,21 +212,25 @@ def bench_resnet50(jax, jnp, peak):
             "resnet50_batch": batch}
 
 
-def bench_bert(jax, jnp, peak):
+def bench_bert(jax, jnp, peak, smoke=False):
     """BERT-base MLM pretrain step tokens/s/chip + MFU (BASELINE.md
     transformer/AMP row)."""
-    if jax.default_backend() in ("cpu",):
+    if jax.default_backend() in ("cpu",) and not smoke:
         return {}
     from paddle_tpu import optimizer as optim
     from paddle_tpu.models import bert
 
-    cfg = bert.bert_base(max_position=512, dropout=0.0)
+    if smoke:
+        cfg = bert.BertConfig(vocab_size=128, d_model=32, n_heads=2,
+                              n_layers=2, max_position=32, dropout=0.0)
+    else:
+        cfg = bert.bert_base(max_position=512, dropout=0.0)
     model = bert.BertForPretraining(cfg, seed=0)
     opt = optim.AdamW(learning_rate=1e-4, weight_decay=0.01,
                       moment_dtype=jnp.bfloat16)
     params, opt_state = bert.init_train_state(model, opt)
     step = bert.build_pretrain_step(model, opt)
-    b, s = 32, 512
+    b, s = (2, 16) if smoke else (32, 512)
     rs = np.random.RandomState(0)
     tokens = jnp.asarray(rs.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
     type_ids = jnp.zeros((b, s), jnp.int32)
@@ -246,15 +257,15 @@ def bench_bert(jax, jnp, peak):
             "bert_base_mfu": round(mfu, 4)}
 
 
-def bench_decode(jax, jnp, peak):
+def bench_decode(jax, jnp, peak, smoke=False):
     """KV-cache autoregressive decode throughput (serving path). Reuses the
     train bench's model so the 2.6GB param transfer over the tunnel is not
     paid twice."""
     model = getattr(bench_gpt, "model", None)
-    if model is None or jax.default_backend() in ("cpu",):
+    if model is None or (jax.default_backend() in ("cpu",) and not smoke):
         return {}
     cfg = model.cfg
-    b, s0, new = 8, 128, 64
+    b, s0, new = (2, 8, 4) if smoke else (8, 128, 64)
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size, (b, s0)),
         jnp.int32)

@@ -36,6 +36,9 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
     from paddle_tpu.amp.auto_cast import amp_cast
     x = amp_cast(jnp.asarray(x))
     w = amp_cast(jnp.asarray(weight))  # (out_c, in_c/groups, *k) ref layout
+    if x.dtype != w.dtype:  # lax.conv requires matching dtypes
+        ct = jnp.promote_types(x.dtype, w.dtype)
+        x, w = x.astype(ct), w.astype(ct)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     if channel_last:
         x = jnp.moveaxis(x, -1, 1)

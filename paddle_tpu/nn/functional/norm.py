@@ -25,28 +25,39 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     ref semantics: phi batch_norm kernel; running stats use
     ``momentum * old + (1-momentum) * batch`` like the reference."""
     x = jnp.asarray(x)
+    in_dtype = x.dtype
     c_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
     shape = [1] * x.ndim
     shape[c_axis] = x.shape[c_axis]
 
     if training:
-        mean = jnp.mean(x, axis=reduce_axes)
-        var = jnp.var(x, axis=reduce_axes)
-        new_mean = momentum * running_mean + (1 - momentum) * mean
+        # statistics in fp32 (bf16 accumulations drift); output is cast
+        # back to the input dtype so bf16 activations stay bf16 through
+        # the conv stack (mixed-precision norm convention)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
+        rm, rv = jnp.asarray(running_mean), jnp.asarray(running_var)
         n = x.size // x.shape[c_axis]
         unbiased = var * n / max(n - 1, 1)
-        new_var = momentum * running_var + (1 - momentum) * unbiased
+        # keep buffer dtypes stable across steps (AOT-compiled steps feed
+        # updated buffers back in; a dtype drift would mismatch the
+        # executable signature)
+        new_mean = (momentum * rm + (1 - momentum) * mean).astype(rm.dtype)
+        new_var = (momentum * rv + (1 - momentum) * unbiased).astype(rv.dtype)
     else:
+        xf = x.astype(jnp.float32)
         mean, var = jnp.asarray(running_mean), jnp.asarray(running_var)
         new_mean, new_var = mean, var
 
-    inv = jax.lax.rsqrt(var + epsilon)
-    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon)
+    out = (xf - mean.astype(jnp.float32).reshape(shape)) * inv.reshape(shape)
     if weight is not None:
-        out = out * jnp.asarray(weight).reshape(shape)
+        out = out * jnp.asarray(weight).astype(jnp.float32).reshape(shape)
     if bias is not None:
-        out = out + jnp.asarray(bias).reshape(shape)
+        out = out + jnp.asarray(bias).astype(jnp.float32).reshape(shape)
+    out = out.astype(in_dtype)
     if training:
         return out, new_mean, new_var
     return out

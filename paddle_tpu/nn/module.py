@@ -277,7 +277,10 @@ class Module:
                 ...
         """
         params = dict(self.named_parameters(include_non_trainable=False))
-        return params, None
+        buffers = {k: v for k, v in self.named_parameters()
+                   if k not in params}
+        buffers.update(self.named_buffers())
+        return params, buffers
 
     def merge_params(self, params: Dict[str, jax.Array]) -> "Module":
         """Return a copy of self with ``params`` swapped in (pure)."""

