@@ -51,13 +51,28 @@ def reshard(x, spec, mesh: Optional[Mesh] = None):
     return shard_tensor(x, spec, mesh)
 
 
-def shard_module(module, rules, mesh: Optional[Mesh] = None):
+def shard_module(module, rules=None, mesh: Optional[Mesh] = None,
+                 auto: bool = False):
     """Apply {param-path-regex: PartitionSpec} rules to a Module's params
     (≙ the reference's per-op DistributedOperatorImpl sharding registry,
-    auto_parallel/operators/common.py:54)."""
+    auto_parallel/operators/common.py:54).
+
+    ``auto=True`` derives the rules structurally instead (planner v0 ≙
+    Completer, auto_parallel/completion.py:964): no annotations needed.
+    """
     import re
     m = _mesh_or_global(mesh)
     state = module.state_dict()
+    if auto:
+        from paddle_tpu.distributed.planner import plan_module
+        plan = plan_module(module, mesh=m)
+        new_state = {
+            name: jax.device_put(value, NamedSharding(m, plan.get(name,
+                                                                  P())))
+            for name, value in state.items()}
+        return module.merge_params(new_state)
+    if rules is None:
+        raise ValueError("shard_module needs rules= or auto=True")
     new_state = {}
     for name, value in state.items():
         spec = P()

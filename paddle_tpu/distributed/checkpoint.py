@@ -361,6 +361,30 @@ class AutoCheckpoint:
         return load_state(os.path.join(self.dir, f"epoch_{eps[-1]}"),
                           shardings=shardings, template=template)
 
+    def restore_like(self, fresh_state, mesh: Optional[Mesh] = None):
+        """Resharding resume (the N→M elastic path, ≙ auto_parallel
+        converter.py resharding-on-load): load the latest checkpoint ONTO
+        the shardings of a freshly-initialized state — typically built on
+        a different mesh than the one the checkpoint was saved under.
+
+        With ``mesh``, fresh leaves whose sharding does not span the whole
+        mesh (e.g. jit-created scalars committed to one device) are
+        normalized to mesh-replicated, so the resumed state is consistent
+        for a donating jitted train step. Returns None if nothing saved."""
+        is_sh = lambda x: isinstance(x, jax.sharding.Sharding)
+        tmpl = jax.tree_util.tree_map(lambda x: x.sharding, fresh_state)
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            n = mesh.size
+
+            def norm(s):
+                try:
+                    return s if len(s.device_set) == n else rep
+                except Exception:
+                    return rep
+            tmpl = jax.tree_util.tree_map(norm, tmpl, is_leaf=is_sh)
+        return self.restore(template=tmpl)
+
     def save(self, state, epoch: int):
         tmp = os.path.join(self.dir, f".tmp_epoch_{epoch}")
         final = os.path.join(self.dir, f"epoch_{epoch}")

@@ -48,14 +48,24 @@ def _parse(argv):
     p.add_argument("--max_restarts", type=int, default=0,
                    help="relaunch the local group this many times on "
                         "worker failure (elastic)")
+    p.add_argument("--elastic", action="store_true",
+                   help="membership-changing mode: on worker failure the "
+                        "job RE-FORMS at the surviving world size (ranks "
+                        "reassigned via the TCPStore registry) instead of "
+                        "restarting at the same size")
+    p.add_argument("--elastic_grace", type=float, default=1.0,
+                   help="seconds the master waits for straggler nodes "
+                        "when forming a membership round")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def _spawn(args, local_rank):
-    world = args.nnodes * args.nproc_per_node
-    rank = args.node_rank * args.nproc_per_node + local_rank
+def _spawn(args, local_rank, rank=None, world=None, extra_env=None):
+    if world is None:
+        world = args.nnodes * args.nproc_per_node
+    if rank is None:
+        rank = args.node_rank * args.nproc_per_node + local_rank
     env = dict(os.environ)
     env.update({
         "PT_COORDINATOR": args.master,
@@ -64,6 +74,8 @@ def _spawn(args, local_rank):
         "PT_LOCAL_RANK": str(local_rank),
         "PT_NNODES": str(args.nnodes),
     })
+    if extra_env:
+        env.update(extra_env)
     cmd = [sys.executable, args.training_script,
            *args.training_script_args]
     if args.log_dir:
@@ -104,9 +116,11 @@ def _kill_group(procs):
             p._pt_logf.close()
 
 
-def _watch(procs, poll_s=0.2):
+def _watch(procs, poll_s=0.2, should_abort=None):
     """Block until all exit 0 (return 0) or any fails (kill rest, return
-    its code). ≙ ControllerBase.watch (launch/controllers/controller.py:34)."""
+    its code). ≙ ControllerBase.watch (launch/controllers/controller.py:34).
+    ``should_abort()`` (elastic): polled each tick; truthy → kill the
+    group and return REFORM_RC (another node asked for a re-form)."""
     while True:
         alive = False
         for p in procs:
@@ -118,11 +132,151 @@ def _watch(procs, poll_s=0.2):
                 return rc
         if not alive:
             return 0
+        if should_abort is not None and should_abort():
+            _kill_group(procs)
+            return REFORM_RC
         time.sleep(poll_s)
+
+
+REFORM_RC = -1000  # internal: group killed because membership changed
+
+
+def _launch_elastic(args):
+    """Membership-changing controller (≙ CollectiveElasticController,
+    launch/controllers/collective.py:184, with the etcd master replaced by
+    ElasticRegistry on the native TCPStore).
+
+    Round protocol: the master announces round v on ``elastic/round``;
+    every node publishes its alive worker count for v; the master forms
+    the rank table; every node (re)launches its local group with the
+    assigned global ranks and the NEW world size. A worker failure on any
+    node bumps ``elastic/reform``, which aborts every group and starts
+    round v+1 with the failed workers removed — N→N−1 re-formation, not
+    same-size restart (VERDICT r2 item 5)."""
+    from paddle_tpu import native
+    from paddle_tpu.distributed.elastic import ElasticRegistry
+
+    host, port = args.master.rsplit(":", 1)
+    reg_port = int(port) + 1
+    is_master = args.node_rank == 0
+    store = (native.TCPStore("127.0.0.1", reg_port, is_master=True)
+             if is_master else native.TCPStore(host, reg_port))
+    reg = ElasticRegistry(store, args.node_rank, is_master=is_master)
+    n_local = args.nproc_per_node
+    version = 0
+    attempt = 0
+    reform_seen = 0
+    try:
+        while True:
+            version += 1
+            if is_master:
+                store.set("elastic/round", str(version))
+            else:
+                while True:
+                    v = int(store.get("elastic/round", timeout=60.0))
+                    if v >= version:
+                        version = v
+                        break
+                    time.sleep(0.1)
+            reg.publish(version, n_local)
+            if is_master:
+                reg.form_table(version, args.nnodes,
+                               grace=args.elastic_grace)
+            table, world = reg.wait_table(version)
+            if args.node_rank not in table:
+                if not is_master:
+                    store.set(f"elastic/done/{version}/{args.node_rank}", "1")
+                    return 0  # dropped from membership; nothing to run
+                # the master hosts the registry server: even with zero
+                # local workers it must coordinate until the surviving
+                # nodes finish (or drive the next re-form round)
+                if not table:
+                    return 1
+                status, reform_seen = _master_wait_members(
+                    store, table, version, reform_seen)
+                if status == "reform":
+                    continue
+                return 0
+            start, n = table[args.node_rank]
+            print(f"[launch] elastic round {version}: world={world} "
+                  f"local={n} start_rank={start}", file=sys.stderr)
+            procs = [_spawn(args, i, rank=start + i, world=world,
+                            extra_env={"PT_ELASTIC_VERSION": str(version)})
+                     for i in range(n)]
+
+            def reform_requested():
+                nonlocal reform_seen
+                try:
+                    c = int(store.get("elastic/reform", timeout=0.2))
+                except (TimeoutError, ValueError):
+                    return False
+                if c > reform_seen:
+                    reform_seen = c
+                    return True
+                return False
+
+            rc = _watch(procs, should_abort=reform_requested)
+            if rc == 0:
+                store.set(f"elastic/done/{version}/{args.node_rank}", "1")
+                if is_master:
+                    # keep the registry alive for surviving members; if
+                    # one of them asks for a re-form, keep coordinating
+                    # with zero local workers
+                    status, reform_seen = _master_wait_members(
+                        store, table, version, reform_seen)
+                    if status == "reform":
+                        n_local = 0
+                        continue
+                return 0
+            if rc != REFORM_RC:
+                # local failure: shrink membership and ask the cluster to
+                # re-form. Only LOCAL failures consume the restart budget;
+                # a healthy node aborted by a peer's re-form request must
+                # not burn its own budget (it did nothing wrong).
+                attempt += 1
+                n_failed = sum(1 for p in procs
+                               if (p.returncode or 0) > 0)
+                n_local = n - max(1, n_failed)
+                reform_seen = store.add("elastic/reform", 1)
+                if n_local <= 0 and args.nnodes == 1:
+                    return rc
+                if attempt > args.max_restarts:
+                    return rc
+            print(f"[launch] re-forming after rc={rc}; attempt "
+                  f"{attempt}/{args.max_restarts}", file=sys.stderr)
+    finally:
+        store.close()
+
+
+def _master_wait_members(store, table, version, reform_seen,
+                         timeout=600.0):
+    """The master's launcher hosts the registry server in-process: it must
+    outlive every member node's round, or survivors lose their control
+    plane mid-job. Blocks until each member posts its done key — or a
+    member requests a re-form (returns ("reform", counter) so the master
+    loop can drive the next round even with zero local workers)."""
+    deadline = time.time() + timeout
+    pending = set(table)
+    while pending and time.time() < deadline:
+        for node in list(pending):
+            try:
+                store.get(f"elastic/done/{version}/{node}", timeout=0.2)
+                pending.discard(node)
+            except TimeoutError:
+                pass
+        try:
+            c = int(store.get("elastic/reform", timeout=0.2))
+            if c > reform_seen:
+                return ("reform", c)
+        except (TimeoutError, ValueError):
+            pass
+    return ("done", reform_seen)
 
 
 def launch(argv):
     args = _parse(argv)
+    if args.elastic:
+        return _launch_elastic(args)
     attempt = 0
     while True:
         procs = [_spawn(args, i) for i in range(args.nproc_per_node)]

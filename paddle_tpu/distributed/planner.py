@@ -1,0 +1,238 @@
+"""Auto-parallel planner v0 — structural PartitionSpec completion.
+
+Reference analog: the auto_parallel Completer + cost model
+(python/paddle/distributed/auto_parallel/completion.py:964 —
+``complete_forward_annotation`` fixed-point propagation over a per-op
+registry of DistributedOperatorImpls; ``auto_parallel/cost/`` for the
+memory/comm estimates; ``tuner/parallel_tuner.py:35`` for degree search).
+
+The reference completes shardings over a *program graph*. Here there is no
+graph before tracing, so v0 completes over *module structure* — which is
+where the information actually lives for the Megatron/ZeRO family of
+plans:
+
+- 2-D weights inside repeated blocks alternate column/row parallel by
+  dimension flow: expanding (d → k·d) = column P('fsdp','tp'),
+  contracting (k·d → d) = row P('tp','fsdp'), square = row when an
+  expanding sibling exists (the attention out-projection pattern).
+- 1-D block params shard over 'tp' iff their dim is an expanded (column
+  output) dim; model-dim vectors (biases of row layers, norms) replicate.
+- Root-level tables: vocab-ratio tables get vocab parallel P('tp','fsdp');
+  other tables (position/type embeddings) P(None,'fsdp'); root linears
+  (identified by a paired bias) P('fsdp', None) — or P('fsdp','tp') when
+  their output dim is vocab-like (an untied lm_head).
+- 3-D weights are treated as expert-stacked: leading dim 'ep', then the
+  column/row rule on the trailing two dims.
+
+Known v0 limitation (documented, ≙ the reference needing dist-op impls
+per op type): a block whose linears are ALL square (unfused q/k/v/o
+projections) cannot be column/row-disambiguated structurally; all squares
+become column-parallel there.
+"""
+
+import math
+import re
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["plan_module", "memory_report", "suggest_mesh"]
+
+_VOCAB_RATIO = 4       # dim0 >= ratio*dim1 → vocab-like table
+_TINY_OUT = 8          # output dims below this are never sharded
+
+
+def _model_dim(params) -> int:
+    """The model's hidden size: the most frequent dim across all params."""
+    from collections import Counter
+    c = Counter()
+    for _, v in params:
+        for d in v.shape:
+            if d > 1:
+                c[d] += 1
+    return c.most_common(1)[0][0] if c else 0
+
+
+def _split_module(path: str) -> Tuple[str, str]:
+    i = path.rfind(".")
+    return ("", path) if i < 0 else (path[:i], path[i + 1:])
+
+
+_REPEAT_RE = re.compile(r"\.(item_|)\d+(\.|$)")
+
+
+def _in_repeated_block(path: str) -> bool:
+    """Under a LayerList / numbered child ⇒ a repeated block param."""
+    return bool(_REPEAT_RE.search("." + path))
+
+
+def _bias_names(wname: str):
+    """Candidate bias names paired with a weight name (wqkv→bqkv,
+    pooler_w→pooler_b, weight→bias)."""
+    out = []
+    if wname.startswith("w"):
+        out.append("b" + wname[1:])
+    out.append(re.sub(r"_?w(eight)?$", lambda m: m.group(0).replace(
+        "w", "b").replace("eight", "ias"), wname))
+    return [o for o in out if o != wname]
+
+
+def plan_module(module, mesh: Optional[Mesh] = None) -> Dict[str, P]:
+    """Propose a {param-path: PartitionSpec} plan for an un-annotated
+    Module (``shard_module(model, auto=True)`` entry point). When ``mesh``
+    is given, axes that do not divide the mapped dim are dropped from the
+    proposed spec (shard_map-grade divisibility)."""
+    params = list(module.named_parameters())
+    names = {n for n, _ in params}
+    d_model = _model_dim(params)
+    vocab_dims = set()
+    plan: Dict[str, P] = {}
+
+    # pass 1: 2-D/3-D weights
+    expanded_dims_by_mod: Dict[str, set] = {}
+    for name, v in params:
+        if v.ndim not in (2, 3) or not jnp.issubdtype(v.dtype, jnp.floating):
+            continue
+        mod, leaf = _split_module(name)
+        in_block = _in_repeated_block(name)
+        if v.ndim == 3:
+            # expert-stacked (E, in, out): ep on experts + col/row rule
+            e, din, dout = v.shape
+            if din == 1:  # (E, 1, out) expert bias
+                plan[name] = (P("ep", None, "tp") if dout != d_model
+                              else P("ep", None, None))
+            elif dout >= din:
+                plan[name] = P("ep", "fsdp", "tp")
+                expanded_dims_by_mod.setdefault(mod, set()).add(dout)
+            else:
+                plan[name] = P("ep", "tp", "fsdp")
+            continue
+        d0, d1 = v.shape
+        if d1 < _TINY_OUT:  # gating / tiny heads
+            plan[name] = P(None, None) if in_block else P("fsdp", None)
+            continue
+        if not in_block:
+            if d0 >= _VOCAB_RATIO * d1 and d0 >= 256:
+                plan[name] = P("tp", "fsdp")        # vocab embedding
+                vocab_dims.add(d0)
+                continue
+            if d1 >= _VOCAB_RATIO * d0 and d1 >= 256:
+                plan[name] = P("fsdp", "tp")        # untied vocab head
+                vocab_dims.add(d1)
+                continue
+            has_bias = any(b in names or f"{mod}.{b}" in names
+                           for b in _bias_names(leaf))
+            # linear (paired bias) vs table (no bias)
+            plan[name] = P("fsdp", None) if has_bias else P(None, "fsdp")
+            continue
+        # in repeated block: dimension-flow column/row
+        if d1 > d0:
+            plan[name] = P("fsdp", "tp")            # column parallel
+            expanded_dims_by_mod.setdefault(mod, set()).add(d1)
+        elif d0 > d1:
+            plan[name] = P("tp", "fsdp")            # row parallel
+        else:
+            # square: row iff an expanding sibling exists (attention
+            # out-proj pattern); else column (v0 limitation, see docstring)
+            mod_has_expand = any(
+                w.shape[1] > w.shape[0]
+                for n2, w in params
+                if w.ndim == 2 and _split_module(n2)[0] == mod)
+            plan[name] = (P("tp", "fsdp") if mod_has_expand
+                          else P("fsdp", "tp"))
+
+    # pass 2: 1-D params
+    for name, v in params:
+        if v.ndim != 1:
+            if v.ndim == 4:  # conv OIHW: ZeRO over output channels
+                plan.setdefault(name, P("fsdp"))
+            elif v.ndim != 2 and v.ndim != 3:
+                plan.setdefault(name, P())
+            continue
+        mod, leaf = _split_module(name)
+        (dim,) = v.shape
+        if dim in vocab_dims:
+            plan[name] = P("tp")                    # vocab-size bias
+        elif _in_repeated_block(name) and \
+                dim in expanded_dims_by_mod.get(mod, ()) and dim != d_model:
+            plan[name] = P("tp")                    # column-output bias
+        else:
+            plan[name] = P(None)
+
+    if mesh is not None:
+        shape = dict(mesh.shape)
+        shapes = dict(params)
+        plan = {n: _prune_indivisible(spec, shapes[n].shape, shape)
+                for n, spec in plan.items()}
+    return plan
+
+
+def _prune_indivisible(spec: P, shape, mesh_shape) -> P:
+    out = []
+    for i, entry in enumerate(tuple(spec)):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        keep = []
+        for ax in axes:
+            if ax is None:
+                continue
+            deg = mesh_shape.get(ax, 1)
+            if deg > 1 and i < len(shape) and shape[i] % deg == 0:
+                keep.append(ax)
+        out.append(tuple(keep) if len(keep) > 1
+                   else (keep[0] if keep else None))
+    return P(*out)
+
+
+def memory_report(module, mesh: Optional[Mesh] = None,
+                  optimizer: str = "adamw",
+                  moment_bytes: int = 4) -> Dict[str, float]:
+    """Per-device memory estimate for (params + optimizer state) under the
+    proposed plan (≙ auto_parallel/cost/ estimate_cost's memory half).
+    Activations are workload-dependent and excluded — treat the result as
+    the static floor."""
+    plan = plan_module(module, mesh)
+    mesh_shape = dict(mesh.shape) if mesh is not None else {}
+
+    def shards(spec):
+        n = 1
+        for entry in tuple(spec):
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                if ax is not None:
+                    n *= mesh_shape.get(ax, 1)
+        return n
+
+    total = 0.0
+    per_device = 0.0
+    n_moments = {"sgd": 0, "momentum": 1}.get(optimizer, 2)
+    for name, v in module.named_parameters():
+        b = v.size * v.dtype.itemsize
+        opt_b = v.size * moment_bytes * n_moments
+        total += b + opt_b
+        per_device += (b + opt_b) / shards(plan.get(name, P()))
+    return {"total_bytes": total, "per_device_bytes": per_device,
+            "n_params": sum(v.size for _, v in module.named_parameters())}
+
+
+def suggest_mesh(module, n_devices: int, hbm_bytes: float = 16e9,
+                 max_tp: int = 8, budget: float = 0.6) -> Dict[str, int]:
+    """Pick (dp, fsdp, tp) degrees for ``n_devices`` so the static memory
+    floor fits in ``budget``·HBM (≙ tuner/parallel_tuner.py:35 search,
+    collapsed to the memory axis). Prefers fsdp (cheaper collectives on
+    the weight path) and escalates to tp only when sharding alone cannot
+    fit — mirroring the reference tuner's dp→sharding→mp ordering."""
+    rep = memory_report(module)
+    need = rep["total_bytes"]
+    fsdp = tp = 1
+    while (need / (fsdp * tp) > budget * hbm_bytes
+           and fsdp * tp < n_devices):
+        if fsdp * 2 * tp <= n_devices:
+            fsdp *= 2
+        elif tp < max_tp and fsdp * tp * 2 <= n_devices:
+            tp *= 2
+        else:
+            break
+    dp = max(1, n_devices // (fsdp * tp))
+    return {"dp": dp, "fsdp": fsdp, "tp": tp}

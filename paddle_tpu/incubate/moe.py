@@ -139,6 +139,25 @@ class MoELayer(Module):
         except Exception:
             return x
 
+    def _shard_groups(self, xg, g):
+        """Shard the group axis over the data axes (largest divisible
+        prefix of dp×fsdp), keeping shard_map-free GSPMD dispatch."""
+        from paddle_tpu.distributed.mesh import get_mesh
+        mesh = get_mesh()
+        if mesh is None or mesh.size == 1 or \
+                dict(mesh.shape).get("ep", 1) == 1:
+            return xg
+        shape = dict(mesh.shape)
+        axes = []
+        div = 1
+        for ax in ("dp", "fsdp"):
+            if shape.get(ax, 1) > 1 and g % (div * shape[ax]) == 0:
+                axes.append(ax)
+                div *= shape[ax]
+        if not axes:
+            return xg
+        return self._shard(xg, P(tuple(axes), None, None))
+
     def capacity(self, n_tokens: int) -> int:
         return max(4, int(math.ceil(
             self.k * n_tokens * self.capacity_factor / self.num_experts)))
@@ -159,6 +178,12 @@ class MoELayer(Module):
         if pad:
             xt = jnp.pad(xt, ((0, pad), (0, 0)))
         xg = xt.reshape(g, t, d)
+        # pin the group axis to the data axes BEFORE the dispatch einsum:
+        # without this the partitioner has no registered transition from the
+        # upstream batch sharding to the ep-sharded dispatch output and
+        # falls back to "involuntary full rematerialization" (full
+        # replication) — MULTICHIP_r02 phase D warning
+        xg = self._shard_groups(xg, g)
         cap = self.capacity(t)
         logits = xg.astype(jnp.float32) @ self.gate_w        # (G,T,E)
         gate_keys = (jax.random.split(rng_key, g)

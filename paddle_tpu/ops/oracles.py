@@ -1,0 +1,531 @@
+"""Numpy oracles for every registered op that lacked one (VERDICT r2 item 2).
+
+Reference analog: the per-op ``OpTest`` subclasses under
+python/paddle/fluid/tests/unittests/test_*_op.py (pattern op_test.py:333) —
+one numpy oracle per op, checked across execution modes. Here the oracles
+attach to the central registry after all op modules import, in one
+table-driven pass; tests/test_op_suite.py iterates the registry.
+
+Conventions:
+- ``sample()`` returns ``(args, kwargs)``; the harness calls
+  ``fn(*args, **kwargs)`` and ``np_ref(*map(np.asarray, args))`` — so the
+  oracle closes over the same kwargs.
+- ``test_fn`` adapts ops whose raw signature/output can't be compared
+  directly (tuple outputs → values only; list/str arguments → closed over).
+- Random ops get no value oracle; tests/test_op_suite.py checks their
+  distributions statistically instead (listed in RANDOM_OPS there).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import _OPS
+
+_RS = np.random.RandomState(20260729)
+
+
+def _f(*shape):
+    return _RS.randn(*shape).astype(np.float32) if shape else \
+        np.float32(_RS.randn())
+
+
+def _pos(*shape):
+    return (np.abs(_RS.randn(*shape)) + 0.5).astype(np.float32)
+
+
+def _i(hi, *shape):
+    return _RS.randint(0, hi, shape).astype(np.int32)
+
+
+def _spd(n):
+    a = _RS.randn(n, n).astype(np.float32)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def _attach(name, np_ref, sample_args_value, test_fn=None,
+            differentiable=None, jit_ok=None):
+    spec = _OPS[name]
+    spec.np_ref = np_ref
+    spec.sample_args = lambda v=sample_args_value: v
+    if test_fn is not None:
+        spec.test_fn = test_fn
+    if differentiable is not None:
+        spec.differentiable = differentiable
+    if jit_ok is not None:
+        spec.jit_ok = jit_ok
+
+
+def attach_all():
+    import paddle_tpu.tensor as T
+
+    x45 = _f(4, 5)
+    x345 = _f(3, 4, 5)
+    x44 = _f(4, 4)
+    spd4 = _spd(4)
+
+    # -- math leftovers ----------------------------------------------------
+    _attach("cumprod", lambda x: np.cumprod(x, axis=1), ((x45,), {"dim": 1}))
+    _attach("logcumsumexp",
+            lambda x: np.log(np.cumsum(np.exp(x.astype(np.float64)),
+                                       axis=-1)).astype(np.float32),
+            ((x45,), {}))
+    _attach("lerp", lambda x, y: x + 0.3 * (y - x),
+            ((x45, _f(4, 5)), {"weight": 0.3}))
+    _attach("addmm", lambda i, x, y: 0.5 * i + 2.0 * (x @ y),
+            ((_f(4, 3), _f(4, 5), _f(5, 3)), {"beta": 0.5, "alpha": 2.0}))
+    mplex_idx = _i(2, 4, 1)
+    _attach("multiplex",
+            lambda a, b: np.where(mplex_idx.reshape(-1, 1) == 0, a, b),
+            ((x45, _f(4, 5)), {}),
+            test_fn=lambda a, b: T.multiplex([a, b], jnp.asarray(mplex_idx)))
+    _attach("outer", np.outer, ((_f(4), _f(5)), {}))
+    take_idx = _i(20, 7)
+    _attach("take", lambda x: x.reshape(-1)[take_idx],
+            ((x45,), {}),
+            test_fn=lambda x: T.take(x, jnp.asarray(take_idx)))
+
+    # -- manipulation ------------------------------------------------------
+    _attach("reshape", lambda x: x.reshape(5, 4), ((x45,), {"shape": (5, 4)}))
+    _attach("flatten", lambda x: x.reshape(3, 20),
+            ((x345,), {"start_axis": 1, "stop_axis": 2}))
+    _attach("transpose", lambda x: x.transpose(2, 0, 1),
+            ((x345,), {"perm": (2, 0, 1)}))
+    _attach("moveaxis", lambda x: np.moveaxis(x, 0, 2),
+            ((x345,), {"source": 0, "destination": 2}))
+    _attach("swapaxes", lambda x: np.swapaxes(x, 0, 1),
+            ((x345,), {"axis1": 0, "axis2": 1}))
+    _attach("squeeze", lambda x: np.squeeze(x, 1),
+            ((_f(3, 1, 5),), {"axis": 1}))
+    _attach("unsqueeze", lambda x: x[:, None], ((x45,), {"axis": 1}))
+    _attach("concat", lambda a, b: np.concatenate([a, b], axis=1),
+            ((x45, _f(4, 3)), {}),
+            test_fn=lambda a, b: T.concat([a, b], axis=1))
+    _attach("stack", lambda a, b: np.stack([a, b], axis=1),
+            ((x45, _f(4, 5)), {}),
+            test_fn=lambda a, b: T.stack([a, b], axis=1))
+    _attach("unstack", lambda x: x.transpose(1, 0, 2), ((x345,), {}),
+            test_fn=lambda x: jnp.stack(T.unstack(x, axis=1)))
+    _attach("split", lambda x: np.stack(np.split(x, 2, axis=0)),
+            ((x44,), {}),
+            test_fn=lambda x: jnp.stack(T.split(x, 2, axis=0)))
+    _attach("chunk", lambda x: np.stack(np.split(x, 2, axis=1)),
+            ((x44,), {}),
+            test_fn=lambda x: jnp.stack(T.chunk(x, 2, axis=1)))
+    _attach("tile", lambda x: np.tile(x, (2, 3)),
+            ((x45,), {"repeat_times": (2, 3)}))
+    _attach("repeat_interleave", lambda x: np.repeat(x, 2, axis=1),
+            ((x45,), {"repeats": 2, "axis": 1}))
+    _attach("expand", lambda x: np.broadcast_to(x, (3, 4, 5)),
+            ((_f(1, 4, 5),), {"shape": (3, 4, 5)}))
+    _attach("expand_as", lambda x, y: np.broadcast_to(x, y.shape),
+            ((_f(1, 5), _f(4, 5)), {}))
+    _attach("broadcast_to", lambda x: np.broadcast_to(x, (6, 4, 5)),
+            ((x45,), {"shape": (6, 4, 5)}))
+    _attach("broadcast_tensors",
+            lambda a, b: np.stack(np.broadcast_arrays(a, b)),
+            ((_f(1, 5), _f(4, 1)), {}),
+            test_fn=lambda a, b: jnp.stack(T.broadcast_tensors([a, b])),
+            differentiable=False)
+    _attach("flip", lambda x: np.flip(x, (0, 1)), ((x45,), {"axis": (0, 1)}))
+    _attach("roll", lambda x: np.roll(x, 2, axis=1),
+            ((x45,), {"shifts": 2, "axis": 1}))
+    g_idx = _i(4, 6)
+    _attach("gather", lambda x: x[g_idx], ((x45,), {}),
+            test_fn=lambda x: T.gather(x, jnp.asarray(g_idx), axis=0))
+    gnd_idx = _i(3, 5, 2)
+    _attach("gather_nd", lambda x: x[gnd_idx[:, 0], gnd_idx[:, 1]],
+            ((x345[:3, :3],), {}),
+            test_fn=lambda x: T.gather_nd(x, jnp.asarray(gnd_idx)))
+    sc_idx = np.array([0, 2, 3], np.int32)
+
+    def _scatter_np(x, u):
+        out = x.copy()
+        out[sc_idx] = u
+        return out
+    _attach("scatter", _scatter_np, ((x45, _f(3, 5)), {}),
+            test_fn=lambda x, u: T.scatter(x, jnp.asarray(sc_idx), u))
+    snd_idx = np.array([[1], [3]], np.int32)
+
+    def _scatter_nd_np(u):
+        out = np.zeros((6, 5), np.float32)
+        np.add.at(out, snd_idx[:, 0], u)
+        return out
+    _attach("scatter_nd", _scatter_nd_np, ((_f(2, 5),), {}),
+            test_fn=lambda u: T.scatter_nd(jnp.asarray(snd_idx), u, (6, 5)))
+
+    def _scatter_nd_add_np(x, u):
+        out = x.copy()
+        np.add.at(out, snd_idx[:, 0], u)
+        return out
+    _attach("scatter_nd_add", _scatter_nd_add_np, ((x45, _f(2, 5)), {}),
+            test_fn=lambda x, u: T.scatter_nd_add(x, jnp.asarray(snd_idx), u))
+    pa_idx = _i(4, 4, 5)
+    _attach("put_along_axis",
+            lambda x, v: _put_ref(x, pa_idx, v),
+            ((x45, _f(4, 5)), {}),
+            test_fn=lambda x, v: T.put_along_axis(
+                x, jnp.asarray(pa_idx), v, axis=0))
+    _attach("take_along_axis",
+            lambda x: np.take_along_axis(x, pa_idx.astype(np.int64), 0),
+            ((x45,), {}),
+            test_fn=lambda x: T.take_along_axis(x, jnp.asarray(pa_idx),
+                                                axis=0))
+    is_idx = _i(4, 6)
+    _attach("index_select", lambda x: x[is_idx], ((x45,), {}),
+            test_fn=lambda x: T.index_select(x, jnp.asarray(is_idx), axis=0))
+    ismp_idx = _i(5, 4, 3)
+    _attach("index_sample",
+            lambda x: np.take_along_axis(x, ismp_idx.astype(np.int64), 1),
+            ((x45,), {}),
+            test_fn=lambda x: T.index_sample(x, jnp.asarray(ismp_idx)))
+    ia_idx = np.array([0, 2], np.int32)
+
+    def _index_add_np(x, v):
+        out = x.copy()
+        np.add.at(out, ia_idx, v)
+        return out
+    _attach("index_add", _index_add_np, ((x45, _f(2, 5)), {}),
+            test_fn=lambda x, v: T.index_add(x, jnp.asarray(ia_idx), 0, v))
+    msk = _RS.rand(4, 5) > 0.5
+    _attach("masked_select", lambda x: x[msk], ((x45,), {}),
+            test_fn=lambda x: T.masked_select(x, jnp.asarray(msk)),
+            jit_ok=False, differentiable=False)
+    _attach("masked_fill", lambda x: np.where(msk, 9.0, x), ((x45,), {}),
+            test_fn=lambda x: T.masked_fill(x, jnp.asarray(msk), 9.0))
+    _attach("where", lambda c, x, y: np.where(c, x, y),
+            ((msk, x45, _f(4, 5)), {}), differentiable=False)
+    nz = (_RS.rand(4, 5) > 0.6).astype(np.float32)
+    _attach("nonzero", lambda x: np.stack(np.nonzero(x), axis=1),
+            ((nz,), {}), jit_ok=False, differentiable=False)
+    _attach("pad",
+            lambda x: np.pad(x, [(0, 0), (0, 0), (3, 4), (1, 2)]),
+            ((_f(2, 3, 4, 5),), {"pad": [1, 2, 3, 4]}))
+    uq = _i(5, 20).astype(np.float32)
+    _attach("unique", lambda x: np.unique(x), ((uq,), {}),
+            jit_ok=False, differentiable=False)
+    ucq = np.array([1, 1, 2, 2, 2, 3, 1, 1], np.float32)
+    _attach("unique_consecutive",
+            lambda x: np.array([1, 2, 3, 1], np.float32), ((ucq,), {}),
+            jit_ok=False, differentiable=False)
+    cplx = _f(4, 6, 2)
+    _attach("as_complex", lambda x: x[..., 0] + 1j * x[..., 1],
+            ((cplx,), {}), differentiable=False)
+    zc = (cplx[..., 0] + 1j * cplx[..., 1]).astype(np.complex64)
+    _attach("as_real",
+            lambda z: np.stack([z.real, z.imag], axis=-1), ((zc,), {}),
+            differentiable=False)
+    _attach("real", np.real, ((zc,), {}), differentiable=False)
+    _attach("imag", np.imag, ((zc,), {}), differentiable=False)
+    _attach("cast", lambda x: x.astype(np.int32),
+            ((x45 * 10,), {"dtype": "int32"}), differentiable=False)
+    _attach("crop", lambda x: x[1:3, 2:5],
+            ((x45,), {"shape": (2, 3), "offsets": (1, 2)}))
+    _attach("strided_slice", lambda x: x[:, 1:5:2],
+            ((x45,), {"axes": [1], "starts": [1], "ends": [5],
+                      "strides": [2]}))
+    _attach("slice", lambda x: x[:, 1:4],
+            ((x45,), {"axes": [1], "starts": [1], "ends": [4]}))
+    shard_in = _i(20, 8)
+
+    def _shard_index_np(idx):
+        # index_num=20, nshards=2, shard_id=0 → ids in [0,10) map to
+        # local id, others to ignore_value -1
+        size = 20 // 2
+        ok = (idx >= 0) & (idx < size)
+        return np.where(ok, idx - 0 * size, -1).astype(idx.dtype)
+    _attach("shard_index", _shard_index_np, ((shard_in,), {
+        "index_num": 20, "nshards": 2, "shard_id": 0}),
+        differentiable=False)
+    _attach("tensordot", lambda x, y: np.tensordot(x, y, axes=1),
+            ((x45, _f(5, 3)), {"axes": 1}))
+    _attach("diag", lambda x: np.diag(x, k=1), ((x44,), {"offset": 1}))
+    _attach("diagflat", lambda x: np.diagflat(x, 1), ((_f(4),), {"offset": 1}))
+
+    def _diag_embed_np(x):
+        out = np.zeros(x.shape + (x.shape[-1],), x.dtype)
+        ii = np.arange(x.shape[-1])
+        out[..., ii, ii] = x
+        return out
+    _attach("diag_embed", _diag_embed_np, ((x45,), {}))
+    _attach("tril", lambda x: np.tril(x, -1), ((x44,), {"diagonal": -1}))
+    _attach("triu", lambda x: np.triu(x, 1), ((x44,), {"diagonal": 1}))
+    _attach("meshgrid",
+            lambda a, b: np.stack(np.meshgrid(a, b, indexing="ij")),
+            ((_f(4), _f(5)), {}),
+            test_fn=lambda a, b: jnp.stack(T.meshgrid(a, b)),
+            differentiable=False)
+    _attach("unbind", lambda x: x.transpose(1, 0, 2), ((x345,), {}),
+            test_fn=lambda x: jnp.stack(T.unbind(x, axis=1)))
+    _attach("numel", lambda x: np.asarray(x.size), ((x45,), {}),
+            differentiable=False)
+    _attach("shape", lambda x: np.asarray(x.shape), ((x345,), {}),
+            differentiable=False)
+    _attach("rank", lambda x: np.asarray(x.ndim), ((x345,), {}),
+            differentiable=False)
+    _attach("is_empty", lambda x: np.asarray(False), ((x45,), {}),
+            differentiable=False)
+    _attach("view", lambda x: x.reshape(5, 4),
+            ((x45,), {"shape_or_dtype": (5, 4)}))
+    _attach("view_as", lambda x, y: x.reshape(y.shape),
+            ((x45, _f(5, 4)), {}))
+    _attach("atleast_1d", lambda x: np.atleast_1d(x), ((_f(),), {}),
+            differentiable=False)
+    _attach("atleast_2d", lambda x: np.atleast_2d(x), ((_f(4),), {}),
+            differentiable=False)
+    _attach("atleast_3d", lambda x: np.atleast_3d(x), ((x45,), {}),
+            differentiable=False)
+
+    # -- creation ----------------------------------------------------------
+    _attach("to_tensor", lambda x: x, ((x45,), {}))
+    _attach("zeros", lambda: np.zeros((3, 4), np.float32),
+            ((), {"shape": (3, 4)}), differentiable=False)
+    _attach("ones", lambda: np.ones((3, 4), np.float32),
+            ((), {"shape": (3, 4)}), differentiable=False)
+    _attach("full", lambda: np.full((3, 4), 2.5, np.float32),
+            ((), {"shape": (3, 4), "fill_value": 2.5}),
+            differentiable=False)
+    _attach("zeros_like", np.zeros_like, ((x45,), {}), differentiable=False)
+    _attach("ones_like", np.ones_like, ((x45,), {}), differentiable=False)
+    _attach("full_like", lambda x: np.full_like(x, 7.0),
+            ((x45,), {"fill_value": 7.0}), differentiable=False)
+    _attach("empty", lambda: np.zeros((3, 4), np.float32),
+            ((), {"shape": (3, 4)}), differentiable=False)
+    _attach("empty_like", np.zeros_like, ((x45,), {}), differentiable=False)
+    _attach("arange", lambda: np.arange(2, 20, 3, dtype=np.float32),
+            ((), {"start": 2, "end": 20, "step": 3, "dtype": "float32"}),
+            differentiable=False)
+    _attach("linspace", lambda: np.linspace(0, 1, 7, dtype=np.float32),
+            ((), {"start": 0.0, "stop": 1.0, "num": 7}),
+            differentiable=False)
+    _attach("logspace",
+            lambda: np.logspace(0, 2, 5, base=10.0, dtype=np.float32),
+            ((), {"start": 0.0, "stop": 2.0, "num": 5}),
+            differentiable=False)
+    _attach("eye", lambda: np.eye(4, 6, dtype=np.float32),
+            ((), {"num_rows": 4, "num_columns": 6}), differentiable=False)
+    _attach("tril_indices", lambda: np.stack(np.tril_indices(4, -1, 5)),
+            ((), {"row": 4, "col": 5, "offset": -1}), differentiable=False)
+    _attach("triu_indices", lambda: np.stack(np.triu_indices(4, 1, 5)),
+            ((), {"row": 4, "col": 5, "offset": 1}), differentiable=False)
+    _attach("clone", lambda x: x, ((x45,), {}))
+    _attach("assign", lambda x: x, ((x45,), {}))
+    _attach("complex", lambda r, i: (r + 1j * i).astype(np.complex64),
+            ((x45, _f(4, 5)), {}), differentiable=False)
+    _attach("polar",
+            lambda a, t: (a * np.exp(1j * t)).astype(np.complex64),
+            ((_pos(4, 5), _f(4, 5)), {}), differentiable=False)
+    oh_in = _i(6, 7)
+    _attach("one_hot", lambda x: np.eye(6, dtype=np.float32)[x],
+            ((oh_in,), {"num_classes": 6}), differentiable=False)
+
+    # -- linalg ------------------------------------------------------------
+    _attach("mm", np.matmul, ((x45, _f(5, 3)), {}))
+    _attach("dot", lambda a, b: np.asarray(np.dot(a, b)),
+            ((_f(5), _f(5)), {}))
+    _attach("mv", lambda a, b: a @ b, ((x45, _f(5)), {}))
+    _attach("cond", lambda x: np.asarray(np.linalg.cond(x), np.float32),
+            ((spd4,), {}), differentiable=False)
+    _attach("slogdet", lambda x: np.stack(np.linalg.slogdet(x)),
+            ((spd4,), {}))
+    _attach("pinv", lambda x: np.linalg.pinv(x, rcond=1e-15),
+            ((x45,), {}), differentiable=False)
+    _attach("solve", np.linalg.solve, ((spd4, _f(4, 3)), {}))
+    tri_u = np.triu(_RS.randn(4, 4)).astype(np.float32) + 3 * np.eye(
+        4, dtype=np.float32)
+    _attach("triangular_solve",
+            lambda a, b: np.linalg.solve(np.triu(a), b),
+            ((tri_u, _f(4, 2)), {"upper": True}))
+    _attach("cholesky", np.linalg.cholesky, ((spd4,), {}))
+    chol_l = np.linalg.cholesky(_spd(4)).astype(np.float32)
+    _attach("cholesky_solve",
+            lambda b, L: np.linalg.solve(L @ L.T, b),
+            ((_f(4, 2), chol_l), {"upper": False}))
+
+    def _lu_recon(x):
+        lu_mat, piv = T.lu(x)
+        lu_mat = np.asarray(lu_mat)
+        piv = np.asarray(piv)
+        n = x.shape[0] if hasattr(x, "shape") else 4
+        l = np.tril(lu_mat, -1) + np.eye(n, dtype=lu_mat.dtype)
+        u = np.triu(lu_mat)
+        a = l @ u
+        # undo partial-pivot row swaps (LAPACK ipiv convention)
+        for k in reversed(range(len(piv))):
+            a[[k, piv[k]]] = a[[piv[k], k]]
+        return jnp.asarray(a)
+    _attach("lu", lambda x: x, ((spd4,), {}), test_fn=_lu_recon,
+            jit_ok=False, differentiable=False)
+    _attach("qr", lambda x: x, ((x45[:, :4],), {}),
+            test_fn=lambda x: (lambda qr_: qr_[0] @ qr_[1])(T.qr(x)))
+    _attach("svd", lambda x: np.linalg.svd(x, compute_uv=False),
+            ((x45,), {}),
+            test_fn=lambda x: T.svd(x)[1], differentiable=False)
+    _attach("eig",
+            lambda x: np.sort(np.abs(np.linalg.eigvals(x))),
+            ((x44,), {}),
+            test_fn=lambda x: jnp.sort(jnp.abs(T.eig(x)[0])),
+            jit_ok=False, differentiable=False)
+    _attach("eigh", lambda x: np.linalg.eigvalsh(x), ((spd4,), {}),
+            test_fn=lambda x: T.eigh(x)[0], differentiable=False)
+    _attach("eigvals",
+            lambda x: np.sort(np.abs(np.linalg.eigvals(x))),
+            ((x44,), {}),
+            test_fn=lambda x: jnp.sort(jnp.abs(T.eigvals(x))),
+            jit_ok=False, differentiable=False)
+    _attach("eigvalsh", np.linalg.eigvalsh, ((spd4,), {}),
+            differentiable=False)
+    _attach("matrix_power", lambda x: np.linalg.matrix_power(x, 3),
+            ((spd4 / 4.0,), {"n": 3}))
+    _attach("matrix_rank",
+            lambda x: np.asarray(np.linalg.matrix_rank(x)),
+            ((x45,), {}), differentiable=False)
+    _attach("multi_dot", lambda a, b, c: a @ b @ c,
+            ((_f(3, 4), x45, _f(5, 2)), {}),
+            test_fn=lambda a, b, c: T.multi_dot([a, b, c]),
+            differentiable=False)
+    _attach("histogram",
+            lambda x: np.histogram(x, bins=10, range=(-3, 3))[0],
+            ((x45,), {"bins": 10, "min": -3, "max": 3}),
+            differentiable=False)
+    bc_in = _i(6, 30)
+    _attach("bincount", lambda x: np.bincount(x, minlength=8),
+            ((bc_in,), {"minlength": 8}), differentiable=False,
+            jit_ok=False)
+    _attach("einsum", lambda a, b: np.einsum("ij,jk->ik", a, b),
+            ((x45, _f(5, 3)), {}),
+            test_fn=lambda a, b: T.einsum("ij,jk->ik", a, b))
+    _attach("lstsq", lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0],
+            ((_f(6, 4), _f(6, 2)), {}),
+            test_fn=lambda a, b: T.lstsq(a, b)[0], differentiable=False)
+    _attach("corrcoef", np.corrcoef, ((_f(4, 10),), {}),
+            differentiable=False)
+    _attach("cov", lambda x: np.cov(x, ddof=1), ((_f(4, 10),), {}))
+
+    # -- logic -------------------------------------------------------------
+    _attach("equal_all", lambda a, b: np.asarray(np.array_equal(a, b)),
+            ((x45, x45.copy()), {}), differentiable=False)
+    _attach("allclose",
+            lambda a, b: np.asarray(np.allclose(a, b, rtol=1e-5, atol=1e-8)),
+            ((x45, x45 + 1e-9), {}), differentiable=False)
+    _attach("is_tensor", lambda x: np.asarray(True),
+            ((jnp.asarray(x45),), {}), differentiable=False)
+
+    # -- search ------------------------------------------------------------
+    _attach("topk", lambda x: -np.sort(-x, axis=-1)[..., :3],
+            ((x45,), {}),
+            test_fn=lambda x: T.topk(x, k=3)[0])
+    ss_seq = np.sort(_f(10))
+    _attach("searchsorted", lambda s, v: np.searchsorted(s, v).astype(
+        np.int64), ((ss_seq, _f(6)), {}), differentiable=False)
+    _attach("kthvalue", lambda x: np.sort(x, axis=-1)[..., 1],
+            ((x45,), {}),
+            test_fn=lambda x: T.kthvalue(x, k=2)[0])
+    md_in = _i(3, 4, 9).astype(np.float32)
+
+    def _mode_np(x):
+        out = np.empty(x.shape[0], x.dtype)
+        for r in range(x.shape[0]):
+            vals, cnt = np.unique(x[r], return_counts=True)
+            # smallest value among the most frequent (scipy/torch tie rule)
+            out[r] = vals[cnt == cnt.max()].min()
+        return out
+    _attach("mode", _mode_np, ((md_in,), {}),
+            test_fn=lambda x: T.mode(x)[0], differentiable=False,
+            jit_ok=False)
+    if_idx = np.array([0, 3], np.int32)
+    _attach("index_fill",
+            lambda x: _index_fill_np(x, if_idx, 5.0), ((x45,), {}),
+            test_fn=lambda x: T.index_fill(x, jnp.asarray(if_idx), 0, 5.0))
+    _attach("bucketize", lambda x: np.searchsorted(ss_seq, x).astype(
+        np.int64), ((_f(6),), {}),
+        test_fn=lambda x: T.bucketize(x, jnp.asarray(ss_seq)),
+        differentiable=False)
+
+    # -- stat --------------------------------------------------------------
+    # -- surface growth (r3): new ops registered this round ----------------
+    _attach("vsplit", lambda x: np.stack(np.split(x, 2, 0)), ((x44,), {}),
+            test_fn=lambda x: jnp.stack(T.vsplit(x, 2)))
+    _attach("hsplit", lambda x: np.stack(np.split(x, 2, 1)), ((x44,), {}),
+            test_fn=lambda x: jnp.stack(T.hsplit(x, 2)))
+    _attach("dsplit", lambda x: np.stack(np.split(x, 2, 2)),
+            ((_f(3, 4, 6),), {}),
+            test_fn=lambda x: jnp.stack(T.dsplit(x, 2)))
+    _attach("hstack", lambda a, b: np.hstack([a, b]),
+            ((x45, _f(4, 3)), {}),
+            test_fn=lambda a, b: T.hstack([a, b]))
+    _attach("vstack", lambda a, b: np.vstack([a, b]),
+            ((x45, _f(2, 5)), {}),
+            test_fn=lambda a, b: T.vstack([a, b]))
+
+    def _fill_diag_np(x):
+        out = x.copy()
+        np.fill_diagonal(out, 3.5)
+        return out
+    _attach("fill_diagonal", _fill_diag_np, ((x44,), {"value": 3.5}))
+
+    def _fill_diag_t_np(x, y):
+        out = x.copy()
+        n = min(x.shape[0], x.shape[1] - 1)
+        out[np.arange(n), np.arange(n) + 1] = y.reshape(-1)[:n]
+        return out
+    _attach("fill_diagonal_tensor", _fill_diag_t_np,
+            ((x45, _f(4)), {"offset": 1}))
+    _attach("tolist", lambda x: x, ((x45,), {}),
+            test_fn=lambda x: jnp.asarray(T.tolist(x)),
+            jit_ok=False, differentiable=False)
+    _attach("add_n", lambda a, b, c: a + b + c,
+            ((x45, _f(4, 5), _f(4, 5)), {}),
+            test_fn=lambda a, b, c: T.add_n([a, b, c]))
+    _attach("dist", lambda a, b: np.asarray(
+        np.sqrt(((a - b) ** 2).sum()), np.float32),
+        ((x45, _f(4, 5)), {"p": 2}))
+    _attach("frexp", lambda x: np.frexp(x)[0], ((_pos(4, 5),), {}),
+            test_fn=lambda x: T.frexp(x)[0], differentiable=False)
+    _attach("inverse", np.linalg.inv, ((spd4,), {}))
+    _attach("renorm",
+            lambda x: x * np.minimum(
+                1.0, 1.5 / (np.abs(x ** 2).sum(
+                    axis=(1,), keepdims=True) ** 0.5 + 1e-7)),
+            ((x45,), {"p": 2, "axis": 0, "max_norm": 1.5}))
+    _attach("trapezoid", lambda y: np.trapezoid(y, dx=0.5, axis=-1)
+            if hasattr(np, "trapezoid") else np.trapz(y, dx=0.5, axis=-1),
+            ((x45,), {"dx": 0.5}))
+    _attach("broadcast_shape", lambda: np.array([4, 5]), ((), {}),
+            test_fn=lambda: jnp.asarray(T.broadcast_shape((4, 1), (1, 5))),
+            differentiable=False, jit_ok=False)
+    _attach("is_complex", lambda x: np.asarray(False), ((x45,), {}),
+            differentiable=False, jit_ok=False)
+    _attach("is_floating_point", lambda x: np.asarray(True), ((x45,), {}),
+            differentiable=False, jit_ok=False)
+    _attach("is_integer", lambda x: np.asarray(False), ((x45,), {}),
+            differentiable=False, jit_ok=False)
+
+    def _lu_unpack_recon(x):
+        lu_mat, piv = T.lu(x)
+        p, l, u = T.lu_unpack(lu_mat, piv)
+        return p @ l @ u
+    _attach("lu_unpack", lambda x: x, ((spd4,), {}),
+            test_fn=_lu_unpack_recon, jit_ok=False, differentiable=False)
+    _attach("vander", lambda x: np.vander(x, 4), ((_f(5),), {"n": 4}))
+
+    # -- stat --------------------------------------------------------------
+    _attach("quantile", lambda x: np.quantile(
+        x.astype(np.float64), 0.3, axis=1).astype(np.float32),
+        ((x45,), {"q": 0.3, "axis": 1}))
+    nanq = x45.copy()
+    nanq[0, 0] = np.nan
+    _attach("nanquantile", lambda x: np.nanquantile(
+        x.astype(np.float64), 0.7, axis=1).astype(np.float32),
+        ((nanq,), {"q": 0.7, "axis": 1}), differentiable=False)
+
+
+def _put_ref(x, idx, v):
+    out = x.copy()
+    np.put_along_axis(out, idx.astype(np.int64), v, 0)
+    return out
+
+
+def _index_fill_np(x, idx, value):
+    out = x.copy()
+    out[idx] = value
+    return out

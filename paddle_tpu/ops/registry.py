@@ -23,6 +23,11 @@ class OpSpec:
     sample_args: Optional[Callable] = None  # () -> (args, kwargs) for OpTest
     ref: str = ""                           # reference file:line citation
     differentiable: bool = True
+    test_fn: Optional[Callable] = None      # harness adapter when fn's raw
+    # signature/output doesn't fit the oracle comparison (tuple outputs,
+    # string args, list inputs); wraps fn, never replaces it
+    jit_ok: bool = True                     # False for host-side dynamic-
+    # shape ops (masked_select/unique/eig...) that cannot trace
 
 
 _OPS: Dict[str, OpSpec] = {}

@@ -123,3 +123,20 @@ for _n in ["to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
            "logspace", "eye", "tril_indices", "triu_indices", "clone",
            "assign", "complex", "polar", "one_hot"]:
     _reg(_n, globals()[_n])
+
+
+def vander(x, n=None, increasing=False):
+    """Vandermonde matrix (ref: python/paddle/tensor/creation.py vander)."""
+    return jnp.vander(jnp.asarray(x), N=n, increasing=increasing)
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, key=None):
+    """Gaussian sample (ref creation.py gaussian → gaussian_random op)."""
+    from paddle_tpu.dtypes import to_dtype
+    from paddle_tpu.tensor.random_ops import normal
+    out = normal(mean=mean, std=std, shape=shape, key=key)
+    return out.astype(to_dtype(dtype)) if dtype is not None else out
+
+
+_reg("vander", vander)
+_reg("gaussian", gaussian)

@@ -243,3 +243,34 @@ _reg("einsum", einsum, None)
 _reg("lstsq", lstsq, None, diff=False)
 _reg("corrcoef", corrcoef, None)
 _reg("cov", cov, None)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack LAPACK-packed LU factorization into (P, L, U)
+    (ref: python/paddle/tensor/linalg.py lu_unpack → lu_unpack_op).
+    Supports batched inputs; pivots are 0-based (matching ``lu`` above)."""
+    lu_m = jnp.asarray(lu_data)
+    n = lu_m.shape[-2]
+    m = lu_m.shape[-1]
+    k = min(n, m)
+    l = u = pmat = None
+    if unpack_ludata:
+        l = jnp.tril(lu_m[..., :, :k], -1) + jnp.eye(n, k, dtype=lu_m.dtype)
+        u = jnp.triu(lu_m[..., :k, :])
+    if unpack_pivots:
+        piv = np.asarray(jax.device_get(lu_pivots))
+        batch = piv.shape[:-1]
+        piv2 = piv.reshape(-1, piv.shape[-1])
+        mats = []
+        for row in piv2:
+            perm = np.arange(n)
+            for i, p in enumerate(row):
+                perm[[i, p]] = perm[[p, i]]
+            mats.append(np.eye(n, dtype=np.float32)[:, perm])
+        pmat = jnp.asarray(
+            np.stack(mats).reshape(batch + (n, n)) if batch
+            else mats[0])
+    return pmat, l, u
+
+
+_reg("lu_unpack", lu_unpack, None, diff=False)

@@ -425,3 +425,72 @@ for _n in ["reshape", "flatten", "transpose", "moveaxis", "swapaxes",
            "triu", "meshgrid", "unbind", "numel", "shape", "rank", "is_empty",
            "view", "view_as", "atleast_1d", "atleast_2d", "atleast_3d"]:
     _reg(_n, globals()[_n])
+
+
+def vsplit(x, num_or_sections):
+    """Split along axis 0 (ref: python/paddle/tensor/manipulation.py
+    vsplit)."""
+    return split(x, num_or_sections, axis=0)
+
+
+def hsplit(x, num_or_sections):
+    x = jnp.asarray(x)
+    return split(x, num_or_sections, axis=1 if x.ndim > 1 else 0)
+
+
+def dsplit(x, num_or_sections):
+    return split(x, num_or_sections, axis=2)
+
+
+def hstack(x):
+    return jnp.hstack([jnp.asarray(t) for t in x])
+
+
+def vstack(x):
+    return jnp.vstack([jnp.asarray(t) for t in x])
+
+
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """Functional fill_diagonal_ (the reference mutates; XLA programs are
+    pure, so this returns a copy — ref manipulation.py fill_diagonal_).
+
+    ndim > 2 writes the hyper-diagonal x[i, i, ..., i] like the reference
+    (and np.fill_diagonal); ``wrap`` repeats the diagonal every m+1 rows of
+    a tall 2-D matrix (numpy wrap semantics; offset must be 0 with wrap)."""
+    x = jnp.asarray(x)
+    if x.ndim > 2:
+        k = min(x.shape)
+        idx = (jnp.arange(k),) * x.ndim
+        return x.at[idx].set(value)
+    n, m = x.shape
+    ii = jnp.arange(n)[:, None]
+    jj = jnp.arange(m)[None, :]
+    if wrap and n > m:
+        if offset:
+            raise ValueError("offset must be 0 when wrap=True")
+        return jnp.where((jj == ii % (m + 1)), value, x)
+    return jnp.where(jj - ii == offset, value, x)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """ref manipulation.py fill_diagonal_tensor: write y onto the
+    (dim1, dim2) diagonal of x (functional copy)."""
+    x = jnp.asarray(x)
+    if x.ndim != 2 or (dim1, dim2) != (0, 1):
+        raise NotImplementedError("fill_diagonal_tensor supports 2-D "
+                                  "(dim1=0, dim2=1); transpose first")
+    n, m = x.shape
+    diag_len = min(n, m - offset) if offset >= 0 else min(n + offset, m)
+    rows = jnp.arange(diag_len) - min(offset, 0)
+    cols = jnp.arange(diag_len) + max(offset, 0)
+    return x.at[rows, cols].set(jnp.asarray(y).reshape(-1)[:diag_len])
+
+
+def tolist(x):
+    """Host transfer + nested python lists (ref Tensor.tolist)."""
+    return np.asarray(jax.device_get(x)).tolist()
+
+
+for _n in ["vsplit", "hsplit", "dsplit", "hstack", "vstack",
+           "fill_diagonal", "fill_diagonal_tensor", "tolist"]:
+    _reg(_n, globals()[_n])

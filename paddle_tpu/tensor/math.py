@@ -224,7 +224,8 @@ def cummin(x, axis=-1):
 
 
 def logcumsumexp(x, axis=-1):
-    return jax.lax.cumlogsumexp(jnp.asarray(x), axis=axis)
+    x = jnp.asarray(x)
+    return jax.lax.cumlogsumexp(x, axis=axis % x.ndim)
 
 
 _reg("cumsum", cumsum, lambda x: np.cumsum(x.reshape(-1)),
@@ -330,3 +331,88 @@ _reg("inner", inner, np.inner, lambda: ((_sample("real"), _sample("real")), {}))
 _reg("outer", outer, None)
 _reg("nan_to_num", nan_to_num, np.nan_to_num, lambda: ((_sample("real"),), {}))
 _reg("take", take, None, diff=False)
+
+
+def add_n(inputs):
+    """Sum a list of tensors (ref: python/paddle/tensor/math.py add_n →
+    sum_op); XLA fuses the chain into one kernel."""
+    if not isinstance(inputs, (list, tuple)):
+        return jnp.asarray(inputs)
+    out = jnp.asarray(inputs[0])
+    for t in inputs[1:]:
+        out = out + jnp.asarray(t)
+    return out
+
+
+def dist(x, y, p=2):
+    """p-norm of (x - y) (ref math.py dist → dist_op)."""
+    d = jnp.abs(jnp.asarray(x) - jnp.asarray(y))
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+def frexp(x):
+    """(mantissa, exponent) decomposition (ref math.py frexp)."""
+    return jnp.frexp(jnp.asarray(x))
+
+
+def increment(x, value=1.0):
+    """Functional increment (the reference mutates in place)."""
+    return jnp.asarray(x) + value
+
+
+def inverse(x):
+    """Matrix inverse (ref math.py inverse → inverse_op)."""
+    return jnp.linalg.inv(jnp.asarray(x))
+
+
+def renorm(x, p, axis, max_norm):
+    """Clamp the p-norm of every slice along ``axis`` to ``max_norm``
+    (ref math.py renorm → renorm_op)."""
+    x = jnp.asarray(x)
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    """Trapezoidal integration (ref math.py trapezoid)."""
+    if x is not None:
+        return jnp.trapezoid(jnp.asarray(y), jnp.asarray(x), axis=axis)
+    return jnp.trapezoid(jnp.asarray(y), dx=1.0 if dx is None else dx,
+                         axis=axis)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Static broadcast-shape utility (ref math.py broadcast_shape)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def is_complex(x):
+    return jnp.iscomplexobj(jnp.asarray(x))
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+_reg("add_n", add_n, None)
+_reg("dist", dist, None)
+_reg("frexp", frexp, None, diff=False)
+_reg("increment", increment, lambda x: x + 1.0,
+     lambda: ((_sample("real"),), {}))
+_reg("inverse", inverse, None)
+_reg("renorm", renorm, None)
+_reg("trapezoid", trapezoid, None)
+_reg("broadcast_shape", broadcast_shape, None, diff=False)
+_reg("is_complex", is_complex, None, diff=False)
+_reg("is_floating_point", is_floating_point, None, diff=False)
+_reg("is_integer", is_integer, None, diff=False)

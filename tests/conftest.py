@@ -21,6 +21,13 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# attach numpy oracles to every registered op (OpTest backbone, SURVEY §4);
+# test-only scaffolding, deliberately NOT run on production import
+import paddle_tpu  # noqa: E402,F401
+from paddle_tpu.ops import oracles as _oracles  # noqa: E402
+
+_oracles.attach_all()
+
 
 @pytest.fixture(autouse=True)
 def _seed():

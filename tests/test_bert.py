@@ -116,3 +116,38 @@ def test_tp_sharded_pretrain_matches_dense():
     _, _, loss_t = step_t(params_t, opt_t, *batch, rng)
     np.testing.assert_allclose(float(loss_t), float(loss_d), rtol=2e-5,
                                atol=2e-5)
+
+
+def test_gathered_mlm_matches_full_loss():
+    """max_predictions gathering must not change the pretrain loss when the
+    cap covers every masked position (VERDICT r3: BERT MFU via masked-
+    position vocab head)."""
+    import numpy as np
+    from paddle_tpu import optimizer as optim
+
+    cfg = bert.BertConfig(vocab_size=128, d_model=32, n_layers=2,
+                          n_heads=2, max_position=32, dropout=0.0,
+                          dtype=jnp.float32)
+    model = bert.BertForPretraining(cfg, seed=0)
+    opt = optim.SGD(learning_rate=0.0)
+    params, opt_state = bert.init_train_state(model, opt)
+    b, s = 4, 32
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, 128, (b, s)), jnp.int32)
+    types = jnp.zeros((b, s), jnp.int32)
+    attn = jnp.ones((b, s), jnp.int32)
+    labels = jnp.asarray(
+        np.where(rs.rand(b, s) < 0.2, rs.randint(0, 128, (b, s)), -100),
+        jnp.int32)
+    nsp = jnp.asarray(rs.randint(0, 2, (b,)), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+
+    full = bert.build_pretrain_step(model, opt, donate=False)
+    gathered = bert.build_pretrain_step(model, opt, donate=False,
+                                        max_predictions=16)
+    _, _, loss_full = full(params, opt_state, tokens, types, attn,
+                           labels, nsp, rng)
+    _, _, loss_g = gathered(params, opt_state, tokens, types, attn,
+                            labels, nsp, rng)
+    assert int((np.asarray(labels) != -100).sum(axis=1).max()) <= 16
+    np.testing.assert_allclose(float(loss_g), float(loss_full), rtol=1e-5)

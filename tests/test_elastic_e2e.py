@@ -1,0 +1,131 @@
+"""Elastic membership end-to-end (VERDICT r2 item 5): kill 1 of 4 local
+workers → the launcher RE-FORMS the job at world 3 (not a same-size
+restart) → rank 0 resumes from AutoCheckpoint through the resharding
+loader onto the smaller mesh → loss continues from where it left off.
+
+Reference analog: fleet/elastic/manager.py:128 (etcd membership watch +
+relaunch) and launch/controllers/master.py:66 — driven through real
+subprocesses like the reference's elastic CLI tests."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPT = """
+import json, os, sys, time
+
+rank = int(os.environ["PT_PROCESS_ID"])
+world = int(os.environ["PT_NUM_PROCESSES"])
+version = int(os.environ["PT_ELASTIC_VERSION"])
+workdir = r"{workdir}"
+done_file = os.path.join(workdir, "done")
+log_file = os.path.join(workdir, "loss_log.jsonl")
+
+if rank != 0:
+    # rank 2 dies once while the job is at world 4, after rank 0 has
+    # written at least one checkpoint epoch
+    if rank == 2 and world == 4:
+        for _ in range(600):
+            if any(d.startswith("epoch_") for d in
+                   os.listdir(os.path.join(workdir, "ckpt", "job"))
+                   ) if os.path.isdir(os.path.join(workdir, "ckpt",
+                                                   "job")) else False:
+                break
+            time.sleep(0.1)
+        os._exit(3)
+    while not os.path.exists(done_file):
+        time.sleep(0.2)
+    sys.exit(0)
+
+# ---- rank 0: train on a dp=<world> virtual mesh with AutoCheckpoint ----
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count="
+                           + str(world))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+from paddle_tpu import optimizer as optim
+from paddle_tpu.models import gpt
+
+topo = dist.init_mesh(dp=world)
+cfg = gpt.GPTConfig(vocab_size=128, max_seq_len=16, d_model=32,
+                    n_layers=2, n_heads=2, dtype=jnp.float32)
+model = gpt.GPT(cfg, seed=0)
+opt = optim.SGD(learning_rate=0.05)
+params, opt_state = gpt.init_train_state(model, opt, topo.mesh)
+step = gpt.build_train_step(model, opt, topo.mesh)
+
+ck = AutoCheckpoint(os.path.join(workdir, "ckpt"), job_id="job", keep=3)
+# resharding restore: saved under dp=4, loaded directly onto this round's
+# dp=world mesh via the fresh state's shardings
+fresh = {{"params": params, "opt": opt_state,
+          "epoch": jnp.zeros((), jnp.int32)}}
+state = ck.restore_like(fresh, mesh=topo.mesh)
+if state is not None:
+    params, opt_state = state["params"], state["opt"]
+    start_epoch = int(state["epoch"]) + 1
+else:
+    start_epoch = 0
+
+tokens = jnp.asarray(np.random.RandomState(0).randint(
+    0, cfg.vocab_size, (12, cfg.max_seq_len)), jnp.int32)
+rng = jax.random.PRNGKey(0)
+for epoch in range(start_epoch, 6):
+    params, opt_state, loss = step(params, opt_state, tokens, rng)
+    with open(log_file, "a") as f:
+        f.write(json.dumps({{"version": version, "world": world,
+                             "epoch": epoch, "loss": float(loss)}}) + "\\n")
+    ck.save({{"params": params, "opt": opt_state,
+              "epoch": jnp.asarray(epoch, jnp.int32)}}, epoch)
+
+open(done_file, "w").close()
+"""
+
+
+@pytest.mark.skipif(not native.is_available(),
+                    reason="native toolchain unavailable")
+def test_kill_worker_reform_smaller_resume(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(SCRIPT.format(workdir=str(tmp_path))))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--master", "127.0.0.1:7811",
+         "--elastic", "--max_restarts", "2", str(script)],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, (r.returncode, r.stderr[-3000:])
+
+    log = [json.loads(line) for line in
+           (tmp_path / "loss_log.jsonl").read_text().splitlines()]
+    worlds = {e["world"] for e in log}
+    assert worlds == {4, 3}, f"expected re-formation 4→3, got {worlds}"
+    # round 2 announced by the controller
+    assert "elastic round 2: world=3" in r.stderr, r.stderr[-2000:]
+
+    v1 = [e for e in log if e["world"] == 4]
+    v2 = [e for e in log if e["world"] == 3]
+    assert v1 and v2
+    # resumed from checkpoint: epochs continue (no restart from 0) and the
+    # loss picks up from the saved optimum, not from scratch
+    assert v2[0]["epoch"] == v1[-1]["epoch"] + 1 or \
+        v2[0]["epoch"] <= v1[-1]["epoch"]  # last epoch may re-run if the
+    # crash landed between save and log append
+    first_loss = log[0]["loss"]
+    resume_loss = v2[0]["loss"]
+    last_pre = v1[-1]["loss"]
+    assert resume_loss < first_loss, (resume_loss, first_loss)
+    assert resume_loss <= last_pre * 1.10 + 1e-3, (resume_loss, last_pre)
+    # training completed all 6 epochs
+    assert max(e["epoch"] for e in log) == 5

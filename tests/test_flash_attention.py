@@ -98,3 +98,191 @@ def test_jit_compiles():
                                                 interpret=True))
     out = f(q, k, v)
     assert out.shape == q.shape
+
+
+# ---------------------------------------------------------------------------
+# v2: kv_lens padding masks, additive bias, deterministic dropout, GQA
+# ---------------------------------------------------------------------------
+
+
+def _padding_bias(kv_lens, sk):
+    """(B,) lengths -> additive (B, 1, 1, Sk) -inf mask for the oracle."""
+    col = np.arange(sk)[None, :]
+    mask = col < np.asarray(kv_lens)[:, None]
+    return jnp.asarray(np.where(mask, 0.0, -1e30)[:, None, None, :],
+                       jnp.float32)
+
+
+def test_kv_lens_padding_mask():
+    q, k, v = _rand_qkv(3, 160, 2, 64, seed=10)
+    kv_lens = jnp.asarray([160, 90, 17], jnp.int32)
+    out = flash_attention(q, k, v, kv_lens=kv_lens, interpret=True)
+    ref = attention_reference(q, k, v, mask=_padding_bias(kv_lens, 160))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_kv_lens_grads():
+    q, k, v = _rand_qkv(2, 128, 2, 32, seed=11)
+    kv_lens = jnp.asarray([128, 50], jnp.int32)
+    cot = jnp.asarray(np.random.RandomState(12).normal(size=q.shape),
+                      jnp.float32)
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(
+        *a, kv_lens=kv_lens, interpret=True) * cot), argnums=(0, 1, 2))(
+        q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(attention_reference(
+        *a, mask=_padding_bias(kv_lens, 128)) * cot), argnums=(0, 1, 2))(
+        q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("bias_shape", [(1, 1, 128, 128), (2, 1, 128, 128),
+                                        (1, 2, 128, 128), (2, 2, 128, 128)])
+def test_additive_bias_broadcast_modes(bias_shape):
+    q, k, v = _rand_qkv(2, 128, 2, 32, seed=13)
+    bias = jnp.asarray(
+        np.random.RandomState(14).normal(size=bias_shape), jnp.float32)
+    out = flash_attention(q, k, v, bias=bias, interpret=True)
+    ref = attention_reference(q, k, v, mask=bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_bias_with_causal_and_grads():
+    q, k, v = _rand_qkv(1, 128, 2, 32, seed=15)
+    bias = jnp.asarray(
+        np.random.RandomState(16).normal(size=(1, 2, 128, 128)),
+        jnp.float32)
+    cot = jnp.asarray(np.random.RandomState(17).normal(size=q.shape),
+                      jnp.float32)
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(
+        *a, causal=True, bias=bias, interpret=True) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(attention_reference(
+        *a, is_causal=True, mask=bias) * cot), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("h_q,h_kv", [(4, 2), (4, 1)])
+def test_gqa_forward_and_grads(h_q, h_kv):
+    rs = np.random.RandomState(18)
+    b, s, d = 2, 128, 32
+    q = jnp.asarray(rs.normal(size=(b, s, h_q, d)), jnp.float32)
+    k = jnp.asarray(rs.normal(size=(b, s, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rs.normal(size=(b, s, h_kv, d)), jnp.float32)
+    group = h_q // h_kv
+    k_rep = jnp.repeat(k, group, axis=2)
+    v_rep = jnp.repeat(v, group, axis=2)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = attention_reference(q, k_rep, v_rep, is_causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    cot = jnp.asarray(rs.normal(size=out.shape), jnp.float32)
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(
+        *a, causal=True, interpret=True) * cot), argnums=(0, 1, 2))(q, k, v)
+
+    def ref_loss(q, k, v):
+        kr = jnp.repeat(k, group, axis=2)
+        vr = jnp.repeat(v, group, axis=2)
+        return jnp.sum(attention_reference(q, kr, vr, is_causal=True) * cot)
+
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_dropout_deterministic_and_unbiased():
+    q, k, v = _rand_qkv(1, 128, 2, 32, seed=19)
+    o1 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=42,
+                         interpret=True)
+    o2 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=42,
+                         interpret=True)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    o3 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=43,
+                         interpret=True)
+    assert np.abs(np.asarray(o1) - np.asarray(o3)).max() > 1e-4
+    # E[dropout(attn)] == attn: mean over many seeds approaches no-dropout
+    outs = [flash_attention(q, k, v, dropout_p=0.3, dropout_seed=s,
+                            interpret=True) for s in range(24)]
+    mean = np.mean([np.asarray(o, np.float64) for o in outs], axis=0)
+    base = np.asarray(flash_attention(q, k, v, interpret=True), np.float64)
+    assert np.abs(mean - base).mean() < 0.05
+
+
+def test_dropout_grads_finite_and_match_mask():
+    """Backward regenerates the identical keep mask: grads of sum(out)
+    computed with dropout must be finite and differ from no-dropout."""
+    q, k, v = _rand_qkv(1, 128, 1, 32, seed=20)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, dropout_p=0.25, dropout_seed=7, interpret=True)))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    g0 = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, interpret=True)))(q)
+    assert np.abs(np.asarray(g) - np.asarray(g0)).max() > 1e-6
+
+
+def test_dropout_seed_traced_no_retrace():
+    """Seed is a traced scalar: changing it must not retrigger compilation
+    (the training loop changes it every step)."""
+    q, k, v = _rand_qkv(1, 128, 1, 32, seed=21)
+    calls = []
+
+    @jax.jit
+    def f(q, k, v, seed):
+        calls.append(1)
+        return flash_attention(q, k, v, dropout_p=0.1, dropout_seed=seed,
+                               interpret=True)
+
+    f(q, k, v, jnp.int32(1))
+    f(q, k, v, jnp.int32(2))
+    assert len(calls) == 1
+
+
+def test_kvlen_zero_row_no_nan():
+    q, k, v = _rand_qkv(2, 128, 1, 32, seed=22)
+    kv_lens = jnp.asarray([128, 0], jnp.int32)
+    out = flash_attention(q, k, v, kv_lens=kv_lens, interpret=True)
+    assert np.isfinite(np.asarray(out[0])).all()
+    np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, kv_lens=kv_lens, interpret=True)))(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_key_only_bias_not_materialized():
+    """(B,1,1,Sk) key-padding bias: correct results, and the jaxpr must not
+    contain a broadcast to (B, 1, Sq, Sk)."""
+    q, k, v = _rand_qkv(2, 128, 2, 32, seed=23)
+    bias = jnp.asarray(
+        np.where(np.arange(128) < 70, 0.0, -1e30)[None, None, None, :],
+        jnp.float32)
+    out = flash_attention(q, k, v, bias=bias, interpret=True)
+    ref = attention_reference(q, k, v, mask=bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    # grads through the sq1 bias path
+    cot = jnp.asarray(np.random.RandomState(24).normal(size=q.shape),
+                      jnp.float32)
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(
+        *a, bias=bias, interpret=True) * cot), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(attention_reference(
+        *a, mask=bias) * cot), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+    # the full (B, H, Sq, Sk) tensor must not appear in the lowered HLO
+    txt = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, bias=bias, interpret=True)).lower(q, k, v).as_text()
+    assert "2x2x128x128" not in txt and "1x1x128x128" not in txt
+
+
+def test_sdpa_fallback_honors_kv_lens():
+    """scaled_dot_product_attention must apply kv_lens on the XLA fallback
+    path too (CPU here), not only in the Pallas kernel."""
+    from paddle_tpu.nn.functional.attention import (
+        scaled_dot_product_attention)
+    q, k, v = _rand_qkv(2, 64, 2, 32, seed=25)
+    kv_lens = jnp.asarray([64, 20], jnp.int32)
+    out = scaled_dot_product_attention(q, k, v, kv_lens=kv_lens)
+    ref = attention_reference(q, k, v, mask=_padding_bias(kv_lens, 64))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

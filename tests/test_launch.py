@@ -156,3 +156,53 @@ def test_check_nan_inf_sweep():
                           [np.ones((2, 1), np.float32)])
     finally:
         pt.set_flags({"check_nan_inf": False})
+
+
+@pytest.mark.skipif(not native.is_available(),
+                    reason="native toolchain unavailable")
+def test_elastic_registry_reforms_rank_table():
+    """Two 'node launchers' (threads) negotiate a rank table; round 2 has
+    one fewer worker on node 1 → table re-forms at world 3 (≙ HTTPMaster /
+    ETCDMaster membership, launch/controllers/master.py:66/:178)."""
+    from paddle_tpu.distributed.elastic import ElasticRegistry
+    import threading
+
+    master_store = native.TCPStore(is_master=True)
+    try:
+        peer_store = native.TCPStore(port=master_store.port)
+        master = ElasticRegistry(master_store, node_rank=0, is_master=True)
+        peer = ElasticRegistry(peer_store, node_rank=1)
+
+        results = {}
+
+        def peer_round(version, n):
+            peer.publish(version, n)
+            results[version] = peer.wait_table(version, timeout=10.0)
+
+        # round 1: 2 + 2 workers
+        t = threading.Thread(target=peer_round, args=(1, 2))
+        t.start()
+        master.publish(1, 2)
+        table, world = master.form_table(1, nnodes=2, grace=2.0)
+        t.join()
+        assert world == 4
+        assert table == {0: (0, 2), 1: (2, 2)}
+        assert results[1] == (table, 4)
+
+        # round 2: node 1 lost a worker → world 3, contiguous ranks
+        t = threading.Thread(target=peer_round, args=(2, 1))
+        t.start()
+        master.publish(2, 2)
+        table2, world2 = master.form_table(2, nnodes=2, grace=2.0)
+        t.join()
+        assert world2 == 3
+        assert table2 == {0: (0, 2), 1: (2, 1)}
+
+        # round 3: node 1 gone entirely (never announces) → dropped after
+        # the grace window
+        master.publish(3, 2)
+        table3, world3 = master.form_table(3, nnodes=2, grace=0.5)
+        assert world3 == 2 and 1 not in table3
+        peer_store.close()
+    finally:
+        master_store.close()

@@ -1,33 +1,60 @@
 """OpTest harness (ref: python/paddle/fluid/tests/unittests/op_test.py:333 —
 one numpy oracle × N execution modes). Here the modes are eager (op-by-op
 XLA) and jit (traced), checked against the registered numpy reference;
-gradients checked against finite differences for differentiable ops."""
+gradients checked against finite differences for differentiable ops.
+Random ops are checked statistically (shape/dtype/moments/bounds) instead
+of by value. A completeness gate asserts no registered op escapes both."""
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
 
-import paddle_tpu  # noqa: F401  (populates the registry)
+import paddle_tpu  # noqa: F401  (populates the registry + attaches oracles)
+import paddle_tpu.tensor as T
 from paddle_tpu.ops.registry import all_ops
+
+# value-oracle tests don't apply to sampling ops: these get the
+# distribution tests at the bottom of this file
+RANDOM_OPS = {"rand", "uniform", "randn", "normal", "standard_normal",
+              "randint", "randint_like", "randperm", "shuffle",
+              "multinomial", "bernoulli", "poisson", "exponential_",
+              "binomial", "gaussian"}
 
 ORACLE_OPS = [op for op in all_ops()
               if op.np_ref is not None and op.sample_args is not None]
 
 
+def test_every_op_is_tested():
+    """Completeness gate (VERDICT r2 item 2): every registered op either
+    has a value oracle or is a random op with a distribution test."""
+    untested = [op.name for op in all_ops()
+                if (op.np_ref is None or op.sample_args is None)
+                and op.name not in RANDOM_OPS]
+    assert not untested, f"ops without oracle: {untested}"
+    registered = {op.name for op in all_ops()}
+    stale = RANDOM_OPS - registered
+    assert not stale, f"RANDOM_OPS not in registry: {stale}"
+
+
 @pytest.mark.parametrize("op", ORACLE_OPS, ids=lambda o: o.name)
 def test_eager_matches_numpy(op):
     args, kwargs = op.sample_args()
-    got = op.fn(*args, **kwargs)
+    fn = op.test_fn or op.fn
+    got = fn(*args, **kwargs)
     want = op.np_ref(*[np.asarray(a) for a in args])
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize("op", ORACLE_OPS, ids=lambda o: o.name)
+JIT_OPS = [op for op in ORACLE_OPS if op.jit_ok]
+
+
+@pytest.mark.parametrize("op", JIT_OPS, ids=lambda o: o.name)
 def test_jit_matches_eager(op):
     args, kwargs = op.sample_args()
-    eager = op.fn(*args, **kwargs)
-    jitted = jax.jit(lambda *a: op.fn(*a, **kwargs))(*args)
+    fn = op.test_fn or op.fn
+    eager = fn(*args, **kwargs)
+    jitted = jax.jit(lambda *a: fn(*a, **kwargs))(*args)
     np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager),
                                rtol=1e-6, atol=1e-6)
 
@@ -39,12 +66,13 @@ GRAD_OPS = [op for op in ORACLE_OPS if op.differentiable]
 def test_grad_matches_finite_difference(op):
     """≙ OpTest.check_grad (op_test.py:2131): analytic vs numeric grads."""
     args, kwargs = op.sample_args()
+    fn = op.test_fn or op.fn
     if not args or not np.issubdtype(np.asarray(args[0]).dtype,
                                      np.floating):
         pytest.skip("non-float primary input")
 
     def scalar_fn(x0):
-        out = op.fn(x0, *args[1:], **kwargs)
+        out = fn(x0, *args[1:], **kwargs)
         if isinstance(out, (tuple, list)):
             out = out[0]
         return jnp.sum(jnp.asarray(out) ** 2) / 2
@@ -66,3 +94,70 @@ def test_grad_matches_finite_difference(op):
         got = analytic.reshape(-1)[i]
         np.testing.assert_allclose(got, numeric, rtol=3e-2, atol=3e-3,
                                    err_msg=f"op={op.name} coord={i}")
+
+
+# ---------------------------------------------------------------------------
+# Random-op distribution tests (≙ unittests/test_uniform_random_op.py
+# pattern: moments + bounds on large samples, not per-value equality)
+# ---------------------------------------------------------------------------
+
+N = 20000
+
+
+def test_rand_uniform():
+    x = np.asarray(T.rand((N,)))
+    assert x.shape == (N,) and (x >= 0).all() and (x < 1).all()
+    assert abs(x.mean() - 0.5) < 0.02 and abs(x.std() - 0.2887) < 0.02
+    y = np.asarray(T.uniform((N,), min=-2.0, max=4.0))
+    assert (y >= -2).all() and (y < 4).all()
+    assert abs(y.mean() - 1.0) < 0.1
+
+
+def test_randn_normal():
+    for fn in (lambda: T.randn((N,)), lambda: T.standard_normal((N,))):
+        x = np.asarray(fn())
+        assert abs(x.mean()) < 0.03 and abs(x.std() - 1.0) < 0.03
+    y = np.asarray(T.normal(mean=3.0, std=0.5, shape=(N,)))
+    assert abs(y.mean() - 3.0) < 0.03 and abs(y.std() - 0.5) < 0.03
+    g = np.asarray(T.gaussian((N,), mean=-1.0, std=2.0))
+    assert abs(g.mean() + 1.0) < 0.1 and abs(g.std() - 2.0) < 0.1
+
+
+def test_randint_and_like():
+    x = np.asarray(T.randint(2, 9, (N,)))
+    assert ((x >= 2) & (x < 9)).all()
+    assert set(np.unique(x)) == set(range(2, 9))
+    y = np.asarray(T.randint_like(jnp.zeros((N,), jnp.int32), 0, 5))
+    assert ((y >= 0) & (y < 5)).all()
+
+
+def test_randperm_shuffle():
+    p = np.sort(np.asarray(T.randperm(257)))
+    np.testing.assert_array_equal(p, np.arange(257))
+    x = jnp.arange(257)
+    s = np.asarray(T.shuffle(x))
+    assert not np.array_equal(s, np.arange(257))
+    np.testing.assert_array_equal(np.sort(s), np.arange(257))
+
+
+def test_bernoulli_multinomial():
+    p = jnp.full((N,), 0.3)
+    b = np.asarray(T.bernoulli(p))
+    assert set(np.unique(b)) <= {0.0, 1.0}
+    assert abs(b.mean() - 0.3) < 0.02
+    probs = jnp.asarray([0.1, 0.2, 0.7])
+    m = np.asarray(T.multinomial(probs, num_samples=N, replacement=True))
+    frac = np.bincount(m, minlength=3) / N
+    np.testing.assert_allclose(frac, [0.1, 0.2, 0.7], atol=0.03)
+
+
+def test_poisson_exponential_binomial():
+    lam = jnp.full((N,), 4.0)
+    x = np.asarray(T.poisson(lam))
+    assert abs(x.mean() - 4.0) < 0.1 and abs(x.var() - 4.0) < 0.3
+    e = np.asarray(T.exponential_(jnp.zeros((N,)), lam=2.0))
+    assert (e >= 0).all() and abs(e.mean() - 0.5) < 0.02
+    bn = np.asarray(T.binomial(jnp.full((N,), 10.0),
+                               jnp.full((N,), 0.4)))
+    assert abs(bn.mean() - 4.0) < 0.1
+    assert (bn >= 0).all() and (bn <= 10).all()

@@ -1,0 +1,140 @@
+"""Auto-parallel planner v0 (VERDICT r2 item 6): structural completion
+must reproduce the hand-written GPT and BERT PARTITION_RULES.
+
+Reference analog: unittests/auto_parallel/test_completion* — the GPT
+completer test asserts propagated dist attrs equal the annotated plan."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import mesh as mesh_lib
+from paddle_tpu.distributed.planner import (plan_module, memory_report,
+                                            suggest_mesh)
+from paddle_tpu.models import gpt, bert
+
+
+def _norm(spec, ndim):
+    """Canonical per-dim tuple form padded to ndim (P() == P(None, None))."""
+    entries = list(tuple(spec)) + [None] * (ndim - len(tuple(spec)))
+    out = []
+    for e in entries:
+        axes = e if isinstance(e, tuple) else (e,)
+        out.append(tuple(a for a in axes if a is not None))
+    return tuple(out)
+
+
+def _assert_plan_matches(model, rule_spec_fn):
+    plan = plan_module(model)
+    mismatches = []
+    for name, v in model.named_parameters():
+        want = _norm(rule_spec_fn(name), v.ndim)
+        got = _norm(plan[name], v.ndim)
+        if want != got:
+            mismatches.append(f"{name} {v.shape}: want {want} got {got}")
+    assert not mismatches, "\n".join(mismatches)
+
+
+def test_planner_reproduces_gpt_rules():
+    cfg = gpt.GPTConfig(vocab_size=2048, max_seq_len=64, d_model=64,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    _assert_plan_matches(gpt.GPT(cfg, seed=0), gpt.partition_spec)
+
+
+def test_planner_reproduces_gpt_moe_rules():
+    cfg = gpt.GPTConfig(vocab_size=2048, max_seq_len=64, d_model=64,
+                        n_layers=2, n_heads=4, moe_experts=2, moe_every=2,
+                        dtype=jnp.float32)
+    _assert_plan_matches(gpt.GPT(cfg, seed=0), gpt.partition_spec)
+
+
+def test_planner_reproduces_gpt_untied_head():
+    cfg = gpt.GPTConfig(vocab_size=2048, max_seq_len=64, d_model=64,
+                        n_layers=1, n_heads=4, tie_embeddings=False,
+                        dtype=jnp.float32)
+    _assert_plan_matches(gpt.GPT(cfg, seed=0), gpt.partition_spec)
+
+
+def test_planner_reproduces_bert_rules():
+    cfg = bert.BertConfig(vocab_size=2048, d_model=64, n_layers=2,
+                          n_heads=4, max_position=64)
+    model = bert.BertForPretraining(cfg, seed=0)
+
+    def rule(p):
+        for pat, s in bert.PARTITION_RULES:
+            if re.search(pat, p):
+                return s
+        return jax.sharding.PartitionSpec()
+
+    _assert_plan_matches(model, rule)
+
+
+def test_auto_shard_module_trains(mesh8):
+    """shard_module(auto=True) end-to-end: params actually placed sharded
+    and a train step runs."""
+    cfg = gpt.GPTConfig(vocab_size=512, max_seq_len=16, d_model=32,
+                        n_layers=2, n_heads=2, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    sharded = dist.shard_module(model, auto=True)
+    wqkv = dict(sharded.named_parameters())["blocks.item_0.wqkv"]
+    assert not wqkv.sharding.is_fully_replicated
+    from paddle_tpu import optimizer as optim
+    opt = optim.AdamW(learning_rate=1e-3)
+    params, opt_state = gpt.init_train_state(sharded, opt, mesh8.mesh)
+    step = gpt.build_train_step(sharded, opt, mesh8.mesh)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 512, (8, 16)), jnp.int32)
+    _, _, loss = step(params, opt_state, tokens, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+
+
+def test_planner_divisibility_pruning(mesh8):
+    """Axes that do not divide the mapped dim are dropped when a mesh is
+    supplied (tp=2 cannot shard a dim of 5)."""
+    from paddle_tpu import nn
+
+    class Odd(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.w = nn.Parameter(jnp.zeros((6, 5)))
+            self.b = nn.Parameter(jnp.zeros((5,)))
+
+        def forward(self, x):
+            return x @ self.w + self.b
+
+    class Outer(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.blocks = nn.LayerList([Odd(), Odd()])
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return x
+
+    plan = plan_module(Outer(), mesh=mesh8.mesh)
+    spec = _norm(plan["blocks.item_0.w"], 2)
+    assert "tp" not in spec[1]  # 5 % 2 != 0 → tp pruned
+
+
+def test_memory_report_and_suggest_mesh():
+    cfg = gpt.GPTConfig(vocab_size=2048, max_seq_len=64, d_model=64,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    rep = memory_report(model)
+    n = rep["n_params"]
+    assert n == cfg.num_params()
+    # fp32 params + 2 fp32 adam moments = 12 bytes/param
+    assert rep["total_bytes"] == pytest.approx(12 * n, rel=0.01)
+
+    deg = suggest_mesh(model, n_devices=8,
+                       hbm_bytes=rep["total_bytes"] / 2, budget=0.5)
+    assert deg["dp"] * deg["fsdp"] * deg["tp"] == 8
+    # memory pressure must trigger sharding, preferring fsdp
+    assert deg["fsdp"] >= 4
+    big = suggest_mesh(model, n_devices=8, hbm_bytes=1e15)
+    assert big == {"dp": 8, "fsdp": 1, "tp": 1}

@@ -1,0 +1,69 @@
+"""TP/DP-sharded KV-cache decode == dense decode (VERDICT r2 item 4a).
+
+Reference analog: HybridParallelInferenceHelper serving TP inference
+(fleet/utils/hybrid_parallel_inference.py:23). Here the decode jit runs
+with the KV cache sharded P(L, dp, T, tp, D) and block weights constrained
+by PARTITION_RULES; on the 8-virtual-device CPU mesh the sharded program
+must reproduce the dense program's tokens exactly (greedy, fp32)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import mesh as mesh_lib
+from paddle_tpu.models import gpt
+
+
+def _model_and_prompt():
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=64, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 8)),
+        jnp.int32)
+    return model, tokens
+
+
+def test_tp_sharded_decode_matches_dense():
+    model, tokens = _model_and_prompt()
+    dense = np.asarray(model.generate(tokens, max_new_tokens=12))
+
+    topo = dist.init_mesh(dp=2, tp=4)
+    try:
+        params, _ = model.split_params()
+        sharded_model = model.merge_params(
+            gpt.shard_params(params, topo.mesh))
+        out = np.asarray(sharded_model.generate(tokens, max_new_tokens=12))
+    finally:
+        mesh_lib.set_topology(None)
+    np.testing.assert_array_equal(out, dense)
+
+
+def test_sharded_decode_cache_actually_sharded():
+    """The decode executable must hold a tp-sharded cache, not a
+    replicated one: check the compiled HLO places a sharded zeros cache."""
+    model, tokens = _model_and_prompt()
+    topo = dist.init_mesh(dp=2, tp=4)
+    try:
+        params, _ = model.split_params()
+        sharded_model = model.merge_params(
+            gpt.shard_params(params, topo.mesh))
+        b, s0 = tokens.shape
+        lowered = jax.jit(lambda p, t, r: gpt._generate_impl(
+            sharded_model, b, s0, 64, 4, 0.0, 1.0, 0, None, p, t, r)).lower(
+            gpt.shard_params(params, topo.mesh),
+            tokens, jax.random.PRNGKey(0))
+        txt = lowered.as_text()
+        # the (L,B,T,H,D) cache tensor must carry the dp/tp sharding
+        # constraint, and block weights must be tp-constrained
+        assert any(
+            "sharding_constraint" in line and "2x4x64x4x8" in line
+            and '"tp"' in line and '"dp"' in line
+            for line in txt.splitlines()), "no sharded KV cache in HLO"
+        assert any(
+            "sharding_constraint" in line and '"tp"' in line
+            and "2x32x96" in line          # stacked wqkv (L, d, 3d)
+            for line in txt.splitlines()), "block weights not tp-sharded"
+    finally:
+        mesh_lib.set_topology(None)
