@@ -131,7 +131,7 @@ def main():
     # clock runs long (the headline metric is already secured)
     budget = float(__import__("os").environ.get("PT_BENCH_BUDGET_S", 480))
     extra = result.setdefault("extra", {})
-    for sub in (bench_decode, bench_bert, bench_resnet50):
+    for sub in (bench_decode, bench_bert, bench_resnet50, bench_pp):
         if time.perf_counter() - t_start > budget:
             extra[sub.__name__ + "_skipped"] = "bench budget exhausted"
             continue
@@ -212,6 +212,85 @@ def bench_resnet50(jax, jnp, peak, smoke=False):
             "resnet50_batch": batch}
 
 
+def bench_pp(jax, jnp, peak, smoke=False):
+    """PP schedule efficiency on ONE chip (VERDICT r2 item 9): both
+    stages of a pp=2 GPipe schedule run time-multiplexed on the single
+    device, so schedule overhead (bubble rows + the rolling-buffer
+    permute) costs real wall-clock and is directly measurable against the
+    dense (unpipelined) step over identical weights/FLOPs.
+
+    theoretical bubble = (S-1)/(n_micro+S-1); with dead-row skipping the
+    measured overhead should land well below adding the full bubble.
+    """
+    if jax.default_backend() in ("cpu",) and not smoke:
+        return {}
+    from paddle_tpu.models import gpt
+
+    if smoke:
+        cfg = gpt.GPTConfig(vocab_size=128, max_seq_len=16, d_model=32,
+                            n_layers=4, n_heads=2, dtype=jnp.float32)
+        n_micro, mb, iters = 3, 2, 1
+    else:
+        cfg = gpt.gpt3_125m(max_seq_len=1024)
+        n_micro, mb, iters = 4, 2, 5
+    S = 2
+    model = gpt.GPT(cfg, seed=0)
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (n_micro, mb, cfg.max_seq_len)), jnp.int32)
+    stacked = gpt.stack_blocks(model, S)
+    # FLOPs-matched comparison: BOTH sides run exactly the transformer
+    # blocks over the same pre-embedded activations and differentiate the
+    # same stacked-block params (no head/embedding on either side) — the
+    # delta is purely schedule overhead (bubble + rolling-buffer permute)
+    x0 = model.embed(toks.reshape(n_micro * mb, cfg.max_seq_len))
+    x0 = x0.reshape(n_micro, mb, cfg.max_seq_len, -1)
+    lps = cfg.n_layers // S
+
+    def fwd_pp(stacked):
+        y = gpt.pipelined_apply(stacked, x0, S, skip_dead_rows=True)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def fwd_dense(stacked):
+        h = x0.reshape(n_micro * mb, cfg.max_seq_len, -1)
+
+        def body(hh, blk):
+            return blk(hh), None
+        flat = jax.tree_util.tree_map(
+            lambda a: a.reshape((S * lps,) + a.shape[2:]), stacked)
+        h, _ = jax.lax.scan(body, h, flat)
+        return jnp.sum(h.astype(jnp.float32) ** 2)
+
+    grad_pp = jax.jit(jax.grad(fwd_pp))
+    grad_dense = jax.jit(jax.grad(fwd_dense))
+
+    def timeit(fn, *args):
+        out = fn(*args)
+        _sync(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        _sync(jax.tree_util.tree_leaves(out)[0].reshape(-1)[0])
+        return (time.perf_counter() - t0) / iters
+
+    t_pp = timeit(grad_pp, stacked)
+    t_dense = timeit(grad_dense, stacked)
+    t_pp_f = timeit(jax.jit(fwd_pp), stacked)
+    t_dense_f = timeit(jax.jit(fwd_dense), stacked)
+    bubble_theory = (S - 1) / (n_micro + S - 1)
+    # Measured r3 (125M, pp2, 4 micro, one v5e chip): fwd overhead ~4%
+    # (vs ~13% without dead-row skip and 20% theoretical bubble — the
+    # cond-skip removes dead-slot compute entirely); fwd+bwd overhead
+    # ~75%, dominated by the tick-scan backward's per-tick weight-grad
+    # accumulation — the cost 1F1B's grad scheduling addresses, recorded
+    # here as the next PP optimization target.
+    return {"pp2_step_ms": round(t_pp * 1e3, 2),
+            "pp2_dense_step_ms": round(t_dense * 1e3, 2),
+            "pp2_overhead_measured": round(t_pp / t_dense - 1.0, 4),
+            "pp2_fwd_overhead_measured": round(t_pp_f / t_dense_f - 1.0, 4),
+            "pp2_bubble_theoretical": round(bubble_theory, 4),
+            "pp2_micro": n_micro}
+
+
 def bench_bert(jax, jnp, peak, smoke=False):
     """BERT-base MLM pretrain step tokens/s/chip + MFU (BASELINE.md
     transformer/AMP row)."""
@@ -278,8 +357,29 @@ def bench_decode(jax, jnp, peak, smoke=False):
     _sync(out[0, -1])
     dt = time.perf_counter() - t0
     name = "1p3b" if cfg.d_model >= 2048 else "gpt"
-    return {f"decode_{name}_tokens_per_sec": round(b * new / dt, 1),
-            "decode_batch": b, "decode_prefill": s0, "decode_new": new}
+    res = {f"decode_{name}_tokens_per_sec": round(b * new / dt, 1),
+           "decode_batch": b, "decode_prefill": s0, "decode_new": new}
+
+    # weight-only int8 serving path (decode is HBM-bandwidth bound: int8
+    # weights are the dominant read)
+    try:
+        from paddle_tpu import quantization as quant
+        qmodel = quant.quantize_for_inference(model)
+        qout = qmodel.generate(tokens, max_new_tokens=new, max_len=s0 + new)
+        _sync(qout[0, -1])
+        t0 = time.perf_counter()
+        qout = qmodel.generate(tokens, max_new_tokens=new, max_len=s0 + new)
+        _sync(qout[0, -1])
+        qdt = time.perf_counter() - t0
+        res[f"decode_{name}_int8_tokens_per_sec"] = round(b * new / qdt, 1)
+        # agreement over GENERATED tokens only (the prompt is verbatim in
+        # both outputs and would floor the metric at s0/(s0+new))
+        res["decode_int8_token_agreement"] = round(float(
+            (np.asarray(qout)[:, s0:] == np.asarray(out)[:, s0:]).mean()),
+            4)
+    except Exception as e:
+        res["decode_int8_error"] = str(e)[:120]
+    return res
 
 
 if __name__ == "__main__":

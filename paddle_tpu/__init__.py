@@ -48,6 +48,7 @@ import paddle_tpu.profiler as profiler
 import paddle_tpu.incubate as incubate
 import paddle_tpu.static as static
 import paddle_tpu.sparse as sparse
+import paddle_tpu.quantization as quantization
 import paddle_tpu.distribution as distribution
 import paddle_tpu.text as text
 import paddle_tpu.audio as audio
@@ -64,6 +65,7 @@ from paddle_tpu.hapi import Model, summary, flops
 __all__ = (
     ["__version__", "nn", "optimizer", "autograd", "amp", "io", "metric",
      "distributed", "vision", "profiler", "incubate", "static", "sparse",
+     "quantization",
      "distribution", "text", "audio", "geometric", "linalg", "fft", "signal",
      "onnx", "hub",
      "Tensor", "to_tensor", "is_tensor", "jit", "no_grad", "grad",

@@ -811,7 +811,8 @@ def unstack_blocks(stacked, n_layers: int):
 
 def pipelined_apply(stacked_blocks, x_mb, n_stages: int,
                     remat_stages: bool = False, layer_mask=None,
-                    collect_aux: bool = False):
+                    collect_aux: bool = False,
+                    skip_dead_rows: Optional[bool] = None):
     """GPipe schedule as a rolling buffer over a 'pp'-sharded stage axis.
 
     x_mb: (n_micro, mb, seq, d) microbatched activations (post-embedding).
@@ -835,10 +836,23 @@ def pipelined_apply(stacked_blocks, x_mb, n_stages: int,
     intermediate — the memory profile that motivates the reference's 1F1B
     over GPipe, achieved here with rematerialization instead of schedule
     reordering (in one XLA program the compiler owns the schedule).
+
+    skip_dead_rows: warmup/cooldown rows hold zeros; with a REAL pp mesh
+    their compute is free wall-clock (the owning rank idles while live
+    ranks set the tick's critical path), so the vmapped stage keeps the
+    single-program SPMD shape. WITHOUT a pp mesh (stages time-multiplexed
+    on one device — the single-chip bench case) dead rows cost real time;
+    this mode unrolls the stage loop with a ``lax.cond`` per row so dead
+    ticks skip the FLOPs (VERDICT r2 item 9). Default: auto (skip iff no
+    pp>1 mesh axis).
     """
     global _PIPELINE_DEPTH
     n_micro = x_mb.shape[0]
     S = n_stages
+    if skip_dead_rows is None:
+        from paddle_tpu.distributed.mesh import get_mesh
+        m = get_mesh()
+        skip_dead_rows = m is None or dict(m.shape).get("pp", 1) == 1
     if layer_mask is None:
         layer_mask = jnp.ones(
             (S, jax.tree_util.tree_leaves(stacked_blocks)[0].shape[1]),
@@ -873,7 +887,24 @@ def pipelined_apply(stacked_blocks, x_mb, n_stages: int,
             x_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
         state = lax.dynamic_update_index_in_dim(state, inp, 0, 0)
         state = _shard_act(state, P("pp", _BATCH_AXES, "sp", None))
-        processed, aux_s = vstage(stacked_blocks, state, layer_mask)
+        if skip_dead_rows:
+            rows, aux_rows = [], []
+            for r in range(S):
+                blocks_r = jax.tree_util.tree_map(
+                    lambda x, r=r: x[r], stacked_blocks)
+                live_r = ((t - r) >= 0) & ((t - r) < n_micro)
+                h_r, aux_r = lax.cond(
+                    live_r,
+                    lambda h, b=blocks_r, mk=layer_mask[r]:
+                        stage_fn(b, h, mk),
+                    lambda h: (h, jnp.zeros((), jnp.float32)),
+                    state[r])
+                rows.append(h_r)
+                aux_rows.append(aux_r)
+            processed = jnp.stack(rows)
+            aux_s = jnp.stack(aux_rows)
+        else:
+            processed, aux_s = vstage(stacked_blocks, state, layer_mask)
         # row i is live iff its current microbatch index t-i is real
         # (warmup/cooldown rows chew zeros; their aux must not count)
         live = ((t - jnp.arange(S)) >= 0) & ((t - jnp.arange(S)) < n_micro)

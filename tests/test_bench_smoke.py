@@ -49,10 +49,17 @@ def test_bench_resnet50_smoke():
     assert out["resnet50_batch"] == 2
 
 
+def test_bench_pp_smoke():
+    out = bench.bench_pp(jax, jnp, PEAK, smoke=True)
+    assert out["pp2_step_ms"] > 0 and out["pp2_dense_step_ms"] > 0
+    assert 0 < out["pp2_bubble_theoretical"] < 1
+
+
 def test_bench_nonsmoke_cpu_guards():
     # driver-mode guards: on CPU the TPU-only sub-benches stay silent
     assert bench.bench_bert(jax, jnp, PEAK) == {}
     assert bench.bench_resnet50(jax, jnp, PEAK) == {}
+    assert bench.bench_pp(jax, jnp, PEAK) == {}
 
 
 def test_split_params_contract():

@@ -265,3 +265,32 @@ def test_pipeline_moe_aux_masked_in_bubble():
         _, a = model(toks[i], return_aux=True)
         ref += float(a)
     np.testing.assert_allclose(float(aux), ref, rtol=1e-4)
+
+
+def test_pipeline_skip_dead_rows_parity():
+    """Dead-row skip (lax.cond per stage row; VERDICT r2 item 9) must be
+    bit-compatible with the vmapped SPMD schedule, for values AND grads."""
+    cfg = _tiny(n_layers=4)
+    model = gpt.GPT(cfg, seed=0)
+    n_micro, mb = 3, 2
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (n_micro, mb, cfg.max_seq_len)), jnp.int32)
+    x = model.embed(toks.reshape(n_micro * mb, cfg.max_seq_len))
+    x = x.reshape(n_micro, mb, cfg.max_seq_len, -1)
+    stacked = gpt.stack_blocks(model, 2)
+
+    y_skip = gpt.pipelined_apply(stacked, x, 2, skip_dead_rows=True)
+    y_vmap = gpt.pipelined_apply(stacked, x, 2, skip_dead_rows=False)
+    np.testing.assert_allclose(np.asarray(y_skip), np.asarray(y_vmap),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(stacked, skip):
+        return jnp.sum(gpt.pipelined_apply(stacked, x, 2,
+                                           skip_dead_rows=skip) ** 2)
+
+    g_skip = jax.grad(lambda s: loss(s, True))(stacked)
+    g_vmap = jax.grad(lambda s: loss(s, False))(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_skip),
+                    jax.tree_util.tree_leaves(g_vmap)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

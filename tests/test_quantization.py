@@ -1,0 +1,98 @@
+"""Weight-only int8 PTQ (VERDICT r2 item 7; ref slim/quantization
+post_training_quantization.py): quantized serving must track the float
+model closely (cosine similarity of logits) and run the full generate/
+Predictor paths transparently."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import quantization as quant
+from paddle_tpu.models import gpt
+
+
+def _cos(a, b):
+    a = np.asarray(a, np.float64).reshape(-1)
+    b = np.asarray(b, np.float64).reshape(-1)
+    return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+
+def _model():
+    cfg = gpt.GPTConfig(vocab_size=256, max_seq_len=64, d_model=64,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    return gpt.GPT(cfg, seed=0)
+
+
+def test_quant_tensor_roundtrip():
+    w = jnp.asarray(np.random.RandomState(0).randn(64, 128), jnp.float32)
+    qt = quant.quantize_tensor(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 128)
+    deq = np.asarray(qt.dequantize())
+    # per-channel absmax: error bounded by scale/2 per element
+    bound = np.asarray(qt.scale) * 0.5 + 1e-6
+    assert (np.abs(deq - np.asarray(w)) <= bound).all()
+    # array protocol
+    x = jnp.ones((4, 64), jnp.float32)
+    np.testing.assert_allclose(np.asarray(x @ qt), np.asarray(x @ deq),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(qt.T), deq.T, rtol=1e-6)
+    assert qt.shape == (64, 128) and qt.ndim == 2
+
+
+def test_quantized_model_logits_cosine():
+    model = _model()
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, 256, (2, 32)), jnp.int32)
+    ref = model(tokens)
+    qmodel = quant.quantize_for_inference(model, min_size=256)
+    qp, _ = qmodel.split_params()
+    assert any(isinstance(v, quant.QuantTensor) for v in qp.values())
+    # embeddings stay float (lookup semantics)
+    assert not isinstance(qp["wte"], quant.QuantTensor)
+    out = qmodel(tokens)
+    assert _cos(out, ref) > 0.999, _cos(out, ref)
+
+
+def test_quantized_generate_matches_float_greedy():
+    model = _model()
+    tokens = jnp.asarray(
+        np.random.RandomState(2).randint(0, 256, (2, 8)), jnp.int32)
+    ref = np.asarray(model.generate(tokens, max_new_tokens=8))
+    qmodel = quant.quantize_for_inference(model, min_size=256)
+    out = np.asarray(qmodel.generate(tokens, max_new_tokens=8))
+    # greedy decode over a near-identical distribution: most GENERATED
+    # tokens equal (prompt excluded — it is verbatim in both)
+    agree = (out[:, 8:] == ref[:, 8:]).mean()
+    assert agree >= 0.8, agree
+
+
+def test_quantized_predictor_runs():
+    from paddle_tpu.inference import Predictor
+    model = _model()
+    qmodel = quant.quantize_for_inference(model, min_size=256)
+    pred = Predictor(lambda t: qmodel(t), batch_size=2)
+    toks = np.random.RandomState(3).randint(0, 256, (5, 16)).astype(np.int32)
+    out = pred.run(toks)
+    assert out.shape == (5, 16, 256)
+
+
+def test_dequantize_params_roundtrip():
+    model = _model()
+    qmodel = quant.quantize_for_inference(model, min_size=256)
+    qp, _ = qmodel.split_params()
+    deq = quant.dequantize_params(qp)
+    assert all(not isinstance(v, quant.QuantTensor) for v in deq.values())
+    fp, _ = model.split_params()
+    for k in fp:
+        assert deq[k].shape == fp[k].shape
+
+
+def test_include_regex_and_empty_error():
+    import pytest
+    model = _model()
+    qmodel = quant.quantize_for_inference(model, include=r"wqkv$")
+    qp, _ = qmodel.split_params()
+    assert isinstance(qp["blocks.item_0.wqkv"], quant.QuantTensor)
+    assert not isinstance(qp["blocks.item_0.wup"], quant.QuantTensor)
+    with pytest.raises(ValueError, match="no weight"):
+        quant.quantize_for_inference(model, include=r"nomatch_xyz")
