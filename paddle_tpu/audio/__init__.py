@@ -9,7 +9,7 @@ from paddle_tpu import signal as pt_signal
 
 __all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
            "mel_frequencies", "compute_fbank_matrix", "hz_to_mel",
-           "mel_to_hz"]
+           "mel_to_hz", "ESC50", "TESS"]
 
 
 def hz_to_mel(freq):
@@ -88,3 +88,6 @@ class MFCC:
     def __call__(self, x):
         lm = self.logmel(x)
         return jnp.einsum("km,...mt->...kt", self.dct, lm)
+
+
+from paddle_tpu.audio.datasets import ESC50, TESS  # noqa: E402

@@ -1,0 +1,69 @@
+"""Audio datasets (ref: python/paddle/audio/datasets/ — TESS, ESC50,
+GTZAN, UrbanSound8K). Downloads are environment-gated; synthetic mode
+generates class-dependent harmonic waveforms (class k = fundamental
+220*2^(k/12) Hz) so spectrogram classifiers can learn, keeping tests
+hermetic."""
+
+import os
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["ESC50", "TESS"]
+
+
+class _SyntheticAudio(Dataset):
+    SAMPLE_RATE = 16000
+
+    def __init__(self, n_classes, mode="train", num_samples=200,
+                 duration=1.0, seed=0, feature_fn=None):
+        rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        t = np.arange(int(self.SAMPLE_RATE * duration)) / self.SAMPLE_RATE
+        self.labels = rs.randint(0, n_classes, num_samples).astype(np.int64)
+        waves = []
+        for y in self.labels:
+            f0 = 220.0 * 2.0 ** (y / 12.0)
+            w = (np.sin(2 * np.pi * f0 * t)
+                 + 0.5 * np.sin(2 * np.pi * 2 * f0 * t)
+                 + 0.1 * rs.randn(len(t)))
+            waves.append(w.astype(np.float32))
+        self.waves = np.stack(waves)
+        self.feature_fn = feature_fn
+
+    def __getitem__(self, idx):
+        w = self.waves[idx]
+        if self.feature_fn is not None:
+            w = np.asarray(self.feature_fn(w))
+        return w, self.labels[idx]
+
+    def __len__(self):
+        return len(self.waves)
+
+
+class ESC50(_SyntheticAudio):
+    """Environmental sounds, 50 classes (ref audio/datasets/esc50.py).
+    archive_path: optional real ESC-50 directory with audio/*.wav; absent
+    → synthetic."""
+
+    def __init__(self, mode="train", archive_path=None, feature_fn=None,
+                 **kw):
+        if archive_path is not None:
+            raise NotImplementedError(
+                "real ESC-50 loading needs an audio decoder (soundfile), "
+                "unavailable in this image — omit archive_path for the "
+                "synthetic split (never silently substituted)")
+        super().__init__(50, mode=mode, feature_fn=feature_fn, **kw)
+
+
+class TESS(_SyntheticAudio):
+    """Toronto emotional speech, 7 classes (ref audio/datasets/tess.py)."""
+
+    def __init__(self, mode="train", archive_path=None, feature_fn=None,
+                 **kw):
+        if archive_path is not None:
+            raise NotImplementedError(
+                "real TESS loading needs an audio decoder (soundfile), "
+                "unavailable in this image — omit archive_path for the "
+                "synthetic split (never silently substituted)")
+        super().__init__(7, mode=mode, feature_fn=feature_fn, **kw)

@@ -14,6 +14,7 @@ from paddle_tpu.distributed.env import (init_parallel_env, get_rank,
                                         get_world_size, ParallelEnv,
                                         is_initialized)
 from paddle_tpu.distributed import mesh
+from paddle_tpu.distributed.spawn import spawn, ProcessContext
 from paddle_tpu.distributed.mesh import (init_mesh, get_mesh, get_topology,
                                          HybridTopology)
 from paddle_tpu.distributed import collective
@@ -35,7 +36,7 @@ from paddle_tpu.distributed.recompute import (
     recompute, recompute_sequential, checkpoint_name)
 from paddle_tpu.native import TCPStore  # ≙ fluid.core.TCPStore (C++)
 
-__all__ = ["env", "mesh", "collective", "init_parallel_env", "get_rank",
+__all__ = ["env", "mesh", "collective", "init_parallel_env", "spawn", "ProcessContext", "get_rank",
            "get_world_size", "ParallelEnv", "is_initialized", "init_mesh",
            "get_mesh", "get_topology", "HybridTopology", "ReduceOp",
            "all_reduce", "all_gather", "all_to_all", "reduce_scatter",

@@ -1,0 +1,103 @@
+"""paddle.distributed.spawn analog — in-Python multiprocess launch.
+
+Reference analog: python/paddle/distributed/spawn.py:482 ``spawn(func,
+args, nprocs, ...)`` — the multiprocessing alternative to the launch CLI
+for users who want to start workers from a script instead of a shell.
+
+TPU note: one process per HOST drives all local chips (SURVEY §5.8), so
+``nprocs`` here means host-process count — useful for CPU-mesh testing
+and for driving per-process data workers, not for splitting one host's
+chips (that's what the device mesh is for).
+"""
+
+import multiprocessing as mp
+import os
+import sys
+import traceback
+
+__all__ = ["spawn", "ProcessContext"]
+
+
+def _worker(fn, args, env, rank, err_dir):
+    for k, v in env.items():
+        os.environ[k] = v
+    try:
+        fn(*args)
+    except SystemExit as e:
+        if e.code in (0, None):
+            raise  # intentional clean exit is not a failure
+        with open(os.path.join(err_dir, f"err_{rank}"), "w") as f:
+            f.write(traceback.format_exc())
+        sys.exit(1)
+    except BaseException:
+        with open(os.path.join(err_dir, f"err_{rank}"), "w") as f:
+            f.write(traceback.format_exc())
+        sys.exit(1)
+
+
+class ProcessContext:
+    """Join handle over spawned workers (≙ the context returned by the
+    reference's spawn with join=False)."""
+
+    def __init__(self, procs, err_dir):
+        self.processes = procs
+        self._err_dir = err_dir
+
+    def join(self, timeout=None):
+        """Wait for every worker; raises RuntimeError with the failing
+        rank's traceback if any exited non-zero. Returns False (like
+        torch.multiprocessing) when a timeout expires with workers still
+        running."""
+        for p in self.processes:
+            p.join(timeout)
+        if any(p.exitcode is None for p in self.processes):
+            return False
+        for rank, p in enumerate(self.processes):
+            if p.exitcode:
+                path = os.path.join(self._err_dir, f"err_{rank}")
+                detail = ""
+                if os.path.exists(path):
+                    with open(path) as f:
+                        detail = f.read()
+                self.terminate()
+                raise RuntimeError(
+                    f"spawn worker {rank} exited with code {p.exitcode}\n"
+                    f"{detail}")
+        return True
+
+    def terminate(self):
+        for p in self.processes:
+            if p.is_alive():
+                p.terminate()
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False,
+          master_port=23471, start_method="spawn", **options):
+    """Start ``nprocs`` processes running ``func(*args)`` with the same
+    PT_* env contract the launch CLI writes (ref spawn.py:482; workers
+    read it through ``init_parallel_env``).
+
+    join=True blocks and re-raises worker failures; join=False returns a
+    :class:`ProcessContext`.
+    """
+    import tempfile
+    ctx = mp.get_context(start_method)
+    err_dir = tempfile.mkdtemp(prefix="pt_spawn_")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PT_COORDINATOR": f"127.0.0.1:{master_port}",
+            "PT_NUM_PROCESSES": str(nprocs),
+            "PT_PROCESS_ID": str(rank),
+            "PT_LOCAL_RANK": str(rank),
+            "PT_NNODES": "1",
+        }
+        p = ctx.Process(target=_worker, args=(func, args, env, rank,
+                                              err_dir), daemon=daemon)
+        p.start()
+        procs.append(p)
+    pc = ProcessContext(procs, err_dir)
+    if join:
+        pc.join()
+        return None
+    return pc

@@ -22,6 +22,8 @@ from paddle_tpu.profiler.statistic import (SpanCollector, StatRegistry,
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "export_chrome_tracing", "Benchmark", "benchmark",
            "start_server", "SpanCollector", "StatRegistry", "stat_registry",
+           "device_memory_stats", "memory_allocated",
+           "max_memory_allocated", "record_memory_stats", "memory_summary",
            "stat_add", "stat_get", "format_table"]
 
 
@@ -128,14 +130,23 @@ class Profiler:
         self.stop()
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
+                time_unit="ms", memory=True):
         """Host-span table + step-time breakdown (≙ the reference's
-        profiler_statistic.py tables printed after Profiler.stop)."""
+        profiler_statistic.py tables printed after Profiler.stop), plus
+        the device-memory watermark block (≙ mem_tracing.h surface;
+        disable with memory=False)."""
         if self._collector is None:
             return self.step_info()
-        return statistic.format_table(
+        table = statistic.format_table(
             self._collector, step_times=self._step_times,
             sorted_by=sorted_by or "total", time_unit=time_unit)
+        if memory:
+            from paddle_tpu.profiler.memory import memory_summary
+            try:
+                table += "\n" + memory_summary()
+            except Exception:
+                pass
+        return table
 
     def export(self, path=None, format=None):  # noqa: A002
         pass  # jax.profiler already wrote the trace to log_dir
@@ -144,3 +155,8 @@ class Profiler:
 def start_server(port: int = 9012):
     """On-demand profiling server (≙ the reference's remote profiler)."""
     return jax.profiler.start_server(port)
+
+
+from paddle_tpu.profiler.memory import (  # noqa: E402
+    device_memory_stats, memory_allocated, max_memory_allocated,
+    record_memory_stats, memory_summary)

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.io.dataset import Dataset
 
-__all__ = ["viterbi_decode", "SyntheticTextDataset"]
+__all__ = ["viterbi_decode", "SyntheticTextDataset", "Imdb", "UCIHousing", "Conll05st"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
@@ -60,3 +60,6 @@ class SyntheticTextDataset(Dataset):
 
     def __len__(self):
         return len(self.tokens)
+
+
+from paddle_tpu.text.datasets import Imdb, UCIHousing, Conll05st  # noqa: E402

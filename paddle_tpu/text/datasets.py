@@ -1,0 +1,135 @@
+"""Text datasets (ref: python/paddle/text/datasets/ — Imdb, Conll05,
+UCIHousing, Movielens...). Downloads are environment-gated (zero-egress
+images); every dataset degrades to a deterministic synthetic split with a
+learnable signal so tests and tutorials stay hermetic, mirroring
+vision.datasets.SyntheticImages."""
+
+import hashlib
+import os
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st"]
+
+
+class Imdb(Dataset):
+    """IMDB movie-review sentiment (ref text/datasets/imdb.py): tokenized
+    review → binary label. Synthetic mode plants class-dependent token
+    frequencies so a bag-of-words model can learn."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 seq_len=256, vocab_size=5000, num_samples=2000, seed=0):
+        """``cutoff`` (the reference's frequency threshold) is accepted
+        for API parity but has no effect here: words map to ids by STABLE
+        feature hashing, so train/test instances agree on every word's id
+        without sharing a frequency-built vocabulary."""
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        if data_file and os.path.exists(data_file):
+            self._load_real(data_file, mode, cutoff)
+            return
+        rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.labels = rs.randint(0, 2, num_samples).astype(np.int64)
+        base = rs.randint(0, vocab_size, (num_samples, seq_len))
+        # positive reviews over-sample the first vocab decile
+        pos_tokens = rs.randint(0, vocab_size // 10, (num_samples, seq_len))
+        use_pos = (rs.rand(num_samples, seq_len) < 0.3) \
+            & (self.labels[:, None] == 1)
+        self.docs = np.where(use_pos, pos_tokens, base).astype(np.int64)
+
+    def _word_id(self, w):
+        # stable feature hashing: id 0 is reserved for padding; the same
+        # word gets the same id in every split/process (md5, not hash())
+        h = int.from_bytes(hashlib.md5(w.encode()).digest()[:4], "little")
+        return 1 + h % (self.vocab_size - 1)
+
+    def _load_real(self, data_file, mode, cutoff):
+        docs, labels = [], []
+        pat = f"aclImdb/{mode}/"
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if not m.name.startswith(pat) or not m.name.endswith(".txt"):
+                    continue
+                if "/pos/" in m.name:
+                    y = 1
+                elif "/neg/" in m.name:
+                    y = 0
+                else:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower().split()
+                ids = [self._word_id(w) for w in words[:self.seq_len]]
+                ids += [0] * (self.seq_len - len(ids))
+                docs.append(ids)
+                labels.append(y)
+        self.docs = np.asarray(docs, np.int64)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (ref text/datasets/uci_housing.py):
+    13 features → price. Synthetic mode draws from a fixed linear model
+    plus noise (learnable by linear regression)."""
+
+    N_FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", num_samples=404,
+                 seed=0):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+            feats, target = raw[:, :-1], raw[:, -1:]
+        else:
+            rs = np.random.RandomState(seed)
+            w = rs.randn(self.N_FEATURES, 1).astype(np.float32)
+            feats = rs.randn(num_samples + 102,
+                             self.N_FEATURES).astype(np.float32)
+            target = feats @ w + 0.1 * rs.randn(len(feats), 1).astype(
+                np.float32)
+        split = int(0.8 * len(feats))
+        sl = slice(0, split) if mode == "train" else slice(split, None)
+        self.feats, self.target = feats[sl], target[sl]
+
+    def __getitem__(self, idx):
+        return self.feats[idx], self.target[idx]
+
+    def __len__(self):
+        return len(self.feats)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic-role labeling (ref text/datasets/conll05.py):
+    (word_ids, predicate_ids, ..., label_ids) sequences. Synthetic mode
+    emits self-consistent tag sequences (label = f(word) near the
+    predicate) so a tagger can fit them."""
+
+    def __init__(self, data_file=None, mode="train", seq_len=64,
+                 word_vocab=5000, label_vocab=67, num_samples=1000, seed=0):
+        if data_file is not None:
+            raise NotImplementedError(
+                "real CoNLL-2005 parsing is not implemented; omit "
+                "data_file for the synthetic split")
+        rs = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.words = rs.randint(1, word_vocab,
+                                (num_samples, seq_len)).astype(np.int64)
+        pred_pos = rs.randint(0, seq_len, num_samples)
+        self.predicates = np.zeros((num_samples, seq_len), np.int64)
+        self.predicates[np.arange(num_samples), pred_pos] = 1
+        near = np.abs(np.arange(seq_len)[None, :]
+                      - pred_pos[:, None]) <= 3
+        self.labels = np.where(near, self.words % (label_vocab - 1) + 1,
+                               0).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.words[idx], self.predicates[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.words)

@@ -119,3 +119,100 @@ class Cifar10(_CifarBase):
 
 class Cifar100(_CifarBase):
     NUM_CLASSES = 100
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp",
+                   ".npy")
+
+
+def _scan_files(root, exts, is_valid_file=None):
+    ok = is_valid_file or (lambda p: p.lower().endswith(exts))
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for f in sorted(files):
+            path = os.path.join(dirpath, f)
+            if ok(path):
+                out.append(path)
+    return out
+
+
+def _default_loader(path):
+    """PIL image → HWC uint8 ndarray (.npy files load directly)."""
+    if path.lower().endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (ref: vision/datasets/folder.py
+    DatasetFolder): ``root/class_x/xxx.png`` → (image, class_index).
+    Classes are the sorted subdirectory names."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or _IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for path in _scan_files(os.path.join(root, c), exts,
+                                    is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"no files with extensions {exts} under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat image folder without labels (ref vision/datasets/folder.py
+    ImageFolder): root may contain files directly; returns images only.
+    (Not a DatasetFolder subclass: there are no classes/class_to_idx.)"""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or _IMG_EXTENSIONS))
+        self.samples = _scan_files(root, exts)
+        if not self.samples:
+            raise RuntimeError(f"no images under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageNet(DatasetFolder):
+    """ImageNet layout: ``root/{train,val}/nXXXXXXXX/*.JPEG`` (ref
+    vision/datasets; the reference delegates download to the user too —
+    the tarballs require manual acquisition)."""
+
+    def __init__(self, root, mode="train", transform=None):
+        super().__init__(os.path.join(root, mode), transform=transform)
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "ImageNet"]

@@ -6,10 +6,30 @@ from paddle_tpu.vision.models.resnet import (ResNet, resnet18, resnet34,
                                              BasicBlock, BottleneckBlock)
 from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from paddle_tpu.vision.models.mobilenet import (MobileNetV1, MobileNetV2,
-                                                mobilenet_v1, mobilenet_v2)
+                                                MobileNetV3Small,
+                                                MobileNetV3Large,
+                                                mobilenet_v1, mobilenet_v2,
+                                                mobilenet_v3_small,
+                                                mobilenet_v3_large)
 from paddle_tpu.vision.models.alexnet import AlexNet, alexnet
+from paddle_tpu.vision.models.squeezenet import SqueezeNet, squeezenet1_0, \
+    squeezenet1_1
+from paddle_tpu.vision.models.shufflenetv2 import ShuffleNetV2, \
+    shufflenet_v2_x0_25, shufflenet_v2_x1_0
+from paddle_tpu.vision.models.densenet import DenseNet, densenet121, \
+    densenet161, densenet201
+from paddle_tpu.vision.models.googlenet import GoogLeNet, googlenet
+from paddle_tpu.vision.models.inceptionv3 import InceptionV3, inception_v3
+from paddle_tpu.vision.models.ppyoloe import PPYOLOE, ppyoloe_s
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "BasicBlock", "BottleneckBlock", "VGG",
            "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV1", "MobileNetV2",
-           "mobilenet_v1", "mobilenet_v2", "AlexNet", "alexnet"]
+           "MobileNetV3Small", "MobileNetV3Large",
+           "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small",
+           "mobilenet_v3_large", "AlexNet", "alexnet",
+           "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+           "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x1_0",
+           "DenseNet", "densenet121", "densenet161", "densenet201",
+           "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
+           "PPYOLOE", "ppyoloe_s"]

@@ -1,0 +1,25 @@
+"""Shared building blocks for the vision model zoo."""
+
+import paddle_tpu.nn as nn
+
+__all__ = ["conv_bn_act"]
+
+
+def conv_bn_act(in_c, out_c, k, s=1, p=None, groups=1, act="relu"):
+    """Conv2D + BatchNorm2D + optional activation. ``p=None`` derives
+    same-ish padding from the kernel (k//2 per dim, tuple kernels
+    included); ``act`` is "relu", "silu", "hardswish", or None/False."""
+    if p is None:
+        p = tuple(kk // 2 for kk in k) if isinstance(k, tuple) else k // 2
+    mods = [nn.Conv2D(in_c, out_c, k, stride=s, padding=p, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c)]
+    if act == "relu":
+        mods.append(nn.ReLU())
+    elif act == "silu":
+        mods.append(nn.Silu())
+    elif act == "hardswish":
+        mods.append(nn.Hardswish())
+    elif act:
+        raise ValueError(f"unknown act {act!r}")
+    return nn.Sequential(*mods)

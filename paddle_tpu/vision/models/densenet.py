@@ -1,0 +1,90 @@
+"""DenseNet (ref: python/paddle/vision/models/densenet.py)."""
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet201"]
+
+
+class DenseLayer(nn.Module):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        return jnp.concatenate([x, y], axis=1)
+
+
+class Transition(nn.Module):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Module):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+               169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+               264: (6, 12, 64, 48)}[layers]
+        if layers == 161:
+            growth_rate, init_c = 48, 96
+        else:
+            init_c = 64
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        c = init_c
+        for i, n in enumerate(cfg):
+            for _ in range(n):
+                blocks.append(DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if i != len(cfg) - 1:
+                blocks.append(Transition(c, c // 2))
+                c //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.bn_final = nn.BatchNorm2D(c)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn_final(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)

@@ -1,0 +1,65 @@
+"""GoogLeNet / Inception v1 (ref: python/paddle/vision/models/googlenet.py).
+The reference's two auxiliary classifier heads (a training-era vanishing-
+gradient workaround predating BatchNorm) are omitted: every conv here is
+BN'd, which is the modern replacement for that trick."""
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.vision.models._utils import conv_bn_act as _cba
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class Inception(nn.Module):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _cba(in_c, c1, 1)
+        self.b2 = nn.Sequential(_cba(in_c, c3r, 1), _cba(c3r, c3, 3, p=1))
+        self.b3 = nn.Sequential(_cba(in_c, c5r, 1), _cba(c5r, c5, 5, p=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _cba(in_c, proj, 1))
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Module):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _cba(3, 64, 7, s=2, p=3), nn.MaxPool2D(3, stride=2, padding=1),
+            _cba(64, 64, 1), _cba(64, 192, 3, p=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.pool3(self.i3b(self.i3a(self.stem(x))))
+        x = self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x)))))
+        x = self.i5b(self.i5a(self.pool4(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

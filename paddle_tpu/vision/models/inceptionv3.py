@@ -1,0 +1,98 @@
+"""Inception v3 (ref: python/paddle/vision/models/inceptionv3.py).
+Compact faithful variant: A/B/C inception blocks with factorized convs."""
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.vision.models._utils import conv_bn_act as _cba
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class InceptionA(nn.Module):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _cba(in_c, 64, 1)
+        self.b5 = nn.Sequential(_cba(in_c, 48, 1), _cba(48, 64, 5, p=2))
+        self.b3 = nn.Sequential(_cba(in_c, 64, 1), _cba(64, 96, 3, p=1),
+                                _cba(96, 96, 3, p=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cba(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return jnp.concatenate([self.b1(x), self.b5(x), self.b3(x),
+                                self.bp(x)], axis=1)
+
+
+class InceptionB(nn.Module):
+    """7x1/1x7 factorized block."""
+
+    def __init__(self, in_c, mid):
+        super().__init__()
+        self.b1 = _cba(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _cba(in_c, mid, 1), _cba(mid, mid, (1, 7), p=None),
+            _cba(mid, 192, (7, 1), p=None))
+        self.b77 = nn.Sequential(
+            _cba(in_c, mid, 1), _cba(mid, mid, (7, 1), p=None),
+            _cba(mid, mid, (1, 7), p=None),
+            _cba(mid, mid, (7, 1), p=None),
+            _cba(mid, 192, (1, 7), p=None))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cba(in_c, 192, 1))
+
+    def forward(self, x):
+        return jnp.concatenate([self.b1(x), self.b7(x), self.b77(x),
+                                self.bp(x)], axis=1)
+
+
+class Reduction(nn.Module):
+    def __init__(self, in_c, c3, cd):
+        super().__init__()
+        self.b3 = _cba(in_c, c3, 3, s=2, p=0)
+        self.bd = nn.Sequential(_cba(in_c, cd, 1), _cba(cd, cd, 3, p=1),
+                                _cba(cd, cd, 3, s=2, p=0))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return jnp.concatenate([self.b3(x), self.bd(x), self.pool(x)],
+                               axis=1)
+
+
+class InceptionV3(nn.Module):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _cba(3, 32, 3, s=2, p=0), _cba(32, 32, 3, p=0),
+            _cba(32, 64, 3, p=1),
+            nn.MaxPool2D(3, stride=2), _cba(64, 80, 1),
+            _cba(80, 192, 3, p=0), nn.MaxPool2D(3, stride=2))
+        self.a1 = InceptionA(192, 32)    # → 64+64+96+32 = 256
+        self.a2 = InceptionA(256, 64)    # → 64+64+96+64 = 288
+        self.red1 = Reduction(288, 384, 96)
+        in_b = 288 + 384 + 96            # = 768
+        self.b1 = InceptionB(in_b, 128)
+        self.b2 = InceptionB(768, 160)
+        self.red2 = Reduction(768, 320, 192)
+        c_final = 768 + 320 + 192
+        self.c_out = c_final
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c_final, num_classes)
+
+    def forward(self, x):
+        x = self.a2(self.a1(self.stem(x)))
+        x = self.b2(self.b1(self.red1(x)))
+        x = self.red2(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

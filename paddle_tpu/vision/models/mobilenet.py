@@ -109,3 +109,108 @@ def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
     return MobileNetV2(scale=scale, **kwargs)
+
+
+class _SE(nn.Module):
+    """Squeeze-excite (MobileNetV3; ref mobilenetv3.py SEModule)."""
+
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(c, c // r, 1)
+        self.fc2 = nn.Conv2D(c // r, c, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(nn.Module):
+    def __init__(self, in_c, exp, out_c, k, s, se, act):
+        super().__init__()
+        from paddle_tpu.vision.models._utils import conv_bn_act
+        a = "hardswish" if act == "HS" else "relu"
+        self.use_res = s == 1 and in_c == out_c
+        mods = []
+        if exp != in_c:
+            mods.append(conv_bn_act(in_c, exp, 1, act=a))
+        mods.append(conv_bn_act(exp, exp, k, s=s, groups=exp, act=a))
+        if se:
+            mods.append(_SE(exp))
+        mods.append(conv_bn_act(exp, out_c, 1, act=None))
+        self.body = nn.Sequential(*mods)
+
+    def forward(self, x):
+        out = self.body(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(nn.Module):
+    def __init__(self, cfg, last_exp, last_c, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        from paddle_tpu.vision.models._utils import conv_bn_act
+        self.stem = conv_bn_act(3, 16, 3, s=2, act="hardswish")
+        blocks = []
+        in_c = 16
+        for k, exp, out_c, se, act, s in cfg:
+            blocks.append(_V3Block(in_c, exp, out_c, k, s, se, act))
+            in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.tail = conv_bn_act(in_c, last_exp, 1, act="hardswish")
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.head = nn.Sequential(nn.Linear(last_exp, last_c),
+                                      nn.Hardswish(),
+                                      nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.tail(self.blocks(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.head(x.reshape(x.shape[0], -1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, num_classes=1000, with_pool=True):
+        cfg = [  # k, exp, out, SE, act, stride (ref mobilenetv3.py)
+            (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+            (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+            (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+            (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+            (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+            (5, 576, 96, True, "HS", 1)]
+        super().__init__(cfg, 576, 1024, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, num_classes=1000, with_pool=True):
+        cfg = [
+            (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+            (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+            (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+            (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+            (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+            (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+            (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+            (5, 960, 160, True, "HS", 1)]
+        super().__init__(cfg, 960, 1280, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, **kwargs):
+    return MobileNetV3Small(**kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, **kwargs):
+    return MobileNetV3Large(**kwargs)
+
+
+__all__ += ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+            "mobilenet_v3_large"]

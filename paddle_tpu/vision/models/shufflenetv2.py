@@ -1,0 +1,97 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py)."""
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x1_0"]
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape(b, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(b, c, h, w)
+
+
+from paddle_tpu.vision.models._utils import conv_bn_act
+
+
+def _cba(in_c, out_c, k, s=1, groups=1, act=True):
+    return conv_bn_act(in_c, out_c, k, s=s, groups=groups,
+                       act="relu" if act else None)
+
+
+class InvertedResidual(nn.Module):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch = out_c // 2
+        if stride == 2:
+            self.b1 = nn.Sequential(
+                _cba(in_c, in_c, 3, s=2, groups=in_c, act=False),
+                _cba(in_c, branch, 1))
+            self.b2 = nn.Sequential(
+                _cba(in_c, branch, 1),
+                _cba(branch, branch, 3, s=2, groups=branch, act=False),
+                _cba(branch, branch, 1))
+        else:
+            self.b1 = None
+            self.b2 = nn.Sequential(
+                _cba(branch, branch, 1),
+                _cba(branch, branch, 3, groups=branch, act=False),
+                _cba(branch, branch, 1))
+
+    def forward(self, x):
+        if self.stride == 2:
+            out = jnp.concatenate([self.b1(x), self.b2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = jnp.concatenate([x1, self.b2(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Module):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        chans = {0.25: (24, 24, 48, 96, 512),
+                 0.5: (24, 48, 96, 192, 1024),
+                 1.0: (24, 116, 232, 464, 1024),
+                 1.5: (24, 176, 352, 704, 1024),
+                 2.0: (24, 244, 488, 976, 2048)}[scale]
+        repeats = (4, 8, 4)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(_cba(3, chans[0], 3, s=2),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        stages = []
+        in_c = chans[0]
+        for out_c, n in zip(chans[1:4], repeats):
+            blocks = [InvertedResidual(in_c, out_c, 2)]
+            for _ in range(n - 1):
+                blocks.append(InvertedResidual(out_c, out_c, 1))
+            stages.append(nn.Sequential(*blocks))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.tail = _cba(in_c, chans[4], 1)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[4], num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)

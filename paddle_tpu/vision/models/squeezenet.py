@@ -1,0 +1,72 @@
+"""SqueezeNet (ref: python/paddle/vision/models/squeezenet.py)."""
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class Fire(nn.Module):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return jnp.concatenate([self.relu(self.expand1(x)),
+                                self.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(nn.Module):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.stem = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2))
+            cfg = [(96, 16, 64, 64), (128, 16, 64, 64), (128, 32, 128, 128),
+                   "pool", (256, 32, 128, 128), (256, 48, 192, 192),
+                   (384, 48, 192, 192), (384, 64, 256, 256), "pool",
+                   (512, 64, 256, 256)]
+        else:
+            self.stem = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2))
+            cfg = [(64, 16, 64, 64), (128, 16, 64, 64), "pool",
+                   (128, 32, 128, 128), (256, 32, 128, 128), "pool",
+                   (256, 48, 192, 192), (384, 48, 192, 192),
+                   (384, 64, 256, 256), (512, 64, 256, 256)]
+        mods = []
+        for c in cfg:
+            if c == "pool":
+                mods.append(nn.MaxPool2D(3, stride=2))
+            else:
+                mods.append(Fire(*c))
+        self.features = nn.Sequential(*mods)
+        if num_classes > 0:
+            self.classifier = nn.Conv2D(512, num_classes, 1)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.features(self.stem(x))
+        if self.num_classes > 0:
+            x = self.relu(self.classifier(x))
+        if self.with_pool:
+            x = self.pool(x)
+            return x.reshape(x.shape[0], -1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)

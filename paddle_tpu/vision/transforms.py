@@ -1,6 +1,7 @@
 """Image transforms (ref: python/paddle/vision/transforms/) — numpy/host-side
 preprocessing feeding the DataLoader."""
 
+import math
 import numbers
 
 import numpy as np
@@ -196,3 +197,173 @@ class BrightnessTransform:
         img = np.asarray(img, np.float32)
         factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
         return np.clip(img * factor, 0, 255 if img.max() > 1.5 else 1.0)
+
+
+def _as_float(img):
+    img = np.asarray(img, np.float32)
+    hi = 255.0 if img.max() > 1.5 else 1.0
+    return img, hi
+
+
+def _chw(img):
+    """True if the channel axis is first (C in {1,3} heuristic)."""
+    return img.ndim == 3 and img.shape[0] in (1, 3) and img.shape[2] > 3
+
+
+def _channel_axis(img):
+    """Channel axis index, or None for 2-D (already grayscale) images."""
+    if img.ndim == 2:
+        return None
+    return 0 if _chw(img) else -1
+
+
+class ContrastTransform:
+    """ref transforms.py ContrastTransform: blend with the mean."""
+
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        img, hi = _as_float(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(img.mean() + f * (img - img.mean()), 0, hi)
+
+
+class SaturationTransform:
+    """Blend with the per-pixel grayscale."""
+
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        img, hi = _as_float(img)
+        ax = _channel_axis(img)
+        if ax is None:
+            return img  # grayscale: no chroma to scale
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = img.mean(axis=ax, keepdims=True)
+        return np.clip(gray + f * (img - gray), 0, hi)
+
+
+class HueTransform:
+    """Channel-rotation hue shift (cheap HSV-free approximation of ref
+    HueTransform; exact for hue steps of 1/3)."""
+
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        img, hi = _as_float(img)
+        ax = _channel_axis(img)
+        if ax is None or img.shape[ax] != 3:
+            return img
+        shift = np.random.uniform(-self.value, self.value)
+        # continuous interpolation between identity and rolled channels
+        rolled = np.roll(img, 1 if shift >= 0 else -1, axis=ax)
+        w = min(abs(shift) * 3.0, 1.0)
+        return np.clip((1 - w) * img + w * rolled, 0, hi)
+
+
+class ColorJitter:
+    """Brightness/contrast/saturation/hue in random order (ref
+    transforms.py ColorJitter:959)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def __call__(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        img, _ = _as_float(img)
+        ax = _channel_axis(img)
+        if ax is None:
+            return img if self.n == 1 else np.repeat(
+                img[..., None], self.n, axis=-1)
+        gray = img.mean(axis=ax, keepdims=True)
+        return np.repeat(gray, self.n, axis=ax)
+
+
+class RandomRotation:
+    """Nearest-neighbor rotation about the center (ref RandomRotation)."""
+
+    def __init__(self, degrees, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = _chw(img)
+        if chw:
+            img = np.moveaxis(img, 0, -1)
+        h, w = img.shape[:2]
+        theta = math.radians(np.random.uniform(*self.degrees))
+        yy, xx = np.mgrid[0:h, 0:w]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        ys = cy + (yy - cy) * math.cos(theta) - (xx - cx) * math.sin(theta)
+        xs = cx + (yy - cy) * math.sin(theta) + (xx - cx) * math.cos(theta)
+        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+        inside = (ys >= 0) & (ys <= h - 1) & (xs >= 0) & (xs <= w - 1)
+        out = np.where(inside[..., None] if img.ndim == 3 else inside,
+                       img[yi, xi], 0)
+        if chw:
+            out = np.moveaxis(out, -1, 0)
+        return out.astype(img.dtype)
+
+
+class RandomErasing:
+    """Cutout-style occlusion (ref transforms.py RandomErasing:1718)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        img = np.array(img)  # copy: erasing mutates
+        if np.random.rand() >= self.prob:
+            return img
+        chw = _chw(img)
+        h, w = (img.shape[1:] if chw else img.shape[:2])
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ratio = math.exp(np.random.uniform(
+                math.log(self.ratio[0]), math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ratio)))
+            ew = int(round(math.sqrt(target / ratio)))
+            if eh <= h and ew <= w:
+                y = np.random.randint(0, h - eh + 1)
+                x = np.random.randint(0, w - ew + 1)
+                v = self.value
+                if chw and np.ndim(v) == 1:
+                    v = np.reshape(v, (-1, 1, 1))  # per-channel fill
+                if chw:
+                    img[:, y:y + eh, x:x + ew] = v
+                else:
+                    img[y:y + eh, x:x + ew] = v
+                break
+        return img
+
+
+__all__ += ["ContrastTransform", "SaturationTransform", "HueTransform",
+            "ColorJitter", "Grayscale", "RandomRotation", "RandomErasing"]

@@ -1,0 +1,83 @@
+"""PP-YOLOE-style detector e2e on synthetic COCO-shaped data (VERDICT r2
+item 10 / BASELINE row 5): one jitted static-shape train step over padded
+ground truth, loss decreases, inference postprocess returns boxes."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import optimizer as optim
+from paddle_tpu.vision.models import ppyoloe
+
+
+def _synthetic_coco(b=2, img=64, max_boxes=4, classes=6, seed=0):
+    """Padded COCO-shaped batch: big axis-aligned colored rectangles whose
+    class == color bucket, so the loss is actually learnable."""
+    rs = np.random.RandomState(seed)
+    images = rs.rand(b, 3, img, img).astype(np.float32) * 0.1
+    boxes = np.zeros((b, max_boxes, 4), np.float32)
+    labels = np.zeros((b, max_boxes), np.int32)
+    valid = np.zeros((b, max_boxes), bool)
+    for i in range(b):
+        n = rs.randint(1, max_boxes + 1)
+        for j in range(n):
+            w, h = rs.randint(16, 40, 2)
+            x1 = rs.randint(0, img - w)
+            y1 = rs.randint(0, img - h)
+            c = rs.randint(0, classes)
+            images[i, c % 3, y1:y1 + h, x1:x1 + w] += 0.8
+            boxes[i, j] = (x1, y1, x1 + w, y1 + h)
+            labels[i, j] = c
+            valid[i, j] = True
+    return (jnp.asarray(images), jnp.asarray(boxes), jnp.asarray(labels),
+            jnp.asarray(valid))
+
+
+def test_assignment_masks_padded_gt():
+    model = ppyoloe.ppyoloe_s(num_classes=6)
+    images, boxes, labels, valid = _synthetic_coco()
+    cls, reg, centers, strides = model.tag_paths()(images)
+    a = centers.shape[0]
+    assert cls.shape == (2, a, 6) and reg.shape == (2, a, 4, 17)
+    assigned, pos = ppyoloe._assign(centers, strides, boxes[0], valid[0])
+    # padded gt slots never assigned
+    n_valid = int(valid[0].sum())
+    assert set(np.unique(np.asarray(assigned[np.asarray(pos)]))) <= \
+        set(range(n_valid))
+    # no-gt image: nothing positive
+    _, pos_none = ppyoloe._assign(centers, strides, boxes[0],
+                                  jnp.zeros_like(valid[0]))
+    assert not bool(pos_none.any())
+
+
+def test_detection_trains_on_synthetic_coco():
+    model = ppyoloe.ppyoloe_s(num_classes=6).tag_paths()
+    opt = optim.AdamW(learning_rate=2e-3)
+    params, buffers = model.split_params()
+    opt_state = opt.init(params)
+    step = ppyoloe.build_train_step(model, opt)
+    images, boxes, labels, valid = _synthetic_coco()
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for i in range(12):
+        params, opt_state, updates, loss, parts = step(
+            params, buffers, opt_state, images, boxes, labels, valid,
+            jax.random.fold_in(key, i))
+        buffers = {**buffers, **updates}
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert float(parts["n_pos"]) > 0
+
+
+def test_decode_predictions_shape():
+    model = ppyoloe.ppyoloe_s(num_classes=6).tag_paths().eval()
+    images, *_ = _synthetic_coco()
+    cls, reg, centers, strides = model(images)
+    dets = ppyoloe.decode_predictions(cls, reg, centers, strides,
+                                      score_thresh=0.0, top_k=10)
+    assert len(dets) == 2
+    for d in dets:
+        assert d["boxes"].shape[1] == 4
+        assert len(d["scores"]) == len(d["labels"]) == len(d["boxes"])
+        assert len(d["boxes"]) <= 10

@@ -1,0 +1,102 @@
+"""Domain datasets: ImageNet-style folder loading + augmentation pipeline,
+text and audio loaders (VERDICT r2 item 10; ref python/paddle/{vision,
+text,audio}/datasets)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder, ImageNet
+from paddle_tpu.text import Imdb, UCIHousing, Conll05st
+from paddle_tpu.audio import ESC50, TESS, MelSpectrogram
+from paddle_tpu.io import DataLoader
+
+
+def _make_imagenet_tree(root, classes=("n01440764", "n01443537"), n=3):
+    from PIL import Image
+    for split in ("train", "val"):
+        for ci, c in enumerate(classes):
+            d = os.path.join(root, split, c)
+            os.makedirs(d)
+            for i in range(n):
+                arr = np.full((8, 8, 3), 40 * ci + i, np.uint8)
+                Image.fromarray(arr).save(os.path.join(d, f"img_{i}.png"))
+
+
+def test_dataset_folder_and_imagenet(tmp_path):
+    _make_imagenet_tree(str(tmp_path))
+    ds = ImageNet(str(tmp_path), mode="train")
+    assert len(ds) == 6
+    assert ds.classes == ["n01440764", "n01443537"]
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and label == 0
+    img, label = ds[5]
+    assert label == 1
+
+    flat = ImageFolder(str(tmp_path / "val"))
+    assert len(flat) == 6
+    (img,) = flat[0]
+    assert img.shape == (8, 8, 3)
+
+    with pytest.raises(RuntimeError, match="no class folders"):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        DatasetFolder(str(empty))
+
+
+def test_imagenet_augmentation_pipeline(tmp_path):
+    """Real training pipeline: folder → augment → normalized CHW batch
+    through the DataLoader."""
+    _make_imagenet_tree(str(tmp_path))
+    pipe = T.Compose([
+        T.RandomResizedCrop(8),
+        T.RandomHorizontalFlip(),
+        T.ColorJitter(0.4, 0.4, 0.4, 0.1),
+        T.RandomRotation(10),
+        T.ToTensor(),
+        T.Normalize([0.485, 0.456, 0.406], [0.229, 0.224, 0.225]),
+        T.RandomErasing(prob=1.0),
+    ])
+    ds = ImageNet(str(tmp_path), mode="train", transform=pipe)
+    loader = DataLoader(ds, batch_size=3, shuffle=True, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    x, y = batches[0]
+    assert np.asarray(x).shape == (3, 3, 8, 8)
+    assert np.isfinite(np.asarray(x, np.float32)).all()
+
+
+def test_text_datasets():
+    tr = Imdb(mode="train", num_samples=64, seq_len=32)
+    te = Imdb(mode="test", num_samples=32, seq_len=32)
+    doc, label = tr[0]
+    assert doc.shape == (32,) and label in (0, 1)
+    assert len(tr) == 64 and len(te) == 32
+    # learnable signal: positive docs over-sample the first vocab decile
+    pos = tr.docs[tr.labels == 1]
+    neg = tr.docs[tr.labels == 0]
+    assert (pos < 500).mean() > (neg < 500).mean() + 0.1
+
+    h = UCIHousing(mode="train")
+    f, t = h[0]
+    assert f.shape == (13,) and t.shape == (1,)
+
+    c = Conll05st(mode="train", num_samples=16, seq_len=24)
+    w, p, l = c[0]
+    assert w.shape == p.shape == l.shape == (24,)
+    assert p.sum() == 1  # exactly one predicate
+
+
+def test_audio_datasets_with_features():
+    mel = MelSpectrogram(sr=16000, n_fft=256, n_mels=32)
+    ds = ESC50(mode="train", num_samples=8, feature_fn=mel)
+    feat, label = ds[0]
+    assert feat.shape[0] == 32 and 0 <= label < 50
+    t = TESS(mode="dev", num_samples=4)
+    w, label = t[0]
+    assert w.shape == (16000,) and 0 <= label < 7
+    # class-dependent fundamentals: different classes differ spectrally
+    d0 = [np.asarray(mel(ds.waves[i])) for i in range(4)]
+    assert all(np.isfinite(x).all() for x in d0)

@@ -1,0 +1,67 @@
+"""spawn API (ref distributed/spawn.py:482) + device-memory observability
+(ref memory/stats.h + mem_tracing.h; VERDICT r2 missing 10)."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu import profiler
+
+
+def _worker_writes(tmpdir):
+    import os as _os
+    rank = _os.environ["PT_PROCESS_ID"]
+    world = _os.environ["PT_NUM_PROCESSES"]
+    with open(_os.path.join(tmpdir, f"w_{rank}"), "w") as f:
+        f.write(world)
+
+
+def _worker_fails():
+    raise ValueError("worker boom")
+
+
+def test_spawn_runs_workers_with_env_contract(tmp_path):
+    dist.spawn(_worker_writes, args=(str(tmp_path),), nprocs=3)
+    for r in range(3):
+        assert (tmp_path / f"w_{r}").read_text() == "3"
+
+
+def test_spawn_propagates_worker_failure():
+    with pytest.raises(RuntimeError, match="worker boom"):
+        dist.spawn(_worker_fails, nprocs=2)
+
+
+def test_spawn_nonjoining_context(tmp_path):
+    ctx = dist.spawn(_worker_writes, args=(str(tmp_path),), nprocs=2,
+                     join=False)
+    assert len(ctx.processes) == 2
+    assert ctx.join()
+    assert (tmp_path / "w_0").exists() and (tmp_path / "w_1").exists()
+
+
+def test_memory_stats_surface():
+    x = jnp.ones((256, 256), jnp.float32)  # keep a live array around
+    s = profiler.device_memory_stats()
+    assert s["bytes_in_use"] >= x.nbytes
+    assert profiler.memory_allocated() == s["bytes_in_use"]
+    assert profiler.max_memory_allocated() >= 0
+    rec = profiler.record_memory_stats()
+    assert profiler.stat_registry.stats()["mem/bytes_in_use"] == \
+        int(rec["bytes_in_use"])
+    text = profiler.memory_summary()
+    assert "bytes_in_use" in text and "GiB" in text
+    del x
+
+
+def test_profiler_summary_includes_memory_block():
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    with profiler.RecordEvent("span"):
+        pass
+    p.stop()
+    assert "Device memory:" in p.summary()
+    assert "Device memory:" not in p.summary(memory=False)
