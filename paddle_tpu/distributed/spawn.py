@@ -48,8 +48,11 @@ class ProcessContext:
         rank's traceback if any exited non-zero. Returns False (like
         torch.multiprocessing) when a timeout expires with workers still
         running."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
         for p in self.processes:
-            p.join(timeout)
+            p.join(None if deadline is None
+                   else max(0.0, deadline - _time.monotonic()))
         if any(p.exitcode is None for p in self.processes):
             return False
         for rank, p in enumerate(self.processes):
@@ -71,6 +74,11 @@ class ProcessContext:
                 p.terminate()
 
 
+_KNOWN_OPTIONS = {"gpus", "xpus", "ips", "backend"}  # accepted for API
+# parity with the reference spawn (device selection is the mesh's job on
+# TPU); anything else is a typo and raises
+
+
 def spawn(func, args=(), nprocs=1, join=True, daemon=False,
           master_port=23471, start_method="spawn", **options):
     """Start ``nprocs`` processes running ``func(*args)`` with the same
@@ -80,6 +88,9 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False,
     join=True blocks and re-raises worker failures; join=False returns a
     :class:`ProcessContext`.
     """
+    unknown = set(options) - _KNOWN_OPTIONS
+    if unknown:
+        raise TypeError(f"spawn got unknown options {sorted(unknown)}")
     import tempfile
     ctx = mp.get_context(start_method)
     err_dir = tempfile.mkdtemp(prefix="pt_spawn_")

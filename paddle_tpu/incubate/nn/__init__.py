@@ -63,7 +63,9 @@ class FusedTransformerEncoderLayer(TransformerEncoderLayer):
 class FusedMultiTransformer(Module):
     """ref: incubate FusedMultiTransformer:997 → fused_multi_transformer_op.cu
     (the inference hot path). Stacked pre-LN decoder blocks sharing one
-    weight layout, compiled as one XLA program."""
+    weight layout, compiled as one XLA program — including the CacheKV
+    incremental-decode path the CUDA kernel exists for: pass
+    ``caches=self.gen_cache(batch)`` and feed one token at a time."""
 
     def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
                  activation="gelu", normalize_before=True, num_layers=1,
@@ -75,8 +77,34 @@ class FusedMultiTransformer(Module):
                                     normalize_before=normalize_before)
             for _ in range(num_layers)])
 
+    def gen_cache(self, x):
+        """Per-layer EMPTY KV caches for a (B, *, D) prototype
+        (≙ FusedMultiTransformer.gen_cache / CacheKV allocation). Prime
+        them by running the prompt through ``forward(prompt, caches=...)``
+        — each layer's cache must hold that layer's OWN K/V, so it cannot
+        be precomputed from the input embedding."""
+        import jax.numpy as jnp
+        b = x.shape[0]
+        out = []
+        for blk in self.blocks:
+            a = blk.self_attn
+            shape = (b, 0, a.num_heads, a.head_dim)
+            out.append((jnp.zeros(shape, x.dtype),
+                        jnp.zeros(shape, x.dtype)))
+        return out
+
     def forward(self, src, attn_mask=None, caches=None):
         out = src
-        for blk in self.blocks:
-            out = blk(out, src_mask=attn_mask)
-        return out
+        if caches is None:
+            for blk in self.blocks:
+                out = blk(out, src_mask=attn_mask)
+            return out
+        if len(caches) != len(self.blocks):
+            raise ValueError(
+                f"caches has {len(caches)} entries for "
+                f"{len(self.blocks)} layers (build with gen_cache)")
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            out, cache = blk(out, src_mask=attn_mask, cache=cache)
+            new_caches.append(cache)
+        return out, new_caches

@@ -8,11 +8,14 @@ infrt/CINN ambition (SURVEY §7.1b item 4). This module provides a
 Program-style API SHELL over jax.jit + AOT lowering (feed/fetch by name,
 InputSpec AOT, save/load_inference_model via jax.export StableHLO).
 
-Scope note (honesty over parity): there is no mutable Program IR here —
-code that CONSTRUCTS reference Programs op-by-op (append_op, block
-rewriting, paddle.static.nn.* layer building) does not port onto this
-shell; write the model as a traced function instead. What ports is the
-run surface: exe.run(feed=..., fetch_list=...) over a compiled function.
+Two surfaces:
+- **compiled-function path**: InputSpec-described functions AOT-lowered to
+  one executable (CompiledFunction), the jit face of static mode;
+- **lazy-graph Program path** (static/program.py): op-by-op construction —
+  ``static.data`` + ``static.nn.fc`` + Variable arithmetic +
+  ``append_backward`` + ``minimize`` — executed by ``Executor.run`` with
+  reference feed/fetch/scope semantics. Programs that REWRITE blocks (the
+  reference's pass infrastructure) have no analog; XLA owns rewriting.
 """
 
 from dataclasses import dataclass
@@ -23,7 +26,10 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["InputSpec", "CompiledFunction", "compile_fn", "Executor",
-           "save_inference_model", "load_inference_model", "default_main_program"]
+           "save_inference_model", "load_inference_model",
+           "default_main_program", "default_startup_program", "Program",
+           "Variable", "program_guard", "data", "call", "minimize",
+           "append_backward", "nn"]
 
 
 @dataclass(frozen=True)
@@ -66,31 +72,101 @@ def compile_fn(fn, input_specs, batch=1):
 
 
 class Executor:
-    """API-parity Executor (ref: fluid/executor.py:912). ``run`` executes a
-    compiled function with a feed dict."""
+    """≙ fluid Executor (executor.py:912 → InterpreterCore). Runs either a
+    lazy-graph :class:`Program` (op-by-op construction, see
+    static/program.py) or a compiled function. For Programs, one jitted
+    XLA step per (program version, feed signature) covers forward +
+    grads + optimizer update — the InterpreterCore replaced by the
+    compiler."""
 
     def __init__(self, place=None):
         self.place = place
+        self._cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None):
-        if not callable(program):
-            raise TypeError(
-                "paddle_tpu Executor runs compiled functions; build one with "
-                "paddle_tpu.static.compile_fn(fn, input_specs)")
+        from paddle_tpu.static.program import Program, Variable
         feed = feed or {}
-        args = list(feed.values())
-        out = program(*args)
-        if isinstance(out, (list, tuple)):
-            return [np.asarray(o) for o in out]
-        return [np.asarray(out)]
+        if program is None:
+            program = default_main_program()
+        if isinstance(program, Program):
+            return self._run_program(program, feed, fetch_list or [])
+        if callable(program):
+            out = program(*list(feed.values()))
+            if isinstance(out, (list, tuple)):
+                return [np.asarray(o) for o in out]
+            return [np.asarray(out)]
+        raise TypeError("program must be a static.Program or a compiled "
+                        "function")
 
+    def _run_program(self, program, feed, fetch_list):
+        from paddle_tpu.static.program import Variable
+        if not program.vars and not fetch_list:
+            return []  # empty/startup program
+        # resolve fetch-by-name (reference Executor accepts names)
+        resolved = []
+        for f in list(fetch_list):
+            if isinstance(f, str) and not f.endswith("@GRAD"):
+                if f not in program.vars:
+                    raise KeyError(f"fetch name {f!r} not in program")
+                f = program.vars[f]
+            resolved.append(f)
+        fetch_list = resolved
+        # @GRAD fetches (append_backward) resolve to param gradients
+        grad_fetches = [f for f in fetch_list
+                        if isinstance(f, str) and f.endswith("@GRAD")]
+        var_fetches = [f for f in fetch_list if isinstance(f, Variable)]
+        feed_vals = {k: jnp.asarray(v) for k, v in feed.items()}
+        key = (id(program), program._version,
+               tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed_vals.items())),
+               tuple(v.name for v in var_fetches),
+               tuple(grad_fetches))
+        step = self._cache.get(key)
+        opt = program._opt
+        if step is None:
+            fwd = program.build_fn(var_fetches, list(feed))
+            loss_var = None
+            if opt is not None:
+                loss_var = opt[1]
+            elif grad_fetches:
+                loss_var = program._loss_for_grads
+            loss_fn = (program.build_fn([loss_var], list(feed))
+                       if loss_var is not None else None)
 
-def default_main_program():
-    raise RuntimeError(
-        "paddle_tpu has no mutable global Program; trace a function with "
-        "paddle_tpu.jit.to_static / static.compile_fn instead "
-        "(ref Program IR: paddle/fluid/framework/framework.proto — replaced "
-        "by XLA HLO from tracing).")
+            def step(feed_vals, params, opt_state):
+                fetched = fwd(feed_vals, params)
+                grads = None
+                if loss_fn is not None:
+                    grads = jax.grad(
+                        lambda p: loss_fn(feed_vals, p)[0])(params)
+                new_params, new_state = params, opt_state
+                if opt is not None:
+                    new_params, new_state = opt[0].update(
+                        grads, opt_state, params)
+                gvals = []
+                for gf in grad_fetches:
+                    gvals.append(grads[program._grad_names[gf]])
+                return fetched, gvals, new_params, new_state
+
+            step = jax.jit(step)
+            self._cache[key] = step
+        if opt is not None and program._opt_state is None:
+            program._opt_state = opt[0].init(program.params)
+        fetched, gvals, new_params, new_state = step(
+            feed_vals, program.params, program._opt_state)
+        if opt is not None:
+            program.params = new_params       # reference scope mutation
+            program._opt_state = new_state
+        out = []
+        gi = vi = 0
+        for f in fetch_list:
+            if isinstance(f, str) and f.endswith("@GRAD"):
+                out.append(np.asarray(gvals[gi]))
+                gi += 1
+            else:
+                out.append(np.asarray(fetched[vi]))
+                vi += 1
+        return out
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
@@ -106,3 +182,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 def load_inference_model(path_prefix, executor=None, **kwargs):
     from paddle_tpu.jit import load as jit_load
     return jit_load(path_prefix)
+
+
+from paddle_tpu.static.program import (  # noqa: E402
+    Program, Variable, program_guard, default_main_program,
+    default_startup_program, data, call, minimize, append_backward, nn)

@@ -1,0 +1,290 @@
+"""Lazy-graph Program/Executor — op-by-op static program construction.
+
+Reference analog: Program/Block/Operator/Variable (fluid/framework.py:5220,
+:3552, :2712, :1353), ``append_backward`` (fluid/backward.py:1726) and
+``Executor.run`` (fluid/executor.py:1378 → InterpreterCore). The reference
+keeps a protobuf op list and interprets it per step; here the Program is a
+lazy expression graph over named Variables, and ``Executor.run`` compiles
+the whole requested computation (forward + grads + optimizer update) into
+ONE jitted XLA program per feed signature — the InterpreterCore's job done
+by the compiler, with reference run semantics (feed dict in, fetched
+numpy out, parameters mutated in the program's scope).
+
+Supported porting surface: ``static.data``, ``static.nn.fc``, Variable
+arithmetic, any registered tensor op through ``static.call`` /
+``Variable.apply``, ``append_backward``, ``optimizer minimize`` via
+``static.minimize``, ``Executor.run(feed, fetch_list)``,
+``program_guard``/``default_main_program``. Programs that REWRITE blocks
+(pass infrastructure) have no analog here — XLA owns program rewriting.
+"""
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Program", "Variable", "program_guard", "default_main_program",
+           "default_startup_program", "data", "call", "minimize",
+           "append_backward", "nn"]
+
+
+class Variable:
+    """Lazy graph node (≙ fluid Variable:1353 + the Operator producing it)."""
+
+    def __init__(self, program: "Program", name: str, shape=None,
+                 dtype=None, kind: str = "op",
+                 op: Optional[Callable] = None,
+                 inputs: Sequence["Variable"] = ()):
+        self.program = program
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.kind = kind                # "data" | "param" | "op"
+        self.op = op
+        self.inputs = list(inputs)
+
+    # -- graph building -------------------------------------------------------
+    def apply(self, fn: Callable, *others, **kwargs):
+        """New node computing ``fn(self, *others, **kwargs)`` — the
+        ``append_op`` analog for any pure tensor function."""
+        return call(fn, self, *others, **kwargs)
+
+    def _binop(self, other, fn, rev=False):
+        if isinstance(other, Variable):
+            a, b = (other, self) if rev else (self, other)
+            return call(fn, a, b)
+        const = other
+        if rev:
+            return call(lambda x: fn(jnp.asarray(const), x), self)
+        return call(lambda x: fn(x, jnp.asarray(const)), self)
+
+    def __add__(self, o):
+        return self._binop(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._binop(o, jnp.subtract, rev=True)
+
+    def __mul__(self, o):
+        return self._binop(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, jnp.divide)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, jnp.divide, rev=True)
+
+    def __matmul__(self, o):
+        return self._binop(o, jnp.matmul)
+
+    def __neg__(self):
+        return call(jnp.negative, self)
+
+    def __pow__(self, p):
+        return call(lambda x: jnp.power(x, p), self)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"kind={self.kind})")
+
+
+class Program:
+    """≙ fluid Program (framework.py:5220): named variables + parameters
+    scope + the optimizer/backward attachments ``minimize`` records."""
+
+    def __init__(self):
+        self.vars: Dict[str, Variable] = {}
+        self.params: Dict[str, jnp.ndarray] = {}   # the "scope"
+        self._counter = 0
+        self._version = 0          # bumped on mutation → executor recompile
+        self._opt = None           # (optimizer, loss Variable)
+        self._opt_state = None
+        self._grad_names: Dict[str, str] = {}
+
+    # -- construction ---------------------------------------------------------
+    def _unique(self, prefix):
+        self._counter += 1
+        return f"{prefix}_{self._counter}"
+
+    def add_var(self, var: Variable):
+        self.vars[var.name] = var
+        self._version += 1
+        return var
+
+    def create_parameter(self, shape, dtype=jnp.float32, name=None,
+                         initializer=None):
+        """≙ LayerHelper.create_parameter: materializes into the scope."""
+        name = name or self._unique("param")
+        if initializer is None:
+            fan_in = int(np.prod(shape[:-1])) if len(shape) > 1 else shape[0]
+            bound = float(np.sqrt(6.0 / max(fan_in + shape[-1], 1)))
+            init = np.random.RandomState(
+                abs(hash(name)) % (2**31)).uniform(
+                -bound, bound, shape).astype("float32")
+        else:
+            init = np.asarray(initializer(shape), "float32")
+        self.params[name] = jnp.asarray(init, dtype)
+        var = Variable(self, name, shape, dtype, kind="param")
+        return self.add_var(var)
+
+    def clone(self, for_test: bool = False):
+        """Shallow clone sharing the parameter scope (≙ Program.clone —
+        the reference's test clone also shares parameters)."""
+        p = Program()
+        p.vars = dict(self.vars)
+        p.params = self.params      # shared scope, like the reference
+        p._counter = self._counter
+        return p
+
+    def global_block(self):
+        return self                 # single-block programs (API parity)
+
+    # -- evaluation -----------------------------------------------------------
+    def _eval(self, var: Variable, feed_vals, params, memo):
+        if var.name in memo:
+            return memo[var.name]
+        if var.kind == "data":
+            val = feed_vals[var.name]
+        elif var.kind == "param":
+            val = params[var.name]
+        else:
+            args = [self._eval(v, feed_vals, params, memo)
+                    for v in var.inputs]
+            val = var.op(*args)
+        memo[var.name] = val
+        return val
+
+    def build_fn(self, fetch_vars: Sequence[Variable],
+                 feed_names: Sequence[str]):
+        """Pure function (feed_vals, params) → fetched values."""
+        def fn(feed_vals, params):
+            memo = {}
+            return [self._eval(v, feed_vals, params, memo)
+                    for v in fetch_vars]
+        return fn
+
+
+# -- default-program machinery (≙ fluid.default_main_program) ---------------
+
+_tls = threading.local()
+
+
+def _progs():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [Program()]
+    return _tls.stack
+
+
+def default_main_program() -> Program:
+    return _progs()[-1]
+
+
+def default_startup_program() -> Program:
+    """Parameters initialize at creation here; returns an empty runnable
+    program for ``exe.run(startup)`` call-site parity."""
+    return Program()
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program=None):
+    _progs().append(main_program)
+    try:
+        yield
+    finally:
+        _progs().pop()
+
+
+# -- op surface --------------------------------------------------------------
+
+def data(name: str, shape, dtype=jnp.float32):
+    """≙ paddle.static.data placeholder."""
+    prog = default_main_program()
+    var = Variable(prog, name, shape, dtype, kind="data")
+    return prog.add_var(var)
+
+
+def call(fn: Callable, *args, **kwargs):
+    """Append an op node computing ``fn(*args, **kwargs)``; Variable args
+    become graph edges, everything else is captured as a constant."""
+    prog = None
+    for a in args:
+        if isinstance(a, Variable):
+            prog = a.program
+            break
+    if prog is None:
+        raise ValueError("call() needs at least one Variable argument")
+    var_args = [a for a in args if isinstance(a, Variable)]
+
+    def op(*vals):
+        it = iter(vals)
+        full = [next(it) if isinstance(a, Variable) else a for a in args]
+        return fn(*full, **kwargs)
+
+    name = prog._unique(getattr(fn, "__name__", "op"))
+    out = Variable(prog, name, kind="op", op=op, inputs=var_args)
+    return prog.add_var(out)
+
+
+class _StaticNN:
+    """≙ paddle.static.nn layer builders (LayerHelper style)."""
+
+    @staticmethod
+    def fc(x: Variable, size: int, num_flatten_dims: int = 1,
+           activation: Optional[str] = None, name=None):
+        prog = x.program
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        w = prog.create_parameter((in_dim, size),
+                                  name=name and f"{name}.w")
+        b = prog.create_parameter((size,), name=name and f"{name}.b",
+                                  initializer=lambda s: np.zeros(s))
+
+        def op(xv, wv, bv):
+            lead = xv.shape[:num_flatten_dims]
+            flat = xv.reshape(lead + (-1,))
+            out = flat @ wv + bv
+            if activation is not None:
+                from paddle_tpu.nn import functional as F
+                out = getattr(F, activation)(out)
+            return out
+
+        out = Variable(prog, prog._unique(name or "fc"), kind="op", op=op,
+                       inputs=[x, w, b])
+        return prog.add_var(out)
+
+
+nn = _StaticNN()
+
+
+def append_backward(loss: Variable, parameter_list=None):
+    """≙ fluid.backward.append_backward:1726: registers @GRAD fetch names
+    for every parameter; the executor computes them with jax.grad inside
+    the same compiled program."""
+    prog = loss.program
+    names = parameter_list or list(prog.params)
+    out = []
+    for n in names:
+        prog._grad_names[f"{n}@GRAD"] = n
+        out.append((prog.vars[n], f"{n}@GRAD"))
+    prog._loss_for_grads = loss
+    prog._version += 1
+    return out
+
+
+def minimize(optimizer, loss: Variable):
+    """≙ Optimizer.minimize in static mode: attaches the update rule; each
+    ``Executor.run`` then performs forward + backward + parameter update
+    as one compiled step, mutating the program scope."""
+    prog = loss.program
+    prog._opt = (optimizer, loss)
+    prog._opt_state = None
+    prog._version += 1
+    return loss

@@ -36,16 +36,17 @@ class Transition(nn.Module):
 
 
 class DenseNet(nn.Module):
-    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+    def __init__(self, layers=121, growth_rate=None, bn_size=4,
                  num_classes=1000, with_pool=True):
         super().__init__()
         cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
                169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
                264: (6, 12, 64, 48)}[layers]
-        if layers == 161:
-            growth_rate, init_c = 48, 96
-        else:
-            init_c = 64
+        # 161 defaults to the wider (48, 96) stem ONLY when the caller
+        # did not choose a growth rate
+        if growth_rate is None:
+            growth_rate = 48 if layers == 161 else 32
+        init_c = 96 if layers == 161 else 64
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.stem = nn.Sequential(

@@ -306,8 +306,12 @@ def decode_predictions(cls_logits, reg_logits, centers, strides,
         order = np.argsort(-scores)[:top_k]
         bx, scores, labels = bx[order], scores[order], labels[order]
         if len(bx):
+            # per-category NMS (≙ multiclass matrix_nms): boxes only
+            # suppress others of the SAME class
             kept = np.asarray(nms(jnp.asarray(bx), iou_thresh,
-                                  scores=jnp.asarray(scores)))
+                                  scores=jnp.asarray(scores),
+                                  category_idxs=jnp.asarray(labels),
+                                  categories=np.arange(p.shape[-1])))
             bx, scores, labels = bx[kept], scores[kept], labels[kept]
         out.append({"boxes": bx, "scores": scores, "labels": labels})
     return out
