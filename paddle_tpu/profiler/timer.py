@@ -34,7 +34,12 @@ class Benchmark:
     def __init__(self, flops_per_step=None, num_chips=1, peak_flops=None):
         self.flops_per_step = flops_per_step
         self.num_chips = num_chips
-        self.peak_flops = peak_flops or detect_peak_flops()
+        # lazy: detect_peak_flops() calls jax.devices(), which INITIALIZES
+        # the backend — constructing a Benchmark (the module-level default
+        # below runs at `import paddle_tpu`!) must never do that.
+        # Falsy values (0/None) defer to detection, like the old
+        # `peak_flops or detect_peak_flops()`.
+        self._peak_flops = peak_flops or None
         self.reset()
 
     def reset(self):
@@ -66,6 +71,12 @@ class Benchmark:
         total_t = sum(t for t, _ in ts)
         total_n = sum(n or 0 for _, n in ts)
         return total_n / total_t if total_t > 0 else float("nan")
+
+    @property
+    def peak_flops(self):
+        if self._peak_flops is None:
+            self._peak_flops = detect_peak_flops()
+        return self._peak_flops
 
     def mfu(self):
         if self.flops_per_step is None:

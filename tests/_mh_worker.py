@@ -1,0 +1,70 @@
+"""Spawned-worker module for test_multihost. Pins the CPU platform at
+MODULE level: multiprocessing's spawn start-method unpickles the target
+function by importing this module, so these lines run before any jax
+backend can initialize (two workers must not both claim the single
+tunneled TPU)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+
+def worker(tmpdir):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # federate the per-process CPU devices into one global backend
+    # (cross-process CPU collectives run over gloo; on TPU pods the ICI/
+    # DCN fabric takes this role and no flag is needed). One device per
+    # process — conftest's xla_force_host_platform_device_count=8 leaks
+    # into spawned children through the environment.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.config.update("jax_num_cpu_devices", 1)
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()      # PT_* env → jax.distributed.initialize
+    rank = dist.get_rank()
+    world = jax.process_count()
+    assert world == 2, world
+    devices = jax.devices()       # global view: one device per process
+    assert len(devices) == 2
+
+    mesh = Mesh(np.array(devices), ("dp",))
+
+    # cross-process psum through shard_map (the NCCL-allreduce analog on
+    # the DCN plane)
+    @jax.jit
+    def allreduce(x):
+        return shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                         in_specs=P("dp"), out_specs=P("dp"))(x)
+
+    local = jnp.full((1, 4), float(rank + 1))
+    glob = jax.make_array_from_single_device_arrays(
+        (2, 4), NamedSharding(mesh, P("dp")),
+        [jax.device_put(local, devices[rank])])
+    out = allreduce(glob)
+    got = np.asarray(out.addressable_shards[0].data)
+    np.testing.assert_allclose(got, np.full((1, 4), 3.0))  # 1 + 2
+
+    # cross-process pipeline tick: roll(+1) as collective-permute BETWEEN
+    # THE TWO PROCESSES — the PP-over-DCN mechanism (≙ FleetExecutor's
+    # cross-rank interceptor sends)
+    @jax.jit
+    def ring_shift(x):
+        return shard_map(
+            lambda v: jax.lax.ppermute(
+                v, "dp", perm=[(i, (i + 1) % 2) for i in range(2)]),
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(x)
+
+    shifted = ring_shift(glob)
+    got = np.asarray(shifted.addressable_shards[0].data)
+    expect = np.full((1, 4), float(((rank - 1) % 2) + 1))
+    np.testing.assert_allclose(got, expect)
+
+    with open(os.path.join(tmpdir, f"ok_{rank}"), "w") as f:
+        f.write("1")

