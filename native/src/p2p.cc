@@ -1,0 +1,228 @@
+// Point-to-point tensor transport for the cross-host pipeline runtime.
+//
+// Reference analog: the FleetExecutor message bus —
+// paddle/fluid/distributed/fleet_executor/message_bus.cc (brpc/gRPC
+// messages between Carriers on different hosts) and interceptor.cc (the
+// per-task mailbox). The TPU-native re-design keeps the same shape: every
+// rank runs one Endpoint (listen socket + reader threads) whose incoming
+// messages land in a tag-addressed mailbox; sends are framed writes on a
+// cached connection per peer. No protobuf envelope — activations are raw
+// bytes framed [u64 tag][u64 len]; schedule semantics live in Python
+// (fleet_executor.py), transport stays dumb and fast.
+//
+// C ABI only (ctypes bindings, no pybind11).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Endpoint {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> readers;
+  std::vector<int> reader_fds;
+  std::mutex fds_mu;
+
+  // mailbox: tag -> FIFO of payloads
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<uint64_t, std::deque<std::vector<char>>> mail;
+
+  // cached outgoing connections, keyed "host:port"
+  std::mutex out_mu;
+  std::map<std::string, int> out_fds;
+};
+
+void reader_loop(Endpoint* ep, int fd) {
+  for (;;) {
+    uint64_t hdr[2];  // tag, len
+    if (!read_full(fd, hdr, sizeof(hdr))) break;
+    std::vector<char> payload(hdr[1]);
+    if (hdr[1] > 0 && !read_full(fd, payload.data(), hdr[1])) break;
+    {
+      std::lock_guard<std::mutex> lk(ep->mu);
+      ep->mail[hdr[0]].push_back(std::move(payload));
+    }
+    ep->cv.notify_all();
+  }
+  close(fd);
+}
+
+void accept_loop(Endpoint* ep) {
+  for (;;) {
+    sockaddr_in peer{};
+    socklen_t len = sizeof(peer);
+    int fd = accept(ep->listen_fd, reinterpret_cast<sockaddr*>(&peer), &len);
+    if (fd < 0) {
+      if (ep->stop.load()) return;
+      continue;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> lk(ep->fds_mu);
+    ep->reader_fds.push_back(fd);
+    ep->readers.emplace_back(reader_loop, ep, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptpp_create(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* ep = new Endpoint();
+  ep->listen_fd = fd;
+  ep->port = ntohs(addr.sin_port);
+  ep->accept_thread = std::thread(accept_loop, ep);
+  return ep;
+}
+
+int ptpp_port(void* h) { return static_cast<Endpoint*>(h)->port; }
+
+// Blocks until a message with `tag` arrives; returns its length WITHOUT
+// consuming it (pair with ptpp_recv). -1 on timeout.
+int64_t ptpp_probe(void* h, uint64_t tag, double timeout_s) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::unique_lock<std::mutex> lk(ep->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                      std::chrono::duration<double>(timeout_s));
+  bool ok = ep->cv.wait_until(lk, deadline, [&] {
+    auto it = ep->mail.find(tag);
+    return it != ep->mail.end() && !it->second.empty();
+  });
+  if (!ok) return -1;
+  return static_cast<int64_t>(ep->mail[tag].front().size());
+}
+
+// Pops the front message for `tag` into buf. Returns length, -1 on
+// timeout, -2 if cap is too small (message stays queued).
+int64_t ptpp_recv(void* h, uint64_t tag, void* buf, uint64_t cap,
+                  double timeout_s) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::unique_lock<std::mutex> lk(ep->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                      std::chrono::duration<double>(timeout_s));
+  bool ok = ep->cv.wait_until(lk, deadline, [&] {
+    auto it = ep->mail.find(tag);
+    return it != ep->mail.end() && !it->second.empty();
+  });
+  if (!ok) return -1;
+  auto& q = ep->mail[tag];
+  auto& msg = q.front();
+  if (msg.size() > cap) return -2;
+  int64_t n = static_cast<int64_t>(msg.size());
+  if (n > 0) memcpy(buf, msg.data(), msg.size());
+  q.pop_front();
+  return n;
+}
+
+// Framed send on a cached connection to host:port. 0 ok, -1 connect
+// failure, -2 write failure (connection dropped from the cache so the
+// next send redials — the elastic/restart path).
+int ptpp_send(void* h, const char* host, int port, uint64_t tag,
+              const void* data, uint64_t len) {
+  auto* ep = static_cast<Endpoint*>(h);
+  std::string key = std::string(host) + ":" + std::to_string(port);
+  std::lock_guard<std::mutex> lk(ep->out_mu);
+  auto it = ep->out_fds.find(key);
+  int fd = (it == ep->out_fds.end()) ? -1 : it->second;
+  if (fd < 0) {
+    fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+        connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      close(fd);
+      return -1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    ep->out_fds[key] = fd;
+  }
+  uint64_t hdr[2] = {tag, len};
+  if (!write_full(fd, hdr, sizeof(hdr)) ||
+      (len > 0 && !write_full(fd, data, len))) {
+    close(fd);
+    ep->out_fds.erase(key);
+    return -2;
+  }
+  return 0;
+}
+
+void ptpp_destroy(void* h) {
+  auto* ep = static_cast<Endpoint*>(h);
+  ep->stop.store(true);
+  shutdown(ep->listen_fd, SHUT_RDWR);
+  close(ep->listen_fd);
+  if (ep->accept_thread.joinable()) ep->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(ep->fds_mu);
+    for (int fd : ep->reader_fds) shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : ep->readers)
+    if (t.joinable()) t.join();
+  std::lock_guard<std::mutex> lk(ep->out_mu);
+  for (auto& kv : ep->out_fds) close(kv.second);
+  delete ep;
+}
+
+}  // extern "C"

@@ -1,0 +1,217 @@
+"""Parameter server (ref: paddle/fluid/distributed/ps/ — table.h dense/
+sparse tables, brpc_ps_server.cc, the fleet PS mode's push/pull protocol;
+python/paddle/distributed/fleet/runtime/parameter_server_runtime.py).
+
+TPU-native stance: synchronous data-parallel training on TPU uses mesh
+collectives, not a PS — but the reference's PS also serves the workload
+collectives can't: huge sparse embedding tables (recommender models) that
+live CPU-side, rows pulled/pushed by id. That is what this module keeps:
+
+- dense tables: whole-array pull / gradient push with a server-side
+  optimizer (async-SGD semantics — no global barrier, ≙ the reference's
+  async mode).
+- sparse tables: rows partitioned across servers by ``id % n_servers``
+  (≙ the reference's hash sharding), lazily initialized on first touch,
+  per-row adagrad or sgd.
+
+Transport is the RPC layer (distributed/rpc.py): handlers are module-level
+functions executed in the server's rpc pool; table state lives in the
+server process's ``_TABLES`` registry.
+"""
+
+import threading
+
+import numpy as np
+
+from paddle_tpu.distributed import rpc
+
+__all__ = ["PSClient", "init_server_tables", "DenseTable", "SparseTable"]
+
+_TABLES = {}
+_TLOCK = threading.Lock()
+
+
+class DenseTable:
+    def __init__(self, shape, lr=0.1, optimizer="sgd", seed=0):
+        rs = np.random.RandomState(seed)
+        self.w = (rs.normal(size=shape) * 0.01).astype(np.float32)
+        self.lr = lr
+        self.optimizer = optimizer
+        self.acc = np.zeros(shape, np.float32)  # adagrad accumulator
+        self.lock = threading.Lock()
+
+    def pull(self):
+        with self.lock:
+            return self.w.copy()
+
+    def push(self, grad):
+        grad = np.asarray(grad, np.float32)
+        with self.lock:
+            if self.optimizer == "adagrad":
+                self.acc += grad * grad
+                self.w -= self.lr * grad / (np.sqrt(self.acc) + 1e-8)
+            else:
+                self.w -= self.lr * grad
+
+
+class SparseTable:
+    """id → row, lazily initialized (≙ memory_sparse_table.cc)."""
+
+    def __init__(self, dim, lr=0.1, optimizer="adagrad", init_std=0.01,
+                 seed=0):
+        self.dim = dim
+        self.lr = lr
+        self.optimizer = optimizer
+        self.init_std = init_std
+        self.seed = seed
+        self.rows = {}
+        self.acc = {}
+        self.lock = threading.Lock()
+
+    def _row(self, i):
+        r = self.rows.get(i)
+        if r is None:
+            rs = np.random.RandomState((self.seed * 1000003 + i) & 0x7FFFFFFF)
+            r = (rs.normal(size=(self.dim,)) * self.init_std).astype(
+                np.float32)
+            self.rows[i] = r
+            self.acc[i] = np.zeros((self.dim,), np.float32)
+        return r
+
+    def pull(self, ids):
+        with self.lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        with self.lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                row = self._row(i)
+                if self.optimizer == "adagrad":
+                    self.acc[i] += g * g
+                    row -= self.lr * g / (np.sqrt(self.acc[i]) + 1e-8)
+                else:
+                    row -= self.lr * g
+
+    def size(self):
+        with self.lock:
+            return len(self.rows)
+
+
+# -- server-side rpc handlers (module-level → picklable by reference) -------
+
+def init_server_tables(specs):
+    """Run ON the server via rpc: create tables from
+    ``{name: ("dense", shape, kwargs) | ("sparse", dim, kwargs)}``."""
+    with _TLOCK:
+        for name, (kind, arg, kwargs) in specs.items():
+            if name in _TABLES:
+                continue  # idempotent: several workers declare the same job
+            if kind == "dense":
+                _TABLES[name] = DenseTable(arg, **kwargs)
+            elif kind == "sparse":
+                _TABLES[name] = SparseTable(arg, **kwargs)
+            else:
+                raise ValueError(kind)
+    return sorted(_TABLES)
+
+
+def _pull_dense(name):
+    return _TABLES[name].pull()
+
+
+def _push_dense(name, grad):
+    _TABLES[name].push(grad)
+    return True
+
+
+def _pull_sparse(name, ids):
+    return _TABLES[name].pull(ids)
+
+
+def _push_sparse(name, ids, grads):
+    _TABLES[name].push(ids, grads)
+    return True
+
+
+def _sparse_size(name):
+    return _TABLES[name].size()
+
+
+class PSClient:
+    """Worker-side handle (≙ fleet PS worker's pull/push API).
+
+    ``servers``: rpc worker names acting as parameter servers. Dense
+    tables live on ``servers[hash(name) % n]``; sparse rows are
+    partitioned ``id % n`` across all servers.
+    """
+
+    def __init__(self, servers):
+        self.servers = list(servers)
+
+    def _dense_home(self, name):
+        return self.servers[hash(name) % len(self.servers)]
+
+    def create_tables(self, specs):
+        """specs: {name: ("dense", shape, kwargs)|("sparse", dim, kwargs)}.
+        Dense specs go to their home server; sparse specs to every server
+        (each holds its id-partition)."""
+        per_server = {s: {} for s in self.servers}
+        for name, spec in specs.items():
+            if spec[0] == "dense":
+                per_server[self._dense_home(name)][name] = spec
+            else:
+                for s in self.servers:
+                    per_server[s][name] = spec
+        for s, sub in per_server.items():
+            if sub:
+                rpc.rpc_sync(s, init_server_tables, args=(sub,))
+
+    def pull_dense(self, name):
+        return rpc.rpc_sync(self._dense_home(name), _pull_dense,
+                            args=(name,))
+
+    def push_dense(self, name, grad, block=True):
+        fut = rpc.rpc_async(self._dense_home(name), _push_dense,
+                            args=(name, np.asarray(grad)))
+        return fut.wait() if block else fut
+
+    def pull_sparse(self, name, ids):
+        ids = np.asarray(ids, np.int64)
+        n = len(self.servers)
+        out = np.empty((len(ids), 0), np.float32)
+        parts = {}
+        for s_idx in range(n):
+            mask = (ids % n) == s_idx
+            if mask.any():
+                parts[s_idx] = (mask, rpc.rpc_async(
+                    self.servers[s_idx], _pull_sparse,
+                    args=(name, ids[mask])))
+        rows = None
+        for s_idx, (mask, fut) in parts.items():
+            got = fut.wait(120.0)
+            if rows is None:
+                rows = np.zeros((len(ids), got.shape[1]), np.float32)
+            rows[mask] = got
+        return rows
+
+    def push_sparse(self, name, ids, grads, block=True):
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        n = len(self.servers)
+        futs = []
+        for s_idx in range(n):
+            mask = (ids % n) == s_idx
+            if mask.any():
+                futs.append(rpc.rpc_async(
+                    self.servers[s_idx], _push_sparse,
+                    args=(name, ids[mask], grads[mask])))
+        if block:
+            for f in futs:
+                f.wait(120.0)
+        return futs
+
+    def sparse_size(self, name):
+        return sum(rpc.rpc_sync(s, _sparse_size, args=(name,))
+                   for s in self.servers)

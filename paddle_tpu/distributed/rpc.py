@@ -1,0 +1,241 @@
+"""RPC framework (ref: python/paddle/distributed/rpc/rpc.py — init_rpc:73,
+rpc_sync:141, rpc_async:179, shutdown:270, get_worker_info:299; C++ side
+paddle/fluid/distributed/rpc/rpc_agent.cc over brpc).
+
+TPU-native re-design: the transport is the same native P2P endpoint the
+pipeline runtime uses (native/src/p2p.cc) instead of brpc; rendezvous goes
+through the native TCPStore. Calls are pickled (fn, args, kwargs) — like
+the reference, which ships cloudpickled callables between trusted trainer
+processes — executed on a small server-side thread pool, results pickled
+back. Request mailbox: one well-known tag per rank; responses are
+individually tagged by (caller_rank, seq) so concurrent futures never
+collide.
+"""
+
+import pickle
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "WorkerInfo"]
+
+_REQ_TAG = 1 << 60
+_RESP_BASE = 1 << 61
+
+_state = None
+_lock = threading.Lock()
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    host: str
+    port: int
+
+
+class _Future:
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def _set(self, value=None, exc=None):
+        self._value, self._exc = value, exc
+        self._ev.set()
+
+    def wait(self, timeout=None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc future timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def done(self):
+        return self._ev.is_set()
+
+
+class _RpcState:
+    def __init__(self, name, rank, world_size, store, endpoint, workers):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.endpoint = endpoint
+        self.workers = workers            # rank -> WorkerInfo
+        self.by_name = {w.name: w for w in workers.values()}
+        self.seq = 0
+        self.seq_lock = threading.Lock()
+        self.futures = {}                 # seq -> _Future
+        self.fut_lock = threading.Lock()
+        self.stopping = threading.Event()
+        self.pool = ThreadPoolExecutor(max_workers=4,
+                                       thread_name_prefix="rpc-exec")
+        self.req_thread = threading.Thread(target=self._serve_requests,
+                                           daemon=True)
+        self.resp_thread = threading.Thread(target=self._serve_responses,
+                                            daemon=True)
+        self.req_thread.start()
+        self.resp_thread.start()
+
+    # -- server side --------------------------------------------------------
+
+    def _serve_requests(self):
+        while not self.stopping.is_set():
+            try:
+                payload = self.endpoint.recv(_REQ_TAG, timeout=0.25)
+            except TimeoutError:
+                continue
+            except Exception:
+                if self.stopping.is_set():
+                    return
+                continue
+            self.pool.submit(self._handle, payload)
+
+    def _handle(self, payload):
+        src, seq, fn, args, kwargs = pickle.loads(payload)
+        try:
+            result = (True, fn(*args, **(kwargs or {})))
+        except Exception as e:  # ship the failure back, not a hang
+            result = (False, (e, traceback.format_exc()))
+        peer = self.workers[src]
+        try:
+            self.endpoint.send(peer.host, peer.port,
+                               _RESP_BASE | (self.rank << 24) | seq,
+                               pickle.dumps(result))
+        except Exception:
+            pass  # caller's timeout handles a dead peer
+
+    # -- client side --------------------------------------------------------
+
+    def _serve_responses(self):
+        # responses are tagged (src_rank<<24 | seq); poll every pending tag
+        while not self.stopping.is_set():
+            with self.fut_lock:
+                pending = list(self.futures.items())
+            if not pending:
+                self.stopping.wait(0.02)
+                continue
+            got_any = False
+            for (tag, fut) in pending:
+                try:
+                    payload = self.endpoint.recv(tag, timeout=0.02)
+                except TimeoutError:
+                    continue
+                except Exception:
+                    continue
+                got_any = True
+                with self.fut_lock:
+                    self.futures.pop(tag, None)
+                ok, value = pickle.loads(payload)
+                if ok:
+                    fut._set(value=value)
+                else:
+                    exc, tb = value
+                    exc.args = (f"{exc}\n[remote traceback]\n{tb}",)
+                    fut._set(exc=exc)
+            if not got_any:
+                continue
+
+    def call(self, to, fn, args, kwargs, timeout):
+        info = self.by_name.get(to)
+        if info is None:
+            raise ValueError(f"unknown worker {to!r}; known: "
+                             f"{sorted(self.by_name)}")
+        with self.seq_lock:
+            seq = self.seq
+            self.seq = (self.seq + 1) & 0xFFFFFF
+        fut = _Future()
+        tag = _RESP_BASE | (info.rank << 24) | seq
+        with self.fut_lock:
+            self.futures[tag] = fut
+        self.endpoint.send(
+            info.host, info.port, _REQ_TAG,
+            pickle.dumps((self.rank, seq, fn, args or (), kwargs)))
+        fut._timeout = timeout
+        return fut
+
+    def close(self):
+        self.stopping.set()
+        self.req_thread.join(timeout=2)
+        self.resp_thread.join(timeout=2)
+        self.pool.shutdown(wait=False)
+        self.endpoint.close()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None,
+             host: str = "127.0.0.1"):
+    """Join the RPC group (≙ rpc.init_rpc:73). ``master_endpoint`` is
+    "host:port" of the TCPStore master (rank 0 starts it in-process)."""
+    global _state
+    import os
+    from paddle_tpu import native
+    rank = int(os.environ.get("PT_RANK", 0)) if rank is None else rank
+    world_size = int(os.environ.get("PT_WORLD_SIZE", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PT_MASTER", "127.0.0.1:23750")
+    mhost, mport = master_endpoint.rsplit(":", 1)
+    store = native.TCPStore(mhost, int(mport), is_master=(rank == 0),
+                            timeout=60.0)
+    endpoint = native.P2PEndpoint()
+    store.set(f"rpc/addr/{rank}", f"{name}|{host}:{endpoint.port}".encode())
+    workers = {}
+    for r in range(world_size):
+        raw = store.get(f"rpc/addr/{r}", timeout=60.0).decode()
+        wname, addr = raw.split("|", 1)
+        whost, wport = addr.rsplit(":", 1)
+        workers[r] = WorkerInfo(wname, r, whost, int(wport))
+    names = [w.name for w in workers.values()]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate rpc worker names: {names}")
+    with _lock:
+        if _state is not None:
+            raise RuntimeError("init_rpc called twice")
+        _state = _RpcState(name, rank, world_size, store, endpoint, workers)
+    return _state
+
+
+def _require_state():
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _state
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=120.0):
+    """Blocking remote call (≙ rpc_sync:141)."""
+    return _require_state().call(to, fn, args, kwargs, timeout).wait(timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=120.0):
+    """Returns a Future with ``.wait()`` / ``.done()`` (≙ rpc_async:179)."""
+    return _require_state().call(to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name=None):
+    """(≙ get_worker_info:299) — by name, or this worker when None."""
+    st = _require_state()
+    return st.by_name[name] if name is not None else st.workers[st.rank]
+
+
+def shutdown():
+    """Barrier then teardown (≙ shutdown:270): every rank checks in; the
+    group dissolves only when all have (so no rank drops requests still
+    in flight from a slower peer)."""
+    global _state
+    st = _state
+    if st is None:
+        return
+    n = st.store.add("rpc/shutdown", 1)
+    deadline = 60.0
+    import time
+    waited = 0.0
+    while n < st.world_size and waited < deadline:
+        time.sleep(0.05)
+        waited += 0.05
+        n = st.store.add("rpc/shutdown", 0)
+    st.close()
+    st.store.close()
+    _state = None

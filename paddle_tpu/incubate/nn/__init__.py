@@ -9,7 +9,45 @@ from paddle_tpu.nn.layer.transformer import (MultiHeadAttention,
 from paddle_tpu.nn.module import Module, LayerList
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedBiasDropoutResidualLayerNorm"]
+
+
+class FusedBiasDropoutResidualLayerNorm(Module):
+    """``ln(residual + dropout(x + bias))`` in one fused Pallas pass
+    (ref: incubate FusedBiasDropoutResidualLayerNorm, fused_transformer.py:81
+    → fused_bias_dropout_residual_layer_norm_op.cu). Unlike the API shells
+    above, this one carries its own fused kernel:
+    paddle_tpu.ops.pallas.layer_norm.fused_layer_norm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, bias_attr=None,
+                 epsilon=1e-5):
+        super().__init__()
+        import jax.numpy as jnp
+        from paddle_tpu.nn.module import Parameter
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.weight = Parameter(jnp.ones((embed_dim,)))
+        self.norm_bias = Parameter(jnp.zeros((embed_dim,)))
+        self.bias = (None if bias_attr is False
+                     else Parameter(jnp.zeros((embed_dim,))))
+
+    def forward(self, x, residual, dropout_seed=None):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.nn.functional.common import fold_ctx_key
+        from paddle_tpu.ops.pallas.layer_norm import fused_layer_norm
+        p = self.dropout_rate if self.training else 0.0
+        if p > 0.0 and dropout_seed is None:
+            # context-threaded RNG like F.dropout; PRF wants a scalar seed
+            dropout_seed = jax.random.bits(fold_ctx_key(), (),
+                                           jnp.uint32).astype(jnp.int32)
+        y, _ = fused_layer_norm(
+            x, self.weight, self.norm_bias, residual=residual,
+            bias=self.bias, dropout_p=p, dropout_seed=dropout_seed,
+            epsilon=self.epsilon)
+        return y
 
 
 class FusedMultiHeadAttention(MultiHeadAttention):

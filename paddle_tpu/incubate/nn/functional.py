@@ -1,0 +1,74 @@
+"""incubate.nn.functional (ref: python/paddle/incubate/nn/functional/
+__init__.py — fused_multi_head_attention, fused_feedforward,
+fused_matmul_bias/fused_linear, fused_bias_dropout_residual_layer_norm).
+
+On TPU, "fused" is either a Pallas kernel (attention, bias-dropout-
+residual-LN) or an XLA fusion guarantee (matmul+bias epilogues fuse under
+jit unconditionally — no cublasLt version gate to re-create)."""
+
+import jax.numpy as jnp
+
+__all__ = ["fused_matmul_bias", "fused_linear",
+           "fused_bias_dropout_residual_layer_norm",
+           "fused_multi_head_attention", "fused_feedforward"]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """ref: incubate fused_matmul_bias (fused_gemm_epilogue op). XLA fuses
+    the bias add into the matmul epilogue under jit; int8 QuantTensor
+    weights route the Pallas int8 kernel via __rmatmul__."""
+    x = jnp.asarray(x)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = y.T if hasattr(y, "dequantize") else jnp.swapaxes(
+            jnp.asarray(y), -1, -2)
+    out = x @ y if hasattr(y, "dequantize") else x @ jnp.asarray(y)
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        dropout_seed=None, name=None):
+    """ref: incubate fused_bias_dropout_residual_layer_norm →
+    fused_bias_dropout_residual_layer_norm_op.cu; here the Pallas fused-LN
+    kernel (ops/pallas/layer_norm.py)."""
+    x = jnp.asarray(x)
+    d = x.shape[-1]
+    from paddle_tpu.ops.pallas.layer_norm import fused_layer_norm
+    gamma = jnp.ones((d,), x.dtype) if ln_scale is None else ln_scale
+    beta = jnp.zeros((d,), x.dtype) if ln_bias is None else ln_bias
+    p = dropout_rate if training else 0.0
+    if p > 0.0 and dropout_seed is None:
+        import jax
+        from paddle_tpu.nn.functional.common import fold_ctx_key
+        dropout_seed = jax.random.bits(fold_ctx_key(), (),
+                                       jnp.uint32).astype(jnp.int32)
+    y, _ = fused_layer_norm(x, gamma, beta, residual=residual, bias=bias,
+                            dropout_p=p, dropout_seed=dropout_seed,
+                            epsilon=ln_epsilon)
+    return y
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
+    """ref: incubate fused_multi_head_attention. Provided at layer level
+    (FusedMultiHeadAttention → Pallas flash attention); the raw-weight
+    functional form is intentionally a thin composition."""
+    raise NotImplementedError(
+        "use incubate.nn.FusedMultiHeadAttention (the layer form); the "
+        "raw-weight functional depends on the reference's packed qkv "
+        "layout which paddle_tpu does not use")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, *args, **kwargs):
+    """ref: incubate fused_feedforward. See fused_multi_head_attention."""
+    raise NotImplementedError(
+        "use incubate.nn.FusedFeedForward (the layer form)")

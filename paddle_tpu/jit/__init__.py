@@ -66,8 +66,23 @@ def save(layer, path, input_spec=None, **configs):
     fn = layer.forward if hasattr(layer, "forward") else layer
     if input_spec is None:
         raise ValueError("input_spec is required for AOT export")
-    structs = [s.to_shape_struct() if isinstance(s, InputSpec) else s
-               for s in input_spec]
+    # None/-1 dims export as SYMBOLIC dimensions (shape polymorphism), so
+    # the loaded model accepts any batch — the reference's -1 batch dim in
+    # save_inference_model
+    scope = jax_export.SymbolicScope()
+    structs = []
+    for i, s in enumerate(input_spec):
+        if not isinstance(s, InputSpec):
+            structs.append(s)
+            continue
+        dims = []
+        for j, d in enumerate(s.shape):
+            if d is None or d == -1:
+                dims.append(jax_export.symbolic_shape(
+                    f"d{i}_{j}", scope=scope)[0])
+            else:
+                dims.append(int(d))
+        structs.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
     exported = jax_export.export(jax.jit(fn))(*structs)
     blob = exported.serialize()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

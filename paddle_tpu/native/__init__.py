@@ -29,7 +29,7 @@ def _build():
     root = os.path.abspath(root)
     out = _lib_path()
     srcs = [os.path.join(root, "src", f)
-            for f in ("ringbuffer.cc", "tcp_store.cc")]
+            for f in ("ringbuffer.cc", "tcp_store.cc", "p2p.cc")]
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-Wall",
            *srcs, "-o", out, "-lpthread", "-lrt"]
     subprocess.run(cmd, check=True, capture_output=True)
@@ -95,6 +95,21 @@ def _configure(lib, ctypes):
     lib.ptts_del.argtypes = [c.c_void_p, c.c_char_p]
     lib.ptts_close.restype = None
     lib.ptts_close.argtypes = [c.c_void_p]
+
+    lib.ptpp_create.restype = c.c_void_p
+    lib.ptpp_create.argtypes = [c.c_int]
+    lib.ptpp_port.restype = c.c_int
+    lib.ptpp_port.argtypes = [c.c_void_p]
+    lib.ptpp_probe.restype = c.c_int64
+    lib.ptpp_probe.argtypes = [c.c_void_p, c.c_uint64, c.c_double]
+    lib.ptpp_recv.restype = c.c_int64
+    lib.ptpp_recv.argtypes = [c.c_void_p, c.c_uint64, c.c_void_p,
+                              c.c_uint64, c.c_double]
+    lib.ptpp_send.restype = c.c_int
+    lib.ptpp_send.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_uint64,
+                              c.c_char_p, c.c_uint64]
+    lib.ptpp_destroy.restype = None
+    lib.ptpp_destroy.argtypes = [c.c_void_p]
 
 
 def is_available() -> bool:
@@ -228,4 +243,45 @@ class TCPStore:
             self._srv = None
 
 
-__all__ = ["is_available", "get_lib", "ShmRingBuffer", "TCPStore"]
+class P2PEndpoint:
+    """Tag-addressed point-to-point message endpoint (see p2p.cc;
+    ≙ fleet_executor/message_bus.cc + interceptor.cc mailboxes). One per
+    rank: ``send(host, port, tag, payload)`` is fire-and-forget on a
+    cached connection; ``recv(tag)`` blocks on the local mailbox."""
+
+    def __init__(self, port: int = 0):
+        import ctypes
+        self._ct = ctypes
+        self._lib = get_lib()
+        self._h = self._lib.ptpp_create(port)
+        if not self._h:
+            raise RuntimeError(f"P2PEndpoint failed to listen on {port}")
+        self.port = self._lib.ptpp_port(self._h)
+
+    def send(self, host: str, port: int, tag: int, payload: bytes):
+        rc = self._lib.ptpp_send(self._h, host.encode(), port, tag,
+                                 payload, len(payload))
+        if rc == -1:
+            raise ConnectionError(f"p2p connect {host}:{port} failed")
+        if rc != 0:
+            raise BrokenPipeError(f"p2p send to {host}:{port} failed")
+
+    def recv(self, tag: int, timeout: float = 60.0) -> bytes:
+        n = self._lib.ptpp_probe(self._h, tag, timeout)
+        if n == -1:
+            raise TimeoutError(f"p2p recv(tag={tag}) timed out "
+                               f"after {timeout}s")
+        buf = self._ct.create_string_buffer(max(int(n), 1))
+        m = self._lib.ptpp_recv(self._h, tag, buf, n, 0.0)
+        if m < 0:
+            raise RuntimeError(f"p2p recv(tag={tag}) failed rc={m}")
+        return self._ct.string_at(buf, m)
+
+    def close(self):
+        if self._h:
+            self._lib.ptpp_destroy(self._h)
+            self._h = None
+
+
+__all__ = ["is_available", "get_lib", "ShmRingBuffer", "TCPStore",
+           "P2PEndpoint"]

@@ -10,7 +10,8 @@ from paddle_tpu.nn.module import current_context, is_training
 __all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
            "embedding", "one_hot", "interpolate", "upsample", "pad",
            "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
-           "channel_shuffle", "label_smooth", "zeropad2d", "fold_ctx_key"]
+           "channel_shuffle", "label_smooth", "zeropad2d", "fold_ctx_key",
+           "pairwise_distance"]
 
 
 def linear(x, weight, bias=None):
@@ -21,7 +22,12 @@ def linear(x, weight, bias=None):
     WHITE_LIST:44)."""
     from paddle_tpu.amp.auto_cast import amp_cast
     x = amp_cast(jnp.asarray(x))
-    out = x @ amp_cast(jnp.asarray(weight))
+    if hasattr(weight, "dequantize"):
+        # int8 QuantTensor: dispatch through __rmatmul__ so the Pallas
+        # int8 kernel (not a dequantized copy) serves the matmul on TPU
+        out = x @ weight
+    else:
+        out = x @ amp_cast(jnp.asarray(weight))
     if bias is not None:
         out = out + amp_cast(jnp.asarray(bias))
     return out
@@ -200,3 +206,17 @@ def channel_shuffle(x, groups, data_format="NCHW"):
     x = x.reshape(n, groups, c // groups, h, w)
     x = jnp.swapaxes(x, 1, 2)
     return x.reshape(n, c, h, w)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    """ref: nn.functional.pairwise_distance (PairwiseDistance layer) —
+    p-norm of x - y along the last dim, epsilon added for gradient
+    stability at zero."""
+    d = jnp.asarray(x) - jnp.asarray(y) + epsilon
+    if p == 2.0:
+        out = jnp.sqrt(jnp.sum(jnp.square(d), axis=-1))
+    elif p == float("inf"):
+        out = jnp.max(jnp.abs(d), axis=-1)
+    else:
+        out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return out[..., None] if keepdim else out

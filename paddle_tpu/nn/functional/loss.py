@@ -11,7 +11,8 @@ __all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
            "hinge_embedding_loss", "log_loss", "square_error_cost",
            "triplet_margin_loss", "sigmoid_focal_loss", "dice_loss",
            "npair_loss", "soft_margin_loss", "multi_label_soft_margin_loss",
-           "poisson_nll_loss"]
+           "poisson_nll_loss", "multi_margin_loss",
+           "triplet_margin_with_distance_loss", "hsigmoid_loss"]
 
 
 def _reduce(loss, reduction):
@@ -317,4 +318,103 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     loss = -ll
     if reduction == "mean":
         return jnp.mean(loss / jnp.maximum(jnp.asarray(label_lengths), 1))
+    return _reduce(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean"):
+    """ref: nn.functional.multi_margin_loss — per-sample mean over
+    non-target classes of max(0, margin - x_y + x_j)^p."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).astype(jnp.int32)
+    n, c = x.shape
+    x_y = jnp.take_along_axis(x, y[:, None], axis=1)
+    hinge = jnp.maximum(0.0, margin - x_y + x) ** p
+    if weight is not None:
+        hinge = hinge * jnp.asarray(weight)[y][:, None]
+    mask = jax.nn.one_hot(y, c, dtype=x.dtype)
+    loss = jnp.sum(hinge * (1.0 - mask), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    """ref: nn.functional.triplet_margin_with_distance_loss — triplet loss
+    with a user distance callable (default: euclidean)."""
+    a = jnp.asarray(input)
+    pos = jnp.asarray(positive)
+    neg = jnp.asarray(negative)
+    dist = distance_function if distance_function is not None else (
+        lambda u, v: jnp.sqrt(jnp.sum(jnp.square(u - v), axis=-1) + 1e-12))
+    d_pos = dist(a, pos)
+    d_neg = dist(a, neg)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(pos, neg))
+    loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+    return _reduce(loss, reduction)
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=64)
+def _hsigmoid_paths(num_classes):
+    """Heap-layout complete binary tree over ``num_classes`` leaves:
+    internal nodes 0..num_classes-2, leaf for class c at num_classes-1+c.
+    Returns (path_table, path_code, path_mask) padded to the max depth."""
+    import numpy as np
+    paths = []
+    for c in range(num_classes):
+        node = num_classes - 1 + c
+        path = []
+        while node > 0:
+            parent = (node - 1) // 2
+            path.append((parent, 1.0 if node == 2 * parent + 2 else 0.0))
+            node = parent
+        paths.append(path[::-1])
+    depth = max(len(p) for p in paths)
+    table = np.zeros((num_classes, depth), np.int32)
+    code = np.zeros((num_classes, depth), np.float32)
+    mask = np.zeros((num_classes, depth), np.float32)
+    for c, p in enumerate(paths):
+        for d, (node, bit) in enumerate(p):
+            table[c, d] = node
+            code[c, d] = bit
+            mask[c, d] = 1.0
+    return jnp.asarray(table), jnp.asarray(code), jnp.asarray(mask)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  reduction="mean"):
+    """Hierarchical sigmoid (ref: nn.functional.hsigmoid_loss → phi
+    hsigmoid_loss kernel). Default tree: heap-layout complete binary tree
+    (the reference's non-custom-tree mode); custom trees via
+    path_table/path_code (+ implicit all-valid mask). O(log C) per sample:
+    sum over the root→leaf path of BCE-with-logits on each internal-node
+    binary decision. A correct implementation satisfies
+    Σ_c exp(-loss(x, c)) == 1 (leaf probabilities normalize), which the
+    tests assert."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label).astype(jnp.int32).reshape(-1)
+    w = jnp.asarray(weight)  # (num_classes-1, D) internal-node weights
+    if path_table is None:
+        table, code, mask = _hsigmoid_paths(int(num_classes))
+        t, cde, msk = table[y], code[y], mask[y]
+    else:
+        # custom mode (≙ is_custom=True): tables are PER-SAMPLE (N, L),
+        # exactly as the reference passes them — never re-indexed by label
+        t = jnp.asarray(path_table)
+        cde = jnp.asarray(path_code)
+        msk = jnp.where(t >= 0, 1.0, 0.0)
+        t = jnp.maximum(t, 0)
+    w_path = w[t]                                   # (N, depth, D)
+    logits = jnp.einsum("nd,npd->np", x, w_path)
+    if bias is not None:
+        logits = logits + jnp.asarray(bias).reshape(-1)[t]
+    # BCE with logits against the path code (right child = 1)
+    bce = jnp.maximum(logits, 0) - logits * cde + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    loss = jnp.sum(bce * msk, axis=1)
     return _reduce(loss, reduction)

@@ -9,7 +9,7 @@ __all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
            "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
            "adaptive_avg_pool2d", "adaptive_avg_pool3d",
            "adaptive_max_pool1d", "adaptive_max_pool2d",
-           "adaptive_max_pool3d", "max_unpool2d"]
+           "adaptive_max_pool3d", "max_unpool2d", "max_unpool1d", "max_unpool3d"]
 
 
 def _t(v, n):
@@ -217,11 +217,55 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     if output_size is None:
         k = _t(kernel_size, 2)
         s = _t(stride if stride is not None else kernel_size, 2)
-        oh = (h - 1) * s[0] + k[0] - 2 * (padding if isinstance(padding, int) else 0)
-        ow = (w - 1) * s[1] + k[1] - 2 * (padding if isinstance(padding, int) else 0)
+        p = (padding,) * 2 if isinstance(padding, int) else _t(padding, 2)
+        oh = (h - 1) * s[0] + k[0] - 2 * p[0]
+        ow = (w - 1) * s[1] + k[1] - 2 * p[1]
     else:
         oh, ow = output_size[-2:]
     flat = jnp.zeros((n, c, oh * ow), x.dtype).at[
         jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
         indices.reshape(n, c, -1)].set(x.reshape(n, c, -1))
     return flat.reshape(n, c, oh, ow)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    """ref: nn.functional.max_unpool1d — scatter via the 2d path on a
+    width-1 spatial dim."""
+    x = jnp.asarray(x)
+    indices = jnp.asarray(indices)
+    pad = padding if isinstance(padding, int) else _t(padding, 1)[0]
+    if output_size is None:
+        k = _t(kernel_size, 1)[0]
+        s = _t(stride if stride is not None else kernel_size, 1)[0]
+        # padding applies to the length dim only — the synthetic width-1
+        # dim below must see padding 0
+        ol = (x.shape[-1] - 1) * s + k - 2 * pad
+    else:
+        ol = output_size[-1]
+    out = max_unpool2d(x[:, :, :, None], indices[:, :, :, None],
+                       (kernel_size, 1),
+                       (stride if stride is not None else kernel_size, 1),
+                       0, (ol, 1))
+    return out[:, :, :, 0]
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    """ref: nn.functional.max_unpool3d — flat-index scatter over D*H*W."""
+    x = jnp.asarray(x)
+    indices = jnp.asarray(indices)
+    n, c, d, h, w = x.shape
+    if output_size is None:
+        k = _t(kernel_size, 3)
+        s = _t(stride if stride is not None else kernel_size, 3)
+        p = (padding,) * 3 if isinstance(padding, int) else _t(padding, 3)
+        od = (d - 1) * s[0] + k[0] - 2 * p[0]
+        oh = (h - 1) * s[1] + k[1] - 2 * p[1]
+        ow = (w - 1) * s[2] + k[2] - 2 * p[2]
+    else:
+        od, oh, ow = output_size[-3:]
+    flat = jnp.zeros((n, c, od * oh * ow), x.dtype).at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+        indices.reshape(n, c, -1)].set(x.reshape(n, c, -1))
+    return flat.reshape(n, c, od, oh, ow)

@@ -10,7 +10,8 @@ __all__ = ["CELU", "ELU", "GELU", "GLU", "Hardshrink", "Hardsigmoid",
            "Hardswish", "Hardtanh", "LeakyReLU", "LogSigmoid", "LogSoftmax",
            "Maxout", "Mish", "PReLU", "ReLU", "ReLU6", "RReLU", "SELU",
            "Sigmoid", "Silu", "Softmax", "Softplus", "Softshrink",
-           "Softsign", "Swish", "Tanh", "Tanhshrink", "ThresholdedReLU"]
+           "Softsign", "Swish", "Tanh", "Tanhshrink", "ThresholdedReLU",
+           "Softmax2D"]
 
 
 def _mk(name, fname, params=()):
@@ -66,3 +67,11 @@ class PReLU(Module):
 
     def forward(self, x):
         return F.prelu(x, self.weight, self.data_format)
+
+
+class Softmax2D(Module):
+    """ref: nn/layer/activation.py Softmax2D — softmax over the channel
+    dim of (N, C, H, W) / (C, H, W) inputs."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

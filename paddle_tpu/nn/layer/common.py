@@ -11,7 +11,7 @@ __all__ = ["Linear", "Identity", "Dropout", "Dropout2D", "Dropout3D",
            "UpsamplingNearest2D", "UpsamplingBilinear2D", "Pad1D", "Pad2D",
            "Pad3D", "ZeroPad2D", "CosineSimilarity", "Bilinear", "Unfold",
            "Fold", "PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
-           "LinearLowRank"]
+           "LinearLowRank", "PairwiseDistance"]
 
 
 class Linear(Module):
@@ -281,3 +281,14 @@ class ChannelShuffle(Module):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class PairwiseDistance(Module):
+    """ref: nn/layer/distance.py PairwiseDistance → p_norm(x - y)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)

@@ -7,7 +7,8 @@ __all__ = ["CrossEntropyLoss", "NLLLoss", "BCELoss", "BCEWithLogitsLoss",
            "MSELoss", "L1Loss", "SmoothL1Loss", "HuberLoss", "KLDivLoss",
            "MarginRankingLoss", "CosineEmbeddingLoss", "CTCLoss",
            "HingeEmbeddingLoss", "TripletMarginLoss", "SoftMarginLoss",
-           "MultiLabelSoftMarginLoss", "PoissonNLLLoss"]
+           "MultiLabelSoftMarginLoss", "PoissonNLLLoss", "MultiMarginLoss",
+           "TripletMarginWithDistanceLoss", "HSigmoidLoss"]
 
 
 class _Loss(Module):
@@ -88,3 +89,39 @@ class MultiLabelSoftMarginLoss(_Loss):
 
 class PoissonNLLLoss(_Loss):
     fn = "poisson_nll_loss"
+
+
+class MultiMarginLoss(_Loss):
+    fn = "multi_margin_loss"
+
+
+class TripletMarginWithDistanceLoss(_Loss):
+    fn = "triplet_margin_with_distance_loss"
+
+
+class HSigmoidLoss(Module):
+    """Hierarchical sigmoid head (ref: nn/layer/loss.py HSigmoidLoss →
+    hsigmoid_loss functional): owns the (num_classes-1, D) internal-node
+    weights of the default complete binary tree."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.nn.module import Parameter
+        self.num_classes = num_classes
+        n_nodes = num_classes - 1
+        rs = np.random.RandomState(0)
+        bound = float(np.sqrt(6.0 / (feature_size + n_nodes)))
+        self.weight = Parameter(jnp.asarray(
+            rs.uniform(-bound, bound, (n_nodes, feature_size)),
+            jnp.float32))
+        self.bias = (None if bias_attr is False
+                     else Parameter(jnp.zeros((n_nodes,), jnp.float32)))
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)

@@ -7,7 +7,7 @@ from paddle_tpu.nn.module import Module
 __all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
            "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
            "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
-           "AdaptiveMaxPool3D", "MaxUnPool2D"]
+           "AdaptiveMaxPool3D", "MaxUnPool2D", "MaxUnPool1D", "MaxUnPool3D"]
 
 
 class _Pool(Module):
@@ -95,3 +95,23 @@ class MaxUnPool2D(Module):
 
     def forward(self, x, indices):
         return F.max_unpool2d(x, indices, *self.args)
+
+
+class MaxUnPool1D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0, output_size=None,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self.args)
+
+
+class MaxUnPool3D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0, output_size=None,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size, data_format)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self.args)

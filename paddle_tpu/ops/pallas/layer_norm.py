@@ -1,0 +1,242 @@
+"""Fused bias + dropout + residual-add + LayerNorm as a Pallas TPU kernel.
+
+Reference analog: paddle/fluid/operators/fused/fused_layernorm_residual_
+dropout_bias.h and fused_bias_dropout_residual_layer_norm_op.cu — the CUDA
+fusion that computes ``ln(residual + dropout(x + bias))`` in one kernel so
+the intermediate (B, S, D) tensors never round-trip HBM. The TPU-native
+re-design is one Pallas pass per row-block: load x once, apply bias +
+counter-based dropout + residual in VMEM, compute row statistics in fp32,
+and write the normalized output plus the pre-norm sum (the residual stream
+a pre-LN transformer block carries forward).
+
+The backward is a custom VJP in plain XLA: it regenerates the dropout mask
+from the same counter PRF (zero residual memory, ≙ the Philox replay in
+the CUDA backward) and recomputes x̂ from the saved (mean, rstd) row
+statistics. The forward fusion is where the HBM win is; the backward
+reductions (dγ/dβ are column sums over all rows) are XLA's home turf.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_layer_norm", "dropout_keep_mask"]
+
+_LANES = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _mix(x):
+    """lowbias32 integer hash (same PRF family as the flash-attention
+    dropout, so forward and backward regenerate identical masks)."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def dropout_keep_mask(seed, row0, n_cols, block_shape, rate):
+    """Deterministic keep-mask for a (rows, cols) block whose first row is
+    ``row0`` of the global (M, N) tensor. Pure jnp: runs identically inside
+    the Pallas kernel and in the XLA backward."""
+    rows, cols = block_shape
+    r = row0 + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
+    lin = r.astype(jnp.uint32) * jnp.uint32(n_cols) + c.astype(jnp.uint32)
+    h = _mix(_mix(lin ^ jnp.asarray(seed).astype(jnp.uint32)))
+    thresh = jnp.uint32(min(int(rate * 2.0**32), 2**32 - 1))
+    return h >= thresh
+
+
+def _fwd_kernel(seed_ref, x_ref, gamma_ref, beta_ref, *refs, eps, has_bias,
+                has_residual, dropout_rate, block_m, n):
+    idx = 0
+    bias_ref = refs[idx] if has_bias else None
+    idx += int(has_bias)
+    res_ref = refs[idx] if has_residual else None
+    idx += int(has_residual)
+    y_ref, pre_ref, mean_ref, rstd_ref = refs[idx:idx + 4]
+
+    i = pl.program_id(0)
+    pre = x_ref[...].astype(jnp.float32)
+    if has_bias:
+        pre = pre + bias_ref[...].astype(jnp.float32)
+    if dropout_rate > 0.0:
+        keep = dropout_keep_mask(seed_ref[0], i * block_m, n, pre.shape,
+                                 dropout_rate)
+        pre = jnp.where(keep, pre / (1.0 - dropout_rate), 0.0)
+    if has_residual:
+        pre = pre + res_ref[...].astype(jnp.float32)
+
+    mean = jnp.mean(pre, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(pre - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (pre - mean) * rstd
+    y = xhat * gamma_ref[...].astype(jnp.float32) \
+        + beta_ref[...].astype(jnp.float32)
+
+    y_ref[...] = y.astype(y_ref.dtype)
+    pre_ref[...] = pre.astype(pre_ref.dtype)
+    # row stats are broadcast across the padded lane dim (TPU wants a
+    # 128-lane minor); column 0 is the value
+    mean_ref[...] = jnp.broadcast_to(mean, mean_ref.shape)
+    rstd_ref[...] = jnp.broadcast_to(rstd, rstd_ref.shape)
+
+
+def _fwd_pallas(x2, gamma, beta, bias, residual, seed, eps, dropout_rate,
+                interpret):
+    m, n = x2.shape
+    block_m = max(8, min(128, _round_up(m, 8)))
+    m_pad = _round_up(m, block_m)
+    if m_pad != m:
+        pad = ((0, m_pad - m), (0, 0))
+        x2 = jnp.pad(x2, pad)
+        if residual is not None:
+            residual = jnp.pad(residual, pad)
+    row_spec = pl.BlockSpec((block_m, n), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, n), lambda i: (0, 0))
+    stat_spec = pl.BlockSpec((block_m, _LANES), lambda i: (i, 0))
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM),
+                row_spec, vec_spec, vec_spec]
+    args = [seed, x2, gamma.reshape(1, n), beta.reshape(1, n)]
+    if bias is not None:
+        in_specs.append(vec_spec)
+        args.append(bias.reshape(1, n))
+    if residual is not None:
+        in_specs.append(row_spec)
+        args.append(residual)
+    kernel = functools.partial(
+        _fwd_kernel, eps=eps, has_bias=bias is not None,
+        has_residual=residual is not None, dropout_rate=dropout_rate,
+        block_m=block_m, n=n)
+    y, pre, mean, rstd = pl.pallas_call(
+        kernel,
+        grid=(m_pad // block_m,),
+        in_specs=in_specs,
+        out_specs=[row_spec, row_spec, stat_spec, stat_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, n), x2.dtype),
+            jax.ShapeDtypeStruct((m_pad, n), x2.dtype),
+            jax.ShapeDtypeStruct((m_pad, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((m_pad, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(*args)
+    return y[:m], pre[:m], mean[:m, :1], rstd[:m, :1]
+
+
+def _fwd_xla(x2, gamma, beta, bias, residual, seed, eps, dropout_rate):
+    pre = x2.astype(jnp.float32)
+    if bias is not None:
+        pre = pre + bias.astype(jnp.float32)
+    if dropout_rate > 0.0:
+        keep = dropout_keep_mask(seed[0], 0, x2.shape[1], pre.shape,
+                                 dropout_rate)
+        pre = jnp.where(keep, pre / (1.0 - dropout_rate), 0.0)
+    if residual is not None:
+        pre = pre + residual.astype(jnp.float32)
+    mean = jnp.mean(pre, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(pre - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = ((pre - mean) * rstd * gamma.astype(jnp.float32)
+         + beta.astype(jnp.float32))
+    return (y.astype(x2.dtype), pre.astype(x2.dtype), mean, rstd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _fused_ln(x2, gamma, beta, bias, residual, seed, eps, dropout_rate,
+              interpret):
+    (y, pre), _ = _fused_ln_fwd(x2, gamma, beta, bias, residual, seed,
+                                eps, dropout_rate, interpret)
+    return y, pre
+
+
+def _fused_ln_fwd(x2, gamma, beta, bias, residual, seed, eps, dropout_rate,
+                  interpret):
+    use_pallas = ((jax.default_backend() == "tpu" or interpret)
+                  and x2.shape[1] % _LANES == 0)
+    if use_pallas:
+        y, pre, mean, rstd = _fwd_pallas(x2, gamma, beta, bias, residual,
+                                         seed, eps, dropout_rate, interpret)
+    else:
+        y, pre, mean, rstd = _fwd_xla(x2, gamma, beta, bias, residual,
+                                      seed, eps, dropout_rate)
+    return (y, pre), (pre, mean, rstd, gamma, seed,
+                      bias is not None, residual is not None)
+
+
+def _fused_ln_bwd(eps, dropout_rate, interpret, res, cts):
+    pre, mean, rstd, gamma, seed, has_bias, has_residual = res
+    dy, dpre_out = cts
+    n = pre.shape[1]
+    pre_f = pre.astype(jnp.float32)
+    dy_f = dy.astype(jnp.float32)
+    xhat = (pre_f - mean) * rstd
+
+    dgamma = jnp.sum(dy_f * xhat, axis=0).astype(gamma.dtype)
+    dbeta = jnp.sum(dy_f, axis=0).astype(gamma.dtype)
+
+    # LN input grad: rstd * (dxhat - mean(dxhat) - xhat * mean(dxhat·xhat))
+    dxhat = dy_f * gamma.astype(jnp.float32)
+    dpre = rstd * (dxhat
+                   - jnp.mean(dxhat, axis=-1, keepdims=True)
+                   - xhat * jnp.mean(dxhat * xhat, axis=-1, keepdims=True))
+    # the pre-norm sum is also an output (residual stream): its cotangent
+    # joins at the sum node
+    dpre = dpre + dpre_out.astype(jnp.float32)
+
+    dresidual = dpre.astype(pre.dtype) if has_residual else None
+    dx = dpre
+    if dropout_rate > 0.0:
+        keep = dropout_keep_mask(seed[0], 0, n, dpre.shape, dropout_rate)
+        dx = jnp.where(keep, dpre / (1.0 - dropout_rate), 0.0)
+    dbias = jnp.sum(dx, axis=0).astype(gamma.dtype) if has_bias else None
+    dseed = np.zeros(seed.shape, jax.dtypes.float0)
+    return (dx.astype(pre.dtype), dgamma, dbeta, dbias, dresidual, dseed)
+
+
+_fused_ln.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+def fused_layer_norm(x, gamma, beta, residual=None, bias=None,
+                     dropout_p: float = 0.0, dropout_seed=None,
+                     epsilon: float = 1e-5, interpret=None):
+    """``ln(residual + dropout(x + bias))`` in one fused pass.
+
+    Returns ``(y, pre)`` where ``pre`` is the pre-norm sum
+    (≙ fused_layernorm_residual_dropout_bias.h returning both out and
+    dropout_residual_out). Normalization is over the last dim; leading
+    dims are flattened. Differentiable w.r.t. x/gamma/beta/bias/residual;
+    dropout replays deterministically from ``dropout_seed`` (scalar int32,
+    array or python int) in the backward — no mask is stored.
+    ``interpret`` defaults to True off-TPU so tests run on CPU.
+    """
+    x = jnp.asarray(x)
+    shape = x.shape
+    n = shape[-1]
+    x2 = x.reshape(-1, n)
+    res2 = None if residual is None else jnp.asarray(residual).reshape(-1, n)
+    if dropout_p >= 1.0 or dropout_p < 0.0:
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    seed = jnp.reshape(
+        jnp.asarray(0 if dropout_seed is None else dropout_seed,
+                    jnp.int32), (1,))
+    y, pre = _fused_ln(x2, jnp.asarray(gamma), jnp.asarray(beta),
+                       None if bias is None else jnp.asarray(bias),
+                       res2, seed, float(epsilon), float(dropout_p),
+                       bool(interpret))
+    return y.reshape(shape), pre.reshape(shape)

@@ -44,8 +44,17 @@ class Optimizer:
         # decoupled weight decay flag (AdamW-style); L2-style subclasses add
         # wd*p to the gradient instead
         self._decoupled_wd = False
-        if parameters is not None:
-            self.bind(parameters)
+        # DEFERRED bind: subclass __init__ has not run yet, so init_param
+        # may depend on attributes (e.g. Adam.moment_dtype) that don't
+        # exist — slots are materialized on first use instead
+        self._params = None
+        self._state = None
+        self._deferred_params = parameters
+
+    def _ensure_bound(self):
+        if self._state is None and self._deferred_params is not None:
+            self.bind(self._deferred_params)
+            self._deferred_params = None
 
     # -- functional API --------------------------------------------------------
     def init_param(self, p):
@@ -93,6 +102,7 @@ class Optimizer:
         return self
 
     def step(self, grads):
+        self._ensure_bound()
         self._params, self._state = self.update(grads, self._state,
                                                 self._params)
         return self._params

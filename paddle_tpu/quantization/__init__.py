@@ -52,8 +52,10 @@ class QuantTensor:
     def dequantize(self):
         return (self.q.astype(jnp.float32) * self.scale).astype(self._dtype)
 
-    # jnp.asarray(...) / operator dispatch hook
-    __jax_array__ = dequantize
+    # NOTE deliberately NO __jax_array__: jax's deferring binary ops would
+    # convert (dequantize) the operand BEFORE Python ever tries our
+    # __rmatmul__, silently bypassing the Pallas int8 kernel. Without it,
+    # jnp_array @ qt returns NotImplemented and Python dispatches here.
 
     @property
     def shape(self):
@@ -82,6 +84,19 @@ class QuantTensor:
         return self.dequantize() @ other
 
     def __rmatmul__(self, other):
+        """``x @ qt`` — the serving hot path. On TPU this routes the
+        Pallas int8 matmul (weights stream HBM→VMEM as int8, dequantized
+        per-tile at the MXU; ops/pallas/quant_matmul.py); elsewhere XLA
+        fuses the convert into the dot."""
+        other = jnp.asarray(other)
+        if (jax.default_backend() == "tpu" and self.q.ndim == 2
+                and other.ndim >= 2 and other.dtype == self._dtype):
+            try:
+                from paddle_tpu.ops.pallas.quant_matmul import int8_matmul
+                return int8_matmul(other, self.q,
+                                   self.scale.reshape(1, -1))
+            except Exception:
+                pass
         return other @ self.dequantize()
 
     def __getitem__(self, idx):

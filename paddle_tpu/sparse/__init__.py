@@ -1,15 +1,31 @@
-"""Sparse tensors (ref: paddle/phi/core/sparse_coo_tensor.h +
-python/paddle/sparse/). XLA:TPU has no native sparse kernels; SparseCooTensor
-is a (indices, values, shape) triple with dense bridging — the pattern that
-matters for TPU (embedding-style scatter/gather) is expressed densely via
-segment_sum, which tiles well on the MXU/VPU."""
+"""Sparse tensors (ref: python/paddle/sparse/__init__.py surface;
+paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h; kernels
+paddle/phi/kernels/sparse/). XLA:TPU has no native sparse kernels, so the
+TPU-native design keeps values/indices as dense arrays and expresses
+every op as gather / scatter-add / segment_sum — the forms that tile onto
+the VPU/MXU — with host-side numpy only where the reference also runs on
+host (rulebook/coalesce index plumbing).
+
+Surface parity: creation (coo/csr), the full unary value-op list
+(sin..expm1, cast, neg, pow, abs), coalesce/transpose/reshape, binary
+(add/subtract/multiply/divide/mv/matmul/masked_matmul/is_same_shape),
+multiary (addmm), and ``sparse.nn`` (activations, BatchNorm, Conv3D,
+SubmConv3D, MaxPool3D) in sparse/nn.py.
+"""
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
-           "to_dense", "to_sparse_coo", "add", "matmul", "masked_matmul"]
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "to_dense", "to_sparse_coo",
+           "is_same_shape", "coalesce", "transpose", "reshape", "cast",
+           "add", "subtract", "multiply", "divide", "matmul",
+           "masked_matmul", "mv", "addmm", "nn",
+           # unary value ops
+           "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+           "sqrt", "square", "log1p", "abs", "pow", "neg", "expm1",
+           "deg2rad", "rad2deg"]
 
 
 class SparseCooTensor:
@@ -26,8 +42,54 @@ class SparseCooTensor:
     def nnz(self):
         return self.values.shape[0]
 
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def with_values(self, values):
+        return SparseCooTensor(self.indices, values, self.shape)
+
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.values.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR (≙ sparse_csr_tensor.h): 2-D, row-pointer layout."""
+
+    def __init__(self, crows, cols, values, shape):
+        crows = jnp.asarray(crows)
+        cols = jnp.asarray(cols)
+        # default to int32 for float/py-list inputs; preserve an explicit
+        # integer dtype (cast(index_dtype=...) round-trips through here)
+        self.crows = crows if jnp.issubdtype(crows.dtype, jnp.integer) \
+            else crows.astype(jnp.int32)
+        self.cols = cols if jnp.issubdtype(cols.dtype, jnp.integer) \
+            else cols.astype(jnp.int32)
+        self.values = jnp.asarray(values)
+        self.shape = tuple(shape)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_coo(self):
+        crows = np.asarray(self.crows)
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        return SparseCooTensor(np.stack([rows, np.asarray(self.cols)]),
+                               self.values, self.shape)
+
+    def to_dense(self):
+        return self.to_coo().to_dense()
+
+    def with_values(self, values):
+        return SparseCsrTensor(self.crows, self.cols, values, self.shape)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
                 f"dtype={self.values.dtype})")
 
 
@@ -40,14 +102,11 @@ def sparse_coo_tensor(indices, values, shape=None):
 
 
 def sparse_csr_tensor(crows, cols, values, shape):
-    crows = np.asarray(crows)
-    cols = np.asarray(cols)
-    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-    return SparseCooTensor(np.stack([rows, cols]), values, shape)
+    return SparseCsrTensor(crows, cols, values, shape)
 
 
 def to_dense(x):
-    return x.to_dense() if isinstance(x, SparseCooTensor) else jnp.asarray(x)
+    return x.to_dense() if hasattr(x, "to_dense") else jnp.asarray(x)
 
 
 def to_sparse_coo(x, sparse_dim=None):
@@ -57,23 +116,155 @@ def to_sparse_coo(x, sparse_dim=None):
     return SparseCooTensor(idx, vals, arr.shape)
 
 
+def is_same_shape(a, b):
+    return tuple(a.shape) == tuple(b.shape)
+
+
+def coalesce(x: "SparseCooTensor"):
+    """Sort indices lexicographically and sum duplicates (≙ phi
+    sparse coalesce kernel — index plumbing on host, value segment_sum on
+    device)."""
+    idx = np.asarray(jax.device_get(x.indices))
+    flat = np.ravel_multi_index(idx, x.shape[:idx.shape[0]])
+    uniq, inv = np.unique(flat, return_inverse=True)
+    vals = jax.ops.segment_sum(x.values, jnp.asarray(inv),
+                               num_segments=len(uniq))
+    new_idx = np.stack(np.unravel_index(uniq, x.shape[:idx.shape[0]]))
+    return SparseCooTensor(new_idx, vals, x.shape)
+
+
+def transpose(x: "SparseCooTensor", perm):
+    idx = x.indices[jnp.asarray(perm)]
+    shape = tuple(x.shape[p] for p in perm)
+    return SparseCooTensor(idx, x.values, shape)
+
+
+def reshape(x: "SparseCooTensor", new_shape):
+    idx = np.asarray(jax.device_get(x.indices))
+    flat = np.ravel_multi_index(idx, x.shape)
+    new_idx = np.stack(np.unravel_index(flat, tuple(new_shape)))
+    return SparseCooTensor(new_idx, x.values, tuple(new_shape))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    vals = x.values if value_dtype is None else x.values.astype(value_dtype)
+    if isinstance(x, SparseCsrTensor):
+        crows = x.crows if index_dtype is None \
+            else x.crows.astype(index_dtype)
+        cols = x.cols if index_dtype is None else x.cols.astype(index_dtype)
+        return SparseCsrTensor(crows, cols, vals, x.shape)
+    idx = x.indices if index_dtype is None else x.indices.astype(index_dtype)
+    return SparseCooTensor(idx, vals, x.shape)
+
+
+# -- unary (value-wise; sparsity-preserving functions only) ------------------
+
+def _unary(fn):
+    def op(x, *args):
+        return x.with_values(fn(x.values, *args))
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)  # noqa: A001 (reference name)
+pow = _unary(jnp.power)  # noqa: A001
+neg = _unary(jnp.negative)
+expm1 = _unary(jnp.expm1)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+
+
+# -- binary ------------------------------------------------------------------
+
 def add(a, b):
-    return sparse_coo_tensor(
+    """COO + COO: concatenate and coalesce (union of patterns)."""
+    merged = SparseCooTensor(
         jnp.concatenate([a.indices, b.indices], axis=1),
         jnp.concatenate([a.values, b.values]), a.shape)
+    return coalesce(merged)
+
+
+def subtract(a, b):
+    return add(a, b.with_values(-b.values))
+
+
+def _aligned(a, b):
+    """Coalesce both onto the union pattern → aligned value vectors."""
+    ca = coalesce(a)
+    cb = coalesce(b)
+    ia = np.asarray(jax.device_get(ca.indices))
+    ib = np.asarray(jax.device_get(cb.indices))
+    fa = np.ravel_multi_index(ia, a.shape)
+    fb = np.ravel_multi_index(ib, b.shape)
+    uniq = np.union1d(fa, fb)
+    pos_a = np.searchsorted(uniq, fa)
+    pos_b = np.searchsorted(uniq, fb)
+    va = jnp.zeros((len(uniq),) + ca.values.shape[1:], ca.values.dtype
+                   ).at[jnp.asarray(pos_a)].set(ca.values)
+    vb = jnp.zeros((len(uniq),) + cb.values.shape[1:], cb.values.dtype
+                   ).at[jnp.asarray(pos_b)].set(cb.values)
+    idx = np.stack(np.unravel_index(uniq, a.shape))
+    return idx, va, vb
+
+
+def multiply(a, b):
+    idx, va, vb = _aligned(a, b)
+    return SparseCooTensor(idx, va * vb, a.shape)
+
+
+def divide(a, b):
+    idx, va, vb = _aligned(a, b)
+    return SparseCooTensor(idx, va / vb, a.shape)
 
 
 def matmul(a, b):
     """SpMM as gather + segment-sum (dense-friendly on TPU)."""
+    if isinstance(a, SparseCsrTensor):
+        a = a.to_coo()
     b = jnp.asarray(b)
     rows, cols = a.indices[0], a.indices[1]
     contrib = a.values[:, None] * b[cols]
     return jax.ops.segment_sum(contrib, rows, num_segments=a.shape[0])
 
 
-def masked_matmul(x, y, mask: "SparseCooTensor"):
-    """Compute (x@y) only at mask positions."""
+def mv(a, x):
+    """Sparse matrix × dense vector (≙ sparse.mv)."""
+    if isinstance(a, SparseCsrTensor):
+        a = a.to_coo()
+    x = jnp.asarray(x)
+    rows, cols = a.indices[0], a.indices[1]
+    return jax.ops.segment_sum(a.values * x[cols], rows,
+                               num_segments=a.shape[0])
+
+
+def masked_matmul(x, y, mask):
+    """Compute (x@y) only at mask positions (≙ sparse.masked_matmul —
+    the SDDMM kernel)."""
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_coo()
+        rows, cols = coo.indices[0], coo.indices[1]
+        vals = jnp.sum(jnp.asarray(x)[rows] * jnp.asarray(y).T[cols],
+                       axis=-1)
+        return mask.with_values(vals)
     rows, cols = mask.indices[0], mask.indices[1]
     vals = jnp.sum(jnp.asarray(x)[rows] * jnp.asarray(y).T[cols], axis=-1)
     return SparseCooTensor(mask.indices, vals,
                            (x.shape[0], jnp.asarray(y).shape[1]))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    """beta*input + alpha*(x@y) with sparse x (≙ sparse.addmm)."""
+    return beta * jnp.asarray(to_dense(input)) + alpha * matmul(x, y)
+
+
+from paddle_tpu.sparse import nn  # noqa: E402  (public submodule)

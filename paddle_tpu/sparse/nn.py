@@ -1,0 +1,328 @@
+"""sparse.nn (ref: python/paddle/sparse/nn/__init__.py — ReLU/ReLU6/
+LeakyReLU/Softmax/BatchNorm/SyncBatchNorm/Conv3D/SubmConv3D/MaxPool3D;
+functional conv.py, transformer.py attention; CUDA kernels
+paddle/phi/kernels/sparse/gpu/conv_kernel.cu + the host rulebook in
+cpu/conv_kernel.cc).
+
+TPU-native design: the rulebook (which active input site contributes to
+which output site under each kernel offset) is built host-side with numpy
+— exactly where the reference builds it — and the value math is all
+gather → matmul → scatter-add on device, shapes static per rulebook, so
+the MXU sees one (nnz_o, Cin)×(Cin, Cout) matmul per kernel offset.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.module import Module, Parameter
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv3D", "SubmConv3D", "MaxPool3D",
+           "functional"]
+
+
+def _coo(x):
+    from paddle_tpu import sparse as S
+    return x.to_coo() if isinstance(x, S.SparseCsrTensor) else x
+
+
+# ---------------------------------------------------------------------------
+# functional
+# ---------------------------------------------------------------------------
+
+class functional:
+    """sparse.nn.functional (≙ reference module of the same path)."""
+
+    @staticmethod
+    def relu(x):
+        return x.with_values(jnp.maximum(x.values, 0.0))
+
+    @staticmethod
+    def relu6(x):
+        return x.with_values(jnp.clip(x.values, 0.0, 6.0))
+
+    @staticmethod
+    def leaky_relu(x, negative_slope=0.01):
+        v = x.values
+        return x.with_values(jnp.where(v >= 0, v, negative_slope * v))
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        """Row-wise softmax over the stored pattern (≙ sparse softmax
+        kernel: softmax across the nnz of each row, zeros stay zero)."""
+        from paddle_tpu import sparse as S
+        coo = _coo(x)
+        rows = coo.indices[-2]
+        n_rows = coo.shape[-2]
+        v = coo.values.astype(jnp.float32)
+        row_max = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        e = jnp.exp(v - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        out = (e / denom[rows]).astype(x.values.dtype)
+        if isinstance(x, S.SparseCsrTensor):
+            return x.with_values(out)
+        return coo.with_values(out)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None):
+        """Sparse-pattern attention (≙ sparse.nn.functional.attention →
+        fused_attention_kernel.cu): scores only at mask nnz (SDDMM),
+        sparse row softmax, then SpMM. q/k/v: (B, H, S, D); sparse_mask:
+        a 2-D (S, S) COO/CSR pattern shared across batch×heads."""
+        coo = _coo(sparse_mask)
+        rows, cols = coo.indices[0], coo.indices[1]
+        q = jnp.asarray(query)
+        k = jnp.asarray(key)
+        v = jnp.asarray(value)
+        d = q.shape[-1]
+        s = q.shape[-2]
+        # (B, H, nnz): score at each stored (row, col)
+        scores = jnp.einsum("bhnd,bhnd->bhn", q[..., rows, :],
+                            k[..., cols, :]) / jnp.sqrt(float(d))
+        seg_max = jax.vmap(jax.vmap(
+            lambda sc: jax.ops.segment_max(sc, rows, num_segments=s)))(
+            scores)
+        e = jnp.exp(scores - seg_max[..., rows])
+        denom = jax.vmap(jax.vmap(
+            lambda ee: jax.ops.segment_sum(ee, rows, num_segments=s)))(e)
+        probs = e / denom[..., rows]
+        # out[r] = Σ_{c in row r} p(r,c) · v[c]
+        return jax.vmap(jax.vmap(
+            lambda p, vv: jax.ops.segment_sum(p[:, None] * vv[cols],
+                                              rows, num_segments=s)))(
+            probs, v)
+
+    # -- 3-D sparse convolution --------------------------------------------
+
+    @staticmethod
+    def _rulebook(idx_np, spatial, ksize, stride, padding, subm):
+        """Host-side rulebook (≙ cpu/conv_kernel.cc ProductRuleBook).
+        idx_np: (4, nnz) rows (batch, z, y, x). Returns
+        (out_indices (4, n_out), [(offset_id, in_idx, out_idx), ...],
+        out_spatial)."""
+        kz, ky, kx = ksize
+        sz, sy, sx = stride
+        pz, py, px = padding
+        coords = idx_np.T  # (nnz, 4)
+        if subm:
+            out_spatial = spatial
+            site = {tuple(c): i for i, c in enumerate(coords)}
+            out_idx_map = site
+            out_coords = coords
+        else:
+            out_spatial = tuple(
+                (spatial[i] + 2 * (pz, py, px)[i] - ksize[i])
+                // (sz, sy, sx)[i] + 1 for i in range(3))
+            out_site = {}
+            out_list = []
+            for c in coords:
+                b, z, y, x = c
+                for oz in range(kz):
+                    for oy in range(ky):
+                        for ox in range(kx):
+                            zz, rz = divmod(z + pz - oz, sz)
+                            yy, ry = divmod(y + py - oy, sy)
+                            xx, rx = divmod(x + px - ox, sx)
+                            if rz or ry or rx:
+                                continue
+                            if not (0 <= zz < out_spatial[0]
+                                    and 0 <= yy < out_spatial[1]
+                                    and 0 <= xx < out_spatial[2]):
+                                continue
+                            t = (b, zz, yy, xx)
+                            if t not in out_site:
+                                out_site[t] = len(out_list)
+                                out_list.append(t)
+            out_idx_map = out_site
+            out_coords = np.asarray(out_list, np.int64).reshape(-1, 4)
+        rules = []
+        for oz in range(kz):
+            for oy in range(ky):
+                for ox in range(kx):
+                    oid = (oz * ky + oy) * kx + ox
+                    ins, outs = [], []
+                    for i, c in enumerate(coords):
+                        b, z, y, x = c
+                        zz, rz = divmod(z + pz - oz, sz)
+                        yy, ry = divmod(y + py - oy, sy)
+                        xx, rx = divmod(x + px - ox, sx)
+                        if rz or ry or rx:
+                            continue
+                        t = (b, zz, yy, xx)
+                        j = out_idx_map.get(t)
+                        if j is not None:
+                            ins.append(i)
+                            outs.append(j)
+                    if ins:
+                        rules.append((oid, np.asarray(ins),
+                                      np.asarray(outs)))
+        return out_coords.T, rules, out_spatial
+
+    @staticmethod
+    def _conv3d(x, weight, bias, stride, padding, subm):
+        from paddle_tpu import sparse as S
+        stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        padding = (padding,) * 3 if isinstance(padding, int) \
+            else tuple(padding)
+        w = jnp.asarray(weight)  # (kz, ky, kx, Cin, Cout)
+        kz, ky, kx, cin, cout = w.shape
+        wf = w.reshape(kz * ky * kx, cin, cout)
+        idx_np = np.asarray(jax.device_get(x.indices))
+        spatial = x.shape[1:4]
+        out_idx, rules, out_spatial = functional._rulebook(
+            idx_np, spatial, (kz, ky, kx), stride, padding, subm)
+        n_out = out_idx.shape[1]
+        out_vals = jnp.zeros((n_out, cout), x.values.dtype)
+        for oid, ins, outs in rules:
+            contrib = x.values[jnp.asarray(ins)] @ wf[oid]
+            out_vals = out_vals.at[jnp.asarray(outs)].add(contrib)
+        if bias is not None:
+            out_vals = out_vals + jnp.asarray(bias)
+        # hybrid COO: sparse dims in .shape, channel is the values' dense
+        # trailing dim (≙ SparseCooTensor dense_dim=1)
+        return S.SparseCooTensor(out_idx, out_vals,
+                                 (x.shape[0],) + out_spatial)
+
+    @staticmethod
+    def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+               groups=1, data_format="NDHWC"):
+        return functional._conv3d(x, weight, bias, stride, padding,
+                                  subm=False)
+
+    @staticmethod
+    def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                    groups=1, data_format="NDHWC"):
+        """Submanifold conv: output sites == input sites (stride must be
+        1) — the sparsity never dilates (≙ subm_conv3d)."""
+        return functional._conv3d(x, weight, bias, (1, 1, 1),
+                                  padding, subm=True)
+
+    @staticmethod
+    def max_pool3d(x, kernel_size, stride=None, padding=0,
+                   data_format="NDHWC"):
+        from paddle_tpu import sparse as S
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        stride = stride if stride is not None else k
+        stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        padding = (padding,) * 3 if isinstance(padding, int) \
+            else tuple(padding)
+        idx_np = np.asarray(jax.device_get(x.indices))
+        out_idx, rules, out_spatial = functional._rulebook(
+            idx_np, x.shape[1:4], k, stride, padding, subm=False)
+        n_out = out_idx.shape[1]
+        c = x.values.shape[-1]
+        out_vals = jnp.full((n_out, c), -jnp.inf, x.values.dtype)
+        for _, ins, outs in rules:
+            out_vals = out_vals.at[jnp.asarray(outs)].max(
+                x.values[jnp.asarray(ins)])
+        return S.SparseCooTensor(out_idx, out_vals,
+                                 (x.shape[0],) + out_spatial)
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+class ReLU(Module):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Module):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Module):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Module):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self.axis)
+
+
+class BatchNorm(Module):
+    """BatchNorm over active sites (≙ sparse BatchNorm: the values
+    (nnz, C) are normalized per channel; zeros don't participate)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC"):
+        super().__init__()
+        from paddle_tpu.nn.module import Buffer
+        self.epsilon = epsilon
+        self.momentum = momentum
+        self.weight = Parameter(jnp.ones((num_features,)))
+        self.bias = Parameter(jnp.zeros((num_features,)))
+        self.running_mean = Buffer(jnp.zeros((num_features,)))
+        self.running_var = Buffer(jnp.ones((num_features,)))
+
+    def forward(self, x):
+        v = x.values.astype(jnp.float32)
+        if self.training:
+            mean = jnp.mean(v, axis=0)
+            var = jnp.var(v, axis=0)
+        else:
+            mean = jnp.asarray(self.running_mean)
+            var = jnp.asarray(self.running_var)
+        out = (v - mean) * jax.lax.rsqrt(var + self.epsilon)
+        out = out * jnp.asarray(self.weight) + jnp.asarray(self.bias)
+        return x.with_values(out.astype(x.values.dtype))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica stats ride the mesh collectives under pjit (GSPMD
+    inserts the psum) — same class body, parity name (≙ sparse
+    SyncBatchNorm)."""
+
+
+class _ConvBase(Module):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None, seed=0):
+        super().__init__()
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        rs = np.random.RandomState(seed)
+        fan_in = in_channels * k[0] * k[1] * k[2]
+        bound = float(np.sqrt(1.0 / fan_in))
+        self.weight = Parameter(jnp.asarray(
+            rs.uniform(-bound, bound, k + (in_channels, out_channels)),
+            jnp.float32))
+        self.bias = (None if bias_attr is False else Parameter(
+            jnp.zeros((out_channels,), jnp.float32)))
+        self.stride = stride
+        self.padding = padding
+
+
+class Conv3D(_ConvBase):
+    def forward(self, x):
+        return functional.conv3d(x, self.weight, self.bias, self.stride,
+                                 self.padding)
+
+
+class SubmConv3D(_ConvBase):
+    def forward(self, x):
+        return functional.subm_conv3d(x, self.weight, self.bias, 1,
+                                      self.padding)
+
+
+class MaxPool3D(Module):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        self.args = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        return functional.max_pool3d(x, *self.args)

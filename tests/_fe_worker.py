@@ -1,0 +1,95 @@
+"""Spawned-worker module for test_fleet_executor: one pipeline stage per
+OS process over the native P2P transport. CPU platform pinned at module
+level (spawn start-method imports this before jax can initialize)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+D, H, K = 8, 16, 4
+N_MICRO, B = 4, 2
+
+
+def make_data():
+    rs = np.random.RandomState(99)
+    x = rs.normal(size=(N_MICRO, B, D)).astype(np.float32)
+    y = rs.normal(size=(N_MICRO, B, K)).astype(np.float32)
+    return x, y
+
+
+def make_params(stage):
+    rs = np.random.RandomState(stage)
+    if stage == 0:
+        return {"w": rs.normal(size=(D, H)).astype(np.float32) * 0.3,
+                "b": np.zeros((H,), np.float32)}
+    if stage == 1:
+        return {"w": rs.normal(size=(H, H)).astype(np.float32) * 0.3,
+                "b": np.zeros((H,), np.float32)}
+    return {"w": rs.normal(size=(H, K)).astype(np.float32) * 0.3,
+            "b": np.zeros((K,), np.float32)}
+
+
+def stage_fn(stage):
+    import jax.numpy as jnp
+
+    if stage == 2:
+        def last(params, x, label):
+            pred = x @ params["w"] + params["b"]
+            return jnp.mean(jnp.square(pred - label))
+        return last
+
+    def mid(params, x):
+        return jnp.maximum(x @ params["w"] + params["b"], 0.0)
+    return mid
+
+
+def reference_grads():
+    """Single-process full-model autodiff oracle."""
+    import jax
+    import jax.numpy as jnp
+    x, y = make_data()
+    ps = [make_params(s) for s in range(3)]
+
+    def loss_fn(ps):
+        total = 0.0
+        for mb in range(N_MICRO):
+            h = jnp.maximum(x[mb] @ ps[0]["w"] + ps[0]["b"], 0.0)
+            h = jnp.maximum(h @ ps[1]["w"] + ps[1]["b"], 0.0)
+            pred = h @ ps[2]["w"] + ps[2]["b"]
+            total = total + jnp.mean(jnp.square(pred - y[mb]))
+        return total / N_MICRO
+
+    loss = loss_fn(ps)
+    grads = jax.grad(loss_fn)(ps)
+    return float(loss), grads
+
+
+def worker(stage, store_port, schedule, tmpdir):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import native
+    from paddle_tpu.distributed.fleet_executor import (FleetExecutor,
+                                                       rendezvous_endpoints)
+
+    store = native.TCPStore("127.0.0.1", store_port,
+                            is_master=(stage == 0), timeout=60.0)
+    ep, peers = rendezvous_endpoints(store, stage, 3)
+    fe = FleetExecutor(stage_fn(stage), stage, 3, ep, peers,
+                       schedule=schedule)
+    x, y = make_data()
+
+    for step in range(2):  # two steps: step-tag separation must hold
+        grads, loss = fe.run(
+            make_params(stage),
+            microbatches=list(x) if stage == 0 else None,
+            labels=list(y) if stage == 2 else None,
+            n_micro=N_MICRO)
+        out = {f"g_{k}": np.asarray(v) for k, v in grads.items()}
+        if loss is not None:
+            out["loss"] = np.float32(loss)
+        np.savez(os.path.join(tmpdir, f"stage{stage}_step{step}.npz"),
+                 **out)
+    ep.close()
+    store.close()

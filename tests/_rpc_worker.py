@@ -1,0 +1,77 @@
+"""Spawned-worker module for test_rpc_ps. CPU platform pinned at module
+level (spawn start-method imports this before jax can initialize)."""
+
+import os
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+
+def _square(x):
+    return x * x
+
+
+def _matsum(a, b):
+    return np.asarray(a) + np.asarray(b)
+
+
+def _boom():
+    raise ValueError("intentional remote failure")
+
+
+def worker(rank, world, port, tmpdir):
+    from paddle_tpu.distributed import rpc, ps
+
+    names = ["server0", "server1", "trainer0"]
+    st = rpc.init_rpc(names[rank], rank=rank, world_size=world,
+                      master_endpoint=f"127.0.0.1:{port}")
+
+    if rank == 2:  # the single trainer drives; servers just serve
+        # --- plain rpc ---
+        assert rpc.rpc_sync("server0", _square, args=(7,)) == 49
+        got = rpc.rpc_sync("server1", _matsum,
+                           args=(np.ones((2, 3)), np.full((2, 3), 2.0)))
+        np.testing.assert_allclose(got, np.full((2, 3), 3.0))
+        fa = rpc.rpc_async("server0", _square, args=(3,))
+        fb = rpc.rpc_async("server1", _square, args=(4,))
+        assert fa.wait(30) + fb.wait(30) == 25
+        try:
+            rpc.rpc_sync("server0", _boom)
+            raise AssertionError("remote exception did not propagate")
+        except ValueError as e:
+            assert "intentional remote failure" in str(e)
+        info = rpc.get_worker_info("server1")
+        assert info.rank == 1
+
+        # --- parameter server over rpc ---
+        client = ps.PSClient(["server0", "server1"])
+        client.create_tables({
+            "dense_w": ("dense", (4, 3), {"lr": 0.5, "optimizer": "sgd",
+                                          "seed": 1}),
+            "emb": ("sparse", 8, {"lr": 0.1, "optimizer": "adagrad",
+                                  "seed": 2}),
+        })
+        w0 = client.pull_dense("dense_w")
+        g = np.ones((4, 3), np.float32)
+        client.push_dense("dense_w", g)
+        client.push_dense("dense_w", g)
+        w1 = client.pull_dense("dense_w")
+        np.testing.assert_allclose(w1, w0 - 0.5 * 2.0, atol=1e-6)
+
+        ids = np.array([0, 1, 5, 9, 12], np.int64)
+        rows0 = client.pull_sparse("emb", ids)
+        assert rows0.shape == (5, 8)
+        # deterministic lazy init: same id pulls the same row
+        np.testing.assert_allclose(client.pull_sparse("emb", ids), rows0)
+        client.push_sparse("emb", ids, np.ones((5, 8), np.float32))
+        rows1 = client.pull_sparse("emb", ids)
+        # adagrad first step: -lr * g / (|g| + eps) ≈ -lr
+        np.testing.assert_allclose(rows1, rows0 - 0.1, atol=1e-5)
+        assert client.sparse_size("emb") == 5
+
+        with open(os.path.join(tmpdir, "ok_trainer"), "w") as f:
+            f.write("1")
+
+    rpc.shutdown()

@@ -107,3 +107,52 @@ def test_dynamic_batcher_queue_bound_and_close():
         b.submit(np.zeros((1,), np.float32))
     with _pytest.raises(ValueError, match="batch_size"):
         DynamicBatcher(Predictor(lambda x: x))
+
+
+class TestFusedBiasDropoutResidualLayerNorm:
+    def test_eval_matches_plain_ln(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu import incubate
+        from paddle_tpu.nn.functional import layer_norm
+        layer = incubate.nn.FusedBiasDropoutResidualLayerNorm(
+            128, dropout_rate=0.3)
+        layer.eval()
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.normal(size=(2, 4, 128)), jnp.float32)
+        res = jnp.asarray(rs.normal(size=(2, 4, 128)), jnp.float32)
+        out = layer(x, res)
+        ref = layer_norm(x + res, (128,))
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_train_drops(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu import incubate
+        layer = incubate.nn.FusedBiasDropoutResidualLayerNorm(
+            128, dropout_rate=0.5)
+        layer.train()
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.normal(size=(4, 128)), jnp.float32)
+        res = jnp.zeros((4, 128), jnp.float32)
+        a = layer(x, res, dropout_seed=3)
+        b = layer(x, res, dropout_seed=3)
+        np.testing.assert_array_equal(a, b)  # deterministic replay
+        c = layer(x, res, dropout_seed=4)
+        assert not np.allclose(a, c)
+
+    def test_functional_form(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.incubate.nn import functional as IF
+        from paddle_tpu.nn.functional import layer_norm
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.normal(size=(3, 128)), jnp.float32)
+        res = jnp.asarray(rs.normal(size=(3, 128)), jnp.float32)
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            x, res, dropout_rate=0.0)
+        ref = layer_norm(x + res, (128,))
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+        y = IF.fused_matmul_bias(x, jnp.ones((128, 16)),
+                                 jnp.zeros((16,)))
+        np.testing.assert_allclose(y, x @ jnp.ones((128, 16)), atol=1e-5)

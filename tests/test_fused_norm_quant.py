@@ -1,0 +1,155 @@
+"""Fused LayerNorm + int8 matmul Pallas kernels vs XLA oracles.
+
+Mirrors the reference's fused-op tests
+(test_fused_bias_dropout_residual_layer_norm_op.py pattern: oracle
+composition checked against the fused kernel for output AND grads).
+Runs in Pallas interpret mode on the CPU test platform.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.layer_norm import (fused_layer_norm,
+                                              dropout_keep_mask)
+from paddle_tpu.ops.pallas.quant_matmul import int8_matmul
+from paddle_tpu import quantization as quant
+
+
+def _ln_oracle(x, gamma, beta, residual=None, bias=None, dropout_p=0.0,
+               seed=0, eps=1e-5):
+    pre = jnp.asarray(x, jnp.float32)
+    if bias is not None:
+        pre = pre + jnp.asarray(bias, jnp.float32)
+    if dropout_p > 0.0:
+        x2 = pre.reshape(-1, pre.shape[-1])
+        keep = dropout_keep_mask(seed, 0, x2.shape[1], x2.shape, dropout_p)
+        pre = jnp.where(keep.reshape(pre.shape),
+                        pre / (1.0 - dropout_p), 0.0)
+    if residual is not None:
+        pre = pre + jnp.asarray(residual, jnp.float32)
+    mean = jnp.mean(pre, axis=-1, keepdims=True)
+    var = jnp.var(pre, axis=-1, keepdims=True)
+    y = (pre - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    return y, pre
+
+
+def _rand(shape, seed=0, dtype=jnp.float32):
+    return jnp.asarray(np.random.RandomState(seed).normal(size=shape), dtype)
+
+
+@pytest.mark.parametrize("shape", [(4, 128), (2, 16, 256), (3, 384)])
+def test_fused_ln_forward(shape):
+    x = _rand(shape, 0)
+    gamma = _rand(shape[-1:], 1) + 1.0
+    beta = _rand(shape[-1:], 2)
+    y, pre = fused_layer_norm(x, gamma, beta, interpret=True)
+    ref_y, ref_pre = _ln_oracle(x, gamma, beta)
+    np.testing.assert_allclose(y, ref_y, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(pre, ref_pre, atol=1e-6, rtol=1e-6)
+
+
+def test_fused_ln_residual_bias():
+    x = _rand((6, 256), 0)
+    res = _rand((6, 256), 3)
+    bias = _rand((256,), 4)
+    gamma = _rand((256,), 1) + 1.0
+    beta = _rand((256,), 2)
+    y, pre = fused_layer_norm(x, gamma, beta, residual=res, bias=bias,
+                              interpret=True)
+    ref_y, ref_pre = _ln_oracle(x, gamma, beta, residual=res, bias=bias)
+    np.testing.assert_allclose(y, ref_y, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(pre, ref_pre, atol=1e-6, rtol=1e-6)
+
+
+def test_fused_ln_dropout_deterministic():
+    x = _rand((16, 128), 0)
+    gamma = jnp.ones((128,))
+    beta = jnp.zeros((128,))
+    res = _rand((16, 128), 5)
+    y1, pre1 = fused_layer_norm(x, gamma, beta, residual=res, dropout_p=0.3,
+                                dropout_seed=11, interpret=True)
+    y2, pre2 = fused_layer_norm(x, gamma, beta, residual=res, dropout_p=0.3,
+                                dropout_seed=11, interpret=True)
+    np.testing.assert_array_equal(y1, y2)
+    ref_y, ref_pre = _ln_oracle(x, gamma, beta, residual=res, dropout_p=0.3,
+                                seed=11)
+    np.testing.assert_allclose(y1, ref_y, atol=1e-5, rtol=1e-5)
+    # a different seed must give a different mask
+    y3, _ = fused_layer_norm(x, gamma, beta, residual=res, dropout_p=0.3,
+                             dropout_seed=12, interpret=True)
+    assert not np.allclose(y1, y3)
+    # dropped fraction ≈ rate (pre minus residual is zero where dropped)
+    dropped = np.mean(np.asarray(pre1 - res) == 0.0)
+    assert 0.2 < dropped < 0.4
+
+
+def test_fused_ln_grads_match_oracle():
+    x = _rand((8, 128), 0)
+    res = _rand((8, 128), 3)
+    bias = _rand((128,), 4)
+    gamma = _rand((128,), 1) + 1.0
+    beta = _rand((128,), 2)
+    cy = _rand((8, 128), 6)
+    cpre = _rand((8, 128), 7)
+
+    def loss_fused(x, gamma, beta, bias, res):
+        y, pre = fused_layer_norm(x, gamma, beta, residual=res, bias=bias,
+                                  dropout_p=0.25, dropout_seed=9,
+                                  interpret=True)
+        return jnp.sum(y * cy) + jnp.sum(pre * cpre)
+
+    def loss_ref(x, gamma, beta, bias, res):
+        y, pre = _ln_oracle(x, gamma, beta, residual=res, bias=bias,
+                            dropout_p=0.25, seed=9)
+        return jnp.sum(y * cy) + jnp.sum(pre * cpre)
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(
+        x, gamma, beta, bias, res)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(
+        x, gamma, beta, bias, res)
+    for gf, gr in zip(g_fused, g_ref):
+        np.testing.assert_allclose(gf, gr, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_ln_jit_traced_seed():
+    # per-step seeds must not retrace: seed is an operand, not a constant
+    x = _rand((4, 128), 0)
+    gamma = jnp.ones((128,))
+    beta = jnp.zeros((128,))
+
+    @jax.jit
+    def f(x, seed):
+        y, _ = fused_layer_norm(x, gamma, beta, dropout_p=0.5,
+                                dropout_seed=seed, interpret=True)
+        return y
+
+    a = f(x, jnp.int32(1))
+    b = f(x, jnp.int32(2))
+    assert not np.allclose(a, b)
+
+
+@pytest.mark.parametrize("shape", [((4, 256), (256, 384)),
+                                   ((2, 7, 128), (128, 256)),
+                                   ((5, 100), (100, 130))])
+def test_int8_matmul_matches_dequant(shape):
+    xs, ws = shape
+    x = _rand(xs, 0)
+    w = _rand(ws, 1)
+    qt = quant.quantize_tensor(w, axis=-1)
+    out = int8_matmul(x, qt.q, qt.scale.reshape(1, -1), interpret=True)
+    ref = x @ qt.dequantize()
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_int8_matmul_bf16_activation():
+    x = _rand((8, 256), 0, jnp.bfloat16)
+    w = _rand((256, 128), 1)
+    qt = quant.quantize_tensor(w, axis=-1, )
+    out = int8_matmul(x, qt.q, qt.scale.reshape(1, -1), interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = (x.astype(jnp.float32) @ qt.dequantize().astype(jnp.float32))
+    np.testing.assert_allclose(out.astype(jnp.float32), ref, atol=0.15,
+                               rtol=0.1)

@@ -161,3 +161,126 @@ def test_static_hash_stable_for_unhashable_attrs():
     assert c == d and hash(c) == hash(d)
     e = _Static((("arr", np.arange(4)),))
     assert c != e
+
+
+class TestRound3Layers:
+    """The seven classes closing the nn inventory gap (VERDICT r2 §2.3
+    'nn 96 vs ~131')."""
+
+    def test_softmax2d(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.nn as nn
+        x = jnp.asarray(np.random.RandomState(0).normal(size=(2, 3, 4, 4)),
+                        jnp.float32)
+        out = nn.Softmax2D()(x)
+        np.testing.assert_allclose(np.sum(np.asarray(out), axis=1),
+                                   np.ones((2, 4, 4)), atol=1e-5)
+
+    def test_pairwise_distance(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.nn as nn
+        rs = np.random.RandomState(1)
+        a = jnp.asarray(rs.normal(size=(5, 8)), jnp.float32)
+        b = jnp.asarray(rs.normal(size=(5, 8)), jnp.float32)
+        out = nn.PairwiseDistance(p=2.0)(a, b)
+        ref = np.linalg.norm(np.asarray(a) - np.asarray(b) + 1e-6, axis=-1)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_max_unpool_1d_3d_roundtrip(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        x = jnp.asarray(np.random.RandomState(2).normal(size=(1, 2, 8)),
+                        jnp.float32)
+        pooled, idx = F.max_pool1d(x, 2, stride=2, return_mask=True)
+        up = nn.MaxUnPool1D(2, stride=2)(pooled, idx)
+        assert up.shape == x.shape
+        # every pooled max lands back somewhere; scattered values == pooled
+        nz = np.asarray(up).ravel()
+        nz = nz[nz != 0.0]
+        np.testing.assert_allclose(np.sort(nz),
+                                   np.sort(np.asarray(pooled).ravel()))
+        x3 = jnp.asarray(np.random.RandomState(3).normal(size=(1, 1, 4, 4, 4)),
+                         jnp.float32)
+        pooled3, idx3 = F.max_pool3d(x3, 2, stride=2, return_mask=True)
+        up3 = nn.MaxUnPool3D(2, stride=2)(pooled3, idx3)
+        assert up3.shape == x3.shape
+        nz3 = np.asarray(up3).ravel()
+        nz3 = nz3[nz3 != 0.0]
+        np.testing.assert_allclose(np.sort(nz3),
+                                   np.sort(np.asarray(pooled3).ravel()))
+
+    def test_multi_margin_loss(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.nn as nn
+        rs = np.random.RandomState(4)
+        x = jnp.asarray(rs.normal(size=(4, 5)), jnp.float32)
+        y = jnp.asarray([0, 2, 4, 1])
+        out = nn.MultiMarginLoss()(x, y)
+        xn = np.asarray(x)
+        ref = 0.0
+        for i, t in enumerate([0, 2, 4, 1]):
+            ref += np.mean([max(0.0, 1.0 - xn[i, t] + xn[i, j]) if j != t
+                            else 0.0 for j in range(5)])
+        np.testing.assert_allclose(out, ref / 4, atol=1e-5, rtol=1e-5)
+
+    def test_triplet_margin_with_distance(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.nn as nn
+        rs = np.random.RandomState(5)
+        a = jnp.asarray(rs.normal(size=(6, 8)), jnp.float32)
+        p = jnp.asarray(rs.normal(size=(6, 8)), jnp.float32)
+        n = jnp.asarray(rs.normal(size=(6, 8)), jnp.float32)
+        l1 = nn.TripletMarginWithDistanceLoss()(a, p, n)
+        # custom distance callable is honored
+        l2 = nn.TripletMarginWithDistanceLoss(
+            distance_function=lambda u, v:
+                __import__("jax.numpy", fromlist=["sum"]).sum(
+                    abs(u - v), axis=-1))(a, p, n)
+        assert float(l1) >= 0 and float(l2) >= 0 and float(l1) != float(l2)
+
+    def test_hsigmoid_probabilities_normalize(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(6)
+        x = jnp.asarray(rs.normal(size=(3, 6)), jnp.float32)
+        layer = nn.HSigmoidLoss(6, 10)
+        total = np.zeros((3,), np.float64)
+        for c in range(10):
+            loss = F.hsigmoid_loss(x, jnp.full((3,), c, jnp.int32), 10,
+                                   layer.weight.value
+                                   if hasattr(layer.weight, "value")
+                                   else layer.weight,
+                                   layer.bias if layer.bias is None
+                                   else (layer.bias.value
+                                         if hasattr(layer.bias, "value")
+                                         else layer.bias),
+                                   reduction="none")
+            total += np.exp(-np.asarray(loss, np.float64))
+        np.testing.assert_allclose(total, 1.0, atol=1e-4)
+        out = layer(x, jnp.asarray([1, 2, 3]))
+        assert np.isfinite(float(out))
+
+    def test_max_unpool_nonzero_padding(self):
+        # review r3: int padding must apply to the length dim only
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu.nn.functional as F
+        x = jnp.asarray(np.random.RandomState(7).normal(size=(1, 1, 6)),
+                        jnp.float32)
+        pooled, idx = F.max_pool1d(x, 2, stride=2, return_mask=True)
+        up = F.max_unpool1d(pooled, idx, 2, stride=2, padding=1)
+        assert up.shape == (1, 1, 4)  # (3-1)*2 + 2 - 2*1
+        x3 = jnp.asarray(
+            np.random.RandomState(8).normal(size=(1, 1, 4, 4, 4)),
+            jnp.float32)
+        p3, i3 = F.max_pool3d(x3, 2, stride=2, return_mask=True)
+        up3 = F.max_unpool3d(p3, i3, 2, stride=2, padding=(1, 1, 1))
+        assert up3.shape == (1, 1, 2, 2, 2)

@@ -96,3 +96,28 @@ def test_include_regex_and_empty_error():
     assert not isinstance(qp["blocks.item_0.wup"], quant.QuantTensor)
     with pytest.raises(ValueError, match="no weight"):
         quant.quantize_for_inference(model, include=r"nomatch_xyz")
+
+
+def test_rmatmul_dispatch_not_bypassed():
+    """review r3: without __jax_array__ jax defers, so x @ qt must reach
+    QuantTensor.__rmatmul__ (the Pallas int8 route on TPU)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu import quantization as quant
+    w = jnp.asarray(np.random.RandomState(0).normal(size=(32, 16)),
+                    jnp.float32)
+    x = jnp.asarray(np.random.RandomState(1).normal(size=(4, 32)),
+                    jnp.float32)
+    qt = quant.quantize_tensor(w)
+    called = {}
+    orig = quant.QuantTensor.__rmatmul__
+    try:
+        def spy(self, other):
+            called["hit"] = True
+            return orig(self, other)
+        quant.QuantTensor.__rmatmul__ = spy
+        out = x @ qt
+    finally:
+        quant.QuantTensor.__rmatmul__ = orig
+    assert called.get("hit"), "x @ QuantTensor bypassed __rmatmul__"
+    np.testing.assert_allclose(out, x @ qt.dequantize(), atol=1e-5)

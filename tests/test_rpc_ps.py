@@ -1,0 +1,30 @@
+"""RPC + parameter-server end-to-end over the native P2P transport:
+three OS processes (two servers, one trainer) exercising rpc_sync/
+rpc_async/exception propagation and dense + sharded-sparse tables.
+
+Reference analog: test_rpc_base.py / the fleet PS-mode tests — with the
+id-sharded sparse pull/push checked for exact adagrad semantics."""
+
+import multiprocessing as mp
+
+import pytest
+
+from paddle_tpu import native
+
+import _rpc_worker
+
+
+@pytest.mark.skipif(not native.is_available(),
+                    reason="native toolchain unavailable")
+def test_rpc_and_parameter_server(tmp_path):
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_rpc_worker.worker,
+                         args=(r, 3, 23761, str(tmp_path)))
+             for r in range(3)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=180)
+    for r, p in enumerate(procs):
+        assert p.exitcode == 0, f"rank {r} exited {p.exitcode}"
+    assert (tmp_path / "ok_trainer").exists()

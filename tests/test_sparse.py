@@ -1,0 +1,172 @@
+"""Sparse tensor surface vs dense oracles (ref test pattern:
+test_sparse_conv_op.py, test_sparse_norm_op.py — dense-conv oracle checked
+against the sparse kernel at active sites)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import sparse as S
+
+
+def _rand_coo(shape, nnz, seed=0, channels=None):
+    rs = np.random.RandomState(seed)
+    flat = rs.choice(int(np.prod(shape)), size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(flat, shape))
+    vshape = (nnz,) if channels is None else (nnz, channels)
+    vals = rs.normal(size=vshape).astype(np.float32)
+    return S.sparse_coo_tensor(idx, vals, shape)
+
+
+def test_unary_ops_match_dense():
+    x = _rand_coo((4, 6), 8, seed=1)
+    d = np.asarray(x.to_dense())
+    for name in ["sin", "tanh", "square", "expm1", "neg", "abs"]:
+        out = getattr(S, name)(x)
+        ref = getattr(np, name if name != "neg" else "negative")(d)
+        # sparsity-preserving: f(0)=0, so dense application matches
+        np.testing.assert_allclose(out.to_dense(), ref, atol=1e-6)
+
+
+def test_coalesce_sums_duplicates():
+    idx = np.array([[0, 0, 1], [2, 2, 3]])
+    x = S.sparse_coo_tensor(idx, np.array([1.0, 2.0, 5.0], np.float32),
+                            (2, 4))
+    c = S.coalesce(x)
+    assert c.nnz() == 2
+    d = np.asarray(c.to_dense())
+    assert d[0, 2] == 3.0 and d[1, 3] == 5.0
+
+
+def test_transpose_reshape_cast():
+    x = _rand_coo((3, 5), 6, seed=2)
+    d = np.asarray(x.to_dense())
+    np.testing.assert_allclose(S.transpose(x, (1, 0)).to_dense(), d.T)
+    np.testing.assert_allclose(S.reshape(x, (5, 3)).to_dense(),
+                               d.reshape(5, 3))
+    assert S.cast(x, value_dtype=jnp.bfloat16).dtype == jnp.bfloat16
+
+
+def test_binary_ops_match_dense():
+    a = _rand_coo((4, 4), 5, seed=3)
+    b = _rand_coo((4, 4), 5, seed=4)
+    da, db = np.asarray(a.to_dense()), np.asarray(b.to_dense())
+    np.testing.assert_allclose(S.add(a, b).to_dense(), da + db, atol=1e-6)
+    np.testing.assert_allclose(S.subtract(a, b).to_dense(), da - db,
+                               atol=1e-6)
+    np.testing.assert_allclose(S.multiply(a, b).to_dense(), da * db,
+                               atol=1e-6)
+    assert S.is_same_shape(a, b)
+
+
+def test_matmul_mv_addmm():
+    a = _rand_coo((4, 6), 7, seed=5)
+    da = np.asarray(a.to_dense())
+    y = np.random.RandomState(6).normal(size=(6, 3)).astype(np.float32)
+    np.testing.assert_allclose(S.matmul(a, y), da @ y, atol=1e-5)
+    v = y[:, 0]
+    np.testing.assert_allclose(S.mv(a, v), da @ v, atol=1e-5)
+    base = np.random.RandomState(7).normal(size=(4, 3)).astype(np.float32)
+    np.testing.assert_allclose(S.addmm(base, a, y, beta=0.5, alpha=2.0),
+                               0.5 * base + 2.0 * (da @ y), atol=1e-5)
+
+
+def test_csr_roundtrip_and_masked_matmul():
+    csr = S.sparse_csr_tensor([0, 2, 3], [0, 2, 1],
+                              np.array([1.0, 2.0, 3.0], np.float32), (2, 3))
+    d = np.zeros((2, 3), np.float32)
+    d[0, 0], d[0, 2], d[1, 1] = 1, 2, 3
+    np.testing.assert_allclose(csr.to_dense(), d)
+    rs = np.random.RandomState(8)
+    x = rs.normal(size=(2, 5)).astype(np.float32)
+    y = rs.normal(size=(5, 3)).astype(np.float32)
+    out = S.masked_matmul(x, y, csr)
+    full = x @ y
+    np.testing.assert_allclose(np.asarray(out.to_dense())[d != 0],
+                               full[d != 0], atol=1e-5)
+
+
+def test_sparse_softmax_rows_normalize():
+    x = _rand_coo((5, 8), 12, seed=9)
+    out = S.nn.functional.softmax(x)
+    d = np.asarray(out.to_dense())
+    rows_with = np.unique(np.asarray(jax.device_get(x.indices))[0])
+    np.testing.assert_allclose(d.sum(axis=1)[rows_with], 1.0, atol=1e-5)
+
+
+def test_sparse_attention_matches_masked_dense():
+    rs = np.random.RandomState(10)
+    b, h, s, dd = 2, 2, 8, 4
+    q = jnp.asarray(rs.normal(size=(b, h, s, dd)), jnp.float32)
+    k = jnp.asarray(rs.normal(size=(b, h, s, dd)), jnp.float32)
+    v = jnp.asarray(rs.normal(size=(b, h, s, dd)), jnp.float32)
+    # causal pattern as COO
+    rows, cols = np.tril_indices(s)
+    mask = S.sparse_coo_tensor(np.stack([rows, cols]),
+                               np.ones(len(rows), np.float32), (s, s))
+    out = S.nn.functional.attention(q, k, v, mask)
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(dd)
+    dmask = np.asarray(mask.to_dense()) != 0
+    logits = np.where(dmask, logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("subm", [True, False])
+def test_sparse_conv3d_matches_dense(subm):
+    rs = np.random.RandomState(11)
+    shape = (1, 5, 5, 5)  # (N, D, H, W), 4 channels
+    x = _rand_coo(shape, 10, seed=11, channels=4)
+    w = jnp.asarray(rs.normal(size=(3, 3, 3, 4, 2)), jnp.float32)
+    if subm:
+        out = S.nn.functional.subm_conv3d(x, w, padding=1)
+    else:
+        out = S.nn.functional.conv3d(x, w, stride=1, padding=1)
+    dense_in = jnp.asarray(x.to_dense())  # (N, D, H, W, C)
+    ref = jax.lax.conv_general_dilated(
+        dense_in, w, window_strides=(1, 1, 1), padding=[(1, 1)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    got = np.asarray(out.to_dense())
+    if subm:
+        # submanifold: valid only at input active sites
+        ii = np.asarray(jax.device_get(x.indices))
+        np.testing.assert_allclose(
+            got[ii[0], ii[1], ii[2], ii[3]],
+            np.asarray(ref)[ii[0], ii[1], ii[2], ii[3]], atol=1e-4)
+    else:
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_sparse_maxpool3d_positive_values():
+    x = _rand_coo((1, 4, 4, 4), 9, seed=12, channels=3)
+    x = x.with_values(jnp.abs(x.values) + 0.1)  # positive → dense oracle ok
+    out = S.nn.functional.max_pool3d(x, 2, stride=2)
+    dense_in = np.asarray(x.to_dense())
+    ref = np.asarray(jax.lax.reduce_window(
+        jnp.asarray(dense_in), -jnp.inf, jax.lax.max,
+        (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID"))
+    got = np.asarray(out.to_dense())
+    active = got != 0
+    np.testing.assert_allclose(got[active], ref[active], atol=1e-6)
+
+
+def test_sparse_layers_and_batchnorm():
+    x = _rand_coo((1, 4, 4, 4), 8, seed=13, channels=4)
+    assert float(jnp.min(S.nn.ReLU()(x).values)) >= 0.0
+    assert float(jnp.max(S.nn.ReLU6()(x).values)) <= 6.0
+    conv = S.nn.SubmConv3D(4, 6, 3, padding=1)
+    y = conv(x)
+    assert y.values.shape == (8, 6)
+    assert y.to_dense().shape == (1, 4, 4, 4, 6)
+    bn = S.nn.BatchNorm(6)
+    bn.train()
+    z = bn(y)
+    v = np.asarray(z.values)
+    np.testing.assert_allclose(v.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(v.std(axis=0), 1.0, atol=1e-2)
+    pool = S.nn.MaxPool3D(2, stride=2)
+    p = pool(y)
+    assert p.to_dense().shape == (1, 2, 2, 2, 6)
