@@ -373,10 +373,19 @@ def bench_decode(jax, jnp, peak, smoke=False):
         qdt = time.perf_counter() - t0
         res[f"decode_{name}_int8_tokens_per_sec"] = round(b * new / qdt, 1)
         # agreement over GENERATED tokens only (the prompt is verbatim in
-        # both outputs and would floor the metric at s0/(s0+new))
+        # both outputs and would floor the metric at s0/(s0+new)). Greedy
+        # decode cascades the first flipped token, so ALSO report logit
+        # cosine — the direct quantization-fidelity number.
         res["decode_int8_token_agreement"] = round(float(
             (np.asarray(qout)[:, s0:] == np.asarray(out)[:, s0:]).mean()),
             4)
+        lg_d = jax.jit(lambda t: model(t))(tokens).astype(jnp.float32)
+        lg_q = jax.jit(lambda t: qmodel(t))(tokens).astype(jnp.float32)
+        num = jnp.sum(lg_d * lg_q, axis=-1)
+        den = (jnp.linalg.norm(lg_d, axis=-1)
+               * jnp.linalg.norm(lg_q, axis=-1) + 1e-9)
+        res["decode_int8_logit_cosine"] = round(float(jnp.mean(num / den)),
+                                                5)
     except Exception as e:
         res["decode_int8_error"] = str(e)[:120]
     return res

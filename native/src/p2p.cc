@@ -73,7 +73,7 @@ struct Endpoint {
   std::map<std::string, int> out_fds;
 };
 
-void reader_loop(Endpoint* ep, int fd) {
+void reader_loop(Endpoint* ep, int fd, size_t slot) {
   for (;;) {
     uint64_t hdr[2];  // tag, len
     if (!read_full(fd, hdr, sizeof(hdr))) break;
@@ -84,6 +84,13 @@ void reader_loop(Endpoint* ep, int fd) {
       ep->mail[hdr[0]].push_back(std::move(payload));
     }
     ep->cv.notify_all();
+  }
+  // invalidate the slot UNDER the mutex before closing: the fd number may
+  // be reused by the kernel, and ptpp_destroy must not shutdown() an
+  // unrelated live connection through a stale entry
+  {
+    std::lock_guard<std::mutex> lk(ep->fds_mu);
+    ep->reader_fds[slot] = -1;
   }
   close(fd);
 }
@@ -100,8 +107,9 @@ void accept_loop(Endpoint* ep) {
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     std::lock_guard<std::mutex> lk(ep->fds_mu);
+    size_t slot = ep->reader_fds.size();
     ep->reader_fds.push_back(fd);
-    ep->readers.emplace_back(reader_loop, ep, fd);
+    ep->readers.emplace_back(reader_loop, ep, fd, slot);
   }
 }
 
@@ -216,7 +224,8 @@ void ptpp_destroy(void* h) {
   if (ep->accept_thread.joinable()) ep->accept_thread.join();
   {
     std::lock_guard<std::mutex> lk(ep->fds_mu);
-    for (int fd : ep->reader_fds) shutdown(fd, SHUT_RDWR);
+    for (int fd : ep->reader_fds)
+      if (fd >= 0) shutdown(fd, SHUT_RDWR);
   }
   for (auto& t : ep->readers)
     if (t.joinable()) t.join();

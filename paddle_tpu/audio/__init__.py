@@ -1,93 +1,17 @@
-"""Audio domain (ref: python/paddle/audio/ — spectrograms, mel features)."""
+"""Audio domain (ref: python/paddle/audio/__init__.py — features,
+functional, datasets subpackages)."""
 
-import math
+from paddle_tpu.audio import functional
+from paddle_tpu.audio import features
+from paddle_tpu.audio.features import (Spectrogram, MelSpectrogram,
+                                       LogMelSpectrogram, MFCC)
+from paddle_tpu.audio.functional import (hz_to_mel, mel_to_hz,
+                                         mel_frequencies, fft_frequencies,
+                                         compute_fbank_matrix, power_to_db,
+                                         create_dct, get_window)
+from paddle_tpu.audio.datasets import ESC50, TESS
 
-import numpy as np
-import jax.numpy as jnp
-
-from paddle_tpu import signal as pt_signal
-
-__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
-           "mel_frequencies", "compute_fbank_matrix", "hz_to_mel",
-           "mel_to_hz", "ESC50", "TESS"]
-
-
-def hz_to_mel(freq):
-    return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
-
-
-def mel_to_hz(mel):
-    return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
-
-
-def mel_frequencies(n_mels=64, f_min=0.0, f_max=8000.0):
-    mels = np.linspace(hz_to_mel(f_min), hz_to_mel(f_max), n_mels)
-    return mel_to_hz(mels)
-
-
-def compute_fbank_matrix(sr=16000, n_fft=512, n_mels=64, f_min=0.0,
-                         f_max=None):
-    f_max = f_max or sr / 2
-    freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
-    mel_f = mel_frequencies(n_mels + 2, f_min, f_max)
-    weights = np.zeros((n_mels, len(freqs)), np.float32)
-    for i in range(n_mels):
-        lower = (freqs - mel_f[i]) / max(mel_f[i + 1] - mel_f[i], 1e-5)
-        upper = (mel_f[i + 2] - freqs) / max(mel_f[i + 2] - mel_f[i + 1],
-                                             1e-5)
-        weights[i] = np.maximum(0, np.minimum(lower, upper))
-    return jnp.asarray(weights)
-
-
-class Spectrogram:
-    def __init__(self, n_fft=512, hop_length=None, win_length=None,
-                 window="hann", power=2.0, center=True, pad_mode="reflect"):
-        self.n_fft = n_fft
-        self.hop_length = hop_length or n_fft // 4
-        self.win_length = win_length or n_fft
-        self.power = power
-        self.center = center
-        self.pad_mode = pad_mode
-        n = self.win_length
-        self.window = jnp.asarray(
-            0.5 - 0.5 * np.cos(2 * math.pi * np.arange(n) / n)
-            if window == "hann" else np.ones(n), jnp.float32)
-
-    def __call__(self, x):
-        spec = pt_signal.stft(jnp.asarray(x), self.n_fft, self.hop_length,
-                              self.win_length, self.window,
-                              center=self.center, pad_mode=self.pad_mode)
-        return jnp.abs(spec) ** self.power
-
-
-class MelSpectrogram:
-    def __init__(self, sr=16000, n_fft=512, hop_length=None, n_mels=64,
-                 f_min=0.0, f_max=None, **kwargs):
-        self.spec = Spectrogram(n_fft, hop_length, **kwargs)
-        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
-
-    def __call__(self, x):
-        s = self.spec(x)  # (..., freq, time)
-        return jnp.einsum("mf,...ft->...mt", self.fbank, s)
-
-
-class LogMelSpectrogram(MelSpectrogram):
-    def __call__(self, x):
-        return jnp.log10(jnp.maximum(super().__call__(x), 1e-10))
-
-
-class MFCC:
-    def __init__(self, sr=16000, n_mfcc=40, n_mels=64, **kwargs):
-        self.logmel = LogMelSpectrogram(sr, n_mels=n_mels, **kwargs)
-        n = n_mels
-        k = np.arange(n_mfcc)[:, None]
-        self.dct = jnp.asarray(
-            np.cos(math.pi / n * (np.arange(n)[None, :] + 0.5) * k)
-            * math.sqrt(2.0 / n), jnp.float32)
-
-    def __call__(self, x):
-        lm = self.logmel(x)
-        return jnp.einsum("km,...mt->...kt", self.dct, lm)
-
-
-from paddle_tpu.audio.datasets import ESC50, TESS  # noqa: E402
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC", "hz_to_mel", "mel_to_hz",
+           "mel_frequencies", "fft_frequencies", "compute_fbank_matrix",
+           "power_to_db", "create_dct", "get_window", "ESC50", "TESS"]

@@ -1,0 +1,94 @@
+"""paddle.audio.features (ref: python/paddle/audio/features/layers.py —
+Spectrogram:28, MelSpectrogram:110, LogMelSpectrogram:210, MFCC:313).
+
+The frontend is matmul-shaped on purpose: STFT (batched rFFT via XLA) →
+|·|^p → fbank matmul → dB/DCT matmul — each a fused XLA op on TPU."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn.module import Module
+from paddle_tpu import signal
+from paddle_tpu.audio import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Module):
+    """|STFT|^power over (..., T) waveforms → (..., freq, frames)."""
+
+    def __init__(self, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=1.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        assert power > 0, "Power of spectrogram must be > 0."
+        self.n_fft = n_fft
+        self.hop_length = hop_length if hop_length is not None else n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = AF.get_window(window, self.win_length,
+                                        fftbins=True, dtype=dtype)
+
+    def forward(self, x):
+        spec = signal.stft(jnp.asarray(x), self.n_fft, self.hop_length,
+                           self.win_length, window=self.fft_window,
+                           center=self.center, pad_mode=self.pad_mode)
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram(Module):
+    def __init__(self, sr=22050, n_fft=2048, hop_length=512,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank_matrix = AF.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spect = self._spectrogram(x)  # (..., freq, frames)
+        return jnp.matmul(self.fbank_matrix, spect)
+
+
+class LogMelSpectrogram(Module):
+    def __init__(self, sr=22050, n_fft=2048, hop_length=512,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self._melspectrogram(x),
+                              ref_value=self.ref_value, amin=self.amin,
+                              top_db=self.top_db)
+
+
+class MFCC(Module):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=2048, hop_length=512,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = AF.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        mel = self._log_melspectrogram(x)  # (..., n_mels, frames)
+        return jnp.matmul(jnp.swapaxes(self.dct_matrix, 0, 1), mel)

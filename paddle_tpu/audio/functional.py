@@ -1,0 +1,118 @@
+"""paddle.audio.functional (ref: python/paddle/audio/functional/
+functional.py — hz_to_mel:23, mel_to_hz:79, mel_frequencies:124,
+fft_frequencies:164, compute_fbank_matrix:187, power_to_db:260,
+create_dct:304; window.py get_window). All jnp — the fbank/DCT matrices
+are built once and the per-frame work is a matmul, which is exactly what
+the MXU wants a feature frontend to be."""
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """Hz → mel. Slaney by default (linear below 1 kHz, log above),
+    HTK formula with htk=True (≙ functional.py:23)."""
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(freq / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk=False):
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    return mel_to_hz(jnp.linspace(lo, hi, n_mels), htk).astype(dtype)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return jnp.linspace(0, sr / 2.0, 1 + n_fft // 2).astype(dtype)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank (n_mels, 1 + n_fft//2)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights.astype(dtype)
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    s = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """(n_mels, n_mfcc) type-II DCT matrix (≙ create_dct:304)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+        dct = dct * math.sqrt(1.0 / (2.0 * n_mels))
+    return dct.astype(dtype)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window by name (≙ window.py get_window). Periodic (fftbins=True)
+    windows for STFT."""
+    m = win_length + 1 if fftbins else win_length
+    n = jnp.arange(m, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / (m - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * n / (m - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * n / (m - 1))
+             + 0.08 * jnp.cos(4 * math.pi * n / (m - 1)))
+    elif window in ("boxcar", "rect", "rectangular", "ones"):
+        w = jnp.ones((m,))
+    elif window == "triang":
+        w = 1.0 - jnp.abs((n - (m - 1) / 2.0) / ((m - 1) / 2.0))
+    elif window == "bartlett":
+        w = 1.0 - jnp.abs((2.0 * n - (m - 1)) / (m - 1))
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return w.astype(dtype)

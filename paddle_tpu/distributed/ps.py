@@ -151,7 +151,10 @@ class PSClient:
         self.servers = list(servers)
 
     def _dense_home(self, name):
-        return self.servers[hash(name) % len(self.servers)]
+        # stable across processes (builtin hash is PYTHONHASHSEED-random:
+        # two workers would route the same table to different servers)
+        import zlib
+        return self.servers[zlib.crc32(name.encode()) % len(self.servers)]
 
     def create_tables(self, specs):
         """specs: {name: ("dense", shape, kwargs)|("sparse", dim, kwargs)}.

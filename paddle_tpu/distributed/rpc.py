@@ -22,7 +22,7 @@ __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "WorkerInfo"]
 
 _REQ_TAG = 1 << 60
-_RESP_BASE = 1 << 61
+_RESP_TAG = 1 << 61  # ONE response mailbox per rank; payload carries seq
 
 _state = None
 _lock = threading.Lock()
@@ -41,6 +41,7 @@ class _Future:
         self._ev = threading.Event()
         self._value = None
         self._exc = None
+        self._on_timeout = None
 
     def _set(self, value=None, exc=None):
         self._value, self._exc = value, exc
@@ -48,6 +49,8 @@ class _Future:
 
     def wait(self, timeout=None):
         if not self._ev.wait(timeout):
+            if self._on_timeout is not None:
+                self._on_timeout()  # unregister so the table can't leak
             raise TimeoutError("rpc future timed out")
         if self._exc is not None:
             raise self._exc
@@ -97,13 +100,12 @@ class _RpcState:
     def _handle(self, payload):
         src, seq, fn, args, kwargs = pickle.loads(payload)
         try:
-            result = (True, fn(*args, **(kwargs or {})))
+            result = (seq, True, fn(*args, **(kwargs or {})))
         except Exception as e:  # ship the failure back, not a hang
-            result = (False, (e, traceback.format_exc()))
+            result = (seq, False, (e, traceback.format_exc()))
         peer = self.workers[src]
         try:
-            self.endpoint.send(peer.host, peer.port,
-                               _RESP_BASE | (self.rank << 24) | seq,
+            self.endpoint.send(peer.host, peer.port, _RESP_TAG,
                                pickle.dumps(result))
         except Exception:
             pass  # caller's timeout handles a dead peer
@@ -111,33 +113,33 @@ class _RpcState:
     # -- client side --------------------------------------------------------
 
     def _serve_responses(self):
-        # responses are tagged (src_rank<<24 | seq); poll every pending tag
+        # single response mailbox: one blocking recv serves ALL pending
+        # futures (no per-tag poll loop); a seq no longer in the table is
+        # a timed-out call's late reply — dropped
         while not self.stopping.is_set():
+            try:
+                payload = self.endpoint.recv(_RESP_TAG, timeout=0.25)
+            except TimeoutError:
+                continue
+            except Exception:
+                if self.stopping.is_set():
+                    return
+                continue
+            seq, ok, value = pickle.loads(payload)
             with self.fut_lock:
-                pending = list(self.futures.items())
-            if not pending:
-                self.stopping.wait(0.02)
+                fut = self.futures.pop(seq, None)
+            if fut is None:
                 continue
-            got_any = False
-            for (tag, fut) in pending:
-                try:
-                    payload = self.endpoint.recv(tag, timeout=0.02)
-                except TimeoutError:
-                    continue
-                except Exception:
-                    continue
-                got_any = True
-                with self.fut_lock:
-                    self.futures.pop(tag, None)
-                ok, value = pickle.loads(payload)
-                if ok:
-                    fut._set(value=value)
-                else:
-                    exc, tb = value
-                    exc.args = (f"{exc}\n[remote traceback]\n{tb}",)
-                    fut._set(exc=exc)
-            if not got_any:
-                continue
+            if ok:
+                fut._set(value=value)
+            else:
+                exc, tb = value
+                exc.args = (f"{exc}\n[remote traceback]\n{tb}",)
+                fut._set(exc=exc)
+
+    def _discard(self, seq):
+        with self.fut_lock:
+            self.futures.pop(seq, None)
 
     def call(self, to, fn, args, kwargs, timeout):
         info = self.by_name.get(to)
@@ -148,13 +150,13 @@ class _RpcState:
             seq = self.seq
             self.seq = (self.seq + 1) & 0xFFFFFF
         fut = _Future()
-        tag = _RESP_BASE | (info.rank << 24) | seq
+        # timed-out futures must not leak: wait() calls this back
+        fut._on_timeout = lambda: self._discard(seq)
         with self.fut_lock:
-            self.futures[tag] = fut
+            self.futures[seq] = fut
         self.endpoint.send(
             info.host, info.port, _REQ_TAG,
             pickle.dumps((self.rank, seq, fn, args or (), kwargs)))
-        fut._timeout = timeout
         return fut
 
     def close(self):

@@ -28,6 +28,9 @@ class OpSpec:
     # string args, list inputs); wraps fn, never replaces it
     jit_ok: bool = True                     # False for host-side dynamic-
     # shape ops (masked_select/unique/eig...) that cannot trace
+    alias_of: Optional[str] = None          # inplace-suffix aliases: same
+    # fn object as the base op; OpTest covers the base, a fn-identity test
+    # covers the alias (re-running the oracle would only duplicate runtime)
 
 
 _OPS: Dict[str, OpSpec] = {}

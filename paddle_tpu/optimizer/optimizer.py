@@ -52,8 +52,13 @@ class Optimizer:
         self._deferred_params = parameters
 
     def _ensure_bound(self):
-        if self._state is None and self._deferred_params is not None:
-            self.bind(self._deferred_params)
+        if self._deferred_params is not None:
+            if self._params is None:
+                self._params = self._deferred_params
+            if self._state is None:
+                # state may already exist: set_state_dict (checkpoint
+                # resume) runs before the first step — keep it
+                self._state = self.init(self._params)
             self._deferred_params = None
 
     # -- functional API --------------------------------------------------------
@@ -116,7 +121,8 @@ class Optimizer:
         values here, nothing to clear."""
 
     def state_dict(self):
-        d = {"state": self._state} if hasattr(self, "_state") else {}
+        self._ensure_bound()
+        d = {"state": self._state} if self._state is not None else {}
         if isinstance(self.learning_rate, LRScheduler):
             d["lr"] = self.learning_rate.state_dict()
         return d
@@ -128,7 +134,7 @@ class Optimizer:
             self.learning_rate.set_state_dict(d["lr"])
 
     def get_lr(self):
-        step = self._state["step"] if hasattr(self, "_state") else 0
+        step = self._state["step"] if self._state is not None else 0
         return float(_lr_value(self.learning_rate, step))
 
 

@@ -50,16 +50,22 @@ class functional:
     @staticmethod
     def softmax(x, axis=-1):
         """Row-wise softmax over the stored pattern (≙ sparse softmax
-        kernel: softmax across the nnz of each row, zeros stay zero)."""
+        kernel: softmax across the nnz of each row, zeros stay zero).
+        For a batched COO (ndim > 2) every leading sparse dim joins the
+        segment id, so rows in different batches normalize separately."""
         from paddle_tpu import sparse as S
         coo = _coo(x)
-        rows = coo.indices[-2]
-        n_rows = coo.shape[-2]
+        # segment id = flattened index over ALL dims but the softmaxed one
+        seg = coo.indices[0] * 0
+        n_seg = 1
+        for d in range(coo.indices.shape[0] - 1):
+            seg = seg * coo.shape[d] + coo.indices[d]
+            n_seg *= coo.shape[d]
         v = coo.values.astype(jnp.float32)
-        row_max = jax.ops.segment_max(v, rows, num_segments=n_rows)
-        e = jnp.exp(v - row_max[rows])
-        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-        out = (e / denom[rows]).astype(x.values.dtype)
+        seg_max = jax.ops.segment_max(v, seg, num_segments=n_seg)
+        e = jnp.exp(v - seg_max[seg])
+        denom = jax.ops.segment_sum(e, seg, num_segments=n_seg)
+        out = (e / denom[seg]).astype(x.values.dtype)
         if isinstance(x, S.SparseCsrTensor):
             return x.with_values(out)
         return coo.with_values(out)
@@ -186,8 +192,16 @@ class functional:
                                  (x.shape[0],) + out_spatial)
 
     @staticmethod
+    def _check_unsupported(dilation, groups):
+        if dilation not in (1, (1, 1, 1), [1, 1, 1]):
+            raise NotImplementedError("sparse conv3d: dilation != 1")
+        if groups != 1:
+            raise NotImplementedError("sparse conv3d: groups != 1")
+
+    @staticmethod
     def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                groups=1, data_format="NDHWC"):
+        functional._check_unsupported(dilation, groups)
         return functional._conv3d(x, weight, bias, stride, padding,
                                   subm=False)
 
@@ -196,6 +210,7 @@ class functional:
                     groups=1, data_format="NDHWC"):
         """Submanifold conv: output sites == input sites (stride must be
         1) — the sparsity never dilates (≙ subm_conv3d)."""
+        functional._check_unsupported(dilation, groups)
         return functional._conv3d(x, weight, bias, (1, 1, 1),
                                   padding, subm=True)
 
@@ -274,6 +289,26 @@ class BatchNorm(Module):
         if self.training:
             mean = jnp.mean(v, axis=0)
             var = jnp.var(v, axis=0)
+            # running stats ride the stateful Context like the dense
+            # _BatchNormBase (nn/layer/norm.py:56-63)
+            from paddle_tpu.nn.module import current_context
+            ctx = current_context()
+            if ctx is not None:
+                m = self.momentum
+                tag = getattr(self, "_stat_tag", None)
+                if tag is None:
+                    tag = f"id{id(self) % 10**9}"  # untagged: tag_paths()
+                prefix = f"{tag}." if tag else ""
+                ctx.record_update(
+                    f"{prefix}running_mean",
+                    (m * jnp.asarray(self.running_mean)
+                     + (1 - m) * mean))
+                n = v.shape[0]
+                unbiased = var * n / max(n - 1, 1)
+                ctx.record_update(
+                    f"{prefix}running_var",
+                    (m * jnp.asarray(self.running_var)
+                     + (1 - m) * unbiased))
         else:
             mean = jnp.asarray(self.running_mean)
             var = jnp.asarray(self.running_var)

@@ -1,0 +1,132 @@
+"""Inplace-suffixed API variants + TensorArray ops + set_printoptions
+(ref: python/paddle/tensor/__init__.py exports the ``op_``` family, e.g.
+math.py add_/clip_/exp_; array.py array_read:25/array_write:74/
+array_length:118/create_array:151).
+
+JAX arrays are immutable, so ``x.add_(y)``-style mutation cannot exist;
+the TPU-native contract for every ``op_`` here is: same computation,
+returns the new array, caller rebinds (which is also what the reference's
+inplace op returns — the same Tensor, updated). Under jit, XLA's buffer
+donation already gives the memory reuse the reference's inplace pass
+exists for, so these are pure API-parity aliases, each inheriting its
+base op's oracle so the OpTest gate covers them.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.ops import registry
+from paddle_tpu.ops.registry import OpSpec, register_op
+
+__all__ = ["create_array", "array_write", "array_read", "array_length",
+           "set_printoptions"]
+
+_ALIASES = {
+    "add_": "add", "ceil_": "ceil", "clip_": "clip", "erfinv_": "erfinv",
+    "exp_": "exp", "flatten_": "flatten", "floor_": "floor",
+    "floor_mod": "remainder", "remainder_": "remainder",
+    "index_add_": "index_add", "lerp_": "lerp",
+    "put_along_axis_": "put_along_axis", "reciprocal_": "reciprocal",
+    "reshape_": "reshape", "round_": "round", "rsqrt_": "rsqrt",
+    "scale_": "scale", "scatter_": "scatter", "sqrt_": "sqrt",
+    "squeeze_": "squeeze", "subtract_": "subtract", "tanh_": "tanh",
+    "unsqueeze_": "unsqueeze", "uniform_": "uniform",
+}
+
+
+def _register_aliases():
+    for name, base in _ALIASES.items():
+        spec = registry.get_op(base)
+        alias = OpSpec(name, spec.fn, spec.category, None, None, spec.ref,
+                       spec.differentiable, None, spec.jit_ok,
+                       alias_of=base)
+        registry._OPS[name] = alias
+        globals()[name] = spec.fn
+        __all__.append(name)
+
+
+_register_aliases()
+
+
+# -- TensorArray (≙ LoDTensorArray, python/paddle/tensor/array.py) ----------
+
+def create_array(dtype="float32", initialized_list=None):
+    """ref: array.py create_array:151 — a plain Python list IS the
+    TensorArray in eager/traced JAX (lod metadata dissolves)."""
+    return list(initialized_list) if initialized_list is not None else []
+
+
+def array_write(x, i, array=None):
+    """ref: array.py array_write:74 — write x at index i, growing as
+    needed."""
+    if array is None:
+        array = []
+    i = int(i)
+    while len(array) <= i:
+        array.append(None)
+    array[i] = jnp.asarray(x)
+    return array
+
+
+def array_read(array, i):
+    """ref: array.py array_read:25."""
+    return array[int(i)]
+
+
+def array_length(array):
+    """ref: array.py array_length:118."""
+    return jnp.asarray(len(array), jnp.int64 if False else jnp.int32)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """ref: tensor/to_string.py set_printoptions — forwards to numpy's
+    printoptions (jax arrays print through numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+    try:
+        jnp.set_printoptions(**kw)
+    except AttributeError:
+        pass
+
+
+register_op("create_array", create_array, "array",
+            np_ref=lambda: np.zeros(0),
+            sample_args=lambda: ((), {}),
+            ref="python/paddle/tensor/array.py:151", differentiable=False)
+registry.get_op("create_array").test_fn = \
+    lambda: jnp.zeros(len(create_array()))
+register_op("array_write", array_write, "array",
+            np_ref=lambda x: np.asarray(x),
+            sample_args=lambda: ((np.arange(3.0, dtype=np.float32),), {}),
+            ref="python/paddle/tensor/array.py:74", differentiable=False)
+registry.get_op("array_write").test_fn = \
+    lambda x: array_read(array_write(x, 0), 0)
+register_op("array_read", array_read, "array",
+            np_ref=lambda x: np.asarray(x),
+            sample_args=lambda: ((np.arange(4.0, dtype=np.float32),), {}),
+            ref="python/paddle/tensor/array.py:25", differentiable=False)
+registry.get_op("array_read").test_fn = \
+    lambda x: array_read(array_write(x, 2), 2)
+register_op("array_length", array_length, "array",
+            np_ref=lambda x: np.asarray(3, np.int32),
+            sample_args=lambda: ((np.zeros(2, np.float32),), {}),
+            ref="python/paddle/tensor/array.py:118", differentiable=False)
+registry.get_op("array_length").test_fn = \
+    lambda x: array_length(array_write(x, 2))
+register_op("set_printoptions", set_printoptions, "framework",
+            np_ref=lambda: np.zeros(0),
+            sample_args=lambda: ((), {}),
+            ref="python/paddle/tensor/to_string.py", differentiable=False)
+registry.get_op("set_printoptions").test_fn = \
+    lambda: (set_printoptions(precision=8), jnp.zeros(0))[1]

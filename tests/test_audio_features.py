@@ -1,0 +1,74 @@
+"""audio.features / audio.functional vs reference semantics (ref test
+pattern: test_audio_functions.py — librosa-oracle checks; here closed-form
+properties + shape/energy oracles, no external deps)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import audio
+from paddle_tpu.audio import functional as AF
+
+
+def test_mel_hz_roundtrip_both_scales():
+    f = jnp.asarray([0.0, 200.0, 999.0, 1000.0, 4000.0, 8000.0])
+    for htk in (False, True):
+        back = AF.mel_to_hz(AF.hz_to_mel(f, htk=htk), htk=htk)
+        np.testing.assert_allclose(back, f, atol=1e-2, rtol=1e-4)
+
+
+def test_fbank_matrix_properties():
+    fb = np.asarray(AF.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40))
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every filter has support; slaney-normalized peaks < 1
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_window_families():
+    for name in ("hann", "hamming", "blackman", "triang", "bartlett",
+                 "boxcar"):
+        w = np.asarray(AF.get_window(name, 64))
+        assert w.shape == (64,) and np.isfinite(w).all()
+    with pytest.raises(ValueError):
+        AF.get_window("nope", 64)
+
+
+def test_power_to_db_top_db_floor():
+    s = jnp.asarray([1.0, 1e-6, 1e-12])
+    db = np.asarray(AF.power_to_db(s, top_db=80.0))
+    assert db[0] == 0.0
+    assert db.min() >= db.max() - 80.0
+
+
+def test_spectrogram_parseval_sine():
+    # a pure tone concentrates energy at its bin
+    sr, n_fft = 16000, 512
+    t = np.arange(sr, dtype=np.float32) / sr
+    wave = np.sin(2 * np.pi * 1000.0 * t)
+    spec = audio.Spectrogram(n_fft=n_fft, hop_length=256, power=2.0)(wave)
+    spec = np.asarray(spec)
+    assert spec.shape[0] == n_fft // 2 + 1
+    peak_bin = spec.mean(axis=1).argmax()
+    expect = round(1000.0 * n_fft / sr)
+    assert abs(int(peak_bin) - expect) <= 1
+
+
+def test_mel_and_mfcc_shapes_and_finiteness():
+    wave = np.random.RandomState(0).normal(size=(2, 8000)).astype(np.float32)
+    mel = audio.MelSpectrogram(sr=16000, n_fft=512, hop_length=256,
+                               n_mels=40)(wave)
+    assert mel.shape[:2] == (2, 40)
+    logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, hop_length=256,
+                                     n_mels=40)(wave)
+    assert np.isfinite(np.asarray(logmel)).all()
+    mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, hop_length=256,
+                      n_mels=40)(wave)
+    assert mfcc.shape[:2] == (2, 13)
+    assert np.isfinite(np.asarray(mfcc)).all()
+
+
+def test_dct_orthonormal():
+    d = np.asarray(AF.create_dct(13, 40, norm="ortho"))
+    gram = d.T @ d
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)

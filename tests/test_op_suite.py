@@ -30,7 +30,7 @@ def test_every_op_is_tested():
     has a value oracle or is a random op with a distribution test."""
     untested = [op.name for op in all_ops()
                 if (op.np_ref is None or op.sample_args is None)
-                and op.name not in RANDOM_OPS]
+                and op.name not in RANDOM_OPS and op.alias_of is None]
     assert not untested, f"ops without oracle: {untested}"
     registered = {op.name for op in all_ops()}
     stale = RANDOM_OPS - registered
@@ -161,3 +161,13 @@ def test_poisson_exponential_binomial():
                                jnp.full((N,), 0.4)))
     assert abs(bn.mean() - 4.0) < 0.1
     assert (bn >= 0).all() and (bn <= 10).all()
+
+
+def test_inplace_aliases_share_base_fn():
+    """Every op_ alias dispatches the exact base implementation (the
+    OpTest oracle covers the base; identity covers the alias)."""
+    from paddle_tpu.ops.registry import get_op
+    aliases = [op for op in all_ops() if op.alias_of is not None]
+    assert len(aliases) >= 24
+    for op in aliases:
+        assert op.fn is get_op(op.alias_of).fn, (op.name, op.alias_of)

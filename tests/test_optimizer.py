@@ -97,3 +97,20 @@ def test_multi_precision_master_weights():
     params, state = opt.update(g, state, params)
     assert params["w"].dtype == jnp.bfloat16
     assert state["slots"]["w"][0].dtype == jnp.float32
+
+
+def test_parameters_kwarg_with_checkpoint_resume():
+    """review r3: deferred bind must survive set_state_dict-before-step
+    (checkpoint resume) and get_lr/state_dict before the first step."""
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu import optimizer as optim
+    params = {"w": jnp.ones((3,))}
+    opt = optim.Adam(learning_rate=0.1, parameters=params)
+    assert abs(opt.get_lr() - 0.1) < 1e-6  # before any step: step-0 LR
+    sd = opt.state_dict()               # materializes state, not a crash
+    opt2 = optim.Adam(learning_rate=0.1, parameters=params)
+    opt2.set_state_dict(sd)             # resume BEFORE first step
+    new_p = opt2.step({"w": jnp.ones((3,))})
+    assert np.isfinite(np.asarray(new_p["w"])).all()
+    assert int(opt2.state_dict()["state"]["step"]) == 1

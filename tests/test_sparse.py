@@ -170,3 +170,41 @@ def test_sparse_layers_and_batchnorm():
     pool = S.nn.MaxPool3D(2, stride=2)
     p = pool(y)
     assert p.to_dense().shape == (1, 2, 2, 2, 6)
+
+
+def test_batched_sparse_softmax_rows_normalize():
+    """review r3: leading sparse dims must join the segment id."""
+    idx = np.array([[0, 0, 1, 1],    # batch
+                    [0, 0, 0, 0],    # row (same row id in both batches!)
+                    [0, 1, 0, 1]])   # col
+    x = S.sparse_coo_tensor(idx, np.array([1.0, 2.0, 5.0, 8.0], np.float32),
+                            (2, 1, 2))
+    out = S.nn.functional.softmax(x)
+    d = np.asarray(out.to_dense())
+    np.testing.assert_allclose(d.sum(-1), np.ones((2, 1)), atol=1e-5)
+    # batches normalized independently: different distributions
+    assert abs(d[0, 0, 0] - d[1, 0, 0]) > 1e-3
+
+
+def test_sparse_batchnorm_running_stats_update():
+    """review r3: training must record running-stat updates like dense BN."""
+    from paddle_tpu import nn as dense_nn
+    bn = S.nn.BatchNorm(3)
+    bn = bn.tag_paths()
+    bn.train()
+    x = _rand_coo((1, 4, 4, 4), 10, seed=21, channels=3)
+    x = x.with_values(x.values * 3.0 + 1.0)
+    with dense_nn.stateful(training=True) as ctx:
+        bn(x)
+    assert any("running_mean" in k for k in ctx.updates)
+    bn2 = bn.apply_updates(ctx.updates)
+    assert float(jnp.sum(jnp.abs(jnp.asarray(bn2.running_mean)))) > 0
+
+
+def test_sparse_conv_rejects_unsupported():
+    x = _rand_coo((1, 4, 4, 4), 6, seed=22, channels=2)
+    w = jnp.zeros((3, 3, 3, 2, 2))
+    with pytest.raises(NotImplementedError):
+        S.nn.functional.conv3d(x, w, dilation=2)
+    with pytest.raises(NotImplementedError):
+        S.nn.functional.subm_conv3d(x, w, groups=2)
