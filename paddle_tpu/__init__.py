@@ -59,6 +59,13 @@ import paddle_tpu.signal as signal
 import paddle_tpu.onnx as onnx
 import paddle_tpu.jit as jit  # callable module: paddle_tpu.jit(fn) / jit.to_static
 import paddle_tpu.hub as hub
+import paddle_tpu.device as device
+import paddle_tpu.reader as reader
+import paddle_tpu.dataset as dataset
+import paddle_tpu.utils as utils
+import paddle_tpu.sysconfig as sysconfig
+import paddle_tpu.regularizer as regularizer
+from paddle_tpu.reader import batch
 from paddle_tpu.framework.io import save, load
 from paddle_tpu.hapi import Model, summary, flops
 
@@ -67,7 +74,8 @@ __all__ = (
      "distributed", "vision", "profiler", "incubate", "static", "sparse",
      "quantization",
      "distribution", "text", "audio", "geometric", "linalg", "fft", "signal",
-     "onnx", "hub",
+     "onnx", "hub", "device", "reader", "dataset", "utils",
+     "sysconfig", "regularizer", "batch", "version",
      "Tensor", "to_tensor", "is_tensor", "jit", "no_grad", "grad",
      "value_and_grad", "stop_gradient", "device_count", "devices",
      "set_device", "get_device", "save", "load", "Model", "summary", "flops",

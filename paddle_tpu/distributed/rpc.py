@@ -7,9 +7,11 @@ pipeline runtime uses (native/src/p2p.cc) instead of brpc; rendezvous goes
 through the native TCPStore. Calls are pickled (fn, args, kwargs) — like
 the reference, which ships cloudpickled callables between trusted trainer
 processes — executed on a small server-side thread pool, results pickled
-back. Request mailbox: one well-known tag per rank; responses are
-individually tagged by (caller_rank, seq) so concurrent futures never
-collide.
+back. Mailboxes: one well-known request tag per rank, and ONE response
+mailbox per rank whose payloads carry the sequence number — the response
+loop does a single blocking recv and routes by seq, so latency does not
+scale with the number of pending futures, and a timed-out call's late
+reply (unknown seq) is simply dropped.
 """
 
 import pickle

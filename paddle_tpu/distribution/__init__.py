@@ -12,7 +12,13 @@ from paddle_tpu import random as pt_random
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
            "Dirichlet", "Exponential", "Gamma", "Laplace", "Bernoulli",
-           "Gumbel", "LogNormal", "Multinomial", "kl_divergence"]
+           "Gumbel", "LogNormal", "Multinomial", "kl_divergence",
+           "Independent", "TransformedDistribution", "Transform",
+           "AffineTransform", "ExpTransform", "SigmoidTransform",
+           "TanhTransform", "SoftmaxTransform", "PowerTransform",
+           "AbsTransform", "ChainTransform", "StackTransform",
+           "StickBreakingTransform", "ReshapeTransform",
+           "IndependentTransform", "transform"]
 
 
 def _key(key):
@@ -340,3 +346,12 @@ def kl_divergence(p, q):
         return jnp.log((q.high - q.low) / (p.high - p.low))
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+from paddle_tpu.distribution import transform  # noqa: E402
+from paddle_tpu.distribution.transform import (  # noqa: E402
+    Transform, AffineTransform, ExpTransform, SigmoidTransform,
+    TanhTransform, SoftmaxTransform, PowerTransform, AbsTransform,
+    ChainTransform, StackTransform, StickBreakingTransform,
+    ReshapeTransform, IndependentTransform, TransformedDistribution,
+    Independent)

@@ -1,0 +1,328 @@
+"""Bijective transforms + TransformedDistribution + Independent
+(ref: python/paddle/distribution/transform.py — Transform, AffineTransform,
+ExpTransform, SigmoidTransform, TanhTransform, SoftmaxTransform,
+PowerTransform, AbsTransform, ChainTransform, StackTransform,
+StickBreakingTransform, ReshapeTransform; transformed_distribution.py;
+independent.py).
+
+Each Transform supplies forward / inverse / log|det J| as pure jnp — the
+change-of-variables machinery is then three lines, and everything traces
+under jit."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "TanhTransform", "SoftmaxTransform",
+           "PowerTransform", "AbsTransform", "ChainTransform",
+           "StackTransform", "StickBreakingTransform", "ReshapeTransform",
+           "IndependentTransform", "TransformedDistribution", "Independent"]
+
+
+class Transform:
+    """Base bijector (≙ transform.py Transform: forward/inverse/
+    forward_log_det_jacobian)."""
+
+    _event_dims = 0  # dims consumed by one application
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def forward(self, x):
+        return self.loc + self.scale * jnp.asarray(x)
+
+    def inverse(self, y):
+        return (jnp.asarray(y) - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)),
+                                jnp.asarray(x).shape)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(jnp.asarray(x))
+
+    def inverse(self, y):
+        return jnp.log(jnp.asarray(y))
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.asarray(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(power, jnp.float32)
+
+    def forward(self, x):
+        return jnp.power(jnp.asarray(x), self.power)
+
+    def inverse(self, y):
+        return jnp.power(jnp.asarray(y), 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x)
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(jnp.asarray(x))
+
+    def inverse(self, y):
+        y = jnp.asarray(y)
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x)
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(jnp.asarray(x))
+
+    def inverse(self, y):
+        return jnp.arctanh(jnp.asarray(y))
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x)
+        # log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x)), stable form
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    """Non-bijective |x| (≙ AbsTransform: inverse returns the positive
+    branch)."""
+
+    def forward(self, x):
+        return jnp.abs(jnp.asarray(x))
+
+    def inverse(self, y):
+        return jnp.asarray(y)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(jnp.asarray(x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x); not volume-preserving — log-det undefined, used
+    for reparameterized simplex values (≙ SoftmaxTransform)."""
+
+    _event_dims = 1
+
+    def forward(self, x):
+        return jax.nn.softmax(jnp.asarray(x), -1)
+
+    def inverse(self, y):
+        y = jnp.asarray(y)
+        return jnp.log(y) - jnp.log(y[..., -1:])
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform is not bijective")
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} → open simplex Δ^{n} via stick breaking (≙
+    StickBreakingTransform)."""
+
+    _event_dims = 1
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=jnp.float32))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,))], -1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,)), jnp.cumprod(1 - z, -1)], -1)
+        return zpad * one_minus
+
+    def inverse(self, y):
+        y = jnp.asarray(y)
+        n = y.shape[-1] - 1
+        cum = jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,)), jnp.cumsum(y[..., :-1], -1)],
+            -1)[..., :-1]
+        z = y[..., :-1] / (1 - cum)
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=jnp.float32))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x)
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=jnp.float32))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        one_minus = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,)), jnp.cumprod(1 - z, -1)[..., :-1]],
+            -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(one_minus), -1)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def inverse(self, y):
+        y = jnp.asarray(y)
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x)
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] along slice i of ``axis`` (≙ StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, method, x):
+        x = jnp.asarray(x)
+        parts = [getattr(t, method)(jnp.take(x, i, self.axis))
+                 for i, t in enumerate(self.transforms)]
+        return jnp.stack(parts, self.axis)
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.n = reinterpreted_batch_ndims
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.n, 0)))
+
+
+class TransformedDistribution:
+    """base distribution pushed through a transform chain
+    (≙ transformed_distribution.py): sample = T(base.sample);
+    log_prob(y) = base.log_prob(T^-1(y)) - log|det J(T^-1(y))|."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(list(transforms)) \
+            if len(transforms) != 1 else transforms[0]
+
+    def sample(self, shape=(), key=None):
+        return self.transform.forward(self.base.sample(shape, key=key))
+
+    def rsample(self, shape=(), key=None):
+        return self.transform.forward(self.base.rsample(shape, key=key))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        ld = self.transform.forward_log_det_jacobian(x)
+        base_lp = self.base.log_prob(x)
+        # per-element transforms broadcast against an event-shaped base lp
+        if hasattr(ld, "shape") and base_lp.shape != getattr(
+                ld, "shape", ()):
+            extra = ld.ndim - base_lp.ndim
+            if extra > 0:
+                ld = jnp.sum(ld, axis=tuple(range(-extra, 0)))
+        return base_lp - ld
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+
+class Independent:
+    """Reinterpret batch dims as event dims (≙ independent.py):
+    log_prob sums over the reinterpreted trailing dims."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.n = int(reinterpreted_batch_ndims)
+
+    def sample(self, shape=(), key=None):
+        return self.base.sample(shape, key=key)
+
+    def rsample(self, shape=(), key=None):
+        return self.base.rsample(shape, key=key)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return jnp.sum(lp, axis=tuple(range(-self.n, 0)))
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return jnp.sum(ent, axis=tuple(range(-self.n, 0)))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance

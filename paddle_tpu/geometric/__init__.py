@@ -6,7 +6,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
-           "segment_mean", "segment_max", "segment_min"]
+           "segment_mean", "segment_max", "segment_min",
+           "reindex_graph", "reindex_heter_graph", "sample_neighbors"]
 
 
 def segment_sum(data, segment_ids, num_segments=None):
@@ -62,3 +63,95 @@ def send_uv(x, y, src_index, dst_index, message_op="add"):
     x = jnp.asarray(x)[jnp.asarray(src_index)]
     y = jnp.asarray(y)[jnp.asarray(dst_index)]
     return x + y if message_op == "add" else x * y
+
+
+def _renumber(pos, out_nodes, nbr):
+    """Shared subgraph renumbering: extend (pos, out_nodes) with unseen
+    ids in ``nbr`` and return their dense indices."""
+    import numpy as np
+    src = np.empty(len(nbr), np.int32)
+    for i, n in enumerate(nbr):
+        n = int(n)
+        j = pos.get(n)
+        if j is None:
+            j = len(out_nodes)
+            pos[n] = j
+            out_nodes.append(n)
+        src[i] = j
+    return src
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """ref: geometric/reindex.py reindex_graph:24 — renumber sampled
+    subgraph node ids to a dense 0..n range; returns (reindex_src,
+    reindex_dst, out_nodes). Host-side index plumbing (like the
+    reference's CPU kernel); the renumbered edges then feed device
+    segment ops."""
+    import numpy as np
+    x_np = np.asarray(jax.device_get(jnp.asarray(x)))
+    nbr = np.asarray(jax.device_get(jnp.asarray(neighbors)))
+    cnt = np.asarray(jax.device_get(jnp.asarray(count)))
+    out_nodes = list(x_np)
+    pos = {int(n): i for i, n in enumerate(x_np)}
+    src = _renumber(pos, out_nodes, nbr)
+    dst = np.repeat(np.arange(len(x_np)), cnt)
+    return (jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32),
+            jnp.asarray(np.asarray(out_nodes), jnp.int32))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """ref: reindex.py reindex_heter_graph — per-edge-type neighbor lists
+    against ONE shared node renumbering."""
+    import numpy as np
+    x_np = np.asarray(jax.device_get(jnp.asarray(x)))
+    out_nodes = list(x_np)
+    pos = {int(n): i for i, n in enumerate(x_np)}
+    srcs, dsts = [], []
+    for nbr, cnt in zip(neighbors, count):
+        nbr = np.asarray(jax.device_get(jnp.asarray(nbr)))
+        cnt = np.asarray(jax.device_get(jnp.asarray(cnt)))
+        srcs.append(jnp.asarray(_renumber(pos, out_nodes, nbr), jnp.int32))
+        dsts.append(jnp.asarray(np.repeat(np.arange(len(x_np)), cnt),
+                                jnp.int32))
+    return srcs, dsts, jnp.asarray(np.asarray(out_nodes), jnp.int32)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None,
+                     seed=0):
+    """ref: geometric/sampling/neighbors.py sample_neighbors:23 — uniform
+    neighbor sampling from a CSC graph. Host-side (sampling is data-
+    dependent-shape by nature; the reference's is a CPU/GPU custom kernel
+    outside the compiled graph too). Returns (out_neighbors, out_count
+    [, out_eids])."""
+    import numpy as np
+    row_np = np.asarray(jax.device_get(jnp.asarray(row))).reshape(-1)
+    col_np = np.asarray(jax.device_get(jnp.asarray(colptr))).reshape(-1)
+    nodes = np.asarray(jax.device_get(jnp.asarray(input_nodes))).reshape(-1)
+    eids_np = None if eids is None else np.asarray(
+        jax.device_get(jnp.asarray(eids))).reshape(-1)
+    rs = np.random.RandomState(seed)
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(col_np[int(v)]), int(col_np[int(v) + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(beg, end)
+        else:
+            sel = beg + rs.choice(deg, size=sample_size, replace=False)
+        out_n.append(row_np[sel])
+        out_c.append(len(sel))
+        if eids_np is not None:
+            out_e.append(eids_np[sel])
+    out_neighbors = jnp.asarray(np.concatenate(out_n) if out_n
+                                else np.zeros(0, row_np.dtype))
+    out_count = jnp.asarray(np.asarray(out_c, np.int32))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids=True requires eids")
+        return (out_neighbors, out_count,
+                jnp.asarray(np.concatenate(out_e) if out_e
+                            else np.zeros(0, eids_np.dtype)))
+    return out_neighbors, out_count

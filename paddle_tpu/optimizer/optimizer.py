@@ -78,8 +78,11 @@ class Optimizer:
         if self.grad_clip is not None:
             grads = self.grad_clip(grads)
         if self.weight_decay and not self._decoupled_wd:
-            grads = tree_map(lambda g, p: g + self.weight_decay * p,
-                             grads, params)
+            if callable(self.weight_decay):  # L1Decay/L2Decay regularizer
+                grads = tree_map(self.weight_decay, grads, params)
+            else:
+                grads = tree_map(lambda g, p: g + self.weight_decay * p,
+                                 grads, params)
 
         def upd(p, g, s):
             new_p, new_s = self.update_param(
@@ -213,13 +216,21 @@ class AdamW(Adam):
             grads = self.grad_clip(grads)
 
         wd = self.weight_decay
+        if callable(wd):
+            # L1Decay/L2Decay regularizer object: its penalty gradient is
+            # wd(0, p); decoupled decay subtracts lr * that from the param
+            def decay_term(p):
+                return wd(jnp.zeros_like(p), p)
+        else:
+            def decay_term(p):
+                return wd * p
 
         def upd(path_p, g, s):
             p = path_p
             new_p, new_s = Adam.update_param(self, p.astype(jnp.float32),
                                              g.astype(jnp.float32), s, lr,
                                              step)
-            new_p = new_p - lr * wd * p.astype(jnp.float32)
+            new_p = new_p - lr * decay_term(p.astype(jnp.float32))
             return new_p.astype(p.dtype), new_s
 
         if self.apply_decay_param_fun is not None and isinstance(params, dict):
@@ -229,7 +240,8 @@ class AdamW(Adam):
                         self, p.astype(jnp.float32), g.astype(jnp.float32),
                         s, lr, step)
                     if self.apply_decay_param_fun(name):
-                        new_p = new_p - lr * wd * p.astype(jnp.float32)
+                        new_p = new_p - lr * decay_term(
+                            p.astype(jnp.float32))
                     return new_p.astype(p.dtype), new_s
                 return f
             new_params, new_slots = {}, {}

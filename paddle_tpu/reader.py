@@ -1,0 +1,135 @@
+"""Legacy reader decorators + paddle.batch (ref: python/paddle/reader/
+decorator.py — map_readers, shuffle, buffered, compose, chain, firstn,
+cache, xmap_readers; python/paddle/batch.py batch:17).
+
+A "reader" is a zero-arg callable returning an iterable of samples. These
+stay host-side generator plumbing (they were in the reference too); the
+modern path is io.DataLoader, which the docs point to."""
+
+import random as _random
+from itertools import chain as _chain
+from queue import Queue
+from threading import Thread
+
+__all__ = ["batch", "map_readers", "shuffle", "buffered", "compose",
+           "chain", "firstn", "cache", "xmap_readers"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref: python/paddle/batch.py:17 — group samples into lists."""
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def reader_():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+    return reader_
+
+
+def buffered(reader, size):
+    """Prefetch up to ``size`` samples on a thread."""
+    end = object()
+
+    def reader_():
+        q = Queue(maxsize=size)
+
+        def fill():
+            for s in reader():
+                q.put(s)
+            q.put(end)
+
+        t = Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                return
+            yield s
+    return reader_
+
+
+def compose(*readers, check_alignment=True):
+    _end = object()
+
+    def reader():
+        its = [iter(r()) for r in readers]
+        while True:
+            items = [next(it, _end) for it in its]
+            done = [i is _end for i in items]
+            if all(done):
+                return
+            if any(done):
+                # a sentinel (not `is None`) detects the mismatch even
+                # when some reader is exactly one element longer or
+                # legitimately yields None samples
+                if check_alignment:
+                    raise ValueError("readers have different lengths")
+                return
+            out = ()
+            for it in items:
+                out = out + (it if isinstance(it, tuple) else (it,))
+            yield out
+    return reader
+
+
+def chain(*readers):
+    def reader():
+        return _chain(*[r() for r in readers])
+    return reader
+
+
+def firstn(reader, n):
+    def reader_():
+        for i, s in enumerate(reader()):
+            if i >= n:
+                return
+            yield s
+    return reader_
+
+
+def cache(reader):
+    all_data = None
+
+    def reader_():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        yield from all_data
+    return reader_
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool mapped reader (≙ xmap_readers; processes dissolve into
+    threads — the work is numpy, the GIL releases in C)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def reader_():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            yield from pool.map(mapper, reader())
+    return reader_

@@ -1,0 +1,33 @@
+"""Weight-decay regularizers (ref: python/paddle/regularizer.py —
+L1Decay, L2Decay; fluid/regularizer.py append_regularization_ops).
+
+Functional form: a regularizer is ``grad' = grad + d(penalty)/d(param)``,
+applied by the optimizer before the update (the reference appends the
+same ops to the backward program). Pass as ``weight_decay=`` to any
+optimizer; a float keeps meaning plain L2 (the common case)."""
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class _Regularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, grad, param):
+        raise NotImplementedError
+
+
+class L1Decay(_Regularizer):
+    """grad += coeff * sign(param) (≙ L1DecayRegularizer)."""
+
+    def __call__(self, grad, param):
+        return grad + self.coeff * jnp.sign(param)
+
+
+class L2Decay(_Regularizer):
+    """grad += coeff * param (≙ L2DecayRegularizer)."""
+
+    def __call__(self, grad, param):
+        return grad + self.coeff * param

@@ -54,6 +54,10 @@ class functional:
         For a batched COO (ndim > 2) every leading sparse dim joins the
         segment id, so rows in different batches normalize separately."""
         from paddle_tpu import sparse as S
+        if axis not in (-1,):
+            raise NotImplementedError(
+                "sparse softmax supports axis=-1 only (the stored-pattern "
+                "row direction)")
         coo = _coo(x)
         # segment id = flattened index over ALL dims but the softmaxed one
         seg = coo.indices[0] * 0

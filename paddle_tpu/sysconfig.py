@@ -1,0 +1,17 @@
+"""ref: python/paddle/sysconfig.py — get_include/get_lib for building
+extensions against the framework. Here: the package dir (headers are the
+jax/XLA ones; the native runtime ships prebuilt in paddle_tpu/native)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")

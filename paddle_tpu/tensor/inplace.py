@@ -74,8 +74,9 @@ def array_read(array, i):
 
 
 def array_length(array):
-    """ref: array.py array_length:118."""
-    return jnp.asarray(len(array), jnp.int64 if False else jnp.int32)
+    """ref: array.py array_length:118 (int32: the reference's int64 is
+    unavailable with jax x64 disabled)."""
+    return jnp.asarray(len(array), jnp.int32)
 
 
 def set_printoptions(precision=None, threshold=None, edgeitems=None,

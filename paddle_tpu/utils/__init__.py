@@ -1,0 +1,123 @@
+"""paddle.utils (ref: python/paddle/utils/__init__.py — deprecated
+decorator, try_import, unique_name, run_check, download; cpp_extension).
+
+cpp_extension maps to the in-tree native build (paddle_tpu/native builds
+libptnative.so with g++ directly — no setuptools dance needed for the
+framework's own runtime); download is gated for the zero-egress
+environment."""
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["deprecated", "try_import", "unique_name", "run_check",
+           "download", "require_version"]
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """(≙ utils/deprecated.py) warn once per call site."""
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """(≙ utils/lazy_import.py try_import)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required: {e}") from e
+
+
+class _UniqueNameGenerator:
+    """(≙ utils/unique_name.py): generate('fc') -> fc_0, fc_1, ..."""
+
+    def __init__(self):
+        self.ids = {}
+        self._prefix = ""
+
+    def generate(self, key="tmp"):
+        i = self.ids.get(key, 0)
+        self.ids[key] = i + 1
+        return f"{self._prefix}{key}_{i}"
+
+    def guard(self, new_prefix=""):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            old_prefix, old_ids = self._prefix, self.ids
+            self._prefix, self.ids = new_prefix, {}
+            try:
+                yield
+            finally:
+                self._prefix, self.ids = old_prefix, old_ids
+        return _guard()
+
+    def switch(self):
+        self.ids = {}
+
+
+unique_name = _UniqueNameGenerator()
+
+
+def run_check():
+    """(≙ utils/install_check.py run_check): one matmul per local device
+    through pjit; prints the verdict."""
+    import jax
+    import jax.numpy as jnp
+    n = len(jax.devices())
+    x = jnp.ones((8 * max(n, 1), 8))
+    out = jax.jit(lambda a: a @ a.T)(x)
+    assert float(out[0, 0]) == 8.0
+    print(f"paddle_tpu is installed successfully! "
+          f"{n} {jax.default_backend()} device(s) available.")
+    return True
+
+
+def download(url, path=None, md5sum=None):
+    """(≙ utils/download.py get_path_from_url). This environment has no
+    egress; only file:// URLs and existing local paths resolve."""
+    import os
+    import shutil
+    if url.startswith("file://"):
+        src = url[len("file://"):]
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            shutil.copy(src, path)
+            return path
+        return src
+    if os.path.exists(url):
+        return url
+    raise RuntimeError(
+        f"download({url!r}): network egress is unavailable; place the "
+        "file locally and pass its path")
+
+
+def require_version(min_version, max_version=None):
+    """(≙ utils/__init__.py require_version) against paddle_tpu.version."""
+    from paddle_tpu.version import full_version
+
+    def as_tuple(v):
+        return tuple(int(p) for p in str(v).split(".")[:3])
+    cur = as_tuple(full_version)
+    if as_tuple(min_version) > cur:
+        raise RuntimeError(
+            f"requires version >= {min_version}, got {full_version}")
+    if max_version is not None and as_tuple(max_version) < cur:
+        raise RuntimeError(
+            f"requires version <= {max_version}, got {full_version}")
+    return True

@@ -1,1 +1,3 @@
 __version__ = "0.1.0"
+full_version = __version__
+major, minor, patch = __version__.split(".")

@@ -1,0 +1,245 @@
+"""Top-level API-parity modules: device, reader/batch, legacy dataset,
+utils, sysconfig, regularizer, distribution transforms, geometric
+reindex/sampling (ref modules of the same names; reindex example is the
+reference docstring's own)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+from paddle_tpu import geometric as G
+
+
+class TestDevice:
+    def test_queries(self):
+        assert pt.device.is_compiled_with_tpu()
+        assert not pt.device.is_compiled_with_cuda()
+        assert pt.device.device_count() >= 1
+        assert ":" in pt.device.get_device()
+        assert "cpu" in pt.device.get_all_device_type()
+        pt.device.synchronize()
+
+    def test_set_device_errors_on_unknown(self):
+        with pytest.raises(ValueError):
+            pt.device.set_device("npu")
+
+    def test_event_stream_api(self):
+        e1, e2 = pt.device.cuda.Event(), pt.device.cuda.Event()
+        e1.record()
+        e2.record()
+        assert e1.elapsed_time(e2) >= 0
+        s = pt.device.cuda.current_stream()
+        s.synchronize()
+        s.record_event()
+        assert pt.device.cuda.memory_allocated() >= 0
+        pt.device.cuda.empty_cache()
+
+
+class TestReader:
+    def test_batch_and_decorators(self):
+        b = pt.batch(lambda: iter(range(10)), 3)
+        sizes = [len(x) for x in b()]
+        assert sizes == [3, 3, 3, 1]
+        b2 = pt.batch(lambda: iter(range(10)), 3, drop_last=True)
+        assert [len(x) for x in b2()] == [3, 3, 3]
+        assert list(pt.reader.firstn(lambda: iter(range(9)), 4)()) \
+            == [0, 1, 2, 3]
+        assert sorted(pt.reader.shuffle(lambda: iter(range(6)), 3)()) \
+            == list(range(6))
+        assert list(pt.reader.chain(lambda: iter([1]),
+                                    lambda: iter([2]))()) == [1, 2]
+        assert list(pt.reader.buffered(lambda: iter([1, 2, 3]), 2)()) \
+            == [1, 2, 3]
+        got = list(pt.reader.xmap_readers(lambda v: v + 1,
+                                          lambda: iter([1, 2]), 2, 2)())
+        assert got == [2, 3]
+
+
+class TestLegacyDataset:
+    def test_schemas(self):
+        x, y = next(pt.dataset.uci_housing.train()())
+        assert x.shape == (13,) and y.shape == (1,)
+        img, label = next(pt.dataset.cifar.train()())
+        assert img.shape == (3072,) and 0 <= label < 10
+        img, label = next(pt.dataset.cifar.test100()())
+        assert 0 <= label < 100
+        words, lab = next(pt.dataset.imdb.train()())
+        assert isinstance(words, list) and lab in (0, 1)
+        gram = next(pt.dataset.imikolov.train()())
+        assert len(gram) == 5
+        rec = next(pt.dataset.movielens.train()())
+        assert len(rec) == 7
+        src, tin, tout = next(pt.dataset.wmt16.train()())
+        assert len(tin) == len(tout)
+        img, seg = next(pt.dataset.voc2012.train()())
+        assert seg.shape == (32, 32)
+
+    def test_composes_with_reader(self):
+        b = pt.batch(pt.dataset.uci_housing.train(), 32)
+        first = next(b())
+        assert len(first) == 32
+
+    def test_deterministic(self):
+        a = list(pt.dataset.uci_housing.test()())
+        b = list(pt.dataset.uci_housing.test()())
+        np.testing.assert_array_equal(a[0][0], b[0][0])
+
+
+class TestUtils:
+    def test_unique_name_and_guard(self):
+        with pt.utils.unique_name.guard("g_"):
+            assert pt.utils.unique_name.generate("w") == "g_w_0"
+            assert pt.utils.unique_name.generate("w") == "g_w_1"
+
+    def test_deprecated_warns(self):
+        @pt.utils.deprecated(update_to="new_fn", since="2.0")
+        def old_fn():
+            return 7
+        with pytest.warns(DeprecationWarning):
+            assert old_fn() == 7
+
+    def test_try_import(self):
+        assert pt.utils.try_import("math").sqrt(4) == 2
+        with pytest.raises(ImportError):
+            pt.utils.try_import("definitely_not_a_module_xyz")
+
+    def test_require_version_and_sysconfig(self):
+        assert pt.utils.require_version("0.0.1")
+        with pytest.raises(RuntimeError):
+            pt.utils.require_version("99.0")
+        assert pt.sysconfig.get_lib().endswith("native")
+
+    def test_download_gated(self):
+        with pytest.raises(RuntimeError):
+            pt.utils.download("https://example.com/x.tgz")
+
+
+class TestRegularizer:
+    def test_l1_l2_in_optimizer(self):
+        from paddle_tpu import optimizer as optim, regularizer
+        params = {"w": jnp.asarray([2.0, -2.0])}
+        opt = optim.SGD(learning_rate=1.0,
+                        weight_decay=regularizer.L1Decay(0.5))
+        new_p, _ = opt.update({"w": jnp.zeros(2)}, opt.init(params), params)
+        np.testing.assert_allclose(new_p["w"], [1.5, -1.5])
+        opt2 = optim.SGD(learning_rate=1.0,
+                         weight_decay=regularizer.L2Decay(0.1))
+        new_p2, _ = opt2.update({"w": jnp.zeros(2)}, opt2.init(params),
+                                params)
+        np.testing.assert_allclose(new_p2["w"], [1.8, -1.8])
+
+
+class TestDistributionTransforms:
+    def test_exp_transform_equals_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.ExpTransform()])
+        y = jnp.asarray([0.5, 1.0, 2.0])
+        np.testing.assert_allclose(td.log_prob(y),
+                                   D.LogNormal(0.0, 1.0).log_prob(y),
+                                   atol=1e-5)
+
+    def test_chain_and_affine(self):
+        t = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                              D.TanhTransform()])
+        x = jnp.asarray([0.1, -0.3])
+        np.testing.assert_allclose(t.inverse(t.forward(x)), x, atol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        sb = D.StickBreakingTransform()
+        x = jnp.asarray([[0.4, -1.0, 0.2]])
+        y = sb.forward(x)
+        np.testing.assert_allclose(np.asarray(y).sum(-1), 1.0, atol=1e-6)
+        np.testing.assert_allclose(sb.inverse(y), x, atol=1e-4)
+
+    def test_independent_sums_event_dims(self):
+        ind = D.Independent(D.Normal(jnp.zeros(3), jnp.ones(3)), 1)
+        lp = ind.log_prob(jnp.zeros((5, 3)))
+        assert lp.shape == (5,)
+        np.testing.assert_allclose(
+            lp, 3 * D.Normal(0.0, 1.0).log_prob(jnp.zeros(())), atol=1e-5)
+
+    def test_sigmoid_power_logdet(self):
+        for t in (D.SigmoidTransform(), D.PowerTransform(2.0),
+                  D.ExpTransform()):
+            x = jnp.asarray([0.5, 1.5])
+            import jax
+            num = jnp.log(jnp.abs(jax.vmap(jax.grad(
+                lambda v: t.forward(v)))(x)))
+            np.testing.assert_allclose(t.forward_log_det_jacobian(x), num,
+                                       atol=1e-4)
+
+
+class TestGeometric:
+    def test_reindex_reference_example(self):
+        src, dst, out = G.reindex_graph(
+            np.array([0, 1, 2]), np.array([8, 9, 0, 4, 7, 6, 7]),
+            np.array([2, 3, 2]))
+        np.testing.assert_array_equal(src, [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(dst, [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(out, [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_sample_neighbors_csc(self):
+        row = np.array([1, 2, 0, 0, 1])
+        colptr = np.array([0, 2, 3, 5])
+        nb, cnt = G.sample_neighbors(row, colptr, np.array([0]),
+                                     sample_size=-1)
+        np.testing.assert_array_equal(nb, [1, 2])
+        nb, cnt, eids = G.sample_neighbors(
+            row, colptr, np.array([2]), sample_size=1,
+            eids=np.arange(5), return_eids=True)
+        assert len(nb) == 1 and int(eids[0]) in (3, 4)
+
+    def test_heter_reindex_shares_numbering(self):
+        srcs, dsts, out = G.reindex_heter_graph(
+            np.array([0, 1]), [np.array([5, 6]), np.array([6, 7])],
+            [np.array([1, 1]), np.array([1, 1])])
+        # node 6 appears in both edge types → same renumbered id
+        assert int(srcs[0][1]) == int(srcs[1][0])
+        assert len(out) == 5
+
+
+class TestReviewRegressions:
+    def test_adamw_with_regularizer_object(self):
+        from paddle_tpu import optimizer as optim, regularizer
+        params = {"w": jnp.asarray([2.0, -2.0])}
+        opt = optim.AdamW(learning_rate=0.0,
+                          weight_decay=regularizer.L2Decay(0.5))
+        st = opt.init(params)
+        new_p, _ = opt.update({"w": jnp.zeros(2)}, st, params)
+        # lr=0 → adam update is 0, decay term too (decoupled scales by lr)
+        np.testing.assert_allclose(new_p["w"], [2.0, -2.0])
+        opt2 = optim.AdamW(learning_rate=1.0, beta1=0.0, beta2=0.0,
+                           weight_decay=regularizer.L2Decay(0.25))
+        new_p2, _ = opt2.update({"w": jnp.zeros(2)}, opt2.init(params),
+                                params)
+        # zero grads → pure decoupled decay: p - lr*coeff*p
+        np.testing.assert_allclose(new_p2["w"], [1.5, -1.5], atol=1e-6)
+
+    def test_compose_detects_mismatch_both_orders(self):
+        long_r = lambda: iter([1, 2, 3])  # noqa: E731
+        short_r = lambda: iter([10, 20])  # noqa: E731
+        for a, b in ((long_r, short_r), (short_r, long_r)):
+            with pytest.raises(ValueError):
+                list(pt.reader.compose(a, b)())
+        ok = list(pt.reader.compose(short_r, short_r)())
+        assert ok == [(10, 10), (20, 20)]
+        # None is a legal sample, not an end marker
+        none_r = lambda: iter([None, None])  # noqa: E731
+        assert len(list(pt.reader.compose(none_r, short_r)())) == 2
+
+    def test_sample_neighbors_empty_nodes_with_eids(self):
+        row = np.array([1, 2, 0])
+        colptr = np.array([0, 2, 3, 3])
+        nb, cnt, eids = G.sample_neighbors(
+            row, colptr, np.array([], np.int32), eids=np.arange(3),
+            return_eids=True)
+        assert len(nb) == 0 and len(cnt) == 0 and len(eids) == 0
+
+    def test_sparse_softmax_rejects_other_axis(self):
+        from paddle_tpu import sparse as S
+        x = S.sparse_coo_tensor(np.array([[0, 1], [0, 1]]),
+                                np.ones(2, np.float32), (2, 2))
+        with pytest.raises(NotImplementedError):
+            S.nn.functional.softmax(x, axis=0)
