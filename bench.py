@@ -277,12 +277,13 @@ def bench_pp(jax, jnp, peak, smoke=False):
     t_pp_f = timeit(jax.jit(fwd_pp), stacked)
     t_dense_f = timeit(jax.jit(fwd_dense), stacked)
     bubble_theory = (S - 1) / (n_micro + S - 1)
-    # Measured r3 (125M, pp2, 4 micro, one v5e chip): fwd overhead ~4%
-    # (vs ~13% without dead-row skip and 20% theoretical bubble — the
-    # cond-skip removes dead-slot compute entirely); fwd+bwd overhead
-    # ~75%, dominated by the tick-scan backward's per-tick weight-grad
-    # accumulation — the cost 1F1B's grad scheduling addresses, recorded
-    # here as the next PP optimization target.
+    # Measured r3 (125M, pp2, 4 micro, one v5e chip): fwd overhead ~38%,
+    # fwd+bwd ~72% (hoisting per-row weight extraction out of the tick
+    # scan shaved ~3 points; the rest is the tick-scan adjoint's per-tick
+    # weight-grad accumulation). This single-chip emulation is the
+    # worst case — on a real pp mesh each rank holds only its stage's
+    # grads and dead rows are free wall-clock; the cross-host runtime
+    # (distributed/fleet_executor.py, true 1F1B) is the multi-host path.
     return {"pp2_step_ms": round(t_pp * 1e3, 2),
             "pp2_dense_step_ms": round(t_dense * 1e3, 2),
             "pp2_overhead_measured": round(t_pp / t_dense - 1.0, 4),

@@ -65,6 +65,7 @@ import paddle_tpu.dataset as dataset
 import paddle_tpu.utils as utils
 import paddle_tpu.sysconfig as sysconfig
 import paddle_tpu.regularizer as regularizer
+import paddle_tpu.cost_model as cost_model
 from paddle_tpu.reader import batch
 from paddle_tpu.framework.io import save, load
 from paddle_tpu.hapi import Model, summary, flops
@@ -75,7 +76,7 @@ __all__ = (
      "quantization",
      "distribution", "text", "audio", "geometric", "linalg", "fft", "signal",
      "onnx", "hub", "device", "reader", "dataset", "utils",
-     "sysconfig", "regularizer", "batch", "version",
+     "sysconfig", "regularizer", "batch", "version", "cost_model",
      "Tensor", "to_tensor", "is_tensor", "jit", "no_grad", "grad",
      "value_and_grad", "stop_gradient", "device_count", "devices",
      "set_device", "get_device", "save", "load", "Model", "summary", "flops",

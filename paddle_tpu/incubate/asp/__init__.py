@@ -7,7 +7,8 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["calculate_density", "create_mask", "check_mask_2d",
-           "prune_model"]
+           "prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers"]
 
 
 def calculate_density(x):
@@ -35,7 +36,77 @@ def prune_model(model, n=2, m=4, mask_algo="mask_2d_best", with_mask=True):
     """Apply 2:4 masks to all 2-D+ params of a Module."""
     new_state = {}
     for name, p in model.state_dict().items():
-        if hasattr(p, "ndim") and p.ndim >= 2:
+        if _prunable(name, p):
             mask = create_mask(p, mask_algo, n, m)
             new_state[name] = jnp.asarray(p) * mask
     return model.merge_params(new_state)
+
+
+_EXCLUDED = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """ref: sparsity set_excluded_layers — params whose masks ASP must not
+    touch (embeddings, heads)."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(name, p):
+    # exact name or dotted-path prefix — substring matching would make
+    # excluding 'fc1' also exclude 'fc10.weight'
+    excluded = any(name == ex or name.startswith(ex + ".")
+                   for ex in _EXCLUDED)
+    return hasattr(p, "ndim") and p.ndim >= 2 and not excluded
+
+
+class OptimizerWithSparsityGuarantee:
+    """ref: asp ASPHelper.decorate → OptimizerWithSparsityGuarantee —
+    re-applies the 2:4 masks after every optimizer update so training
+    cannot regrow pruned weights. Masks are captured from the params of
+    the FIRST update (run prune_model first) and stay fixed."""
+
+    def __init__(self, optimizer, n=2, m=4):
+        self._inner = optimizer
+        self._n, self._m = n, m
+        self._masks = None
+
+    def __getattr__(self, name):  # delegate the full optimizer surface
+        return getattr(self._inner, name)
+
+    def _ensure_masks(self, params):
+        if self._masks is None:
+            self._masks = {
+                name: create_mask(p, n=self._n, m=self._m)
+                if _prunable(name, p) else None
+                for name, p in params.items()}
+
+    def _apply_masks(self, params):
+        return {name: (p * self._masks[name]
+                       if self._masks.get(name) is not None else p)
+                for name, p in params.items()}
+
+    def init(self, params):
+        return self._inner.init(params)
+
+    def update(self, grads, state, params):
+        self._ensure_masks(params)
+        new_params, new_state = self._inner.update(grads, state, params)
+        return self._apply_masks(new_params), new_state
+
+    def step(self, grads):
+        self._inner._ensure_bound()
+        self._ensure_masks(self._inner._params)
+        new_p = self._inner.step(grads)
+        masked = self._apply_masks(new_p)
+        self._inner._params = masked
+        return masked
+
+
+def decorate(optimizer, n=2, m=4):
+    """ref: asp decorate — wrap an optimizer with the sparsity
+    guarantee."""
+    return OptimizerWithSparsityGuarantee(optimizer, n=n, m=m)

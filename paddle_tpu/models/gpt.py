@@ -876,6 +876,16 @@ def pipelined_apply(stacked_blocks, x_mb, n_stages: int,
         stage_fn = jax.checkpoint(stage_fn)
 
     vstage = jax.vmap(stage_fn)
+    # per-row block trees are extracted ONCE, outside the tick scan: each
+    # row's weights then enter the scan as its own constant, so the
+    # backward accumulates dW_r directly across ticks. Indexing inside the
+    # tick instead would make every tick's adjoint materialize a full
+    # (S, ...)-stacked zero buffer per row and scatter dW_r into it — the
+    # dominant cost of the measured single-chip pp2 backward overhead.
+    if skip_dead_rows:
+        row_blocks = [jax.tree_util.tree_map(lambda x, r=r: x[r],
+                                             stacked_blocks)
+                      for r in range(S)]
 
     state = jnp.zeros((S,) + x_mb.shape[1:], x_mb.dtype)
     outputs = jnp.zeros_like(x_mb)
@@ -890,12 +900,10 @@ def pipelined_apply(stacked_blocks, x_mb, n_stages: int,
         if skip_dead_rows:
             rows, aux_rows = [], []
             for r in range(S):
-                blocks_r = jax.tree_util.tree_map(
-                    lambda x, r=r: x[r], stacked_blocks)
                 live_r = ((t - r) >= 0) & ((t - r) < n_micro)
                 h_r, aux_r = lax.cond(
                     live_r,
-                    lambda h, b=blocks_r, mk=layer_mask[r]:
+                    lambda h, b=row_blocks[r], mk=layer_mask[r]:
                         stage_fn(b, h, mk),
                     lambda h: (h, jnp.zeros((), jnp.float32)),
                     state[r])

@@ -5,7 +5,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["nms", "box_coder", "yolo_box", "roi_align", "distribute_fpn_proposals"]
+__all__ = ["nms", "box_coder", "yolo_box", "roi_align",
+           "distribute_fpn_proposals", "roi_pool", "psroi_pool",
+           "matrix_nms", "prior_box", "deform_conv2d", "DeformConv2D",
+           "generate_proposals"]
 
 
 def _iou_matrix(boxes1, boxes2):
@@ -123,12 +126,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     oh, ow = output_size
     n, c, h, w = x.shape
     offset = 0.5 if aligned else 0.0
+    feats = _roi_feats(x, boxes, boxes_num)
 
-    # assume single image (N=1) or boxes_num maps rois → image 0; general
-    # batched variant handled by vmapping over images upstream
-    feat = x[0]
-
-    def one_roi(box):
+    def one_roi(box, feat):
         x0, y0, x1, y1 = box * spatial_scale - offset
         rw = jnp.maximum(x1 - x0, 1e-3)
         rh = jnp.maximum(y1 - y0, 1e-3)
@@ -148,7 +148,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
                 + v10 * wy * (1 - wx) + v11 * wy * wx)
 
-    return jax.vmap(one_roi)(boxes)
+    return jax.vmap(one_roi)(boxes, feats)
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
@@ -167,3 +167,312 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         outs.append(jnp.asarray(rois[lvl == l]))
     counts = [int((lvl == l).sum()) for l in range(min_level, max_level + 1)]
     return outs, jnp.asarray(restore), [jnp.asarray([c]) for c in counts]
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """ref: vision/ops.py roi_pool:1685 — max-pooled ROI bins (the
+    pre-align Fast-RCNN pooling; quantized bin edges)."""
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    feats = _roi_feats(x, boxes, boxes_num)
+
+    def one_roi(box, feat):
+        x0 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+        y0 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+        x1 = jnp.maximum(jnp.round(box[2] * spatial_scale).astype(
+            jnp.int32), x0 + 1)
+        y1 = jnp.maximum(jnp.round(box[3] * spatial_scale).astype(
+            jnp.int32), y0 + 1)
+        # static-shape trick: sample a dense grid inside each bin and take
+        # the max of gathered values (bins are data-dependent; a dense
+        # bilinear-free gather keeps shapes static under jit)
+        samples = 4
+        ys = y0 + ((jnp.arange(oh * samples) + 0.5)
+                   * (y1 - y0) / (oh * samples))
+        xs = x0 + ((jnp.arange(ow * samples) + 0.5)
+                   * (x1 - x0) / (ow * samples))
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        vals = feat[:, yi[:, None], xi[None, :]]  # (C, oh*s, ow*s)
+        vals = vals.reshape(c, oh, samples, ow, samples)
+        return jnp.max(vals, axis=(2, 4))
+
+    return jax.vmap(one_roi)(boxes, feats)
+
+
+def _roi_feats(x, boxes, boxes_num):
+    """Per-ROI feature maps honoring ``boxes_num`` (ROIs r of image i for
+    the i-th entry). Without boxes_num a single-image batch is required —
+    silently pooling every ROI from image 0 would be a wrong-answer trap."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    if boxes_num is None:
+        if n != 1:
+            raise ValueError(
+                "batched input needs boxes_num to map ROIs to images")
+        img_idx = np.zeros(boxes.shape[0], np.int32)
+    else:
+        counts = np.asarray(jax.device_get(jnp.asarray(boxes_num))
+                            ).reshape(-1)
+        img_idx = np.repeat(np.arange(len(counts)), counts)
+    return x[jnp.asarray(img_idx)]  # (R, C, H, W)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """ref: vision/ops.py psroi_pool:1553 — position-sensitive ROI pool
+    (R-FCN). Reference channel layout is CHANNEL-major: input channel
+    (k*pooled_h + i)*pooled_w + j feeds (out_channel k, bin (i, j))."""
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    assert c % (oh * ow) == 0, "channels must divide output_size^2"
+    co = c // (oh * ow)
+    feats = _roi_feats(x, boxes, boxes_num)
+
+    def one_roi(box, feat_flat):
+        feat = feat_flat.reshape(co, oh, ow, h, w)
+        x0, y0, x1, y1 = box * spatial_scale
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        samples = 4
+        ys = y0 + (jnp.arange(oh * samples) + 0.5) * rh / (oh * samples)
+        xs = x0 + (jnp.arange(ow * samples) + 0.5) * rw / (ow * samples)
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        # (co, oh, ow, oh*s, ow*s) gathered → bin (i, j) pools its own
+        # sample window from channel block (i, j)
+        vals = feat[:, :, :, yi[:, None], xi[None, :]]
+        vals = vals.reshape(co, oh, ow, oh, samples, ow, samples)
+        idx_i = jnp.arange(oh)
+        idx_j = jnp.arange(ow)
+        # non-contiguous advanced indices move to the FRONT:
+        # picked is (oh, ow, co, samples, samples)
+        picked = vals[:, idx_i[:, None], idx_j[None, :],
+                      idx_i[:, None], :, idx_j[None, :], :]
+        return jnp.transpose(jnp.mean(picked, axis=(-2, -1)), (2, 0, 1))
+
+    return jax.vmap(one_roi)(boxes, feats)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """ref: vision/ops.py matrix_nms:2430 (SOLOv2) — parallel soft-NMS:
+    every box's score is decayed by its IoU with all higher-scored boxes,
+    no sequential suppression loop. O(n^2) matrix math → TPU-friendly.
+    Single-image (1, C, M) inputs; returns (out (K, 6), index, rois_num)."""
+    boxes = jnp.asarray(bboxes)[0]      # (M, 4)
+    scr = jnp.asarray(scores)[0]        # (C, M)
+    c, m = scr.shape
+    keep_mask = scr > score_threshold
+    if background_label >= 0 and c > 1:
+        # the background class never competes for detection slots
+        keep_mask = keep_mask & (jnp.arange(c)[:, None] != background_label)
+    flat_scores = jnp.where(keep_mask, scr, 0.0).reshape(-1)
+    top = min(nms_top_k, c * m)
+    order = jnp.argsort(-flat_scores)[:top]
+    sel_cls = order // m
+    sel_box = order % m
+    sel_scores = flat_scores[order]
+    sel_boxes = boxes[sel_box]
+    iou = _iou_matrix(sel_boxes, sel_boxes)
+    same_cls = sel_cls[:, None] == sel_cls[None, :]
+    upper = jnp.triu(jnp.ones((top, top), bool), k=1)  # j decays i iff j<i
+    decay_iou = jnp.where(same_cls & upper.T, iou, 0.0)
+    # compensation: how suppressed the DECAYER j itself already is —
+    # comp[j] = max IoU of j with its own higher-scored boxes, broadcast
+    # along each row's j axis (SOLOv2 eq. 5)
+    comp_iou = jnp.max(decay_iou, axis=1)[None, :]
+    if use_gaussian:
+        decay = jnp.exp(-(decay_iou ** 2 - comp_iou ** 2) / gaussian_sigma)
+    else:
+        decay = (1.0 - decay_iou) / jnp.maximum(1.0 - comp_iou, 1e-9)
+    decay = jnp.where(same_cls & upper.T, decay, 1.0)
+    final = sel_scores * jnp.min(decay, axis=1)
+    keep = final > post_threshold
+    order2 = jnp.argsort(-jnp.where(keep, final, -1.0))[:keep_top_k]
+    out = jnp.concatenate(
+        [sel_cls[order2][:, None].astype(boxes.dtype),
+         final[order2][:, None], sel_boxes[order2]], axis=1)
+    n_kept = jnp.sum(keep.astype(jnp.int32))
+    return out, sel_box[order2], jnp.asarray([n_kept])
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # noqa: A002
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    """ref: vision/ops.py prior_box:485 (SSD anchors)."""
+    feat_h, feat_w = jnp.asarray(input).shape[2:4]
+    img_h, img_w = jnp.asarray(image).shape[2:4]
+    step_h = steps[1] or img_h / feat_h
+    step_w = steps[0] or img_w / feat_w
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    sizes = []
+    for ms in min_sizes:
+        for a in ars:
+            sizes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+    if max_sizes:
+        for ms, mx in zip(min_sizes, max_sizes):
+            sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    sizes = np.asarray(sizes, np.float32)  # (A, 2) w,h
+    cy = (np.arange(feat_h) + offset) * step_h
+    cx = (np.arange(feat_w) + offset) * step_w
+    cxg, cyg = np.meshgrid(cx, cy)
+    a = len(sizes)
+    boxes = np.zeros((feat_h, feat_w, a, 4), np.float32)
+    boxes[..., 0] = (cxg[..., None] - sizes[:, 0] / 2) / img_w
+    boxes[..., 1] = (cyg[..., None] - sizes[:, 1] / 2) / img_h
+    boxes[..., 2] = (cxg[..., None] + sizes[:, 0] / 2) / img_w
+    boxes[..., 3] = (cyg[..., None] + sizes[:, 1] / 2) / img_h
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return jnp.asarray(boxes), jnp.asarray(var)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """ref: vision/ops.py deform_conv2d:858 (DCNv1/v2 kernels) — sampling
+    positions shifted by learned offsets, bilinear-gathered then reduced
+    with the kernel weights. Gather + einsum: MXU-friendly, no custom op."""
+    x = jnp.asarray(x)
+    offset = jnp.asarray(offset)
+    weight = jnp.asarray(weight)  # (Cout, Cin/groups, kh, kw)
+    n, c, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    assert groups == 1 and deformable_groups == 1, \
+        "deform_conv2d: groups/deformable_groups > 1 not supported"
+
+    # base sampling grid (oh, ow, kh, kw)
+    base_y = (jnp.arange(oh) * s[0] - p[0])[:, None, None, None] \
+        + (jnp.arange(kh) * d[0])[None, None, :, None]
+    base_x = (jnp.arange(ow) * s[1] - p[1])[None, :, None, None] \
+        + (jnp.arange(kw) * d[1])[None, None, None, :]
+    off = offset.reshape(n, kh, kw, 2, oh, ow)
+    dy = jnp.transpose(off[:, :, :, 0], (0, 3, 4, 1, 2))
+    dx = jnp.transpose(off[:, :, :, 1], (0, 3, 4, 1, 2))
+    sy = base_y[None] + dy  # (N, oh, ow, kh, kw)
+    sx = base_x[None] + dx
+
+    y0 = jnp.floor(sy)
+    x0 = jnp.floor(sx)
+    wy = sy - y0
+    wx = sx - x0
+
+    def gather(img, yi, xi):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        xc = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        vals = img[:, yc, xc]  # (C, oh, ow, kh, kw)
+        return jnp.where(valid[None], vals, 0.0)
+
+    def one_image(img, y0, x0, wy, wx, m):
+        v00 = gather(img, y0, x0)
+        v01 = gather(img, y0, x0 + 1)
+        v10 = gather(img, y0 + 1, x0)
+        v11 = gather(img, y0 + 1, x0 + 1)
+        sampled = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                   + v10 * wy * (1 - wx) + v11 * wy * wx)
+        if m is not None:
+            sampled = sampled * m[None]
+        # (C, oh, ow, kh, kw) × (Cout, C, kh, kw) → (Cout, oh, ow)
+        return jnp.einsum("cyxhw,ochw->oyx", sampled, weight[None, :, :, :]
+                          .reshape(cout, c, kh, kw))
+
+    if mask is None:
+        out = jax.vmap(lambda img, a, b, cc, dd: one_image(
+            img, a, b, cc, dd, None))(x, y0, x0, wy, wx)
+    else:
+        masks = jnp.asarray(mask).reshape(n, kh, kw, oh, ow).transpose(
+            0, 3, 4, 1, 2)
+        out = jax.vmap(one_image)(x, y0, x0, wy, wx, masks)
+    if bias is not None:
+        out = out + jnp.asarray(bias)[None, :, None, None]
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False):
+    """ref: vision/ops.py generate_proposals:2241 (RPN head): decode
+    deltas on anchors, clip, drop tiny boxes, NMS. Single image.
+    Layouts follow the reference: scores (1, A, H, W), bbox_deltas
+    (1, 4A, H, W), anchors/variances (H, W, A, 4) or flat (H*W*A, 4) in
+    (H, W, A)-major order — everything is flattened to that same order
+    before decoding."""
+    s = jnp.asarray(scores)[0]                       # (A, H, W)
+    a_num, fh, fw = s.shape
+    scr = jnp.transpose(s, (1, 2, 0)).reshape(-1)    # (H, W, A) major
+    deltas = jnp.transpose(
+        jnp.asarray(bbox_deltas)[0].reshape(a_num, 4, fh, fw),
+        (2, 3, 0, 1)).reshape(-1, 4)
+    anc = jnp.asarray(anchors).reshape(-1, 4)
+    var = jnp.asarray(variances).reshape(-1, 4)
+    boxes = box_coder(anc, None, deltas * var,
+                      code_type="decode_center_size")
+    ih, iw = [float(v) for v in np.asarray(jax.device_get(
+        jnp.asarray(img_size))).reshape(-1)[:2]]
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw),
+                       jnp.clip(boxes[:, 1], 0, ih),
+                       jnp.clip(boxes[:, 2], 0, iw),
+                       jnp.clip(boxes[:, 3], 0, ih)], axis=1)
+    ws = boxes[:, 2] - boxes[:, 0]
+    hs = boxes[:, 3] - boxes[:, 1]
+    valid = (ws >= min_size) & (hs >= min_size)
+    scr = jnp.where(valid, scr, -1.0)
+    top = min(pre_nms_top_n, scr.shape[0])
+    order = jnp.argsort(-scr)[:top]
+    keep = nms(boxes[order], iou_threshold=nms_thresh, scores=scr[order],
+               top_k=post_nms_top_n)
+    sel = np.asarray(jax.device_get(order[keep]))
+    # filtered (sub-min_size) boxes are REMOVED, not returned at score -1
+    sel = sel[np.asarray(jax.device_get(valid))[sel]]
+    return boxes[sel], scr[sel], jnp.asarray([len(sel)])
+
+
+from paddle_tpu.nn.module import Module as _Module  # noqa: E402
+from paddle_tpu.nn.module import Parameter as _Parameter  # noqa: E402
+
+
+class DeformConv2D(_Module):
+    """Layer form of deform_conv2d (ref: vision/ops.py DeformConv2D:1096)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if groups != 1 or deformable_groups != 1:
+            raise NotImplementedError(
+                "DeformConv2D: groups/deformable_groups > 1 not supported")
+        k = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        rs = np.random.RandomState(0)
+        bound = float(np.sqrt(1.0 / (in_channels * k[0] * k[1])))
+        self.weight = _Parameter(jnp.asarray(
+            rs.uniform(-bound, bound,
+                       (out_channels, in_channels // groups, *k)),
+            jnp.float32))
+        self.bias = (None if bias_attr is False else _Parameter(
+            jnp.zeros((out_channels,), jnp.float32)))
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             mask=mask)

@@ -243,3 +243,54 @@ class TestReviewRegressions:
                                 np.ones(2, np.float32), (2, 2))
         with pytest.raises(NotImplementedError):
             S.nn.functional.softmax(x, axis=0)
+
+
+class TestASP:
+    def test_decorate_keeps_24_sparsity(self):
+        from paddle_tpu import incubate, optimizer as optim
+        from paddle_tpu import nn
+        net = nn.Linear(8, 8).tag_paths()
+        net = incubate.asp.prune_model(net)
+        params, _ = net.split_params()
+        assert incubate.asp.calculate_density(params["weight"]) <= 0.5 + 1e-6
+        opt = incubate.asp.decorate(optim.SGD(learning_rate=0.1))
+        st = opt.init(params)
+        grads = {k: jnp.ones_like(v) for k, v in params.items()}
+        new_p, st = opt.update(grads, st, params)
+        # mask survives the update: still exactly 2-of-4 per group
+        assert incubate.asp.check_mask_2d(np.asarray(new_p["weight"]) != 0)
+        # bias (1-D) updated freely
+        assert float(np.abs(np.asarray(new_p["bias"])).sum()) > 0
+
+    def test_excluded_layers(self):
+        from paddle_tpu import incubate
+        from paddle_tpu import nn
+        net = nn.Linear(4, 4).tag_paths()
+        incubate.asp.set_excluded_layers(["weight"])
+        try:
+            pruned = incubate.asp.prune_model(net)
+            d = incubate.asp.calculate_density(
+                pruned.split_params()[0]["weight"])
+            assert d == 1.0  # excluded → untouched
+        finally:
+            incubate.asp.reset_excluded_layers()
+
+
+class TestCostModel:
+    def test_static_and_measured(self):
+        import jax
+        from paddle_tpu.cost_model import CostModel
+        cm = CostModel()
+
+        def f(a, b):
+            return a @ b
+
+        x = jnp.ones((256, 256))
+        data = cm.static_cost_data(f, x, x)
+        assert data.get("flops", 0) >= 2 * 256**3 * 0.9
+        t_static = cm.get_static_op_time(f, x, x)
+        assert t_static > 0
+        t_bwd = cm.get_static_op_time(f, x, x, forward=False)
+        assert t_bwd > t_static
+        t_real = cm.profile_measure(f, x, x)
+        assert t_real > 0

@@ -1,0 +1,154 @@
+"""Detection ops vs oracles (ref test pattern: test_roi_pool_op.py,
+test_matrix_nms_op.py, test_deform_conv2d.py — deform conv with zero
+offsets must equal plain conv; matrix-NMS decay checked on a constructed
+overlap case)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.vision import ops as V
+
+
+def test_roi_pool_and_psroi_pool_shapes_and_max():
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 8, 16, 16), jnp.float32)
+    boxes = jnp.asarray([[0., 0., 8., 8.], [4., 4., 12., 12.]])
+    rp = V.roi_pool(x, boxes, None, 2)
+    assert rp.shape == (2, 8, 2, 2)
+    # max-pool property: every pooled value appears in the source window
+    assert float(jnp.max(rp)) <= float(jnp.max(x)) + 1e-6
+    ps = V.psroi_pool(x, boxes, None, 2)  # 8 ch / 4 bins = 2 out channels
+    assert ps.shape == (2, 2, 2, 2)
+
+
+def test_matrix_nms_decays_overlaps_only():
+    bb = jnp.asarray([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                     jnp.float32)
+    sc = jnp.asarray([[[0.9, 0.8, 0.7]]], jnp.float32)
+    out, idx, n = V.matrix_nms(bb, sc, score_threshold=0.1, keep_top_k=3)
+    vals = sorted(np.asarray(out[:, 1]))
+    assert abs(vals[-1] - 0.9) < 1e-5      # top box undecayed
+    assert abs(vals[-2] - 0.7) < 1e-3      # non-overlapping box untouched
+    assert vals[0] < 0.45                  # overlapping box decayed hard
+    # gaussian mode also monotone
+    outg, _, _ = V.matrix_nms(bb, sc, score_threshold=0.1, keep_top_k=3,
+                              use_gaussian=True)
+    gv = sorted(np.asarray(outg[:, 1]))
+    assert gv[0] < 0.8
+
+
+def test_deform_conv_zero_offset_equals_conv():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.rand(2, 2, 8, 8), jnp.float32)
+    w = jnp.asarray(rs.rand(3, 2, 3, 3), jnp.float32)
+    off = jnp.zeros((2, 18, 6, 6), jnp.float32)
+    ref = jax.lax.conv_general_dilated(
+        x, w, (1, 1), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(V.deform_conv2d(x, off, w), ref, atol=1e-4)
+    # DCNv2 mask of ones is a no-op; non-zero offsets change the output
+    m1 = jnp.ones((2, 9, 6, 6), jnp.float32)
+    np.testing.assert_allclose(V.deform_conv2d(x, off, w, mask=m1), ref,
+                               atol=1e-4)
+    off2 = jnp.full((2, 18, 6, 6), 0.5, jnp.float32)
+    assert not np.allclose(V.deform_conv2d(x, off2, w), ref)
+
+
+def test_deform_conv_layer_and_grads():
+    layer = V.DeformConv2D(2, 3, 3)
+    x = jnp.asarray(np.random.RandomState(2).rand(1, 2, 8, 8), jnp.float32)
+    off = jnp.zeros((1, 18, 6, 6), jnp.float32)
+    out = layer(x, off)
+    assert out.shape == (1, 3, 6, 6)
+    params, _ = layer.split_params()
+
+    def loss(p):
+        return jnp.sum(layer.merge_params(p)(x, off) ** 2)
+
+    g = jax.grad(loss)(params)
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+    assert float(jnp.sum(jnp.abs(g["weight"]))) > 0
+
+
+def test_prior_box_counts_and_range():
+    pb, pv = V.prior_box(jnp.zeros((1, 3, 4, 4)), jnp.zeros((1, 3, 32, 32)),
+                         min_sizes=[8.0], aspect_ratios=[1.0, 2.0],
+                         flip=True, clip=True)
+    # ars: 1, 2, 1/2 → 3 anchors per cell
+    assert pb.shape == (4, 4, 3, 4) and pv.shape == pb.shape
+    assert float(jnp.min(pb)) >= 0.0 and float(jnp.max(pb)) <= 1.0
+
+
+def test_generate_proposals_filters_and_clips():
+    anchors = jnp.asarray([[0, 0, 10, 10], [5, 5, 15, 15],
+                           [18, 18, 19, 19]], jnp.float32)
+    scores = jnp.asarray([[[[0.9]], [[0.8]], [[0.99]]]], jnp.float32)
+    deltas = jnp.zeros((1, 12, 1, 1), jnp.float32)
+    boxes, scr, n = V.generate_proposals(
+        scores, deltas, jnp.asarray([20., 20.]), anchors,
+        jnp.ones((3, 4)), min_size=2.0)
+    # the tiny 1x1 anchor is filtered despite its top score
+    assert float(jnp.max(boxes)) <= 20.0
+    kept = np.asarray(scr)
+    assert 0.99 not in np.round(kept, 2)
+
+
+def test_psroi_pool_channel_major_layout():
+    """review r3: input channel (k*ph + i)*pw + j → (out k, bin (i,j))."""
+    x = np.zeros((1, 8, 8, 8), np.float32)
+    x[0, 1] = 1.0  # channel 1 = k0, bin (0, 1) under channel-major layout
+    out = V.psroi_pool(jnp.asarray(x), jnp.asarray([[0., 0., 8., 8.]]),
+                       None, 2)
+    o = np.asarray(out[0])  # (co=2, 2, 2)
+    assert o[0, 0, 1] == 1.0
+    assert o.sum() == 1.0
+
+
+def test_generate_proposals_spatial_layout():
+    """review r3: deltas (1, 4A, H, W) must map channel k to component k
+    of the SAME spatial anchor."""
+    h = w = 2
+    anchors = np.zeros((h, w, 1, 4), np.float32)
+    for i in range(h):
+        for j in range(w):
+            anchors[i, j, 0] = [j * 10, i * 10, j * 10 + 4, i * 10 + 4]
+    deltas = np.zeros((1, 4, h, w), np.float32)
+    deltas[0, 1, 1, 0] = 0.5  # dy of the anchor at spatial (1, 0)
+    scores = np.full((1, 1, h, w), 0.5, np.float32)
+    boxes, scr, n = V.generate_proposals(
+        jnp.asarray(scores), jnp.asarray(deltas), jnp.asarray([40., 40.]),
+        jnp.asarray(anchors), jnp.ones((h * w, 4), np.float32),
+        min_size=0.0, nms_thresh=0.99)
+    got = np.asarray(boxes)
+    base = anchors.reshape(-1, 4)
+    # exactly one box moved, and it is the (1,0) anchor, moved in +y
+    moved = np.abs(got - base).sum(1) > 1e-4
+    assert moved.sum() == 1
+    k = int(np.nonzero(moved)[0][0])
+    assert np.allclose(base[k], [0, 10, 4, 14])      # spatial (1,0) anchor
+    assert got[k][1] > base[k][1] and abs(got[k][0] - base[k][0]) < 1e-4
+
+
+def test_matrix_nms_excludes_background():
+    bb = jnp.asarray([[[0, 0, 10, 10], [20, 20, 30, 30]]], jnp.float32)
+    sc = jnp.asarray([[[0.99, 0.98],     # class 0 = background
+                       [0.5, 0.4]]], jnp.float32)
+    out, _, _ = V.matrix_nms(bb, sc, score_threshold=0.1,
+                             background_label=0, keep_top_k=4)
+    kept = np.asarray(out)
+    kept = kept[kept[:, 1] > 0]
+    assert (kept[:, 0] == 1).all()       # only foreground class returned
+
+
+def test_roi_ops_batched_via_boxes_num():
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.rand(2, 4, 8, 8), jnp.float32)
+    boxes = jnp.asarray([[0., 0., 8., 8.], [0., 0., 8., 8.]])
+    # same box on both images must pool DIFFERENT features
+    out = V.roi_align(x, boxes, jnp.asarray([1, 1]), 2)
+    assert not np.allclose(out[0], out[1])
+    outp = V.roi_pool(x, boxes, jnp.asarray([1, 1]), 2)
+    assert not np.allclose(outp[0], outp[1])
+    with pytest.raises(ValueError):
+        V.roi_align(x, boxes, None, 2)
+
