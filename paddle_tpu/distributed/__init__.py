@@ -36,11 +36,12 @@ from paddle_tpu.distributed.recompute import (
     recompute, recompute_sequential, checkpoint_name)
 from paddle_tpu.distributed.fleet_executor import (
     FleetExecutor, rendezvous_endpoints)
+from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed import rpc
 from paddle_tpu.distributed import ps
 from paddle_tpu.native import TCPStore  # ≙ fluid.core.TCPStore (C++)
 
-__all__ = ["FleetExecutor", "rendezvous_endpoints", "rpc", "ps",
+__all__ = ["FleetExecutor", "rendezvous_endpoints", "rpc", "ps", "fleet",
            "env", "mesh", "collective", "init_parallel_env", "spawn", "ProcessContext", "get_rank",
            "get_world_size", "ParallelEnv", "is_initialized", "init_mesh",
            "get_mesh", "get_topology", "HybridTopology", "ReduceOp",

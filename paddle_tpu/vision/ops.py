@@ -126,9 +126,10 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     oh, ow = output_size
     n, c, h, w = x.shape
     offset = 0.5 if aligned else 0.0
-    feats = _roi_feats(x, boxes, boxes_num)
+    img_idx = _roi_img_idx(x, boxes, boxes_num)
 
-    def one_roi(box, feat):
+    def one_roi(box, idx):
+        feat = x[idx]
         x0, y0, x1, y1 = box * spatial_scale - offset
         rw = jnp.maximum(x1 - x0, 1e-3)
         rh = jnp.maximum(y1 - y0, 1e-3)
@@ -148,7 +149,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
                 + v10 * wy * (1 - wx) + v11 * wy * wx)
 
-    return jax.vmap(one_roi)(boxes, feats)
+    return jax.vmap(one_roi)(boxes, img_idx)
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
@@ -171,44 +172,50 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
     """ref: vision/ops.py roi_pool:1685 — max-pooled ROI bins (the
-    pre-align Fast-RCNN pooling; quantized bin edges)."""
+    pre-align Fast-RCNN pooling; quantized bin edges). Exact: every pixel
+    of the quantized ROI is assigned to its bin with a dense in-bin mask
+    and max-reduced — data-dependent bin SIZES with static shapes."""
     x = jnp.asarray(x)
     boxes = jnp.asarray(boxes)
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
     n, c, h, w = x.shape
-    feats = _roi_feats(x, boxes, boxes_num)
+    img_idx = _roi_img_idx(x, boxes, boxes_num)
 
-    def one_roi(box, feat):
+    def one_roi(box, idx):
+        feat = x[idx]
         x0 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
         y0 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
         x1 = jnp.maximum(jnp.round(box[2] * spatial_scale).astype(
             jnp.int32), x0 + 1)
         y1 = jnp.maximum(jnp.round(box[3] * spatial_scale).astype(
             jnp.int32), y0 + 1)
-        # static-shape trick: sample a dense grid inside each bin and take
-        # the max of gathered values (bins are data-dependent; a dense
-        # bilinear-free gather keeps shapes static under jit)
-        samples = 4
-        ys = y0 + ((jnp.arange(oh * samples) + 0.5)
-                   * (y1 - y0) / (oh * samples))
-        xs = x0 + ((jnp.arange(ow * samples) + 0.5)
-                   * (x1 - x0) / (ow * samples))
-        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
-        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
-        vals = feat[:, yi[:, None], xi[None, :]]  # (C, oh*s, ow*s)
-        vals = vals.reshape(c, oh, samples, ow, samples)
-        return jnp.max(vals, axis=(2, 4))
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        # bin id of every pixel (floor((p - p0) * bins / extent))
+        by = ((ys - y0) * oh) // jnp.maximum(y1 - y0, 1)
+        bx = ((xs - x0) * ow) // jnp.maximum(x1 - x0, 1)
+        in_y = (ys >= y0) & (ys < y1)
+        in_x = (xs >= x0) & (xs < x1)
+        # (oh, H) and (ow, W) bin-membership masks
+        my = (by[None, :] == jnp.arange(oh)[:, None]) & in_y[None, :]
+        mx = (bx[None, :] == jnp.arange(ow)[:, None]) & in_x[None, :]
+        mask = my[:, None, :, None] & mx[None, :, None, :]  # (oh,ow,H,W)
+        vals = jnp.where(mask[None], feat[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(-2, -1))  # (C, oh, ow)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
 
-    return jax.vmap(one_roi)(boxes, feats)
+    return jax.vmap(one_roi)(boxes, img_idx)
 
 
-def _roi_feats(x, boxes, boxes_num):
-    """Per-ROI feature maps honoring ``boxes_num`` (ROIs r of image i for
-    the i-th entry). Without boxes_num a single-image batch is required —
-    silently pooling every ROI from image 0 would be a wrong-answer trap."""
-    x = jnp.asarray(x)
+def _roi_img_idx(x, boxes, boxes_num):
+    """Image index per ROI from ``boxes_num`` (ROIs r of image i for the
+    i-th entry). Without boxes_num a single-image batch is required —
+    silently pooling every ROI from image 0 would be a wrong-answer trap.
+    Returns indices, not gathered maps: the per-ROI row gather happens
+    INSIDE the vmapped body so XLA can fuse it with the spatial gather
+    instead of materializing an (R, C, H, W) copy."""
     n = x.shape[0]
     if boxes_num is None:
         if n != 1:
@@ -219,7 +226,7 @@ def _roi_feats(x, boxes, boxes_num):
         counts = np.asarray(jax.device_get(jnp.asarray(boxes_num))
                             ).reshape(-1)
         img_idx = np.repeat(np.arange(len(counts)), counts)
-    return x[jnp.asarray(img_idx)]  # (R, C, H, W)
+    return jnp.asarray(img_idx)
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
@@ -234,10 +241,10 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
     n, c, h, w = x.shape
     assert c % (oh * ow) == 0, "channels must divide output_size^2"
     co = c // (oh * ow)
-    feats = _roi_feats(x, boxes, boxes_num)
+    img_idx = _roi_img_idx(x, boxes, boxes_num)
 
-    def one_roi(box, feat_flat):
-        feat = feat_flat.reshape(co, oh, ow, h, w)
+    def one_roi(box, idx):
+        feat = x[idx].reshape(co, oh, ow, h, w)
         x0, y0, x1, y1 = box * spatial_scale
         rw = jnp.maximum(x1 - x0, 0.1)
         rh = jnp.maximum(y1 - y0, 0.1)
@@ -258,7 +265,7 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
                       idx_i[:, None], :, idx_j[None, :], :]
         return jnp.transpose(jnp.mean(picked, axis=(-2, -1)), (2, 0, 1))
 
-    return jax.vmap(one_roi)(boxes, feats)
+    return jax.vmap(one_roi)(boxes, img_idx)
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
@@ -316,13 +323,26 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # 
     ars = list(aspect_ratios)
     if flip:
         ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    # reference per-cell anchor ORDER (prior_box kernel): for each
+    # min_size: the ar=1 min box, then [max box if
+    # min_max_aspect_ratios_order] interleaved with the other-ar boxes —
+    # an SSD head trained against the reference decodes by position, so
+    # the order is part of the contract
     sizes = []
-    for ms in min_sizes:
-        for a in ars:
-            sizes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
-    if max_sizes:
-        for ms, mx in zip(min_sizes, max_sizes):
-            sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    for i, ms in enumerate(min_sizes):
+        sizes.append((float(ms), float(ms)))  # ar = 1 first
+        rest = [(ms * np.sqrt(a), ms / np.sqrt(a)) for a in ars if a != 1.0]
+        mx_box = None
+        if max_sizes:
+            mx = max_sizes[i]
+            mx_box = (np.sqrt(ms * mx), np.sqrt(ms * mx))
+        if min_max_aspect_ratios_order and mx_box is not None:
+            sizes.append(mx_box)
+            sizes.extend(rest)
+        else:
+            sizes.extend(rest)
+            if mx_box is not None:
+                sizes.append(mx_box)
     sizes = np.asarray(sizes, np.float32)  # (A, 2) w,h
     cy = (np.arange(feat_h) + offset) * step_h
     cx = (np.arange(feat_w) + offset) * step_w
@@ -391,8 +411,7 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         if m is not None:
             sampled = sampled * m[None]
         # (C, oh, ow, kh, kw) × (Cout, C, kh, kw) → (Cout, oh, ow)
-        return jnp.einsum("cyxhw,ochw->oyx", sampled, weight[None, :, :, :]
-                          .reshape(cout, c, kh, kw))
+        return jnp.einsum("cyxhw,ochw->oyx", sampled, weight)
 
     if mask is None:
         out = jax.vmap(lambda img, a, b, cc, dd: one_image(
@@ -428,12 +447,13 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                       code_type="decode_center_size")
     ih, iw = [float(v) for v in np.asarray(jax.device_get(
         jnp.asarray(img_size))).reshape(-1)[:2]]
-    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw),
-                       jnp.clip(boxes[:, 1], 0, ih),
-                       jnp.clip(boxes[:, 2], 0, iw),
-                       jnp.clip(boxes[:, 3], 0, ih)], axis=1)
-    ws = boxes[:, 2] - boxes[:, 0]
-    hs = boxes[:, 3] - boxes[:, 1]
+    off = 1.0 if pixel_offset else 0.0
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - off),
+                       jnp.clip(boxes[:, 1], 0, ih - off),
+                       jnp.clip(boxes[:, 2], 0, iw - off),
+                       jnp.clip(boxes[:, 3], 0, ih - off)], axis=1)
+    ws = boxes[:, 2] - boxes[:, 0] + off
+    hs = boxes[:, 3] - boxes[:, 1] + off
     valid = (ws >= min_size) & (hs >= min_size)
     scr = jnp.where(valid, scr, -1.0)
     top = min(pre_nms_top_n, scr.shape[0])

@@ -1,0 +1,105 @@
+"""fleet namespace: init from DistributedStrategy → distributed_model →
+distributed_optimizer train step on the 8-device mesh (ref:
+test_fleet_base.py / test_fleet_hybrid_* pattern)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 64)
+        self.fc2 = nn.Linear(64, 8)
+
+    def forward(self, x):
+        return self.fc2(jax.nn.relu(self.fc1(x)))
+
+
+def test_fleet_init_strategy_and_hybrid_group():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 1, "sharding_degree": 2}
+    topo = fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_model_parallel_world_size() == 2
+    # dp world in the ZeRO sense: dp x sharding replicas of the params
+    assert hcg.get_data_parallel_world_size() == 4
+    assert hcg.get_sharding_parallel_world_size() == 2
+    assert fleet.worker_num() >= 1 and fleet.is_first_worker()
+
+
+def test_fleet_dp_minus_one_absorbs_remainder():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 4}
+    topo = fleet.init(strategy=strategy)
+    assert topo.get_data_parallel_world_size() == 2  # 8 devices / tp4
+
+
+def test_fleet_distributed_model_and_optimizer():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "sharding_degree": 2}
+    fleet.init(strategy=strategy)
+    net = fleet.distributed_model(Net().tag_paths())
+    params, _ = net.split_params()
+    # a plain MLP (no repeated blocks) gets ZeRO-style fsdp sharding from
+    # the structural planner; tp engages on transformer-shaped models
+    assert any("fsdp" in str(p.sharding.spec) for p in params.values())
+
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.AdamW(learning_rate=1e-2), strategy)
+    state = opt.init(params)
+    x = jnp.asarray(np.random.RandomState(0).normal(size=(8, 16)),
+                    jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 8, (8,)), jnp.int32)
+
+    @jax.jit
+    def step(params, state):
+        def loss_fn(p):
+            return nn.functional.cross_entropy(net.merge_params(p)(x), y)
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        p2, s2 = opt.update(g, state, params)
+        return p2, s2, loss
+
+    losses = []
+    for _ in range(4):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_gradient_merge():
+    fleet.init(strategy=fleet.DistributedStrategy())
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2}
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.SGD(learning_rate=1.0), strategy)
+    params = {"w": jnp.asarray([1.0])}
+    state = opt.init(params)
+    p1, state = opt.update({"w": jnp.asarray([0.5])}, state, params)
+    np.testing.assert_allclose(p1["w"], [1.0])  # accumulated, no step
+    p2, state = opt.update({"w": jnp.asarray([1.5])}, state, p1)
+    np.testing.assert_allclose(p2["w"], [0.0])  # stepped with mean grad 1.0
+
+
+def test_fleet_gradient_merge_bound_step():
+    """review r3: the paddle-style bound step() must honor merge too."""
+    fleet.init(strategy=fleet.DistributedStrategy())
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2}
+    inner = pt.optimizer.SGD(learning_rate=1.0,
+                             parameters={"w": jnp.asarray([1.0])})
+    opt = fleet.distributed_optimizer(inner, strategy)
+    p1 = opt.step({"w": jnp.asarray([0.5])})
+    np.testing.assert_allclose(p1["w"], [1.0])   # accumulated only
+    p2 = opt.step({"w": jnp.asarray([1.5])})
+    np.testing.assert_allclose(p2["w"], [0.0])   # mean grad 1.0 applied

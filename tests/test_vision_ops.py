@@ -152,3 +152,41 @@ def test_roi_ops_batched_via_boxes_num():
     with pytest.raises(ValueError):
         V.roi_align(x, boxes, None, 2)
 
+
+
+def test_roi_pool_exact_max_large_bins():
+    """review r3: a peak anywhere in a large bin must be found (the
+    4x4-sample approximation missed even coordinates)."""
+    x = np.zeros((1, 1, 16, 16), np.float32)
+    x[0, 0, 2, 2] = 5.0
+    out = V.roi_pool(jnp.asarray(x), jnp.asarray([[0., 0., 16., 16.]]),
+                     None, 2)
+    assert float(out[0, 0, 0, 0]) == 5.0
+
+
+def test_prior_box_reference_order():
+    """review r3: per-cell anchor order is part of the SSD contract."""
+    feat = jnp.zeros((1, 3, 1, 1))
+    img = jnp.zeros((1, 3, 32, 32))
+    pb, _ = V.prior_box(feat, img, min_sizes=[8.0, 16.0],
+                        max_sizes=[16.0, 32.0], aspect_ratios=[1.0, 2.0],
+                        min_max_aspect_ratios_order=True)
+    w = (np.asarray(pb)[0, 0, :, 2] - np.asarray(pb)[0, 0, :, 0]) * 32
+    # per min_size: [min(ar1), max, ar2] → widths 8, sqrt(128), 8*sqrt2,
+    #                                      16, sqrt(512), 16*sqrt2
+    expect = [8, np.sqrt(8 * 16), 8 * np.sqrt(2),
+              16, np.sqrt(16 * 32), 16 * np.sqrt(2)]
+    np.testing.assert_allclose(w, expect, rtol=1e-4)
+
+
+def test_generate_proposals_pixel_offset():
+    anchors = jnp.asarray([[0, 0, 1, 1]], jnp.float32)  # 1x1 box
+    scores = jnp.asarray([[[[0.9]]]], jnp.float32)
+    deltas = jnp.zeros((1, 4, 1, 1), jnp.float32)
+    # w = 1 without offset (< min_size 2) but 2 with pixel_offset
+    _, _, n0 = V.generate_proposals(scores, deltas, jnp.asarray([20., 20.]),
+                                    anchors, jnp.ones((1, 4)), min_size=2.0)
+    _, _, n1 = V.generate_proposals(scores, deltas, jnp.asarray([20., 20.]),
+                                    anchors, jnp.ones((1, 4)), min_size=2.0,
+                                    pixel_offset=True)
+    assert int(n0[0]) == 0 and int(n1[0]) == 1
