@@ -17,7 +17,7 @@ attribute object.
 
 from typing import Optional
 
-__all__ = ["DistributedStrategy", "init", "distributed_model",
+__all__ = ["DistributedStrategy", "init", "distributed_model", "utils",
            "distributed_optimizer", "get_hybrid_communicate_group",
            "worker_index", "worker_num", "is_first_worker", "worker_endpoints",
            "barrier_worker", "stop_worker", "UserDefinedRoleMaker",
@@ -85,12 +85,13 @@ def init(role_maker=None, is_collective=True, strategy=None):
                "sp": hc.get("sep_degree", 1) or 1,
                "ep": hc.get("ep_degree", 1) or 1}
     # reference semantics: dp_degree = -1 (or unset remainder) absorbs the
-    # devices the explicit degrees don't cover
+    # devices the explicit degrees don't cover — including absorbing down
+    # to 1 when the explicit degrees already cover everything
     explicit = 1
     for k, v in degrees.items():
         if k != "dp":
             explicit *= v
-    if degrees["dp"] in (-1, 1) and explicit * max(degrees["dp"], 1) != n:
+    if degrees["dp"] == -1 or (degrees["dp"] == 1 and explicit != n):
         if n % explicit != 0:
             raise ValueError(f"device count {n} not divisible by "
                              f"non-dp degrees product {explicit}")
@@ -136,35 +137,71 @@ class _FleetOptimizer:
                 "init_loss_scaling"])
         self._merge_k = (strategy.gradient_merge_configs["k_steps"]
                          if strategy.gradient_merge else 1)
-        self._merge_buf = None
-        self._merge_n = 0
+        self._wstate = None
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+    def init(self, params):
+        """Merge state lives IN the state pytree (jnp counter + buffer),
+        not on the wrapper — Python-side counters would be baked in at
+        trace time and silently freeze training under jit."""
+        import jax
+        import jax.numpy as jnp
+        st = {"inner": self._inner.init(params)}
+        if self._merge_k > 1:
+            st["gm_buf"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+            st["gm_n"] = jnp.zeros((), jnp.int32)
+        return st
 
     def step(self, grads):
         """Paddle-style bound step MUST route through this wrapper's
         update() — falling through to the inner step() would silently
         bypass gradient-merge/amp."""
         self._inner._ensure_bound()
-        new_p, new_s = self.update(grads, self._inner._state,
-                                   self._inner._params)
-        self._inner._params, self._inner._state = new_p, new_s
+        if self._wstate is None:
+            import jax
+            import jax.numpy as jnp
+            self._wstate = {"inner": self._inner._state}
+            if self._merge_k > 1:
+                self._wstate["gm_buf"] = jax.tree_util.tree_map(
+                    jnp.zeros_like, self._inner._params)
+                self._wstate["gm_n"] = jnp.zeros((), jnp.int32)
+        new_p, self._wstate = self.update(grads, self._wstate,
+                                          self._inner._params)
+        self._inner._params = new_p
+        self._inner._state = self._wstate["inner"]
         return new_p
 
     def update(self, grads, state, params):
         import jax
-        if self._merge_k > 1:
-            self._merge_buf = grads if self._merge_buf is None else \
-                jax.tree_util.tree_map(lambda a, b: a + b,
-                                       self._merge_buf, grads)
-            self._merge_n += 1
-            if self._merge_n < self._merge_k:
-                return params, state  # accumulate, no step yet
-            grads = jax.tree_util.tree_map(lambda g: g / self._merge_k,
-                                           self._merge_buf)
-            self._merge_buf, self._merge_n = None, 0
-        return self._inner.update(grads, state, params)
+        import jax.numpy as jnp
+        tm = jax.tree_util.tree_map
+        if "inner" not in state:  # tolerate a raw inner-state pytree
+            state = {"inner": state}
+        if self._merge_k > 1 and "gm_buf" not in state:
+            state = dict(state)
+            state["gm_buf"] = tm(jnp.zeros_like, params)
+            state["gm_n"] = jnp.zeros((), jnp.int32)
+        if self._merge_k <= 1:
+            new_p, inner_s = self._inner.update(grads, state["inner"],
+                                                params)
+            out = dict(state)
+            out["inner"] = inner_s
+            return new_p, out
+        # jit-safe k-step merge: compute the would-be update every call
+        # and SELECT it on step boundaries (no Python branch on a tracer)
+        k = self._merge_k
+        buf = tm(lambda b, g: b + g, state["gm_buf"], grads)
+        n = state["gm_n"] + 1
+        do = (n % k) == 0
+        eff = tm(lambda b: b / k, buf)
+        upd_p, upd_s = self._inner.update(eff, state["inner"], params)
+        new_p = tm(lambda a, b: jnp.where(do, a, b), upd_p, params)
+        new_inner = tm(lambda a, b: jnp.where(do, a, b), upd_s,
+                       state["inner"])
+        new_buf = tm(lambda b: jnp.where(do, jnp.zeros_like(b), b), buf)
+        return new_p, {"inner": new_inner, "gm_buf": new_buf, "gm_n": n}
 
 
 def distributed_optimizer(optimizer, strategy=None):
@@ -216,3 +253,6 @@ class UserDefinedRoleMaker:
 
 class PaddleCloudRoleMaker(UserDefinedRoleMaker):
     """(≙ role_maker.PaddleCloudRoleMaker) — roles come from PT_* env."""
+
+
+from paddle_tpu.distributed.fleet import utils  # noqa: E402

@@ -1,0 +1,83 @@
+"""fleet.utils (ref: python/paddle/distributed/fleet/utils/fs.py —
+LocalFS/HDFSClient file-system abstraction the PS/elastic stack uses for
+checkpoints; fleet/utils/__init__.py recompute re-export).
+
+LocalFS is complete; HDFSClient requires a hadoop client binary, which
+this environment does not ship — constructing it raises with guidance
+rather than failing on first use."""
+
+import os
+import shutil
+
+from paddle_tpu.distributed.recompute import recompute  # noqa: F401
+
+__all__ = ["LocalFS", "HDFSClient", "recompute"]
+
+
+class LocalFS:
+    """(≙ fs.py LocalFS) — posix-backed implementation of the FS API."""
+
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.replace(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise FileNotFoundError(src)
+        if not overwrite and self.is_exist(dst):
+            raise FileExistsError(dst)
+        os.replace(src, dst)
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, path, exist_ok=True):
+        if not exist_ok and self.is_exist(path):
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+    def cat(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient:
+    """(≙ fs.py HDFSClient) — needs the hadoop CLI, absent here."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **kw):
+        raise RuntimeError(
+            "HDFSClient requires a hadoop client installation; this "
+            "TPU image has none. Use LocalFS (same API) or mount the "
+            "store through a fuse/local path.")

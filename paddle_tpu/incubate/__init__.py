@@ -11,4 +11,5 @@ from paddle_tpu.incubate import asp
 from paddle_tpu.incubate import moe
 from paddle_tpu.incubate.moe import MoELayer
 
-__all__ = ["nn", "asp", "moe", "MoELayer"]
+__all__ = ["nn", "asp", "moe", "MoELayer", "optimizer"]
+from paddle_tpu.incubate import optimizer  # noqa: E402

@@ -1,0 +1,86 @@
+"""incubate.optimizer (ref: python/paddle/incubate/optimizer/ —
+lookahead.py LookAhead:25, modelaverage.py ModelAverage;
+distributed_fused_lamb is CUDA-only fusion, dissolved into the plain
+optimizer + GSPMD).
+
+Functional design like the core optimizers: state is an explicit pytree
+through ``update``, so both compose with jit/pjit and checkpointing."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """slow/fast two-timescale wrapper (≙ lookahead.py:25):
+    every k inner steps, slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "slow": jax.tree_util.tree_map(jnp.asarray, params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params):
+        fast, inner_state = self.inner.update(grads, state["inner"], params)
+        step = state["step"] + 1
+        sync = (step % self.k) == 0
+
+        def blend(slow, f):
+            new_slow = jnp.where(sync, slow + self.alpha * (f - slow), slow)
+            new_fast = jnp.where(sync, new_slow, f)
+            return new_slow, new_fast
+
+        pairs = jax.tree_util.tree_map(blend, state["slow"], fast)
+        is_pair = (lambda x: isinstance(x, tuple) and len(x) == 2
+                   and isinstance(x[0], jax.Array))
+        new_slow = jax.tree_util.tree_map(lambda p: p[0], pairs,
+                                          is_leaf=is_pair)
+        new_fast = jax.tree_util.tree_map(lambda p: p[1], pairs,
+                                          is_leaf=is_pair)
+        return new_fast, {"inner": inner_state, "slow": new_slow,
+                          "step": step}
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class ModelAverage:
+    """Parameter averaging over a sliding window (≙ modelaverage.py):
+    accumulate parameter sums each step; ``apply`` swaps in the average
+    for evaluation, ``restore`` hands back the live weights."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=2, max_average_window=10000):
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+
+    def init(self, params):
+        return {"sum": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "n": jnp.zeros((), jnp.int32)}
+
+    def accumulate(self, state, params):
+        n = state["n"] + 1
+        # sliding window: once past max_average_window, restart the sum
+        # from the current params (≙ the reference's sum_1/2/3 rotation)
+        reset = n > self.max_w
+
+        def acc(s, p):
+            return jnp.where(reset, p, s + p)
+
+        new_sum = jax.tree_util.tree_map(acc, state["sum"], params)
+        return {"sum": new_sum, "n": jnp.where(reset, 1, n)}
+
+    def apply(self, state, params):
+        """Averaged params for eval (live params returned by restore)."""
+        n = jnp.maximum(state["n"], 1).astype(jnp.float32)
+        return jax.tree_util.tree_map(lambda s: s / n, state["sum"])
+
+    def restore(self, params):
+        return params

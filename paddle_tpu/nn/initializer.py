@@ -12,7 +12,7 @@ from paddle_tpu import random as pt_random
 
 __all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
            "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-           "Assign", "Orthogonal", "calculate_gain", "set_global_initializer"]
+           "Assign", "Orthogonal", "calculate_gain", "set_global_initializer", "Bilinear", "Dirac"]
 
 
 def _fans(shape):
@@ -170,3 +170,41 @@ def default_weight_init():
 
 def default_bias_init():
     return _global_bias_init or Constant(0.0)
+
+
+class Bilinear(Initializer):
+    """ref: nn/initializer/Bilinear — upsampling-kernel init for
+    (Cout, Cin, kh, kw) transposed-conv weights."""
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        import numpy as np
+        assert len(shape) == 4, "Bilinear expects a 4-D conv weight"
+        _, _, kh, kw = shape
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        cy = (kh - 1) / 2.0
+        cx = (kw - 1) / 2.0
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] - cy) / fh) * (1 - abs(og[1] - cx) / fw))
+        # reference BilinearInitializer tiles the filter into EVERY
+        # (out, in) channel pair, not just the diagonal
+        w = np.broadcast_to(filt, shape).astype(np.float32)
+        return jnp.asarray(w, dtype)
+
+
+class Dirac(Initializer):
+    """ref: nn/initializer/dirac.py:28 — identity-preserving conv init:
+    channel i passes through at the kernel center."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        import numpy as np
+        assert len(shape) >= 3, "Dirac expects a conv weight (3-D+)"
+        w = np.zeros(shape, np.float32)
+        out_per_g = shape[0] // self.groups
+        center = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(min(out_per_g, shape[1])):
+                w[(g * out_per_g + i, i) + center] = 1.0
+        return jnp.asarray(w, dtype)

@@ -193,14 +193,24 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
             jnp.int32), y0 + 1)
         ys = jnp.arange(h)
         xs = jnp.arange(w)
-        # bin id of every pixel (floor((p - p0) * bins / extent))
-        by = ((ys - y0) * oh) // jnp.maximum(y1 - y0, 1)
-        bx = ((xs - x0) * ow) // jnp.maximum(x1 - x0, 1)
+        # reference bin ranges OVERLAP: bin i spans
+        # [floor(i*extent/bins), ceil((i+1)*extent/bins)) relative to the
+        # ROI origin — boundary pixels belong to both neighbors
+        hh = jnp.maximum(y1 - y0, 1).astype(jnp.float32)
+        ww = jnp.maximum(x1 - x0, 1).astype(jnp.float32)
+        i = jnp.arange(oh, dtype=jnp.float32)
+        j = jnp.arange(ow, dtype=jnp.float32)
+        y_lo = y0 + jnp.floor(i * hh / oh).astype(jnp.int32)
+        y_hi = y0 + jnp.ceil((i + 1) * hh / oh).astype(jnp.int32)
+        x_lo = x0 + jnp.floor(j * ww / ow).astype(jnp.int32)
+        x_hi = x0 + jnp.ceil((j + 1) * ww / ow).astype(jnp.int32)
         in_y = (ys >= y0) & (ys < y1)
         in_x = (xs >= x0) & (xs < x1)
         # (oh, H) and (ow, W) bin-membership masks
-        my = (by[None, :] == jnp.arange(oh)[:, None]) & in_y[None, :]
-        mx = (bx[None, :] == jnp.arange(ow)[:, None]) & in_x[None, :]
+        my = ((ys[None, :] >= y_lo[:, None])
+              & (ys[None, :] < y_hi[:, None]) & in_y[None, :])
+        mx = ((xs[None, :] >= x_lo[:, None])
+              & (xs[None, :] < x_hi[:, None]) & in_x[None, :])
         mask = my[:, None, :, None] & mx[None, :, None, :]  # (oh,ow,H,W)
         vals = jnp.where(mask[None], feat[:, None, None, :, :], -jnp.inf)
         out = jnp.max(vals, axis=(-2, -1))  # (C, oh, ow)
@@ -320,9 +330,13 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # 
     img_h, img_w = jnp.asarray(image).shape[2:4]
     step_h = steps[1] or img_h / feat_h
     step_w = steps[0] or img_w / feat_w
-    ars = list(aspect_ratios)
-    if flip:
-        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    # ≙ ExpandAspectRatios: each ratio immediately followed by its
+    # reciprocal when flip — the per-cell anchor order is positional
+    ars = []
+    for a in aspect_ratios:
+        ars.append(a)
+        if flip and a != 1.0:
+            ars.append(1.0 / a)
     # reference per-cell anchor ORDER (prior_box kernel): for each
     # min_size: the ar=1 min box, then [max box if
     # min_max_aspect_ratios_order] interleaved with the other-ar boxes —

@@ -294,3 +294,39 @@ class TestCostModel:
         assert t_bwd > t_static
         t_real = cm.profile_measure(f, x, x)
         assert t_real > 0
+
+
+class TestIncubateOptimizer:
+    def test_lookahead_sync_every_k(self):
+        from paddle_tpu import optimizer as optim
+        from paddle_tpu.incubate.optimizer import LookAhead
+        la = LookAhead(optim.SGD(learning_rate=1.0), alpha=0.5, k=2)
+        params = {"w": jnp.asarray([0.0])}
+        st = la.init(params)
+        g = {"w": jnp.asarray([-1.0])}        # fast moves +1 per step
+        p1, st = la.update(g, st, params)     # fast=1, no sync
+        np.testing.assert_allclose(p1["w"], [1.0])
+        p2, st = la.update(g, st, p1)         # fast=2 → sync: slow=1, fast=1
+        np.testing.assert_allclose(p2["w"], [1.0])
+        np.testing.assert_allclose(st["slow"]["w"], [1.0])
+
+    def test_model_average(self):
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        ma = ModelAverage()
+        params = {"w": jnp.asarray([1.0])}
+        st = ma.init(params)
+        for v in (1.0, 2.0, 3.0):
+            st = ma.accumulate(st, {"w": jnp.asarray([v])})
+        avg = ma.apply(st, params)
+        np.testing.assert_allclose(avg["w"], [2.0])
+
+    def test_new_initializers(self):
+        from paddle_tpu.nn import initializer as I
+        w = I.Dirac()((4, 4, 3, 3))
+        # identity-preserving: center tap of channel i→i is 1
+        assert float(w[0, 0, 1, 1]) == 1.0 and float(jnp.sum(w)) == 4.0
+        b = I.Bilinear()((2, 2, 4, 4))
+        assert float(jnp.max(b)) <= 1.0 and float(jnp.sum(b)) > 0
+        import math
+        assert abs(I.calculate_gain("relu") - math.sqrt(2)) < 1e-9
+        assert I.calculate_gain("tanh") == 5.0 / 3.0

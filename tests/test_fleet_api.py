@@ -103,3 +103,46 @@ def test_fleet_gradient_merge_bound_step():
     np.testing.assert_allclose(p1["w"], [1.0])   # accumulated only
     p2 = opt.step({"w": jnp.asarray([1.5])})
     np.testing.assert_allclose(p2["w"], [0.0])   # mean grad 1.0 applied
+
+
+def test_fleet_utils_localfs(tmp_path):
+    fs = fleet.utils.LocalFS()
+    d = str(tmp_path / "ckpt")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    fs.touch(d + "/a.txt")
+    fs.mv(d + "/a.txt", d + "/b.txt")
+    assert fs.is_file(d + "/b.txt")
+    dirs, files = fs.ls_dir(d)
+    assert files == ["b.txt"]
+    assert fs.cat(d + "/b.txt") == b""
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    with pytest.raises(RuntimeError):
+        fleet.utils.HDFSClient()
+
+
+def test_fleet_gradient_merge_under_jit():
+    """review r3: merge state must live in the state pytree — a Python
+    counter would freeze at trace time and silently stop training."""
+    fleet.init(strategy=fleet.DistributedStrategy())
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2}
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.SGD(learning_rate=1.0), strategy)
+    params = {"w": jnp.asarray([0.0])}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s, g):
+        return opt.update({"w": g}, s, p)
+
+    p, state = step(params, state, jnp.asarray([0.5]))
+    np.testing.assert_allclose(p["w"], [0.0])      # accumulate
+    p, state = step(p, state, jnp.asarray([1.5]))
+    np.testing.assert_allclose(p["w"], [-1.0])     # mean 1.0 applied
+    p, state = step(p, state, jnp.asarray([1.0]))
+    np.testing.assert_allclose(p["w"], [-1.0])     # accumulate again
+    p, state = step(p, state, jnp.asarray([3.0]))
+    np.testing.assert_allclose(p["w"], [-3.0])     # mean 2.0 applied

@@ -190,3 +190,26 @@ def test_generate_proposals_pixel_offset():
                                     anchors, jnp.ones((1, 4)), min_size=2.0,
                                     pixel_offset=True)
     assert int(n0[0]) == 0 and int(n1[0]) == 1
+
+
+def test_roi_pool_overlapping_bins():
+    """review r3: reference bins overlap (floor/ceil) — a peak on the
+    shared boundary row must appear in BOTH bins."""
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 2] = 5.0  # ROI rows 0..4, oh=2 → bin0 [0,3), bin1 [2,5)
+    out = V.roi_pool(jnp.asarray(x), jnp.asarray([[0., 0., 5., 5.]]),
+                     None, 2)
+    o = np.asarray(out[0, 0])
+    assert o[0, 0] == 5.0 and o[1, 0] == 5.0
+
+
+def test_prior_box_flip_interleaved():
+    feat = jnp.zeros((1, 3, 1, 1))
+    img = jnp.zeros((1, 3, 32, 32))
+    pb, _ = V.prior_box(feat, img, min_sizes=[8.0],
+                        aspect_ratios=[1.0, 2.0, 3.0], flip=True)
+    w = (np.asarray(pb)[0, 0, :, 2] - np.asarray(pb)[0, 0, :, 0]) * 32
+    # order: ar1, ar2, ar1/2, ar3, ar1/3 (each ratio then its reciprocal)
+    expect = [8, 8 * np.sqrt(2), 8 / np.sqrt(2),
+              8 * np.sqrt(3), 8 / np.sqrt(3)]
+    np.testing.assert_allclose(w, expect, rtol=1e-4)
