@@ -19,6 +19,7 @@ from paddle_tpu.distributed.mesh import (init_mesh, get_mesh, get_topology,
                                          HybridTopology)
 from paddle_tpu.distributed import collective
 from paddle_tpu.distributed.collective import (
+    Group, new_group, get_group, group_reduce, group_all_gather,
     ReduceOp, all_reduce, all_gather, all_to_all, reduce_scatter, broadcast,
     psum, pmean, pmax, pmin, ppermute, barrier, send_recv_ring)
 from paddle_tpu.distributed.api import (shard_tensor, shard_module,
@@ -47,7 +48,8 @@ __all__ = ["FleetExecutor", "rendezvous_endpoints", "rpc", "ps", "fleet",
            "get_mesh", "get_topology", "HybridTopology", "ReduceOp",
            "all_reduce", "all_gather", "all_to_all", "reduce_scatter",
            "broadcast", "psum", "pmean", "pmax", "pmin", "ppermute",
-           "barrier", "send_recv_ring", "shard_tensor", "shard_module",
+           "barrier", "send_recv_ring", "Group", "new_group", "get_group",
+           "group_reduce", "group_all_gather", "shard_tensor", "shard_module",
            "reshard", "replicate", "ring_attention", "ulysses_attention",
            "sequence_parallel_attention", "group_sharded_parallel",
            "group_sharded_specs", "build_group_sharded_step",

@@ -20,7 +20,8 @@ from jax import lax
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "all_to_all",
            "reduce_scatter", "broadcast", "psum", "pmean", "pmax", "pmin",
            "ppermute", "axis_index", "axis_size", "send_recv_ring",
-           "barrier"]
+           "barrier", "Group", "new_group", "get_group", "group_reduce",
+           "group_all_gather"]
 
 
 class ReduceOp:
@@ -103,3 +104,135 @@ def barrier(axis=None):
     if axis is None:
         import jax.experimental.multihost_utils as mhu
         mhu.sync_global_devices("paddle_tpu_barrier")
+
+
+class Group:
+    """Communicator subgroup (≙ paddle.distributed.collective.Group /
+    new_group → ProcessGroup subsets). TPU-native: a subgroup is an
+    ``axis_index_groups`` partition of a mesh axis — the XLA collective
+    then runs independently inside each part, which is exactly what a
+    sub-communicator does."""
+
+    _next_id = 1
+
+    def __init__(self, ranks, axis="dp", index_groups=None):
+        self.ranks = list(ranks)
+        self.axis = axis
+        self.index_groups = index_groups
+        self.id = Group._next_id
+        Group._next_id += 1
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis})"
+
+
+_GROUPS = {}
+
+
+def new_group(ranks=None, backend=None, axis="dp", world=None):
+    """ref: paddle.distributed.new_group (collective.py:340). ``ranks``
+    selects axis indices; the remaining indices are partitioned into
+    equal-size groups when possible (the reference pattern — e.g. tp
+    groups of 2 over 8 ranks) so every collective with
+    ``axis_index_groups`` stays legal, else they form one complement
+    group (psum-class reductions accept uneven parts)."""
+    if world is None:
+        from paddle_tpu.distributed.mesh import get_mesh
+        m = get_mesh()
+        world = dict(m.shape)[axis] if m is not None else len(jax.devices())
+    all_idx = list(range(world))
+    ranks = all_idx if ranks is None else sorted(int(r) for r in ranks)
+    if len(set(ranks)) != len(ranks):
+        raise ValueError(f"new_group: duplicate ranks {ranks}")
+    bad = [r for r in ranks if not 0 <= r < world]
+    if bad:
+        raise ValueError(f"new_group: ranks {bad} out of range "
+                         f"[0, {world})")
+    rest = [i for i in all_idx if i not in ranks]
+    groups = [ranks]
+    if rest:
+        n = len(ranks)
+        if len(rest) % n == 0:
+            groups += [rest[i:i + n] for i in range(0, len(rest), n)]
+        else:
+            groups.append(rest)
+    g = Group(ranks, axis=axis, index_groups=groups)
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid):
+    return _GROUPS.get(gid)
+
+
+def _part_table(group):
+    """part-id per axis index, as a static lookup array."""
+    import numpy as np
+    world = sum(len(p) for p in group.index_groups)
+    table = np.zeros(world, np.int32)
+    for pid, part in enumerate(group.index_groups):
+        for r in part:
+            table[r] = pid
+    return jnp.asarray(table)
+
+
+def group_reduce(x, op=ReduceOp.SUM, group: "Group" = None):
+    """Collective restricted to ``group`` (inside shard_map): ranks in
+    the group reduce among themselves; other ranks reduce within their
+    own partition part. Mechanism: full-axis all_gather + a static
+    part-membership mask (shard_map does not lower axis_index_groups),
+    then a masked reduction — one gather instead of a sub-communicator,
+    which on a TPU mesh is the same ICI traffic class."""
+    if group is None:
+        return all_reduce(x, op=op)
+    table = _part_table(group)
+    my_part = table[lax.axis_index(group.axis)]
+    gathered = lax.all_gather(x, group.axis)  # (world, ...)
+    mask = (table == my_part)
+    mshape = (-1,) + (1,) * (gathered.ndim - 1)
+    m = mask.reshape(mshape)
+    dt = gathered.dtype
+    # dtype-preserving identities (an inf mask would promote ints to f32)
+    if jnp.issubdtype(dt, jnp.integer):
+        lo, hi = jnp.iinfo(dt).min, jnp.iinfo(dt).max
+    else:
+        lo, hi = -jnp.inf, jnp.inf
+    if op == ReduceOp.SUM:
+        return jnp.sum(jnp.where(m, gathered, 0), axis=0)
+    if op == ReduceOp.AVG:
+        return (jnp.sum(jnp.where(m, gathered, 0), axis=0)
+                / jnp.sum(mask))
+    if op == ReduceOp.MAX:
+        return jnp.max(jnp.where(m, gathered, lo), axis=0)
+    if op == ReduceOp.MIN:
+        return jnp.min(jnp.where(m, gathered, hi), axis=0)
+    if op == ReduceOp.PROD:
+        return jnp.prod(jnp.where(m, gathered, jnp.ones((), dt)), axis=0)
+    raise ValueError(f"group_reduce: unsupported op {op}")
+
+
+def group_all_gather(x, group: "Group", tiled_axis=0):
+    """all_gather inside ``group`` — parts must be equal-size (so every
+    rank's result has one static shape)."""
+    sizes = {len(p) for p in group.index_groups}
+    if len(sizes) != 1:
+        raise ValueError("group_all_gather needs equal-size parts; "
+                         f"got {group.index_groups}")
+    import numpy as np
+    table = _part_table(group)
+    my_part = table[lax.axis_index(group.axis)]
+    members = jnp.asarray(np.asarray(group.index_groups, np.int32))
+    gathered = lax.all_gather(x, group.axis)       # (world, ...)
+    rows = gathered[members[my_part]]              # (part_size, ...)
+    part = rows.shape[0]
+    # concatenate the per-member shards along tiled_axis (same contract
+    # as all_gather(..., tiled=True))
+    return jnp.concatenate([rows[i] for i in range(part)],
+                           axis=tiled_axis)

@@ -158,20 +158,38 @@ class _FleetOptimizer:
         """Paddle-style bound step MUST route through this wrapper's
         update() — falling through to the inner step() would silently
         bypass gradient-merge/amp."""
+        import jax
+        import jax.numpy as jnp
         self._inner._ensure_bound()
-        if self._wstate is None:
-            import jax
-            import jax.numpy as jnp
-            self._wstate = {"inner": self._inner._state}
-            if self._merge_k > 1:
-                self._wstate["gm_buf"] = jax.tree_util.tree_map(
-                    jnp.zeros_like, self._inner._params)
-                self._wstate["gm_n"] = jnp.zeros((), jnp.int32)
-        new_p, self._wstate = self.update(grads, self._wstate,
-                                          self._inner._params)
+        if self._wstate is None and self._merge_k > 1:
+            self._wstate = {
+                "gm_buf": jax.tree_util.tree_map(jnp.zeros_like,
+                                                 self._inner._params),
+                "gm_n": jnp.zeros((), jnp.int32)}
+        # the inner optimizer's state is AUTHORITATIVE every step (a
+        # set_state_dict checkpoint restore writes there); only the
+        # merge slots persist on the wrapper
+        st = {"inner": self._inner._state}
+        if self._wstate is not None:
+            st.update(self._wstate)
+        new_p, new_st = self.update(grads, st, self._inner._params)
         self._inner._params = new_p
-        self._inner._state = self._wstate["inner"]
+        self._inner._state = new_st["inner"]
+        if self._merge_k > 1:
+            self._wstate = {"gm_buf": new_st["gm_buf"],
+                            "gm_n": new_st["gm_n"]}
         return new_p
+
+    def state_dict(self):
+        d = self._inner.state_dict()
+        if self._wstate is not None:
+            d["gradient_merge"] = dict(self._wstate)
+        return d
+
+    def set_state_dict(self, d):
+        self._inner.set_state_dict(d)
+        self._wstate = (dict(d["gradient_merge"])
+                        if "gradient_merge" in d else None)
 
     def update(self, grads, state, params):
         import jax

@@ -53,7 +53,13 @@ class LookAhead:
 class ModelAverage:
     """Parameter averaging over a sliding window (≙ modelaverage.py):
     accumulate parameter sums each step; ``apply`` swaps in the average
-    for evaluation, ``restore`` hands back the live weights."""
+    for evaluation, ``restore`` hands back the live weights.
+
+    Window semantics follow the reference: the effective window is
+    ``clip(rate * num_updates, min_average_window, max_average_window)``,
+    realized with the reference's block-rotation trick — a current block
+    plus the previous block, rotated when the current block fills, so
+    ``apply`` always averages over between W and 2W recent steps."""
 
     def __init__(self, average_window_rate=0.15, parameters=None,
                  min_average_window=2, max_average_window=10000):
@@ -62,25 +68,43 @@ class ModelAverage:
         self.max_w = max_average_window
 
     def init(self, params):
-        return {"sum": jax.tree_util.tree_map(jnp.zeros_like, params),
-                "n": jnp.zeros((), jnp.int32)}
+        z = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"sum_cur": z,
+                "sum_prev": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "n_cur": jnp.zeros((), jnp.int32),
+                "n_prev": jnp.zeros((), jnp.int32),
+                "total": jnp.zeros((), jnp.int32)}
+
+    def _window(self, total):
+        w = (self.rate * total.astype(jnp.float32)).astype(jnp.int32)
+        return jnp.clip(w, self.min_w, self.max_w)
 
     def accumulate(self, state, params):
-        n = state["n"] + 1
-        # sliding window: once past max_average_window, restart the sum
-        # from the current params (≙ the reference's sum_1/2/3 rotation)
-        reset = n > self.max_w
+        total = state["total"] + 1
+        w = self._window(total)
+        rotate = state["n_cur"] >= w
 
-        def acc(s, p):
-            return jnp.where(reset, p, s + p)
+        def cur(s, p):
+            return jnp.where(rotate, p, s + p)
 
-        new_sum = jax.tree_util.tree_map(acc, state["sum"], params)
-        return {"sum": new_sum, "n": jnp.where(reset, 1, n)}
+        def prev(sp, sc):
+            return jnp.where(rotate, sc, sp)
+
+        new_prev = jax.tree_util.tree_map(prev, state["sum_prev"],
+                                          state["sum_cur"])
+        new_cur = jax.tree_util.tree_map(cur, state["sum_cur"], params)
+        return {"sum_cur": new_cur, "sum_prev": new_prev,
+                "n_cur": jnp.where(rotate, 1, state["n_cur"] + 1),
+                "n_prev": jnp.where(rotate, state["n_cur"],
+                                    state["n_prev"]),
+                "total": total}
 
     def apply(self, state, params):
         """Averaged params for eval (live params returned by restore)."""
-        n = jnp.maximum(state["n"], 1).astype(jnp.float32)
-        return jax.tree_util.tree_map(lambda s: s / n, state["sum"])
+        n = jnp.maximum(state["n_cur"] + state["n_prev"], 1
+                        ).astype(jnp.float32)
+        return jax.tree_util.tree_map(
+            lambda a, b: (a + b) / n, state["sum_cur"], state["sum_prev"])
 
     def restore(self, params):
         return params

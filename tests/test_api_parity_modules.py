@@ -330,3 +330,16 @@ class TestIncubateOptimizer:
         import math
         assert abs(I.calculate_gain("relu") - math.sqrt(2)) < 1e-9
         assert I.calculate_gain("tanh") == 5.0 / 3.0
+
+    def test_model_average_window_rotation(self):
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        ma = ModelAverage(average_window_rate=1.0, min_average_window=2,
+                          max_average_window=3)
+        params = {"w": jnp.asarray([0.0])}
+        st = ma.init(params)
+        for v in (1.0, 2.0, 3.0, 4.0, 10.0):
+            st = ma.accumulate(st, {"w": jnp.asarray([v])})
+        # window max 3: blocks rotate; average covers recent steps only,
+        # so the early 1.0 has dropped out
+        avg = float(ma.apply(st, params)["w"][0])
+        assert avg > 3.0, avg

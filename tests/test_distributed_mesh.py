@@ -111,3 +111,88 @@ def test_shard_module_rules():
     m = nn.Linear(16, 32)
     m2 = dist.shard_module(m, {r"weight": (None, "tp")})
     assert m2.weight.sharding.spec == P(None, "tp")
+
+
+def test_new_group_subgroup_collectives():
+    """new_group → axis_index_groups: ranks reduce within their part only
+    (≙ paddle.distributed.new_group + group= collectives)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    import paddle_tpu.distributed as dist
+
+    devices = jax.devices()[:8]
+    mesh = Mesh(np.array(devices), ("dp",))
+    g = dist.new_group([0, 1, 2, 3], world=8)
+    assert g.nranks == 4 and g.get_group_rank(2) == 2
+    assert g.get_group_rank(7) == -1
+    assert dist.get_group(g.id) is g
+
+    x = jnp.arange(8.0).reshape(8, 1)
+
+    @jax.jit
+    def f(x):
+        return shard_map(
+            lambda v: dist.group_reduce(v, group=g),
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(x)
+
+    out = np.asarray(f(jax.device_put(
+        x, NamedSharding(mesh, P("dp"))))).reshape(-1)
+    # ranks 0-3 sum to 6, ranks 4-7 (the complement part) sum to 22
+    np.testing.assert_allclose(out[:4], 6.0)
+    np.testing.assert_allclose(out[4:], 22.0)
+
+    @jax.jit
+    def ga(x):
+        return shard_map(
+            lambda v: dist.group_all_gather(v, g),
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp", None))(x)
+
+    gout = np.asarray(ga(jax.device_put(
+        x, NamedSharding(mesh, P("dp")))))
+    # each rank returns its part's (4, 1) rows; P("dp", None) concatenates
+    # the 8 ranks into (32, 1)
+    assert gout.shape == (32, 1)
+    np.testing.assert_allclose(gout[0:4, 0], [0, 1, 2, 3])   # rank 0
+    np.testing.assert_allclose(gout[16:20, 0], [4, 5, 6, 7])  # rank 4
+
+
+def test_group_reduce_dtypes_and_validation():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    import paddle_tpu.distributed as dist
+    import pytest
+
+    with pytest.raises(ValueError):
+        dist.new_group([0, 9], world=8)
+    with pytest.raises(ValueError):
+        dist.new_group([1, 1], world=8)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    g = dist.new_group([0, 1, 2, 3], world=8)
+    x = jnp.arange(1, 9, dtype=jnp.int32).reshape(8, 1)
+
+    @jax.jit
+    def f(x):
+        return shard_map(
+            lambda v: dist.group_reduce(v, op=dist.ReduceOp.MAX, group=g),
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(x)
+
+    out = f(jax.device_put(x, NamedSharding(mesh, P("dp"))))
+    assert out.dtype == jnp.int32          # no float promotion
+    np.testing.assert_array_equal(np.asarray(out).reshape(-1)[:4], 4)
+
+    @jax.jit
+    def fp(x):
+        return shard_map(
+            lambda v: dist.group_reduce(v, op=dist.ReduceOp.PROD, group=g),
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(x)
+
+    pout = np.asarray(fp(jax.device_put(
+        x.astype(jnp.float32), NamedSharding(mesh, P("dp")))))
+    np.testing.assert_allclose(pout.reshape(-1)[:4], 24.0)  # 1*2*3*4

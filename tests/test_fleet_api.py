@@ -146,3 +146,17 @@ def test_fleet_gradient_merge_under_jit():
     np.testing.assert_allclose(p["w"], [-1.0])     # accumulate again
     p, state = step(p, state, jnp.asarray([3.0]))
     np.testing.assert_allclose(p["w"], [-3.0])     # mean 2.0 applied
+
+
+def test_fleet_bound_step_checkpoint_restore():
+    """review r3: set_state_dict between bound steps must be honored."""
+    fleet.init(strategy=fleet.DistributedStrategy())
+    inner = pt.optimizer.SGD(learning_rate=1.0,
+                             parameters={"w": jnp.asarray([1.0])})
+    opt = fleet.distributed_optimizer(inner, fleet.DistributedStrategy())
+    opt.step({"w": jnp.asarray([0.25])})
+    ckpt = opt.state_dict()
+    opt.step({"w": jnp.asarray([0.25])})
+    opt.set_state_dict(ckpt)
+    opt.step({"w": jnp.asarray([0.0])})
+    assert int(opt.state_dict()["state"]["step"]) == 2  # 1 (ckpt) + 1
