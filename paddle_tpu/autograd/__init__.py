@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["grad", "value_and_grad", "vjp", "jvp", "jacobian", "hessian",
-           "PyLayer", "PyLayerContext", "no_grad", "backward"]
+           "PyLayer", "PyLayerContext", "no_grad", "backward",
+           "saved_tensors_hooks"]
 
 grad = jax.grad
 value_and_grad = jax.value_and_grad
@@ -54,10 +55,17 @@ class PyLayerContext:
         self.extra = {}
 
     def save_for_backward(self, *tensors):
+        hooks = saved_tensors_hooks._active
+        if hooks is not None:
+            tensors = tuple(hooks.pack_hook(t) for t in tensors)
+            self._hooks = hooks
         self._saved = tensors
 
     @property
     def saved_tensor(self):
+        hooks = getattr(self, "_hooks", None)
+        if hooks is not None:
+            return tuple(hooks.unpack_hook(t) for t in self._saved)
         return self._saved
 
     saved_tensors = saved_tensor
@@ -82,7 +90,7 @@ class PyLayer:
 
     @classmethod
     def apply(cls, *args, **kwargs):
-        if not hasattr(cls, "_jax_fn"):
+        if "_jax_fn" not in cls.__dict__:
             @jax.custom_vjp
             def fn(*fargs):
                 ctx = PyLayerContext()
@@ -91,10 +99,29 @@ class PyLayer:
             def fwd(*fargs):
                 ctx = PyLayerContext()
                 out = cls.forward(ctx, *fargs)
-                return out, (ctx, fargs)
+                # residuals must be jax types: only the saved ARRAYS cross
+                # the custom_vjp boundary. Static metadata (ctx.extra,
+                # active saved-tensor hooks) rides a per-class LIFO:
+                # backward traces replay in reverse order of the forward
+                # traces within one differentiated function, so pop()
+                # pairs each bwd with ITS OWN application (a single cell
+                # would hand every bwd the last application's metadata).
+                if "_trace_meta" not in cls.__dict__:
+                    import collections
+                    cls._trace_meta = collections.deque(maxlen=64)
+                cls._trace_meta.append((dict(ctx.extra),
+                                        getattr(ctx, "_hooks", None)))
+                return out, (ctx._saved, fargs)
 
             def bwd(res, g):
-                ctx, fargs = res
+                saved, fargs = res
+                ctx = PyLayerContext()
+                ctx._saved = saved
+                meta = cls.__dict__.get("_trace_meta")
+                extra, hooks = (meta.pop() if meta else ({}, None))
+                ctx.extra = dict(extra)
+                if hooks is not None:
+                    ctx._hooks = hooks
                 grads = cls.backward(ctx, g)
                 if not isinstance(grads, tuple):
                     grads = (grads,)
@@ -124,3 +151,30 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         "loss function instead of tensor.backward() "
         "(ref eager Backward, paddle/fluid/eager/backward.cc:393 — replaced "
         "by tracing-based AD).")
+
+
+class saved_tensors_hooks:  # noqa: N801 (reference casing)
+    """ref: paddle.autograd.saved_tensors_hooks (python/paddle/autograd/
+    saved_tensors_hooks.py; C++ eager/saved_tensors_hooks.cc) — a context
+    whose pack/unpack hooks transform activations saved for backward
+    (e.g. offload to host, cast down).
+
+    Scope here: tensors saved through ``PyLayerContext.save_for_backward``
+    (the runtime this framework controls). XLA-managed residuals inside
+    jit are scheduled by the compiler; their memory story is
+    ``jax.checkpoint`` policies (distributed.recompute), not hooks."""
+
+    _active = None
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = saved_tensors_hooks._active
+        saved_tensors_hooks._active = self
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active = self._prev
+        return False
