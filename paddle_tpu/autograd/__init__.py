@@ -48,14 +48,19 @@ def hessian(func, xs, create_graph=False):
 
 
 class PyLayerContext:
-    """ref: paddle.autograd.PyLayerContext — save_for_backward surface."""
+    """ref: paddle.autograd.PyLayerContext — save_for_backward surface.
 
-    def __init__(self):
+    ``apply_hooks=False`` builds the context for the PRIMAL path: pack
+    hooks exist to transform residuals kept for backward, so a plain
+    (undifferentiated) forward must not pay for — or crash on — them."""
+
+    def __init__(self, apply_hooks=True):
         self._saved = ()
         self.extra = {}
+        self._apply_hooks = apply_hooks
 
     def save_for_backward(self, *tensors):
-        hooks = saved_tensors_hooks._active
+        hooks = saved_tensors_hooks._current() if self._apply_hooks else None
         if hooks is not None:
             tensors = tuple(hooks.pack_hook(t) for t in tensors)
             self._hooks = hooks
@@ -65,10 +70,38 @@ class PyLayerContext:
     def saved_tensor(self):
         hooks = getattr(self, "_hooks", None)
         if hooks is not None:
-            return tuple(hooks.unpack_hook(t) for t in self._saved)
+            if not hasattr(self, "_unpacked"):
+                # unpack once — a backward reading saved_tensor several
+                # times must not repeat e.g. a host-to-device transfer
+                self._unpacked = tuple(hooks.unpack_hook(t)
+                                       for t in self._saved)
+            return self._unpacked
         return self._saved
 
     saved_tensors = saved_tensor
+
+
+@jax.tree_util.register_pytree_node_class
+class _PyLayerResidual:
+    """custom_vjp residual wrapper: the saved arrays are pytree children;
+    the application's metadata KEY is static aux data — it survives the
+    residual round-trip untouched by tracing, so each backward finds its
+    own application's (extra, hooks) regardless of pullback order."""
+
+    def __init__(self, saved, meta_id):
+        self.saved = saved
+        self.meta_id = meta_id
+
+    def tree_flatten(self):
+        return (self.saved,), (self.meta_id,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0])
+
+
+import threading as _threading_mod
+_PYLAYER_INIT_LOCK = _threading_mod.Lock()
 
 
 class PyLayer:
@@ -91,55 +124,77 @@ class PyLayer:
     @classmethod
     def apply(cls, *args, **kwargs):
         if "_jax_fn" not in cls.__dict__:
-            @jax.custom_vjp
-            def fn(*fargs):
-                ctx = PyLayerContext()
-                return cls.forward(ctx, *fargs)
-
-            def fwd(*fargs):
-                ctx = PyLayerContext()
-                out = cls.forward(ctx, *fargs)
-                # residuals must be jax types: only the saved ARRAYS cross
-                # the custom_vjp boundary. Static metadata (ctx.extra,
-                # active saved-tensor hooks) rides a per-class LIFO:
-                # backward traces replay in reverse order of the forward
-                # traces within one differentiated function, so pop()
-                # pairs each bwd with ITS OWN application (a single cell
-                # would hand every bwd the last application's metadata).
-                if "_trace_meta" not in cls.__dict__:
-                    import collections
-                    cls._trace_meta = collections.deque(maxlen=64)
-                cls._trace_meta.append((dict(ctx.extra),
-                                        getattr(ctx, "_hooks", None)))
-                return out, (ctx._saved, fargs)
-
-            def bwd(res, g):
-                saved, fargs = res
-                ctx = PyLayerContext()
-                ctx._saved = saved
-                meta = cls.__dict__.get("_trace_meta")
-                extra, hooks = (meta.pop() if meta else ({}, None))
-                ctx.extra = dict(extra)
-                if hooks is not None:
-                    ctx._hooks = hooks
-                grads = cls.backward(ctx, g)
-                if not isinstance(grads, tuple):
-                    grads = (grads,)
-                # pad Nones for non-differentiable args
-                out = []
-                gi = iter(grads)
-                for a in fargs:
-                    try:
-                        out.append(next(gi))
-                    except StopIteration:
-                        out.append(jnp.zeros_like(a))
-                return tuple(
-                    jnp.zeros_like(a) if g is None else g
-                    for g, a in zip(out, fargs))
-
-            fn.defvjp(fwd, bwd)
-            cls._jax_fn = fn
+            with _PYLAYER_INIT_LOCK:
+                if "_jax_fn" not in cls.__dict__:
+                    cls._build()
         return cls._jax_fn(*args, **kwargs)
+
+    @classmethod
+    def _build(cls):
+        import collections
+        import threading
+        cls._meta_map = collections.OrderedDict()
+        cls._meta_seq = 0
+        cls._meta_lock = threading.Lock()
+
+        @jax.custom_vjp
+        def fn(*fargs):
+            # primal-only path: hooks are for backward residuals
+            ctx = PyLayerContext(apply_hooks=False)
+            return cls.forward(ctx, *fargs)
+
+        def fwd(*fargs):
+            ctx = PyLayerContext()
+            out = cls.forward(ctx, *fargs)
+            # residuals must be jax types: only the saved ARRAYS cross
+            # the custom_vjp boundary. Static metadata (ctx.extra, active
+            # hooks) is keyed by a per-application id carried as residual
+            # pytree AUX DATA, so every backward finds its own
+            # application's metadata no matter what order pullbacks run
+            # in — and repeated pullback calls re-read it (get, not pop).
+            with cls._meta_lock:
+                cls._meta_seq += 1
+                mid = cls._meta_seq
+                cls._meta_map[mid] = (dict(ctx.extra),
+                                      getattr(ctx, "_hooks", None))
+                while len(cls._meta_map) > 4096:
+                    cls._meta_map.popitem(last=False)
+            return out, (_PyLayerResidual(ctx._saved, mid), fargs)
+
+        def bwd(res, g):
+            wrapper, fargs = res
+            ctx = PyLayerContext()
+            ctx._saved = wrapper.saved
+            with cls._meta_lock:
+                meta = cls._meta_map.get(wrapper.meta_id)
+            if meta is None:
+                # evicted (>4096 in-flight applications): fail LOUDLY —
+                # a ({}, None) default would compute wrong gradients
+                raise RuntimeError(
+                    f"{cls.__name__}: backward metadata for application "
+                    f"#{wrapper.meta_id} was evicted (more than 4096 "
+                    "in-flight applications of one PyLayer class)")
+            extra, hooks = meta
+            ctx.extra = dict(extra)
+            if hooks is not None:
+                ctx._hooks = hooks
+            grads = cls.backward(ctx, g)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            # pad Nones for non-differentiable args
+            out = []
+            gi = iter(grads)
+            for a in fargs:
+                try:
+                    out.append(next(gi))
+                except StopIteration:
+                    out.append(jnp.zeros_like(a))
+            return tuple(
+                jnp.zeros_like(a) if g is None else g
+                for g, a in zip(out, fargs))
+
+        fn.defvjp(fwd, bwd)
+        cls._jax_fn = fn
 
 
 from paddle_tpu.framework import no_grad  # noqa: E402
@@ -162,19 +217,27 @@ class saved_tensors_hooks:  # noqa: N801 (reference casing)
     Scope here: tensors saved through ``PyLayerContext.save_for_backward``
     (the runtime this framework controls). XLA-managed residuals inside
     jit are scheduled by the compiler; their memory story is
-    ``jax.checkpoint`` policies (distributed.recompute), not hooks."""
+    ``jax.checkpoint`` policies (distributed.recompute), not hooks.
+    The active context is per-THREAD (≙ the reference's thread-local
+    hook stack) so concurrent training threads cannot see each other's
+    hooks."""
 
-    _active = None
+    import threading as _threading
+    _tls = _threading.local()
+
+    @classmethod
+    def _current(cls):
+        return getattr(cls._tls, "active", None)
 
     def __init__(self, pack_hook, unpack_hook):
         self.pack_hook = pack_hook
         self.unpack_hook = unpack_hook
 
     def __enter__(self):
-        self._prev = saved_tensors_hooks._active
-        saved_tensors_hooks._active = self
+        self._prev = saved_tensors_hooks._current()
+        saved_tensors_hooks._tls.active = self
         return self
 
     def __exit__(self, *exc):
-        saved_tensors_hooks._active = self._prev
+        saved_tensors_hooks._tls.active = self._prev
         return False

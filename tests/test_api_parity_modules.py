@@ -426,3 +426,69 @@ class TestSavedTensorsHooks:
 
         g = jax.grad(f)(jnp.asarray([1.0]))
         np.testing.assert_allclose(g, [7.0])
+
+    def test_pylayer_out_of_order_pullbacks(self):
+        """review r3: pullbacks invoked in NON-LIFO order must still pair
+        with their own application's metadata (static-aux residual id)."""
+        import jax
+        from paddle_tpu import autograd
+
+        class Mul(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x, k):
+                ctx.extra["k"] = float(k)
+                return x * k
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * ctx.extra["k"], jnp.zeros(())
+
+        x = jnp.asarray([1.0])
+        _, pb1 = jax.vjp(lambda v: Mul.apply(v, jnp.asarray(2.0)), x)
+        _, pb2 = jax.vjp(lambda v: Mul.apply(v, jnp.asarray(5.0)), x)
+        g1 = pb1(jnp.asarray([1.0]))[0]     # called FIRST-created first
+        g2 = pb2(jnp.asarray([1.0]))[0]
+        np.testing.assert_allclose(g1, [2.0])
+        np.testing.assert_allclose(g2, [5.0])
+
+    def test_pylayer_jit_primal_with_hooks(self):
+        """review r3: pack hooks must not run in the undifferentiated
+        primal path (np.asarray on a tracer would crash jit)."""
+        import jax
+        from paddle_tpu import autograd
+
+        class Sq(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x ** 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor
+                return 2 * x * dy
+
+        with autograd.saved_tensors_hooks(np.asarray, jnp.asarray):
+            out = jax.jit(Sq.apply)(jnp.asarray([3.0]))
+        np.testing.assert_allclose(out, [9.0])
+
+    def test_pylayer_pullback_called_twice(self):
+        """review r3: re-invoking the same pullback must work (metadata
+        is read, not consumed)."""
+        import jax
+        from paddle_tpu import autograd
+
+        class Mul(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x, k):
+                ctx.extra["k"] = float(k)
+                return x * k
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * ctx.extra["k"], jnp.zeros(())
+
+        _, pb = jax.vjp(lambda v: Mul.apply(v, jnp.asarray(3.0)),
+                        jnp.asarray([1.0]))
+        np.testing.assert_allclose(pb(jnp.asarray([1.0]))[0], [3.0])
+        np.testing.assert_allclose(pb(jnp.asarray([2.0]))[0], [6.0])
