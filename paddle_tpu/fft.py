@@ -1,7 +1,12 @@
 """FFT ops (ref: python/paddle/fft.py → phi fft kernels over cuFFT; here
-jnp.fft over XLA's FFT HLO)."""
+jnp.fft over XLA's FFT HLO). The full reference surface — c2c/r2c/c2r in
+1d/2d/nd, hermitian variants, helpers — with numpy.fft as the free oracle,
+registered in the op registry like every other op."""
 
+import numpy as np
 import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
 
 _j = jnp.fft
 
@@ -24,6 +29,106 @@ rfftfreq = _j.rfftfreq
 fftshift = _j.fftshift
 ifftshift = _j.ifftshift
 
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    """ref: python/paddle/fft.py hfft2 — hermitian-input FFT over two axes:
+    c2c FFT on the leading axis, hermitian c2r on the last."""
+    x = jnp.asarray(x)
+    inner = _j.fft(x, n=None if s is None else s[0], axis=axes[0], norm=norm)
+    return _j.hfft(inner, n=None if s is None else s[1], axis=axes[1],
+                   norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    """ref: python/paddle/fft.py ihfft2 — inverse of hfft2 (r2c hermitian on
+    the last axis, c2c inverse on the leading)."""
+    x = jnp.asarray(x)
+    inner = _j.ihfft(x, n=None if s is None else s[1], axis=axes[1],
+                     norm=norm)
+    return _j.ifft(inner, n=None if s is None else s[0], axis=axes[0],
+                   norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward"):
+    """ref: python/paddle/fft.py hfftn — c2c FFT over all but the last given
+    axis, hermitian c2r over the last."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = (tuple(range(x.ndim)) if s is None
+                else tuple(range(-len(s), 0)))
+    lead, last = tuple(axes[:-1]), axes[-1]
+    if lead:
+        x = _j.fftn(x, s=None if s is None else s[:-1], axes=lead, norm=norm)
+    return _j.hfft(x, n=None if s is None else s[-1], axis=last, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    """ref: python/paddle/fft.py ihfftn — inverse of hfftn."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = (tuple(range(x.ndim)) if s is None
+                else tuple(range(-len(s), 0)))
+    lead, last = tuple(axes[:-1]), axes[-1]
+    out = _j.ihfft(x, n=None if s is None else s[-1], axis=last, norm=norm)
+    if lead:
+        out = _j.ifftn(out, s=None if s is None else s[:-1], axes=lead,
+                       norm=norm)
+    return out
+
+
 __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
-           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "fftfreq",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+           "hfft2", "ihfft2", "hfftn", "ihfftn", "fftfreq",
            "rfftfreq", "fftshift", "ifftshift"]
+
+
+# -- registry + oracles ------------------------------------------------------
+# numpy.fft is the oracle for every op (the reference checks its phi fft
+# kernels against scipy/numpy the same way, test_fft.py). Complex-output ops
+# are non-differentiable under the harness (which needs a real scalar loss);
+# the shift helpers are real→real and keep grad coverage.
+
+_R = np.random.RandomState(20260730)
+_xr = _R.randn(4, 6).astype(np.float32)
+_xc = (_R.randn(4, 6) + 1j * _R.randn(4, 6)).astype(np.complex64)
+_xh = (_R.randn(4, 4) + 1j * _R.randn(4, 4)).astype(np.complex64)
+
+
+def _reg(name, fn, np_ref, sample, differentiable=False, jit_ok=True):
+    register_op(name, fn, "fft", np_ref=np_ref,
+                sample_args=lambda s=sample: s,
+                ref="python/paddle/fft.py", differentiable=differentiable,
+                jit_ok=jit_ok)
+
+
+_reg("fft", fft, np.fft.fft, ((_xr,), {}))
+_reg("ifft", ifft, np.fft.ifft, ((_xc,), {}))
+_reg("fft2", fft2, np.fft.fft2, ((_xr,), {}))
+_reg("ifft2", ifft2, np.fft.ifft2, ((_xc,), {}))
+_reg("fftn", fftn, np.fft.fftn, ((_xr,), {}))
+_reg("ifftn", ifftn, np.fft.ifftn, ((_xc,), {}))
+_reg("rfft", rfft, np.fft.rfft, ((_xr,), {}))
+_reg("irfft", irfft, np.fft.irfft, ((_xh,), {}))
+_reg("rfft2", rfft2, np.fft.rfft2, ((_xr,), {}))
+_reg("irfft2", irfft2, np.fft.irfft2, ((_xh,), {}))
+_reg("rfftn", rfftn, np.fft.rfftn, ((_xr,), {}))
+_reg("irfftn", irfftn, np.fft.irfftn, ((_xh,), {}))
+_reg("hfft", hfft, np.fft.hfft, ((_xh,), {}))
+_reg("ihfft", ihfft, np.fft.ihfft, ((_xr,), {}))
+_reg("hfft2", hfft2,
+     lambda x: np.fft.hfft(np.fft.fft(x, axis=-2), axis=-1), ((_xh,), {}))
+_reg("ihfft2", ihfft2,
+     lambda x: np.fft.ifft(np.fft.ihfft(x, axis=-1), axis=-2), ((_xr,), {}))
+_reg("hfftn", hfftn,
+     lambda x: np.fft.hfft(np.fft.fft(x, axis=0), axis=-1), ((_xh,), {}))
+_reg("ihfftn", ihfftn,
+     lambda x: np.fft.ifft(np.fft.ihfft(x, axis=-1), axis=0), ((_xr,), {}))
+# size argument is a static shape, not a tensor — cannot trace under jit
+_reg("fftfreq", fftfreq, lambda n: np.fft.fftfreq(8, 0.5), ((8,), {"d": 0.5}),
+     jit_ok=False)
+_reg("rfftfreq", rfftfreq, lambda n: np.fft.rfftfreq(8, 0.5),
+     ((8,), {"d": 0.5}), jit_ok=False)
+_reg("fftshift", fftshift, np.fft.fftshift, ((_xr,), {}),
+     differentiable=True)
+_reg("ifftshift", ifftshift, np.fft.ifftshift, ((_xr,), {}),
+     differentiable=True)

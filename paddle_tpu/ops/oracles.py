@@ -55,7 +55,14 @@ def _attach(name, np_ref, sample_args_value, test_fn=None,
         spec.jit_ok = jit_ok
 
 
+_ATTACHED = False
+
+
 def attach_all():
+    global _ATTACHED
+    if _ATTACHED:
+        return
+    _ATTACHED = True
     import paddle_tpu.tensor as T
 
     x45 = _f(4, 5)

@@ -39,15 +39,33 @@ _OPS: Dict[str, OpSpec] = {}
 def register_op(name: str, fn: Callable, category: str,
                 np_ref: Optional[Callable] = None,
                 sample_args: Optional[Callable] = None,
-                ref: str = "", differentiable: bool = True) -> Callable:
+                ref: str = "", differentiable: bool = True,
+                test_fn: Optional[Callable] = None,
+                jit_ok: bool = True) -> Callable:
     _OPS[name] = OpSpec(name, fn, category, np_ref, sample_args, ref,
-                        differentiable)
+                        differentiable, test_fn, jit_ok)
     return fn
 
 
+def _ensure_oracles() -> None:
+    """Attach the numpy oracles (ops/oracles.py) on first registry read.
+
+    The oracle table is part of the op registry proper — every op's spec is
+    incomplete without its ``np_ref``/``sample_args`` (ref: op_test.py:333
+    pairs every op with its numpy check) — but it imports the whole Python
+    surface, so it attaches lazily on first introspection rather than at
+    package-import time. attach_all() itself is idempotent.
+    """
+    from paddle_tpu.ops import oracles
+
+    oracles.attach_all()
+
+
 def get_op(name: str) -> OpSpec:
+    _ensure_oracles()
     return _OPS[name]
 
 
 def all_ops() -> List[OpSpec]:
+    _ensure_oracles()
     return list(_OPS.values())

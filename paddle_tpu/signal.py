@@ -86,3 +86,73 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     if length is not None:
         out = out[..., :length]
     return out
+
+
+# -- registry + oracles ------------------------------------------------------
+# Hand-written numpy references (the reference checks stft against librosa,
+# test_signal.py:stft_np; here numpy primitives play that role).
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.ops.registry import register_op  # noqa: E402
+
+
+def _frame_np(x, frame_length, hop_length):
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(num_frames)[:, None])
+    return np.swapaxes(x[..., idx], -1, -2)
+
+
+def _overlap_add_np(x, hop_length):
+    frame_length, num_frames = x.shape[-2], x.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    out = np.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    for i in range(num_frames):
+        out[..., i * hop_length:i * hop_length + frame_length] += x[..., i]
+    return out
+
+
+def _stft_np(x, n_fft, hop_length):
+    x = np.pad(x, [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+               mode="reflect")
+    frames = _frame_np(x, n_fft, hop_length)
+    spec = np.fft.fft(frames, axis=-2)
+    return spec[..., :n_fft // 2 + 1, :]
+
+
+def _istft_np(x, n_fft, hop_length):
+    full = np.concatenate(
+        [x, np.conj(np.flip(x[..., 1:-1, :], axis=-2))], axis=-2)
+    frames = np.fft.ifft(full, axis=-2).real
+    out = _overlap_add_np(frames, hop_length)
+    wsq = _overlap_add_np(
+        np.broadcast_to(np.ones((n_fft, 1)), (n_fft, x.shape[-1])).copy(),
+        hop_length)
+    out = out / np.maximum(wsq, 1e-11)
+    return out[..., n_fft // 2:-(n_fft // 2)]
+
+
+_R = np.random.RandomState(20260731)
+_sig = _R.randn(2, 64).astype(np.float32)
+_spec = (_R.randn(2, 9, 13) + 1j * _R.randn(2, 9, 13)).astype(np.complex64)
+_frames = _R.randn(2, 16, 13).astype(np.float32)
+
+register_op("frame", frame, "fft",
+            np_ref=lambda x: _frame_np(x, 16, 4),
+            sample_args=lambda: ((_sig,), {"frame_length": 16,
+                                           "hop_length": 4}),
+            ref="python/paddle/signal.py:frame", differentiable=True)
+register_op("overlap_add", overlap_add, "fft",
+            np_ref=lambda x: _overlap_add_np(x, 4),
+            sample_args=lambda: ((_frames,), {"hop_length": 4}),
+            ref="python/paddle/signal.py:overlap_add", differentiable=True)
+register_op("stft", stft, "fft",
+            np_ref=lambda x: _stft_np(x, 16, 4),
+            sample_args=lambda: ((_sig,), {"n_fft": 16, "hop_length": 4}),
+            ref="python/paddle/signal.py:stft", differentiable=False)
+register_op("istft", istft, "fft",
+            np_ref=lambda x: _istft_np(x, 16, 4),
+            sample_args=lambda: ((_spec,), {"n_fft": 16, "hop_length": 4}),
+            ref="python/paddle/signal.py:istft", differentiable=False)

@@ -36,7 +36,7 @@ _ALIASES = {
 
 def _register_aliases():
     for name, base in _ALIASES.items():
-        spec = registry.get_op(base)
+        spec = registry._OPS[base]
         alias = OpSpec(name, spec.fn, spec.category, None, None, spec.ref,
                        spec.differentiable, None, spec.jit_ok,
                        alias_of=base)
@@ -105,29 +105,29 @@ register_op("create_array", create_array, "array",
             np_ref=lambda: np.zeros(0),
             sample_args=lambda: ((), {}),
             ref="python/paddle/tensor/array.py:151", differentiable=False)
-registry.get_op("create_array").test_fn = \
+registry._OPS["create_array"].test_fn = \
     lambda: jnp.zeros(len(create_array()))
 register_op("array_write", array_write, "array",
             np_ref=lambda x: np.asarray(x),
             sample_args=lambda: ((np.arange(3.0, dtype=np.float32),), {}),
             ref="python/paddle/tensor/array.py:74", differentiable=False)
-registry.get_op("array_write").test_fn = \
+registry._OPS["array_write"].test_fn = \
     lambda x: array_read(array_write(x, 0), 0)
 register_op("array_read", array_read, "array",
             np_ref=lambda x: np.asarray(x),
             sample_args=lambda: ((np.arange(4.0, dtype=np.float32),), {}),
             ref="python/paddle/tensor/array.py:25", differentiable=False)
-registry.get_op("array_read").test_fn = \
+registry._OPS["array_read"].test_fn = \
     lambda x: array_read(array_write(x, 2), 2)
 register_op("array_length", array_length, "array",
             np_ref=lambda x: np.asarray(3, np.int32),
             sample_args=lambda: ((np.zeros(2, np.float32),), {}),
             ref="python/paddle/tensor/array.py:118", differentiable=False)
-registry.get_op("array_length").test_fn = \
+registry._OPS["array_length"].test_fn = \
     lambda x: array_length(array_write(x, 2))
 register_op("set_printoptions", set_printoptions, "framework",
             np_ref=lambda: np.zeros(0),
             sample_args=lambda: ((), {}),
             ref="python/paddle/tensor/to_string.py", differentiable=False)
-registry.get_op("set_printoptions").test_fn = \
+registry._OPS["set_printoptions"].test_fn = \
     lambda: (set_printoptions(precision=8), jnp.zeros(0))[1]

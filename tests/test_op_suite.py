@@ -35,6 +35,14 @@ def test_every_op_is_tested():
     registered = {op.name for op in all_ops()}
     stale = RANDOM_OPS - registered
     assert not stale, f"RANDOM_OPS not in registry: {stale}"
+    # exact partition: every registered op is an oracle op, a random op,
+    # or an alias of one — no fourth bucket
+    n_alias = sum(1 for op in all_ops() if op.alias_of is not None)
+    n_random = sum(1 for op in all_ops()
+                   if op.name in RANDOM_OPS and op.alias_of is None)
+    assert len(ORACLE_OPS) + n_random + n_alias == len(all_ops())
+    assert len(ORACLE_OPS) >= 294, (
+        f"oracle coverage regressed: {len(ORACLE_OPS)}")
 
 
 @pytest.mark.parametrize("op", ORACLE_OPS, ids=lambda o: o.name)

@@ -1,0 +1,31 @@
+"""Dev helper: report registry oracle coverage on the CPU platform."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu  # noqa: E402,F401
+from paddle_tpu.ops import oracles  # noqa: E402
+
+oracles.attach_all()
+from paddle_tpu.ops.registry import all_ops  # noqa: E402
+
+ops = all_ops()
+have = [o for o in ops if o.np_ref is not None and o.sample_args is not None]
+aliases = [o for o in ops if o.alias_of is not None]
+print("total", len(ops), "have", len(have), "aliases", len(aliases))
+missing = [o.name for o in ops
+           if (o.np_ref is None or o.sample_args is None)
+           and o.alias_of is None]
+print("missing (incl random):", len(missing))
+print(missing)
