@@ -16,10 +16,12 @@ import jax
 
 __all__ = ["CostModel"]
 
-# bf16 peak FLOP/s and HBM GB/s per chip generation (public numbers)
-_PEAKS = {"v6": (918e12, 1640e9), "v5p": (459e12, 2765e9),
-          "v5": (197e12, 819e9), "v4": (275e12, 1228e9),
-          "v3": (123e12, 900e9), "cpu": (1e11, 5e10)}
+# bf16 peak FLOP/s, HBM GB/s, and per-chip ICI GB/s per generation
+# (public numbers; ICI is the aggregate inter-chip bandwidth a collective
+# can ride — the scaling-book's beta term)
+_PEAKS = {"v6": (918e12, 1640e9, 360e9), "v5p": (459e12, 2765e9, 480e9),
+          "v5": (197e12, 819e9, 160e9), "v4": (275e12, 1228e9, 240e9),
+          "v3": (123e12, 900e9, 140e9), "cpu": (1e11, 5e10, 1e10)}
 
 
 def _peak(device):
@@ -35,8 +37,14 @@ class CostModel:
 
     def __init__(self):
         self.device = jax.devices()[0]
-        self.peak_flops, self.peak_bw = _peak(self.device)
+        self.peak_flops, self.peak_bw, self.ici_bw = _peak(self.device)
         self._measured = {}
+
+    def collective_time(self, nbytes: float) -> float:
+        """Seconds to move ``nbytes`` over the chip's ICI links (bandwidth
+        term only; latency is negligible at the message sizes the planner
+        reasons about)."""
+        return float(nbytes) / self.ici_bw
 
     # -- static (analysis-based) costs --------------------------------------
 

@@ -39,7 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["plan_module", "memory_report", "suggest_mesh"]
+__all__ = ["plan_module", "memory_report", "suggest_mesh",
+           "enumerate_plans", "plan_cost", "rank_plans"]
 
 _VOCAB_RATIO = 4       # dim0 >= ratio*dim1 → vocab-like table
 _TINY_OUT = 8          # output dims below this are never sharded
@@ -80,11 +81,13 @@ def _bias_names(wname: str):
     return [o for o in out if o != wname]
 
 
-def plan_module(module, mesh: Optional[Mesh] = None) -> Dict[str, P]:
+def plan_module(module, mesh: Optional[Mesh] = None,
+                mesh_shape: Optional[Dict[str, int]] = None) -> Dict[str, P]:
     """Propose a {param-path: PartitionSpec} plan for an un-annotated
     Module (``shard_module(model, auto=True)`` entry point). When ``mesh``
-    is given, axes that do not divide the mapped dim are dropped from the
-    proposed spec (shard_map-grade divisibility)."""
+    (or a bare ``mesh_shape`` {axis: size} dict — the search path, no
+    devices needed) is given, axes that do not divide the mapped dim are
+    dropped from the proposed spec (shard_map-grade divisibility)."""
     params = list(module.named_parameters())
     names = {n for n, _ in params}
     d_model = _model_dim(params)
@@ -162,10 +165,11 @@ def plan_module(module, mesh: Optional[Mesh] = None) -> Dict[str, P]:
         else:
             plan[name] = P(None)
 
-    if mesh is not None:
-        shape = dict(mesh.shape)
+    if mesh_shape is None and mesh is not None:
+        mesh_shape = dict(mesh.shape)
+    if mesh_shape is not None:
         shapes = dict(params)
-        plan = {n: _prune_indivisible(spec, shapes[n].shape, shape)
+        plan = {n: _prune_indivisible(spec, shapes[n].shape, mesh_shape)
                 for n, spec in plan.items()}
     return plan
 
@@ -186,53 +190,193 @@ def _prune_indivisible(spec: P, shape, mesh_shape) -> P:
     return P(*out)
 
 
-def memory_report(module, mesh: Optional[Mesh] = None,
-                  optimizer: str = "adamw",
-                  moment_bytes: int = 4) -> Dict[str, float]:
-    """Per-device memory estimate for (params + optimizer state) under the
-    proposed plan (≙ auto_parallel/cost/ estimate_cost's memory half).
-    Activations are workload-dependent and excluded — treat the result as
-    the static floor."""
-    plan = plan_module(module, mesh)
-    mesh_shape = dict(mesh.shape) if mesh is not None else {}
-
+def _memory_with_plan(params, plan, degrees: Dict[str, int],
+                      optimizer: str = "adamw",
+                      moment_bytes: int = 4) -> Dict[str, float]:
     def shards(spec):
         n = 1
         for entry in tuple(spec):
             for ax in (entry if isinstance(entry, tuple) else (entry,)):
                 if ax is not None:
-                    n *= mesh_shape.get(ax, 1)
+                    n *= degrees.get(ax, 1)
         return n
 
     total = 0.0
     per_device = 0.0
     n_moments = {"sgd": 0, "momentum": 1}.get(optimizer, 2)
-    for name, v in module.named_parameters():
+    for name, v in params:
         b = v.size * v.dtype.itemsize
         opt_b = v.size * moment_bytes * n_moments
         total += b + opt_b
         per_device += (b + opt_b) / shards(plan.get(name, P()))
     return {"total_bytes": total, "per_device_bytes": per_device,
-            "n_params": sum(v.size for _, v in module.named_parameters())}
+            "n_params": sum(v.size for _, v in params)}
+
+
+def memory_report(module, mesh: Optional[Mesh] = None,
+                  optimizer: str = "adamw",
+                  moment_bytes: int = 4,
+                  degrees: Optional[Dict[str, int]] = None
+                  ) -> Dict[str, float]:
+    """Per-device memory estimate for (params + optimizer state) under the
+    proposed plan (≙ auto_parallel/cost/ estimate_cost's memory half).
+    Activations are workload-dependent and excluded — treat the result as
+    the static floor. Either a live ``mesh`` or a ``degrees`` dict (no
+    devices needed — the search path) supplies the axis sizes."""
+    params = list(module.named_parameters())
+    if degrees is None:
+        degrees = dict(mesh.shape) if mesh is not None else {}
+        plan = plan_module(module, mesh)
+    else:
+        plan = plan_module(module, mesh_shape=degrees)
+    return _memory_with_plan(params, plan, degrees, optimizer, moment_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Plan search (≙ tuner/parallel_tuner.py:35 — enumerate degree assignments,
+# score each with the cost model, return the argmin)
+# ---------------------------------------------------------------------------
+
+def _count_blocks(params) -> int:
+    """Number of repeated blocks (distinct numbered-child prefixes)."""
+    mods = set()
+    for n, _ in params:
+        m = _REPEAT_RE.search("." + n)
+        if m:
+            mods.add(("." + n)[:m.end()])
+    return max(1, len(mods))
+
+
+def _plan_ctx(module):
+    """Candidate-independent inputs of the search, computed once: the
+    param list, the unpruned structural plan, and the model stats."""
+    params = list(module.named_parameters())
+    return {"params": params, "base_plan": plan_module(module),
+            "shapes": dict(params),
+            "p_bytes": sum(v.size * v.dtype.itemsize for _, v in params),
+            "n_blocks": _count_blocks(params),
+            "d_model": _model_dim(params)}
+
+
+def enumerate_plans(n_devices: int, max_tp: int = 8):
+    """Power-of-two (dp, fsdp, tp) factorizations of ``n_devices`` with tp
+    capped (tp beyond one chip's worth of ICI neighbors stops paying).
+    Odd factors of a non-power-of-two device count land on dp — TPU
+    slices are power-of-two shaped, and odd tp/fsdp degrees rarely divide
+    any weight dim anyway."""
+    out = []
+    tp = 1
+    while tp <= min(max_tp, n_devices):
+        if n_devices % tp == 0:
+            rest = n_devices // tp
+            fsdp = 1
+            while fsdp <= rest:
+                if rest % fsdp == 0:
+                    out.append({"dp": rest // fsdp, "fsdp": fsdp, "tp": tp})
+                fsdp *= 2
+        tp *= 2
+    return out
+
+
+def plan_cost(module, degrees: Dict[str, int], hbm_bytes: float = 16e9,
+              budget: float = 0.6, optimizer: str = "adamw",
+              flops_per_step: float = 0.0, tokens_per_step: int = 8192,
+              act_bytes: int = 2, cost_model=None,
+              _ctx=None) -> Dict[str, float]:
+    """Estimated step time + memory feasibility for one degree assignment.
+
+    Cost terms (scaling-book comm recipe, ≙ auto_parallel/cost/
+    estimate_cost.py's comm+memory halves):
+    - compute: flops_per_step spread over all devices at peak
+    - dp: ring all-reduce of the local grad shard, 2(dp-1)/dp
+    - fsdp: param all-gather fwd+bwd + grad reduce-scatter, 3(fsdp-1)/fsdp
+    - tp: 4 activation all-reduces per block (2 fwd + 2 bwd), 2(tp-1)/tp
+    """
+    from paddle_tpu.cost_model import CostModel
+
+    cm = cost_model or CostModel()
+    dp, fsdp, tp = (degrees.get("dp", 1), degrees.get("fsdp", 1),
+                    degrees.get("tp", 1))
+    world = dp * fsdp * tp
+    ctx = _ctx or _plan_ctx(module)
+    pruned = {n: _prune_indivisible(spec, ctx["shapes"][n].shape, degrees)
+              for n, spec in ctx["base_plan"].items()}
+    rep = _memory_with_plan(ctx["params"], pruned, degrees, optimizer)
+    p_bytes = ctx["p_bytes"]
+    n_blocks = ctx["n_blocks"]
+    d_model = ctx["d_model"]
+    # per-device activation bytes of one block's boundary tensor: the batch
+    # dimension splits over BOTH data axes (fsdp is ZeRO data parallelism)
+    act = tokens_per_step / (dp * fsdp) * d_model * act_bytes
+
+    comm = 0.0
+    if dp > 1:
+        comm += 2 * (dp - 1) / dp * p_bytes / (fsdp * tp)
+    if fsdp > 1:
+        comm += 3 * (fsdp - 1) / fsdp * p_bytes / tp
+    if tp > 1:
+        comm += 4 * n_blocks * 2 * (tp - 1) / tp * act
+    compute_t = flops_per_step / (world * cm.peak_flops)
+    time_s = compute_t + cm.collective_time(comm)
+    return {"time_s": time_s, "comm_bytes": comm,
+            "compute_s": compute_t,
+            "per_device_bytes": rep["per_device_bytes"],
+            "feasible": rep["per_device_bytes"] <= budget * hbm_bytes}
+
+
+def rank_plans(module, n_devices: int, hbm_bytes: float = 16e9,
+               max_tp: int = 8, budget: float = 0.6,
+               optimizer: str = "adamw", flops_per_step: float = 0.0,
+               tokens_per_step: int = 8192, measure_fn=None,
+               measure_top_k: int = 3):
+    """Score every candidate degree assignment; return
+    ``[(cost_s, degrees, info), ...]`` best-first with infeasible plans
+    (static memory floor over budget) ranked after all feasible ones.
+
+    ``measure_fn(degrees) -> seconds`` optionally re-ranks the top
+    ``measure_top_k`` feasible candidates by real measured step time
+    (≙ tuner/optimization_tuner.py:188's trial runs).
+    """
+    from paddle_tpu.cost_model import CostModel
+
+    cm = CostModel()
+    ctx = _plan_ctx(module)
+    scored = []
+    for degrees in enumerate_plans(n_devices, max_tp):
+        info = plan_cost(module, degrees, hbm_bytes, budget, optimizer,
+                         flops_per_step, tokens_per_step, cost_model=cm,
+                         _ctx=ctx)
+        scored.append((info["time_s"], degrees, info))
+    scored.sort(key=lambda t: (not t[2]["feasible"], t[0]))
+    if measure_fn is not None:
+        head = [s for s in scored[:measure_top_k] if s[2]["feasible"]]
+        tail = scored[len(head):]
+        remeasured = []
+        for _, degrees, info in head:
+            t = measure_fn(degrees)
+            info = dict(info, measured_s=t)
+            remeasured.append((t, degrees, info))
+        remeasured.sort(key=lambda t: t[0])
+        scored = remeasured + tail
+    return scored
 
 
 def suggest_mesh(module, n_devices: int, hbm_bytes: float = 16e9,
-                 max_tp: int = 8, budget: float = 0.6) -> Dict[str, int]:
-    """Pick (dp, fsdp, tp) degrees for ``n_devices`` so the static memory
-    floor fits in ``budget``·HBM (≙ tuner/parallel_tuner.py:35 search,
-    collapsed to the memory axis). Prefers fsdp (cheaper collectives on
-    the weight path) and escalates to tp only when sharding alone cannot
-    fit — mirroring the reference tuner's dp→sharding→mp ordering."""
-    rep = memory_report(module)
-    need = rep["total_bytes"]
-    fsdp = tp = 1
-    while (need / (fsdp * tp) > budget * hbm_bytes
-           and fsdp * tp < n_devices):
-        if fsdp * 2 * tp <= n_devices:
-            fsdp *= 2
-        elif tp < max_tp and fsdp * tp * 2 <= n_devices:
-            tp *= 2
-        else:
-            break
-    dp = max(1, n_devices // (fsdp * tp))
-    return {"dp": dp, "fsdp": fsdp, "tp": tp}
+                 max_tp: int = 8, budget: float = 0.6,
+                 optimizer: str = "adamw", flops_per_step: float = 0.0,
+                 tokens_per_step: int = 8192,
+                 measure_fn=None) -> Dict[str, int]:
+    """Pick (dp, fsdp, tp) degrees for ``n_devices``: enumerate every
+    factorization, reject those whose static memory floor exceeds
+    ``budget``·HBM, and return the cost-model argmin
+    (≙ tuner/parallel_tuner.py:35). With ``measure_fn`` the finalists are
+    re-ranked by measured step time."""
+    ranked = rank_plans(module, n_devices, hbm_bytes, max_tp, budget,
+                        optimizer, flops_per_step, tokens_per_step,
+                        measure_fn=measure_fn)
+    for _, degrees, info in ranked:
+        if info["feasible"]:
+            return degrees
+    # nothing fits the budget: return the min-memory plan so the caller
+    # can at least try (matching the reference tuner's best-effort fall-through)
+    return min(ranked, key=lambda t: t[2]["per_device_bytes"])[1]

@@ -35,6 +35,11 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
     # conv is on the reference O1 white list (amp/auto_cast WHITE_LIST:44)
     from paddle_tpu.amp.auto_cast import amp_cast
     x = amp_cast(jnp.asarray(x))
+    if hasattr(weight, "dequantize"):
+        # int8 QuantTensor kernel: XLA fuses the dequant convert into the
+        # conv read (no Pallas conv kernel — convs are MXU-bound, not
+        # weight-bandwidth-bound like decode matmuls)
+        weight = weight.dequantize()
     w = amp_cast(jnp.asarray(weight))  # (out_c, in_c/groups, *k) ref layout
     if x.dtype != w.dtype:  # lax.conv requires matching dtypes
         ct = jnp.promote_types(x.dtype, w.dtype)

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["QuantTensor", "quantize_tensor", "quantize_for_inference",
-           "dequantize_params"]
+           "dequantize_params", "quantize_aware", "convert", "qat"]
 
 # embedding-table heuristic shared with the planner: vocab-ratio tables
 # are lookup (gather) weights — quantizing them per-column would mix
@@ -180,7 +180,9 @@ def quantize_for_inference(model, include: Optional[str] = None,
                   and jnp.issubdtype(w.dtype, jnp.floating)
                   and w.size >= min_size)
         if quantize:
-            out[name] = quantize_tensor(w, axis=-1)
+            # matmul weights (in, out): channel dim is the output = -1;
+            # conv kernels OIHW: the output-channel dim is 0
+            out[name] = quantize_tensor(w, axis=0 if w.ndim == 4 else -1)
             n_q += 1
         else:
             out[name] = w
@@ -195,3 +197,9 @@ def dequantize_params(params):
     (for checkpointing a quantized model or accuracy diffing)."""
     return {k: (v.dequantize() if isinstance(v, QuantTensor) else v)
             for k, v in params.items()}
+
+
+# QAT (fake-quant training → convert into the weight-only serving path);
+# imported at the tail so qat.py can import the PTQ machinery above.
+from paddle_tpu.quantization import qat  # noqa: E402
+from paddle_tpu.quantization.qat import convert, quantize_aware  # noqa: E402

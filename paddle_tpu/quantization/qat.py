@@ -1,0 +1,229 @@
+"""Quantization-aware training.
+
+Reference analog: the fake-quant layer pairs in
+python/paddle/nn/quant/quant_layers.py (QuantizedLinear/QuantizedConv2D with
+FakeQuantMovingAverageAbsMax for activations + FakeQuantChannelWiseAbsMax for
+weights) driven by
+fluid/contrib/slim/quantization/imperative/qat.py ImperativeQuantAware
+(quantize = swap layers in, convert = bake scales out).
+
+TPU-native design: fake-quant is a pure function with a straight-through
+estimator (the round sits under ``stop_gradient``, so XLA fuses the whole
+QDQ into the surrounding matmul and the backward pass is the identity —
+no custom kernels, no graph passes). Activation ranges are EMA buffers
+threaded through the functional ``nn.stateful`` Context exactly like
+BatchNorm running stats; weight scales are recomputed from the live
+weights each step (the reference does the same for channel-wise weight
+quant). ``convert`` lowers a trained QAT model back to plain layers and
+hands the named weights to the existing weight-only int8 PTQ path
+(``quantize_for_inference``), so serving sees one quantization story.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.common import Linear
+from paddle_tpu.nn.layer.conv import Conv2D
+from paddle_tpu.nn.module import (Buffer, Module, Parameter,
+                                  current_context, is_training)
+
+__all__ = ["fake_quant", "QuantedLinear", "QuantedConv2D",
+           "quantize_aware", "convert"]
+
+
+def fake_quant(x, absmax, bits: int = 8):
+    """Symmetric quantize-dequantize with a straight-through estimator
+    (≙ FakeQuantAbsMax forward, quant_layers.py; STE ≙ its backward
+    passing gradients through unchanged)."""
+    bound = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(jnp.asarray(absmax, jnp.float32), 1e-8) / bound
+    xf = x.astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / scale), -bound, bound) * scale
+    return (xf + jax.lax.stop_gradient(q - xf)).astype(x.dtype)
+
+
+def _channel_absmax(w, axis: int = -1):
+    """Per-output-channel absmax, kept broadcastable against ``w``."""
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    return jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
+                   keepdims=True)
+
+
+class _FakeQuantActMixin:
+    """EMA absmax tracking for activations (≙ moving_average_abs_max)."""
+
+    def _init_act_state(self, activation_bits: int, ema: float):
+        self.activation_bits = activation_bits
+        self.ema = ema
+        self.register_buffer("act_absmax", jnp.zeros((), jnp.float32))
+
+    def _fake_quant_input(self, x):
+        cur = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        have = self.act_absmax > 0
+        if is_training():
+            absmax = jnp.where(have,
+                               self.ema * self.act_absmax
+                               + (1.0 - self.ema) * cur, cur)
+            ctx = current_context()
+            if ctx is not None:
+                tag = getattr(self, "_stat_tag", None)
+                if tag is None:
+                    tag = f"id{id(self) % 10**9}"  # untagged: tag_paths()
+                prefix = f"{tag}." if tag else ""
+                ctx.record_update(f"{prefix}act_absmax", absmax)
+        else:
+            # inference: trust the trained range; fall back to the live
+            # batch range only if the model never trained
+            absmax = jnp.where(have, self.act_absmax, cur)
+        return fake_quant(x, absmax, self.activation_bits)
+
+
+class QuantedLinear(Module, _FakeQuantActMixin):
+    """Linear with fake-quantized input + per-channel fake-quantized weight
+    (≙ QuantizedLinear, quant_layers.py)."""
+
+    def __init__(self, layer: Linear, weight_bits: int = 8,
+                 activation_bits: int = 8, ema: float = 0.9):
+        super().__init__()
+        self.in_features = layer.in_features
+        self.out_features = layer.out_features
+        self.weight_bits = weight_bits
+        self.weight = Parameter(layer.weight)
+        self.bias = (Parameter(layer.bias) if layer.bias is not None
+                     else None)
+        self._init_act_state(activation_bits, ema)
+
+    def forward(self, x):
+        xq = self._fake_quant_input(x)
+        wq = fake_quant(self.weight, _channel_absmax(self.weight, -1),
+                        self.weight_bits)
+        return F.linear(xq, wq, self.bias)
+
+
+class QuantedConv2D(Module, _FakeQuantActMixin):
+    """Conv2D with fake-quantized input + per-out-channel fake-quantized
+    kernel (≙ QuantizedConv2D, quant_layers.py). Kernel layout is OIHW, so
+    the channel axis is 0."""
+
+    def __init__(self, layer: Conv2D, weight_bits: int = 8,
+                 activation_bits: int = 8, ema: float = 0.9):
+        super().__init__()
+        for attr in ("in_channels", "out_channels", "kernel_size", "stride",
+                     "padding", "dilation", "groups", "data_format"):
+            setattr(self, attr, getattr(layer, attr))
+        self.weight_bits = weight_bits
+        self.weight = Parameter(layer.weight)
+        self.bias = (Parameter(layer.bias) if layer.bias is not None
+                     else None)
+        self._init_act_state(activation_bits, ema)
+
+    def forward(self, x):
+        xq = self._fake_quant_input(x)
+        wq = fake_quant(self.weight, _channel_absmax(self.weight, 0),
+                        self.weight_bits)
+        return F.conv2d(xq, wq, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+_SWAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _deep_copy(model: Module) -> Module:
+    # a Module is a pytree: identity tree_map rebuilds fresh module objects
+    return jax.tree_util.tree_map(lambda x: x, model)
+
+
+def _swap_children(module: Module, weight_bits, activation_bits, ema):
+    for name in sorted(module._modules):
+        child = getattr(module, name)
+        if isinstance(child, Module):
+            setattr(module, name,
+                    _maybe_quant(child, weight_bits, activation_bits, ema))
+        else:  # registered list/tuple of modules
+            setattr(module, name, type(child)(
+                _maybe_quant(c, weight_bits, activation_bits, ema)
+                for c in child))
+    return module
+
+
+def _maybe_quant(module, weight_bits, activation_bits, ema):
+    cls = _SWAP.get(type(module))
+    if cls is not None:
+        return cls(module, weight_bits, activation_bits, ema)
+    return _swap_children(module, weight_bits, activation_bits, ema)
+
+
+def quantize_aware(model: Module, weight_bits: int = 8,
+                   activation_bits: int = 8, ema: float = 0.9) -> Module:
+    """Return a copy of ``model`` with every Linear/Conv2D swapped for its
+    fake-quant twin (≙ ImperativeQuantAware.quantize, qat.py:~200). Train
+    the result exactly like the original — same optimizers, same
+    ``nn.stateful`` loop; the EMA act ranges ride ``ctx.updates``."""
+    out = _swap_children(_deep_copy(model), weight_bits, activation_bits,
+                         ema)
+    return out.tag_paths()
+
+
+def convert(model: Module, for_inference: bool = True,
+            min_size: int = 0) -> Module:
+    """Lower a trained QAT model back to plain layers
+    (≙ ImperativeQuantAware.save_quantized_model's conversion half), then —
+    by default — push the quantized-in-training weights through the
+    weight-only int8 PTQ path so serving uses the one existing
+    ``QuantTensor`` machinery."""
+    from paddle_tpu.quantization import quantize_for_inference
+
+    quant_paths = []
+
+    def _unswap(module, prefix=""):
+        for name in sorted(module._modules):
+            child = getattr(module, name)
+            path = f"{prefix}{name}"
+            if isinstance(child, Module):
+                setattr(module, name, _restore(child, path))
+            else:
+                setattr(module, name, type(child)(
+                    _restore(c, f"{path}.{i}")
+                    for i, c in enumerate(child)))
+        return module
+
+    def _restore(module, path):
+        if isinstance(module, QuantedLinear):
+            new = Linear(module.in_features, module.out_features,
+                         bias_attr=False if module.bias is None else None)
+            new.weight = Parameter(
+                fake_quant(module.weight,
+                           _channel_absmax(module.weight, -1),
+                           module.weight_bits))
+            if module.bias is not None:
+                new.bias = Parameter(module.bias)
+            quant_paths.append(f"{path}.weight")
+            return new
+        if isinstance(module, QuantedConv2D):
+            new = Conv2D(module.in_channels, module.out_channels,
+                         module.kernel_size, module.stride, module.padding,
+                         module.dilation, module.groups,
+                         bias_attr=False if module.bias is None else None,
+                         data_format=module.data_format)
+            new.weight = Parameter(
+                fake_quant(module.weight,
+                           _channel_absmax(module.weight, 0),
+                           module.weight_bits))
+            if module.bias is not None:
+                new.bias = Parameter(module.bias)
+            quant_paths.append(f"{path}.weight")
+            return new
+        return _unswap(module, f"{path}.")
+
+    plain = _unswap(_deep_copy(model))
+    if not for_inference:
+        return plain
+    if not quant_paths:
+        raise ValueError("convert() found no Quanted layer in the model")
+    include = "^(" + "|".join(
+        p.replace(".", r"\.") for p in quant_paths) + ")$"
+    return quantize_for_inference(plain, include=include,
+                                  min_size=min_size)

@@ -49,6 +49,12 @@ def test_bench_resnet50_smoke():
     assert out["resnet50_batch"] == 2
 
 
+def test_bench_ppyoloe_smoke():
+    out = bench.bench_ppyoloe(jax, jnp, PEAK, smoke=True)
+    assert out["ppyoloe_s_imgs_per_sec"] > 0
+    assert out["ppyoloe_s_batch"] == 2
+
+
 def test_bench_pp_smoke():
     out = bench.bench_pp(jax, jnp, PEAK, smoke=True)
     assert out["pp2_step_ms"] > 0 and out["pp2_dense_step_ms"] > 0
@@ -59,6 +65,7 @@ def test_bench_nonsmoke_cpu_guards():
     # driver-mode guards: on CPU the TPU-only sub-benches stay silent
     assert bench.bench_bert(jax, jnp, PEAK) == {}
     assert bench.bench_resnet50(jax, jnp, PEAK) == {}
+    assert bench.bench_ppyoloe(jax, jnp, PEAK) == {}
     assert bench.bench_pp(jax, jnp, PEAK) == {}
 
 
