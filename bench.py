@@ -366,6 +366,19 @@ def bench_pp(jax, jnp, peak, smoke=False):
     t_pp_f = timeit(jax.jit(fwd_pp), stacked)
     t_dense_f = timeit(jax.jit(fwd_dense), stacked)
     bubble_theory = (S - 1) / (n_micro + S - 1)
+
+    # interleaved (vpp=2) variant of the same model: in ONE XLA program
+    # fwd/bwd order is the compiler's (see pipelined_apply_interleaved
+    # docstring), so this measures the schedule machinery at S·V ring
+    # depth; the bubble ÷V claim is proven on the cross-host runtime
+    # (tests/test_fleet_executor.py::test_interleaved_bubble_reduction)
+    stacked_v, _ = gpt.stack_blocks_interleaved(model, S, 2)
+
+    def fwd_vpp(stacked_v):
+        y = gpt.pipelined_apply_interleaved(stacked_v, x0, S, 2)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    t_vpp_f = timeit(jax.jit(fwd_vpp), stacked_v)
     # Measured r3 (125M, pp2, 4 micro, one v5e chip): fwd overhead ~38%,
     # fwd+bwd ~72% (hoisting per-row weight extraction out of the tick
     # scan shaved ~3 points; the rest is the tick-scan adjoint's per-tick
@@ -378,6 +391,7 @@ def bench_pp(jax, jnp, peak, smoke=False):
             "pp2_overhead_measured": round(t_pp / t_dense - 1.0, 4),
             "pp2_fwd_overhead_measured": round(t_pp_f / t_dense_f - 1.0, 4),
             "pp2_bubble_theoretical": round(bubble_theory, 4),
+            "pp2_vpp2_fwd_overhead": round(t_vpp_f / t_dense_f - 1.0, 4),
             "pp2_micro": n_micro}
 
 

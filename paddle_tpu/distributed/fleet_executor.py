@@ -3,8 +3,9 @@
 Reference analog: paddle/fluid/distributed/fleet_executor/ —
 fleet_executor.h:35 (the per-rank runtime), carrier.cc (schedules task
 nodes), interceptor.cc (tag-addressed mailboxes), message_bus.cc
-(cross-host transport), and the 1F1B semantics of
-fleet/meta_parallel/pipeline_parallel.py:117-198.
+(cross-host transport), and the schedules of
+fleet/meta_parallel/pipeline_parallel.py:117-198 (1F1B) and :457
+(PipelineParallelWithInterleave — virtual stages).
 
 The in-mesh PP path (models/gpt.py build_pipelined_train_step) is a single
 SPMD program — right for stages connected by ICI. This runtime is the DCN
@@ -17,11 +18,23 @@ tp/fsdp/dp over ICI, which is exactly how the reference splits NCCL
 
 Schedules: "fthenb" (GPipe) and "1f1b" (warmup = n_stages-stage-1, then
 steady alternation — caps in-flight activations at the stage depth).
-Deadlock-free by construction: receives block, sends never do.
+``n_virtual > 1`` runs the interleaved schedule: each rank owns V model
+chunks (global stage v·S + r), microbatches are processed in S-sized
+groups through all chunks before the next group, and the warmup depth is
+Megatron's (S-rank-1)·2 + (V-1)·S — cutting the pipeline bubble by ~V.
+
+Sends are handed to a background worker thread (device_get → pack →
+socket) so the next microbatch's compute dispatches while the previous
+boundary tensor is still in flight — the comm/compute overlap the
+reference gets from its async interceptor queues. Per-worker FIFO keeps
+message order deterministic. Deadlock-free by construction: receives
+block, sends never do.
 """
 
 import io
-from typing import Callable, List, Optional, Sequence
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence, Union
 
 import numpy as np
 import jax
@@ -46,8 +59,9 @@ def _unpack(payload: bytes):
     return arrays[0] if len(arrays) == 1 else tuple(arrays)
 
 
-def _tag(kind: int, step: int, mb: int) -> int:
-    return (kind << 56) | ((step & 0xFFFFFFFF) << 24) | (mb & 0xFFFFFF)
+def _tag(kind: int, step: int, chunk: int, mb: int) -> int:
+    return ((kind << 54) | ((step & 0x3FFFFFFF) << 24)
+            | ((chunk & 0xFF) << 16) | (mb & 0xFFFF))
 
 
 def rendezvous_endpoints(store, stage_idx: int, n_stages: int,
@@ -67,26 +81,39 @@ def rendezvous_endpoints(store, stage_idx: int, n_stages: int,
 
 
 class FleetExecutor:
-    """Runs ONE pipeline stage of a cross-host pipeline.
+    """Runs ONE pipeline rank of a cross-host pipeline.
 
     Args:
-      stage_fn: jit-compatible ``(params, x) -> y``; the LAST stage returns
-        a scalar loss (it receives the final activations and owns the loss
-        head). Compiled once per activation shape.
+      stage_fn: jit-compatible ``(params, x) -> y`` — or, with
+        ``n_virtual > 1``, a list of V such callables (chunk v implements
+        global stage v·S + rank). The LAST global stage's callable returns
+        a scalar loss and takes ``(params, x, label)`` (it owns the loss
+        head, matching the reference's data feed to both pipeline ends).
       stage_idx / n_stages: this rank's stage and the pipeline depth.
       endpoint: a ``native.P2PEndpoint`` (see ``rendezvous_endpoints``).
       peers: ``peers[s] = (host, port)`` for every stage.
       schedule: "1f1b" (default) or "fthenb".
+      n_virtual: model chunks per rank (interleaved schedule when > 1).
     """
 
-    def __init__(self, stage_fn: Callable, stage_idx: int, n_stages: int,
+    def __init__(self, stage_fn: Union[Callable, Sequence[Callable]],
+                 stage_idx: int, n_stages: int,
                  endpoint, peers: Sequence, schedule: str = "1f1b",
-                 timeout: float = 120.0):
+                 timeout: float = 120.0, n_virtual: int = 1):
         if schedule not in ("1f1b", "fthenb"):
             raise ValueError(f"unknown schedule {schedule!r}")
-        self.stage_fn = stage_fn
+        if n_virtual > 1:
+            if not isinstance(stage_fn, (list, tuple)) \
+                    or len(stage_fn) != n_virtual:
+                raise ValueError("n_virtual>1 needs a list of n_virtual "
+                                 "stage callables (one per model chunk)")
+            self.chunk_fns = list(stage_fn)
+        else:
+            self.chunk_fns = [stage_fn] if callable(stage_fn) \
+                else list(stage_fn)
         self.stage_idx = stage_idx
         self.n_stages = n_stages
+        self.n_virtual = n_virtual
         self.endpoint = endpoint
         self.peers = peers
         self.schedule = schedule
@@ -94,17 +121,58 @@ class FleetExecutor:
         self._step = 0
         self.is_first = stage_idx == 0
         self.is_last = stage_idx == n_stages - 1
+        # async send worker: FIFO queue keeps per-connection ordering
+        self._sendq: "queue.Queue" = queue.Queue()
+        self._send_err: List[BaseException] = []
+        self._sender = threading.Thread(target=self._send_loop, daemon=True)
+        self._sender.start()
 
     # -- transport ----------------------------------------------------------
 
-    def _send(self, stage: int, kind: int, mb: int, value):
-        host, port = self.peers[stage]
-        self.endpoint.send(host, port, _tag(kind, self._step, mb),
-                           _pack(jax.device_get(value)))
+    def _send_loop(self):
+        while True:
+            item = self._sendq.get()
+            if item is None:
+                return
+            stage, kind, chunk, mb, step, value = item
+            try:
+                host, port = self.peers[stage]
+                self.endpoint.send(host, port, _tag(kind, step, chunk, mb),
+                                   _pack(jax.device_get(value)))
+            except BaseException as e:  # surfaced at the next flush
+                self._send_err.append(e)
+            finally:
+                self._sendq.task_done()
 
-    def _recv(self, kind: int, mb: int):
-        return _unpack(self.endpoint.recv(_tag(kind, self._step, mb),
-                                          self.timeout))
+    def _send(self, stage: int, kind: int, mb: int, value, chunk: int = 0):
+        self._sendq.put((stage, kind, chunk, mb, self._step, value))
+
+    def _flush_sends(self):
+        self._sendq.join()
+        self._raise_send_err()
+
+    def _raise_send_err(self):
+        if self._send_err:
+            err = self._send_err[0]
+            del self._send_err[:]
+            raise err
+
+    def _recv(self, kind: int, mb: int, chunk: int = 0):
+        # a failed async send (peer died) would otherwise surface as an
+        # unrelated recv timeout — check before blocking and on timeout
+        self._raise_send_err()
+        try:
+            payload = self.endpoint.recv(
+                _tag(kind, self._step, chunk, mb), self.timeout)
+        except TimeoutError:
+            self._raise_send_err()
+            raise
+        return _unpack(payload)
+
+    def close(self):
+        self._sendq.put(None)
+        self._sender.join(timeout=5)
+        self._raise_send_err()
 
     # -- public -------------------------------------------------------------
 
@@ -112,69 +180,122 @@ class FleetExecutor:
             labels: Optional[List] = None, n_micro: Optional[int] = None):
         """One optimizer-step's worth of pipeline: ``n_micro`` forwards and
         backwards in the configured schedule. Stage 0 passes the list of
-        microbatch inputs; the last stage passes ``labels`` (its stage_fn
-        then takes ``(params, x, label)`` — the loss head owns the
-        targets, matching the reference's data feed to both pipeline
-        ends). Returns ``(grads, mean_loss)`` — grads for THIS stage's
-        params (averaged over microbatches), loss on the last stage else
-        None."""
+        microbatch inputs; the last stage passes ``labels``. Returns
+        ``(grads, mean_loss)`` — grads for THIS rank's params (averaged
+        over microbatches; a list of per-chunk grads when n_virtual > 1),
+        loss on the last stage else None."""
         if self.is_first:
             n_micro = len(microbatches)
         if n_micro is None:
             raise ValueError("non-first stages must pass n_micro")
+        S, V, r = self.n_stages, self.n_virtual, self.stage_idx
+        if V > 1 and n_micro % S != 0:
+            raise ValueError(f"interleaved schedule needs n_micro divisible"
+                             f" by n_stages ({n_micro} % {S} != 0)")
 
         saved = {}
         losses = []
-        grad_acc = None
+        grad_acc: List = [None] * V
+        last_chunk_is_loss = self.is_last  # chunk V-1 on the last rank
 
-        def fwd(mb):
-            x = microbatches[mb] if self.is_first \
-                else jax.numpy.asarray(self._recv(_FWD, mb))
-            if labels is not None:
+        def fwd(mb, v=0):
+            g = v * S + r
+            if g == 0:
+                x = microbatches[mb]
+            else:
+                x = jax.numpy.asarray(self._recv(_FWD, mb, chunk=v))
+            if last_chunk_is_loss and v == V - 1 and labels is not None:
                 y, vjp_fn = jax.vjp(
-                    lambda p, xx: self.stage_fn(p, xx, labels[mb]),
-                    params, x)
+                    lambda p, xx: self.chunk_fns[v](p, xx, labels[mb]),
+                    params[v] if V > 1 else params, x)
             else:
-                y, vjp_fn = jax.vjp(self.stage_fn, params, x)
-            saved[mb] = vjp_fn
-            if self.is_last:
+                y, vjp_fn = jax.vjp(self.chunk_fns[v],
+                                    params[v] if V > 1 else params, x)
+            saved[(v, mb)] = vjp_fn
+            if last_chunk_is_loss and v == V - 1:
                 losses.append(float(y))
-            else:
-                self._send(self.stage_idx + 1, _FWD, mb, y)
+            elif r < S - 1:
+                self._send(r + 1, _FWD, mb, y, chunk=v)
+            else:  # chunk boundary hop: rank S-1 chunk v → rank 0 chunk v+1
+                self._send(0, _FWD, mb, y, chunk=v + 1)
 
-        def bwd(mb):
-            nonlocal grad_acc
-            vjp_fn = saved.pop(mb)
-            if self.is_last:
+        def bwd(mb, v=0):
+            vjp_fn = saved.pop((v, mb))
+            if last_chunk_is_loss and v == V - 1:
                 cot = np.float32(1.0)
             else:
-                got = self._recv(_BWD, mb)
+                got = self._recv(_BWD, mb, chunk=v)
                 cot = jax.tree_util.tree_map(np.asarray, got) \
                     if isinstance(got, tuple) else np.asarray(got)
             (gp, gx) = vjp_fn(cot)
-            grad_acc = gp if grad_acc is None else jax.tree_util.tree_map(
-                lambda a, b: a + b, grad_acc, gp)
-            if not self.is_first:
-                self._send(self.stage_idx - 1, _BWD, mb, gx)
+            grad_acc[v] = gp if grad_acc[v] is None else \
+                jax.tree_util.tree_map(lambda a, b: a + b, grad_acc[v], gp)
+            if r > 0:
+                self._send(r - 1, _BWD, mb, gx, chunk=v)
+            elif v > 0:  # rank 0 chunk v → rank S-1 chunk v-1
+                self._send(S - 1, _BWD, mb, gx, chunk=v - 1)
+            # g == 0 discards gx (no producer upstream)
 
-        if self.schedule == "fthenb":
-            for mb in range(n_micro):
-                fwd(mb)
-            for mb in range(n_micro):
-                bwd(mb)
-        else:  # 1f1b
-            warmup = min(n_micro, self.n_stages - self.stage_idx - 1)
-            for mb in range(warmup):
-                fwd(mb)
-            next_f, next_b = warmup, 0
-            while next_b < n_micro:
-                if next_f < n_micro:
-                    fwd(next_f)
-                    next_f += 1
-                bwd(next_b)
-                next_b += 1
+        if V == 1:
+            if self.schedule == "fthenb":
+                for mb in range(n_micro):
+                    fwd(mb)
+                for mb in range(n_micro):
+                    bwd(mb)
+            else:  # 1f1b
+                warmup = min(n_micro, S - r - 1)
+                for mb in range(warmup):
+                    fwd(mb)
+                next_f, next_b = warmup, 0
+                while next_b < n_micro:
+                    if next_f < n_micro:
+                        fwd(next_f)
+                        next_f += 1
+                    bwd(next_b)
+                    next_b += 1
+        else:
+            # interleaved unit order (≙ get_model_chunk_id,
+            # pipeline_parallel.py:457): S-sized microbatch groups sweep
+            # all V chunks before the next group enters
+            group = S * V
 
+            def funit(k):
+                within = k % group
+                return within // S, (k // group) * S + within % S
+
+            def bunit(j):
+                within = j % group
+                return (V - 1 - within // S,
+                        (j // group) * S + within % S)
+
+            total = n_micro * V
+            if self.schedule == "fthenb":
+                for k in range(total):
+                    v, mb = funit(k)
+                    fwd(mb, v)
+                for j in range(total):
+                    v, mb = bunit(j)
+                    bwd(mb, v)
+            else:
+                warmup = min(total, (S - r - 1) * 2 + (V - 1) * S)
+                for k in range(warmup):
+                    v, mb = funit(k)
+                    fwd(mb, v)
+                fk, bk = warmup, 0
+                while bk < total:
+                    if fk < total:
+                        v, mb = funit(fk)
+                        fwd(mb, v)
+                        fk += 1
+                    v, mb = bunit(bk)
+                    bwd(mb, v)
+                    bk += 1
+
+        self._flush_sends()
         self._step += 1
-        grads = jax.tree_util.tree_map(lambda g: g / n_micro, grad_acc)
+        grads = [jax.tree_util.tree_map(lambda g: g / n_micro, ga)
+                 for ga in grad_acc]
+        if V == 1:
+            grads = grads[0]
         loss = float(np.mean(losses)) if losses else None
         return grads, loss

@@ -801,6 +801,147 @@ def layer_slot_mask(n_layers: int, n_stages: int):
     return jnp.asarray([[i < c for i in range(lps)] for c in counts])
 
 
+def stack_blocks_interleaved(model: GPT, n_stages: int, n_virtual: int):
+    """Interleaved (virtual-stage) stacking: leading axes
+    (n_virtual, n_stages, layers_per_global_stage, ...), where pp rank r
+    holds the n_virtual chunks {v·S + r} of the S·V-deep global pipeline
+    (≙ PipelineParallelWithInterleave's model-chunk assignment,
+    fleet/meta_parallel/pipeline_parallel.py:457). Returns
+    (stacked, mask) with mask (V, S, lpg) marking real layer slots
+    (None when L divides evenly)."""
+    L = model.cfg.n_layers
+    S, V = n_stages, n_virtual
+    G = S * V
+    kinds = {model.blocks[i].moe is not None for i in range(L)}
+    if len(kinds) > 1:
+        raise ValueError("pipeline stacking needs homogeneous blocks")
+    if L < G:
+        raise ValueError(f"{L} layers over {G} global stages leaves an "
+                         f"empty stage; reduce n_stages or n_virtual")
+    counts = _balanced_counts(L, G)
+    lpg = counts[0]
+    rows = []
+    idx = 0
+    for g in range(G):
+        take = counts[g]
+        layer_ids = list(range(idx, idx + take))
+        idx += take
+        layer_ids += [layer_ids[0]] * (lpg - take)  # placeholders, masked
+        rows.append([model.blocks[i] for i in layer_ids])
+    flat = [b for row in rows for b in row]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *flat)
+    stacked = jax.tree_util.tree_map(
+        lambda x: x.reshape((V, S, lpg) + x.shape[1:]), stacked)
+    return stacked, interleaved_slot_mask(L, S, V)
+
+
+def _balanced_counts(n_layers: int, n_groups: int):
+    """Balanced layer counts per group: the first L%G groups get one layer
+    more (finer placement than ceil-greedy — the interleave's point)."""
+    q, rem = divmod(n_layers, n_groups)
+    return [q + 1] * rem + [q] * (n_groups - rem)
+
+
+def interleaved_slot_mask(n_layers: int, n_stages: int, n_virtual: int):
+    """(V, S, lpg) bool mask of real layer slots under the balanced
+    interleaved split; None when evenly divisible."""
+    G = n_stages * n_virtual
+    if n_layers % G == 0:
+        return None
+    counts = _balanced_counts(n_layers, G)
+    lpg = counts[0]
+    flat = jnp.asarray([[i < c for i in range(lpg)] for c in counts])
+    return flat.reshape(n_virtual, n_stages, lpg)
+
+
+def pipelined_apply_interleaved(stacked_blocks, x_mb, n_stages: int,
+                                n_virtual: int, remat_stages: bool = False,
+                                layer_mask=None):
+    """Virtual-stage (interleaved) rolling-buffer schedule: the buffer has
+    one row per GLOBAL stage, shaped (V, S, ...) with the S axis sharded
+    over 'pp' — pp rank r owns its V chunk rows. One tick advances every
+    live row one global stage; the flat roll (v, S-1) → (v+1, 0) is the
+    chunk boundary hop, which stays ON-RANK only for the ring neighbor —
+    XLA lowers the whole shift to one collective-permute.
+
+    Honest scheduling note (vs PipelineParallelWithInterleave,
+    pipeline_parallel.py:457): inside ONE XLA program the backward is the
+    reversed forward scan, so fwd/bwd interleaving — where Megatron's
+    bubble ÷V comes from — is the compiler's call, not ours; this variant
+    buys finer-grained layer placement (uneven models balance over S·V
+    slots instead of S) and halves the per-hop activation dwell time. The
+    schedule-owned interleave with real 1F1B overlap is the cross-host
+    runtime (distributed/fleet_executor.py, n_virtual>1).
+    """
+    global _PIPELINE_DEPTH
+    n_micro = x_mb.shape[0]
+    S, V = n_stages, n_virtual
+    G = S * V
+    if layer_mask is None:
+        lpg = jax.tree_util.tree_leaves(stacked_blocks)[0].shape[2]
+        layer_mask = jnp.ones((V, S, lpg), bool)
+
+    def stage_fn(blocks_one_stage, h, mask_one_stage):
+        def body(hh, blk_m):
+            blk, m = blk_m
+            out = blk(hh)
+            hh = jnp.where(m, out, hh)
+            return hh, None
+        h, _ = lax.scan(body, h, (blocks_one_stage, mask_one_stage))
+        return h
+
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    # per-(v, r) block trees extracted once, outside the tick scan (same
+    # adjoint-accumulation reasoning as pipelined_apply)
+    row_blocks = [[jax.tree_util.tree_map(lambda x, v=v, r=r: x[v, r],
+                                          stacked_blocks)
+                   for r in range(S)] for v in range(V)]
+
+    state = jnp.zeros((V, S) + x_mb.shape[1:], x_mb.dtype)
+    outputs = jnp.zeros_like(x_mb)
+
+    def tick(carry, t):
+        state, outputs = carry
+        inp = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        state = state.at[0, 0].set(inp)
+        state = _shard_act(state, P(None, "pp", _BATCH_AXES, "sp", None))
+        rows = []
+        for v in range(V):
+            rank_rows = []
+            for r in range(S):
+                g = v * S + r
+                live = ((t - g) >= 0) & ((t - g) < n_micro)
+                h = lax.cond(
+                    live,
+                    lambda h, b=row_blocks[v][r], mk=layer_mask[v, r]:
+                        stage_fn(b, h, mk),
+                    lambda h: h,
+                    state[v, r])
+                rank_rows.append(h)
+            rows.append(jnp.stack(rank_rows))
+        processed = jnp.stack(rows)
+        out_t = processed[V - 1, S - 1]
+        outputs = lax.cond(
+            t >= G - 1,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, out_t, jnp.clip(t - (G - 1), 0, n_micro - 1), 0),
+            lambda o: o, outputs)
+        flat = processed.reshape((G,) + processed.shape[2:])
+        state = jnp.roll(flat, 1, axis=0).reshape(state.shape)
+        return (state, outputs), None
+
+    _PIPELINE_DEPTH += 1
+    try:
+        (state, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(n_micro + G - 1))
+    finally:
+        _PIPELINE_DEPTH -= 1
+    return outputs
+
+
 def unstack_blocks(stacked, n_layers: int):
     """Inverse of stack_blocks → list of per-layer block pytrees."""
     flat = jax.tree_util.tree_map(
@@ -947,21 +1088,32 @@ def _moe_block_with_aux(blk: GPTBlock, x):
     return out, aux
 
 
-def pipeline_partition_spec(path: str) -> P:
-    """Partition spec for a stacked-block param (two leading stage axes)."""
+def pipeline_partition_spec(path: str, n_virtual: int = 1) -> P:
+    """Partition spec for a stacked-block param: leading axes (S, lps) —
+    or (V, S, lpg) for the interleaved stacking, where only S shards."""
     base = partition_spec(path.split(".")[-1])
-    return P(*(("pp", None) + tuple(base)))
+    lead = ("pp", None) if n_virtual == 1 else (None, "pp", None)
+    return P(*(lead + tuple(base)))
 
 
 def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,
                                n_stages: int, n_micro: int,
-                               remat_stages: bool = False):
+                               remat_stages: bool = False,
+                               n_virtual: int = 1):
     """Full hybrid dp×fsdp×tp×sp×pp train step (≙ §3.4 call stack:
     fleet.distributed_model + train_batch + HybridParallelOptimizer.step,
-    all fused into one XLA program)."""
+    all fused into one XLA program). ``n_virtual > 1`` uses the
+    interleaved virtual-stage buffer (stacked blocks from
+    ``stack_blocks_interleaved``)."""
     cfg = model.cfg
-    mask = layer_slot_mask(cfg.n_layers, n_stages)
     use_moe = cfg.moe_experts > 0
+    if n_virtual > 1:
+        if use_moe:
+            raise ValueError("interleaved pipeline does not collect MoE "
+                             "aux loss yet; use n_virtual=1 for MoE")
+        mask = interleaved_slot_mask(cfg.n_layers, n_stages, n_virtual)
+    else:
+        mask = layer_slot_mask(cfg.n_layers, n_stages)
 
     def step(emb_params, stacked_blocks, opt_state, tokens, rng):
         # tokens: (n_micro, mb, seq)
@@ -970,9 +1122,14 @@ def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,
             m = model.merge_params(emb_p)
             x = m.embed(tokens.reshape(nm * mb, s))
             x = x.reshape(nm, mb, s, -1)
-            out = pipelined_apply(blocks_p, x, n_stages,
-                                  remat_stages=remat_stages,
-                                  layer_mask=mask, collect_aux=use_moe)
+            if n_virtual > 1:
+                out = pipelined_apply_interleaved(
+                    blocks_p, x, n_stages, n_virtual,
+                    remat_stages=remat_stages, layer_mask=mask)
+            else:
+                out = pipelined_apply(blocks_p, x, n_stages,
+                                      remat_stages=remat_stages,
+                                      layer_mask=mask, collect_aux=use_moe)
             x, aux = out if use_moe else (out, 0.0)
             logits = m.head(x.reshape(nm * mb, s, -1))
             loss = lm_loss(logits, tokens.reshape(nm * mb, s))
@@ -990,7 +1147,8 @@ def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,
     return jax.jit(step, donate_argnums=(0, 1, 2))
 
 
-def init_pipelined_state(model: GPT, optimizer, mesh: Mesh, n_stages: int):
+def init_pipelined_state(model: GPT, optimizer, mesh: Mesh, n_stages: int,
+                         n_virtual: int = 1):
     """Split params into (embedding/head dict, pp-stacked blocks) and place
     them on the mesh."""
     params, _ = model.split_params()
@@ -1001,13 +1159,17 @@ def init_pipelined_state(model: GPT, optimizer, mesh: Mesh, n_stages: int):
     emb_params = {k: jax.device_put(
         jnp.copy(v), NamedSharding(mesh, partition_spec(k))) for k, v in
         emb_params.items()}
-    stacked, _ = stack_blocks_uneven(model, n_stages)
-    # `stacked` is itself a GPTBlock pytree (leaves have two extra leading
+    if n_virtual > 1:
+        stacked, _ = stack_blocks_interleaved(model, n_stages, n_virtual)
+    else:
+        stacked, _ = stack_blocks_uneven(model, n_stages)
+    # `stacked` is itself a GPTBlock pytree (leaves have extra leading
     # axes); place each named param per the pipeline rules.
     for name in sorted(stacked._params):
         arr = getattr(stacked, name)
         object.__setattr__(stacked, name, jax.device_put(
-            arr, NamedSharding(mesh, pipeline_partition_spec(name))))
+            arr, NamedSharding(mesh,
+                               pipeline_partition_spec(name, n_virtual))))
     opt_state = jax.jit(optimizer.init)((emb_params, stacked))
     return emb_params, stacked, opt_state
 

@@ -27,8 +27,8 @@ import jax.numpy as jnp
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layer.common import Linear
 from paddle_tpu.nn.layer.conv import Conv2D
-from paddle_tpu.nn.module import (Buffer, Module, Parameter,
-                                  current_context, is_training)
+from paddle_tpu.nn.module import (Module, Parameter, current_context,
+                                  is_training)
 
 __all__ = ["fake_quant", "QuantedLinear", "QuantedConv2D",
            "quantize_aware", "convert"]

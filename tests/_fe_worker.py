@@ -93,3 +93,125 @@ def worker(stage, store_port, schedule, tmpdir):
                  **out)
     ep.close()
     store.close()
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) pipeline: 4 global stages over 2 ranks, V=2
+# (≙ PipelineParallelWithInterleave, pipeline_parallel.py:457)
+# ---------------------------------------------------------------------------
+
+N_STAGES_V, N_VIRTUAL = 2, 2
+G = N_STAGES_V * N_VIRTUAL  # 4 global stages
+
+
+def make_params_g(g):
+    rs = np.random.RandomState(100 + g)
+    din = D if g == 0 else H
+    dout = K if g == G - 1 else H
+    return {"w": rs.normal(size=(din, dout)).astype(np.float32) * 0.3,
+            "b": np.zeros((dout,), np.float32)}
+
+
+def chunk_fn(g, sleep_s=0.0):
+    import time
+
+    import jax.numpy as jnp
+
+    if g == G - 1:
+        def last(params, x, label):
+            if sleep_s:
+                time.sleep(sleep_s)
+            pred = x @ params["w"] + params["b"]
+            return jnp.mean(jnp.square(pred - label))
+        return last
+
+    def mid(params, x):
+        if sleep_s:
+            time.sleep(sleep_s)
+        return jnp.maximum(x @ params["w"] + params["b"], 0.0)
+    return mid
+
+
+def reference_grads_vpp():
+    import jax
+    import jax.numpy as jnp
+    x, y = make_data()
+    ps = [make_params_g(g) for g in range(G)]
+
+    def loss_fn(ps):
+        total = 0.0
+        for mb in range(N_MICRO):
+            h = x[mb]
+            for g in range(G - 1):
+                h = jnp.maximum(h @ ps[g]["w"] + ps[g]["b"], 0.0)
+            pred = h @ ps[G - 1]["w"] + ps[G - 1]["b"]
+            total = total + jnp.mean(jnp.square(pred - y[mb]))
+        return total / N_MICRO
+
+    return float(loss_fn(ps)), jax.grad(loss_fn)(ps)
+
+
+def worker_vpp(rank, store_port, schedule, tmpdir, n_virtual=N_VIRTUAL,
+               sleep_s=0.0):
+    """One rank owning n_virtual chunks; with n_virtual=1 the same 4-layer
+    model runs as a 2-deep pipeline of 2-layer stages (for the bubble
+    comparison both variants do identical numeric work)."""
+    import time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu import native
+    from paddle_tpu.distributed.fleet_executor import (FleetExecutor,
+                                                       rendezvous_endpoints)
+
+    S = N_STAGES_V
+    store = native.TCPStore("127.0.0.1", store_port,
+                            is_master=(rank == 0), timeout=60.0)
+    ep, peers = rendezvous_endpoints(store, rank, S)
+    x, y = make_data()
+
+    if n_virtual > 1:
+        fns = [chunk_fn(v * S + rank, sleep_s) for v in range(n_virtual)]
+        params = [make_params_g(v * S + rank) for v in range(n_virtual)]
+    else:
+        # rank owns global stages [2r, 2r+1] fused into one callable
+        import jax.numpy as jnp
+        gs = [rank * 2, rank * 2 + 1]
+
+        def fused(params, x, label=None):
+            if sleep_s:
+                time.sleep(2 * sleep_s)  # same total work as two chunks
+            h = jnp.maximum(x @ params[0]["w"] + params[0]["b"], 0.0)
+            if rank == S - 1:
+                pred = h @ params[1]["w"] + params[1]["b"]
+                return jnp.mean(jnp.square(pred - label))
+            return jnp.maximum(h @ params[1]["w"] + params[1]["b"], 0.0)
+        fns = fused
+        params = [make_params_g(g) for g in gs]
+
+    fe = FleetExecutor(fns, rank, S, ep, peers, schedule=schedule,
+                       n_virtual=n_virtual)
+
+    walls = []
+    for step in range(2):
+        t0 = time.perf_counter()
+        grads, loss = fe.run(
+            params,
+            microbatches=list(x) if rank == 0 else None,
+            labels=list(y) if rank == S - 1 else None,
+            n_micro=N_MICRO)
+        walls.append(time.perf_counter() - t0)
+        out = {}
+        # V>1: grads is a per-chunk list of dicts; V==1 fused: grads
+        # mirrors params (a 2-list of dicts) — same enumeration either way
+        for i, gp in enumerate(grads):
+            for k, v in gp.items():
+                out[f"g{i}_{k}"] = np.asarray(v)
+        if loss is not None:
+            out["loss"] = np.float32(loss)
+        out["wall"] = np.float64(walls[-1])
+        np.savez(os.path.join(tmpdir, f"vpp{n_virtual}_rank{rank}_"
+                                      f"step{step}.npz"), **out)
+    fe.close()
+    ep.close()
+    store.close()

@@ -150,7 +150,7 @@ def test_enumerate_plans_covers_factorizations():
     from paddle_tpu.distributed.planner import enumerate_plans
     plans = enumerate_plans(8)
     assert all(d["dp"] * d["fsdp"] * d["tp"] == 8 for d in plans)
-    # all eight power-of-two factorizations of 8 over three axes
+    # tp in {1,2,4,8} leaves 8/tp for fsdp: 4+3+2+1 assignments
     assert len(plans) == 10
     assert {"dp": 8, "fsdp": 1, "tp": 1} in plans
     assert {"dp": 1, "fsdp": 1, "tp": 8} in plans
