@@ -143,20 +143,24 @@ class ElasticRegistry:
                        str(n_workers))
 
     def form_table(self, version: int, nnodes: int, timeout: float = 30.0,
-                   grace: float = 1.0):
+                   grace: float = 1.0, nnodes_min: int = 1):
         """Master only: gather this round's announcements and publish the
-        rank table. Waits up to ``timeout`` for the first announcement,
-        then ``grace`` seconds for stragglers; nodes that miss the window
-        are dropped from the membership (that IS the elastic semantics)."""
+        rank table. Waits up to ``timeout`` for ``nnodes_min`` nodes
+        (the elastic range's hard lower bound, ≙ --np MIN:MAX), then
+        ``grace`` seconds for stragglers beyond the minimum; nodes that
+        miss the window are dropped from the membership (that IS the
+        elastic semantics)."""
         assert self.is_master
         members = {}
         deadline = time.monotonic() + timeout
-        while not members and time.monotonic() < deadline:
+        while len(members) < nnodes_min and time.monotonic() < deadline:
             members = self._poll_round(version, nnodes, per_key_timeout=1.0)
-            if not members:
+            if len(members) < nnodes_min:
                 time.sleep(0.1)
-        if not members:
-            raise TimeoutError(f"no members announced for round {version}")
+        if len(members) < nnodes_min:
+            raise TimeoutError(
+                f"round {version}: only {len(members)} of the required "
+                f"{nnodes_min} nodes announced within {timeout}s")
         grace_end = time.monotonic() + grace
         while len(members) < nnodes and time.monotonic() < grace_end:
             time.sleep(0.1)

@@ -121,8 +121,10 @@ class FleetExecutor:
         self._step = 0
         self.is_first = stage_idx == 0
         self.is_last = stage_idx == n_stages - 1
-        # async send worker: FIFO queue keeps per-connection ordering
-        self._sendq: "queue.Queue" = queue.Queue()
+        # async send worker: FIFO queue keeps per-connection ordering;
+        # bounded so a stalled peer backpressures fwd() instead of letting
+        # every in-flight boundary activation pile up in host memory
+        self._sendq: "queue.Queue" = queue.Queue(maxsize=4)
         self._send_err: List[BaseException] = []
         self._sender = threading.Thread(target=self._send_loop, daemon=True)
         self._sender.start()

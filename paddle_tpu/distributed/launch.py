@@ -38,7 +38,11 @@ def _parse(argv):
         prog="paddle_tpu.distributed.launch",
         description="launch a (multi-host) training job")
     p.add_argument("--nproc_per_node", type=int, default=1)
-    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nnodes", default="1",
+                   help="node count, or MIN:MAX for an elastic range "
+                        "(≙ the reference's --np 2:4): the job runs with "
+                        "whatever node count inside the range announces "
+                        "each membership round, so late nodes can JOIN")
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument("--master", default="127.0.0.1:8765",
                    help="host:port of the jax.distributed coordinator "
@@ -58,7 +62,12 @@ def _parse(argv):
                         "when forming a membership round")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    lo, _, hi = str(args.nnodes).partition(":")
+    args.nnodes_min = int(lo)
+    args.nnodes_max = int(hi) if hi else args.nnodes_min
+    args.nnodes = args.nnodes_max
+    return args
 
 
 def _spawn(args, local_rank, rank=None, world=None, extra_env=None):
@@ -141,6 +150,15 @@ def _watch(procs, poll_s=0.2, should_abort=None):
 REFORM_RC = -1000  # internal: group killed because membership changed
 
 
+
+def _counter_value(raw) -> int:
+    """The native store's add() keeps counters as little-endian int64
+    bytes; a set() writes ascii. Accept both."""
+    try:
+        return int(raw)
+    except ValueError:
+        return int.from_bytes(raw, "little", signed=True)
+
 def _launch_elastic(args):
     """Membership-changing controller (≙ CollectiveElasticController,
     launch/controllers/collective.py:184, with the etcd master replaced by
@@ -166,6 +184,7 @@ def _launch_elastic(args):
     version = 0
     attempt = 0
     reform_seen = 0
+    join_attempts = 0
     try:
         while True:
             version += 1
@@ -181,9 +200,28 @@ def _launch_elastic(args):
             reg.publish(version, n_local)
             if is_master:
                 reg.form_table(version, args.nnodes,
-                               grace=args.elastic_grace)
+                               grace=args.elastic_grace,
+                               nnodes_min=args.nnodes_min)
             table, world = reg.wait_table(version)
             if args.node_rank not in table:
+                if not is_master and n_local > 0:
+                    # late JOINER (≙ manager.py:128 node-join watch): the
+                    # round's table was formed before this node announced;
+                    # ask the cluster to re-form and try the next round
+                    join_attempts += 1
+                    if join_attempts <= 3:
+                        print(f"[launch] node {args.node_rank} joining: "
+                              f"requesting re-form after round {version}",
+                              file=sys.stderr)
+                        reform_seen = store.add("elastic/reform", 1)
+                        time.sleep(0.5)
+                        continue
+                    # the node never made it into a table: exiting 0 would
+                    # read as success to the operator's orchestration
+                    print(f"[launch] node {args.node_rank} failed to join "
+                          f"after {join_attempts - 1} re-form requests",
+                          file=sys.stderr)
+                    return 1
                 if not is_master:
                     store.set(f"elastic/done/{version}/{args.node_rank}", "1")
                     return 0  # dropped from membership; nothing to run
@@ -207,7 +245,7 @@ def _launch_elastic(args):
             def reform_requested():
                 nonlocal reform_seen
                 try:
-                    c = int(store.get("elastic/reform", timeout=0.2))
+                    c = _counter_value(store.get("elastic/reform", timeout=0.2))
                 except (TimeoutError, ValueError):
                     return False
                 if c > reform_seen:
@@ -265,7 +303,7 @@ def _master_wait_members(store, table, version, reform_seen,
             except TimeoutError:
                 pass
         try:
-            c = int(store.get("elastic/reform", timeout=0.2))
+            c = _counter_value(store.get("elastic/reform", timeout=0.2))
             if c > reform_seen:
                 return ("reform", c)
         except (TimeoutError, ValueError):

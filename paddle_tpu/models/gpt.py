@@ -767,29 +767,38 @@ def stack_blocks_uneven(model: GPT, n_stages: int):
     the mask guarantees they are never applied). Returns (stacked, mask)
     where mask is (n_stages, lps) bool — None when evenly divisible."""
     L = model.cfg.n_layers
-    kinds = {model.blocks[i].moe is not None for i in range(L)}
-    if len(kinds) > 1:
-        raise ValueError(
-            "pipeline stacking needs homogeneous blocks (all dense or all "
-            "MoE, e.g. moe_every=1); mixed dense/MoE stacks cannot stack")
     lps = -(-L // n_stages)  # ceil
     counts = [min(lps, L - s * lps) for s in range(n_stages)]
     if any(c <= 0 for c in counts):
         raise ValueError(f"{L} layers over {n_stages} stages leaves an "
                          f"empty stage; reduce n_stages")
+    stacked = _stack_block_rows(model, counts, lps, (n_stages,))
+    return stacked, layer_slot_mask(L, n_stages)
+
+
+def _stack_block_rows(model, counts, slots, lead_shape):
+    """Stack per-layer block pytrees into groups of ``slots`` layer slots
+    (one group per entry in ``counts``), padding short groups by REUSING
+    their first layer's weights under a run-time mask, then reshape the
+    leading group axis to ``lead_shape``. Shared by the plain and
+    interleaved stackings."""
+    kinds = {b.moe is not None for b in model.blocks}
+    if len(kinds) > 1:
+        raise ValueError(
+            "pipeline stacking needs homogeneous blocks (all dense or all "
+            "MoE, e.g. moe_every=1); mixed dense/MoE stacks cannot stack")
     rows = []
     idx = 0
-    for s in range(n_stages):
-        take = counts[s]
+    for take in counts:
         layer_ids = list(range(idx, idx + take))
         idx += take
-        layer_ids += [layer_ids[0]] * (lps - take)  # placeholders, masked
+        layer_ids += [layer_ids[0]] * (slots - take)  # placeholders, masked
         rows.append([model.blocks[i] for i in layer_ids])
     flat = [b for row in rows for b in row]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *flat)
-    stacked = jax.tree_util.tree_map(
-        lambda x: x.reshape((n_stages, lps) + x.shape[1:]), stacked)
-    return stacked, layer_slot_mask(L, n_stages)
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape(tuple(lead_shape) + (slots,) + x.shape[1:]),
+        stacked)
 
 
 def layer_slot_mask(n_layers: int, n_stages: int):
@@ -812,26 +821,11 @@ def stack_blocks_interleaved(model: GPT, n_stages: int, n_virtual: int):
     L = model.cfg.n_layers
     S, V = n_stages, n_virtual
     G = S * V
-    kinds = {model.blocks[i].moe is not None for i in range(L)}
-    if len(kinds) > 1:
-        raise ValueError("pipeline stacking needs homogeneous blocks")
     if L < G:
         raise ValueError(f"{L} layers over {G} global stages leaves an "
                          f"empty stage; reduce n_stages or n_virtual")
     counts = _balanced_counts(L, G)
-    lpg = counts[0]
-    rows = []
-    idx = 0
-    for g in range(G):
-        take = counts[g]
-        layer_ids = list(range(idx, idx + take))
-        idx += take
-        layer_ids += [layer_ids[0]] * (lpg - take)  # placeholders, masked
-        rows.append([model.blocks[i] for i in layer_ids])
-    flat = [b for row in rows for b in row]
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *flat)
-    stacked = jax.tree_util.tree_map(
-        lambda x: x.reshape((V, S, lpg) + x.shape[1:]), stacked)
+    stacked = _stack_block_rows(model, counts, counts[0], (V, S))
     return stacked, interleaved_slot_mask(L, S, V)
 
 

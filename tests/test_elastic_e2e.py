@@ -129,3 +129,140 @@ def test_kill_worker_reform_smaller_resume(tmp_path):
     assert resume_loss <= last_pre * 1.10 + 1e-3, (resume_loss, last_pre)
     # training completed all 6 epochs
     assert max(e["epoch"] for e in log) == 5
+
+
+SCRIPT_GROW = """
+import json, os, sys, time
+
+rank = int(os.environ["PT_PROCESS_ID"])
+world = int(os.environ["PT_NUM_PROCESSES"])
+version = int(os.environ["PT_ELASTIC_VERSION"])
+workdir = r"{workdir}"
+done_file = os.path.join(workdir, "done")
+log_file = os.path.join(workdir, "loss_log.jsonl")
+
+if rank != 0:
+    while not os.path.exists(done_file):
+        time.sleep(0.2)
+    sys.exit(0)
+
+# ---- rank 0: train on a dp=<world> virtual mesh with AutoCheckpoint ----
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count="
+                           + str(world))
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+from paddle_tpu import optimizer as optim
+from paddle_tpu.models import gpt
+
+topo = dist.init_mesh(dp=world)
+cfg = gpt.GPTConfig(vocab_size=128, max_seq_len=16, d_model=32,
+                    n_layers=2, n_heads=2, dtype=jnp.float32)
+model = gpt.GPT(cfg, seed=0)
+opt = optim.SGD(learning_rate=0.05)
+params, opt_state = gpt.init_train_state(model, opt, topo.mesh)
+step = gpt.build_train_step(model, opt, topo.mesh)
+
+ck = AutoCheckpoint(os.path.join(workdir, "ckpt"), job_id="job", keep=3)
+fresh = {{"params": params, "opt": opt_state,
+          "epoch": jnp.zeros((), jnp.int32)}}
+state = ck.restore_like(fresh, mesh=topo.mesh)
+if state is not None:
+    params, opt_state = state["params"], state["opt"]
+    start_epoch = int(state["epoch"]) + 1
+else:
+    start_epoch = 0
+
+tokens = jnp.asarray(np.random.RandomState(0).randint(
+    0, cfg.vocab_size, (12, cfg.max_seq_len)), jnp.int32)
+rng = jax.random.PRNGKey(0)
+for epoch in range(start_epoch, 8):
+    params, opt_state, loss = step(params, opt_state, tokens, rng)
+    with open(log_file, "a") as f:
+        f.write(json.dumps({{"version": version, "world": world,
+                             "epoch": epoch, "loss": float(loss)}}) + "\\n")
+    ck.save({{"params": params, "opt": opt_state,
+              "epoch": jnp.asarray(epoch, jnp.int32)}}, epoch)
+    # at world 2 the job idles after epoch 3 until the joining node's
+    # re-form kills this process group — the world-2 run must not finish
+    # before the (slow to start) joiner lands; at world 3 run to the end
+    while world == 2 and epoch >= 3:
+        time.sleep(0.2)
+
+open(done_file, "w").close()
+"""
+
+
+@pytest.mark.skipif(not native.is_available(),
+                    reason="native toolchain unavailable")
+def test_join_node_reform_larger_resume(tmp_path):
+    """Scale-UP: a 2-worker job re-forms at world 3 when a node JOINS
+    (≙ fleet/elastic/manager.py:128 node-join watch), resuming from the
+    resharding checkpoint onto the larger mesh."""
+    import time
+
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(
+        SCRIPT_GROW.format(workdir=str(tmp_path))))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    # pid-derived port: a previous aborted run's orphaned launcher must
+    # never squat this run's registry port
+    port = 7911 + (os.getpid() % 500) * 2
+    base = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+            "--master", f"127.0.0.1:{port}", "--elastic",
+            "--nnodes", "1:2", "--max_restarts", "2"]
+    master = joiner = None
+    try:
+        master = subprocess.Popen(
+            base + ["--nproc_per_node", "2", "--node_rank", "0",
+                    str(script)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+
+        # wait until the world-2 job has trained (and checkpointed)
+        log_path = tmp_path / "loss_log.jsonl"
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if log_path.exists() and \
+                    len(log_path.read_text().splitlines()) >= 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("world-2 training never produced a log")
+
+        joiner = subprocess.Popen(
+            base + ["--nproc_per_node", "1", "--node_rank", "1",
+                    str(script)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+
+        m_out, m_err = master.communicate(timeout=300)
+        j_out, j_err = joiner.communicate(timeout=60)
+    finally:
+        # the launcher's children run in their own sessions: on any abort,
+        # reap launchers AND their spawned trainers or they hold the port
+        for p in (master, joiner):
+            if p is not None and p.poll() is None:
+                p.kill()
+        subprocess.run(["pkill", "-9", "-f", str(script)], check=False)
+    assert master.returncode == 0, (master.returncode, m_err[-3000:])
+    assert joiner.returncode == 0, (joiner.returncode, j_err[-3000:])
+
+    log = [json.loads(line) for line in
+           log_path.read_text().splitlines()]
+    worlds = {e["world"] for e in log}
+    assert worlds == {2, 3}, f"expected re-formation 2→3, got {worlds}"
+    assert "requesting re-form" in j_err, j_err[-2000:]
+
+    v1 = [e for e in log if e["world"] == 2]
+    v2 = [e for e in log if e["world"] == 3]
+    assert v1 and v2
+    # resumed from checkpoint onto the LARGER mesh: epochs continue
+    assert v1[-1]["epoch"] >= v2[0]["epoch"] - 1
+    assert v2[0]["epoch"] >= 1
+    assert v2[0]["loss"] <= log[0]["loss"], (v2[0], log[0])
+    assert max(e["epoch"] for e in log) == 7
