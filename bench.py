@@ -426,6 +426,32 @@ def bench_bert(jax, jnp, peak, smoke=False):
     nsp = jnp.asarray(rs.randint(0, 2, (b,)), jnp.int32)
     rng = jax.random.PRNGKey(0)
     args = (tokens, type_ids, attn, labels, nsp, rng)
+
+    tuned = None
+    if not smoke and jax.default_backend() == "tpu":
+        # block-size autotune on the encoder's exact attention shapes
+        # (VERDICT r3 item 8): the winner lands in the persistent cache,
+        # and the jitted step's trace-time lookup picks it up below. A
+        # second bench run hits the cache and skips the sweep entirely.
+        try:
+            from paddle_tpu.ops.pallas.flash_attention import (
+                tune_flash_attention)
+            dh = cfg.d_model // cfg.n_heads
+            rs2 = np.random.RandomState(7)
+            qt, kt, vt = (jnp.asarray(rs2.randn(b, s, cfg.n_heads, dh),
+                                      jnp.bfloat16) for _ in range(3))
+            best, timings = tune_flash_attention(
+                qt, kt, vt, kv_lens=jnp.full((b,), s, jnp.int32),
+                bias=jnp.zeros((b, 1, 1, s), jnp.float32),
+                candidates=[(256, 512), (512, 512), (256, 256),
+                            (512, 256)], iters=2)
+            tuned = {"blocks": list(best),
+                     "sweep_ms": {f"{bq}x{bk}": round(t * 1e3, 2)
+                                  for (bq, bk), t in timings.items()},
+                     "cache_hit": not timings}
+        except Exception as e:
+            tuned = {"error": str(e)[:120]}
+
     compiled = step.lower(params, opt_state, *args).compile()
     for _ in range(2):
         params, opt_state, loss = compiled(params, opt_state, *args)
@@ -438,8 +464,11 @@ def bench_bert(jax, jnp, peak, smoke=False):
     dt = (time.perf_counter() - t0) / iters
     tps = b * s / dt
     mfu = cfg.flops_per_token() * tps / peak
-    return {"bert_base_tokens_per_sec_per_chip": round(tps, 1),
-            "bert_base_mfu": round(mfu, 4)}
+    out = {"bert_base_tokens_per_sec_per_chip": round(tps, 1),
+           "bert_base_mfu": round(mfu, 4)}
+    if tuned is not None:
+        out["bert_flash_autotune"] = tuned
+    return out
 
 
 def bench_decode(jax, jnp, peak, smoke=False):

@@ -57,11 +57,9 @@ class ElasticManager:
             raw = self.store.get(self._hb_key(rank), timeout=1.0)
         except (TimeoutError, ValueError):
             return None
-        # store.add keeps counters as raw little-endian int64
-        if len(raw) == 8:
-            return int.from_bytes(raw, "little", signed=True)
+        from paddle_tpu.native import decode_counter
         try:
-            return int(raw)
+            return decode_counter(raw)
         except ValueError:
             return None
 

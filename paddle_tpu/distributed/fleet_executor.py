@@ -27,8 +27,12 @@ Sends are handed to a background worker thread (device_get → pack →
 socket) so the next microbatch's compute dispatches while the previous
 boundary tensor is still in flight — the comm/compute overlap the
 reference gets from its async interceptor queues. Per-worker FIFO keeps
-message order deterministic. Deadlock-free by construction: receives
-block, sends never do.
+message order deterministic. The send queue is bounded (a few in-flight
+boundary tensors); a peer that stops draining its socket surfaces as a
+queue-full/timeout error rather than a silent hang or unbounded host
+memory. (The receiver's native mailbox is itself unbounded — a full
+credit protocol is future work; the bound here caps the SENDER's
+pyramid of live activations, which is where fthenb piles them up.)
 """
 
 import io
@@ -147,7 +151,10 @@ class FleetExecutor:
                 self._sendq.task_done()
 
     def _send(self, stage: int, kind: int, mb: int, value, chunk: int = 0):
-        self._sendq.put((stage, kind, chunk, mb, self._step, value))
+        # bounded put: a wedged peer surfaces as queue.Full after the
+        # executor timeout instead of a silent indefinite block
+        self._sendq.put((stage, kind, chunk, mb, self._step, value),
+                        timeout=self.timeout)
 
     def _flush_sends(self):
         self._sendq.join()

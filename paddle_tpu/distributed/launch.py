@@ -64,8 +64,14 @@ def _parse(argv):
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     lo, _, hi = str(args.nnodes).partition(":")
-    args.nnodes_min = int(lo)
-    args.nnodes_max = int(hi) if hi else args.nnodes_min
+    try:
+        args.nnodes_min = int(lo)
+        args.nnodes_max = int(hi) if hi else args.nnodes_min
+    except ValueError:
+        p.error(f"--nnodes must be N or MIN:MAX, got {args.nnodes!r}")
+    if not 1 <= args.nnodes_min <= args.nnodes_max:
+        p.error(f"--nnodes range must satisfy 1 <= MIN <= MAX, "
+                f"got {args.nnodes!r}")
     args.nnodes = args.nnodes_max
     return args
 
@@ -150,15 +156,6 @@ def _watch(procs, poll_s=0.2, should_abort=None):
 REFORM_RC = -1000  # internal: group killed because membership changed
 
 
-
-def _counter_value(raw) -> int:
-    """The native store's add() keeps counters as little-endian int64
-    bytes; a set() writes ascii. Accept both."""
-    try:
-        return int(raw)
-    except ValueError:
-        return int.from_bytes(raw, "little", signed=True)
-
 def _launch_elastic(args):
     """Membership-changing controller (≙ CollectiveElasticController,
     launch/controllers/collective.py:184, with the etcd master replaced by
@@ -198,11 +195,24 @@ def _launch_elastic(args):
                         break
                     time.sleep(0.1)
             reg.publish(version, n_local)
-            if is_master:
-                reg.form_table(version, args.nnodes,
-                               grace=args.elastic_grace,
-                               nnodes_min=args.nnodes_min)
-            table, world = reg.wait_table(version)
+            try:
+                if is_master:
+                    reg.form_table(version, args.nnodes,
+                                   grace=args.elastic_grace,
+                                   nnodes_min=args.nnodes_min)
+                table, world = reg.wait_table(version)
+            except TimeoutError as e:
+                # below-minimum membership (a node is late) is a WAIT
+                # state, not a crash: announce the next round and keep
+                # trying — the elastic semantics (≙ manager.py's watch
+                # loop idling until min nodes register)
+                print(f"[launch] round {version} incomplete ({e}); "
+                      f"retrying", file=sys.stderr)
+                time.sleep(1.0)
+                continue
+            if args.node_rank in table:
+                join_attempts = 0  # an established member re-earns its
+                # join budget for any later re-form race
             if args.node_rank not in table:
                 if not is_master and n_local > 0:
                     # late JOINER (≙ manager.py:128 node-join watch): the
@@ -245,7 +255,8 @@ def _launch_elastic(args):
             def reform_requested():
                 nonlocal reform_seen
                 try:
-                    c = _counter_value(store.get("elastic/reform", timeout=0.2))
+                    c = native.decode_counter(
+                        store.get("elastic/reform", timeout=0.2))
                 except (TimeoutError, ValueError):
                     return False
                 if c > reform_seen:
@@ -293,6 +304,8 @@ def _master_wait_members(store, table, version, reform_seen,
     plane mid-job. Blocks until each member posts its done key — or a
     member requests a re-form (returns ("reform", counter) so the master
     loop can drive the next round even with zero local workers)."""
+    from paddle_tpu import native
+
     deadline = time.time() + timeout
     pending = set(table)
     while pending and time.time() < deadline:
@@ -303,7 +316,8 @@ def _master_wait_members(store, table, version, reform_seen,
             except TimeoutError:
                 pass
         try:
-            c = _counter_value(store.get("elastic/reform", timeout=0.2))
+            c = native.decode_counter(
+                        store.get("elastic/reform", timeout=0.2))
             if c > reform_seen:
                 return ("reform", c)
         except (TimeoutError, ValueError):

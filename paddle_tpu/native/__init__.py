@@ -112,6 +112,15 @@ def _configure(lib, ctypes):
     lib.ptpp_destroy.argtypes = [c.c_void_p]
 
 
+def decode_counter(raw) -> int:
+    """Decode a TCPStore counter value: ``add()`` keeps counters as raw
+    little-endian int64 bytes; a ``set()`` writes ascii. One decoder for
+    every consumer (elastic heartbeats, launch re-form watch)."""
+    if isinstance(raw, (bytes, bytearray)) and len(raw) == 8:
+        return int.from_bytes(raw, "little", signed=True)
+    return int(raw)
+
+
 def is_available() -> bool:
     return _load() is not None
 
@@ -283,5 +292,6 @@ class P2PEndpoint:
             self._h = None
 
 
-__all__ = ["is_available", "get_lib", "ShmRingBuffer", "TCPStore",
+__all__ = ["is_available", "get_lib", "decode_counter",
+           "ShmRingBuffer", "TCPStore",
            "P2PEndpoint"]

@@ -475,9 +475,74 @@ def _flash_bwd(causal, scale, block_q, block_k, group, bias_mode, bias_sq1,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _tune_key(b, sq, sk, h_q, h_kv, d, dtype, causal, has_kvlens,
+              has_bias, has_dropout):
+    from paddle_tpu.ops.pallas.autotune import AutotuneCache
+    return AutotuneCache.key(
+        "flash_attention", b=b, sq=sq, sk=sk, hq=h_q, hkv=h_kv, d=d,
+        dtype=str(dtype), causal=bool(causal), kvlens=bool(has_kvlens),
+        bias=bool(has_bias), dropout=bool(has_dropout))
+
+
+# measured default on a v5e chip (see flash_attention docstring); used
+# when the autotune cache has no entry for the shape
+_DEFAULT_BLOCKS = (256, 512)
+
+
+def tune_flash_attention(q, k, v, causal=False, scale=None, kv_lens=None,
+                         bias=None, dropout_p=0.0, dropout_seed=None,
+                         candidates=None, include_bwd=True, iters=3):
+    """Eagerly measure flash-attention block candidates on the REAL shapes
+    and persist the winner (≙ auto_tune_base.h PickBestKernel — Pallas
+    block sizes are trace-time constants, so tuning runs outside jit; any
+    later ``flash_attention`` call on these shapes picks the tuned blocks
+    from the cache at trace time). Returns ((block_q, block_k), timings).
+    """
+    import jax as _jax
+
+    from paddle_tpu.ops.pallas import autotune as at
+
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    b, sq, h_q, d = q.shape
+    sk, h_kv = k.shape[1], k.shape[2]
+    key = _tune_key(b, sq, sk, h_q, h_kv, d, q.dtype, causal,
+                    kv_lens is not None, bias is not None, dropout_p > 0)
+    if candidates is None:
+        candidates = [(128, 128), (128, 256), (256, 256), (256, 512),
+                      (512, 256), (512, 512), (1024, 512)]
+    lim_q, lim_k = _round_up(sq, _LANES), _round_up(sk, _LANES)
+    candidates = sorted({(min(bq, lim_q), min(bk, lim_k))
+                         for bq, bk in candidates})
+
+    # one jitted callable per candidate, built once: the timing loop must
+    # measure kernel runtime, not re-trace/re-compile every call
+    jitted = {}
+
+    def build_and_run(cfg):
+        if cfg not in jitted:
+            bq, bk = cfg
+
+            def fwd(q, k, v, _bq=bq, _bk=bk):
+                o = flash_attention(q, k, v, causal=causal, scale=scale,
+                                    kv_lens=kv_lens, bias=bias,
+                                    dropout_p=dropout_p,
+                                    dropout_seed=dropout_seed,
+                                    block_q=_bq, block_k=_bk)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            fn = _jax.grad(fwd, argnums=(0, 1, 2)) if include_bwd else fwd
+            jitted[cfg] = _jax.jit(fn)
+        out = jitted[cfg](q, k, v)
+        leaf = _jax.tree_util.tree_leaves(out)[0]
+        float(leaf.reshape(-1)[0] if leaf.ndim else leaf)  # sync
+
+    return at.tune("flash_attention", key, candidates, build_and_run,
+                   iters=iters)
+
+
 def flash_attention(q, k, v, causal=False, scale=None, kv_lens=None,
                     bias=None, dropout_p=0.0, dropout_seed=None,
-                    block_q=256, block_k=512, interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """Flash attention over (B, S, H, D) inputs; returns (B, S, Hq, D).
 
     Args:
@@ -515,6 +580,17 @@ def flash_attention(q, k, v, causal=False, scale=None, kv_lens=None,
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+
+    if block_q is None or block_k is None:
+        # trace-time cache lookup (tune_flash_attention fills it); the
+        # measured v5e default otherwise
+        from paddle_tpu.ops.pallas.autotune import get_cache
+        hit = get_cache().get(_tune_key(
+            b, sq, sk, h_q, h_kv, d, q.dtype, causal, kv_lens is not None,
+            bias is not None, dropout_p > 0))
+        tuned = hit if hit is not None else _DEFAULT_BLOCKS
+        block_q = block_q if block_q is not None else tuned[0]
+        block_k = block_k if block_k is not None else tuned[1]
 
     # clamp blocks for short sequences — padding 128 rows up to a 256/512
     # block would multiply the real work

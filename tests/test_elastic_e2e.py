@@ -214,7 +214,8 @@ def test_join_node_reform_larger_resume(tmp_path):
     port = 7911 + (os.getpid() % 500) * 2
     base = [sys.executable, "-m", "paddle_tpu.distributed.launch",
             "--master", f"127.0.0.1:{port}", "--elastic",
-            "--nnodes", "1:2", "--max_restarts", "2"]
+            "--nnodes", "1:2", "--max_restarts", "2",
+            "--elastic_grace", "3"]
     master = joiner = None
     try:
         master = subprocess.Popen(
