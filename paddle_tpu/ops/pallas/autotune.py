@@ -103,6 +103,7 @@ def tune(kernel: str, key: str, candidates: Sequence,
     if hit is not None:
         return hit, {}
     timings: Dict = {}
+    last_exc = None
     for config in candidates:
         ckey = tuple(config) if isinstance(config, (list, tuple)) \
             else config
@@ -114,11 +115,12 @@ def tune(kernel: str, key: str, candidates: Sequence,
             for _ in range(iters):
                 build_and_run(config)
             timings[ckey] = (time.perf_counter() - t0) / iters
-        except Exception:
+        except Exception as e:  # a config the backend rejects is skipped
+            last_exc = e
             continue
     if not timings:
         raise ValueError(f"autotune({kernel}): every candidate failed "
-                         f"for key {key}")
+                         f"for key {key}") from last_exc
     best = min(timings, key=timings.get)
     cache.put(key, best)
     return best, timings

@@ -116,47 +116,76 @@ class Executor:
                         if isinstance(f, str) and f.endswith("@GRAD")]
         var_fetches = [f for f in fetch_list if isinstance(f, Variable)]
         feed_vals = {k: jnp.asarray(v) for k, v in feed.items()}
+        # run-mode + per-run dropout seed as ordinary (traced) inputs —
+        # the reference bakes is_test into cloned programs; here the
+        # clone only flips the flag the executor feeds
+        feed_vals["__training__"] = jnp.asarray(not program._is_test)
+        feed_vals["__rng__"] = jnp.asarray(
+            np.random.randint(0, 2 ** 31 - 1), jnp.uint32)
+        # only buffer updates whose data inputs are fed this run (partial
+        # feed/fetch must not trace unrelated branches)
+        buf_updates = [n for n in sorted(program._buffer_updates)
+                       if program.data_deps(program._buffer_updates[n])
+                       <= set(feed)]
         key = (id(program), program._version,
                tuple(sorted((k, v.shape, str(v.dtype))
                             for k, v in feed_vals.items())),
                tuple(v.name for v in var_fetches),
-               tuple(grad_fetches))
+               tuple(grad_fetches), tuple(buf_updates))
         step = self._cache.get(key)
         opt = program._opt
+        feed_names = [k for k in feed] + ["__training__", "__rng__"]
         if step is None:
-            fwd = program.build_fn(var_fetches, list(feed))
+            fwd = program.build_fn(var_fetches, feed_names)
+            upd_fn = program.build_fn(
+                [program._buffer_updates[n] for n in buf_updates],
+                feed_names) if buf_updates else None
             loss_var = None
             if opt is not None:
                 loss_var = opt[1]
             elif grad_fetches:
                 loss_var = program._loss_for_grads
-            loss_fn = (program.build_fn([loss_var], list(feed))
+            loss_fn = (program.build_fn([loss_var], feed_names)
                        if loss_var is not None else None)
 
-            def step(feed_vals, params, opt_state):
-                fetched = fwd(feed_vals, params)
+            def step(feed_vals, params, buffers, opt_state):
+                fetched = fwd(feed_vals, params, buffers)
                 grads = None
                 if loss_fn is not None:
                     grads = jax.grad(
-                        lambda p: loss_fn(feed_vals, p)[0])(params)
+                        lambda p: loss_fn(feed_vals, p, buffers)[0])(params)
                 new_params, new_state = params, opt_state
                 if opt is not None:
                     new_params, new_state = opt[0].update(
                         grads, opt_state, params)
+                new_buffers = buffers
+                if upd_fn is not None:
+                    # where(training, ...)-guarded: identity on test runs
+                    vals = upd_fn(feed_vals, params, buffers)
+                    new_buffers = dict(buffers)
+                    for n, v in zip(buf_updates, vals):
+                        new_buffers[n] = v
                 gvals = []
                 for gf in grad_fetches:
                     gvals.append(grads[program._grad_names[gf]])
-                return fetched, gvals, new_params, new_state
+                return fetched, gvals, new_params, new_buffers, new_state
 
             step = jax.jit(step)
             self._cache[key] = step
         if opt is not None and program._opt_state is None:
             program._opt_state = opt[0].init(program.params)
-        fetched, gvals, new_params, new_state = step(
-            feed_vals, program.params, program._opt_state)
+        fetched, gvals, new_params, new_buffers, new_state = step(
+            feed_vals, program.params, program.buffers,
+            program._opt_state)
         if opt is not None:
-            program.params = new_params       # reference scope mutation
+            # in-place: the scope dict is SHARED with clone(for_test=True)
+            # programs, which must observe the trained parameters
+            program.params.clear()
+            program.params.update(new_params)
             program._opt_state = new_state
+        if buf_updates:
+            program.buffers.clear()           # shared dict: clones see the
+            program.buffers.update(new_buffers)  # updated running stats
         out = []
         gi = vi = 0
         for f in fetch_list:

@@ -104,6 +104,11 @@ class Program:
     def __init__(self):
         self.vars: Dict[str, Variable] = {}
         self.params: Dict[str, jnp.ndarray] = {}   # the "scope"
+        self.buffers: Dict[str, jnp.ndarray] = {}  # non-trainable state
+        # buffer-name → Variable computing its post-step value (BN running
+        # stats); applied by the executor on TRAIN programs only
+        self._buffer_updates: Dict[str, Variable] = {}
+        self._is_test = False
         self._counter = 0
         self._version = 0          # bumped on mutation → executor recompile
         self._opt = None           # (optimizer, loss Variable)
@@ -136,12 +141,28 @@ class Program:
         var = Variable(self, name, shape, dtype, kind="param")
         return self.add_var(var)
 
+    def create_buffer(self, shape, name=None, initializer=None,
+                      dtype=jnp.float32):
+        """Non-trainable scope state (BN running stats): evaluated like a
+        param but excluded from grads/optimizer updates."""
+        name = name or self._unique("buffer")
+        init = (np.zeros(shape, "float32") if initializer is None
+                else np.asarray(initializer(shape), "float32"))
+        self.buffers[name] = jnp.asarray(init, dtype)
+        var = Variable(self, name, shape, dtype, kind="buffer")
+        return self.add_var(var)
+
     def clone(self, for_test: bool = False):
         """Shallow clone sharing the parameter scope (≙ Program.clone —
-        the reference's test clone also shares parameters)."""
+        the reference's test clone shares parameters and flips ops to
+        is_test: here the mode is a run-time input, so the clone just
+        records the flag and the executor feeds eval-mode)."""
         p = Program()
         p.vars = dict(self.vars)
         p.params = self.params      # shared scope, like the reference
+        p.buffers = self.buffers
+        p._buffer_updates = self._buffer_updates
+        p._is_test = for_test or self._is_test
         p._counter = self._counter
         return p
 
@@ -149,26 +170,64 @@ class Program:
         return self                 # single-block programs (API parity)
 
     # -- evaluation -----------------------------------------------------------
-    def _eval(self, var: Variable, feed_vals, params, memo):
+    def _eval(self, var: Variable, feed_vals, params, buffers, memo):
         if var.name in memo:
             return memo[var.name]
         if var.kind == "data":
             val = feed_vals[var.name]
         elif var.kind == "param":
             val = params[var.name]
+        elif var.kind == "buffer":
+            val = buffers[var.name]
+        elif var.kind == "mode":
+            val = feed_vals["__training__"]
+        elif var.kind == "rng":
+            val = feed_vals["__rng__"]
         else:
-            args = [self._eval(v, feed_vals, params, memo)
+            args = [self._eval(v, feed_vals, params, buffers, memo)
                     for v in var.inputs]
             val = var.op(*args)
         memo[var.name] = val
         return val
 
+    def data_deps(self, var: Variable):
+        """Names of the ``data`` placeholders var transitively reads —
+        the executor uses this to skip buffer-update graphs whose inputs
+        are not in the current feed (partial-fetch runs)."""
+        out = set()
+        stack = [var]
+        seen = set()
+        while stack:
+            v = stack.pop()
+            if v.name in seen:
+                continue
+            seen.add(v.name)
+            if v.kind == "data":
+                out.add(v.name)
+            stack.extend(v.inputs)
+        return out
+
+    def _mode_var(self) -> Variable:
+        """Shared run-mode input (True = training); the executor feeds it
+        from the program's _is_test flag (≙ the reference rewriting ops to
+        is_test in Program.clone — here mode is a run-time input)."""
+        if "__mode__" not in self.vars:
+            self.add_var(Variable(self, "__mode__", (), jnp.bool_,
+                                  kind="mode"))
+        return self.vars["__mode__"]
+
+    def _rng_var(self) -> Variable:
+        if "__rngv__" not in self.vars:
+            self.add_var(Variable(self, "__rngv__", (), jnp.uint32,
+                                  kind="rng"))
+        return self.vars["__rngv__"]
+
     def build_fn(self, fetch_vars: Sequence[Variable],
                  feed_names: Sequence[str]):
-        """Pure function (feed_vals, params) → fetched values."""
-        def fn(feed_vals, params):
+        """Pure function (feed_vals, params, buffers) → fetched values."""
+        def fn(feed_vals, params, buffers):
             memo = {}
-            return [self._eval(v, feed_vals, params, memo)
+            return [self._eval(v, feed_vals, params, buffers, memo)
                     for v in fetch_vars]
         return fn
 
@@ -258,6 +317,220 @@ class _StaticNN:
 
         out = Variable(prog, prog._unique(name or "fc"), kind="op", op=op,
                        inputs=[x, w, b])
+        return prog.add_var(out)
+
+    @staticmethod
+    def conv2d(x: Variable, num_filters: int, filter_size, stride=1,
+               padding=0, dilation=1, groups=1, activation=None,
+               name=None):
+        """≙ static.nn.conv2d (NCHW; weight OIHW like the reference)."""
+        prog = x.program
+        fs = ((filter_size, filter_size) if isinstance(filter_size, int)
+              else tuple(filter_size))
+        in_c = x.shape[1]
+        w = prog.create_parameter((num_filters, in_c // groups) + fs,
+                                  name=name and f"{name}.w")
+        b = prog.create_parameter((num_filters,),
+                                  name=name and f"{name}.b",
+                                  initializer=lambda s: np.zeros(s))
+
+        def op(xv, wv, bv):
+            from paddle_tpu.nn import functional as F
+            out = F.conv2d(xv, wv, bv, stride=stride, padding=padding,
+                           dilation=dilation, groups=groups)
+            if activation is not None:
+                out = getattr(F, activation)(out)
+            return out
+
+        out = Variable(prog, prog._unique(name or "conv2d"), kind="op",
+                       op=op, inputs=[x, w, b])
+        if x.shape is not None:  # static shape propagation for builders
+            st = (stride, stride) if isinstance(stride, int) else stride
+            pd = (padding, padding) if isinstance(padding, int) else padding
+            dl = ((dilation, dilation) if isinstance(dilation, int)
+                  else dilation)
+            eff = [(f - 1) * d + 1 for f, d in zip(fs, dl)]
+            h = (x.shape[2] + 2 * pd[0] - eff[0]) // st[0] + 1
+            wdt = (x.shape[3] + 2 * pd[1] - eff[1]) // st[1] + 1
+            out.shape = (x.shape[0], num_filters, h, wdt)
+        return prog.add_var(out)
+
+    @staticmethod
+    def pool2d(x: Variable, pool_size=2, pool_type="max", pool_stride=None,
+               pool_padding=0, name=None):
+        """≙ static.nn.pool2d ('max' or 'avg')."""
+        if pool_type not in ("max", "avg"):
+            raise ValueError(f"pool_type must be 'max' or 'avg', "
+                             f"got {pool_type!r}")
+
+        def op(xv):
+            from paddle_tpu.nn import functional as F
+            fn = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+            return fn(xv, pool_size, stride=pool_stride,
+                      padding=pool_padding)
+
+        prog = x.program
+        out = Variable(prog, prog._unique(name or "pool2d"), kind="op",
+                       op=op, inputs=[x])
+        if x.shape is not None:
+            ks = ((pool_size, pool_size) if isinstance(pool_size, int)
+                  else tuple(pool_size))
+            st = ks if pool_stride is None else (
+                (pool_stride, pool_stride)
+                if isinstance(pool_stride, int) else tuple(pool_stride))
+            pd = ((pool_padding, pool_padding)
+                  if isinstance(pool_padding, int) else tuple(pool_padding))
+            h = (x.shape[2] + 2 * pd[0] - ks[0]) // st[0] + 1
+            w = (x.shape[3] + 2 * pd[1] - ks[1]) // st[1] + 1
+            out.shape = (x.shape[0], x.shape[1], h, w)
+        return prog.add_var(out)
+
+    @staticmethod
+    def embedding(x: Variable, size, padding_idx=None, name=None):
+        """≙ static.nn.embedding: size = (vocab, dim)."""
+        prog = x.program
+        table = prog.create_parameter(tuple(size),
+                                      name=name and f"{name}.w")
+
+        def op(ids, tv):
+            from paddle_tpu.nn import functional as F
+            return F.embedding(jnp.asarray(ids, jnp.int32), tv,
+                               padding_idx=padding_idx)
+
+        out = Variable(prog, prog._unique(name or "embedding"), kind="op",
+                       op=op, inputs=[x, table])
+        return prog.add_var(out)
+
+    @staticmethod
+    def flatten(x: Variable, axis: int = 1, name=None):
+        """≙ fluid.layers.flatten: 2-D output
+        (prod(shape[:axis]), prod(shape[axis:]))."""
+        prog = x.program
+
+        def op(xv):
+            lead = int(np.prod(xv.shape[:axis]))
+            return xv.reshape(lead, -1)
+
+        out = Variable(prog, prog._unique(name or "flatten"), kind="op",
+                       op=op, inputs=[x])
+        if x.shape is not None and all(
+                d is not None and d >= 0 for d in x.shape):
+            out.shape = (int(np.prod(x.shape[:axis])),
+                         int(np.prod(x.shape[axis:])))
+        elif x.shape is not None and axis == 1:
+            out.shape = (x.shape[0], int(np.prod(x.shape[1:])))
+        return prog.add_var(out)
+
+    @staticmethod
+    def batch_norm(x: Variable, momentum=0.9, epsilon=1e-5, name=None,
+                   data_layout="NCHW", num_channels=None):
+        """≙ static.nn.batch_norm: batch stats + running-stat updates in
+        training mode (the executor applies the registered buffer
+        updates), running stats in a ``clone(for_test=True)`` program —
+        one graph, mode fed at run time."""
+        prog = x.program
+        if num_channels is not None:
+            c = num_channels
+        elif x.shape is not None:
+            c = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+        else:
+            raise ValueError("batch_norm cannot infer the channel count "
+                             "from an untyped variable; pass num_channels=")
+        scale = prog.create_parameter((c,), name=name and f"{name}.scale",
+                                      initializer=lambda s: np.ones(s))
+        bias = prog.create_parameter((c,), name=name and f"{name}.bias",
+                                     initializer=lambda s: np.zeros(s))
+        r_mean = prog.create_buffer(
+            (c,), name=f"{name}.mean" if name
+            else prog._unique("bn") + ".mean")
+        r_var = prog.create_buffer(
+            (c,), name=f"{name}.var" if name
+            else prog._unique("bn") + ".var",
+            initializer=lambda s: np.ones(s))
+        mode = prog._mode_var()
+        axes = (0, 2, 3) if data_layout == "NCHW" else (0, 1, 2)
+        shape_b = ((1, -1, 1, 1) if data_layout == "NCHW"
+                   else (1, 1, 1, -1))
+
+        def stat(xv, training):
+            bm = jnp.mean(xv, axes)
+            bv = jnp.var(xv, axes)
+            return bm, bv
+
+        def op(xv, sv, bv_, rm, rv, training):
+            bm, bvar = stat(xv, training)
+            mean = jnp.where(training, bm, rm)
+            var = jnp.where(training, bvar, rv)
+            inv = jax.lax.rsqrt(var + epsilon)
+            return ((xv - mean.reshape(shape_b)) * inv.reshape(shape_b)
+                    * sv.reshape(shape_b) + bv_.reshape(shape_b))
+
+        out = Variable(prog, prog._unique(name or "batch_norm"), kind="op",
+                       op=op, inputs=[x, scale, bias, r_mean, r_var, mode])
+        out.shape = x.shape  # elementwise: same static shape
+        out = prog.add_var(out)
+
+        # running-stat update nodes (applied by the executor in training)
+        def upd_mean(xv, rm, training):
+            bm, _ = stat(xv, training)
+            return jnp.where(training, momentum * rm + (1 - momentum) * bm,
+                             rm)
+
+        def upd_var(xv, rv, training):
+            _, bvar = stat(xv, training)
+            return jnp.where(training,
+                             momentum * rv + (1 - momentum) * bvar, rv)
+
+        um = prog.add_var(Variable(prog, prog._unique("bn_upd_mean"),
+                                   kind="op", op=upd_mean,
+                                   inputs=[x, r_mean, mode]))
+        uv = prog.add_var(Variable(prog, prog._unique("bn_upd_var"),
+                                   kind="op", op=upd_var,
+                                   inputs=[x, r_var, mode]))
+        prog._buffer_updates[r_mean.name] = um
+        prog._buffer_updates[r_var.name] = uv
+        return out
+
+    @staticmethod
+    def dropout(x: Variable, dropout_prob=0.5, name=None):
+        """≙ static.nn.dropout (upscale_in_train); active only in training
+        mode, seeded per Executor.run."""
+        prog = x.program
+        mode = prog._mode_var()
+        rng = prog._rng_var()
+        node_name = prog._unique(name or "dropout")
+        salt = abs(hash(node_name)) % (2 ** 31)
+
+        def op(xv, training, seed):
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(seed), salt)
+            keep = jax.random.bernoulli(key, 1.0 - dropout_prob, xv.shape)
+            dropped = jnp.where(keep, xv / (1.0 - dropout_prob), 0.0)
+            return jnp.where(training, dropped.astype(xv.dtype), xv)
+
+        out = Variable(prog, node_name, kind="op", op=op,
+                       inputs=[x, mode, rng])
+        out.shape = x.shape  # elementwise: same static shape
+        return prog.add_var(out)
+
+    @staticmethod
+    def cross_entropy(input: Variable, label: Variable, soft_label=False,
+                      name=None):
+        """≙ fluid.layers.cross_entropy: ``input`` is a PROBABILITY
+        distribution (e.g. fc(..., activation='softmax')); returns
+        per-example loss (N, 1)."""
+        prog = input.program
+
+        def op(p, y):
+            p = jnp.clip(p, 1e-8, 1.0)
+            if soft_label:
+                return -jnp.sum(y * jnp.log(p), -1, keepdims=True)
+            y = jnp.asarray(y, jnp.int32).reshape(-1)
+            picked = jnp.take_along_axis(p, y[:, None], axis=-1)
+            return -jnp.log(picked)
+
+        out = Variable(prog, prog._unique(name or "cross_entropy"),
+                       kind="op", op=op, inputs=[input, label])
         return prog.add_var(out)
 
 

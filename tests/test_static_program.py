@@ -112,3 +112,128 @@ def test_executor_recompiles_on_new_shapes():
     (b,) = exe.run(prog, feed={"x": np.ones((5, 2), np.float32)},
                    fetch_list=[out])
     assert a.shape == (2, 2) and b.shape == (5, 2)
+
+
+# ---------------------------------------------------------------------------
+# static.nn breadth (VERDICT r3 item 9): conv2d/pool2d/embedding/
+# batch_norm/dropout/cross_entropy on the lazy Program, and the
+# recognize-digits "book" script end-to-end
+# (≙ fluid/tests/book/test_recognize_digits.py).
+# ---------------------------------------------------------------------------
+
+def _digits(n=256, seed=0):
+    """Synthetic 4-class 'digits': class k lights rows 2k..2k+1."""
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 4, (n,)).astype(np.int64)
+    x = rs.randn(n, 1, 8, 8).astype(np.float32) * 0.25
+    for i, cls in enumerate(y):
+        x[i, 0, 2 * cls:2 * cls + 2, :] += 1.5
+    return x, y
+
+
+def test_static_nn_layer_shapes():
+    prog = static.Program()
+    with static.program_guard(prog):
+        img = static.data("img", [-1, 1, 8, 8])
+        ids = static.data("ids", [-1, 3], dtype=np.int32)
+        conv = static.nn.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, activation="relu")
+        pooled = static.nn.pool2d(conv, pool_size=2, pool_type="max")
+        bn = static.nn.batch_norm(pooled)
+        drop = static.nn.dropout(bn, dropout_prob=0.3)
+        emb = static.nn.embedding(ids, size=(16, 5))
+    exe = static.Executor()
+    x = np.random.RandomState(0).rand(2, 1, 8, 8).astype(np.float32)
+    i = np.array([[1, 2, 3], [4, 5, 6]], np.int32)
+    c, p, b, d, e = exe.run(prog, feed={"img": x, "ids": i},
+                            fetch_list=[conv, pooled, bn, drop, emb])
+    assert c.shape == (2, 4, 8, 8) and (c >= 0).all()
+    assert p.shape == (2, 4, 4, 4)
+    assert b.shape == (2, 4, 4, 4)
+    assert d.shape == (2, 4, 4, 4)
+    assert e.shape == (2, 3, 5)
+
+
+def test_static_batch_norm_train_vs_test_modes():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [-1, 2, 4, 4])
+        out = static.nn.batch_norm(x, momentum=0.5)
+    test_prog = prog.clone(for_test=True)
+    exe = static.Executor()
+    rs = np.random.RandomState(0)
+    xv = (rs.randn(8, 2, 4, 4) * 3 + 1).astype(np.float32)
+
+    # training run: output uses batch stats (≈ zero mean), buffers move
+    (tr,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    assert abs(tr.mean()) < 0.1
+    mean_name = [k for k in prog.buffers if k.endswith(".mean") or
+                 "mean" in k][0]
+    assert np.abs(np.asarray(prog.buffers[mean_name])).max() > 0
+
+    # eval run (cloned program): running stats, buffers frozen
+    before = {k: np.asarray(v) for k, v in prog.buffers.items()}
+    (ev,) = exe.run(test_prog, feed={"x": xv},
+                    fetch_list=[test_prog.vars[out.name]])
+    for k in before:
+        np.testing.assert_array_equal(before[k],
+                                      np.asarray(prog.buffers[k]))
+    assert not np.allclose(tr, ev)  # different normalization stats
+
+
+def test_static_dropout_modes():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [-1, 64])
+        out = static.nn.dropout(x, dropout_prob=0.5)
+    test_prog = prog.clone(for_test=True)
+    exe = static.Executor()
+    xv = np.ones((4, 64), np.float32)
+    (tr,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    assert (tr == 0).mean() > 0.2          # ~half dropped
+    assert abs(tr.mean() - 1.0) < 0.35     # upscale_in_train
+    (ev,) = exe.run(test_prog, feed={"x": xv},
+                    fetch_list=[test_prog.vars[out.name]])
+    np.testing.assert_array_equal(ev, xv)  # identity in eval
+
+
+def test_book_recognize_digits_convnet_trains():
+    """The book script: conv→pool→bn→conv→pool→fc(softmax), cross_entropy
+    loss, SGD minimize, Executor.run epochs → accuracy, then eval through
+    clone(for_test=True) (≙ fluid/tests/book/test_recognize_digits.py
+    conv_net path)."""
+    from paddle_tpu import optimizer as optim
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        img = static.data("img", [-1, 1, 8, 8])
+        label = static.data("label", [-1, 1], dtype=np.int64)
+        conv1 = static.nn.conv2d(img, num_filters=8, filter_size=3,
+                                 padding=1, activation="relu")
+        pool1 = static.nn.pool2d(conv1, pool_size=2, pool_type="max")
+        bn = static.nn.batch_norm(pool1)
+        conv2 = static.nn.conv2d(bn, num_filters=8, filter_size=3,
+                                 padding=1, activation="relu")
+        pool2 = static.nn.pool2d(conv2, pool_size=2, pool_type="avg")
+        flat = static.nn.flatten(pool2)
+        pred = static.nn.fc(flat, size=4, activation="softmax")
+        ce = static.nn.cross_entropy(pred, label)
+        loss = ce.apply(lambda v: v.mean())
+    test_prog = prog.clone(for_test=True)
+    static.minimize(optim.Momentum(learning_rate=0.1, momentum=0.9), loss)
+
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    x, y = _digits(256)
+    losses = []
+    for epoch in range(25):
+        (lv,) = exe.run(prog, feed={"img": x, "label": y.reshape(-1, 1)},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+    xt, yt = _digits(128, seed=1)
+    (probs,) = exe.run(test_prog, feed={"img": xt},
+                       fetch_list=[test_prog.vars[pred.name]])
+    acc = (probs.argmax(-1) == yt).mean()
+    assert acc > 0.9, acc
