@@ -56,6 +56,7 @@ import paddle_tpu.geometric as geometric
 import paddle_tpu.linalg as linalg
 import paddle_tpu.fft as fft
 import paddle_tpu.signal as signal
+import paddle_tpu.stats as stats
 import paddle_tpu.onnx as onnx
 import paddle_tpu.jit as jit  # callable module: paddle_tpu.jit(fn) / jit.to_static
 import paddle_tpu.hub as hub
@@ -74,7 +75,7 @@ __all__ = (
     ["__version__", "nn", "optimizer", "autograd", "amp", "io", "metric",
      "distributed", "vision", "profiler", "incubate", "static", "sparse",
      "quantization",
-     "distribution", "text", "audio", "geometric", "linalg", "fft", "signal",
+     "distribution", "text", "audio", "geometric", "linalg", "fft", "signal", "stats",
      "onnx", "hub", "device", "reader", "dataset", "utils",
      "sysconfig", "regularizer", "batch", "version", "cost_model",
      "Tensor", "to_tensor", "is_tensor", "jit", "no_grad", "grad",

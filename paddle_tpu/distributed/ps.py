@@ -17,6 +17,18 @@ live CPU-side, rows pulled/pushed by id. That is what this module keeps:
 Transport is the RPC layer (distributed/rpc.py): handlers are module-level
 functions executed in the server's rpc pool; table state lives in the
 server process's ``_TABLES`` registry.
+
+**Scale envelope (deliberate non-parity).** This is an in-memory,
+single-socket-per-peer PS: tables live in server RAM, the wire is the
+framework RPC over TCP, and sharding is id-hash only. The reference's
+production machinery — brpc services with rpc compression, SSD-backed
+tables (ssd_sparse_table), geo-async sync, heterogeneous PS
+(cpu+gpu, heter_ps/), GPUPS HBM embedding caches — is out of scope
+here: those exist to serve trillion-row embeddings at datacenter QPS,
+which is not a TPU-training bottleneck this framework targets. The API
+surface (push/pull dense+sparse, server-side optimizers) matches, so
+models port; the capacity ceiling (≈ server RAM, ≈ thousands of QPS)
+does not.
 """
 
 import threading

@@ -18,6 +18,17 @@ from paddle_tpu.hapi import callbacks as cbks_mod
 from paddle_tpu.metric import Metric
 
 
+def _batch_len(x) -> int:
+    # loaders may yield list/tuple-wrapped inputs (train_batch unwraps
+    # via inputs[0]); count samples of the actual batch array
+    if isinstance(x, (list, tuple)) and x:
+        x = x[0]
+    try:
+        return int(np.shape(x)[0])
+    except Exception:
+        return 1
+
+
 class Model:
     """ref: paddle.Model."""
 
@@ -287,6 +298,10 @@ class Model:
                 loss = res[0] if isinstance(res, list) else res
                 logs = {"loss": loss, "step": step}
                 cbks.on_batch_end("train", step, logs)
+                from paddle_tpu import stats
+                stats.add("hapi/train_steps", 1)
+                stats.add("hapi/train_samples", _batch_len(x))
+                stats.set_value("hapi/last_loss", float(loss))
                 it_count += 1
                 if num_iters is not None and it_count >= num_iters:
                     break

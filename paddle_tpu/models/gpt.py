@@ -850,7 +850,7 @@ def interleaved_slot_mask(n_layers: int, n_stages: int, n_virtual: int):
 
 def pipelined_apply_interleaved(stacked_blocks, x_mb, n_stages: int,
                                 n_virtual: int, remat_stages: bool = False,
-                                layer_mask=None):
+                                layer_mask=None, collect_aux: bool = False):
     """Virtual-stage (interleaved) rolling-buffer schedule: the buffer has
     one row per GLOBAL stage, shaped (V, S, ...) with the S axis sharded
     over 'pp' — pp rank r owns its V chunk rows. One tick advances every
@@ -878,11 +878,16 @@ def pipelined_apply_interleaved(stacked_blocks, x_mb, n_stages: int,
     def stage_fn(blocks_one_stage, h, mask_one_stage):
         def body(hh, blk_m):
             blk, m = blk_m
-            out = blk(hh)
+            if collect_aux:
+                out, aux = _moe_block_with_aux(blk, hh)
+                aux = jnp.where(m, aux, 0.0)
+            else:
+                out = blk(hh)
+                aux = jnp.zeros((), jnp.float32)
             hh = jnp.where(m, out, hh)
-            return hh, None
-        h, _ = lax.scan(body, h, (blocks_one_stage, mask_one_stage))
-        return h
+            return hh, aux
+        h, auxs = lax.scan(body, h, (blocks_one_stage, mask_one_stage))
+        return h, jnp.sum(auxs)
 
     if remat_stages:
         stage_fn = jax.checkpoint(stage_fn)
@@ -895,9 +900,10 @@ def pipelined_apply_interleaved(stacked_blocks, x_mb, n_stages: int,
 
     state = jnp.zeros((V, S) + x_mb.shape[1:], x_mb.dtype)
     outputs = jnp.zeros_like(x_mb)
+    aux_total = jnp.zeros((), jnp.float32)
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_total = carry
         inp = lax.dynamic_index_in_dim(
             x_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
         state = state.at[0, 0].set(inp)
@@ -908,13 +914,16 @@ def pipelined_apply_interleaved(stacked_blocks, x_mb, n_stages: int,
             for r in range(S):
                 g = v * S + r
                 live = ((t - g) >= 0) & ((t - g) < n_micro)
-                h = lax.cond(
+                h, aux_r = lax.cond(
                     live,
                     lambda h, b=row_blocks[v][r], mk=layer_mask[v, r]:
                         stage_fn(b, h, mk),
-                    lambda h: h,
+                    lambda h: (h, jnp.zeros((), jnp.float32)),
                     state[v, r])
                 rank_rows.append(h)
+                # live-guarded by the cond's false branch: bubble rows
+                # contribute zero aux
+                aux_total = aux_total + aux_r
             rows.append(jnp.stack(rank_rows))
         processed = jnp.stack(rows)
         out_t = processed[V - 1, S - 1]
@@ -925,14 +934,17 @@ def pipelined_apply_interleaved(stacked_blocks, x_mb, n_stages: int,
             lambda o: o, outputs)
         flat = processed.reshape((G,) + processed.shape[2:])
         state = jnp.roll(flat, 1, axis=0).reshape(state.shape)
-        return (state, outputs), None
+        return (state, outputs, aux_total), None
 
     _PIPELINE_DEPTH += 1
     try:
-        (state, outputs), _ = lax.scan(
-            tick, (state, outputs), jnp.arange(n_micro + G - 1))
+        (state, outputs, aux_total), _ = lax.scan(
+            tick, (state, outputs, aux_total),
+            jnp.arange(n_micro + G - 1))
     finally:
         _PIPELINE_DEPTH -= 1
+    if collect_aux:
+        return outputs, aux_total
     return outputs
 
 
@@ -1102,9 +1114,6 @@ def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,
     cfg = model.cfg
     use_moe = cfg.moe_experts > 0
     if n_virtual > 1:
-        if use_moe:
-            raise ValueError("interleaved pipeline does not collect MoE "
-                             "aux loss yet; use n_virtual=1 for MoE")
         mask = interleaved_slot_mask(cfg.n_layers, n_stages, n_virtual)
     else:
         mask = layer_slot_mask(cfg.n_layers, n_stages)
@@ -1119,7 +1128,8 @@ def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,
             if n_virtual > 1:
                 out = pipelined_apply_interleaved(
                     blocks_p, x, n_stages, n_virtual,
-                    remat_stages=remat_stages, layer_mask=mask)
+                    remat_stages=remat_stages, layer_mask=mask,
+                    collect_aux=use_moe)
             else:
                 out = pipelined_apply(blocks_p, x, n_stages,
                                       remat_stages=remat_stages,

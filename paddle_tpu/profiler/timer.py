@@ -85,8 +85,15 @@ class Benchmark:
             self.avg_step_time * self.num_chips * self.peak_flops)
 
     def report(self):
-        return {"step_time_s": self.avg_step_time, "ips": self.ips(),
-                "mfu": self.mfu()}
+        out = {"step_time_s": self.avg_step_time, "ips": self.ips(),
+               "mfu": self.mfu()}
+        # publish into the named-stat registry (≙ monitor.h STAT_ADD
+        # consumers scraping the benchmark numbers)
+        from paddle_tpu import stats
+        for k, v in out.items():
+            if v == v:  # skip NaN
+                stats.set_value(f"benchmark/{k}", v)
+        return out
 
 
 _global_benchmark = Benchmark()

@@ -328,8 +328,15 @@ class _StaticNN:
         fs = ((filter_size, filter_size) if isinstance(filter_size, int)
               else tuple(filter_size))
         in_c = x.shape[1]
-        w = prog.create_parameter((num_filters, in_c // groups) + fs,
-                                  name=name and f"{name}.w")
+        wshape = (num_filters, in_c // groups) + fs
+        fan_in = (in_c // groups) * int(np.prod(fs))
+        fan_out = num_filters * int(np.prod(fs))
+        bound = float(np.sqrt(6.0 / (fan_in + fan_out)))
+        wname = name and f"{name}.w"
+        w = prog.create_parameter(
+            wshape, name=wname,
+            initializer=lambda s, b=bound: np.random.RandomState(
+                abs(hash(str(s))) % (2 ** 31)).uniform(-b, b, s))
         b = prog.create_parameter((num_filters,),
                                   name=name and f"{name}.b",
                                   initializer=lambda s: np.zeros(s))
@@ -440,25 +447,20 @@ class _StaticNN:
                                       initializer=lambda s: np.ones(s))
         bias = prog.create_parameter((c,), name=name and f"{name}.bias",
                                      initializer=lambda s: np.zeros(s))
-        r_mean = prog.create_buffer(
-            (c,), name=f"{name}.mean" if name
-            else prog._unique("bn") + ".mean")
-        r_var = prog.create_buffer(
-            (c,), name=f"{name}.var" if name
-            else prog._unique("bn") + ".var",
-            initializer=lambda s: np.ones(s))
+        prefix = name or prog._unique("bn")
+        r_mean = prog.create_buffer((c,), name=f"{prefix}.mean")
+        r_var = prog.create_buffer((c,), name=f"{prefix}.var",
+                                   initializer=lambda s: np.ones(s))
         mode = prog._mode_var()
         axes = (0, 2, 3) if data_layout == "NCHW" else (0, 1, 2)
         shape_b = ((1, -1, 1, 1) if data_layout == "NCHW"
                    else (1, 1, 1, -1))
 
-        def stat(xv, training):
-            bm = jnp.mean(xv, axes)
-            bv = jnp.var(xv, axes)
-            return bm, bv
+        def stat(xv):
+            return jnp.mean(xv, axes), jnp.var(xv, axes)
 
         def op(xv, sv, bv_, rm, rv, training):
-            bm, bvar = stat(xv, training)
+            bm, bvar = stat(xv)
             mean = jnp.where(training, bm, rm)
             var = jnp.where(training, bvar, rv)
             inv = jax.lax.rsqrt(var + epsilon)
@@ -472,12 +474,12 @@ class _StaticNN:
 
         # running-stat update nodes (applied by the executor in training)
         def upd_mean(xv, rm, training):
-            bm, _ = stat(xv, training)
+            bm, _ = stat(xv)
             return jnp.where(training, momentum * rm + (1 - momentum) * bm,
                              rm)
 
         def upd_var(xv, rv, training):
-            _, bvar = stat(xv, training)
+            _, bvar = stat(xv)
             return jnp.where(training,
                              momentum * rv + (1 - momentum) * bvar, rv)
 

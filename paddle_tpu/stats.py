@@ -1,0 +1,162 @@
+"""Named-counter observability surface (StatRegistry).
+
+Reference analog: paddle/fluid/platform/monitor.h — ``StatRegistry`` with
+``STAT_ADD``/``STAT_RESET`` macros exposing named int64 stats that tools
+scrape (plus the per-module monitors fluid registers, e.g. the dataloader
+and RPC byte counters). Here: one process-wide registry of counters,
+gauges, and timers; framework subsystems record into it (hapi fit loop,
+profiler Benchmark, DataLoader workers can), and users read it as a dict
+or a formatted table.
+
+    from paddle_tpu import stats
+    stats.add("my/steps", 1)
+    with stats.timer("my/io"):
+        ...
+    print(stats.table())
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["StatRegistry", "default_registry", "add", "set_value", "get",
+           "timer", "snapshot", "table", "reset"]
+
+
+class _Timer:
+    __slots__ = ("total_s", "count", "max_s")
+
+    def __init__(self):
+        self.total_s = 0.0
+        self.count = 0
+        self.max_s = 0.0
+
+    def record(self, seconds: float):
+        self.total_s += seconds
+        self.count += 1
+        self.max_s = max(self.max_s, seconds)
+
+    @property
+    def mean_s(self):
+        return self.total_s / self.count if self.count else 0.0
+
+
+class StatRegistry:
+    """Thread-safe named counters/gauges/timers (≙ monitor.h
+    StatRegistry::Instance)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._timers: Dict[str, _Timer] = {}
+
+    # -- counters (monotonic; STAT_ADD) -------------------------------------
+    def add(self, name: str, value: float = 1) -> float:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+            return self._counters[name]
+
+    # -- gauges (last-value-wins) --------------------------------------------
+    def set_value(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = value
+
+    def get(self, name: str, default=0):
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            if name in self._gauges:
+                return self._gauges[name]
+            if name in self._timers:
+                return self._timers[name].total_s
+            return default
+
+    # -- timers ---------------------------------------------------------------
+    def record_time(self, name: str, seconds: float):
+        with self._lock:
+            self._timers.setdefault(name, _Timer()).record(seconds)
+
+    def timer(self, name: str):
+        """Context manager accumulating wall time under ``name``."""
+        registry = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.record_time(name,
+                                     time.perf_counter() - self._t0)
+                return False
+
+        return _Ctx()
+
+    # -- export ---------------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+            out.update(self._gauges)
+            for name, t in self._timers.items():
+                out[f"{name}.total_s"] = t.total_s
+                out[f"{name}.count"] = t.count
+                out[f"{name}.mean_s"] = t.mean_s
+                out[f"{name}.max_s"] = t.max_s
+            return out
+
+    def table(self) -> str:
+        snap = self.snapshot()
+        if not snap:
+            return "(no stats recorded)"
+        width = max(len(k) for k in snap)
+        lines = [f"{'stat':<{width}}  value", "-" * (width + 12)]
+        for k in sorted(snap):
+            v = snap[k]
+            vs = f"{v:.6g}" if isinstance(v, float) else str(v)
+            lines.append(f"{k:<{width}}  {vs}")
+        return "\n".join(lines)
+
+    def reset(self, prefix: Optional[str] = None):
+        with self._lock:
+            for d in (self._counters, self._gauges, self._timers):
+                if prefix is None:
+                    d.clear()
+                else:
+                    for k in [k for k in d if k.startswith(prefix)]:
+                        del d[k]
+
+
+_DEFAULT = StatRegistry()
+
+
+def default_registry() -> StatRegistry:
+    return _DEFAULT
+
+
+def add(name: str, value: float = 1) -> float:
+    return _DEFAULT.add(name, value)
+
+
+def set_value(name: str, value: float):
+    _DEFAULT.set_value(name, value)
+
+
+def get(name: str, default=0):
+    return _DEFAULT.get(name, default)
+
+
+def timer(name: str):
+    return _DEFAULT.timer(name)
+
+
+def snapshot() -> Dict[str, float]:
+    return _DEFAULT.snapshot()
+
+
+def table() -> str:
+    return _DEFAULT.table()
+
+
+def reset(prefix: Optional[str] = None):
+    _DEFAULT.reset(prefix)

@@ -86,12 +86,13 @@ def test_interleaved_bubble_reduction(tmp_path):
     Both runs do identical numeric+sleep work per rank; V=1 pays
     (S-1)·T_stage of bubble, V=2 pays (S-1)·T_stage/V
     (≙ the bubble claim of pipeline_parallel.py:457). With sleep-dominated
-    stages the expected walls are 10τ vs 9τ (m=4, S=2, τ=0.2)."""
+    stages the expected walls are 10τ vs 9τ (m=4, S=2, τ=0.3; the τ-scale
+    margin rides out per-unit jax.vjp re-trace overhead under CI load)."""
     ctx = mp.get_context("spawn")
     walls = {}
     for nv, port in ((1, 23860), (2, 23870)):
         procs = [ctx.Process(target=_fe_worker.worker_vpp,
-                             args=(r, port, "1f1b", str(tmp_path), nv, 0.2))
+                             args=(r, port, "1f1b", str(tmp_path), nv, 0.3))
                  for r in range(2)]
         for p in procs:
             p.start()
@@ -103,7 +104,8 @@ def test_interleaved_bubble_reduction(tmp_path):
             float(np.load(tmp_path / f"vpp{nv}_rank0_step{s}.npz")["wall"])
             for s in range(2))
     # sanity: the V=1 wall is at least the zero-bubble lower bound m·2τ
-    assert walls[1] > 1.5
+    assert walls[1] > 2.3
     # the interleaved run must recover most of the predicted
-    # τ·(S-1)·(1-1/V) = 100ms saving; 60ms margin rides out CI jitter
-    assert walls[2] < walls[1] - 0.06, walls
+    # τ·(S-1)·(1-1/V) = 150ms saving; 80ms margin rides out CI jitter
+    # and the extra per-unit vjp re-traces the V=2 schedule pays
+    assert walls[2] < walls[1] - 0.08, walls

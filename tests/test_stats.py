@@ -1,0 +1,102 @@
+"""Named-counter observability (VERDICT §5.5: StatRegistry analog —
+ref: paddle/fluid/platform/monitor.h StatRegistry + STAT_ADD macros)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import stats
+from paddle_tpu.stats import StatRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    stats.reset()
+    yield
+    stats.reset()
+
+
+def test_counters_gauges_timers():
+    r = StatRegistry()
+    assert r.add("io/reads", 3) == 3
+    assert r.add("io/reads") == 4
+    r.set_value("mem/hbm_frac", 0.7)
+    r.set_value("mem/hbm_frac", 0.8)  # last-value-wins
+    with r.timer("step"):
+        time.sleep(0.01)
+    snap = r.snapshot()
+    assert snap["io/reads"] == 4
+    assert snap["mem/hbm_frac"] == 0.8
+    assert snap["step.count"] == 1 and snap["step.total_s"] >= 0.01
+    assert "io/reads" in r.table() and "step.mean_s" in r.table()
+
+
+def test_reset_by_prefix():
+    r = StatRegistry()
+    r.add("a/x")
+    r.add("b/y")
+    r.reset("a/")
+    assert r.get("a/x") == 0 and r.get("b/y") == 1
+
+
+def test_thread_safety():
+    r = StatRegistry()
+
+    def work():
+        for _ in range(1000):
+            r.add("n")
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert r.get("n") == 8000
+
+
+def test_module_level_default_registry():
+    stats.add("x", 2)
+    stats.set_value("g", 1.5)
+    assert stats.get("x") == 2 and stats.get("g") == 1.5
+    assert stats.snapshot()["x"] == 2
+    assert pt.stats is stats  # exported on the package
+
+
+def test_hapi_fit_records_stats():
+    import jax.numpy as jnp
+    from paddle_tpu import nn, optimizer as optim
+    from paddle_tpu.hapi import Model
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = Model(Net())
+    m.prepare(optim.SGD(learning_rate=0.1),
+              nn.CrossEntropyLoss())
+    x = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 2, (16, 1)).astype(np.int64)
+    m.fit(list(zip(x.reshape(4, 4, 4), y.reshape(4, 4, 1))), epochs=2,
+          verbose=0)
+    assert stats.get("hapi/train_steps") == 8
+    assert stats.get("hapi/train_samples") == 32
+    assert isinstance(stats.get("hapi/last_loss"), float)
+
+
+def test_benchmark_publishes_stats():
+    from paddle_tpu.profiler.timer import Benchmark
+    b = Benchmark(flops_per_step=1e9, peak_flops=1e12)
+    b.begin()
+    for _ in range(3):
+        time.sleep(0.002)
+        b.step(num_samples=4)
+    rep = b.report()
+    assert stats.get("benchmark/ips") == rep["ips"]
+    assert stats.get("benchmark/mfu") == rep["mfu"]
