@@ -5,3 +5,46 @@ from paddle_tpu.nn.functional.norm import *        # noqa: F401,F403
 from paddle_tpu.nn.functional.loss import *        # noqa: F401,F403
 from paddle_tpu.nn.functional.common import *      # noqa: F401,F403
 from paddle_tpu.nn.functional.attention import *   # noqa: F401,F403
+from paddle_tpu.nn.functional.extension import *   # noqa: F401,F403
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None):
+    """ref: nn/functional/sparse_attention.py — block-sparse attention
+    with the pattern given as CSR (offset, columns); delegates to the
+    sparse-tensor attention kernel (sparse/nn.py)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.sparse as S
+    from paddle_tpu.sparse.nn import functional as sparse_F
+
+    offs = jnp.asarray(sparse_csr_offset)
+    cols = jnp.asarray(sparse_csr_columns)
+    # batch/head-shared 2-D pattern (the kernel broadcasts over B, H);
+    # refuse to silently collapse genuinely per-head patterns
+    for arr_name, arr in (("offset", offs), ("columns", cols)):
+        while arr.ndim > 1:
+            first = arr[0]
+            if not bool(jnp.all(arr == first[None])):
+                raise NotImplementedError(
+                    f"sparse_attention: per-batch/per-head CSR {arr_name} "
+                    "patterns differ; only a shared pattern is supported")
+            arr = first
+        if arr_name == "offset":
+            offs = arr
+        else:
+            cols = arr
+    s = query.shape[-2]
+    mask = S.sparse_csr_tensor(offs, cols,
+                               jnp.ones(cols.shape, jnp.float32), (s, s))
+    return sparse_F.attention(query, key, value, mask,
+                              key_padding_mask=key_padding_mask,
+                              attn_mask=attn_mask)
+
+
+# inplace-suffix aliases (eager aliases of the pure ops, ≙ the
+# reference's *_ functional variants)
+elu_ = elu        # noqa: F405
+relu_ = relu      # noqa: F405
+softmax_ = softmax  # noqa: F405

@@ -7,7 +7,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.nn.module import current_context, is_training
 
-__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+__all__ = ["linear", "bilinear", "class_center_sample",
+           "dropout", "dropout2d", "dropout3d", "alpha_dropout",
            "embedding", "one_hot", "interpolate", "upsample", "pad",
            "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
            "channel_shuffle", "label_smooth", "zeropad2d", "fold_ctx_key",
@@ -220,3 +221,37 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
     else:
         out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
     return out[..., None] if keepdim else out
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """ref: nn/functional/common.py bilinear — out[n, o] =
+    x1[n, i] · W[o, i, j] · x2[n, j] (+ bias)."""
+    x1 = jnp.asarray(x1)
+    x2 = jnp.asarray(x2)
+    w = jnp.asarray(weight)
+    out = jnp.einsum("ni,oij,nj->no", x1, w, x2)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(1, -1)
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        seed=0):
+    """ref: nn/functional/common.py:2008 — sample ``num_samples`` class
+    centers ALWAYS including every positive class in ``label``; returns
+    (remapped_label, sampled_class_indices). Deterministic given seed
+    (the reference seeds from the global generator)."""
+    import numpy as np
+    label_np = np.asarray(label).reshape(-1)
+    pos = np.unique(label_np)
+    rs = np.random.RandomState(seed)
+    if len(pos) >= num_samples:
+        sampled = np.sort(pos)
+    else:
+        neg = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rs.choice(neg, size=num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones((num_classes,), np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (jnp.asarray(remap[label_np], jnp.int32),
+            jnp.asarray(sampled, jnp.int32))

@@ -1,0 +1,156 @@
+"""Extension functionals (ref: python/paddle/nn/functional/extension.py —
+sequence_mask/gather_tree/temporal_shift/diag_embed — and vision.py —
+affine_grid/grid_sample)."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["sequence_mask", "gather_tree", "temporal_shift", "diag_embed",
+           "affine_grid", "grid_sample"]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """ref: extension.py:162 — y[..., j] = (j < x[...])."""
+    x = jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(x))  # host read, like the reference's max(x)
+    mask = jnp.arange(maxlen) < x[..., None]
+    return mask.astype(dtype)
+
+
+def gather_tree(ids, parents):
+    """ref: extension.py:253 — beam-search backtrace over
+    (max_time, batch, beam) id/parent arrays, as a reverse lax.scan."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T = ids.shape[0]
+    beam_iota = jnp.arange(ids.shape[2])[None, :]
+
+    def step(beam_idx, t):
+        # beam_idx: (batch, beam) — which beam each FINAL sequence rides
+        # at time t+1; collect ids[t] at that beam, then hop to parents
+        out_t = jnp.take_along_axis(ids[t], beam_idx, axis=1)
+        prev = jnp.take_along_axis(parents[t], beam_idx, axis=1)
+        return prev, out_t
+
+    last = jnp.broadcast_to(beam_iota, ids.shape[1:])
+    _, outs = lax.scan(step, last, jnp.arange(T - 1, -1, -1))
+    return outs[::-1]
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """ref: extension.py:346 — TSM channel shift across the segment axis:
+    the first ``shift_ratio`` of channels shift t-1→t, the next block
+    shifts t+1→t, the rest stay."""
+    x = jnp.asarray(x)
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(x5[:, :1, :c1]), x5[:, :-1, :c1]], axis=1)
+    bwd = jnp.concatenate(
+        [x5[:, 1:, c1:c2], jnp.zeros_like(x5[:, :1, c1:c2])], axis=1)
+    out = jnp.concatenate([fwd, bwd, x5[:, :, c2:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """ref: functional diag_embed — delegates to the registered tensor op
+    (tensor/manipulation.py), one implementation for both surfaces."""
+    from paddle_tpu.tensor.manipulation import diag_embed as _impl
+    return _impl(x, offset=offset, dim1=dim1, dim2=dim2)
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """ref: vision.py:28 — (N, 2, 3) affine params → (N, H, W, 2) sampling
+    grid in [-1, 1] coords (2-D case; (N, 3, 4) → (N, D, H, W, 3))."""
+    theta = jnp.asarray(theta)
+    shape = [int(s) for s in out_shape]
+
+    def line(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    if theta.shape[1] == 2:  # 2-D
+        n, _, h, w = shape
+        ys, xs = jnp.meshgrid(line(h), line(w), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)   # (H, W, 3)
+        grid = jnp.einsum("hwk,nck->nhwc", base,
+                          theta.astype(jnp.float32))
+        return grid.astype(theta.dtype)
+    n, _, d, h, w = shape
+    zs, ys, xs = jnp.meshgrid(line(d), line(h), line(w), indexing="ij")
+    base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], -1)   # (D, H, W, 4)
+    grid = jnp.einsum("dhwk,nck->ndhwc", base, theta.astype(jnp.float32))
+    return grid.astype(theta.dtype)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """ref: vision.py:136 — sample NCHW ``x`` at (N, H', W', 2) grid
+    locations given in [-1, 1]; bilinear or nearest, zeros/border/
+    reflection padding."""
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid, jnp.float32)
+    n, c, h, w = x.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    ix = unnormalize(grid[..., 0], w)   # (N, H', W')
+    iy = unnormalize(grid[..., 1], h)
+
+    def reflect(coord, size):
+        if align_corners:
+            span = 2.0 * (size - 1)
+            if size == 1:
+                return jnp.zeros_like(coord)
+            coord = jnp.abs(coord) % span
+            return jnp.where(coord > size - 1, span - coord, coord)
+        span = 2.0 * size
+        coord = jnp.abs(coord + 0.5) % span
+        coord = jnp.where(coord > size, span - coord, coord) - 0.5
+        return jnp.clip(coord, 0, size - 1)
+
+    if padding_mode == "border":
+        ix = jnp.clip(ix, 0, w - 1)
+        iy = jnp.clip(iy, 0, h - 1)
+    elif padding_mode == "reflection":
+        ix = reflect(ix, w)
+        iy = reflect(iy, h)
+
+    def gather(iy_idx, ix_idx):
+        """x[n, :, iy, ix] with zero padding outside."""
+        valid = ((iy_idx >= 0) & (iy_idx <= h - 1)
+                 & (ix_idx >= 0) & (ix_idx <= w - 1))
+        iy_c = jnp.clip(iy_idx, 0, h - 1).astype(jnp.int32)
+        ix_c = jnp.clip(ix_idx, 0, w - 1).astype(jnp.int32)
+        out = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, iy_c, ix_c)
+        # out: (N, C, H', W'); valid: (N, H', W')
+        return out * valid[:, None].astype(x.dtype)
+
+    if mode == "nearest":
+        return gather(jnp.round(iy), jnp.round(ix))
+    x0, y0 = jnp.floor(ix), jnp.floor(iy)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = ((x1 - ix) * (y1 - iy))[:, None]
+    wb = ((x1 - ix) * (iy - y0))[:, None]
+    wc = ((ix - x0) * (y1 - iy))[:, None]
+    wd = ((ix - x0) * (iy - y0))[:, None]
+    va = gather(y0, x0)
+    vb = gather(y1, x0)
+    vc = gather(y0, x1)
+    vd = gather(y1, x1)
+    return (va * wa + vb * wb + vc * wc + vd * wd).astype(x.dtype)

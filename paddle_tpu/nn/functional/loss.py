@@ -4,7 +4,9 @@ classes' functional mirrors)."""
 import jax
 import jax.numpy as jnp
 
-__all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+__all__ = ["cross_entropy", "softmax_with_cross_entropy",
+           "edit_distance", "margin_cross_entropy",
+           "fluid_softmax_with_cross_entropy", "nll_loss",
            "binary_cross_entropy", "binary_cross_entropy_with_logits",
            "mse_loss", "l1_loss", "smooth_l1_loss", "huber_loss", "kl_div",
            "margin_ranking_loss", "cosine_embedding_loss", "ctc_loss",
@@ -418,3 +420,103 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
         jnp.exp(-jnp.abs(logits)))
     loss = jnp.sum(bce * msk, axis=1)
     return _reduce(loss, reduction)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """ref: nn/functional/loss.py:472 — batched Levenshtein distance via a
+    lax.scan DP over the hypothesis axis (anti-diagonal-free formulation:
+    one row of the DP table per scan step). Returns
+    (distance (B, 1) float32, sequence_num (1,) float32)."""
+    from jax import lax
+    inp = jnp.asarray(input, jnp.int32)
+    lab = jnp.asarray(label, jnp.int32)
+    b, m = inp.shape
+    n = lab.shape[1]
+    if input_length is None:
+        input_length = jnp.full((b,), m, jnp.int32)
+    if label_length is None:
+        label_length = jnp.full((b,), n, jnp.int32)
+    input_length = jnp.asarray(input_length, jnp.int32)
+    label_length = jnp.asarray(label_length, jnp.int32)
+    if ignored_tokens:
+        # drop ignored tokens by compacting each row (stable order)
+        def compact(seq, length, toks):
+            keep = jnp.ones(seq.shape, bool)
+            for t in toks:
+                keep &= seq != t
+            keep &= jnp.arange(seq.shape[0]) < length
+            order = jnp.argsort(~keep, stable=True)
+            return seq[order], jnp.sum(keep).astype(jnp.int32)
+        inp, input_length = jax.vmap(
+            lambda s, l: compact(s, l, ignored_tokens))(inp, input_length)
+        lab, label_length = jax.vmap(
+            lambda s, l: compact(s, l, ignored_tokens))(lab, label_length)
+
+    # DP rows: prev[j] = D(i-1, j); masked positions beyond lengths pinned
+    j_iota = jnp.arange(n + 1)
+
+    def per_example(hyp, ref, hlen, rlen):
+        def row(prev, i):
+            # i: 1..m (current hypothesis position)
+            sub_cost = (hyp[i - 1] != ref) & (jnp.arange(n) < rlen)
+            # compute current row left-to-right with an inner scan
+            def cell(left, j):
+                up = prev[j]
+                diag = prev[j - 1]
+                cur = jnp.minimum(jnp.minimum(up + 1, left + 1),
+                                  diag + jnp.where(sub_cost[j - 1], 1, 0))
+                return cur, cur
+            first = jnp.asarray(i, jnp.int32)
+            _, rest = lax.scan(cell, first, jnp.arange(1, n + 1))
+            cur_row = jnp.concatenate([first[None], rest])
+            # beyond hlen the row must stay at the hlen row's values
+            return jnp.where(i <= hlen, cur_row, prev), None
+
+        row0 = j_iota.astype(jnp.int32)
+        final, _ = lax.scan(row, row0, jnp.arange(1, m + 1))
+        return final[rlen]
+
+    dist = jax.vmap(per_example)(inp, lab, input_length,
+                                 label_length).astype(jnp.float32)
+    if normalized:
+        dist = dist / jnp.maximum(label_length.astype(jnp.float32), 1.0)
+    return dist.reshape(b, 1), jnp.asarray([float(b)], jnp.float32)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ref: nn/functional/loss.py:1841 (ArcFace margin loss). ``logits``
+    are cos(theta) of normalized features × normalized weights. With a
+    'tp'-sharded class dim under shard_map the softmax normalizer would
+    need a psum — this single-program version expects full logits (the
+    model-parallel variant lives in distributed/mp_ops.py
+    parallel_cross_entropy)."""
+    logits = jnp.asarray(logits)
+    label = jnp.asarray(label, jnp.int32).reshape(-1)
+    n, c = logits.shape
+    cos_t = jnp.clip(jnp.take_along_axis(
+        logits, label[:, None], axis=1)[:, 0], -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = logits.at[jnp.arange(n), label].set(target)
+    z = adjusted * scale
+    logp = jax.nn.log_softmax(z, axis=-1)
+    loss = -jnp.take_along_axis(logp, label[:, None], axis=1)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jax.nn.softmax(z, axis=-1)
+    return loss
+
+
+def fluid_softmax_with_cross_entropy(logits, label, soft_label=False,
+                                     ignore_index=-100, numeric_stable_mode=True,
+                                     return_softmax=False, axis=-1):
+    """ref: fluid alias of softmax_with_cross_entropy (loss.py)."""
+    return softmax_with_cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        return_softmax=return_softmax, axis=axis)

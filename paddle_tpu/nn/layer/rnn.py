@@ -12,7 +12,7 @@ from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.module import Module, Parameter, LayerList
 
 __all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
-           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+           "BiRNN", "SimpleRNN", "LSTM", "GRU", "RNNBase"]
 
 
 class RNNCellBase(Module):
@@ -213,6 +213,9 @@ class _RNNBase(Module):
                 out = F.dropout(out, self.dropout)
         states = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *finals)
         return out, states
+
+
+RNNBase = _RNNBase  # public alias (ref: paddle.nn.layer.rnn.RNNBase)
 
 
 class SimpleRNN(_RNNBase):

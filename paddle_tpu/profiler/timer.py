@@ -91,8 +91,9 @@ class Benchmark:
         # consumers scraping the benchmark numbers)
         from paddle_tpu import stats
         for k, v in out.items():
-            if v == v:  # skip NaN
-                stats.set_value(f"benchmark/{k}", v)
+            # NaN publishes too: gauges are last-value-wins, and a stale
+            # number from a previous run is worse than an honest NaN
+            stats.set_value(f"benchmark/{k}", v)
         return out
 
 

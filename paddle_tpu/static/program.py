@@ -332,11 +332,13 @@ class _StaticNN:
         fan_in = (in_c // groups) * int(np.prod(fs))
         fan_out = num_filters * int(np.prod(fs))
         bound = float(np.sqrt(6.0 / (fan_in + fan_out)))
-        wname = name and f"{name}.w"
+        wname = f"{name}.w" if name else prog._unique("conv2d_w")
+        seed = int(np.frombuffer(
+            wname.encode(), dtype=np.uint8).sum()) * 2654435761 % (2 ** 31)
         w = prog.create_parameter(
             wshape, name=wname,
-            initializer=lambda s, b=bound: np.random.RandomState(
-                abs(hash(str(s))) % (2 ** 31)).uniform(-b, b, s))
+            initializer=lambda s, b=bound, sd=seed: np.random.RandomState(
+                sd).uniform(-b, b, s))
         b = prog.create_parameter((num_filters,),
                                   name=name and f"{name}.b",
                                   initializer=lambda s: np.zeros(s))

@@ -1,0 +1,168 @@
+"""Extension functionals closing the nn.functional parity gap
+(ref: nn/functional/extension.py, vision.py, loss.py:472/:1841,
+common.py:2008). Oracles: reference docstring examples + numpy DP."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.nn import functional as F
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(jnp.asarray([3, 1, 0]), maxlen=4)
+    assert np.asarray(m).tolist() == [[1, 1, 1, 0], [1, 0, 0, 0],
+                                      [0, 0, 0, 0]]
+    # maxlen=None → max(x); reference docstring example
+    m2 = F.sequence_mask(jnp.asarray([10, 9, 8]))
+    assert m2.shape == (3, 10)
+    assert np.asarray(m2).sum() == 27
+
+
+def test_gather_tree_reference_example():
+    ids = jnp.asarray([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                       [[0, 1], [9, 0]]])
+    par = jnp.asarray([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                       [[0, 0], [0, 1]]])
+    out = F.gather_tree(ids, par)
+    assert np.asarray(out).tolist() == [[[2, 2], [1, 6]], [[3, 3], [6, 1]],
+                                        [[0, 1], [9, 0]]]
+
+
+def _ed_np(a, b):
+    m, n = len(a), len(b)
+    D = np.zeros((m + 1, n + 1), int)
+    D[:, 0] = range(m + 1)
+    D[0, :] = range(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            D[i, j] = min(D[i - 1, j] + 1, D[i, j - 1] + 1,
+                          D[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return D[m, n]
+
+
+def test_edit_distance_matches_numpy_dp():
+    inp = jnp.asarray([[1, 2, 3], [4, 5, 6], [4, 4, 4], [1, 1, 1]])
+    lab = jnp.asarray([[1, 3, 4, 1], [4, 5, 8, 1], [7, 7, 7, 1],
+                       [1, 1, 1, 1]])
+    d, n = F.edit_distance(inp, lab, normalized=False)
+    want = [float(_ed_np([int(v) for v in inp[i]],
+                         [int(v) for v in lab[i]])) for i in range(4)]
+    assert np.asarray(d).ravel().tolist() == want
+    assert float(n[0]) == 4.0
+    # partial lengths
+    d2, _ = F.edit_distance(inp, lab, normalized=False,
+                            input_length=jnp.asarray([2, 3, 1, 3]),
+                            label_length=jnp.asarray([2, 2, 3, 4]))
+    want2 = [float(_ed_np([int(v) for v in inp[i][:l1]],
+                          [int(v) for v in lab[i][:l2]]))
+             for i, (l1, l2) in enumerate([(2, 2), (3, 2), (1, 3), (3, 4)])]
+    assert np.asarray(d2).ravel().tolist() == want2
+    # normalization divides by label length
+    dn, _ = F.edit_distance(inp, lab)
+    np.testing.assert_allclose(np.asarray(dn).ravel(),
+                               np.asarray(want) / 4.0)
+
+
+def test_temporal_shift():
+    x = jnp.asarray(np.arange(2 * 4 * 2 * 2, dtype=np.float32)
+                    .reshape(2, 4, 2, 2))
+    out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+    assert out.shape == x.shape
+    # channel 0 shifts t-1→t: segment 0 gets zeros, segment 1 gets seg 0
+    x5 = np.asarray(x).reshape(1, 2, 4, 2, 2)
+    o5 = np.asarray(out).reshape(1, 2, 4, 2, 2)
+    assert (o5[0, 0, 0] == 0).all()
+    np.testing.assert_array_equal(o5[0, 1, 0], x5[0, 0, 0])
+    # channel 1 shifts t+1→t; channels 2+ stay
+    np.testing.assert_array_equal(o5[0, 0, 1], x5[0, 1, 1])
+    assert (o5[0, 1, 1] == 0).all()
+    np.testing.assert_array_equal(o5[..., 2:, :, :], x5[..., 2:, :, :])
+
+
+def test_diag_embed():
+    de = F.diag_embed(jnp.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(de), np.diag([1.0, 2.0, 3.0]))
+    de2 = F.diag_embed(jnp.asarray([[1.0, 2.0], [3.0, 4.0]]), offset=1)
+    assert de2.shape == (2, 3, 3)
+    assert float(de2[0, 0, 1]) == 1.0 and float(de2[1, 1, 2]) == 4.0
+    de3 = F.diag_embed(jnp.asarray([1.0, 2.0]), offset=-1)
+    assert float(de3[1, 0]) == 1.0
+
+
+def test_affine_grid_and_grid_sample_identity():
+    theta = jnp.asarray([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]])
+    g = F.affine_grid(theta, [1, 1, 3, 3], align_corners=True)
+    assert g.shape == (1, 3, 3, 2)
+    np.testing.assert_allclose(np.asarray(g)[0, 0, 0], [-1, -1])
+    np.testing.assert_allclose(np.asarray(g)[0, 2, 2], [1, 1])
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 2, 5, 5), jnp.float32)
+    out = F.grid_sample(x, F.affine_grid(theta, [1, 2, 5, 5]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-5)
+    # nearest + border modes run; shifted grid actually shifts
+    shift = jnp.asarray([[[1.0, 0.0, 0.5], [0.0, 1.0, 0.0]]])
+    out2 = F.grid_sample(x, F.affine_grid(shift, [1, 2, 5, 5]),
+                         mode="nearest", padding_mode="border")
+    assert out2.shape == x.shape
+    assert not np.allclose(np.asarray(out2), np.asarray(x))
+
+
+def test_grid_sample_zero_padding_outside():
+    x = jnp.ones((1, 1, 4, 4), jnp.float32)
+    grid = jnp.full((1, 2, 2, 2), 3.0)  # far outside [-1, 1]
+    out = F.grid_sample(x, grid)
+    assert np.allclose(np.asarray(out), 0.0)
+
+
+def test_bilinear():
+    rs = np.random.RandomState(0)
+    x1 = jnp.asarray(rs.rand(3, 4), jnp.float32)
+    x2 = jnp.asarray(rs.rand(3, 5), jnp.float32)
+    w = jnp.asarray(rs.rand(6, 4, 5), jnp.float32)
+    b = jnp.asarray(rs.rand(6), jnp.float32)
+    out = F.bilinear(x1, x2, w, b)
+    want = np.einsum("ni,oij,nj->no", x1, w, x2) + np.asarray(b)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+def test_margin_cross_entropy_zero_margin_is_ce():
+    rs = np.random.RandomState(1)
+    logits = jnp.asarray(rs.uniform(-1, 1, (4, 10)), jnp.float32)
+    y = jnp.asarray([1, 2, 3, 4], jnp.int32)
+    mce = F.margin_cross_entropy(logits, y, margin1=1.0, margin2=0.0,
+                                 margin3=0.0, scale=1.0)
+    np.testing.assert_allclose(float(mce), float(F.cross_entropy(logits, y)),
+                               atol=1e-5)
+    # arcface margin increases the loss on the true class
+    mce2 = F.margin_cross_entropy(logits, y)
+    assert float(mce2) > float(mce)
+    loss, sm = F.margin_cross_entropy(logits, y, return_softmax=True)
+    assert sm.shape == logits.shape
+    np.testing.assert_allclose(np.asarray(sm).sum(-1), 1.0, atol=1e-5)
+
+
+def test_class_center_sample():
+    rl, sampled = F.class_center_sample(jnp.asarray([2, 5, 7]), 10, 5)
+    sampled = np.asarray(sampled)
+    assert len(sampled) == 5 and len(set(sampled.tolist())) == 5
+    assert {2, 5, 7} <= set(sampled.tolist())
+    # positives remap to their index in the sampled list
+    rl = np.asarray(rl)
+    for lab, r in zip([2, 5, 7], rl):
+        assert sampled[r] == lab
+
+
+def test_sparse_attention_shim():
+    offs = jnp.asarray([0, 2, 4, 6, 8])
+    cols = jnp.asarray([0, 1, 0, 1, 2, 3, 2, 3])
+    q = jnp.asarray(np.random.RandomState(0).rand(1, 1, 4, 8), jnp.float32)
+    out = F.sparse_attention(q, q, q, offs, cols)
+    assert out.shape == q.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_inplace_aliases_and_rnnbase():
+    import paddle_tpu.nn as nn
+    assert F.relu_ is F.relu and F.elu_ is F.elu and F.softmax_ is F.softmax
+    assert issubclass(nn.LSTM, nn.RNNBase)
