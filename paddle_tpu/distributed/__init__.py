@@ -21,7 +21,9 @@ from paddle_tpu.distributed import collective
 from paddle_tpu.distributed.collective import (
     Group, new_group, get_group, group_reduce, group_all_gather,
     ReduceOp, all_reduce, all_gather, all_to_all, reduce_scatter, broadcast,
-    psum, pmean, pmax, pmin, ppermute, barrier, send_recv_ring)
+    psum, pmean, pmax, pmin, ppermute, barrier, send_recv_ring,
+    alltoall, alltoall_single, reduce, scatter, split, ParallelMode,
+    stream)
 from paddle_tpu.distributed.api import (shard_tensor, shard_module,
                                         reshard, replicate)
 from paddle_tpu.distributed.ring_attention import (
@@ -40,6 +42,22 @@ from paddle_tpu.distributed.fleet_executor import (
 from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed import rpc
 from paddle_tpu.distributed import ps
+from paddle_tpu.distributed import p2p
+from paddle_tpu.distributed.p2p import (
+    init_p2p, send, recv, isend, irecv, wait, all_gather_object,
+    destroy_process_group)
+from paddle_tpu.distributed.dataset import (
+    InMemoryDataset, QueueDataset, CountFilterEntry, ProbabilityEntry,
+    ShowClickEntry)
+from paddle_tpu.distributed import launch as launch_module
+launch = launch_module  # ref: paddle.distributed.launch (module)
+# gloo_* shims: the reference's CPU-barrier plane; the TCPStore covers it
+def gloo_init_parallel_env(*a, **k):
+    return None
+def gloo_barrier(*a, **k):
+    return None
+def gloo_release(*a, **k):
+    return None
 from paddle_tpu.native import TCPStore  # ≙ fluid.core.TCPStore (C++)
 
 __all__ = ["FleetExecutor", "rendezvous_endpoints", "rpc", "ps", "fleet",
@@ -57,4 +75,10 @@ __all__ = ["FleetExecutor", "rendezvous_endpoints", "rpc", "ps", "fleet",
            "load_state", "AutoCheckpoint", "TCPStore",
            "parallel_cross_entropy", "vocab_parallel_embedding",
            "axis_rng_key", "recompute", "recompute_sequential",
-           "checkpoint_name"]
+           "checkpoint_name", "alltoall", "alltoall_single", "reduce",
+           "scatter", "split", "ParallelMode", "stream", "p2p", "init_p2p",
+           "send", "recv", "isend", "irecv", "wait", "all_gather_object",
+           "destroy_process_group", "InMemoryDataset", "QueueDataset",
+           "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+           "launch", "gloo_init_parallel_env", "gloo_barrier",
+           "gloo_release"]

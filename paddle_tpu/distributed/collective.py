@@ -236,3 +236,96 @@ def group_all_gather(x, group: "Group", tiled_axis=0):
     # as all_gather(..., tiled=True))
     return jnp.concatenate([rows[i] for i in range(part)],
                            axis=tiled_axis)
+
+
+# -- reference-name parity over the same lax machinery ----------------------
+
+def alltoall(in_tensor_list, out_tensor_list=None, axis="ep"):
+    """ref: paddle.distributed.alltoall (list-of-tensors form): rank r's
+    i-th input lands as the r-th output of rank i. In-program form: stack
+    → all_to_all → unstack."""
+    x = jnp.stack([jnp.asarray(t) for t in in_tensor_list])
+    out = lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+    outs = [out[i] for i in range(out.shape[0])]
+    if out_tensor_list is not None:
+        del out_tensor_list[:]
+        out_tensor_list.extend(outs)
+    return outs
+
+
+def alltoall_single(x, axis="ep", split_axis=0, concat_axis=0):
+    """ref: paddle.distributed.alltoall_single (even splits)."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def reduce(x, dst=0, op=ReduceOp.SUM, axis="dp"):
+    """ref: paddle.distributed.reduce — the reduced value lands on rank
+    ``dst``; other ranks keep their input (the reference leaves their
+    output buffer unspecified; keeping the input is deterministic)."""
+    red = all_reduce(x, op=op, axis=axis)
+    return jnp.where(lax.axis_index(axis) == dst, red, x)
+
+
+def scatter(x, src=0, axis="dp"):
+    """ref: paddle.distributed.scatter — rank ``src``'s input, split into
+    axis-size chunks along dim 0; rank i receives chunk i."""
+    full = broadcast(x, src=src, axis=axis)
+    n = lax.axis_size(axis)
+    if full.shape[0] % n:
+        raise ValueError(f"scatter: dim 0 ({full.shape[0]}) must divide "
+                         f"evenly over axis {axis!r} ({n} ranks)")
+    chunk = full.shape[0] // n
+    i = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(full, i * chunk, chunk, axis=0)
+
+
+def split(x, weight=None, bias=None, operation="linear", axis=1,
+          num_partitions=None, gather_out=True):
+    """ref: paddle.distributed.split (fleet/layers/mpu) — the
+    megatron-style model-parallel linear/embedding splitter; delegates to
+    distributed/mp_ops.py's column/row helpers. ``axis``: 1 = column
+    (output-dim) parallel, 0 = row (input-dim) parallel."""
+    from paddle_tpu.distributed import mp_ops
+    if operation == "embedding":
+        return mp_ops.vocab_parallel_embedding(weight, x, axis="tp")
+    if operation != "linear":
+        raise ValueError(f"split: unknown operation {operation!r}")
+    if axis == 1:
+        out = jnp.asarray(x) @ weight  # weight already column-sharded
+        if bias is not None:
+            out = out + bias
+        if gather_out:
+            out = lax.all_gather(out, "tp", axis=out.ndim - 1, tiled=True)
+        return out
+    out = jnp.asarray(x) @ weight      # row-parallel: partial sums
+    out = lax.psum(out, "tp")
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class ParallelMode:
+    """ref: paddle.distributed.ParallelMode enum."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class _StreamNamespace:
+    """ref: paddle.distributed.stream.* — the stream-annotated collective
+    variants. XLA owns stream scheduling, so each maps to the plain op."""
+
+    def __getattr__(self, name):
+        import sys
+        mod = sys.modules[__name__]
+        if hasattr(mod, name):
+            return getattr(mod, name)
+        raise AttributeError(f"stream has no collective {name!r}")
+
+
+stream = _StreamNamespace()
+
+__all__ += ["alltoall", "alltoall_single", "reduce", "scatter", "split",
+            "ParallelMode", "stream"]

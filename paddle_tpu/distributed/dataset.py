@@ -1,0 +1,153 @@
+"""Fleet dataset surface (ref: python/paddle/distributed/fleet/dataset/ —
+InMemoryDataset/QueueDataset feeding the PS trainers, plus the sparse
+table accessor Entry configs of fleet/base/distributed_strategy.py).
+
+TPU-native scope: the reference's datasets wrap a C++ DatasetFactory
+feeding the trainer threads directly; here they are honest Python
+iterables over in-memory samples (the TPU input path is io/dataloader's
+shm-ring DataLoader — these classes exist for PS-workflow API parity)."""
+
+import random
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset", "CountFilterEntry",
+           "ProbabilityEntry", "ShowClickEntry"]
+
+
+class InMemoryDataset:
+    """ref: fleet/dataset/InMemoryDataset — load files into memory,
+    global-shuffle, then iterate batches."""
+
+    def __init__(self):
+        self._samples: List = []
+        self._batch_size = 1
+        self._parse_fn: Optional[Callable] = None
+        self._seed = 0
+
+    def init(self, batch_size=1, parse_fn=None, **kwargs):
+        self._batch_size = batch_size
+        self._parse_fn = parse_fn
+        return self
+
+    set_batch_size = init
+
+    def set_filelist(self, filelist: Iterable[str]):
+        self._filelist = list(filelist)
+
+    def load_into_memory(self):
+        """Parse every line of the filelist with parse_fn (default: float
+        fields)."""
+        self._samples = []
+        for path in getattr(self, "_filelist", []):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if self._parse_fn is not None:
+                        self._samples.append(self._parse_fn(line))
+                    else:
+                        self._samples.append(
+                            np.asarray([float(v) for v in line.split()],
+                                       np.float32))
+
+    def set_samples(self, samples: Iterable):
+        """Direct in-memory feed (no file round-trip needed on TPU)."""
+        self._samples = list(samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=None):
+        rng = random.Random(self._seed if seed is None else seed)
+        rng.shuffle(self._samples)
+        self._seed += 1
+
+    local_shuffle = global_shuffle
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        bs = self._batch_size
+        for i in range(0, len(self._samples) - bs + 1, bs):
+            batch = self._samples[i:i + bs]
+            try:
+                yield np.stack(batch)
+            except Exception:
+                yield batch
+
+    def __len__(self):
+        return max(0, len(self._samples) // self._batch_size)
+
+
+class QueueDataset(InMemoryDataset):
+    """ref: fleet/dataset/QueueDataset — streaming variant: iterates the
+    filelist lazily instead of loading into memory."""
+
+    def load_into_memory(self):
+        raise RuntimeError("QueueDataset streams; use __iter__ directly")
+
+    def __iter__(self):
+        bs = self._batch_size
+        batch = []
+        for path in getattr(self, "_filelist", []):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    sample = (self._parse_fn(line) if self._parse_fn
+                              else np.asarray([float(v) for v
+                                               in line.split()],
+                                              np.float32))
+                    batch.append(sample)
+                    if len(batch) == bs:
+                        try:
+                            yield np.stack(batch)
+                        except Exception:
+                            yield list(batch)
+                        batch = []
+
+
+class _Entry:
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        kv = ", ".join(f"{k}={v!r}" for k, v in self.__dict__.items())
+        return f"{type(self).__name__}({kv})"
+
+
+class CountFilterEntry(_Entry):
+    """ref: sparse-table accessor config — admit a feature id into the
+    table only after ``threshold`` occurrences."""
+
+    def __init__(self, threshold: int = 0):
+        super().__init__(threshold=threshold)
+
+    def admit(self, count: int) -> bool:
+        return count >= self.threshold
+
+
+class ProbabilityEntry(_Entry):
+    """ref: admit new ids with probability ``probability``."""
+
+    def __init__(self, probability: float = 1.0):
+        super().__init__(probability=probability)
+
+    def admit(self, rng: random.Random) -> bool:
+        return rng.random() < self.probability
+
+
+class ShowClickEntry(_Entry):
+    """ref: show/click-weighted accessor (CTR tables)."""
+
+    def __init__(self, show_coeff: float = 1.0, click_coeff: float = 1.0):
+        super().__init__(show_coeff=show_coeff, click_coeff=click_coeff)
+
+    def score(self, shows: float, clicks: float) -> float:
+        return self.show_coeff * shows + self.click_coeff * clicks

@@ -1,0 +1,195 @@
+"""Eager cross-process point-to-point sends (send/recv/isend/irecv) and
+object collectives.
+
+Reference analog: paddle.distributed.{send, recv, isend, irecv, wait,
+all_gather_object} over ProcessGroupNCCL p2p
+(collective/ProcessGroupNCCL.cc Send/Recv + distributed/communication/).
+
+TPU-native stance: INSIDE a jitted program, point-to-point is
+``lax.ppermute`` (see collective.send_recv_ring) and XLA schedules it on
+ICI. These functions are the EAGER, host-side path the reference also
+has — moving a tensor between controller processes over DCN — riding the
+framework's native tag-addressed P2P endpoint (native/src/p2p.cc), with
+the TCPStore for rendezvous. isend/irecv return Task objects with
+``wait()`` (the endpoint's reader threads make isend genuinely async;
+irecv completes on first wait)."""
+
+import io
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["init_p2p", "send", "recv", "isend", "irecv", "wait",
+           "all_gather_object", "destroy_process_group"]
+
+_state = None
+_lock = threading.Lock()
+_TAG_KIND = 3 << 60  # distinct from fleet-executor/rpc tag spaces
+
+
+class _P2PState:
+    def __init__(self, rank, world, store, endpoint, peers):
+        self.rank = rank
+        self.world = world
+        self.store = store
+        self.endpoint = endpoint
+        self.peers = peers
+        self.send_seq = {}
+        self.recv_seq = {}
+        self.ago_round = 0
+
+
+def init_p2p(rank: Optional[int] = None, world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None,
+             host: str = "127.0.0.1"):
+    """Rendezvous the eager p2p group (rank 0 hosts the store)."""
+    global _state
+    from paddle_tpu import native
+
+    rank = int(os.environ.get("PT_PROCESS_ID", 0)) if rank is None else rank
+    world_size = int(os.environ.get("PT_NUM_PROCESSES", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PT_COORDINATOR", "127.0.0.1:23900")
+    mhost, mport = master_endpoint.rsplit(":", 1)
+    # offset: don't collide with the jax.distributed coordinator itself
+    port = int(mport) + 7
+    store = native.TCPStore(mhost if rank else "127.0.0.1", port,
+                            is_master=(rank == 0), timeout=60.0)
+    endpoint = native.P2PEndpoint()
+    store.set(f"p2p/addr/{rank}", f"{host}:{endpoint.port}".encode())
+    peers = []
+    for r in range(world_size):
+        raw = store.get(f"p2p/addr/{r}", timeout=60.0).decode()
+        h, p = raw.rsplit(":", 1)
+        peers.append((h, int(p)))
+    with _lock:
+        _state = _P2PState(rank, world_size, store, endpoint, peers)
+    return _state
+
+
+def _require():
+    if _state is None:
+        raise RuntimeError("call distributed.init_p2p() first (or run "
+                           "under the launch CLI and call init_p2p())")
+    return _state
+
+
+def _tag(src, dst, seq):
+    return _TAG_KIND | (src << 44) | (dst << 28) | (seq & 0xFFFFFFF)
+
+
+def _pack(value) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(value), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _unpack(payload: bytes):
+    return np.load(io.BytesIO(payload), allow_pickle=False)
+
+
+class Task:
+    """≙ the reference's distributed Task future (ProcessGroup::Task)."""
+
+    def __init__(self, fn):
+        self._result = None
+        self._exc = None
+        self._done = threading.Event()
+
+        def run():
+            try:
+                self._result = fn()
+            except BaseException as e:  # surfaced on wait()
+                self._exc = e
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("p2p task did not complete in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def is_completed(self):
+        return self._done.is_set()
+
+
+def send(tensor, dst: int):
+    """ref: paddle.distributed.send — blocking eager send to rank dst."""
+    st = _require()
+    seq = st.send_seq[dst] = st.send_seq.get(dst, 0) + 1
+    h, p = st.peers[dst]
+    st.endpoint.send(h, p, _tag(st.rank, dst, seq), _pack(tensor))
+
+
+def recv(tensor=None, src: int = 0, timeout: float = 120.0):
+    """ref: paddle.distributed.recv — blocking receive from rank src.
+    Returns the received array (also copied into ``tensor`` when a numpy
+    array is passed, matching the reference's out-param style)."""
+    st = _require()
+    seq = st.recv_seq[src] = st.recv_seq.get(src, 0) + 1
+    payload = st.endpoint.recv(_tag(src, st.rank, seq), timeout)
+    out = _unpack(payload)
+    if tensor is not None and isinstance(tensor, np.ndarray):
+        tensor[...] = out
+    return out
+
+
+def isend(tensor, dst: int) -> Task:
+    """ref: paddle.distributed.isend — async send; wait() for completion."""
+    value = np.asarray(tensor)  # snapshot before returning
+    return Task(lambda: send(value, dst))
+
+
+def irecv(tensor=None, src: int = 0, timeout: float = 120.0) -> Task:
+    """ref: paddle.distributed.irecv."""
+    return Task(lambda: recv(tensor, src, timeout))
+
+
+def wait(task_or_tensor, group=None, use_calc_stream=True):
+    """ref: paddle.distributed.wait. For a Task, block on it. For a
+    tensor, a no-op returning it: XLA's data dependencies ARE the stream
+    ordering the reference's c_sync_* ops enforce by hand."""
+    if isinstance(task_or_tensor, Task):
+        return task_or_tensor.wait()
+    return task_or_tensor
+
+
+def all_gather_object(obj_list, obj, timeout: float = 120.0):
+    """ref: paddle.distributed.all_gather_object — gather arbitrary
+    (json-serializable) python objects from every rank through the store.
+    The reference pickles over NCCL; a control-plane store exchange is
+    the honest transport for objects."""
+    import json
+
+    st = _require()
+    # per-call round id: a LOCAL counter — every rank calls
+    # all_gather_object collectively, so local counts agree
+    st.ago_round += 1
+    key = st.ago_round
+    st.store.set(f"p2p/ago/{key}/{st.rank}", json.dumps(obj).encode())
+    del obj_list[:]
+    for r in range(st.world):
+        raw = st.store.get(f"p2p/ago/{key}/{r}", timeout=timeout)
+        obj_list.append(json.loads(raw.decode()))
+    return obj_list
+
+
+def destroy_process_group(group=None):
+    """ref: paddle.distributed.destroy_process_group."""
+    global _state
+    with _lock:
+        if _state is not None:
+            try:
+                _state.endpoint.close()
+                _state.store.close()
+            except Exception:
+                pass
+            _state = None

@@ -19,22 +19,30 @@ def sparse_attention(query, key, value, sparse_csr_offset,
     import paddle_tpu.sparse as S
     from paddle_tpu.sparse.nn import functional as sparse_F
 
-    offs = jnp.asarray(sparse_csr_offset)
-    cols = jnp.asarray(sparse_csr_columns)
+    import numpy as _np
+
     # batch/head-shared 2-D pattern (the kernel broadcasts over B, H);
-    # refuse to silently collapse genuinely per-head patterns
-    for arr_name, arr in (("offset", offs), ("columns", cols)):
-        while arr.ndim > 1:
-            first = arr[0]
-            if not bool(jnp.all(arr == first[None])):
-                raise NotImplementedError(
-                    f"sparse_attention: per-batch/per-head CSR {arr_name} "
-                    "patterns differ; only a shared pattern is supported")
-            arr = first
-        if arr_name == "offset":
-            offs = arr
-        else:
-            cols = arr
+    # refuse to silently collapse genuinely per-head patterns. The
+    # pattern is static data, so the check runs host-side — under jit a
+    # traced >1-D pattern cannot be verified and is rejected outright.
+    def _collapse(arr_name, arr):
+        if getattr(arr, "ndim", 1) <= 1:
+            return jnp.asarray(arr)
+        try:
+            host = _np.asarray(arr)
+        except Exception:
+            raise NotImplementedError(
+                f"sparse_attention: traced multi-dim CSR {arr_name} under "
+                "jit; pass a shared 1-D pattern instead") from None
+        first = host.reshape(-1, host.shape[-1])[0]
+        if not (host == first).all():
+            raise NotImplementedError(
+                f"sparse_attention: per-batch/per-head CSR {arr_name} "
+                "patterns differ; only a shared pattern is supported")
+        return jnp.asarray(first)
+
+    offs = _collapse("offset", sparse_csr_offset)
+    cols = _collapse("columns", sparse_csr_columns)
     s = query.shape[-2]
     mask = S.sparse_csr_tensor(offs, cols,
                                jnp.ones(cols.shape, jnp.float32), (s, s))

@@ -11,12 +11,15 @@ __all__ = ["sequence_mask", "gather_tree", "temporal_shift", "diag_embed",
 
 
 def sequence_mask(x, maxlen=None, dtype="int64"):
-    """ref: extension.py:162 — y[..., j] = (j < x[...])."""
+    """ref: extension.py:162 — y[..., j] = (j < x[...]). The dtype maps
+    through the framework dtype table (int64 → int32 under JAX's default
+    32-bit mode, silently, like every other int64-taking op here)."""
+    from paddle_tpu.dtypes import to_dtype
     x = jnp.asarray(x)
     if maxlen is None:
         maxlen = int(jnp.max(x))  # host read, like the reference's max(x)
     mask = jnp.arange(maxlen) < x[..., None]
-    return mask.astype(dtype)
+    return mask.astype(to_dtype(dtype))
 
 
 def gather_tree(ids, parents):

@@ -333,8 +333,8 @@ class _StaticNN:
         fan_out = num_filters * int(np.prod(fs))
         bound = float(np.sqrt(6.0 / (fan_in + fan_out)))
         wname = f"{name}.w" if name else prog._unique("conv2d_w")
-        seed = int(np.frombuffer(
-            wname.encode(), dtype=np.uint8).sum()) * 2654435761 % (2 ** 31)
+        import zlib
+        seed = zlib.crc32(wname.encode()) % (2 ** 31)
         w = prog.create_parameter(
             wshape, name=wname,
             initializer=lambda s, b=bound, sd=seed: np.random.RandomState(

@@ -8,7 +8,9 @@ import numpy as np
 __all__ = ["nms", "box_coder", "yolo_box", "roi_align",
            "distribute_fpn_proposals", "roi_pool", "psroi_pool",
            "matrix_nms", "prior_box", "deform_conv2d", "DeformConv2D",
-           "generate_proposals"]
+           "generate_proposals",
+           "yolo_loss", "read_file", "decode_jpeg", "RoIAlign", "RoIPool",
+           "PSRoIPool", "ConvNormActivation"]
 
 
 def _iou_matrix(boxes1, boxes2):
@@ -510,3 +512,218 @@ class DeformConv2D(_Module):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              self.stride, self.padding, self.dilation,
                              mask=mask)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0):
+    """ref: vision/ops.py yolo_loss:52 (yolov3_loss op) — per-sample
+    YOLOv3 loss over one detection scale.
+
+    x: (N, S*(5+class_num), H, W) raw head output; gt_box (N, B, 4)
+    center-xywh normalized to [0, 1]; gt_label (N, B) int32 (boxes with
+    w<=0 are padding); anchors: flat [w0, h0, w1, h1, ...] pixel sizes;
+    anchor_mask: indices of this scale's anchors. Returns (N,) loss.
+    """
+    x = jnp.asarray(x)
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    gt_label = jnp.asarray(gt_label, jnp.int32)
+    n, c, h, w = x.shape
+    s = len(anchor_mask)
+    assert c == s * (5 + class_num), (c, s, class_num)
+    all_anch = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_anch = jnp.asarray(all_anch[list(anchor_mask)])   # (S, 2) pixels
+    input_size = downsample_ratio * h
+    if gt_score is None:
+        gt_score = jnp.ones(gt_label.shape, jnp.float32)
+
+    p = x.reshape(n, s, 5 + class_num, h, w)
+    tx, ty = p[:, :, 0], p[:, :, 1]           # (N, S, H, W)
+    tw, th = p[:, :, 2], p[:, :, 3]
+    tobj = p[:, :, 4]
+    tcls = p[:, :, 5:]                        # (N, S, class_num, H, W)
+
+    # -- target assignment: best anchor (wh IoU) per gt box ----------------
+    gw = gt_box[..., 2] * input_size          # (N, B) pixels
+    gh = gt_box[..., 3] * input_size
+    inter = (jnp.minimum(gw[..., None], all_anch[None, None, :, 0])
+             * jnp.minimum(gh[..., None], all_anch[None, None, :, 1]))
+    union = (gw * gh)[..., None] + (all_anch[:, 0] * all_anch[:, 1]
+                                    )[None, None] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # (N, B)
+    valid = gt_box[..., 2] > 0
+    # the gt lands on this scale iff its best anchor is in anchor_mask
+    mask_arr = jnp.asarray(list(anchor_mask))
+    on_scale = jnp.any(best[..., None] == mask_arr[None, None], -1) & valid
+    slot = jnp.argmax(
+        (best[..., None] == mask_arr[None, None]).astype(jnp.int32), -1)
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    # scatter per-gt targets into (N, S, H, W) grids; off-scale/padding
+    # boxes write to an extra discard slot S (dropped after the scatter),
+    # so they can never clobber a real box landing at the same cell
+    slot_or_discard = jnp.where(on_scale, slot, s)
+
+    def scat(values, fill=0.0):
+        out = jnp.full((n, s + 1, h, w), fill, jnp.float32)
+        bidx = jnp.arange(n)[:, None] * jnp.ones_like(slot)
+        out = out.at[bidx, slot_or_discard, gj, gi].set(values)
+        return out[:, :s]
+
+    obj_target = scat(jnp.ones_like(gw))
+    sx = gt_box[..., 0] * w - gi               # σ(tx) target in [0,1)
+    sy = gt_box[..., 1] * h - gj
+    twt = jnp.log(jnp.maximum(gw[..., None] / mask_anch[None, None, :, 0],
+                              1e-9))           # (N, B, S)
+    twt = jnp.take_along_axis(twt, slot[..., None], -1)[..., 0]
+    tht = jnp.log(jnp.maximum(gh[..., None] / mask_anch[None, None, :, 1],
+                              1e-9))
+    tht = jnp.take_along_axis(tht, slot[..., None], -1)[..., 0]
+    box_w = 2.0 - gt_box[..., 2] * gt_box[..., 3]  # small-box upweight
+    pos = scat(jnp.ones_like(gw)) > 0          # (N, S, H, W) bool
+    x_t, y_t = scat(sx), scat(sy)
+    w_t, h_t = scat(twt), scat(tht)
+    wgt = scat(box_w * gt_score)
+    lbl = scat(gt_label.astype(jnp.float32), fill=-1.0).astype(jnp.int32)
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+
+    loss_xy = wgt * (bce(tx, x_t) + bce(ty, y_t)) * pos
+    loss_wh = wgt * (jnp.abs(tw - w_t) + jnp.abs(th - h_t)) * pos
+
+    # objectness: positives → 1; negatives whose PREDICTED box overlaps
+    # any gt above ignore_thresh are ignored
+    cx = (jnp.arange(w)[None, None, None] + jax.nn.sigmoid(tx)) / w
+    cy = (jnp.arange(h)[None, None, :, None] + jax.nn.sigmoid(ty)) / h
+    pw = mask_anch[None, :, None, None, 0] * jnp.exp(tw) / input_size
+    ph = mask_anch[None, :, None, None, 1] * jnp.exp(th) / input_size
+    px1, px2 = cx - pw / 2, cx + pw / 2
+    py1, py2 = cy - ph / 2, cy + ph / 2
+    g = gt_box[:, None, None, None]            # (N, 1, 1, 1, B, 4)
+    gx1 = g[..., 0] - g[..., 2] / 2
+    gx2 = g[..., 0] + g[..., 2] / 2
+    gy1 = g[..., 1] - g[..., 3] / 2
+    gy2 = g[..., 1] + g[..., 3] / 2
+    iw = jnp.maximum(jnp.minimum(px2[..., None], gx2)
+                     - jnp.maximum(px1[..., None], gx1), 0)
+    ih = jnp.maximum(jnp.minimum(py2[..., None], gy2)
+                     - jnp.maximum(py1[..., None], gy1), 0)
+    inter_p = iw * ih
+    area_p = (px2 - px1)[..., None] * (py2 - py1)[..., None]
+    area_g = (gx2 - gx1) * (gy2 - gy1)
+    iou = inter_p / jnp.maximum(area_p + area_g - inter_p, 1e-9)
+    iou = jnp.where(valid[:, None, None, None], iou, 0.0)
+    ignore = (jnp.max(iou, -1) > ignore_thresh) & ~pos
+    obj_w = jnp.where(ignore, 0.0, 1.0)
+    loss_obj = obj_w * bce(tobj, obj_target)
+
+    smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+    onehot = jax.nn.one_hot(jnp.clip(lbl, 0, class_num - 1), class_num,
+                            axis=2)
+    onehot = onehot * (1.0 - smooth) + smooth / class_num
+    loss_cls = jnp.sum(bce(tcls, onehot), axis=2) * pos
+
+    total = (loss_xy + loss_wh + loss_obj + loss_cls)
+    return jnp.sum(total, axis=(1, 2, 3))
+
+
+def read_file(path):
+    """ref: vision/ops.py read_file — file bytes as a uint8 tensor."""
+    with open(path, "rb") as f:
+        return jnp.asarray(np.frombuffer(f.read(), np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged"):
+    """ref: vision/ops.py decode_jpeg (nvjpeg-backed there; PIL here —
+    image IO is host-side input-pipeline work on TPU). x: uint8 bytes
+    tensor from read_file. Returns (C, H, W) uint8."""
+    import io as _io
+
+    from PIL import Image
+    img = Image.open(_io.BytesIO(np.asarray(x, np.uint8).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)
+
+
+class RoIAlign(_Module):
+    """Layer form of roi_align (ref: vision/ops.py RoIAlign:1310)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(_Module):
+    """Layer form of roi_pool (ref: vision/ops.py RoIPool:1154)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(_Module):
+    """Layer form of psroi_pool (ref: vision/ops.py PSRoIPool:1076)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class ConvNormActivation(_Module):
+    """ref: vision/ops.py ConvNormActivation — Conv2D + BatchNorm2D +
+    activation block (the torchvision-style building block)."""
+
+    _DEFAULT = object()  # distinguishes "unspecified" from explicit None
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=_DEFAULT,
+                 activation_layer=_DEFAULT, dilation=1, bias=None):
+        super().__init__()
+        from paddle_tpu import nn as _nn
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        nl = _nn.BatchNorm2D if norm_layer is self._DEFAULT else norm_layer
+        al = _nn.ReLU if activation_layer is self._DEFAULT \
+            else activation_layer
+        if bias is None:
+            bias = nl is None  # reference: conv bias only without a norm
+        self.conv = _nn.Conv2D(in_channels, out_channels, kernel_size,
+                               stride, padding, dilation=dilation,
+                               groups=groups,
+                               bias_attr=None if bias else False)
+        self.norm = nl(out_channels) if nl is not None else None
+        self.act = al() if al is not None else None
+
+    def forward(self, x):
+        x = self.conv(x)
+        if self.norm is not None:
+            x = self.norm(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x

@@ -1,0 +1,34 @@
+"""Spawned worker for eager p2p tests (send/recv/isend/irecv over the
+native endpoint)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+
+def worker(rank, port, tmpdir):
+    from paddle_tpu.distributed import p2p
+    p2p.init_p2p(rank=rank, world_size=2,
+                 master_endpoint=f"127.0.0.1:{port}")
+    if rank == 0:
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        p2p.send(x, dst=1)
+        t = p2p.isend(x * 2, dst=1)
+        p2p.wait(t)
+        back = p2p.recv(src=1)
+        np.testing.assert_allclose(back, x + 1)
+    else:
+        got = p2p.recv(src=0)
+        np.testing.assert_allclose(
+            got, np.arange(12, dtype=np.float32).reshape(3, 4))
+        t = p2p.irecv(src=0)
+        got2 = p2p.wait(t)
+        np.testing.assert_allclose(got2, got * 2)
+        p2p.send(got + 1, dst=0)
+    objs = []
+    p2p.all_gather_object(objs, {"rank": rank, "sq": rank * rank})
+    assert objs == [{"rank": 0, "sq": 0}, {"rank": 1, "sq": 1}], objs
+    p2p.destroy_process_group()
+    open(os.path.join(tmpdir, f"ok{rank}"), "w").close()
