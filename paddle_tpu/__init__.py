@@ -35,6 +35,14 @@ from paddle_tpu.framework import (
     Tensor, to_tensor, is_tensor, no_grad, device_count, devices,
     set_device, get_device, grad, value_and_grad, stop_gradient,
 )
+from paddle_tpu.framework.compat import (
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, NPUPlace, TPUPlace, ParamAttr,
+    LazyGuard, DataParallel, enable_static, disable_static,
+    in_dynamic_mode, is_grad_enabled, set_grad_enabled, check_shape,
+    disable_signal_handler, get_cuda_rng_state, set_cuda_rng_state,
+    create_parameter, iinfo, reverse)
+from paddle_tpu.dtypes import bool_ as bool  # noqa: A001 (ref name)
+from paddle_tpu.dtypes import to_dtype as dtype  # ref: paddle.dtype
 
 import paddle_tpu.nn as nn
 import paddle_tpu.optimizer as optimizer
@@ -58,6 +66,7 @@ import paddle_tpu.fft as fft
 import paddle_tpu.signal as signal
 import paddle_tpu.stats as stats
 import paddle_tpu.onnx as onnx
+import paddle_tpu.inference as inference
 import paddle_tpu.jit as jit  # callable module: paddle_tpu.jit(fn) / jit.to_static
 import paddle_tpu.hub as hub
 import paddle_tpu.device as device
@@ -82,6 +91,12 @@ __all__ = (
      "value_and_grad", "stop_gradient", "device_count", "devices",
      "set_device", "get_device", "save", "load", "Model", "summary", "flops",
      "seed", "get_rng_state", "set_rng_state", "get_flags", "set_flags",
-     "get_default_dtype", "set_default_dtype"]
+     "get_default_dtype", "set_default_dtype", "inference",
+     "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "NPUPlace", "TPUPlace",
+     "ParamAttr", "LazyGuard", "DataParallel", "enable_static",
+     "disable_static", "in_dynamic_mode", "is_grad_enabled",
+     "set_grad_enabled", "check_shape", "disable_signal_handler",
+     "get_cuda_rng_state", "set_cuda_rng_state", "create_parameter",
+     "iinfo", "reverse", "bool", "dtype"]
     + list(_tensor_all)
 )

@@ -286,9 +286,19 @@ def split(x, weight=None, bias=None, operation="linear", axis=1,
     megatron-style model-parallel linear/embedding splitter; delegates to
     distributed/mp_ops.py's column/row helpers. ``axis``: 1 = column
     (output-dim) parallel, 0 = row (input-dim) parallel."""
-    from paddle_tpu.distributed import mp_ops
     if operation == "embedding":
-        return mp_ops.vocab_parallel_embedding(weight, x, axis="tp")
+        # in-shard_map vocab-parallel lookup (split's linear branch also
+        # assumes the caller's shard_map): each rank holds a contiguous
+        # row range of the table; out-of-range ids read row 0 masked to
+        # zero, psum over tp sums exactly one live contribution
+        ids = jnp.asarray(x)
+        per = weight.shape[0]
+        start = lax.axis_index("tp") * per
+        local = ids - start
+        in_range = (local >= 0) & (local < per)
+        rows = weight[jnp.clip(local, 0, per - 1)]
+        rows = jnp.where(in_range[..., None], rows, 0.0)
+        return lax.psum(rows, "tp")
     if operation != "linear":
         raise ValueError(f"split: unknown operation {operation!r}")
     if axis == 1:

@@ -24,14 +24,19 @@ class InMemoryDataset:
         self._samples: List = []
         self._batch_size = 1
         self._parse_fn: Optional[Callable] = None
+        self._drop_last = False
         self._seed = 0
 
-    def init(self, batch_size=1, parse_fn=None, **kwargs):
+    def init(self, batch_size=1, parse_fn=None, drop_last=False,
+             **kwargs):
         self._batch_size = batch_size
         self._parse_fn = parse_fn
+        self._drop_last = drop_last
         return self
 
-    set_batch_size = init
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+        return self
 
     def set_filelist(self, filelist: Iterable[str]):
         self._filelist = list(filelist)
@@ -72,8 +77,10 @@ class InMemoryDataset:
 
     def __iter__(self):
         bs = self._batch_size
-        for i in range(0, len(self._samples) - bs + 1, bs):
+        for i in range(0, len(self._samples), bs):
             batch = self._samples[i:i + bs]
+            if len(batch) < bs and self._drop_last:
+                break
             try:
                 yield np.stack(batch)
             except Exception:
@@ -110,6 +117,11 @@ class QueueDataset(InMemoryDataset):
                         except Exception:
                             yield list(batch)
                         batch = []
+        if batch and not self._drop_last:  # trailing partial batch
+            try:
+                yield np.stack(batch)
+            except Exception:
+                yield list(batch)
 
 
 class _Entry:

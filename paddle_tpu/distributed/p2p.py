@@ -121,10 +121,16 @@ class Task:
         return self._done.is_set()
 
 
-def send(tensor, dst: int):
+def _next_send_seq(st, dst):
+    with _lock:
+        st.send_seq[dst] = st.send_seq.get(dst, 0) + 1
+        return st.send_seq[dst]
+
+
+def send(tensor, dst: int, _seq=None):
     """ref: paddle.distributed.send — blocking eager send to rank dst."""
     st = _require()
-    seq = st.send_seq[dst] = st.send_seq.get(dst, 0) + 1
+    seq = _next_send_seq(st, dst) if _seq is None else _seq
     h, p = st.peers[dst]
     st.endpoint.send(h, p, _tag(st.rank, dst, seq), _pack(tensor))
 
@@ -134,8 +140,13 @@ def recv(tensor=None, src: int = 0, timeout: float = 120.0):
     Returns the received array (also copied into ``tensor`` when a numpy
     array is passed, matching the reference's out-param style)."""
     st = _require()
-    seq = st.recv_seq[src] = st.recv_seq.get(src, 0) + 1
+    with _lock:
+        seq = st.recv_seq.get(src, 0) + 1
+    # committed only on success: a timed-out recv can be retried and
+    # still match the sender's sequence
     payload = st.endpoint.recv(_tag(src, st.rank, seq), timeout)
+    with _lock:
+        st.recv_seq[src] = seq
     out = _unpack(payload)
     if tensor is not None and isinstance(tensor, np.ndarray):
         tensor[...] = out
@@ -143,9 +154,13 @@ def recv(tensor=None, src: int = 0, timeout: float = 120.0):
 
 
 def isend(tensor, dst: int) -> Task:
-    """ref: paddle.distributed.isend — async send; wait() for completion."""
+    """ref: paddle.distributed.isend — async send; wait() for completion.
+    The sequence number is claimed in CALL order (not worker-thread
+    order), so interleaved isend/send to one destination stay FIFO."""
+    st = _require()
     value = np.asarray(tensor)  # snapshot before returning
-    return Task(lambda: send(value, dst))
+    seq = _next_send_seq(st, dst)
+    return Task(lambda: send(value, dst, _seq=seq))
 
 
 def irecv(tensor=None, src: int = 0, timeout: float = 120.0) -> Task:

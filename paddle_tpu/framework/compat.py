@@ -1,0 +1,204 @@
+"""Top-level API-parity compatibility surface.
+
+Reference analog: the grab-bag of names paddle exports at top level from
+fluid/framework.py and fluid/core — Places, ParamAttr, static-mode
+toggles, grad toggles, rng-state accessors. On TPU most are
+single-implementation trivia (PJRT owns devices, jax owns RNG keys), but
+reference scripts import them, so they exist with honest semantics."""
+
+import contextlib
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "NPUPlace",
+           "TPUPlace", "ParamAttr", "LazyGuard", "DataParallel",
+           "enable_static", "disable_static", "in_dynamic_mode",
+           "is_grad_enabled", "set_grad_enabled", "check_shape",
+           "disable_signal_handler", "get_cuda_rng_state",
+           "set_cuda_rng_state", "create_parameter", "iinfo", "reverse"]
+
+
+class _Place:
+    """≙ fluid.core Place family. One real backend (PJRT); the CUDA/NPU
+    places exist so reference scripts parse, and all map to the default
+    device."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+
+class CPUPlace(_Place):
+    pass
+
+
+class CUDAPlace(_Place):
+    pass
+
+
+class CUDAPinnedPlace(_Place):
+    pass
+
+
+class NPUPlace(_Place):
+    pass
+
+
+class TPUPlace(_Place):
+    pass
+
+
+class ParamAttr:
+    """≙ paddle.ParamAttr — parameter configuration carried into layer
+    constructors (initializer / trainable / name / regularizer)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    def __call__(self, shape, dtype=None):
+        """Materialize: layers may call a ParamAttr like an initializer."""
+        from paddle_tpu.dtypes import get_default_dtype
+        from paddle_tpu.nn import initializer as I
+        init = self.initializer or I.XavierUniform()
+        return init(shape, dtype or get_default_dtype())
+
+
+@contextlib.contextmanager
+def LazyGuard():  # noqa: N802 (reference name)
+    """≙ paddle.LazyGuard — delays parameter materialization in the
+    reference; here parameters are cheap jax arrays, so it scopes
+    nothing but keeps lazy-init scripts running."""
+    yield
+
+
+class DataParallel:
+    """≙ paddle.DataParallel(model): in the reference this installs NCCL
+    gradient all-reduce hooks. Under SPMD the compiler inserts the
+    gradient psum from shardings, so this wrapper only carries the model
+    (and the no-op sync entry points scripts call)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        self._layers = layers
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # pmean over dp is the train step's job
+
+    def apply_collective_grads(self):
+        return None
+
+
+_mode = threading.local()
+
+
+def enable_static():
+    """≙ paddle.enable_static — scripts then build via paddle.static."""
+    _mode.static = True
+
+
+def disable_static():
+    _mode.static = False
+
+
+def in_dynamic_mode() -> bool:
+    return not getattr(_mode, "static", False)
+
+
+_grad = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    """≙ paddle.is_grad_enabled. Informational under jax: gradients are
+    computed by explicit transforms, not a tape; paddle.no_grad /
+    set_grad_enabled flip this flag for script parity."""
+    return getattr(_grad, "enabled", True)
+
+
+def set_grad_enabled(enabled: bool):
+    class _Ctx:
+        def __enter__(self):
+            self._prev = is_grad_enabled()
+            _grad.enabled = bool(enabled)
+            return self
+
+        def __exit__(self, *exc):
+            _grad.enabled = self._prev
+            return False
+
+    return _Ctx()
+
+
+def check_shape(shape):
+    """≙ fluid check_shape: validate a creation-op shape argument."""
+    if isinstance(shape, (list, tuple)):
+        for d in shape:
+            if not isinstance(d, (int, np.integer)) and not hasattr(
+                    d, "dtype"):
+                raise TypeError(f"shape entries must be ints, got {d!r}")
+    return shape
+
+
+def disable_signal_handler():
+    """≙ paddle.disable_signal_handler — the reference unhooks its C++
+    fault handlers; this runtime installs none."""
+    return None
+
+
+def get_cuda_rng_state():
+    """≙ paddle.get_cuda_rng_state — maps to the framework RNG state (one
+    RNG plane here; 'cuda' is a name, the state is the jax key)."""
+    from paddle_tpu import random as pt_random
+    return pt_random.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from paddle_tpu import random as pt_random
+    return pt_random.set_rng_state(state)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """≙ paddle.create_parameter (top level): a fresh initialized array."""
+    from paddle_tpu.dtypes import to_dtype
+    from paddle_tpu.nn import initializer as I
+    init = default_initializer or (
+        attr.initializer if isinstance(attr, ParamAttr) and attr.initializer
+        else (I.Constant(0.0) if is_bias else I.XavierUniform()))
+    return init(tuple(shape), to_dtype(dtype))
+
+
+def iinfo(dtype):
+    """≙ paddle.iinfo."""
+    from paddle_tpu.dtypes import to_dtype
+    return jnp.iinfo(to_dtype(dtype))
+
+
+def reverse(x, axis):
+    """≙ paddle.reverse (legacy name for flip)."""
+    from paddle_tpu.tensor.manipulation import flip
+    return flip(x, axis)

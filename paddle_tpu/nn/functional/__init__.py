@@ -26,14 +26,14 @@ def sparse_attention(query, key, value, sparse_csr_offset,
     # pattern is static data, so the check runs host-side — under jit a
     # traced >1-D pattern cannot be verified and is rejected outright.
     def _collapse(arr_name, arr):
-        if getattr(arr, "ndim", 1) <= 1:
-            return jnp.asarray(arr)
         try:
-            host = _np.asarray(arr)
+            host = _np.asarray(arr)  # lists/tuples/np/jax concretize here
         except Exception:
             raise NotImplementedError(
                 f"sparse_attention: traced multi-dim CSR {arr_name} under "
                 "jit; pass a shared 1-D pattern instead") from None
+        if host.ndim <= 1:
+            return jnp.asarray(host)
         first = host.reshape(-1, host.shape[-1])[0]
         if not (host == first).all():
             raise NotImplementedError(

@@ -581,7 +581,12 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                               1e-9))
     tht = jnp.take_along_axis(tht, slot[..., None], -1)[..., 0]
     box_w = 2.0 - gt_box[..., 2] * gt_box[..., 3]  # small-box upweight
-    pos = scat(jnp.ones_like(gw)) > 0          # (N, S, H, W) bool
+    pos = obj_target > 0                       # (N, S, H, W) bool
+    # scale_x_y (YOLOv4 grid sensitivity): pred bx = sigma(tx)*a-(a-1)/2,
+    # so the sigmoid target is (offset + (a-1)/2)/a (matches yolo_box)
+    a = float(scale_x_y)
+    sx = jnp.clip((sx + (a - 1.0) / 2.0) / a, 0.0, 1.0)
+    sy = jnp.clip((sy + (a - 1.0) / 2.0) / a, 0.0, 1.0)
     x_t, y_t = scat(sx), scat(sy)
     w_t, h_t = scat(twt), scat(tht)
     wgt = scat(box_w * gt_score)

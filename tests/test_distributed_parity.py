@@ -141,3 +141,20 @@ def test_split_column_and_row_parallel():
                      in_specs=(P(None, "tp"), P("tp", None)),
                      out_specs=P(), check_rep=False)(x, w)
     np.testing.assert_allclose(np.asarray(out2), dense, rtol=1e-5)
+
+
+def test_split_embedding_vocab_parallel():
+    topo = dist.init_mesh(tp=8)
+    rs = np.random.RandomState(0)
+    table = jnp.asarray(rs.rand(16, 4), jnp.float32)  # vocab 16, dim 4
+    ids = jnp.asarray([0, 3, 7, 15, 8, 2], jnp.int32)
+
+    def body(idv, tv):
+        return dist.split(idv, tv, operation="embedding")
+
+    out = shard_map(body, mesh=topo.mesh,
+                    in_specs=(P(), P("tp", None)),
+                    out_specs=P(), check_rep=False)(ids, table)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(table)[np.asarray(ids)],
+                               rtol=1e-6)
