@@ -140,13 +140,19 @@ def recv(tensor=None, src: int = 0, timeout: float = 120.0):
     Returns the received array (also copied into ``tensor`` when a numpy
     array is passed, matching the reference's out-param style)."""
     st = _require()
+    # claim a DISTINCT seq per call (concurrent irecvs must not share a
+    # tag); on timeout, roll the claim back if no later recv claimed past
+    # us, so a retry still matches the sender's sequence
     with _lock:
         seq = st.recv_seq.get(src, 0) + 1
-    # committed only on success: a timed-out recv can be retried and
-    # still match the sender's sequence
-    payload = st.endpoint.recv(_tag(src, st.rank, seq), timeout)
-    with _lock:
         st.recv_seq[src] = seq
+    try:
+        payload = st.endpoint.recv(_tag(src, st.rank, seq), timeout)
+    except TimeoutError:
+        with _lock:
+            if st.recv_seq.get(src) == seq:
+                st.recv_seq[src] = seq - 1
+        raise
     out = _unpack(payload)
     if tensor is not None and isinstance(tensor, np.ndarray):
         tensor[...] = out

@@ -10,7 +10,8 @@ from jax.scipy import special as jsp
 
 from paddle_tpu import random as pt_random
 
-__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
+__all__ = ["Distribution", "ExponentialFamily", "register_kl",
+           "Normal", "Uniform", "Categorical", "Beta",
            "Dirichlet", "Exponential", "Gamma", "Laplace", "Bernoulli",
            "Gumbel", "LogNormal", "Multinomial", "kl_divergence",
            "Independent", "TransformedDistribution", "Transform",
@@ -330,8 +331,57 @@ class Multinomial(Distribution):
                 + jnp.sum(v * jnp.log(self.probs + 1e-12), -1))
 
 
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """ref: paddle.distribution.register_kl — decorator registering a KL
+    rule for a (type(p), type(q)) pair; kl_divergence dispatches through
+    the registry before its built-ins (most-derived match wins)."""
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+class ExponentialFamily(object):
+    """ref: paddle.distribution.ExponentialFamily — base class for
+    natural-parameter families; subclasses expose _natural_parameters
+    and _log_normalizer, from which entropy follows by Bregman identity
+    (entropy = logZ - <natural, E[T]> with E[T] = dlogZ/dnat, via jax
+    autodiff instead of the reference's hand-derived per-family code)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0  # families with a nonzero carrier override
+
+    def entropy(self):
+        nat = self._natural_parameters
+        logz, grads = jax.value_and_grad(
+            self._log_normalizer, argnums=tuple(range(len(nat))))(*nat)
+        ent = -self._mean_carrier_measure + logz
+        for n, g in zip(nat, grads):
+            ent = ent - n * g
+        return ent
+
+
 def kl_divergence(p, q):
     """ref: paddle.distribution.kl_divergence (kl.py registry)."""
+    best = None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            if best is None or (issubclass(cp, best[0][0])
+                                and issubclass(cq, best[0][1])):
+                best = ((cp, cq), fn)
+    if best is not None:
+        return best[1](p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         return p.kl_divergence(q)
     if isinstance(p, Categorical) and isinstance(q, Categorical):

@@ -155,3 +155,61 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
                 jnp.asarray(np.concatenate(out_e) if out_e
                             else np.zeros(0, eids_np.dtype)))
     return out_neighbors, out_count
+
+
+def khop_sampler(row, colptr, input_nodes, sample_sizes, sorted_eids=None,
+                 return_eids=False, seed=0):
+    """ref: incubate.graph_khop_sampler (graph_khop_sampler_op) — multi-hop
+    neighbor sampling from a CSC graph, host-side like sample_neighbors.
+    Returns (edge_src, edge_dst, sample_index, reindex_x): edges are
+    reindexed into the sampled-node numbering, sample_index maps new ids
+    back to original node ids, reindex_x locates the seed nodes."""
+    import numpy as np
+    row_np = np.asarray(jax.device_get(jnp.asarray(row))).reshape(-1)
+    col_np = np.asarray(jax.device_get(jnp.asarray(colptr))).reshape(-1)
+    seeds = np.asarray(jax.device_get(jnp.asarray(input_nodes))).reshape(-1)
+    eids_np = None if sorted_eids is None else np.asarray(
+        jax.device_get(jnp.asarray(sorted_eids))).reshape(-1)
+    if return_eids and eids_np is None:
+        raise ValueError("return_eids=True requires sorted_eids")
+    rs = np.random.RandomState(seed)
+    frontier = seeds
+    edge_src, edge_dst, edge_ids = [], [], []
+    for size in sample_sizes:
+        next_frontier = []
+        for v in frontier:
+            beg, end = int(col_np[int(v)]), int(col_np[int(v) + 1])
+            deg = end - beg
+            if deg == 0:
+                continue
+            if size < 0 or deg <= size:
+                sel = np.arange(beg, end)
+            else:
+                sel = beg + rs.choice(deg, size=size, replace=False)
+            nbrs = row_np[sel]
+            edge_src.extend(nbrs.tolist())
+            edge_dst.extend([int(v)] * len(nbrs))
+            if eids_np is not None:
+                edge_ids.extend(eids_np[sel].tolist())
+            next_frontier.extend(nbrs.tolist())
+        frontier = np.unique(np.asarray(next_frontier, row_np.dtype))
+    # unique node table: seeds first (so reindex_x = arange(len(seeds)))
+    uniq = np.unique(np.concatenate(
+        [seeds, np.asarray(edge_src, row_np.dtype),
+         np.asarray(edge_dst, row_np.dtype)]))
+    seed_set = set(seeds.tolist())
+    order = {int(n): i for i, n in enumerate(
+        list(dict.fromkeys(seeds.tolist()))
+        + [n for n in uniq.tolist() if n not in seed_set])}
+    sample_index = np.asarray(sorted(order, key=order.get), row_np.dtype)
+    esrc = np.asarray([order[int(s)] for s in edge_src], np.int64)
+    edst = np.asarray([order[int(d)] for d in edge_dst], np.int64)
+    reindex_x = np.asarray([order[int(s)] for s in seeds], np.int64)
+    out = (jnp.asarray(esrc), jnp.asarray(edst),
+           jnp.asarray(sample_index), jnp.asarray(reindex_x))
+    if return_eids:
+        out = out + (jnp.asarray(np.asarray(edge_ids, np.int64)),)
+    return out
+
+
+__all__ += ["khop_sampler"]

@@ -13,3 +13,53 @@ from paddle_tpu.incubate.moe import MoELayer
 
 __all__ = ["nn", "asp", "moe", "MoELayer", "optimizer"]
 from paddle_tpu.incubate import optimizer  # noqa: E402
+
+# reference re-exports (paddle.incubate.__init__ surfaces these at top
+# level; the implementations live with their subject areas here)
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage  # noqa: E402
+from paddle_tpu.geometric import (  # noqa: E402
+    segment_sum, segment_mean, segment_max, segment_min)
+from paddle_tpu.geometric import (  # noqa: E402
+    send_u_recv as graph_send_recv, reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+    khop_sampler as graph_khop_sampler)
+
+
+def softmax_mask_fuse(x, mask):
+    """ref: incubate.softmax_mask_fuse (fused_softmax_mask_op.cu) — on
+    TPU XLA fuses the additive mask into the softmax; one expression."""
+    import jax
+    import jax.numpy as jnp
+    return jax.nn.softmax(jnp.asarray(x) + jnp.asarray(mask), axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """ref: incubate.softmax_mask_fuse_upper_triangle — causal-masked
+    softmax over the last two dims (the GPT attention mask)."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.asarray(x)
+    s = x.shape[-1]
+    causal = jnp.tril(jnp.ones((x.shape[-2], s), bool))
+    return jax.nn.softmax(jnp.where(causal, x, -1e9), axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """ref: incubate.identity_loss — marks a tensor as the loss with an
+    optional reduction (used by custom-loss pipelines)."""
+    import jax.numpy as jnp
+    x = jnp.asarray(x)
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("mean", 1):
+        return jnp.mean(x)
+    if reduction in ("sum", 0):
+        return jnp.sum(x)
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+__all__ += ["LookAhead", "ModelAverage", "segment_sum", "segment_mean",
+            "segment_max", "segment_min", "graph_send_recv",
+            "graph_reindex", "graph_sample_neighbors",
+            "graph_khop_sampler", "softmax_mask_fuse",
+            "softmax_mask_fuse_upper_triangle", "identity_loss"]

@@ -110,3 +110,76 @@ class _CallableModule(types.ModuleType):
 
 
 sys.modules[__name__].__class__ = _CallableModule
+
+
+class ProgramTranslator:
+    """ref: dygraph_to_static ProgramTranslator singleton — enables or
+    disables dy2static globally. Tracing IS the translator here; the
+    flag only gates whether to_static wraps with jit."""
+
+    _instance = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool = True):
+        type(self)._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return type(self)._enabled
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """ref: jit.set_code_level — the reference dumps transformed AST
+    stages; there is no AST pipeline here, so this records the level for
+    introspection only."""
+    from paddle_tpu import stats
+    stats.set_value("jit/code_level", level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """ref: jit.set_verbosity (dy2static logging)."""
+    from paddle_tpu import stats
+    stats.set_value("jit/verbosity", level)
+
+
+class TracedLayer:
+    """ref: fluid.dygraph.TracedLayer — trace a layer into a static
+    callable. Here tracing is jit: ``TracedLayer.trace(layer, inputs)``
+    returns (eager outputs, a TracedLayer whose __call__ is the jitted
+    forward and whose save_inference_model exports StableHLO)."""
+
+    def __init__(self, layer, jitted, specs):
+        self._layer = layer
+        self._jitted = jitted
+        self._specs = specs
+
+    @staticmethod
+    def trace(layer, inputs):
+        import jax as _jax
+        import jax.numpy as _jnp
+        from paddle_tpu.static import InputSpec
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        specs = [InputSpec(shape=_jnp.shape(a),
+                           dtype=_jnp.asarray(a).dtype) for a in inputs]
+        jitted = _jax.jit(lambda *a: layer(*a))
+        out = jitted(*inputs)
+        return out, TracedLayer(layer, jitted, specs)
+
+    def __call__(self, *inputs):
+        return self._jitted(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kw):
+        from paddle_tpu.jit import save as _save
+        kw.setdefault("input_spec", self._specs)
+        return _save(lambda *a: self._layer(*a), path, **kw)
+
+
+__all__ += ["ProgramTranslator", "TracedLayer", "set_code_level",
+            "set_verbosity"]

@@ -12,3 +12,6 @@ __all__ = ["matmul", "mm", "bmm", "dot", "mv", "t", "norm", "cond", "det",
            "cholesky_solve", "lu", "qr", "svd", "eig", "eigh", "eigvals",
            "eigvalsh", "matrix_power", "matrix_rank", "multi_dot", "cross",
            "histogram", "bincount", "einsum", "lstsq", "corrcoef", "cov"]
+from paddle_tpu.tensor.linalg import lu_unpack  # noqa: E402,F401
+
+__all__ = __all__ + ["lu_unpack"]

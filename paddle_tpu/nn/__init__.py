@@ -5,8 +5,9 @@ through jit/grad/vmap/pjit; layers mirror the reference's class surface.
 """
 
 from paddle_tpu.nn.module import (Buffer, Context, LayerDict, LayerList,
-                                  Module, Parameter, Sequential,
-                                  current_context, is_training, stateful)
+                                  Module, Parameter, ParameterList,
+                                  Sequential, current_context, is_training,
+                                  stateful)
 
 Layer = Module  # reference name (paddle.nn.Layer)
 
@@ -22,3 +23,9 @@ from paddle_tpu.nn.layer.pooling import *  # noqa: F401,F403,E402
 from paddle_tpu.nn.layer.loss import *  # noqa: F401,F403,E402
 from paddle_tpu.nn.layer.transformer import *  # noqa: F401,F403,E402
 from paddle_tpu.nn.layer.rnn import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.decode import (BeamSearchDecoder,  # noqa: E402
+                                  dynamic_decode)
+# grad-clip classes live with the optimizers; the reference also exports
+# them from paddle.nn
+from paddle_tpu.optimizer.clip import (ClipGradByGlobalNorm,  # noqa: E402
+                                       ClipGradByNorm, ClipGradByValue)

@@ -1,0 +1,111 @@
+"""Beam-search decoding (ref: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode over RNNCellBase cells).
+
+TPU-native: the decode loop is a ``lax.scan`` over ``max_step_num`` steps
+with per-beam finished masks (static shapes; the reference's early-exit
+while_op becomes mask arithmetic the compiler pipelines), and the beam
+bookkeeping — log-prob accumulation, top-k over (beam × vocab), parent
+backtrace via ``gather_tree`` — is plain vectorized jnp."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.nn.module import Module
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder(Module):
+    """≙ paddle.nn.BeamSearchDecoder: wraps a cell (h = cell(x, states))
+    with an embedding fn and an output (logits) fn, expanding every
+    input to ``beam_size`` hypotheses."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- internals -----------------------------------------------------------
+    def _embed(self, ids):
+        if self.embedding_fn is None:
+            return ids
+        return self.embedding_fn(ids)
+
+    def _logits(self, cell_out):
+        return cell_out if self.output_fn is None else self.output_fn(
+            cell_out)
+
+    def _tile(self, t):
+        """(B, ...) → (B*beam, ...) repeating each row beam_size times."""
+        return jnp.repeat(jnp.asarray(t), self.beam_size, axis=0)
+
+    def initialize(self, initial_states):
+        b = jax.tree_util.tree_leaves(initial_states)[0].shape[0]
+        states = jax.tree_util.tree_map(self._tile, initial_states)
+        ids = jnp.full((b * self.beam_size,), self.start_token, jnp.int32)
+        # beam 0 starts live, the rest at -inf so step 1 expands ONE beam
+        lp = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32), b)
+        finished = jnp.zeros((b * self.beam_size,), bool)
+        return ids, states, lp, finished
+
+    def step(self, ids, states, log_probs, finished):
+        """One expand-and-prune beam step. Returns
+        (token_ids, parent_idx, new_states, new_log_probs, new_finished)
+        with everything shaped (B*beam, ...)."""
+        K = self.beam_size
+        out, new_states = self.cell(self._embed(ids), states)
+        logits = self._logits(out)
+        v = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        # finished beams only extend with end_token at zero cost
+        keep = jnp.full((v,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, None], keep[None, :], step_lp)
+        total = log_probs[:, None] + step_lp          # (B*K, V)
+        b = total.shape[0] // K
+        flat = total.reshape(b, K * v)
+        top_lp, top_idx = lax.top_k(flat, K)           # (B, K)
+        parent_in_beam = top_idx // v                  # which source beam
+        token = (top_idx % v).astype(jnp.int32)
+        parent = (parent_in_beam
+                  + (jnp.arange(b) * K)[:, None]).reshape(-1)
+        token = token.reshape(-1)
+        new_lp = top_lp.reshape(-1)
+        new_states = jax.tree_util.tree_map(lambda s: s[parent],
+                                            new_states)
+        new_finished = finished[parent] | (token == self.end_token)
+        return token, parent, new_states, new_lp, new_finished
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 32, **kwargs):
+    """≙ paddle.nn.dynamic_decode: run the decoder to ``max_step_num``
+    steps (static bound — the reference's dynamic while-loop exit becomes
+    finished-mask arithmetic). Returns (ids (B, T, beam), final_lp
+    (B, beam)) with beams backtraced through their parents."""
+    from paddle_tpu.nn.functional.extension import gather_tree
+
+    ids0, states, lp, finished = decoder.initialize(inits)
+    K = decoder.beam_size
+    b = ids0.shape[0] // K
+
+    def body(carry, _):
+        ids, states, lp, finished = carry
+        token, parent, states, lp, finished = decoder.step(
+            ids, states, lp, finished)
+        return (token, states, lp, finished), (token, parent)
+
+    (_, _, lp, finished), (tokens, parents) = lax.scan(
+        body, (ids0, states, lp, finished), None, length=max_step_num)
+    # (T, B*K) → (T, B, K) for the backtrace
+    tokens = tokens.reshape(max_step_num, b, K)
+    parents = parents.reshape(max_step_num, b, K) % K
+    seqs = gather_tree(tokens, parents)            # (T, B, K)
+    return jnp.transpose(seqs, (1, 0, 2)), lp.reshape(b, K)

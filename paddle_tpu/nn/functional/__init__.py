@@ -26,8 +26,10 @@ def sparse_attention(query, key, value, sparse_csr_offset,
     # pattern is static data, so the check runs host-side — under jit a
     # traced >1-D pattern cannot be verified and is rejected outright.
     def _collapse(arr_name, arr):
+        if getattr(arr, "ndim", None) is not None and arr.ndim <= 1:
+            return jnp.asarray(arr)  # 1-D (incl. traced) passes through
         try:
-            host = _np.asarray(arr)  # lists/tuples/np/jax concretize here
+            host = _np.asarray(arr)  # lists/np/eager-jax concretize here
         except Exception:
             raise NotImplementedError(
                 f"sparse_attention: traced multi-dim CSR {arr_name} under "

@@ -545,6 +545,35 @@ class LayerList(Module):
         return (self[i] for i in range(self._n))
 
 
+class ParameterList(Module):
+    """ref: paddle.nn.ParameterList — indexable parameter container."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        self._n = 0
+        for p in (parameters or []):
+            self.append(p)
+
+    def append(self, parameter):
+        if not isinstance(parameter, Parameter):
+            parameter = Parameter(parameter)
+        setattr(self, f"p_{self._n}", parameter)
+        self._n += 1
+        return self
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if not -self._n <= i < self._n:
+            raise IndexError(f"index {i} out of range for ParameterList "
+                             f"of length {self._n}")
+        return getattr(self, f"p_{i % self._n}")
+
+    def __iter__(self):
+        return (self[i] for i in range(self._n))
+
+
 class LayerDict(Module):
     """ref: paddle.nn.LayerDict."""
 

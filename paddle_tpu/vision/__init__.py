@@ -7,3 +7,37 @@ from paddle_tpu.vision import models
 from paddle_tpu.vision import ops
 
 __all__ = ["transforms", "datasets", "models", "ops"]
+
+
+# -- image backend (ref: paddle.vision.image — get/set_image_backend,
+# image_load; 'pil' is the only wired backend in this zero-CV image) -------
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """ref: vision/image.py set_image_backend ('pil' or 'cv2')."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got {backend!r}")
+    if backend == "cv2":
+        raise ValueError("cv2 is not available in this environment; "
+                         "the 'pil' backend is wired")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    """ref: vision/image.py get_image_backend."""
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """ref: vision/image.py image_load — PIL.Image."""
+    from PIL import Image
+    backend = backend or _image_backend
+    if backend != "pil":
+        raise ValueError(f"unsupported backend {backend!r}")
+    return Image.open(path)
+
+
+__all__ += ["set_image_backend", "get_image_backend", "image_load"]

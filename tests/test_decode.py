@@ -1,0 +1,90 @@
+"""Beam-search decoding (ref: python/paddle/nn/decode.py —
+BeamSearchDecoder/dynamic_decode; oracle: exhaustive search over all
+token sequences of a tiny deterministic 'grammar' cell)."""
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+
+
+class _BigramCell(nn.Module):
+    """Deterministic cell: logits depend only on the previous token
+    (a bigram LM), state = previous token one-hot."""
+
+    def __init__(self, table):
+        super().__init__()
+        self.table = nn.Parameter(jnp.asarray(table, jnp.float32))
+
+    def forward(self, ids, states):
+        logits = self.table[ids]
+        return logits, states
+
+
+def _exhaustive_best(table, start, T):
+    """Highest-log-prob token sequence of length T under the bigram LM."""
+    v = table.shape[0]
+    lsm = np.asarray(jax.nn.log_softmax(jnp.asarray(table), -1))
+    best_lp, best_seq = -1e18, None
+    for seq in itertools.product(range(v), repeat=T):
+        lp, prev = 0.0, start
+        for t in seq:
+            lp += lsm[prev][t]
+            prev = t
+        if lp > best_lp:
+            best_lp, best_seq = lp, seq
+    return best_lp, best_seq
+
+
+def test_beam_search_finds_exhaustive_optimum():
+    rs = np.random.RandomState(0)
+    v, T = 5, 4
+    table = rs.randn(v, v).astype(np.float32) * 2.0
+    cell = _BigramCell(table)
+    # end_token outside the active vocab: no early stopping in this test
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=v - 1,
+                               beam_size=5)
+    table2 = table.copy()
+    table2[:, v - 1] = -100.0  # make end token never optimal
+    cell2 = _BigramCell(table2)
+    dec = nn.BeamSearchDecoder(cell2, start_token=0, end_token=v - 1,
+                               beam_size=5)
+    states = jnp.zeros((1, 1), jnp.float32)
+    seqs, lps = nn.dynamic_decode(dec, states, max_step_num=T)
+    assert seqs.shape == (1, T, 5) and lps.shape == (1, 5)
+    got = tuple(int(t) for t in np.asarray(seqs)[0, :, 0])
+    want_lp, want = _exhaustive_best(table2, 0, T)
+    assert got == want, (got, want)
+    np.testing.assert_allclose(float(lps[0, 0]), want_lp, rtol=1e-5)
+    # beams are sorted best-first
+    assert np.all(np.diff(np.asarray(lps)[0]) <= 1e-6)
+
+
+def test_beam_search_end_token_freezes_beam():
+    v = 4
+    table = np.full((v, v), -5.0, np.float32)
+    table[0, 3] = 5.0   # start → end immediately is the best move
+    table[3, 1] = 5.0   # would extend if not finished
+    cell = _BigramCell(table)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=3,
+                               beam_size=2)
+    seqs, lps = nn.dynamic_decode(dec, jnp.zeros((1, 1)), max_step_num=3)
+    top = np.asarray(seqs)[0, :, 0]
+    # once finished, the beam keeps emitting end_token at zero cost
+    assert top[0] == 3 and (top[1:] == 3).all(), top
+
+
+def test_batch_independence():
+    rs = np.random.RandomState(1)
+    v, T = 4, 3
+    table = rs.randn(v, v).astype(np.float32)
+    cell = _BigramCell(table)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=v - 1,
+                               beam_size=3)
+    one, lp1 = nn.dynamic_decode(dec, jnp.zeros((1, 1)), max_step_num=T)
+    two, lp2 = nn.dynamic_decode(dec, jnp.zeros((2, 1)), max_step_num=T)
+    np.testing.assert_array_equal(np.asarray(two)[0], np.asarray(one)[0])
+    np.testing.assert_array_equal(np.asarray(two)[1], np.asarray(one)[0])

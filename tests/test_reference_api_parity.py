@@ -106,3 +106,27 @@ def test_nn_layer_parity():
     missing = sorted(c for c in classes
                      if not c.startswith("_") and not hasattr(nn, c))
     assert not missing, missing
+
+
+@pytest.mark.parametrize("rel,modpath", [
+    ("optimizer/__init__.py", "paddle_tpu.optimizer"),
+    ("io/__init__.py", "paddle_tpu.io"),
+    ("metric/__init__.py", "paddle_tpu.metric"),
+    ("jit/__init__.py", "paddle_tpu.jit"),
+    ("amp/__init__.py", "paddle_tpu.amp"),
+    ("nn/__init__.py", "paddle_tpu.nn"),
+    ("vision/__init__.py", "paddle_tpu.vision"),
+    ("signal.py", "paddle_tpu.signal"),
+    ("sparse/__init__.py", "paddle_tpu.sparse"),
+    ("incubate/__init__.py", "paddle_tpu.incubate"),
+    ("distribution/__init__.py", "paddle_tpu.distribution"),
+    ("linalg.py", "paddle_tpu.linalg"),
+])
+def test_module_all_parity(rel, modpath):
+    import importlib
+    mod = importlib.import_module(modpath)
+    ref = _ref_all(rel)
+    missing = sorted(n for n in ref
+                     if "'" not in n and "\\n" not in n
+                     and not hasattr(mod, n))
+    assert not missing, f"{rel}: {missing}"
