@@ -6,6 +6,9 @@ north-star config); vision models live in paddle_tpu.vision.models.
 
 from paddle_tpu.models import gpt
 from paddle_tpu.models import bert
+from paddle_tpu.models import ernie
+from paddle_tpu.models.ernie import (Ernie, ErnieConfig,
+                                     ErnieForSequenceClassification)
 from paddle_tpu.models.bert import (Bert, BertConfig, BertForPretraining,
                                     BertForSequenceClassification,
                                     bert_tiny, bert_base, bert_large)
@@ -15,4 +18,5 @@ from paddle_tpu.models.gpt import (GPT, GPTConfig, gpt_tiny, gpt3_125m,
 __all__ = ["gpt", "GPT", "GPTConfig", "gpt_tiny", "gpt3_125m", "gpt3_350m",
            "gpt3_1p3b", "bert", "Bert", "BertConfig", "BertForPretraining",
            "BertForSequenceClassification", "bert_tiny", "bert_base",
-           "bert_large"]
+           "bert_large", "ernie", "Ernie",
+           "ErnieConfig", "ErnieForSequenceClassification"]

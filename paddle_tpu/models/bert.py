@@ -156,13 +156,18 @@ class Bert(Module):
         self.pooler_b = Parameter(jnp.zeros((d,), dt))
 
     def forward(self, tokens, token_type_ids=None, attention_mask=None,
-                rng_key=None):
+                rng_key=None, extra_embed=None):
+        """``extra_embed``: optional additive embedding plane folded in
+        BEFORE the embedding LayerNorm (the ERNIE task-type embedding
+        rides this hook — models/ernie.py)."""
         b, s = tokens.shape
         x = jnp.take(self.wte, tokens, axis=0) + self.wpe[:s]
         if token_type_ids is not None:
             x = x + jnp.take(self.wtype, token_type_ids, axis=0)
         else:
             x = x + self.wtype[0]
+        if extra_embed is not None:
+            x = x + extra_embed
         x32 = x.astype(jnp.float32)
         mu = jnp.mean(x32, -1, keepdims=True)
         var = jnp.var(x32, -1, keepdims=True)

@@ -1,0 +1,94 @@
+"""ERNIE 3.0 model family (the BASELINE.md "ERNIE-3.0 / BERT-base
+finetune" row; architecture per the PaddleNLP ernie modeling configs —
+the reference core repo ships the transformer blocks, PaddleNLP the
+configs).
+
+Architecturally ERNIE 3.0's dense trunk is a BERT-style encoder with one
+addition this module implements: a TASK-TYPE embedding plane added to the
+token/position/segment sum (task_type_vocab_size=3 in the released
+configs — universal representation vs task-specific heads select
+different task ids). Everything else — the encoder stack, the Megatron
+TP × ZeRO partitioning, the flash-attention kv_lens fast path, the AMP
+policy — is shared with models/bert.py, which is the point: one tuned
+encoder serves both families."""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.bert import (Bert, BertConfig,
+                                    BertForSequenceClassification,
+                                    PARTITION_RULES, _normal)
+from paddle_tpu.nn.module import Module, Parameter
+
+__all__ = ["ErnieConfig", "Ernie", "ErnieForSequenceClassification",
+           "ernie3_base", "ernie3_medium", "ernie3_micro",
+           "PARTITION_RULES"]
+
+
+@dataclass(frozen=True)
+class ErnieConfig(BertConfig):
+    vocab_size: int = 40000          # ernie-3.0 zh vocab
+    task_type_vocab_size: int = 3
+
+
+class Ernie(Module):
+    """ERNIE trunk = Bert trunk + task-type embedding (added before the
+    embedding LayerNorm, matching the released model's embedding sum)."""
+
+    def __init__(self, cfg: ErnieConfig, seed: int = 0, trunk=None):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = trunk if trunk is not None else Bert(cfg, seed)
+        k = jax.random.fold_in(jax.random.PRNGKey(seed), 777)
+        self.wtask = Parameter(_normal(
+            k, (cfg.task_type_vocab_size, cfg.d_model), 0.02, cfg.dtype))
+
+    def forward(self, tokens, token_type_ids=None, task_type_ids=None,
+                attention_mask=None, rng_key=None):
+        task = (jnp.take(self.wtask, task_type_ids, axis=0)
+                if task_type_ids is not None else self.wtask[0])
+        # the shared Bert trunk folds the task plane in before its
+        # embedding LayerNorm — ONE encoder implementation for both
+        return self.bert(tokens, token_type_ids, attention_mask, rng_key,
+                         extra_embed=task)
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    """The finetune configuration of the BASELINE row: the shared BERT
+    classifier head over the ERNIE trunk (one head implementation for
+    both families)."""
+
+    def __init__(self, cfg: ErnieConfig, num_classes: int = 2,
+                 seed: int = 0):
+        super().__init__(cfg, num_classes, seed)
+        # re-home the already-built trunk inside the Ernie wrapper (no
+        # second Bert construction)
+        self.ernie = Ernie(cfg, seed, trunk=self.bert)
+        del self.bert
+
+    def forward(self, tokens, token_type_ids=None, task_type_ids=None,
+                attention_mask=None, rng_key=None):
+        _, pooled = self.ernie(tokens, token_type_ids, task_type_ids,
+                               attention_mask, rng_key)
+        return pooled @ self.cls_w + self.cls_b
+
+
+def ernie3_base(**kw):
+    d = dict(d_model=768, n_layers=12, n_heads=12)
+    d.update(kw)
+    return ErnieConfig(**d)
+
+
+def ernie3_medium(**kw):
+    d = dict(d_model=768, n_layers=6, n_heads=12)
+    d.update(kw)
+    return ErnieConfig(**d)
+
+
+def ernie3_micro(**kw):
+    d = dict(vocab_size=1024, max_position=64, d_model=64, n_layers=2,
+             n_heads=2, dropout=0.0, dtype=jnp.float32)
+    d.update(kw)
+    return ErnieConfig(**d)
