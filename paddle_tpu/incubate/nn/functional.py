@@ -58,17 +58,118 @@ def fused_bias_dropout_residual_layer_norm(
     return y
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
-    """ref: incubate fused_multi_head_attention. Provided at layer level
-    (FusedMultiHeadAttention → Pallas flash attention); the raw-weight
-    functional form is intentionally a thin composition."""
-    raise NotImplementedError(
-        "use incubate.nn.FusedMultiHeadAttention (the layer form); the "
-        "raw-weight functional depends on the reference's packed qkv "
-        "layout which paddle_tpu does not use")
+def _ln(x, scale, bias, eps):
+    import jax
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale
+    if bias is not None:
+        y = y + bias
+    return y.astype(x.dtype)
 
 
-def fused_feedforward(x, linear1_weight, linear2_weight, *args, **kwargs):
-    """ref: incubate fused_feedforward. See fused_multi_head_attention."""
-    raise NotImplementedError(
-        "use incubate.nn.FusedFeedForward (the layer form)")
+def _dropout(x, rate, training, key, mode="upscale_in_train"):
+    # one dropout implementation for the whole package (incl. the
+    # downscale_in_infer mode the reference supports)
+    from paddle_tpu.nn.functional.common import dropout
+    return dropout(x, rate, training=training, mode=mode, key=key)
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True,
+        rng_key=None, name=None):
+    """ref: incubate fused_multi_head_attention
+    (fused_transformer.py:462, fused_attention_op.cu) — the whole
+    [pre-LN →] packed-QKV projection → attention → out-proj → dropout →
+    residual [→ post-LN] block. qkv_weight: (3, H, dh, D). On TPU the
+    attention core routes the Pallas flash kernel via
+    F.scaled_dot_product_attention; everything around it is one traced
+    expression XLA fuses."""
+    import jax
+
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.nn.functional.common import fold_ctx_key
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention(cache_kv=...) incremental decode "
+            "is served by the model-level KV caches (models/gpt.py "
+            "generate); the raw-weight cache form is not implemented")
+    x = jnp.asarray(x)
+    qkv_w = jnp.asarray(qkv_weight)
+    assert qkv_w.ndim == 4 and qkv_w.shape[0] == 3, qkv_w.shape
+    _, h, dh, d = qkv_w.shape
+    assert d == x.shape[-1], (qkv_w.shape, x.shape)
+    residual = x
+    if pre_layer_norm:
+        x = _ln(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    b, sq = x.shape[0], x.shape[1]
+    qkv = jnp.einsum("bsd,thed->bsthe", x, qkv_w)      # (B,S,3,H,dh)
+    if qkv_bias is not None:
+        qkv = qkv + jnp.asarray(qkv_bias)[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if rng_key is None:
+        rng_key = fold_ctx_key(salt=101)  # context RNG, like the sibling
+    k1, k2 = jax.random.split(rng_key)
+    attn = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, is_causal=False,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training, rng_key=k1)
+    out = attn.reshape(b, sq, h * dh) @ jnp.asarray(linear_weight)
+    if not pre_layer_norm and add_residual:
+        # the whole tail IS the sibling fused op → Pallas fused-LN kernel
+        import jax as _jax
+        seed = _jax.random.bits(k2, (), jnp.uint32).astype(jnp.int32)
+        return fused_bias_dropout_residual_layer_norm(
+            out, residual, bias=linear_bias, ln_scale=ln_scale,
+            ln_bias=ln_bias, dropout_rate=dropout_rate,
+            ln_epsilon=ln_epsilon, training=training, dropout_seed=seed)
+    if linear_bias is not None:
+        out = out + jnp.asarray(linear_bias)
+    out = _dropout(out, dropout_rate, training, k2, mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = _ln(out, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(
+        x, linear1_weight, linear2_weight, linear1_bias=None,
+        linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+        ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+        activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+        pre_layer_norm=False, training=True, mode="upscale_in_train",
+        ring_id=-1, add_residual=True, rng_key=None, name=None):
+    """ref: incubate fused_feedforward (fused_transformer.py:31,
+    fused_feedforward_op.cu) — [pre-LN →] linear1 → act → dropout1 →
+    linear2 → dropout2 → residual [→ post-LN], one traced expression."""
+    import jax  # noqa: F401 (split below)
+
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.nn.functional.common import fold_ctx_key
+
+    x = jnp.asarray(x)
+    residual = x
+    if pre_layer_norm:
+        x = _ln(x, ln1_scale, ln1_bias, ln1_epsilon)
+    if rng_key is None:
+        rng_key = fold_ctx_key(salt=102)  # context RNG, like the sibling
+    k1, k2 = jax.random.split(rng_key)
+    h = fused_matmul_bias(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = _dropout(h, dropout1_rate, training, k1, mode)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    h = _dropout(h, dropout2_rate, training, k2, mode)
+    out = residual + h if add_residual else h
+    if not pre_layer_norm:
+        out = _ln(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out

@@ -76,13 +76,16 @@ class ErnieForSequenceClassification(BertForSequenceClassification):
 
 
 def ernie3_base(**kw):
-    d = dict(d_model=768, n_layers=12, n_heads=12)
+    # released ernie-3.0-base config: 2048 positions, 4 segment types
+    d = dict(d_model=768, n_layers=12, n_heads=12, max_position=2048,
+             type_vocab_size=4)
     d.update(kw)
     return ErnieConfig(**d)
 
 
 def ernie3_medium(**kw):
-    d = dict(d_model=768, n_layers=6, n_heads=12)
+    d = dict(d_model=768, n_layers=6, n_heads=12, max_position=2048,
+             type_vocab_size=4)
     d.update(kw)
     return ErnieConfig(**d)
 

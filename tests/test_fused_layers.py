@@ -156,3 +156,95 @@ class TestFusedBiasDropoutResidualLayerNorm:
         y = IF.fused_matmul_bias(x, jnp.ones((128, 16)),
                                  jnp.zeros((16,)))
         np.testing.assert_allclose(y, x @ jnp.ones((128, 16)), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Functional fused transformer ops (round 4: no longer NotImplemented —
+# ref: incubate/nn/functional/fused_transformer.py:31/:462)
+# ---------------------------------------------------------------------------
+
+def _ln_np(x, scale, bias, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * scale + bias
+
+
+def test_fused_multi_head_attention_matches_unfused():
+    from paddle_tpu.incubate.nn import functional as IF
+    rs = np.random.RandomState(0)
+    b, s, h, dh = 2, 8, 2, 4
+    d = h * dh
+    x = rs.randn(b, s, d).astype(np.float32)
+    qkv_w = rs.randn(3, h, dh, d).astype(np.float32) * 0.2
+    qkv_b = rs.randn(3, h, dh).astype(np.float32) * 0.1
+    lin_w = rs.randn(d, d).astype(np.float32) * 0.2
+    lin_b = rs.randn(d).astype(np.float32) * 0.1
+    ln_s = np.ones(d, np.float32)
+    ln_b = np.zeros(d, np.float32)
+
+    out = IF.fused_multi_head_attention(
+        jnp.asarray(x), jnp.asarray(qkv_w), jnp.asarray(lin_w),
+        qkv_bias=jnp.asarray(qkv_b), linear_bias=jnp.asarray(lin_b),
+        ln_scale=jnp.asarray(ln_s), ln_bias=jnp.asarray(ln_b),
+        dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+
+    # numpy oracle: the unfused composition
+    qkv = np.einsum("bsd,thed->bsthe", x, qkv_w) + qkv_b[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scores = np.einsum("bqhe,bkhe->bhqk", q, k) / np.sqrt(dh)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    attn = np.einsum("bhqk,bkhe->bqhe", p, v).reshape(b, s, d)
+    want = _ln_np(x + attn @ lin_w + lin_b, ln_s, ln_b)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=2e-4)
+
+    # pre-LN variant skips the post-LN
+    out_pre = IF.fused_multi_head_attention(
+        jnp.asarray(x), jnp.asarray(qkv_w), jnp.asarray(lin_w),
+        pre_layer_norm=True, pre_ln_scale=jnp.asarray(ln_s),
+        pre_ln_bias=jnp.asarray(ln_b), dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False)
+    xn = _ln_np(x, ln_s, ln_b)
+    qkv = np.einsum("bsd,thed->bsthe", xn, qkv_w)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scores = np.einsum("bqhe,bkhe->bhqk", q, k) / np.sqrt(dh)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    attn = np.einsum("bhqk,bkhe->bqhe", p, v).reshape(b, s, d)
+    want_pre = x + attn @ lin_w
+    np.testing.assert_allclose(np.asarray(out_pre), want_pre,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_fused_feedforward_matches_unfused():
+    from paddle_tpu.incubate.nn import functional as IF
+    rs = np.random.RandomState(1)
+    b, s, d, f = 2, 4, 8, 16
+    x = rs.randn(b, s, d).astype(np.float32)
+    w1 = rs.randn(d, f).astype(np.float32) * 0.2
+    b1 = rs.randn(f).astype(np.float32) * 0.1
+    w2 = rs.randn(f, d).astype(np.float32) * 0.2
+    b2 = rs.randn(d).astype(np.float32) * 0.1
+    ln_s = np.ones(d, np.float32)
+    ln_b = np.zeros(d, np.float32)
+    out = IF.fused_feedforward(
+        jnp.asarray(x), jnp.asarray(w1), jnp.asarray(w2),
+        linear1_bias=jnp.asarray(b1), linear2_bias=jnp.asarray(b2),
+        ln2_scale=jnp.asarray(ln_s), ln2_bias=jnp.asarray(ln_b),
+        dropout1_rate=0.0, dropout2_rate=0.0, training=False)
+    h = np.maximum(x @ w1 + b1, 0.0)
+    want = _ln_np(x + h @ w2 + b2, ln_s, ln_b)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_ops_dropout_and_jit():
+    from paddle_tpu.incubate.nn import functional as IF
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(2, 4, 8), jnp.float32)
+    w1 = jnp.asarray(rs.randn(8, 16) * 0.2, jnp.float32)
+    w2 = jnp.asarray(rs.randn(16, 8) * 0.2, jnp.float32)
+    f = jax.jit(lambda xx, key: IF.fused_feedforward(
+        xx, w1, w2, dropout1_rate=0.5, training=True, rng_key=key))
+    a = f(x, jax.random.PRNGKey(0))
+    b = f(x, jax.random.PRNGKey(1))
+    assert not np.allclose(np.asarray(a), np.asarray(b))
