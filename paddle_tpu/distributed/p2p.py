@@ -203,14 +203,31 @@ def all_gather_object(obj_list, obj, timeout: float = 120.0):
     return obj_list
 
 
-def destroy_process_group(group=None):
-    """ref: paddle.distributed.destroy_process_group."""
+def destroy_process_group(group=None, timeout: float = 60.0):
+    """ref: paddle.distributed.destroy_process_group. Rank 0 hosts the
+    store SERVER: it must not tear it down while peers are mid-request,
+    so every rank posts a departure key and rank 0 waits for all of them
+    (bounded) before closing — the shutdown barrier the reference gets
+    from NCCL comm destruction semantics."""
     global _state
     with _lock:
-        if _state is not None:
-            try:
-                _state.endpoint.close()
-                _state.store.close()
-            except Exception:
-                pass
-            _state = None
+        st = _state
+        _state = None
+    if st is None:
+        return
+    try:
+        st.store.set(f"p2p/bye/{st.rank}", b"1")
+        if st.rank == 0:
+            for r in range(st.world):
+                try:
+                    st.store.get(f"p2p/bye/{r}", timeout=timeout)
+                except TimeoutError:
+                    continue  # a dead peer must not wedge shutdown,
+                    # but LIVE higher ranks still deserve the barrier
+    except Exception:
+        pass
+    try:
+        st.endpoint.close()
+        st.store.close()
+    except Exception:
+        pass
