@@ -25,7 +25,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Config", "Predictor", "create_predictor", "DynamicBatcher"]
+__all__ = ["Config", "Predictor", "create_predictor", "DynamicBatcher",
+           "DecodeEngine", "decode_roofline_tokens_per_sec"]
+
+from paddle_tpu.inference.decode_engine import (  # noqa: E402
+    DecodeEngine, decode_roofline_tokens_per_sec)
 
 
 class Config:

@@ -92,6 +92,23 @@ def _normal(key, shape, std, dtype):
     return (std * jax.random.normal(key, shape)).astype(dtype)
 
 
+def _flag(name):
+    from paddle_tpu import flags
+    return flags.get_flag(name)
+
+
+def _use_decode_kernel(T: int) -> bool:
+    """Route single-token decode through the Pallas flash-decode kernel.
+    Disabled under a multi-device mesh: GSPMD has no partitioning rule for
+    the pallas custom-call, so a tp-sharded KV cache would be all-gathered
+    per layer per step — the einsum path lets the partitioner shard."""
+    if T % 128 or not _flag("use_pallas_kernels"):
+        return False
+    from paddle_tpu.distributed.mesh import get_mesh
+    mesh = get_mesh()
+    return mesh is None or mesh.size == 1
+
+
 class GPTBlock(Module):
     """Pre-LN transformer decoder block with fused qkv (one (d,3d) matmul
     keeps the MXU busy vs three thin ones)."""
@@ -173,11 +190,13 @@ class GPTBlock(Module):
         functionally).
 
         x: (B, L, d) new positions [pos, pos+L); kv: (k, v) each
-        (B, T, H, D) preallocated; pos may be traced. Returns (y, new_kv).
+        (B, H, T, D) head-major preallocated (the flash-decode kernel's
+        layout: a KV block is then a contiguous (block_k, D) tile); pos may
+        be traced. Returns (y, new_kv).
         """
         b, L, d = x.shape
         k_cache, v_cache = kv
-        T = k_cache.shape[1]
+        T = k_cache.shape[2]
         h = self._ln(x, self.ln1_scale, self.ln1_bias)
         qkv = h @ self.wqkv
         if self.bqkv is not None:
@@ -185,16 +204,90 @@ class GPTBlock(Module):
         qkv = qkv.reshape(b, L, 3, self.n_heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         k_cache = lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+            k_cache, jnp.transpose(k, (0, 2, 1, 3)).astype(k_cache.dtype),
+            (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+            v_cache, jnp.transpose(v, (0, 2, 1, 3)).astype(v_cache.dtype),
+            (0, 0, pos, 0))
         scale = 1.0 / math.sqrt(self.head_dim)
-        att = jnp.einsum("blhd,bthd->bhlt", q, k_cache) * scale
-        q_pos = pos + jnp.arange(L)[:, None]
-        k_pos = jnp.arange(T)[None, :]
-        att = jnp.where(k_pos <= q_pos, att.astype(jnp.float32), -jnp.inf)
-        att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhlt,bthd->blhd", att, v_cache).reshape(b, L, d)
+        if L == 1 and _use_decode_kernel(T):
+            # single-token decode: stream the cache block-wise, skipping
+            # blocks beyond pos (the einsum below reads all T always)
+            from paddle_tpu.ops.pallas.decode_attention import \
+                decode_attention
+            lengths = jnp.broadcast_to(
+                jnp.asarray(pos, jnp.int32) + 1, (b,))
+            attn = decode_attention(
+                q[:, 0].astype(k_cache.dtype), k_cache, v_cache, lengths,
+                scale=scale)
+            attn = attn.astype(x.dtype).reshape(b, 1, d)
+        else:
+            att = jnp.einsum("blhd,bhtd->bhlt", q, k_cache) * scale
+            q_pos = pos + jnp.arange(L)[:, None]
+            k_pos = jnp.arange(T)[None, :]
+            att = jnp.where(k_pos <= q_pos, att.astype(jnp.float32),
+                            -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bhlt,bhtd->blhd", att,
+                              v_cache).reshape(b, L, d)
+        o = attn @ self.wo
+        if self.bo is not None:
+            o = o + self.bo
+        x = x + o
+        h = self._ln(x, self.ln2_scale, self.ln2_bias)
+        if self.moe is not None:
+            h, _ = self.moe(h, None)
+        else:
+            h = jax.nn.gelu(h @ self.wup + (self.bup if self.bup is not None
+                                            else 0.0))
+            h = h @ self.wdown
+            if self.bdown is not None:
+                h = h + self.bdown
+        return x + h, (k_cache, v_cache)
+
+    def decode_step(self, x, kv, positions):
+        """One-token decode with RAGGED per-row cache positions — the
+        continuous-batching primitive (≙ fused_multi_transformer_op.cu's
+        masked_multihead_attention, which likewise takes a per-sequence
+        ``sequence_lengths`` tensor so in-flight requests of different ages
+        share one batch).
+
+        x: (B, 1, d); kv: head-major (B, H, T, D) pair; positions: (B,)
+        int32 — row b's new token lands at cache position positions[b] and
+        attends to [0, positions[b]]. Returns (y, new_kv).
+        """
+        b, L, d = x.shape
+        k_cache, v_cache = kv
+        T = k_cache.shape[2]
+        h = self._ln(x, self.ln1_scale, self.ln1_bias)
+        qkv = h @ self.wqkv
+        if self.bqkv is not None:
+            qkv = qkv + self.bqkv
+        qkv = qkv.reshape(b, 3, self.n_heads, self.head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+
+        def write(cache, new, pos):  # (H, T, D) ← (H, 1, D) at pos
+            return lax.dynamic_update_slice(cache, new, (0, pos, 0))
+
+        k_cache = jax.vmap(write)(
+            k_cache, k[:, :, None, :].astype(k_cache.dtype), positions)
+        v_cache = jax.vmap(write)(
+            v_cache, v[:, :, None, :].astype(v_cache.dtype), positions)
+        lengths = positions + 1
+        scale = 1.0 / math.sqrt(self.head_dim)
+        if _use_decode_kernel(T):
+            from paddle_tpu.ops.pallas.decode_attention import \
+                decode_attention
+            attn = decode_attention(q.astype(k_cache.dtype), k_cache,
+                                    v_cache, lengths, scale=scale)
+            attn = attn.astype(x.dtype).reshape(b, 1, d)
+        else:
+            att = jnp.einsum("bhd,bhtd->bht", q, k_cache) * scale
+            mask = jnp.arange(T)[None, None, :] < lengths[:, None, None]
+            att = jnp.where(mask, att.astype(jnp.float32), -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bht,bhtd->bhd", att,
+                              v_cache).reshape(b, 1, d)
         o = attn @ self.wo
         if self.bo is not None:
             o = o + self.bo
@@ -271,6 +364,16 @@ def _shard_act(x, spec: P):
         return x
 
 
+def final_ln(x, scale, bias, eps: float = 1e-5):
+    """The head's pre-projection LayerNorm (fp32 statistics) — the single
+    definition shared by GPT.head, fused_lm_loss, and the decode engine."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * scale
+            + bias).astype(x.dtype)
+
+
 def _gathered_table(w):
     """ZeRO-3 gather-for-use on an fsdp-sharded embedding table: a lookup
     from a d-sharded table produces d-sharded rows, and the partitioner has
@@ -340,20 +443,15 @@ class GPT(Module):
         return _shard_act(x, P(_BATCH_AXES, "sp", None))
 
     def head(self, x):
-        x32 = x.astype(jnp.float32)
-        mu = jnp.mean(x32, -1, keepdims=True)
-        var = jnp.var(x32, -1, keepdims=True)
-        x = ((x32 - mu) * lax.rsqrt(var + 1e-5) * self.lnf_scale
-             + self.lnf_bias).astype(x.dtype)
+        x = final_ln(x, self.lnf_scale, self.lnf_bias)
         w = self.wte.T if self.lm_head is None else self.lm_head
         logits = x @ w
         return _shard_act(logits, P(_BATCH_AXES, "sp", "tp"))
 
-    def forward(self, tokens, rng_key=None, return_aux=False):
-        """return_aux=True additionally returns the summed MoE load-balance
-        aux loss (zeros for dense configs); threaded explicitly — no
-        global state, safe across multiple forwards per trace."""
-        aux_acc = []
+    def hidden_states(self, tokens, rng_key=None, aux_acc=None):
+        """Final hidden states (B, S, d) — forward minus the LM head (the
+        fused-CE loss path consumes these directly so (B, S, V) logits
+        never materialize)."""
         x = self.embed(tokens)
         # remat never coexists with MoE (enforced in __init__), so the
         # checkpointed closure does not capture aux_acc
@@ -365,6 +463,14 @@ class GPT(Module):
             k = (jax.random.fold_in(rng_key, i)
                  if rng_key is not None else None)
             x = blk_fn(self.blocks[i], x, k)
+        return x
+
+    def forward(self, tokens, rng_key=None, return_aux=False):
+        """return_aux=True additionally returns the summed MoE load-balance
+        aux loss (zeros for dense configs); threaded explicitly — no
+        global state, safe across multiple forwards per trace."""
+        aux_acc = []
+        x = self.hidden_states(tokens, rng_key, aux_acc)
         logits = self.head(x)
         if return_aux:
             aux = jnp.zeros((), jnp.float32)
@@ -378,11 +484,12 @@ class GPT(Module):
 
     def init_cache(self, batch: int, max_len: Optional[int] = None,
                    dtype=None):
-        """Preallocated per-layer (k, v) caches, (B, T, H, D) each."""
+        """Preallocated per-layer (k, v) caches, head-major (B, H, T, D)
+        each (the flash-decode kernel's layout)."""
         cfg = self.cfg
         T = max_len or cfg.max_seq_len
         dt = dtype or cfg.dtype
-        shape = (batch, T, cfg.n_heads, cfg.head_dim)
+        shape = (batch, cfg.n_heads, T, cfg.head_dim)
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.n_layers)]
 
@@ -558,14 +665,14 @@ def _generate_scan(m: GPT, b, s0, T, max_new_tokens, temperature, top_p,
     L = cfg.n_layers
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *[m.blocks[i] for i in range(L)])
-    shape = (L, b, T, cfg.n_heads, cfg.head_dim)
+    shape = (L, b, cfg.n_heads, T, cfg.head_dim)
     kc = jnp.zeros(shape, cfg.dtype)
     vc = jnp.zeros(shape, cfg.dtype)
     mesh = _decode_mesh(cfg, b)
     if mesh is not None:
         # KV cache sharded over tp heads + dp batch: the whole decode loop
         # then runs TP-parallel with psum'd attention/MLP outputs
-        kv_spec = NamedSharding(mesh, P(None, "dp", None, "tp", None))
+        kv_spec = NamedSharding(mesh, P(None, "dp", "tp", None, None))
         kc = lax.with_sharding_constraint(kc, kv_spec)
         vc = lax.with_sharding_constraint(vc, kv_spec)
         stacked = _shard_stacked(stacked, m.blocks[0], mesh)
@@ -648,6 +755,44 @@ def lm_loss(logits, labels):
     return jnp.mean(logz - picked)
 
 
+def _use_fused_ce(cfg) -> bool:
+    """Route the train loss through the Pallas fused blockwise CE.
+    Requires: kernel flag on, dense stack, no multi-device mesh (the
+    sharded cases go through parallel_cross_entropy / GSPMD), and a vocab
+    with a 128-multiple block divisor."""
+    if not _flag("use_pallas_kernels") or cfg.moe_experts > 0:
+        return False
+    from paddle_tpu.distributed.mesh import get_mesh
+    mesh = get_mesh()
+    if mesh is not None and mesh.size > 1:
+        return False
+    from paddle_tpu.ops.pallas.fused_ce import _pick_block_v
+    try:
+        _pick_block_v(cfg.vocab_size, 512)
+    except ValueError:
+        return False
+    return True
+
+
+def fused_lm_loss(m: GPT, tokens, rng_key=None, force: bool = False):
+    """Causal LM loss with head-LN + LM projection + softmax-CE fused so
+    the (B, S, V) logits and their grads never exist in HBM
+    (≙ c_softmax_with_cross_entropy_op.cu:38-192 — here the fusion also
+    swallows the projection matmul, the reference only fuses the CE).
+    Falls back to forward()+lm_loss when the kernel can't engage."""
+    if not force and not _use_fused_ce(m.cfg):
+        return lm_loss(m(tokens, rng_key=rng_key), tokens)
+    from paddle_tpu.ops.pallas.fused_ce import fused_softmax_cross_entropy
+    x = m.hidden_states(tokens, rng_key)
+    b, s, d = x.shape
+    xn = final_ln(x, m.lnf_scale, m.lnf_bias)
+    w = m.wte if m.lm_head is None else m.lm_head.T   # (V, d)
+    rows = xn[:, :-1].reshape(b * (s - 1), d)
+    labels = tokens[:, 1:].reshape(-1)
+    per_tok = fused_softmax_cross_entropy(rows, w, labels)
+    return jnp.sum(per_tok) / (b * (s - 1))
+
+
 # (regex on param path → PartitionSpec). Megatron-style TP composed with
 # ZeRO-3-style fsdp (ref: mp_layers.py + group_sharded_stage3.py).
 PARTITION_RULES = (
@@ -708,8 +853,9 @@ def build_train_step(model: GPT, optimizer, mesh: Optional[Mesh] = None,
                 logits, aux = m(tokens, rng_key=rng, return_aux=True)
                 return lm_loss(logits, tokens) \
                     + model.cfg.moe_aux_weight * aux
-            logits = m(tokens, rng_key=rng)
-            return lm_loss(logits, tokens)
+            # dense: fused blockwise CE when it can engage — the (B,S,V)
+            # logits never hit HBM (falls back internally otherwise)
+            return fused_lm_loss(m, tokens, rng_key=rng)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         new_params, new_state = optimizer.update(grads, opt_state, params)

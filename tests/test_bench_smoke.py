@@ -35,6 +35,9 @@ def test_bench_decode_smoke():
     out = bench.bench_decode(jax, jnp, PEAK, smoke=True)
     assert any(k.startswith("decode_") and k.endswith("_tokens_per_sec")
                for k in out), out
+    # the continuous-batching engine path must run clean in smoke mode
+    assert "decode_engine_tokens_per_sec" in out, out
+    assert out.get("decode_engine_vs_roofline", 0) > 0, out
 
 
 def test_bench_bert_smoke():

@@ -1,0 +1,108 @@
+"""Flash-decode Pallas kernel vs the naive masked-softmax oracle
+(decode half of fused_multi_transformer_op.cu; SURVEY §4 OpTest style —
+kernel output compared elementwise against an independent reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.decode_attention import (
+    decode_attention, decode_attention_reference)
+
+
+def _mk(b, hq, hkv, T, d, dtype, seed=0):
+    rs = np.random.RandomState(seed)
+    q = rs.randn(b, hq, d).astype(np.float32)
+    k = rs.randn(b, hkv, T, d).astype(np.float32)
+    v = rs.randn(b, hkv, T, d).astype(np.float32)
+    return (jnp.asarray(q, dtype), jnp.asarray(k, dtype),
+            jnp.asarray(v, dtype))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_matches_oracle_ragged_lengths(dtype):
+    b, hq, T, d = 4, 4, 256, 64
+    q, k, v = _mk(b, hq, hq, T, d, dtype)
+    lengths = jnp.asarray([1, 17, 128, 256], jnp.int32)
+    got = decode_attention(q, k, v, lengths, block_k=128)
+    want = decode_attention_reference(q, k, v, lengths)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=tol,
+                               rtol=tol)
+
+
+def test_gqa_grouping():
+    # 8 query heads over 2 KV heads: query head h must read kv head h // 4
+    b, hq, hkv, T, d = 2, 8, 2, 128, 32
+    q, k, v = _mk(b, hq, hkv, T, d, jnp.float32)
+    lengths = jnp.asarray([77, 128], jnp.int32)
+    got = decode_attention(q, k, v, lengths, block_k=128)
+    want = decode_attention_reference(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_block_shrinks_to_divide_cache():
+    # T=384 is not divisible by the default 512 block; the kernel must
+    # shrink to a dividing lane-multiple block, not crash or pad the cache
+    b, h, T, d = 2, 2, 384, 64
+    q, k, v = _mk(b, h, h, T, d, jnp.float32)
+    lengths = jnp.asarray([5, 384], jnp.int32)
+    got = decode_attention(q, k, v, lengths)
+    want = decode_attention_reference(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_zero_length_row_is_finite():
+    # an empty slot (length 0, the free-slot case in the decode engine)
+    # must produce zeros, not NaN
+    b, h, T, d = 2, 2, 128, 32
+    q, k, v = _mk(b, h, h, T, d, jnp.float32)
+    lengths = jnp.asarray([0, 128], jnp.int32)
+    got = np.asarray(decode_attention(q, k, v, lengths))
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got[0], 0.0, atol=0)
+
+
+def test_generate_kernel_path_matches_einsum_path():
+    """With a 128-multiple cache, GPT decode routes through the Pallas
+    kernel (gpt.GPTBlock.forward_cached L==1 branch); greedy tokens must
+    match the einsum path bit-for-bit disabled via the flag."""
+    from paddle_tpu import flags
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=128, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)),
+        jnp.int32)
+    with_kernel = np.asarray(
+        model.generate(tokens, max_new_tokens=6, max_len=128))
+    flags.set_flags({"use_pallas_kernels": False})
+    try:
+        gpt._GEN_CACHE.pop(model, None)  # force a re-trace on the flag flip
+        without = np.asarray(
+            model.generate(tokens, max_new_tokens=6, max_len=128))
+    finally:
+        flags.set_flags({"use_pallas_kernels": True})
+    np.testing.assert_array_equal(with_kernel, without)
+
+
+def test_jit_and_traced_lengths():
+    # lengths arrive traced inside the engine's jitted step
+    b, h, T, d = 2, 4, 128, 32
+    q, k, v = _mk(b, h, h, T, d, jnp.float32)
+
+    @jax.jit
+    def f(q, k, v, lengths):
+        return decode_attention(q, k, v, lengths)
+
+    lengths = jnp.asarray([3, 100], jnp.int32)
+    got = f(q, k, v, lengths)
+    want = decode_attention_reference(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)

@@ -2,7 +2,7 @@
 
 Reference analog: HybridParallelInferenceHelper serving TP inference
 (fleet/utils/hybrid_parallel_inference.py:23). Here the decode jit runs
-with the KV cache sharded P(L, dp, T, tp, D) and block weights constrained
+with the KV cache sharded P(L, dp, tp, T, D) and block weights constrained
 by PARTITION_RULES; on the 8-virtual-device CPU mesh the sharded program
 must reproduce the dense program's tokens exactly (greedy, fp32)."""
 
@@ -55,10 +55,10 @@ def test_sharded_decode_cache_actually_sharded():
             gpt.shard_params(params, topo.mesh),
             tokens, jax.random.PRNGKey(0))
         txt = lowered.as_text()
-        # the (L,B,T,H,D) cache tensor must carry the dp/tp sharding
+        # the (L,B,H,T,D) cache tensor must carry the dp/tp sharding
         # constraint, and block weights must be tp-constrained
         assert any(
-            "sharding_constraint" in line and "2x4x64x4x8" in line
+            "sharding_constraint" in line and "2x4x4x64x8" in line
             and '"tp"' in line and '"dp"' in line
             for line in txt.splitlines()), "no sharded KV cache in HLO"
         assert any(

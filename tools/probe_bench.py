@@ -1,0 +1,115 @@
+"""Opportunistic TPU bench capture (VERDICT r4 item 1).
+
+Rounds 3 and 4 produced zero hardware numbers because the axon tunnel was
+down whenever the single end-of-round bench ran. This prober decouples
+capture from the driver's schedule: it loops all round, probing the tunnel
+with a short, hard-killed device check; the moment the tunnel answers it
+runs the full ``bench.py`` and records the result, then keeps re-benching
+periodically so later code improvements (decode engine, fused CE) are
+reflected in the freshest capture.
+
+Artifacts:
+  - ``PROBE_LOG_r05.jsonl``  — one line per probe attempt (timestamped trail;
+    proves the tunnel state over the whole round even if it never rises).
+  - ``BENCH_r05_probe.json`` — the latest successful full-bench JSON line,
+    wrapped with capture metadata.
+
+Run detached:  ``python tools/probe_bench.py &``  (stdout/err to probe log).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIL = os.path.join(REPO, "PROBE_LOG_r05.jsonl")
+RESULT = os.path.join(REPO, "BENCH_r05_probe.json")
+
+PROBE_TIMEOUT_S = int(os.environ.get("PT_PROBE_TIMEOUT_S", 150))
+DOWN_INTERVAL_S = int(os.environ.get("PT_PROBE_INTERVAL_S", 1200))
+UP_REBENCH_S = int(os.environ.get("PT_REBENCH_INTERVAL_S", 4800))
+
+_PROBE_CODE = (
+    "import jax; d = jax.devices()[0]; "
+    "print(d.platform, getattr(d, 'device_kind', ''))"
+)
+
+
+def _log(entry):
+    entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(TRAIL, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def probe() -> str:
+    """Return the device kind if a non-CPU device answers, else ''."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE], capture_output=True,
+            text=True, timeout=PROBE_TIMEOUT_S, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log({"event": "probe", "up": False, "reason": "timeout"})
+        return ""
+    line = (out.stdout.strip().splitlines() or [""])[-1]
+    up = out.returncode == 0 and line and not line.startswith("cpu")
+    _log({"event": "probe", "up": bool(up),
+          "device": line if up else "",
+          "reason": "" if up else (out.stderr.strip()[-200:] or "rc=%d"
+                                   % out.returncode)})
+    return line if up else ""
+
+
+def run_bench(device: str):
+    env = dict(os.environ)
+    # The tunnel just answered, so a wedged acquisition now means it died
+    # mid-bench — fail fast enough to resume probing.
+    env.setdefault("PT_DEVICE_TIMEOUT_S", "300")
+    env.setdefault("PT_BENCH_BUDGET_S", "2400")
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=3600, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log({"event": "bench", "ok": False, "reason": "3600s timeout"})
+        return False
+    parsed = None
+    for ln in reversed(out.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(ln)
+            break
+        except ValueError:
+            continue
+    ok = (out.returncode == 0 and parsed
+          and parsed.get("metric") != "bench_failed")
+    _log({"event": "bench", "ok": bool(ok), "rc": out.returncode,
+          "secs": round(time.time() - t0, 1),
+          "metric": (parsed or {}).get("metric"),
+          "stderr_tail": out.stderr.strip()[-300:] if not ok else ""})
+    if ok:
+        with open(RESULT, "w") as f:
+            json.dump({"captured_at":
+                       time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                       "device": device, "rc": out.returncode,
+                       "result": parsed}, f, indent=1)
+    return bool(ok)
+
+
+def main():
+    _log({"event": "start", "pid": os.getpid(),
+          "probe_timeout_s": PROBE_TIMEOUT_S,
+          "down_interval_s": DOWN_INTERVAL_S})
+    while True:
+        device = probe()
+        if device:
+            ok = run_bench(device)
+            time.sleep(UP_REBENCH_S if ok else DOWN_INTERVAL_S)
+        else:
+            time.sleep(DOWN_INTERVAL_S)
+
+
+if __name__ == "__main__":
+    main()
