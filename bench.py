@@ -555,14 +555,10 @@ def bench_decode(jax, jnp, peak, smoke=False):
 
 
 def _hbm_gbps(device) -> float:
-    """Per-chip HBM bandwidth by device kind (public spec sheets)."""
-    kind = getattr(device, "device_kind", "").lower()
-    table = (("v6", 1640.0), ("v5p", 2765.0), ("v5", 819.0),
-             ("v4", 1228.0), ("v3", 900.0))
-    for key, val in table:
-        if key in kind:
-            return val
-    return 819.0
+    """Per-chip HBM bandwidth (GB/s) from the cost model's single spec
+    table — no second copy to drift."""
+    from paddle_tpu.cost_model import _peak
+    return _peak(device)[1] / 1e9
 
 
 if __name__ == "__main__":

@@ -35,16 +35,42 @@ def _peak(device):
 class CostModel:
     """(≙ cost_model.py CostModel:23)."""
 
-    def __init__(self):
-        self.device = jax.devices()[0]
-        self.peak_flops, self.peak_bw, self.ici_bw = _peak(self.device)
+    #: per-host DCN bandwidth (bytes/s) a cross-host collective can ride —
+    #: a 200 Gbps NIC ballpark; ~an order of magnitude below ICI, which is
+    #: why the planner routes only low-volume axes (pp activations) over it
+    #: (≙ auto_parallel/cost/comm_op_cost.py's cross-machine link tier)
+    DCN_BW = 25e9
+
+    def __init__(self, dcn_bw: float = None, device_kind: str = None):
+        """``device_kind`` ("v5", "v4", ...) plans for a TARGET chip
+        without being attached to it — the search path runs on CPU but
+        must reason with real TPU peaks (≙ the reference shipping
+        static_op_benchmark.json profiles for absent hardware)."""
+        if device_kind is not None:
+            # planning for a TARGET chip: never touch the local backend
+            # (the tunnel may be down — that's the very case this serves)
+            self.device = None
+            kind = device_kind.lower()
+            for key, val in _PEAKS.items():
+                if key in kind:
+                    self.peak_flops, self.peak_bw, self.ici_bw = val
+                    break
+            else:
+                raise ValueError(
+                    f"unknown device_kind {device_kind!r}; expected one "
+                    f"containing {sorted(_PEAKS)}")
+        else:
+            self.device = jax.devices()[0]
+            self.peak_flops, self.peak_bw, self.ici_bw = _peak(self.device)
+        self.dcn_bw = dcn_bw if dcn_bw is not None else self.DCN_BW
         self._measured = {}
 
-    def collective_time(self, nbytes: float) -> float:
-        """Seconds to move ``nbytes`` over the chip's ICI links (bandwidth
-        term only; latency is negligible at the message sizes the planner
-        reasons about)."""
-        return float(nbytes) / self.ici_bw
+    def collective_time(self, nbytes: float, tier: str = "ici") -> float:
+        """Seconds to move ``nbytes`` over the given link tier ("ici"
+        within a slice, "dcn" across hosts; bandwidth term only — latency
+        is negligible at the message sizes the planner reasons about)."""
+        bw = self.dcn_bw if tier == "dcn" else self.ici_bw
+        return float(nbytes) / bw
 
     # -- static (analysis-based) costs --------------------------------------
 

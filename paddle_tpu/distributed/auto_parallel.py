@@ -1,0 +1,234 @@
+"""One-call auto-parallel training: ``Engine(module, loss, opt).fit(data)``.
+
+Reference analog: python/paddle/distributed/auto_parallel/engine.py:58 —
+the Engine that wraps plan → parallelize → fit into one object (`_plan`
+at :618 invokes the Planner/tuner, `_parallel` at :646 applies the
+distributed passes, `fit` at :749 runs the loop). The TPU re-design is
+thinner because the heavy machinery dissolved: planning is
+`planner.suggest_mesh` (cost-ranked degree search), parallelization is
+sharded `device_put` + GSPMD (no graph passes), and the train step is one
+jitted SPMD program.
+
+    eng = Engine(model, loss=my_loss, optimizer=AdamW(1e-3))
+    eng.fit(loader, epochs=2)          # plans the mesh on first batch
+    eng.evaluate(val_loader)
+
+The plan can be pinned via ``DistributedStrategy.hybrid_configs`` (any
+explicit degree > 1 skips the search — the reference's semi-auto mode).
+"""
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["Engine"]
+
+
+def _as_xy(batch):
+    if isinstance(batch, (tuple, list)):
+        if len(batch) == 2:
+            return batch[0], batch[1]
+        if len(batch) == 1:
+            return batch[0], batch[0]
+    return batch, batch   # LM convention: labels are the inputs
+
+
+class Engine:
+    """Plan, parallelize, and train a Module in one object
+    (≙ auto_parallel.engine.Engine:58).
+
+    Args:
+      module: a paddle_tpu Module; called as ``module(x)``.
+      loss: ``loss(outputs, y) -> scalar``; defaults to
+        ``models.gpt.lm_loss`` semantics when the module is a GPT.
+      optimizer: a paddle_tpu optimizer (``init``/``update`` protocol).
+      strategy: fleet.DistributedStrategy; explicit hybrid degrees > 1
+        pin the plan, otherwise the planner searches (suggest_mesh).
+      hbm_bytes / max_pp / n_hosts: forwarded to the planner search.
+    """
+
+    def __init__(self, module, loss: Optional[Callable] = None,
+                 optimizer=None, strategy=None,
+                 hbm_bytes: float = 16e9, max_pp: int = 1,
+                 n_hosts: int = 1):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        self.module = module.tag_paths() if hasattr(module, "tag_paths") \
+            else module
+        self.loss = loss or self._default_loss()
+        self.optimizer = optimizer
+        self.strategy = strategy or DistributedStrategy()
+        self.hbm_bytes = hbm_bytes
+        self.max_pp = max_pp
+        self.n_hosts = n_hosts
+        self.degrees: Optional[Dict[str, int]] = None
+        self.mesh = None
+        self._params = None
+        self._buffers = None
+        self._opt_state = None
+        self._step = None
+        self._eval = None
+        self.history = {"loss": []}
+
+    def _default_loss(self):
+        from paddle_tpu.models import gpt
+        if isinstance(self.module, gpt.GPT):
+            return lambda logits, y: gpt.lm_loss(logits, y)
+        raise ValueError("Engine needs an explicit loss= for this module")
+
+    # -- plan (≙ engine.py _plan:618) ---------------------------------------
+
+    def _plan(self, sample_x) -> Dict[str, int]:
+        hc = dict(self.strategy.hybrid_configs)
+        explicit = {"dp": hc.get("dp_degree", 1),
+                    "tp": hc.get("mp_degree", 1),
+                    "pp": hc.get("pp_degree", 1),
+                    "fsdp": hc.get("sharding_degree", 1)}
+        if any(v and v > 1 for v in explicit.values()):
+            degrees = {k: (v if v and v > 1 else 1)
+                       for k, v in explicit.items()}
+            # fleet.init semantics: dp absorbs the devices the explicit
+            # degrees don't cover (a partial pin like mp_degree=4 on 8
+            # devices means dp=2, not a mesh-size error mid-fit)
+            world = 1
+            for v in degrees.values():
+                world *= v
+            n = len(jax.devices())
+            if n % world:
+                raise ValueError(
+                    f"pinned degrees {degrees} (= {world}) do not divide "
+                    f"the device count {n}")
+            degrees["dp"] *= n // world
+            return degrees
+        from paddle_tpu.distributed.planner import suggest_mesh
+        tokens = int(np.prod(np.asarray(sample_x).shape[:2])) \
+            if np.asarray(sample_x).ndim >= 2 else np.asarray(sample_x).size
+        # 6·N·tokens step-FLOPs estimate: without it the cost model sees
+        # zero compute, which disables the grad-sync overlap credit and
+        # skews the search toward needless tp
+        n_params = sum(int(v.size)
+                       for _, v in self.module.named_parameters())
+        return suggest_mesh(self.module, len(jax.devices()),
+                            hbm_bytes=self.hbm_bytes, max_pp=self.max_pp,
+                            n_hosts=self.n_hosts, tokens_per_step=tokens,
+                            flops_per_step=6.0 * n_params * tokens)
+
+    # -- parallelize (≙ engine.py _parallel:646) ----------------------------
+
+    def _parallel(self, degrees: Dict[str, int]):
+        from paddle_tpu.distributed import mesh as mesh_lib
+        from paddle_tpu.distributed.planner import plan_module
+        if degrees.get("pp", 1) > 1:
+            # honest failure beats silently replicating blocks across the
+            # pp axis (which would also void the planner's 1/pp memory
+            # credit): Engine v1 executes dp/fsdp/tp plans; pipeline runs
+            # go through gpt.pipelined_apply / FleetExecutor
+            raise NotImplementedError(
+                f"plan {degrees} needs pipeline execution — Engine "
+                "executes dp/fsdp/tp plans; use the GPT pipeline path or "
+                "FleetExecutor for pp")
+        axes = {k: degrees.get(k, 1) for k in ("dp", "tp", "pp", "fsdp")}
+        topo = mesh_lib.init_mesh(**axes)
+        self.mesh = topo.mesh
+        self.degrees = degrees
+        plan = plan_module(self.module, mesh=self.mesh)
+        params, buffers = self.module.split_params()
+        self._params = {
+            n: jax.device_put(v, NamedSharding(self.mesh,
+                                               plan.get(n, P())))
+            for n, v in params.items()}
+        self._buffers = {
+            n: jax.device_put(v, NamedSharding(self.mesh, P()))
+            for n, v in buffers.items()}
+        if self.optimizer is not None:
+            self._opt_state = self.optimizer.init(self._params)
+
+        module, loss_fn, opt = self.module, self.loss, self.optimizer
+
+        def loss_of(p, x, y, rng):
+            m = module.merge_params({**self._buffers, **p})
+            try:
+                out = m(x, rng_key=rng)
+            except TypeError:
+                out = m(x)
+            return loss_fn(out, y)
+
+        def step(p, opt_state, x, y, rng):
+            loss, grads = jax.value_and_grad(loss_of)(p, x, y, rng)
+            new_p, new_state = opt.update(grads, opt_state, p)
+            return new_p, new_state, loss
+
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._eval = jax.jit(loss_of)
+
+    def prepare(self, sample_batch):
+        """Plan the mesh from a sample batch and compile the hybrid step —
+        fit() calls this lazily on its first batch."""
+        x, _ = _as_xy(sample_batch)
+        self._parallel(self._plan(x))
+        return self.degrees
+
+    # -- fit (≙ engine.py fit:749) ------------------------------------------
+
+    def _place_batch(self, a):
+        a = jnp.asarray(a)
+        shape = dict(self.mesh.shape)
+        kept, prod = [], 1
+        for ax in ("dp", "fsdp"):
+            deg = shape.get(ax, 1)
+            # divisibility is against the PRODUCT of kept axes — checking
+            # each axis alone admits dp*fsdp > batch
+            if deg > 1 and a.shape[0] % (prod * deg) == 0:
+                kept.append(ax)
+                prod *= deg
+        spec = P(tuple(kept) if kept else None)
+        return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+    def fit(self, train_data: Iterable, epochs: int = 1,
+            log_freq: int = 0, rng_seed: int = 0) -> Dict[str, Any]:
+        """Train over ``train_data`` (iterable of x or (x, y) batches);
+        plans + parallelizes on the first batch. Returns the history."""
+        if self.optimizer is None:
+            raise ValueError("Engine.fit needs an optimizer")
+        rng = jax.random.PRNGKey(rng_seed)
+        for epoch in range(epochs):
+            for i, batch in enumerate(train_data):
+                x, y = _as_xy(batch)
+                if self._step is None:
+                    self.prepare(batch)
+                rng, k = jax.random.split(rng)
+                xb, yb = self._place_batch(x), self._place_batch(y)
+                self._params, self._opt_state, loss = self._step(
+                    self._params, self._opt_state, xb, yb, k)
+                self.history["loss"].append(float(loss))
+                if log_freq and i % log_freq == 0:
+                    print(f"[engine] epoch {epoch} step {i} "
+                          f"loss {float(loss):.4f} plan {self.degrees}",
+                          flush=True)
+        return self.history
+
+    def evaluate(self, data: Iterable) -> float:
+        """Mean loss over a dataset with the trained (sharded) params.
+        Safe for one-shot iterators: the batch used for lazy prepare()
+        still counts toward the mean."""
+        import itertools
+        it = iter(data)
+        first = next(it, None)
+        if first is None:
+            return float("nan")
+        if self._step is None:
+            self.prepare(first)
+        rng = jax.random.PRNGKey(0)
+        losses = []
+        for batch in itertools.chain([first], it):
+            x, y = _as_xy(batch)
+            losses.append(float(self._eval(
+                self._params, self._place_batch(x), self._place_batch(y),
+                rng)))
+        return float(np.mean(losses))
+
+    def state_dict(self):
+        return dict(self._params or {})

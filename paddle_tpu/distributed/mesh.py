@@ -28,7 +28,13 @@ AXIS_PP = "pp"          # pipeline parallel ≙ "pipe"
 AXIS_SP = "sp"          # sequence/context parallel (new capability)
 AXIS_EP = "ep"          # expert parallel
 
-_ORDER = ("dp", "pp", "fsdp", "sp", "ep", "tp")
+# Outermost → innermost. pp LEADS: on a multi-host device list (host-major
+# order) the outermost axis is the one that spans hosts, and pipeline
+# stage boundaries move orders of magnitude fewer bytes than dp gradient
+# all-reduce — so pp is the axis that can afford DCN (the reference's
+# hybrid topology order pp→dp→sharding→mp, fleet/base/topology.py; the
+# planner's _axis_tier DCN assignment assumes exactly this order).
+_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
 
 _global_topology = None
 

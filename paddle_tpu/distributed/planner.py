@@ -192,7 +192,7 @@ def _prune_indivisible(spec: P, shape, mesh_shape) -> P:
 
 def _memory_with_plan(params, plan, degrees: Dict[str, int],
                       optimizer: str = "adamw",
-                      moment_bytes: int = 4) -> Dict[str, float]:
+                      moment_bytes: int = 4, pp: int = 1) -> Dict[str, float]:
     def shards(spec):
         n = 1
         for entry in tuple(spec):
@@ -208,7 +208,10 @@ def _memory_with_plan(params, plan, degrees: Dict[str, int],
         b = v.size * v.dtype.itemsize
         opt_b = v.size * moment_bytes * n_moments
         total += b + opt_b
-        per_device += (b + opt_b) / shards(plan.get(name, P()))
+        # pp splits the repeated blocks across stages (exact, per param);
+        # embeddings/head stay whole on their stage
+        stage = pp if pp > 1 and _in_repeated_block(name) else 1
+        per_device += (b + opt_b) / shards(plan.get(name, P())) / stage
     return {"total_bytes": total, "per_device_bytes": per_device,
             "n_params": sum(v.size for _, v in params)}
 
@@ -258,93 +261,168 @@ def _plan_ctx(module):
             "d_model": _model_dim(params)}
 
 
-def enumerate_plans(n_devices: int, max_tp: int = 8):
-    """Power-of-two (dp, fsdp, tp) factorizations of ``n_devices`` with tp
-    capped (tp beyond one chip's worth of ICI neighbors stops paying).
+def enumerate_plans(n_devices: int, max_tp: int = 8, max_pp: int = 1):
+    """Power-of-two (dp, fsdp, tp[, pp]) factorizations of ``n_devices``
+    with tp capped (tp beyond one chip's worth of ICI neighbors stops
+    paying) and pp opt-in via ``max_pp`` (pipeline plans carry a "pp" key
+    only when pp > 1, ≙ tuner/parallel_tuner.py:35's degree axes).
     Odd factors of a non-power-of-two device count land on dp — TPU
     slices are power-of-two shaped, and odd tp/fsdp degrees rarely divide
     any weight dim anyway."""
     out = []
-    tp = 1
-    while tp <= min(max_tp, n_devices):
-        if n_devices % tp == 0:
-            rest = n_devices // tp
-            fsdp = 1
-            while fsdp <= rest:
-                if rest % fsdp == 0:
-                    out.append({"dp": rest // fsdp, "fsdp": fsdp, "tp": tp})
-                fsdp *= 2
-        tp *= 2
+    pp = 1
+    while pp <= min(max_pp, n_devices):
+        if n_devices % pp == 0:
+            inner = n_devices // pp
+            tp = 1
+            while tp <= min(max_tp, inner):
+                if inner % tp == 0:
+                    rest = inner // tp
+                    fsdp = 1
+                    while fsdp <= rest:
+                        if rest % fsdp == 0:
+                            plan = {"dp": rest // fsdp, "fsdp": fsdp,
+                                    "tp": tp}
+                            if pp > 1:
+                                plan["pp"] = pp
+                            out.append(plan)
+                        fsdp *= 2
+                tp *= 2
+        pp *= 2
     return out
+
+
+def _axis_tier(degrees: Dict[str, int], axis: str, n_hosts: int) -> str:
+    """"dcn" when the axis's collective crosses host boundaries, "ici"
+    otherwise. Axis order outermost→innermost is (pp, dp, fsdp, tp) —
+    matching ``mesh._ORDER``, so the tier the cost model charges is the
+    tier the built mesh actually uses. An axis crosses hosts when its
+    stride × degree exceeds the per-host device count
+    (≙ comm_op_cost.py's cross-machine link selection)."""
+    if n_hosts <= 1:
+        return "ici"
+    dp, fsdp, tp = (degrees.get("dp", 1), degrees.get("fsdp", 1),
+                    degrees.get("tp", 1))
+    pp = degrees.get("pp", 1)
+    world = dp * fsdp * tp * pp
+    per_host = max(1, world // n_hosts)
+    stride = {"tp": 1, "fsdp": tp, "dp": tp * fsdp,
+              "pp": tp * fsdp * dp}[axis]
+    deg = degrees.get(axis, 1)
+    return "dcn" if deg > 1 and stride * deg > per_host else "ici"
 
 
 def plan_cost(module, degrees: Dict[str, int], hbm_bytes: float = 16e9,
               budget: float = 0.6, optimizer: str = "adamw",
               flops_per_step: float = 0.0, tokens_per_step: int = 8192,
-              act_bytes: int = 2, cost_model=None,
+              act_bytes: int = 2, cost_model=None, n_hosts: int = 1,
+              microbatches: Optional[int] = None,
               _ctx=None) -> Dict[str, float]:
     """Estimated step time + memory feasibility for one degree assignment.
 
     Cost terms (scaling-book comm recipe, ≙ auto_parallel/cost/
-    estimate_cost.py's comm+memory halves):
-    - compute: flops_per_step spread over all devices at peak
+    estimate_cost.py's comm+memory halves, comm_op_cost.py's per-link
+    tiers):
+    - compute: flops_per_step spread over all devices at peak, inflated by
+      the pipeline bubble (m + pp - 1)/m for m microbatches
     - dp: ring all-reduce of the local grad shard, 2(dp-1)/dp
     - fsdp: param all-gather fwd+bwd + grad reduce-scatter, 3(fsdp-1)/fsdp
     - tp: 4 activation all-reduces per block (2 fwd + 2 bwd), 2(tp-1)/tp
+    - pp: fwd+bwd stage-boundary activation p2p, full microbatch volume
+    Each axis is charged at its link tier: with ``n_hosts`` > 1, axes whose
+    collectives cross hosts (outermost-first layout pp, dp, fsdp, tp) ride
+    DCN instead of ICI — which is exactly why pp (low-volume boundary
+    activations) wins the cross-host axis over dp (full-gradient volume).
+    Memory: repeated-block params additionally divide by pp (stage split).
     """
     from paddle_tpu.cost_model import CostModel
 
     cm = cost_model or CostModel()
     dp, fsdp, tp = (degrees.get("dp", 1), degrees.get("fsdp", 1),
                     degrees.get("tp", 1))
-    world = dp * fsdp * tp
+    pp = degrees.get("pp", 1)
+    world = dp * fsdp * tp * pp
+    m = microbatches if microbatches else (4 * pp if pp > 1 else 1)
     ctx = _ctx or _plan_ctx(module)
     pruned = {n: _prune_indivisible(spec, ctx["shapes"][n].shape, degrees)
               for n, spec in ctx["base_plan"].items()}
-    rep = _memory_with_plan(ctx["params"], pruned, degrees, optimizer)
+    rep = _memory_with_plan(ctx["params"], pruned, degrees, optimizer,
+                            pp=pp)
     p_bytes = ctx["p_bytes"]
     n_blocks = ctx["n_blocks"]
     d_model = ctx["d_model"]
+    per_dev = rep["per_device_bytes"]
     # per-device activation bytes of one block's boundary tensor: the batch
     # dimension splits over BOTH data axes (fsdp is ZeRO data parallelism)
     act = tokens_per_step / (dp * fsdp) * d_model * act_bytes
 
+    grad_sync_t = 0.0   # dp/fsdp grad collectives: overlappable with bwd
+    critical_t = 0.0    # tp/pp activation comm: on the critical path
     comm = 0.0
     if dp > 1:
-        comm += 2 * (dp - 1) / dp * p_bytes / (fsdp * tp)
+        b = 2 * (dp - 1) / dp * p_bytes / (pp * fsdp * tp)
+        comm += b
+        grad_sync_t += cm.collective_time(
+            b, _axis_tier(degrees, "dp", n_hosts))
     if fsdp > 1:
-        comm += 3 * (fsdp - 1) / fsdp * p_bytes / tp
+        b = 3 * (fsdp - 1) / fsdp * p_bytes / (pp * tp)
+        comm += b
+        grad_sync_t += cm.collective_time(
+            b, _axis_tier(degrees, "fsdp", n_hosts))
     if tp > 1:
-        comm += 4 * n_blocks * 2 * (tp - 1) / tp * act
-    compute_t = flops_per_step / (world * cm.peak_flops)
-    time_s = compute_t + cm.collective_time(comm)
+        b = 4 * (n_blocks / pp) * 2 * (tp - 1) / tp * act
+        comm += b
+        critical_t += cm.collective_time(
+            b, _axis_tier(degrees, "tp", n_hosts))
+    pp_bytes = 0.0
+    bubble = 1.0
+    if pp > 1:
+        # each stage boundary moves every microbatch's activation fwd+bwd;
+        # per-device link load is the full per-replica token volume
+        pp_bytes = 2.0 * act
+        comm += pp_bytes
+        critical_t += cm.collective_time(
+            pp_bytes, _axis_tier(degrees, "pp", n_hosts))
+        bubble = (m + pp - 1) / m
+    compute_t = flops_per_step / (world * cm.peak_flops) * bubble
+    # grad-sync overlaps the backward pass (~2/3 of compute) the way the
+    # fleet optimizer actually schedules it; only the excess is exposed
+    exposed_t = max(0.0, grad_sync_t - (2.0 / 3.0) * compute_t)
+    time_s = compute_t + critical_t + exposed_t
     return {"time_s": time_s, "comm_bytes": comm,
             "compute_s": compute_t,
-            "per_device_bytes": rep["per_device_bytes"],
-            "feasible": rep["per_device_bytes"] <= budget * hbm_bytes}
+            "comm_s": grad_sync_t + critical_t,
+            "exposed_comm_s": critical_t + exposed_t,
+            "bubble_frac": bubble - 1.0, "pp_p2p_bytes": pp_bytes,
+            "per_device_bytes": per_dev,
+            "feasible": per_dev <= budget * hbm_bytes}
 
 
 def rank_plans(module, n_devices: int, hbm_bytes: float = 16e9,
                max_tp: int = 8, budget: float = 0.6,
                optimizer: str = "adamw", flops_per_step: float = 0.0,
                tokens_per_step: int = 8192, measure_fn=None,
-               measure_top_k: int = 3):
+               measure_top_k: int = 3, max_pp: int = 1, n_hosts: int = 1,
+               microbatches: Optional[int] = None, cost_model=None):
     """Score every candidate degree assignment; return
     ``[(cost_s, degrees, info), ...]`` best-first with infeasible plans
     (static memory floor over budget) ranked after all feasible ones.
 
+    ``max_pp`` > 1 adds pipeline plans; ``n_hosts`` > 1 charges cross-host
+    axes at DCN bandwidth (≙ comm_op_cost.py's link tiers).
     ``measure_fn(degrees) -> seconds`` optionally re-ranks the top
     ``measure_top_k`` feasible candidates by real measured step time
     (≙ tuner/optimization_tuner.py:188's trial runs).
     """
     from paddle_tpu.cost_model import CostModel
 
-    cm = CostModel()
+    cm = cost_model or CostModel()
     ctx = _plan_ctx(module)
     scored = []
-    for degrees in enumerate_plans(n_devices, max_tp):
+    for degrees in enumerate_plans(n_devices, max_tp, max_pp):
         info = plan_cost(module, degrees, hbm_bytes, budget, optimizer,
                          flops_per_step, tokens_per_step, cost_model=cm,
+                         n_hosts=n_hosts, microbatches=microbatches,
                          _ctx=ctx)
         scored.append((info["time_s"], degrees, info))
     scored.sort(key=lambda t: (not t[2]["feasible"], t[0]))
@@ -364,16 +442,21 @@ def rank_plans(module, n_devices: int, hbm_bytes: float = 16e9,
 def suggest_mesh(module, n_devices: int, hbm_bytes: float = 16e9,
                  max_tp: int = 8, budget: float = 0.6,
                  optimizer: str = "adamw", flops_per_step: float = 0.0,
-                 tokens_per_step: int = 8192,
-                 measure_fn=None) -> Dict[str, int]:
-    """Pick (dp, fsdp, tp) degrees for ``n_devices``: enumerate every
-    factorization, reject those whose static memory floor exceeds
+                 tokens_per_step: int = 8192, measure_fn=None,
+                 max_pp: int = 1, n_hosts: int = 1,
+                 microbatches: Optional[int] = None,
+                 cost_model=None) -> Dict[str, int]:
+    """Pick (dp, fsdp, tp[, pp]) degrees for ``n_devices``: enumerate
+    every factorization, reject those whose static memory floor exceeds
     ``budget``·HBM, and return the cost-model argmin
     (≙ tuner/parallel_tuner.py:35). With ``measure_fn`` the finalists are
-    re-ranked by measured step time."""
+    re-ranked by measured step time; ``max_pp``/``n_hosts`` unlock
+    pipeline plans and the DCN link tier."""
     ranked = rank_plans(module, n_devices, hbm_bytes, max_tp, budget,
                         optimizer, flops_per_step, tokens_per_step,
-                        measure_fn=measure_fn)
+                        measure_fn=measure_fn, max_pp=max_pp,
+                        n_hosts=n_hosts, microbatches=microbatches,
+                        cost_model=cost_model)
     for _, degrees, info in ranked:
         if info["feasible"]:
             return degrees

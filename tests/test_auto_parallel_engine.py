@@ -1,0 +1,161 @@
+"""One-call auto-parallel Engine (VERDICT r4 item 6)
+≙ python/paddle/distributed/auto_parallel/engine.py:58 (_plan:618,
+_parallel:646, fit:749): plan → mesh → shard → compile → train in a
+single ``Engine(module, ...).fit(loader)`` call."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import Engine
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.models import gpt
+from paddle_tpu import optimizer as optim
+
+
+def _gpt_mini():
+    cfg = gpt.GPTConfig(vocab_size=512, max_seq_len=16, d_model=32,
+                        n_layers=2, n_heads=2, dtype=jnp.float32)
+    return gpt.GPT(cfg, seed=0)
+
+
+def _batches(n, b=8, s=16, vocab=512, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (b, s)).astype(np.int32)
+            for _ in range(n)]
+
+
+def test_engine_fits_gpt_with_planner_chosen_plan(mesh8):
+    """The headline: GPT-mini trains through Engine in ONE call, on a
+    planner-searched mesh, with sharded params and decreasing loss."""
+    model = _gpt_mini()
+    eng = Engine(model, optimizer=optim.AdamW(learning_rate=1e-3),
+                 hbm_bytes=1e15)
+    hist = eng.fit(_batches(6), epochs=2)
+    # planner ran and covered all 8 devices
+    assert eng.degrees is not None
+    world = 1
+    for v in eng.degrees.values():
+        world *= v
+    assert world == 8
+    assert len(hist["loss"]) == 12
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert all(np.isfinite(l) for l in hist["loss"])
+
+
+def test_engine_respects_pinned_strategy(mesh8):
+    """Explicit hybrid degrees skip the search (semi-auto mode, ref
+    engine.py's user-annotated path)."""
+    strat = DistributedStrategy()
+    strat.hybrid_configs["dp_degree"] = 2
+    strat.hybrid_configs["mp_degree"] = 4
+    eng = Engine(_gpt_mini(), optimizer=optim.AdamW(learning_rate=1e-3),
+                 strategy=strat)
+    eng.fit(_batches(2), epochs=1)
+    assert eng.degrees["dp"] == 2 and eng.degrees["tp"] == 4
+    # tp actually applied: the qkv weight must be placed sharded
+    wqkv = eng._params["blocks.item_0.wqkv"]
+    assert not wqkv.sharding.is_fully_replicated
+
+
+def test_engine_partial_pin_fills_dp(mesh8):
+    """Code-review regression: a lone mp_degree=4 on 8 devices must fill
+    dp=2 (fleet.init residual semantics), not crash in init_mesh."""
+    strat = DistributedStrategy()
+    strat.hybrid_configs["mp_degree"] = 4
+    eng = Engine(_gpt_mini(), optimizer=optim.AdamW(learning_rate=1e-3),
+                 strategy=strat)
+    eng.fit(_batches(1), epochs=1)
+    assert eng.degrees == {"dp": 2, "tp": 4, "pp": 1, "fsdp": 1}
+
+
+def test_engine_evaluate(mesh8):
+    eng = Engine(_gpt_mini(), optimizer=optim.AdamW(learning_rate=1e-3),
+                 hbm_bytes=1e15)
+    eng.fit(_batches(3), epochs=1)
+    val = eng.evaluate(_batches(2, seed=7))
+    assert np.isfinite(val)
+
+
+def test_engine_rejects_pp_plan(mesh8):
+    """Code-review regression: Engine must refuse a pp plan rather than
+    silently replicate blocks across the pp axis (voiding the planner's
+    1/pp memory credit)."""
+    strat = DistributedStrategy()
+    strat.hybrid_configs["pp_degree"] = 2
+    strat.hybrid_configs["dp_degree"] = 4
+    eng = Engine(_gpt_mini(), optimizer=optim.AdamW(learning_rate=1e-3),
+                 strategy=strat)
+    with pytest.raises(NotImplementedError):
+        eng.fit(_batches(1), epochs=1)
+
+
+def test_engine_small_batch_placement(mesh8):
+    """Code-review regression: batch 4 under dp=4 x fsdp=2 must fall back
+    to partial placement (4 % (4*2) != 0), not crash in device_put."""
+    strat = DistributedStrategy()
+    strat.hybrid_configs["dp_degree"] = 4
+    strat.hybrid_configs["sharding_degree"] = 2
+    eng = Engine(_gpt_mini(), optimizer=optim.AdamW(learning_rate=1e-3),
+                 strategy=strat)
+    hist = eng.fit(_batches(2, b=4), epochs=1)
+    assert all(np.isfinite(l) for l in hist["loss"])
+
+
+def test_engine_evaluate_counts_every_batch(mesh8):
+    """Code-review regression: evaluate() on a one-shot generator must
+    include the prepare() batch in the mean."""
+    eng = Engine(_gpt_mini(), optimizer=optim.AdamW(learning_rate=1e-3),
+                 hbm_bytes=1e15)
+    seen = []
+
+    def gen():
+        for b in _batches(3, seed=5):
+            seen.append(1)
+            yield b
+
+    val = eng.evaluate(gen())
+    assert np.isfinite(val) and len(seen) == 3
+    assert np.isnan(eng.evaluate([]))
+
+
+def test_mesh_pp_axis_is_outermost():
+    """Code-review regression: the built mesh must place pp outermost so
+    the planner's DCN-tier assumption (pp spans hosts, dp/fsdp/tp stay
+    within) matches reality on a host-major device list."""
+    from paddle_tpu.distributed import mesh as mesh_lib
+    topo = mesh_lib.init_mesh(pp=2, dp=2, tp=2, set_global=False)
+    arr = np.asarray(topo.mesh.devices)
+    ids = np.vectorize(lambda d: d.id)(arr)
+    # pp slice 0 = first half of the device list (one "host"), slice 1 =
+    # second half — contiguous host-major blocks
+    pp_axis = topo.mesh.axis_names.index("pp")
+    first = np.take(ids, 0, axis=pp_axis).ravel()
+    second = np.take(ids, 1, axis=pp_axis).ravel()
+    assert sorted(first) == [0, 1, 2, 3]
+    assert sorted(second) == [4, 5, 6, 7]
+
+
+def test_cost_model_device_kind_strict():
+    from paddle_tpu.cost_model import CostModel, _PEAKS
+    assert CostModel(device_kind="v5p").peak_flops == _PEAKS["v5p"][0]
+    assert CostModel(device_kind="TPU v5 lite").peak_flops == \
+        _PEAKS["v5"][0]
+    with pytest.raises(ValueError):
+        CostModel(device_kind="h100")
+
+
+def test_engine_requires_loss_for_unknown_module():
+    from paddle_tpu import nn
+
+    class Tiny(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.w = nn.Parameter(jnp.ones((4, 4)))
+
+        def forward(self, x):
+            return x @ self.w
+
+    with pytest.raises(ValueError):
+        Engine(Tiny())

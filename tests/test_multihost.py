@@ -11,11 +11,15 @@ ranks go through paddle_tpu.distributed.spawn + init_parallel_env.
 The worker body lives in tests/_mh_worker.py, whose module top pins the
 CPU platform before unpickling can touch jax."""
 
+import json
+
+import numpy as np
 import pytest
 
 import paddle_tpu.distributed as dist
 from paddle_tpu import native
 
+import _mh_worker
 from _mh_worker import worker as _worker
 
 
@@ -25,3 +29,43 @@ def test_cross_process_collectives_and_ring(tmp_path):
     dist.spawn(_worker, args=(str(tmp_path),), nprocs=2,
                master_port=23491)
     assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
+
+
+def test_two_controller_gpt_hybrid_parity(tmp_path):
+    """VERDICT r4 item 4: the FULL dp×fsdp×tp GPT train step under
+    jax.distributed with 2 real processes × 4 virtual CPU devices each,
+    loss-parity against the single-controller 8-device run (ref
+    test_dist_base.py:901)."""
+    from paddle_tpu.distributed import mesh as mesh_lib
+
+    # single-controller reference on the pytest process's 8 devices
+    want = _mh_worker.gpt_losses()
+    mesh_lib.set_topology(None)
+
+    dist.spawn(_mh_worker.gpt_worker, args=(str(tmp_path),), nprocs=2,
+               master_port=23493)
+    for rank in range(2):
+        got = json.load(open(tmp_path / f"losses_{rank}.json"))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5,
+                                   err_msg=f"rank {rank}")
+
+
+@pytest.mark.skipif(not native.is_available(),
+                    reason="native toolchain unavailable")
+def test_two_controller_fleet_executor_pp(tmp_path):
+    """A FleetExecutor pipeline whose two stages live on the two
+    controllers of one jax.distributed job — each stage an SPMD program
+    over its local 2×2 (dp, tp) mesh, boundary tensors over the native
+    p2p endpoint. Grad + loss parity vs the full-model autodiff oracle."""
+    dist.spawn(_mh_worker.fe_worker, args=(str(tmp_path), 23597),
+               nprocs=2, master_port=23495)
+    ref_loss, ref_grads = _mh_worker.fe_reference()
+    g0 = json.load(open(tmp_path / "fe_0.json"))
+    g1 = json.load(open(tmp_path / "fe_1.json"))
+    np.testing.assert_allclose(g1["loss"], ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(
+        g0["grad_w_sum"], float(np.asarray(ref_grads[0]["w"]).sum()),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        g1["grad_w_sum"], float(np.asarray(ref_grads[1]["w"]).sum()),
+        rtol=1e-4)

@@ -194,6 +194,66 @@ def test_suggest_mesh_uses_compute_term():
     assert a["time_s"] > b["time_s"]
 
 
+def test_enumerate_plans_with_pp():
+    from paddle_tpu.distributed.planner import enumerate_plans
+    plans = enumerate_plans(8, max_pp=4)
+    pp_plans = [p for p in plans if p.get("pp", 1) > 1]
+    assert pp_plans, "max_pp>1 must emit pipeline plans"
+    assert all(
+        p["dp"] * p["fsdp"] * p["tp"] * p.get("pp", 1) == 8 for p in plans)
+    assert {"dp": 2, "fsdp": 1, "tp": 2, "pp": 2} in plans
+    # default stays pp-free (backward compatible)
+    assert all("pp" not in p for p in enumerate_plans(8))
+
+
+def test_pp_bubble_and_memory_terms():
+    """pp inflates compute by (m+pp-1)/m and deflates block memory by pp
+    (≙ estimate_cost.py's pipeline terms)."""
+    from paddle_tpu.distributed.planner import plan_cost
+    cfg = gpt.GPTConfig(vocab_size=2048, max_seq_len=64, d_model=64,
+                        n_layers=4, n_heads=4, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    flat = plan_cost(model, {"dp": 8, "fsdp": 1, "tp": 1},
+                     flops_per_step=1e12)
+    pipe = plan_cost(model, {"dp": 4, "fsdp": 1, "tp": 1, "pp": 2},
+                     flops_per_step=1e12, microbatches=8)
+    assert pipe["bubble_frac"] == pytest.approx((8 + 2 - 1) / 8 - 1)
+    assert pipe["compute_s"] > flat["compute_s"]  # bubble-inflated
+    assert pipe["pp_p2p_bytes"] > 0
+    # block weights split across stages → lower static floor than pure dp
+    assert pipe["per_device_bytes"] < flat["per_device_bytes"]
+
+
+def test_planner_picks_pp_for_cross_host():
+    """Phase-A reproduction at the cost-model level: on 2 hosts with the
+    model too big for one host's worth of pure-dp replication, the search
+    must put pp on the cross-host (DCN) axis — boundary activations are
+    orders of magnitude lighter than cross-host gradient all-reduce
+    (≙ comm_op_cost.py cross-machine links; dryrun phase A's hand-picked
+    pp=2 mesh)."""
+    from paddle_tpu.cost_model import CostModel
+    from paddle_tpu.distributed.planner import suggest_mesh
+    cfg = gpt.GPTConfig(vocab_size=2048, max_seq_len=256, d_model=256,
+                        n_layers=8, n_heads=8, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    # plan for a real v5 chip (the test runs on CPU whose 1e11 peak would
+    # distort every compute-vs-comm trade)
+    cm = CostModel(device_kind="v5")
+    flops = 6 * cfg.num_params() * 2048  # true step FLOPs at 2048 tok
+    deg = suggest_mesh(model, n_devices=8, hbm_bytes=1e15,
+                       flops_per_step=flops, max_pp=4, n_hosts=2,
+                       tokens_per_step=2048, cost_model=cm)
+    assert deg.get("pp", 1) >= 2, deg
+    # sanity: single-host AND compute-bound (large batch), the bubble
+    # outweighs any comm saving — no pipeline
+    big_tok = 65536
+    one = suggest_mesh(model, n_devices=8, hbm_bytes=1e15,
+                       flops_per_step=6 * cfg.num_params() * big_tok,
+                       max_pp=4, n_hosts=1, tokens_per_step=big_tok,
+                       cost_model=cm)
+    assert one.get("pp", 1) == 1, one
+
+
 def test_measured_search_beats_heuristic(mesh8):
     """Trial-run re-ranking: the searched plan's MEASURED step time must
     not lose to the memory-only heuristic's choice (tuner's promise)."""
