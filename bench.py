@@ -73,6 +73,15 @@ def bench_gpt(jax, jnp, peak):
                 hw_flops = compiled.cost_analysis().get("flops", 0.0)
             except Exception:
                 hw_flops = 0.0
+            # peak-memory evidence for the fused blockwise CE (the
+            # (B,S,V) logits no longer exist in HBM): XLA's own analysis
+            # of THE executable that will run
+            try:
+                ma = compiled.memory_analysis()
+                step_peak_mb = round((ma.temp_size_in_bytes
+                                      + ma.output_size_in_bytes) / 2**20)
+            except Exception:
+                step_peak_mb = None
             step = compiled
 
             for _ in range(warmup):
@@ -101,6 +110,7 @@ def bench_gpt(jax, jnp, peak):
                     "hw_util_cost_analysis": round(hw_flops / dt / peak, 4)
                     if hw_flops else None,
                     "step_ms": round(dt * 1e3, 2),
+                    "step_peak_mb": step_peak_mb,
                     "batch": batch,
                     "seq": cfg.max_seq_len,
                 },
@@ -294,11 +304,37 @@ def bench_ppyoloe(jax, jnp, peak, smoke=False):
             gt_valid, key)
     _sync(loss)
     dt = (time.perf_counter() - t0) / iters
-    return {"ppyoloe_s_imgs_per_sec": round(batch / dt, 1),
-            "ppyoloe_s_hw_util": round(hw_flops / dt / peak, 4)
-            if hw_flops else None,
-            "ppyoloe_s_batch": batch,
-            "ppyoloe_s_img": img}
+    res = {"ppyoloe_s_imgs_per_sec": round(batch / dt, 1),
+           "ppyoloe_s_hw_util": round(hw_flops / dt / peak, 4)
+           if hw_flops else None,
+           "ppyoloe_s_batch": batch,
+           "ppyoloe_s_img": img}
+
+    # eval path: forward + matrix-NMS decode compiled as ONE program
+    # (VERDICT r4 item 7 — the host-NMS path cannot be served like this)
+    try:
+        from paddle_tpu import nn
+
+        eval_model = model.merge_params({**buffers, **params})
+
+        @jax.jit
+        def eval_fn(im):
+            with nn.stateful(training=False):
+                cls, reg, centers, strides = eval_model(im)
+            return M.decode_predictions_jit(cls, reg, centers, strides,
+                                            top_k=100)
+        boxes_o, scores_o, labels_o, valid = eval_fn(images)
+        _sync(scores_o[0, 0])
+        t0 = time.perf_counter()
+        e_iters = max(iters, 2)
+        for _ in range(e_iters):
+            boxes_o, scores_o, labels_o, valid = eval_fn(images)
+        _sync(scores_o[0, 0])
+        edt = (time.perf_counter() - t0) / e_iters
+        res["ppyoloe_s_eval_imgs_per_sec"] = round(batch / edt, 1)
+    except Exception as e:
+        res["ppyoloe_s_eval_error"] = str(e)[:120]
+    return res
 
 
 def bench_pp(jax, jnp, peak, smoke=False):

@@ -53,6 +53,7 @@ from paddle_tpu.distributed import launch as launch_module
 launch = launch_module  # ref: paddle.distributed.launch (module)
 from paddle_tpu.distributed import auto_parallel
 from paddle_tpu.distributed.auto_parallel import Engine
+from paddle_tpu.distributed import compression
 # gloo_* shims: the reference's CPU-barrier plane; the TCPStore covers it
 def gloo_init_parallel_env(*a, **k):
     return None

@@ -1,0 +1,144 @@
+"""Gradient-compressed data parallelism with error feedback.
+
+Reference analog: the DGC / local-SGD meta-optimizer family
+(python/paddle/distributed/fleet/meta_optimizers/dgc_optimizer.py,
+paddle/fluid/operators/dgc_op.cc) — compress the gradient exchange when
+the data-parallel axis rides a slow link. The TPU re-design keeps the
+part that matters on this stack (the wire format of the dp collective)
+and drops what doesn't (DGC's top-k sparsification exists to cut NCCL
+ring volume; on TPU the same 2-4x cut comes from dtype narrowing, which
+stays dense and MXU/XLA-friendly):
+
+- ``bf16``: gradients cross the dp axis as bfloat16 — 2x volume cut.
+- ``int8``: symmetric per-tensor quantization with a pmax-agreed scale —
+  4x cut. The psum accumulates in int32 (XLA upcasts on the wire for the
+  reduction; a DCN deployment chasing the full 4x would all-gather int8
+  and reduce locally — noted, not implemented).
+- **Error feedback** (the residual accumulation DGC calls "momentum
+  correction"): each replica carries ``ef = (g + ef) - Q(g + ef)`` to the
+  next step, so quantization error accumulates into later updates instead
+  of biasing the trajectory — the property the convergence-parity test
+  pins down.
+
+When to use: dp over DCN (multi-host data parallelism) where the gradient
+all-reduce is the bottleneck — see ``planner._axis_tier``. On ICI the
+collectives are rarely the bottleneck and full-precision sync is the
+default.
+"""
+
+import functools
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["compressed_psum_mean", "build_compressed_dp_step",
+           "init_error_feedback"]
+
+_METHODS = ("bf16", "int8")
+
+
+def _check_method(method: str):
+    if method not in _METHODS:
+        raise ValueError(f"grad_compression must be one of {_METHODS}, "
+                         f"got {method!r}")
+
+
+def compressed_psum_mean(grads, ef, axis: str, method: str):
+    """Quantized mean-all-reduce over ``axis`` with error feedback.
+
+    Must be called INSIDE a shard_map/pmap context where ``axis`` is a
+    bound mesh axis. ``grads`` are this replica's local gradients, ``ef``
+    the replica's residual from the previous step (same pytree).
+    Returns (mean_grads fp32, new_ef).
+    """
+    _check_method(method)
+    n = lax.psum(jnp.ones((), jnp.float32), axis)
+
+    def one(g, e):
+        v = g.astype(jnp.float32) + e
+        if method == "bf16":
+            q = v.astype(jnp.bfloat16)            # the wire dtype
+            deq = q.astype(jnp.float32)
+            tot = lax.psum(q, axis).astype(jnp.float32)
+        else:
+            # scale agreed across replicas (pmax) so the int8 payloads are
+            # summable; +tiny floor keeps all-zero grads finite
+            s = lax.pmax(jnp.max(jnp.abs(v)), axis) / 127.0 + 1e-30
+            q = jnp.clip(jnp.round(v / s), -127, 127).astype(jnp.int8)
+            deq = q.astype(jnp.float32) * s
+            tot = lax.psum(q.astype(jnp.int32), axis).astype(
+                jnp.float32) * s
+        return tot / n, v - deq
+
+    flat_g, tree = jax.tree_util.tree_flatten(grads)
+    flat_e = jax.tree_util.tree_leaves(ef)
+    out = [one(g, e) for g, e in zip(flat_g, flat_e)]
+    synced = jax.tree_util.tree_unflatten(tree, [o[0] for o in out])
+    new_ef = jax.tree_util.tree_unflatten(tree, [o[1] for o in out])
+    return synced, new_ef
+
+
+def init_error_feedback(params, mesh: Mesh, axis: str = "dp"):
+    """Per-replica residual buffers: zeros with a leading ``axis`` dim,
+    sharded over it (each replica owns its own residual)."""
+    dp = dict(mesh.shape).get(axis, 1)
+
+    def zeros(p):
+        z = jnp.zeros((dp,) + p.shape, jnp.float32)
+        return jax.device_put(z, NamedSharding(mesh, P(axis)))
+
+    return jax.tree_util.tree_map(zeros, params)
+
+
+def build_compressed_dp_step(loss_fn: Callable, optimizer, mesh: Mesh,
+                             method: Optional[str], axis: str = "dp",
+                             donate: bool = True):
+    """One jitted dp train step whose gradient exchange is compressed.
+
+    ``loss_fn(params, batch) -> scalar`` is the per-replica loss on the
+    replica's batch shard (batch leading dim splits over ``axis``).
+    Returns ``step(params, opt_state, ef, batch) ->
+    (params, opt_state, ef, loss)``; build ``ef`` with
+    :func:`init_error_feedback` (pass ``()`` when ``method`` is None).
+
+    ``method=None`` keeps the identical shard_map structure with a plain
+    fp32 pmean — toggling compression on/off changes ONLY the wire
+    format, never the batch-splitting or loss/grad semantics.
+
+    ≙ dgc_optimizer.py's minimize(): grads compress before the dp
+    all-reduce, the residual feeds back, the inner optimizer sees the
+    dequantized mean.
+    """
+    if method is not None:
+        _check_method(method)
+
+    def per_replica(params, ef, batch):
+        loss, g = jax.value_and_grad(loss_fn)(params, batch)
+        if method is None:
+            g = jax.tree_util.tree_map(
+                lambda x: lax.pmean(x.astype(jnp.float32), axis), g)
+        else:
+            # ef arrives (1, *shape) — this replica's slice
+            e = jax.tree_util.tree_map(lambda x: x[0], ef)
+            g, e = compressed_psum_mean(g, e, axis, method)
+            ef = jax.tree_util.tree_map(lambda x: x[None], e)
+        loss = lax.pmean(loss, axis)
+        return loss, g, ef
+
+    smapped = shard_map(
+        per_replica, mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=(P(), P(), P(axis)),
+        check_vma=False)
+
+    def step(params, opt_state, ef, batch):
+        loss, g, ef = smapped(params, ef, batch)
+        new_p, new_s = optimizer.update(g, opt_state, params)
+        return new_p, new_s, ef, loss
+
+    kw = {"donate_argnums": (0, 1, 2)} if donate else {}
+    return jax.jit(step, **kw)

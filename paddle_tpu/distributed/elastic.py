@@ -93,6 +93,8 @@ class ElasticManager:
                     dead.append(r)
             fresh = [r for r in dead if r not in self._reported]
             if fresh and self.on_change is not None:
+                from paddle_tpu import stats
+                stats.add("elastic/peers_lost", len(fresh))  # §5.5
                 self._reported.update(fresh)
                 self.on_change(sorted(fresh))
             self._stop.wait(self.interval)

@@ -53,6 +53,10 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1}
         self.find_unused_parameters = False
+        # "bf16" | "int8" | None — compress the dp gradient exchange with
+        # error feedback (≙ meta_optimizers/dgc_optimizer.py; see
+        # distributed/compression.py for when to use)
+        self.grad_compression = None
 
     def __repr__(self):
         return (f"DistributedStrategy(hybrid={dict(self.hybrid_configs)}, "
@@ -226,6 +230,29 @@ def distributed_optimizer(optimizer, strategy=None):
     _require_init()
     return _FleetOptimizer(optimizer, strategy or _strategy
                            or DistributedStrategy())
+
+
+def build_dp_train_step(loss_fn, optimizer, strategy=None):
+    """Data-parallel train step honoring ``strategy.grad_compression``:
+    the dp gradient exchange runs through the compressed channel with
+    error feedback (distributed/compression.py) when set, plain GSPMD
+    psum otherwise. Signature either way:
+    ``step(params, opt_state, ef, batch) -> (params, opt_state, ef,
+    loss)`` — build ``ef`` with ``compression.init_error_feedback`` when
+    compression is on, pass ``()`` otherwise.
+
+    ``loss_fn(params, batch) -> scalar`` per-replica; batch dim 0 splits
+    over dp. ≙ dgc_optimizer.minimize wiring under fleet.
+    """
+    topo = _require_init()
+    strat = strategy or _strategy or DistributedStrategy()
+    # the _FleetOptimizer wrapper stays in the loop (its update() carries
+    # the strategy's gradient-merge slots), and BOTH settings build the
+    # same shard_map step — method=None is a plain fp32 pmean, so
+    # toggling compression changes only the wire format
+    from paddle_tpu.distributed.compression import build_compressed_dp_step
+    return build_compressed_dp_step(loss_fn, optimizer, topo.mesh,
+                                    strat.grad_compression)
 
 
 # -- worker queries (≙ Fleet.worker_index:454 etc.) --------------------------

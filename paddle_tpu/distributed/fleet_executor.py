@@ -38,10 +38,13 @@ pyramid of live activations, which is where fthenb piles them up.)
 import io
 import queue
 import threading
+import time
 from typing import Callable, List, Optional, Sequence, Union
 
 import numpy as np
 import jax
+
+from paddle_tpu import stats
 
 __all__ = ["FleetExecutor", "rendezvous_endpoints"]
 
@@ -143,8 +146,12 @@ class FleetExecutor:
             stage, kind, chunk, mb, step, value = item
             try:
                 host, port = self.peers[stage]
+                payload = _pack(jax.device_get(value))
                 self.endpoint.send(host, port, _tag(kind, step, chunk, mb),
-                                   _pack(jax.device_get(value)))
+                                   payload)
+                # §5.5 observability (≙ platform/monitor.h STAT_ADD)
+                stats.add("fleet_executor/send_msgs")
+                stats.add("fleet_executor/send_bytes", len(payload))
             except BaseException as e:  # surfaced at the next flush
                 self._send_err.append(e)
             finally:
@@ -170,12 +177,18 @@ class FleetExecutor:
         # a failed async send (peer died) would otherwise surface as an
         # unrelated recv timeout — check before blocking and on timeout
         self._raise_send_err()
+        t0 = time.perf_counter()
         try:
             payload = self.endpoint.recv(
                 _tag(kind, self._step, chunk, mb), self.timeout)
         except TimeoutError:
             self._raise_send_err()
             raise
+        # per-microbatch boundary wait + volume (§5.5 observability)
+        stats.default_registry().record_time(
+            "fleet_executor/recv_wait", time.perf_counter() - t0)
+        stats.add("fleet_executor/recv_msgs")
+        stats.add("fleet_executor/recv_bytes", len(payload))
         return _unpack(payload)
 
     def close(self):
@@ -208,6 +221,7 @@ class FleetExecutor:
         last_chunk_is_loss = self.is_last  # chunk V-1 on the last rank
 
         def fwd(mb, v=0):
+            stats.add("fleet_executor/microbatch_fwd")
             g = v * S + r
             if g == 0:
                 x = microbatches[mb]
@@ -229,6 +243,7 @@ class FleetExecutor:
                 self._send(0, _FWD, mb, y, chunk=v + 1)
 
         def bwd(mb, v=0):
+            stats.add("fleet_executor/microbatch_bwd")
             vjp_fn = saved.pop((v, mb))
             if last_chunk_is_loss and v == V - 1:
                 cot = np.float32(1.0)

@@ -220,6 +220,8 @@ def _launch_elastic(args):
                     # ask the cluster to re-form and try the next round
                     join_attempts += 1
                     if join_attempts <= 3:
+                        from paddle_tpu import stats
+                        stats.add("launch/join_requests")
                         print(f"[launch] node {args.node_rank} joining: "
                               f"requesting re-form after round {version}",
                               file=sys.stderr)
@@ -246,6 +248,11 @@ def _launch_elastic(args):
                     continue
                 return 0
             start, n = table[args.node_rank]
+            from paddle_tpu import stats
+            stats.add("launch/rounds")       # round 1 = form, 2+ = re-forms
+            stats.add("launch/reforms", 1 if stats.get("launch/rounds") > 1
+                      else 0)
+            stats.set_value("launch/world_size", world)
             print(f"[launch] elastic round {version}: world={world} "
                   f"local={n} start_rank={start}", file=sys.stderr)
             procs = [_spawn(args, i, rank=start + i, world=world,

@@ -129,10 +129,14 @@ def _next_send_seq(st, dst):
 
 def send(tensor, dst: int, _seq=None):
     """ref: paddle.distributed.send — blocking eager send to rank dst."""
+    from paddle_tpu import stats
     st = _require()
     seq = _next_send_seq(st, dst) if _seq is None else _seq
     h, p = st.peers[dst]
-    st.endpoint.send(h, p, _tag(st.rank, dst, seq), _pack(tensor))
+    payload = _pack(tensor)
+    st.endpoint.send(h, p, _tag(st.rank, dst, seq), payload)
+    stats.add("p2p/send_msgs")              # §5.5 (≙ monitor.h STAT_ADD)
+    stats.add("p2p/send_bytes", len(payload))
 
 
 def recv(tensor=None, src: int = 0, timeout: float = 120.0):
@@ -152,7 +156,12 @@ def recv(tensor=None, src: int = 0, timeout: float = 120.0):
         with _lock:
             if st.recv_seq.get(src) == seq:
                 st.recv_seq[src] = seq - 1
+        from paddle_tpu import stats
+        stats.add("p2p/recv_timeouts")
         raise
+    from paddle_tpu import stats
+    stats.add("p2p/recv_msgs")
+    stats.add("p2p/recv_bytes", len(payload))
     out = _unpack(payload)
     if tensor is not None and isinstance(tensor, np.ndarray):
         tensor[...] = out

@@ -184,15 +184,25 @@ class PSClient:
                 rpc.rpc_sync(s, init_server_tables, args=(sub,))
 
     def pull_dense(self, name):
-        return rpc.rpc_sync(self._dense_home(name), _pull_dense,
-                            args=(name,))
+        from paddle_tpu import stats
+        out = rpc.rpc_sync(self._dense_home(name), _pull_dense,
+                           args=(name,))
+        stats.add("ps/pulls")                  # §5.5 (≙ monitor.h)
+        stats.add("ps/pull_bytes", np.asarray(out).nbytes)
+        return out
 
     def push_dense(self, name, grad, block=True):
+        from paddle_tpu import stats
+        grad = np.asarray(grad)
+        stats.add("ps/pushes")
+        stats.add("ps/push_bytes", grad.nbytes)
         fut = rpc.rpc_async(self._dense_home(name), _push_dense,
-                            args=(name, np.asarray(grad)))
+                            args=(name, grad))
         return fut.wait() if block else fut
 
     def pull_sparse(self, name, ids):
+        from paddle_tpu import stats
+        stats.add("ps/pulls")
         ids = np.asarray(ids, np.int64)
         n = len(self.servers)
         out = np.empty((len(ids), 0), np.float32)
@@ -209,11 +219,16 @@ class PSClient:
             if rows is None:
                 rows = np.zeros((len(ids), got.shape[1]), np.float32)
             rows[mask] = got
+        if rows is not None:
+            stats.add("ps/pull_bytes", rows.nbytes)
         return rows
 
     def push_sparse(self, name, ids, grads, block=True):
+        from paddle_tpu import stats
+        stats.add("ps/pushes")
         ids = np.asarray(ids, np.int64)
         grads = np.asarray(grads, np.float32)
+        stats.add("ps/push_bytes", grads.nbytes)
         n = len(self.servers)
         futs = []
         for s_idx in range(n):

@@ -100,6 +100,9 @@ define_flag("use_pallas_kernels", True,
             "Use Pallas TPU kernels for fused ops (flash attention etc.) "
             "when running on TPU; falls back to XLA-fused reference impls.")
 define_flag("log_level", "warning", "Framework log level.")
+define_flag("stats_at_exit", False,
+            "Dump the StatRegistry table to stderr at process exit "
+            "(operator scrape path for launch/elastic CLI processes).")
 define_flag("allocator_strategy", "xla",
             "Kept for API parity (ref auto_growth/naive_best_fit); on TPU the "
             "XLA/PJRT runtime owns HBM allocation.")

@@ -57,9 +57,30 @@ class TranslatedLayer:
         return self._exported.mlir_module()
 
 
+# .ptexport format version (VERDICT r4 item 10; ≙ the reference's per-op
+# semantic versions, paddle/fluid/framework/op_version_registry.h:397 +
+# phi/api/yaml/op_version.yaml — old programs must load correctly or fail
+# loudly, never silently misbehave). Bump when the bundle layout or the
+# semantics of exported programs change; widen MIN_READABLE only with a
+# migration path.
+FORMAT_VERSION = 1
+MIN_READABLE_FORMAT = 1
+
+
+def _op_registry_hash() -> str:
+    """Fingerprint of the op registry the artifact was exported under —
+    diagnostic provenance for drift reports (not a load gate: StableHLO
+    programs carry their own ops)."""
+    import hashlib
+    from paddle_tpu.ops.registry import all_ops
+    names = ",".join(sorted(spec.name for spec in all_ops()))
+    return hashlib.md5(names.encode()).hexdigest()[:16]
+
+
 def save(layer, path, input_spec=None, **configs):
     """Export a function/Module to .ptexport (serialized StableHLO +
-    metadata). ref: paddle.jit.save → __model__ + params files."""
+    {format_version, package_version, op-registry hash} metadata).
+    ref: paddle.jit.save → __model__ + params files."""
     from jax import export as jax_export
     from paddle_tpu.static import InputSpec
 
@@ -86,8 +107,12 @@ def save(layer, path, input_spec=None, **configs):
     exported = jax_export.export(jax.jit(fn))(*structs)
     blob = exported.serialize()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from paddle_tpu.version import __version__ as pkg_version
     with open(path + ".ptexport", "wb") as f:
-        pickle.dump({"stablehlo": bytes(blob)}, f)
+        pickle.dump({"stablehlo": bytes(blob),
+                     "format_version": FORMAT_VERSION,
+                     "package_version": pkg_version,
+                     "op_registry_hash": _op_registry_hash()}, f)
     # params saved separately when layer is a Module
     if hasattr(layer, "state_dict"):
         from paddle_tpu.framework.io import save as obj_save
@@ -96,10 +121,39 @@ def save(layer, path, input_spec=None, **configs):
 
 
 def load(path, **configs):
+    """Load a .ptexport bundle, gating on its format version: an artifact
+    outside [MIN_READABLE_FORMAT, FORMAT_VERSION] fails with a clear
+    error instead of deserializing a layout this build cannot interpret
+    (≙ op_version_registry.h:397's load-time version checks)."""
+    import warnings
     from jax import export as jax_export
     p = path if path.endswith(".ptexport") else path + ".ptexport"
     with open(p, "rb") as f:
         data = pickle.load(f)
+    if "format_version" not in data:
+        # pre-versioning bundles have the identical {"stablehlo": ...}
+        # layout — load them, but flag the missing provenance
+        warnings.warn(
+            f"{p} predates .ptexport version stamping (no format_version)"
+            "; loading as legacy — re-export to stamp provenance",
+            stacklevel=2)
+    else:
+        fmt = data["format_version"]
+        if not (MIN_READABLE_FORMAT <= fmt <= FORMAT_VERSION):
+            raise ValueError(
+                f"{p} has .ptexport format version {fmt} (saved by "
+                f"paddle_tpu {data.get('package_version', '<unknown>')});"
+                f" this build reads versions {MIN_READABLE_FORMAT}.."
+                f"{FORMAT_VERSION} — re-export the artifact")
+    saved_hash = data.get("op_registry_hash")
+    if saved_hash:
+        current = _op_registry_hash()
+        if saved_hash != current:
+            warnings.warn(
+                f"{p} was exported under a different op registry "
+                f"({saved_hash} vs {current}); the StableHLO program is "
+                "self-contained, but re-export to refresh provenance",
+                stacklevel=2)
     exported = jax_export.deserialize(bytearray(data["stablehlo"]))
     return TranslatedLayer(exported)
 

@@ -160,3 +160,26 @@ def table() -> str:
 
 def reset(prefix: Optional[str] = None):
     _DEFAULT.reset(prefix)
+
+
+def _dump_at_exit():
+    """Flag-gated exit dump (PT_FLAGS_STATS_AT_EXIT=1): lets operators
+    scrape the counters of short-lived CLI processes — launch agents,
+    elastic workers — the way the reference's monitor stats are dumped
+    by tools (platform/monitor.h:35-139, §5.5)."""
+    import sys
+    try:
+        from paddle_tpu import flags as _flags
+        if not _flags.get_flag("stats_at_exit"):
+            return
+    except Exception:
+        return
+    snap = _DEFAULT.snapshot()
+    if snap:
+        print("[paddle_tpu.stats]\n" + _DEFAULT.table(), file=sys.stderr,
+              flush=True)
+
+
+import atexit as _atexit  # noqa: E402
+
+_atexit.register(_dump_at_exit)

@@ -280,6 +280,24 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
     return jax.vmap(one_roi)(boxes, img_idx)
 
 
+def matrix_nms_decay(iou, same_cls, use_gaussian=False,
+                     gaussian_sigma=2.0):
+    """Matrix-NMS score-decay factors (SOLOv2 eq. 5) — the ONE definition
+    shared by `matrix_nms` and PP-YOLOE's jit decode. Inputs are sorted
+    by descending score; box j decays box i iff j < i and same class.
+    ``comp[j]`` compensates for how suppressed the decayer j itself is."""
+    n = iou.shape[0]
+    lower = jnp.tril(jnp.ones((n, n), bool), k=-1)   # j < i: j decays i
+    decay_iou = jnp.where(same_cls & lower, iou, 0.0)
+    comp_iou = jnp.max(decay_iou, axis=1)[None, :]
+    if use_gaussian:
+        decay = jnp.exp(-(decay_iou ** 2 - comp_iou ** 2) / gaussian_sigma)
+    else:
+        decay = (1.0 - decay_iou) / jnp.maximum(1.0 - comp_iou, 1e-9)
+    decay = jnp.where(same_cls & lower, decay, 1.0)
+    return jnp.min(decay, axis=1)
+
+
 def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                nms_top_k=400, keep_top_k=200, use_gaussian=False,
                gaussian_sigma=2.0, background_label=0, normalized=True):
@@ -303,18 +321,8 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
     sel_boxes = boxes[sel_box]
     iou = _iou_matrix(sel_boxes, sel_boxes)
     same_cls = sel_cls[:, None] == sel_cls[None, :]
-    upper = jnp.triu(jnp.ones((top, top), bool), k=1)  # j decays i iff j<i
-    decay_iou = jnp.where(same_cls & upper.T, iou, 0.0)
-    # compensation: how suppressed the DECAYER j itself already is —
-    # comp[j] = max IoU of j with its own higher-scored boxes, broadcast
-    # along each row's j axis (SOLOv2 eq. 5)
-    comp_iou = jnp.max(decay_iou, axis=1)[None, :]
-    if use_gaussian:
-        decay = jnp.exp(-(decay_iou ** 2 - comp_iou ** 2) / gaussian_sigma)
-    else:
-        decay = (1.0 - decay_iou) / jnp.maximum(1.0 - comp_iou, 1e-9)
-    decay = jnp.where(same_cls & upper.T, decay, 1.0)
-    final = sel_scores * jnp.min(decay, axis=1)
+    final = sel_scores * matrix_nms_decay(iou, same_cls, use_gaussian,
+                                          gaussian_sigma)
     keep = final > post_threshold
     order2 = jnp.argsort(-jnp.where(keep, final, -1.0))[:keep_top_k]
     out = jnp.concatenate(

@@ -91,6 +91,18 @@ def worker(stage, store_port, schedule, tmpdir):
             out["loss"] = np.float32(loss)
         np.savez(os.path.join(tmpdir, f"stage{stage}_step{step}.npz"),
                  **out)
+    # distributed-layer observability (VERDICT r4 item 8): the runtime
+    # must have recorded its traffic
+    from paddle_tpu import stats
+    assert stats.get("fleet_executor/microbatch_fwd") >= 2 * N_MICRO
+    assert stats.get("fleet_executor/microbatch_bwd") >= 2 * N_MICRO
+    if stage < 2:
+        assert stats.get("fleet_executor/send_msgs") > 0
+        assert stats.get("fleet_executor/send_bytes") > 0
+    if stage > 0:
+        assert stats.get("fleet_executor/recv_msgs") > 0
+        assert stats.snapshot().get(
+            "fleet_executor/recv_wait.count", 0) > 0
     ep.close()
     store.close()
 

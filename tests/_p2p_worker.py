@@ -30,5 +30,9 @@ def worker(rank, port, tmpdir):
     objs = []
     p2p.all_gather_object(objs, {"rank": rank, "sq": rank * rank})
     assert objs == [{"rank": 0, "sq": 0}, {"rank": 1, "sq": 1}], objs
+    from paddle_tpu import stats
+    assert stats.get("p2p/send_msgs") > 0
+    assert stats.get("p2p/send_bytes") > 0
+    assert stats.get("p2p/recv_msgs") > 0
     p2p.destroy_process_group()
     open(os.path.join(tmpdir, f"ok{rank}"), "w").close()

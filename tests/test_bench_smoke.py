@@ -56,6 +56,8 @@ def test_bench_ppyoloe_smoke():
     out = bench.bench_ppyoloe(jax, jnp, PEAK, smoke=True)
     assert out["ppyoloe_s_imgs_per_sec"] > 0
     assert out["ppyoloe_s_batch"] == 2
+    # the one-program eval path (forward + jit matrix-NMS) must run clean
+    assert out.get("ppyoloe_s_eval_imgs_per_sec", 0) > 0, out
 
 
 def test_bench_pp_smoke():

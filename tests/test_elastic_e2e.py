@@ -7,6 +7,7 @@ Reference analog: fleet/elastic/manager.py:128 (etcd membership watch +
 relaunch) and launch/controllers/master.py:66 — driven through real
 subprocesses like the reference's elastic CLI tests."""
 
+import re
 import json
 import os
 import subprocess
@@ -99,13 +100,22 @@ open(done_file, "w").close()
 def test_kill_worker_reform_smaller_resume(tmp_path):
     script = tmp_path / "train.py"
     script.write_text(textwrap.dedent(SCRIPT.format(workdir=str(tmp_path))))
-    env = dict(os.environ, PYTHONPATH=REPO)
+    env = dict(os.environ, PYTHONPATH=REPO,
+               PT_FLAGS_STATS_AT_EXIT="1")
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "4", "--master", "127.0.0.1:7811",
          "--elastic", "--max_restarts", "2", str(script)],
         env=env, capture_output=True, text=True, timeout=420)
     assert r.returncode == 0, (r.returncode, r.stderr[-3000:])
+
+    # §5.5 observability: the launcher's exit dump must carry the re-form
+    # counters (VERDICT r4 item 8; ≙ platform/monitor.h scrape)
+    assert "[paddle_tpu.stats]" in r.stderr, r.stderr[-2000:]
+    m = re.search(r"launch/reforms\s+(\d+)", r.stderr)
+    assert m and int(m.group(1)) >= 1, r.stderr[-2000:]
+    m = re.search(r"launch/rounds\s+(\d+)", r.stderr)
+    assert m and int(m.group(1)) >= 2, r.stderr[-2000:]
 
     log = [json.loads(line) for line in
            (tmp_path / "loss_log.jsonl").read_text().splitlines()]

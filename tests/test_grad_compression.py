@@ -1,0 +1,124 @@
+"""Gradient-compressed data parallelism (VERDICT r4 item 9)
+≙ fleet/meta_optimizers/dgc_optimizer.py + dgc_op.cc: the dp gradient
+exchange narrows to bf16/int8 with error feedback; convergence must stay
+at parity with full-precision sync on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.compression import (
+    build_compressed_dp_step, compressed_psum_mean, init_error_feedback)
+from paddle_tpu import optimizer as optim
+
+
+def _problem(seed=0):
+    """Tiny least-squares: params w (8, 4); batch (B, 8) -> targets (B, 4)
+    from a fixed true w — loss is exactly minimizable, so convergence
+    differences show."""
+    rs = np.random.RandomState(seed)
+    w_true = rs.randn(8, 4).astype(np.float32)
+    x = rs.randn(64, 8).astype(np.float32)
+    y = x @ w_true + 0.01 * rs.randn(64, 4).astype(np.float32)
+    params = {"w": jnp.zeros((8, 4), jnp.float32)}
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        pred = xb @ p["w"]
+        return jnp.mean((pred - yb) ** 2)
+
+    return params, loss_fn, (jnp.asarray(x), jnp.asarray(y))
+
+
+def _run(method, steps=60, lr=0.1, seed=0):
+    topo = dist.init_mesh(dp=8)
+    try:
+        params, loss_fn, batch = _problem(seed)
+        opt = optim.SGD(learning_rate=lr)
+        opt_state = opt.init(params)
+        if method is None:
+            strat = fleet.DistributedStrategy()
+        else:
+            strat = fleet.DistributedStrategy()
+            strat.grad_compression = method
+        fleet._strategy = strat
+        fleet._topo = topo
+        step = fleet.build_dp_train_step(loss_fn, opt, strategy=strat)
+        ef = init_error_feedback(params, topo.mesh) if method else ()
+        losses = []
+        for _ in range(steps):
+            params, opt_state, ef, loss = step(params, opt_state, ef,
+                                               batch)
+            losses.append(float(loss))
+        return losses
+    finally:
+        from paddle_tpu.distributed import mesh as mesh_lib
+        mesh_lib.set_topology(None)
+        fleet._strategy = None
+        fleet._topo = None
+
+
+def test_channel_is_lossy_but_error_feedback_preserves_sum():
+    """The int8 channel alone loses information; with error feedback the
+    CUMULATIVE dequantized signal tracks the cumulative true signal (the
+    DGC residual-accumulation property)."""
+    topo = dist.init_mesh(dp=8)
+    try:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        rs = np.random.RandomState(0)
+        gs = jnp.asarray(rs.randn(30, 8, 16, 8).astype(np.float32)) * 0.1
+
+        def sync(g, e):
+            out, new_e = compressed_psum_mean(
+                {"w": g[0]}, {"w": e[0]}, "dp", "int8")
+            return out["w"], new_e["w"][None]
+
+        smap = shard_map(sync, mesh=topo.mesh,
+                         in_specs=(P("dp"), P("dp")), out_specs=(P(), P("dp")),
+                         check_vma=False)
+        ef = jnp.zeros((8, 16, 8))
+        true_cum = np.zeros((16, 8))
+        deq_cum = np.zeros((16, 8))
+        worst_single = 0.0
+        for t in range(30):
+            g = gs[t]
+            synced, ef = jax.jit(smap)(g, ef)
+            true_mean = np.asarray(g).mean(0)
+            worst_single = max(worst_single,
+                               np.abs(np.asarray(synced) - true_mean).max())
+            true_cum += true_mean
+            deq_cum += np.asarray(synced)
+        # single-step error is real (lossy channel)...
+        assert worst_single > 1e-5
+        # ...but the residual feeds back: cumulative error stays bounded
+        # by ~one quantization step instead of growing with t
+        assert np.abs(deq_cum - true_cum).max() < worst_single * 3
+    finally:
+        from paddle_tpu.distributed import mesh as mesh_lib
+        mesh_lib.set_topology(None)
+
+
+@pytest.mark.parametrize("method", ["bf16", "int8"])
+def test_convergence_parity_on_cpu_mesh(method):
+    base = _run(None)
+    comp = _run(method)
+    # both drive the loss down hard
+    assert comp[-1] < 0.05 * comp[0], comp[-1]
+    # and the compressed trajectory lands at parity with full precision
+    assert comp[-1] <= base[-1] * 1.5 + 1e-4, (comp[-1], base[-1])
+
+
+def test_unknown_method_rejected():
+    topo = dist.init_mesh(dp=8)
+    try:
+        params, loss_fn, _ = _problem()
+        with pytest.raises(ValueError):
+            build_compressed_dp_step(loss_fn, optim.SGD(0.1), topo.mesh,
+                                     "fp4")
+    finally:
+        from paddle_tpu.distributed import mesh as mesh_lib
+        mesh_lib.set_topology(None)

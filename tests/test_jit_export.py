@@ -1,0 +1,93 @@
+""".ptexport version stamping (VERDICT r4 item 10)
+≙ paddle/fluid/framework/op_version_registry.h:397 + op_version.yaml:
+artifacts carry {format_version, package_version, op registry hash};
+load gates on the readable range with a clear error."""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import jit as ptjit
+from paddle_tpu.static import InputSpec
+
+
+def _export(tmp_path, name="m"):
+    def fn(x):
+        return jnp.tanh(x) * 2.0 + 1.0
+
+    path = str(tmp_path / name)
+    out = ptjit.save(fn, path,
+                     input_spec=[InputSpec([None, 4], "float32")])
+    return fn, out
+
+
+def test_roundtrip_and_stamp(tmp_path):
+    fn, p = _export(tmp_path)
+    with open(p, "rb") as f:
+        bundle = pickle.load(f)
+    assert bundle["format_version"] == ptjit.FORMAT_VERSION
+    assert bundle["package_version"] == pt.__version__
+    assert len(bundle["op_registry_hash"]) == 16
+
+    loaded = ptjit.load(p)
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(loaded(x)),
+                               np.asarray(fn(jnp.asarray(x))),
+                               rtol=1e-6)
+
+
+def test_unstamped_legacy_artifact_loads_with_warning(tmp_path):
+    """A pre-versioning bundle (no format_version key) has the identical
+    layout — it must LOAD, with a provenance warning, not break users'
+    existing exports."""
+    fn, p = _export(tmp_path)
+    with open(p, "rb") as f:
+        bundle = pickle.load(f)
+    del bundle["format_version"]
+    with open(p, "wb") as f:
+        pickle.dump(bundle, f)
+    with pytest.warns(UserWarning, match="predates"):
+        loaded = ptjit.load(p)
+    assert np.isfinite(
+        np.asarray(loaded(np.ones((2, 4), np.float32)))).all()
+
+
+def test_below_range_format_rejected(tmp_path):
+    """A STAMPED version below the readable floor (a synthetically old
+    artifact) must fail with a clear error naming the range."""
+    fn, p = _export(tmp_path)
+    with open(p, "rb") as f:
+        bundle = pickle.load(f)
+    bundle["format_version"] = ptjit.MIN_READABLE_FORMAT - 1
+    with open(p, "wb") as f:
+        pickle.dump(bundle, f)
+    with pytest.raises(ValueError, match="re-export"):
+        ptjit.load(p)
+
+
+def test_future_format_rejected(tmp_path):
+    fn, p = _export(tmp_path)
+    with open(p, "rb") as f:
+        bundle = pickle.load(f)
+    bundle["format_version"] = ptjit.FORMAT_VERSION + 7
+    bundle["package_version"] = "99.0.0"
+    with open(p, "wb") as f:
+        pickle.dump(bundle, f)
+    with pytest.raises(ValueError, match="99.0.0"):
+        ptjit.load(p)
+
+
+def test_registry_drift_warns_but_loads(tmp_path):
+    fn, p = _export(tmp_path)
+    with open(p, "rb") as f:
+        bundle = pickle.load(f)
+    bundle["op_registry_hash"] = "0" * 16
+    with open(p, "wb") as f:
+        pickle.dump(bundle, f)
+    with pytest.warns(UserWarning, match="different op registry"):
+        loaded = ptjit.load(p)
+    x = np.ones((2, 4), np.float32)
+    assert np.isfinite(np.asarray(loaded(x))).all()
