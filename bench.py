@@ -585,8 +585,49 @@ def bench_decode(jax, jnp, peak, smoke=False):
         res["decode_engine_tokens_per_sec"] = round(tps, 1)
         res["decode_engine_vs_roofline"] = round(tps / roof, 4)
         res["decode_roofline_tokens_per_sec"] = round(roof, 1)
+        # free the baseline engine's stacked weights + KV caches before
+        # the speculative engine allocates its own (at 1.3B a third
+        # weight copy in HBM risks OOM)
+        eng.kc = eng.vc = eng._stacked = None
+        del eng
     except Exception as e:
         res["decode_engine_error"] = str(e)[:160]
+        roof = None
+
+    # speculative decoding on repetition-heavy text (the regime it
+    # serves): lossless greedy, so the only change is steps-per-token.
+    # Own try/except: a spec regression must not erase the baseline
+    # metrics (nor vice versa).
+    try:
+        from paddle_tpu.inference.decode_engine import DecodeEngine
+        k = 4
+        slots, s_pf, n_new2 = (2, 8, 4) if smoke else (8, 128, 128)
+        eng2 = DecodeEngine(model, max_slots=slots,
+                            max_len=s_pf + n_new2 + 128 + k,
+                            speculative_k=k)
+        rs = np.random.RandomState(2)
+        loops = [list(rs.randint(0, cfg.vocab_size, 8)) for _ in
+                 range(slots)]
+        sp_prompts = [(lp * (s_pf // 8 + 1))[:s_pf] for lp in loops]
+        for p in sp_prompts:  # warm
+            eng2.submit(p, max_new_tokens=2)
+        eng2.run()
+        reqs2 = [eng2.submit(p, max_new_tokens=n_new2)
+                 for p in sp_prompts]
+        eng2.step()
+        pre2 = sum(len(r.tokens) for r in reqs2)
+        s0_steps = eng2.steps
+        t0 = time.perf_counter()
+        eng2.run()
+        sdt = time.perf_counter() - t0
+        toks2 = sum(len(r.tokens) for r in reqs2) - pre2
+        res["decode_spec_tokens_per_sec"] = round(toks2 / sdt, 1)
+        res["decode_spec_tokens_per_step"] = round(
+            toks2 / max(1, eng2.steps - s0_steps), 2)
+        if roof:
+            res["decode_spec_vs_roofline"] = round(toks2 / sdt / roof, 4)
+    except Exception as e:
+        res["decode_spec_error"] = str(e)[:160]
     return res
 
 

@@ -26,8 +26,7 @@ collectives are rarely the bottleneck and full-precision sync is the
 default.
 """
 
-import functools
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

@@ -24,6 +24,20 @@ leaves to paddle-serving:
   (deterministic recompute — identical K/V values land in place).
 - **Continuous admission**: new requests join between decode steps —
   nothing waits for a "generation batch" to drain.
+- **Speculative decoding** (``speculative_k > 0``, greedy only): each
+  step verifies K candidate tokens per slot in ONE pass
+  (`GPTBlock.verify_step`), so weights + KV prefix are read once per
+  accepted run instead of once per token — decode can then beat the
+  per-token HBM roofline. Drafts come from prompt-lookup (the last
+  bigram's previous continuation in the slot's own history — no draft
+  model), and the scheme is LOSSLESS: acceptance keeps exactly the
+  greedy stream of the verify pass's own forward math, whatever the
+  acceptance rate. (The verify pass uses the dense einsum attention;
+  the plain K=1 path may use the flash-decode kernel — argmax ties
+  between the two numerics are the only way outputs can differ from a
+  non-speculative engine, the same tolerance the kernel-vs-einsum
+  parity tests already pin.) No reference analog; the reference decodes
+  strictly one token per launch.
 
 HBM note: the engine runs on a scan-stacked copy of the block weights,
 passed to its jitted functions as arguments (never closure constants).
@@ -85,7 +99,8 @@ class DecodeEngine:
                  max_len: Optional[int] = None,
                  buckets: Optional[Sequence[int]] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 top_k: int = 0, seed: int = 0, cache_dtype=None):
+                 top_k: int = 0, seed: int = 0, cache_dtype=None,
+                 speculative_k: int = 0):
         cfg = model.cfg
         if any(model.blocks[i].moe is not None
                for i in range(cfg.n_layers)):
@@ -131,20 +146,34 @@ class DecodeEngine:
         self._slot_req: List[Optional[Request]] = [None] * self.S
         self._waiting: collections.deque = collections.deque()
 
+        self.spec_k = int(speculative_k)
+        if self.spec_k:
+            if self.spec_k < 2:
+                raise ValueError("speculative_k must be >= 2 (one input "
+                                 "token + at least one candidate)")
+            if temperature != 0.0:
+                raise NotImplementedError(
+                    "speculative decoding is greedy-only (lossless "
+                    "acceptance needs argmax determinism)")
+        self.steps = 0          # device round-trips (the spec-decode win)
+        self.tokens_emitted = 0
+
         # caches donated: the engine rebinds them every call, and donation
         # lets XLA update the multi-GB buffers in place
         self._step_fn = jax.jit(self._step_impl, donate_argnums=(2, 3))
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=(2, 3))
+        self._verify_fn = jax.jit(self._verify_impl,
+                                  donate_argnums=(2, 3))
 
     # -- jitted bodies ------------------------------------------------------
 
     def _lm_head(self, head, x):
-        """Final LN + (tied) LM projection on (S, 1, d) → (S, V)."""
+        """Final LN + (tied) LM projection on (S, L, d) → (S, L, V)."""
         x = gpt_lib.final_ln(x, head["lnf_scale"], head["lnf_bias"])
         w = (head["wte"].T if head["lm_head"] is None
              else head["lm_head"])
-        return (x @ w)[:, 0]
+        return x @ w
 
     def _step_impl(self, head, stacked, kc, vc, lengths, last, active, rng):
         temperature, top_p, top_k = self.sample
@@ -157,13 +186,43 @@ class DecodeEngine:
             return x, (k_l, v_l)
 
         x, (kc, vc) = lax.scan(layer, x, (stacked, kc, vc))
-        logits = self._lm_head(head, x)
+        logits = self._lm_head(head, x)[:, 0]
         rng, k = jax.random.split(rng)
         nxt = gpt_lib._sample_token(logits.astype(jnp.float32), k,
                                     temperature, top_p, top_k)
         nxt = jnp.where(active, nxt, last)
         lengths = lengths + active.astype(jnp.int32)
         return kc, vc, lengths, nxt, rng
+
+    def _verify_impl(self, head, stacked, kc, vc, lengths, cand, last,
+                     active):
+        """One speculative step: K candidate tokens per slot through one
+        pass; greedy-accept the longest matching prefix + one correction
+        token (lossless vs plain greedy decode)."""
+        S, K = cand.shape
+        x = (jnp.take(head["wte"], cand, axis=0)
+             + jnp.take(head["wpe"],
+                        lengths[:, None] + jnp.arange(K), axis=0))
+
+        def layer(x, blk_kv):
+            blk, k_l, v_l = blk_kv
+            x, (k_l, v_l) = blk.verify_step(x, (k_l, v_l), lengths)
+            return x, (k_l, v_l)
+
+        x, (kc, vc) = lax.scan(layer, x, (stacked, kc, vc))
+        logits = self._lm_head(head, x).astype(jnp.float32)  # (S, K, V)
+        pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # candidate j (cand[:, j], j>=1) is accepted iff it equals the
+        # model's prediction at the previous position — cumulative
+        match = jnp.cumprod(
+            (cand[:, 1:] == pred[:, :-1]).astype(jnp.int32), axis=1)
+        n_acc = jnp.sum(match, axis=1)                 # 0..K-1
+        n_emit = jnp.where(active, n_acc + 1, 0)
+        last = jnp.where(
+            active, jnp.take_along_axis(pred, n_acc[:, None],
+                                        axis=1)[:, 0], last)
+        lengths = lengths + n_emit
+        return kc, vc, lengths, last, pred, n_emit
 
     def _prefill_impl(self, head, stacked, kc, vc, lengths, last, active,
                       slot, tokens, start, true_total, is_final, rng):
@@ -189,7 +248,7 @@ class DecodeEngine:
         vc = lax.dynamic_update_slice(vc, vcs, (0, slot, 0, 0, 0))
 
         idx = jnp.clip(true_total - 1 - start, 0, bucket - 1)
-        logits = self._lm_head(head, x[:, idx][:, None])
+        logits = self._lm_head(head, x[:, idx][:, None])[:, 0]
         temperature, top_p, top_k = self.sample
         rng, k = jax.random.split(rng)
         nxt = gpt_lib._sample_token(logits.astype(jnp.float32), k,
@@ -211,6 +270,12 @@ class DecodeEngine:
         if len(prompt) + max_new_tokens > self.T:
             raise ValueError(
                 f"{len(prompt)} prompt + {max_new_tokens} new tokens "
+                f"exceed cache length {self.T}")
+        if self.spec_k and (len(prompt) + max_new_tokens
+                            + self.spec_k - 1 > self.T):
+            raise ValueError(
+                f"speculative window: prompt + new + K-1 "
+                f"({len(prompt)}+{max_new_tokens}+{self.spec_k - 1}) "
                 f"exceed cache length {self.T}")
         req = Request(prompt, max_new_tokens, eos_id)
         self._waiting.append(req)
@@ -260,9 +325,23 @@ class DecodeEngine:
             self._slot_req[slot] = None
             self.active = self.active.at[slot].set(False)
 
+    @staticmethod
+    def _draft(history, k):
+        """Prompt-lookup draft: continuation of the most recent earlier
+        occurrence of the trailing bigram (n-gram speculative decoding —
+        no draft model). Returns k-1 candidate tokens (zero-padded)."""
+        out = []
+        if len(history) >= 2:
+            a, b = history[-2], history[-1]
+            for i in range(len(history) - 3, -1, -1):
+                if history[i] == a and history[i + 1] == b:
+                    out = list(history[i + 2:i + 1 + k])
+                    break
+        return (out + [0] * (k - 1))[:k - 1]
+
     def step(self) -> int:
-        """Admit what fits, then advance every active slot one token.
-        Returns the number of tokens emitted."""
+        """Admit what fits, then advance every active slot (one token,
+        or up to K with speculative decoding). Returns tokens emitted."""
         while self._waiting:
             slot = self._free_slot()
             if slot is None:
@@ -272,14 +351,41 @@ class DecodeEngine:
                 if r is not None]
         if not live:
             return 0
-        (self.kc, self.vc, self.lengths, self.last,
-         self._rng) = self._step_fn(
-            self._head, self._stacked, self.kc, self.vc, self.lengths,
-            self.last, self.active, self._rng)
-        emitted = np.asarray(self.last)
+        self.steps += 1
+        if self.spec_k:
+            n = self._spec_step(live)
+        else:
+            (self.kc, self.vc, self.lengths, self.last,
+             self._rng) = self._step_fn(
+                self._head, self._stacked, self.kc, self.vc, self.lengths,
+                self.last, self.active, self._rng)
+            emitted = np.asarray(self.last)
+            for slot, req in live:
+                self._emit(slot, req, int(emitted[slot]))
+            n = len(live)
+        self.tokens_emitted += n
+        return n
+
+    def _spec_step(self, live) -> int:
+        K = self.spec_k
+        cand = np.zeros((self.S, K), np.int32)
+        cand[:, 0] = np.asarray(self.last)
         for slot, req in live:
-            self._emit(slot, req, int(emitted[slot]))
-        return len(live)
+            cand[slot, 1:] = self._draft(req.output, K)
+        (self.kc, self.vc, self.lengths, self.last, pred,
+         n_emit) = self._verify_fn(
+            self._head, self._stacked, self.kc, self.vc, self.lengths,
+            jnp.asarray(cand), self.last, self.active)
+        pred = np.asarray(pred)
+        n_emit = np.asarray(n_emit)
+        total = 0
+        for slot, req in live:
+            for j in range(int(n_emit[slot])):
+                if req.done:
+                    break   # eos/budget hit mid-acceptance: drop the rest
+                self._emit(slot, req, int(pred[slot, j]))
+                total += 1
+        return total
 
     def run(self) -> None:
         """Drain: run steps until every submitted request is done."""

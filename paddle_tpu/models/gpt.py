@@ -183,53 +183,9 @@ class GPTBlock(Module):
         y = (x32 - mu) * lax.rsqrt(var + 1e-5) * scale + bias
         return y.astype(x.dtype)
 
-    def forward_cached(self, x, kv, pos):
-        """Decode/prefill step with a KV cache (≙ the reference's
-        fused_multi_transformer_op.cu decode path — CacheKV write + masked
-        attention over the prefix; here one XLA program, cache threaded
-        functionally).
-
-        x: (B, L, d) new positions [pos, pos+L); kv: (k, v) each
-        (B, H, T, D) head-major preallocated (the flash-decode kernel's
-        layout: a KV block is then a contiguous (block_k, D) tile); pos may
-        be traced. Returns (y, new_kv).
-        """
-        b, L, d = x.shape
-        k_cache, v_cache = kv
-        T = k_cache.shape[2]
-        h = self._ln(x, self.ln1_scale, self.ln1_bias)
-        qkv = h @ self.wqkv
-        if self.bqkv is not None:
-            qkv = qkv + self.bqkv
-        qkv = qkv.reshape(b, L, 3, self.n_heads, self.head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        k_cache = lax.dynamic_update_slice(
-            k_cache, jnp.transpose(k, (0, 2, 1, 3)).astype(k_cache.dtype),
-            (0, 0, pos, 0))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, jnp.transpose(v, (0, 2, 1, 3)).astype(v_cache.dtype),
-            (0, 0, pos, 0))
-        scale = 1.0 / math.sqrt(self.head_dim)
-        if L == 1 and _use_decode_kernel(T):
-            # single-token decode: stream the cache block-wise, skipping
-            # blocks beyond pos (the einsum below reads all T always)
-            from paddle_tpu.ops.pallas.decode_attention import \
-                decode_attention
-            lengths = jnp.broadcast_to(
-                jnp.asarray(pos, jnp.int32) + 1, (b,))
-            attn = decode_attention(
-                q[:, 0].astype(k_cache.dtype), k_cache, v_cache, lengths,
-                scale=scale)
-            attn = attn.astype(x.dtype).reshape(b, 1, d)
-        else:
-            att = jnp.einsum("blhd,bhtd->bhlt", q, k_cache) * scale
-            q_pos = pos + jnp.arange(L)[:, None]
-            k_pos = jnp.arange(T)[None, :]
-            att = jnp.where(k_pos <= q_pos, att.astype(jnp.float32),
-                            -jnp.inf)
-            att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
-            attn = jnp.einsum("bhlt,bhtd->blhd", att,
-                              v_cache).reshape(b, L, d)
+    def _block_tail(self, x, attn):
+        """Post-attention half of the block (out-proj + MLP), shared by
+        every cached-decode variant — ONE definition."""
         o = attn @ self.wo
         if self.bo is not None:
             o = o + self.bo
@@ -243,65 +199,88 @@ class GPTBlock(Module):
             h = h @ self.wdown
             if self.bdown is not None:
                 h = h + self.bdown
-        return x + h, (k_cache, v_cache)
+        return x + h
 
-    def decode_step(self, x, kv, positions):
-        """One-token decode with RAGGED per-row cache positions — the
-        continuous-batching primitive (≙ fused_multi_transformer_op.cu's
-        masked_multihead_attention, which likewise takes a per-sequence
-        ``sequence_lengths`` tensor so in-flight requests of different ages
-        share one batch).
-
-        x: (B, 1, d); kv: head-major (B, H, T, D) pair; positions: (B,)
-        int32 — row b's new token lands at cache position positions[b] and
-        attends to [0, positions[b]]. Returns (y, new_kv).
-        """
-        b, L, d = x.shape
+    def _qkv_write(self, x, kv, positions):
+        """LN1 + fused QKV + per-row cache write at ``positions`` —
+        shared front half of the cached-decode variants.
+        x: (B, K, d) → (q (B,K,H,D), new k/v caches)."""
+        b, K, _ = x.shape
         k_cache, v_cache = kv
-        T = k_cache.shape[2]
         h = self._ln(x, self.ln1_scale, self.ln1_bias)
         qkv = h @ self.wqkv
         if self.bqkv is not None:
             qkv = qkv + self.bqkv
-        qkv = qkv.reshape(b, 3, self.n_heads, self.head_dim)
-        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        qkv = qkv.reshape(b, K, 3, self.n_heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-        def write(cache, new, pos):  # (H, T, D) ← (H, 1, D) at pos
+        def write(cache, new, pos):  # (H, T, D) ← (H, K, D) at pos
             return lax.dynamic_update_slice(cache, new, (0, pos, 0))
 
         k_cache = jax.vmap(write)(
-            k_cache, k[:, :, None, :].astype(k_cache.dtype), positions)
+            k_cache, jnp.transpose(k, (0, 2, 1, 3)).astype(k_cache.dtype),
+            positions)
         v_cache = jax.vmap(write)(
-            v_cache, v[:, :, None, :].astype(v_cache.dtype), positions)
-        lengths = positions + 1
+            v_cache, jnp.transpose(v, (0, 2, 1, 3)).astype(v_cache.dtype),
+            positions)
+        return q, k_cache, v_cache
+
+    def verify_step(self, x, kv, positions):
+        """K-token decode with RAGGED per-row cache positions.
+
+        K=1 is the continuous-batching step (≙ masked_multihead_attention
+        in fused_multi_transformer_op.cu, which likewise takes a
+        per-sequence ``sequence_lengths`` tensor); K>1 is the
+        speculative-decoding verify primitive: all K candidate tokens of
+        every slot go through ONE pass, so the weights and each slot's
+        KV prefix are read once per K tokens instead of once per token
+        (no reference analog — the reference decodes strictly one token
+        per kernel launch).
+
+        x: (B, K, d) embeddings at positions [positions[b],
+        positions[b]+K); kv: head-major (B, H, T, D). Row (b, j) attends
+        to cache [0, positions[b]+j]. Returns (y, new_kv); the caller
+        treats entries beyond an accepted prefix as garbage (overwritten
+        or masked by `lengths` exactly like padded prefill entries).
+        """
+        b, K, d = x.shape
+        T = kv[0].shape[2]
+        q, k_cache, v_cache = self._qkv_write(x, kv, positions)
         scale = 1.0 / math.sqrt(self.head_dim)
-        if _use_decode_kernel(T):
-            from paddle_tpu.ops.pallas.decode_attention import \
-                decode_attention
-            attn = decode_attention(q.astype(k_cache.dtype), k_cache,
-                                    v_cache, lengths, scale=scale)
-            attn = attn.astype(x.dtype).reshape(b, 1, d)
-        else:
-            att = jnp.einsum("bhd,bhtd->bht", q, k_cache) * scale
-            mask = jnp.arange(T)[None, None, :] < lengths[:, None, None]
-            att = jnp.where(mask, att.astype(jnp.float32), -jnp.inf)
-            att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
-            attn = jnp.einsum("bht,bhtd->bhd", att,
-                              v_cache).reshape(b, 1, d)
-        o = attn @ self.wo
-        if self.bo is not None:
-            o = o + self.bo
-        x = x + o
-        h = self._ln(x, self.ln2_scale, self.ln2_bias)
-        if self.moe is not None:
-            h, _ = self.moe(h, None)
-        else:
-            h = jax.nn.gelu(h @ self.wup + (self.bup if self.bup is not None
-                                            else 0.0))
-            h = h @ self.wdown
-            if self.bdown is not None:
-                h = h + self.bdown
-        return x + h, (k_cache, v_cache)
+        att = jnp.einsum("bkhd,bhtd->bhkt", q, k_cache) * scale
+        q_pos = positions[:, None, None, None] + jnp.arange(K)[None, None,
+                                                               :, None]
+        k_pos = jnp.arange(T)[None, None, None, :]
+        att = jnp.where(k_pos <= q_pos, att.astype(jnp.float32), -jnp.inf)
+        att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhkt,bhtd->bkhd", att, v_cache).reshape(b, K, d)
+        return self._block_tail(x, attn), (k_cache, v_cache)
+
+    def decode_step(self, x, kv, positions):
+        """One-token ragged decode: the Pallas flash-decode kernel when
+        it can engage, else `verify_step` with K=1 (same einsum math —
+        one definition, not a drifted copy)."""
+        if not _use_decode_kernel(kv[0].shape[2]):
+            return self.verify_step(x, kv, positions)
+        b, L, d = x.shape
+        q, k_cache, v_cache = self._qkv_write(x, kv, positions)
+        from paddle_tpu.ops.pallas.decode_attention import decode_attention
+        attn = decode_attention(q[:, 0].astype(k_cache.dtype), k_cache,
+                                v_cache, positions + 1,
+                                scale=1.0 / math.sqrt(self.head_dim))
+        attn = attn.astype(x.dtype).reshape(b, 1, d)
+        return self._block_tail(x, attn), (k_cache, v_cache)
+
+    def forward_cached(self, x, kv, pos):
+        """Decode/prefill step with a KV cache and a SCALAR start
+        position (≙ fused_multi_transformer_op.cu CacheKV write + masked
+        attention over the prefix). x: (B, L, d) at positions
+        [pos, pos+L); delegates to the ragged-position variants."""
+        b = x.shape[0]
+        positions = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+        if x.shape[1] == 1:
+            return self.decode_step(x, kv, positions)
+        return self.verify_step(x, kv, positions)
 
     def forward(self, x, rng_key=None, aux_acc=None):
         b, s, d = x.shape

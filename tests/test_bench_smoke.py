@@ -38,6 +38,9 @@ def test_bench_decode_smoke():
     # the continuous-batching engine path must run clean in smoke mode
     assert "decode_engine_tokens_per_sec" in out, out
     assert out.get("decode_engine_vs_roofline", 0) > 0, out
+    # ...and so must the speculative path (its own try/except means a
+    # regression would otherwise vanish silently)
+    assert out.get("decode_spec_tokens_per_step", 0) > 0, out
 
 
 def test_bench_bert_smoke():
