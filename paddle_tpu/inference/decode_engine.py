@@ -135,7 +135,8 @@ class DecodeEngine:
             *[model.blocks[i] for i in range(cfg.n_layers)])
 
         dt = cache_dtype or cfg.dtype
-        shape = (cfg.n_layers, self.S, cfg.n_heads, self.T, cfg.head_dim)
+        shape = (cfg.n_layers, self.S, cfg.kv_heads, self.T,
+                 cfg.head_dim)
         self.kc = jnp.zeros(shape, dt)
         self.vc = jnp.zeros(shape, dt)
         self.lengths = jnp.zeros((self.S,), jnp.int32)
@@ -177,8 +178,10 @@ class DecodeEngine:
 
     def _step_impl(self, head, stacked, kc, vc, lengths, last, active, rng):
         temperature, top_p, top_k = self.sample
-        x = (jnp.take(head["wte"], last, axis=0)
-             + jnp.take(head["wpe"], lengths, axis=0))[:, None, :]
+        x = jnp.take(head["wte"], last, axis=0)
+        if head["wpe"] is not None:   # rope models position in attention
+            x = x + jnp.take(head["wpe"], lengths, axis=0)
+        x = x[:, None, :]
 
         def layer(x, blk_kv):
             blk, k_l, v_l = blk_kv
@@ -200,9 +203,10 @@ class DecodeEngine:
         pass; greedy-accept the longest matching prefix + one correction
         token (lossless vs plain greedy decode)."""
         S, K = cand.shape
-        x = (jnp.take(head["wte"], cand, axis=0)
-             + jnp.take(head["wpe"],
-                        lengths[:, None] + jnp.arange(K), axis=0))
+        x = jnp.take(head["wte"], cand, axis=0)
+        if head["wpe"] is not None:
+            x = x + jnp.take(head["wpe"],
+                             lengths[:, None] + jnp.arange(K), axis=0)
 
         def layer(x, blk_kv):
             blk, k_l, v_l = blk_kv
@@ -231,12 +235,13 @@ class DecodeEngine:
         slot. `tokens` is (1, bucket) — one compile per bucket size."""
         cfg = self.cfg
         L, bucket = cfg.n_layers, tokens.shape[1]
-        sl = (L, 1, cfg.n_heads, self.T, cfg.head_dim)
+        sl = (L, 1, cfg.kv_heads, self.T, cfg.head_dim)
         kcs = lax.dynamic_slice(kc, (0, slot, 0, 0, 0), sl)
         vcs = lax.dynamic_slice(vc, (0, slot, 0, 0, 0), sl)
 
-        x = (jnp.take(head["wte"], tokens, axis=0)
-             + lax.dynamic_slice_in_dim(head["wpe"], start, bucket))
+        x = jnp.take(head["wte"], tokens, axis=0)
+        if head["wpe"] is not None:
+            x = x + lax.dynamic_slice_in_dim(head["wpe"], start, bucket)
 
         def layer(x, blk_kv):
             blk, k_l, v_l = blk_kv
@@ -409,6 +414,7 @@ def decode_roofline_tokens_per_sec(cfg, batch: int, context: int,
     against (VERDICT r4: r02 decode sat at ~43% of this bound).
     """
     n = cfg.num_params()
-    kv = 2 * cfg.n_layers * cfg.n_heads * cfg.head_dim * context
+    kv_heads = getattr(cfg, "kv_heads", cfg.n_heads)  # GQA shrinks this
+    kv = 2 * cfg.n_layers * kv_heads * cfg.head_dim * context
     step_bytes = n * weight_bytes + batch * kv * cache_bytes
     return batch * hbm_gbps * 1e9 / step_bytes

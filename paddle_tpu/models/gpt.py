@@ -60,10 +60,25 @@ class GPTConfig:
     moe_every: int = 2
     moe_aux_weight: float = 0.01
     moe_gate: str = "gshard"
+    # GQA/MQA: fewer KV heads than query heads — the KV cache (and the
+    # decode HBM roofline) shrinks by n_heads/n_kv_heads. None = MHA.
+    n_kv_heads: Optional[int] = None
+    # Rotary position embeddings (Llama-family positions) instead of the
+    # learned wpe table; max_seq_len still caps the cache length.
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     @property
     def head_dim(self):
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self):
+        kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % kv:
+            raise ValueError(
+                f"n_heads {self.n_heads} not divisible by n_kv_heads {kv}")
+        return kv
 
     @property
     def d_ffn(self):
@@ -77,12 +92,18 @@ class GPTConfig:
 
     def num_params(self, non_embedding: bool = False) -> int:
         d, L = self.d_model, self.n_layers
-        per_layer = 4 * d * d + 2 * d * self.d_ffn + 4 * d
+        kv_dim = self.kv_heads * self.head_dim
+        per_layer = (d * (d + 2 * kv_dim)   # wqkv (GQA-sized kv)
+                     + d * d                # wo
+                     + 2 * d * self.d_ffn + 4 * d)
         if self.use_bias:
-            per_layer += 5 * d + self.d_ffn  # bqkv(3d)+bo(d)+bup(ffn)+bdown(d)
+            # bqkv(d+2kv) + bo(d) + bup(ffn) + bdown(d)
+            per_layer += (d + 2 * kv_dim) + 2 * d + self.d_ffn
         n = L * per_layer + 2 * d  # + final ln
         if not non_embedding:
-            n += self.vocab_size * d + self.max_seq_len * d
+            n += self.vocab_size * d
+            if not self.rope:
+                n += self.max_seq_len * d
             if not self.tie_embeddings:
                 n += self.vocab_size * d
         return n
@@ -117,7 +138,13 @@ class GPTBlock(Module):
         super().__init__()
         d, h = cfg.d_model, cfg.n_heads
         self.n_heads = h
+        self.kv_heads = cfg.kv_heads
         self.head_dim = cfg.head_dim
+        self.rope = cfg.rope
+        self.rope_theta = cfg.rope_theta
+        if cfg.rope and cfg.head_dim % 2:
+            raise ValueError("rope needs an even head_dim")
+        kv_dim = self.kv_heads * self.head_dim
         self.dropout = cfg.dropout
         ks = jax.random.split(key, 4)
         std = 0.02
@@ -128,7 +155,7 @@ class GPTBlock(Module):
         self.ln1_bias = Parameter(jnp.zeros((d,), jnp.float32))
         self.ln2_scale = Parameter(jnp.ones((d,), jnp.float32))
         self.ln2_bias = Parameter(jnp.zeros((d,), jnp.float32))
-        self.wqkv = Parameter(_normal(ks[0], (d, 3 * d), std, dt))
+        self.wqkv = Parameter(_normal(ks[0], (d, d + 2 * kv_dim), std, dt))
         self.wo = Parameter(_normal(ks[1], (d, d), resid_std, dt))
         if use_moe:
             from paddle_tpu.incubate.moe import MoELayer
@@ -143,7 +170,7 @@ class GPTBlock(Module):
             self.wdown = Parameter(_normal(ks[3], (cfg.d_ffn, d),
                                            resid_std, dt))
         if cfg.use_bias:
-            self.bqkv = Parameter(jnp.zeros((3 * d,), dt))
+            self.bqkv = Parameter(jnp.zeros((d + 2 * kv_dim,), dt))
             self.bo = Parameter(jnp.zeros((d,), dt))
             if not use_moe:
                 self.bup = Parameter(jnp.zeros((cfg.d_ffn,), dt))
@@ -152,6 +179,38 @@ class GPTBlock(Module):
                 self.bup = self.bdown = None
         else:
             self.bqkv = self.bo = self.bup = self.bdown = None
+
+    def _split_qkv(self, qkv):
+        """(B, L, d+2·kv_dim) fused projection → q (B, L, H, D),
+        k/v (B, L, Hkv, D) — GQA-sized kv."""
+        b, L = qkv.shape[:2]
+        d = self.n_heads * self.head_dim
+        kvd = self.kv_heads * self.head_dim
+        q = qkv[..., :d].reshape(b, L, self.n_heads, self.head_dim)
+        k = qkv[..., d:d + kvd].reshape(b, L, self.kv_heads,
+                                        self.head_dim)
+        v = qkv[..., d + kvd:].reshape(b, L, self.kv_heads, self.head_dim)
+        return q, k, v
+
+    def _apply_rope(self, x, positions):
+        """Rotary embedding on (B, L, Hx, D) at absolute ``positions``
+        (B, L) or (L,) (Llama-family positions; rotate-half convention)."""
+        if not self.rope:
+            return x
+        half = self.head_dim // 2
+        freqs = self.rope_theta ** (
+            -jnp.arange(0, half, dtype=jnp.float32) / half)
+        pos = jnp.asarray(positions, jnp.float32)
+        ang = pos[..., None] * freqs               # (..., L, half)
+        while ang.ndim < 3:
+            ang = ang[None]
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
+        x32 = x.astype(jnp.float32)
+        x1, x2 = x32[..., :half], x32[..., half:]
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+        return out.astype(x.dtype)
 
     def _attention(self, q, k, v, s):
         """Ring attention over the 'sp' axis when the global mesh shards the
@@ -168,7 +227,8 @@ class GPTBlock(Module):
                      and q.shape[0] % (shape.get("dp", 1)
                                        * shape.get("fsdp", 1)) == 0
                      and self.n_heads % shape.get("tp", 1) == 0)
-        if sp > 1 and not _in_pipeline() and divisible:
+        if (sp > 1 and not _in_pipeline() and divisible
+                and self.kv_heads == self.n_heads):
             from paddle_tpu.distributed.ring_attention import (
                 sequence_parallel_attention)
             return sequence_parallel_attention(q, k, v, mesh, causal=True,
@@ -202,19 +262,22 @@ class GPTBlock(Module):
         return x + h
 
     def _qkv_write(self, x, kv, positions):
-        """LN1 + fused QKV + per-row cache write at ``positions`` —
-        shared front half of the cached-decode variants.
-        x: (B, K, d) → (q (B,K,H,D), new k/v caches)."""
+        """LN1 + fused QKV (+ rope) + per-row cache write at
+        ``positions`` — shared front half of the cached-decode variants.
+        x: (B, K, d) → (q (B,K,H,D), new k/v caches (B,Hkv,T,D))."""
         b, K, _ = x.shape
         k_cache, v_cache = kv
         h = self._ln(x, self.ln1_scale, self.ln1_bias)
         qkv = h @ self.wqkv
         if self.bqkv is not None:
             qkv = qkv + self.bqkv
-        qkv = qkv.reshape(b, K, 3, self.n_heads, self.head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, k, v = self._split_qkv(qkv)
+        if self.rope:
+            pos2 = positions[:, None] + jnp.arange(K)[None, :]
+            q = self._apply_rope(q, pos2)
+            k = self._apply_rope(k, pos2)
 
-        def write(cache, new, pos):  # (H, T, D) ← (H, K, D) at pos
+        def write(cache, new, pos):  # (Hkv, T, D) ← (Hkv, K, D) at pos
             return lax.dynamic_update_slice(cache, new, (0, pos, 0))
 
         k_cache = jax.vmap(write)(
@@ -247,13 +310,19 @@ class GPTBlock(Module):
         T = kv[0].shape[2]
         q, k_cache, v_cache = self._qkv_write(x, kv, positions)
         scale = 1.0 / math.sqrt(self.head_dim)
-        att = jnp.einsum("bkhd,bhtd->bhkt", q, k_cache) * scale
-        q_pos = positions[:, None, None, None] + jnp.arange(K)[None, None,
-                                                               :, None]
-        k_pos = jnp.arange(T)[None, None, None, :]
+        # GQA via grouped einsum against the UN-expanded cache (query
+        # head h reads kv head h // group — same convention as the
+        # flash-decode kernel); never jnp.repeat the cache in HBM
+        group = self.n_heads // self.kv_heads
+        qg = q.reshape(b, K, self.kv_heads, group, self.head_dim)
+        att = jnp.einsum("bkhgd,bhtd->bhgkt", qg, k_cache) * scale
+        q_pos = positions[:, None, None, None, None] \
+            + jnp.arange(K)[None, None, None, :, None]
+        k_pos = jnp.arange(T)[None, None, None, None, :]
         att = jnp.where(k_pos <= q_pos, att.astype(jnp.float32), -jnp.inf)
         att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhkt,bhtd->bkhd", att, v_cache).reshape(b, K, d)
+        attn = jnp.einsum("bhgkt,bhtd->bkhgd", att,
+                          v_cache).reshape(b, K, d)
         return self._block_tail(x, attn), (k_cache, v_cache)
 
     def decode_step(self, x, kv, positions):
@@ -288,9 +357,13 @@ class GPTBlock(Module):
         qkv = h @ self.wqkv
         if self.bqkv is not None:
             qkv = qkv + self.bqkv
-        qkv = qkv.reshape(b, s, 3, self.n_heads, self.head_dim)
-        qkv = _shard_act(qkv, P(_BATCH_AXES, "sp", None, "tp", None))
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, k, v = self._split_qkv(qkv)
+        q = _shard_act(q, P(_BATCH_AXES, "sp", "tp", None))
+        k = _shard_act(k, P(_BATCH_AXES, "sp", "tp", None))
+        v = _shard_act(v, P(_BATCH_AXES, "sp", "tp", None))
+        if self.rope:
+            q = self._apply_rope(q, jnp.arange(s))
+            k = self._apply_rope(k, jnp.arange(s))
         attn = self._attention(q, k, v, s)
         attn = attn.reshape(b, s, d)
         o = attn @ self.wo
@@ -383,8 +456,10 @@ class GPT(Module):
         dt = cfg.dtype
         self.wte = Parameter(_normal(kw, (cfg.vocab_size, cfg.d_model),
                                      0.02, dt))
-        self.wpe = Parameter(_normal(kp, (cfg.max_seq_len, cfg.d_model),
-                                     0.01, dt))
+        # rope models carry positions in the attention rotation, not a
+        # learned table
+        self.wpe = None if cfg.rope else Parameter(
+            _normal(kp, (cfg.max_seq_len, cfg.d_model), 0.01, dt))
         if cfg.moe_experts > 0 and cfg.moe_every < 1:
             raise ValueError(
                 f"moe_every must be >= 1, got {cfg.moe_every}")
@@ -415,10 +490,10 @@ class GPT(Module):
             # ≙ VocabParallelEmbedding (mp_layers.py:37): masked local
             # lookup + psum — the (V, d) table is never all-gathered
             x = vocab_parallel_embedding(self.wte, tokens, mesh=get_mesh())
-            x = x + self.wpe[:s]
         else:
-            x = jnp.take(_gathered_table(self.wte), tokens, axis=0) \
-                + self.wpe[:s]
+            x = jnp.take(_gathered_table(self.wte), tokens, axis=0)
+        if self.wpe is not None:  # rope models position in attention
+            x = x + self.wpe[:s]
         return _shard_act(x, P(_BATCH_AXES, "sp", None))
 
     def head(self, x):
@@ -468,7 +543,7 @@ class GPT(Module):
         cfg = self.cfg
         T = max_len or cfg.max_seq_len
         dt = dtype or cfg.dtype
-        shape = (batch, cfg.n_heads, T, cfg.head_dim)
+        shape = (batch, cfg.kv_heads, T, cfg.head_dim)
         return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                 for _ in range(cfg.n_layers)]
 
@@ -476,6 +551,8 @@ class GPT(Module):
         """Embedding for a chunk starting at (possibly traced) `pos`."""
         L = tokens.shape[-1]
         x = jnp.take(_gathered_table(self.wte), tokens, axis=0)
+        if self.wpe is None:
+            return x
         return x + lax.dynamic_slice_in_dim(self.wpe, pos, L)
 
     def forward_cached(self, tokens, cache, pos):
@@ -609,7 +686,9 @@ def _decode_mesh(cfg, b):
     if mesh is None or mesh.size == 1 or _in_pipeline():
         return None
     shape = dict(mesh.shape)
-    if cfg.n_heads % shape.get("tp", 1) or b % shape.get("dp", 1):
+    if (cfg.n_heads % shape.get("tp", 1)
+            or cfg.kv_heads % shape.get("tp", 1)   # GQA cache sharding
+            or b % shape.get("dp", 1)):
         return None
     return mesh
 
@@ -644,7 +723,7 @@ def _generate_scan(m: GPT, b, s0, T, max_new_tokens, temperature, top_p,
     L = cfg.n_layers
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *[m.blocks[i] for i in range(L)])
-    shape = (L, b, cfg.n_heads, T, cfg.head_dim)
+    shape = (L, b, cfg.kv_heads, T, cfg.head_dim)
     kc = jnp.zeros(shape, cfg.dtype)
     vc = jnp.zeros(shape, cfg.dtype)
     mesh = _decode_mesh(cfg, b)
@@ -1328,5 +1407,16 @@ def gpt3_350m(**kw):
 
 def gpt3_1p3b(**kw):
     d = dict(d_model=2048, n_layers=24, n_heads=16, max_seq_len=2048)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def llama_style_1b(**kw):
+    """Llama-family shape: rope positions, GQA kv heads, no biases,
+    untied head — the modern serving config (the GQA cache is 4x smaller,
+    which raises the decode HBM roofline by the same factor)."""
+    d = dict(d_model=2048, n_layers=22, n_heads=16, n_kv_heads=4,
+             rope=True, use_bias=False, tie_embeddings=False,
+             max_seq_len=2048, vocab_size=32000)
     d.update(kw)
     return GPTConfig(**d)
