@@ -1,0 +1,138 @@
+"""String tensors, TPU-form (ref: paddle/phi/kernels/strings/ — StringTensor
+with empty/copy/lower/upper kernels, unicode case tables in
+phi/kernels/strings/unicode.h).
+
+The reference's ``StringTensor`` is a host-resident array of byte strings;
+its only device kernels are case conversion over UTF-8 code points via
+precomputed tables.  The TPU-native form makes the SAME data a dense pair
+
+    codepoints : (B, T) int32, one Unicode code point per slot
+    lengths    : (B,)  int32
+
+so case conversion, comparison, and length are ordinary jit-safe array ops
+(a table gather IS how the reference kernel works — unicode.h:ToLower/
+ToUpper flag arrays), and the padded-ids layout feeds tokenizer output
+straight into embedding lookups with no host round-trip.
+
+One-to-one case mappings only, like the reference kernel: code points whose
+case form expands (e.g. ß→SS) map to themselves; points beyond the table
+(astral planes) pass through unchanged.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["StringTensor", "to_string_tensor", "to_strings", "lower",
+           "upper", "length", "equal", "empty", "empty_like"]
+
+# case tables over the full Basic Multilingual Plane, built once from
+# Python's own Unicode database — the analog of the reference's generated
+# unicode.h arrays (256 KB per table; every 1:1 BMP case pair covered)
+_TABLE_SIZE = 0x10000
+
+
+def _case_tables():
+    lo = np.arange(_TABLE_SIZE, dtype=np.int32)
+    up = np.arange(_TABLE_SIZE, dtype=np.int32)
+    for cp in range(_TABLE_SIZE):
+        c = chr(cp)
+        l, u = c.lower(), c.upper()
+        if len(l) == 1:
+            lo[cp] = ord(l)
+        if len(u) == 1:
+            up[cp] = ord(u)
+    return lo, up
+
+
+_LOWER_NP, _UPPER_NP = _case_tables()
+
+
+class StringTensor:
+    """Dense (codepoints, lengths) pair; rows are Unicode strings."""
+
+    def __init__(self, codepoints, lengths):
+        self.codepoints = jnp.asarray(codepoints, jnp.int32)
+        self.lengths = jnp.asarray(lengths, jnp.int32)
+
+    @property
+    def shape(self):
+        return (self.codepoints.shape[0],)
+
+    def to_strings(self):
+        cp = np.asarray(self.codepoints)
+        ln = np.asarray(self.lengths)
+        return ["".join(chr(int(c)) for c in cp[b, :ln[b]])
+                for b in range(cp.shape[0])]
+
+    def __repr__(self):
+        return f"StringTensor({self.to_strings()!r})"
+
+
+def to_string_tensor(strings, maxlen=None):
+    """Host-boundary converter: list[str] → StringTensor (≙ the pybind
+    py::list → StringTensor path, phi/kernels/strings/strings_copy_kernel)."""
+    rows = [[ord(ch) for ch in s] for s in strings]
+    T = int(maxlen) if maxlen is not None else max(
+        (len(r) for r in rows), default=0)
+    out = np.zeros((len(rows), T), np.int32)
+    lens = np.zeros((len(rows),), np.int32)
+    for b, r in enumerate(rows):
+        out[b, :min(len(r), T)] = r[:T]
+        lens[b] = min(len(r), T)
+    return StringTensor(out, lens)
+
+
+def to_strings(st):
+    return st.to_strings()
+
+
+def _map_case(st, table_np):
+    table = jnp.asarray(table_np)
+    cp = st.codepoints
+    mapped = jnp.where(cp < _TABLE_SIZE,
+                       table[jnp.clip(cp, 0, _TABLE_SIZE - 1)], cp)
+    valid = jnp.arange(cp.shape[1])[None, :] < st.lengths[:, None]
+    return StringTensor(jnp.where(valid, mapped, cp), st.lengths)
+
+
+def lower(st):
+    """ref: strings_lower_upper_kernel.h StringLowerKernel — per-codepoint
+    table map, jit-safe."""
+    return _map_case(st, _LOWER_NP)
+
+
+def upper(st):
+    """ref: strings_lower_upper_kernel.h StringUpperKernel."""
+    return _map_case(st, _UPPER_NP)
+
+
+def length(st):
+    """Per-row character counts (code points, not bytes)."""
+    return st.lengths
+
+
+def equal(a, b):
+    """Row-wise string equality → (B,) bool, jit-safe."""
+    if a.codepoints.shape[1] != b.codepoints.shape[1]:
+        T = max(a.codepoints.shape[1], b.codepoints.shape[1])
+        pad = lambda s: jnp.pad(  # noqa: E731
+            s.codepoints, ((0, 0), (0, T - s.codepoints.shape[1])))
+        acp, bcp = pad(a), pad(b)
+    else:
+        acp, bcp = a.codepoints, b.codepoints
+    t = jnp.arange(acp.shape[1])[None, :]
+    av = jnp.where(t < a.lengths[:, None], acp, -1)
+    bv = jnp.where(t < b.lengths[:, None], bcp, -1)
+    return jnp.all(av == bv, axis=1) & (a.lengths == b.lengths)
+
+
+def empty(shape, maxlen=0):
+    """ref: strings_empty_kernel.cc — uninitialized (here: zero) strings."""
+    n = int(np.prod(shape)) if not isinstance(shape, int) else shape
+    return StringTensor(np.zeros((n, maxlen), np.int32),
+                        np.zeros((n,), np.int32))
+
+
+def empty_like(st):
+    return empty(st.shape[0], int(st.codepoints.shape[1]))

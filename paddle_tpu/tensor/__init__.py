@@ -11,6 +11,7 @@ from paddle_tpu.tensor import search as _search
 from paddle_tpu.tensor import stat as _stat
 from paddle_tpu.tensor import random_ops as _random_ops
 from paddle_tpu.tensor import inplace as _inplace
+from paddle_tpu.tensor import sequence as _sequence
 
 from paddle_tpu.tensor.math import *        # noqa: F401,F403
 from paddle_tpu.tensor.manipulation import *  # noqa: F401,F403
@@ -21,8 +22,9 @@ from paddle_tpu.tensor.search import *      # noqa: F401,F403
 from paddle_tpu.tensor.stat import *        # noqa: F401,F403
 from paddle_tpu.tensor.inplace import *    # noqa: F401,F403
 from paddle_tpu.tensor.random_ops import *  # noqa: F401,F403
+from paddle_tpu.tensor.sequence import *    # noqa: F401,F403
 
 __all__ = (_math.__all__ + _manipulation.__all__ + _creation.__all__
            + _linalg.__all__ + _logic.__all__ + _search.__all__
            + _stat.__all__ + _random_ops.__all__
-           + _inplace.__all__)
+           + _inplace.__all__ + _sequence.__all__)

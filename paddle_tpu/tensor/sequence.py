@@ -1,0 +1,591 @@
+"""Sequence ops over dense padded batches (the TPU-native LoD replacement).
+
+Reference analog: the LoD sequence op family
+(paddle/fluid/operators/sequence_ops/, python surface
+python/paddle/fluid/layers/sequence_lod.py, re-exported as
+paddle.static.nn.sequence_*).  The reference represents variable-length
+batches as LoD (level-of-detail) tensors — a flat value buffer plus host-side
+offset tables — and every sequence op walks the offsets.  That layout is
+hostile to XLA (dynamic shapes, host-resident metadata), so here the SAME
+operations are defined over the TPU-idiomatic representation:
+
+    x        : (B, T, ...) dense, each row's valid data a prefix
+    lengths  : (B,) int32, valid timesteps per row
+
+Every op is a pure jax function; all but the host-boundary converters
+(sequence_pad / sequence_unpad / sequence_expand, which by nature produce
+ragged Python data) trace under jit with static shapes.  Ops whose result
+has per-row valid extents return ``(out, out_lengths)`` so they chain.
+
+The LoD→dense mapping for porting is documented in docs/porting_guide.md.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import register_op
+
+__all__ = [
+    "sequence_softmax", "sequence_pool", "sequence_first_step",
+    "sequence_last_step", "sequence_reverse", "sequence_enumerate",
+    "sequence_conv", "sequence_expand_as", "sequence_expand",
+    "sequence_reshape", "sequence_slice", "sequence_concat",
+    "sequence_erase", "sequence_scatter", "sequence_pad", "sequence_unpad",
+]
+
+
+def _mask(lengths, T, extra_dims=0):
+    """(B, T[, 1]*extra_dims) bool validity mask."""
+    m = jnp.arange(T)[None, :] < jnp.asarray(lengths)[:, None]
+    return m.reshape(m.shape + (1,) * extra_dims)
+
+
+def sequence_softmax(x, lengths):
+    """Masked softmax along the time axis (axis 1).
+
+    ref: sequence_softmax_op.cc / sequence_lod.py:191 — softmax within each
+    sequence independently; padded positions get probability 0."""
+    x = jnp.asarray(x)
+    m = _mask(lengths, x.shape[1], x.ndim - 2)
+    neg = jnp.finfo(jnp.result_type(x, jnp.float32)).min
+    z = jnp.where(m, x, neg)
+    z = z - jnp.max(z, axis=1, keepdims=True)
+    e = jnp.exp(z) * m.astype(x.dtype)
+    return e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-30)
+
+
+def sequence_pool(x, lengths, pool_type="sum", pad_value=0.0):
+    """Reduce the time axis per sequence: sum/average/sqrt/max/min/first/last.
+
+    ref: sequence_pool_op.cc / sequence_lod.py:278.  'sqrt' is sum scaled by
+    1/sqrt(len) (the reference's attention-pooling variant).  Empty sequences
+    produce ``pad_value`` (ref pad_value attr)."""
+    x = jnp.asarray(x)
+    lengths = jnp.asarray(lengths)
+    T = x.shape[1]
+    m = _mask(lengths, T, x.ndim - 2)
+    md = m.astype(x.dtype)
+    # broadcast-safe per-row divisor / emptiness
+    len_shaped = lengths.reshape((-1,) + (1,) * (x.ndim - 2))
+    empty = len_shaped == 0
+    if pool_type in ("sum", "average", "sqrt"):
+        s = jnp.sum(x * md, axis=1)
+        if pool_type == "average":
+            s = s / jnp.maximum(len_shaped, 1).astype(x.dtype)
+        elif pool_type == "sqrt":
+            s = s / jnp.sqrt(jnp.maximum(len_shaped, 1).astype(x.dtype))
+        out = s
+    elif pool_type in ("max", "min"):
+        info = (jnp.finfo if jnp.issubdtype(x.dtype, jnp.inexact)
+                else jnp.iinfo)(x.dtype)
+        lim = info.min if pool_type == "max" else info.max
+        z = jnp.where(m, x, lim)
+        out = z.max(axis=1) if pool_type == "max" else z.min(axis=1)
+    elif pool_type == "first":
+        out = x[:, 0]
+    elif pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+
+def sequence_first_step(x, lengths):
+    """ref: sequence_lod.py:464 — first timestep of each sequence."""
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths):
+    """ref: sequence_lod.py:522 — last valid timestep of each sequence."""
+    return sequence_pool(x, lengths, "last")
+
+
+def sequence_reverse(x, lengths):
+    """Reverse each sequence's valid prefix; padding stays in place.
+
+    ref: sequence_reverse_op.cc / sequence_lod.py:1434."""
+    x = jnp.asarray(x)
+    lengths = jnp.asarray(lengths)
+    t = jnp.arange(x.shape[1])[None, :]
+    src = jnp.where(t < lengths[:, None], lengths[:, None] - 1 - t, t)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_enumerate(x, lengths, win_size, pad_value=0):
+    """Sliding windows of ids: out[b, t, k] = x[b, t+k] while t+k is inside
+    the sequence, else pad_value.
+
+    ref: sequence_enumerate_op.cc / sequence_lod.py:1301 (the all-window
+    enumeration used by n-gram feature extraction)."""
+    x = jnp.asarray(x)
+    lengths = jnp.asarray(lengths)
+    B, T = x.shape[:2]
+    t = jnp.arange(T)[None, :, None]                  # (1, T, 1)
+    k = jnp.arange(win_size)[None, None, :]           # (1, 1, K)
+    pos = t + k                                       # (1, T, K)
+    valid = pos < lengths[:, None, None]              # (B, T, K)
+    gathered = x[jnp.arange(B)[:, None, None], jnp.minimum(pos, T - 1)]
+    return jnp.where(valid, gathered, jnp.asarray(pad_value, x.dtype))
+
+
+def sequence_conv(x, lengths, weight, bias=None, padding_start=None):
+    """Contextual (a.k.a. row) convolution over each sequence.
+
+    For filter_size F (= weight.shape[0] // D) the window at step t covers
+    timesteps [t + padding_start, t + padding_start + F); positions outside
+    the valid sequence contribute zeros.  ``padding_start`` defaults to
+    ``-(F // 2)`` like the reference.
+
+    ref: sequence_conv_op.cc (im2col over LoD rows) / sequence_lod.py:51,
+    default padding sequence_lod.py:171-172.  Here the im2col is F static
+    shifts concatenated on the feature axis — one (B*T, F*D) x (F*D, M)
+    matmul, exactly the MXU-friendly layout."""
+    x = jnp.asarray(x)
+    weight = jnp.asarray(weight)
+    B, T, D = x.shape
+    F = weight.shape[0] // D
+    if padding_start is None:
+        padding_start = -(F // 2)
+    m = _mask(lengths, T, 1).astype(x.dtype)
+    xm = x * m
+    cols = []
+    for j in range(F):
+        off = padding_start + j
+        if off < 0:
+            shifted = jnp.pad(xm[:, :T + off if T + off > 0 else 0],
+                              ((0, 0), (min(-off, T), 0), (0, 0)))
+        elif off > 0:
+            shifted = jnp.pad(xm[:, min(off, T):],
+                              ((0, 0), (0, min(off, T)), (0, 0)))
+        else:
+            shifted = xm
+        cols.append(shifted)
+    ctx = jnp.concatenate(cols, axis=-1)              # (B, T, F*D)
+    out = ctx @ weight                                # (B, T, M)
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return out * _mask(lengths, T, 1).astype(out.dtype)
+
+
+def sequence_expand_as(x, lengths, maxlen=None):
+    """Expand each row of ``x`` (one timestep per sequence) along time to
+    its target length: out[b, t] = x[b] for t < lengths[b], else 0.
+
+    ref: sequence_expand_as_op.cc / sequence_lod.py:814.  Returns
+    ``(out, lengths)``."""
+    x = jnp.asarray(x)
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        # host read, like sequence_mask
+        maxlen = int(jnp.max(lengths)) if lengths.size else 0
+    lengths = jnp.minimum(lengths, maxlen)
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], maxlen) + x.shape[1:])
+    out = out * _mask(lengths, maxlen, x.ndim - 1).astype(x.dtype)
+    return out, lengths
+
+
+def sequence_expand(x, lengths, repeats):
+    """Repeat each row's sequence ``repeats[b]`` times (ragged output —
+    host-side by nature, like the reference's LoD-growing expand).
+
+    ref: sequence_expand_op.cc / sequence_lod.py:675 (ref_level=0 case:
+    whole-sequence repetition per y's outer LoD).  Returns the expanded
+    padded batch (B', T, ...) and its lengths, B' = sum(repeats)."""
+    x = np.asarray(x)
+    lengths = np.asarray(lengths)
+    repeats = np.asarray(repeats)
+    rows = [x[b] for b in range(x.shape[0]) for _ in range(int(repeats[b]))]
+    lens = [int(lengths[b]) for b in range(x.shape[0])
+            for _ in range(int(repeats[b]))]
+    if not rows:
+        return (jnp.zeros((0,) + x.shape[1:], x.dtype),
+                jnp.zeros((0,), jnp.int32))
+    return jnp.asarray(np.stack(rows)), jnp.asarray(lens, jnp.int32)
+
+
+def sequence_reshape(x, lengths, new_dim):
+    """Re-chunk each sequence's features: (B, T, D) → (B, T*D//new_dim,
+    new_dim), lengths scaled by D/new_dim.
+
+    Because each row's valid data is a prefix of the flattened row, the
+    dense reshape IS the LoD reshape — no data movement beyond XLA's
+    bitcast.  ref: sequence_reshape_op.cc / sequence_lod.py:1136 (which
+    requires len*D % new_dim == 0 per row; same constraint here)."""
+    x = jnp.asarray(x)
+    B, T, D = x.shape
+    if (T * D) % new_dim:
+        raise ValueError(f"T*D={T * D} not divisible by new_dim={new_dim}")
+    lengths = jnp.asarray(lengths)
+    if not isinstance(lengths, jax.core.Tracer):
+        bad = np.asarray(lengths) * D % new_dim != 0
+        if bad.any():
+            raise ValueError(
+                f"rows {np.nonzero(bad)[0].tolist()}: len*D (D={D}) not "
+                f"divisible by new_dim={new_dim} (reference constraint)")
+    out = x.reshape(B, (T * D) // new_dim, new_dim)
+    new_len = (lengths * D) // new_dim
+    return out, new_len
+
+
+def sequence_slice(x, lengths, offset, length):
+    """Per-sequence slice: out[b, t] = x[b, offset[b] + t] for t <
+    length[b]; the padded width stays x.shape[1].
+
+    ref: sequence_slice_op.cc / sequence_lod.py:581 (offset/length are
+    per-sequence tensors there too).  Returns ``(out, length)``."""
+    x = jnp.asarray(x)
+    offset = jnp.broadcast_to(jnp.asarray(offset, jnp.int32), (x.shape[0],))
+    length = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (x.shape[0],))
+    if not any(isinstance(v, jax.core.Tracer)
+               for v in (offset, length, lengths)):
+        over = (np.asarray(offset) + np.asarray(length)
+                > np.asarray(lengths))
+        if over.any():
+            raise ValueError(
+                f"rows {np.nonzero(over)[0].tolist()}: offset+length "
+                "exceeds the sequence length (reference constraint, "
+                "sequence_slice_op.cc)")
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]
+    src = jnp.clip(offset[:, None] + t, 0, T - 1)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    out = out * _mask(length, T, x.ndim - 2).astype(x.dtype)
+    return out, length
+
+
+def sequence_concat(xs, lengths_list):
+    """Concatenate sequences row-wise: out row b is xs[0][b][:l0] ++
+    xs[1][b][:l1] ++ …, padded to the summed max width.
+
+    ref: sequence_concat_op.cc / sequence_lod.py:396.  Jit-safe: each
+    input's valid entries scatter to offset positions computed from the
+    running per-row length sums (invalid lanes scatter out of range and
+    drop).  Returns ``(out, total_lengths)``."""
+    xs = [jnp.asarray(x) for x in xs]
+    lens = [jnp.asarray(l) for l in lengths_list]
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((B, T_out) + feat, xs[0].dtype)
+    offset = jnp.zeros((B,), jnp.int32)
+    for x, l in zip(xs, lens):
+        T = x.shape[1]
+        t = jnp.arange(T)[None, :]
+        dest = jnp.where(t < l[:, None], offset[:, None] + t, T_out)
+        b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+        out = out.at[b_idx, dest].set(x, mode="drop")
+        offset = offset + l.astype(jnp.int32)
+    return out, offset
+
+
+def sequence_erase(x, lengths, tokens):
+    """Remove every occurrence of ``tokens`` from each sequence and compact
+    left; returns ``(out, new_lengths)`` with erased tail zero-padded.
+
+    ref: sequence_erase_op.cc (used to drop <unk>/<pad> ids).  Jit-safe
+    compaction: stable argsort of keep-flags moves survivors to the front
+    without host sync."""
+    x = jnp.asarray(x)
+    T = x.shape[1]
+    valid = _mask(lengths, T)
+    keep = valid
+    for tok in tokens:
+        keep = keep & (x != tok)
+    # stable sort: survivors (key 0) first, in original order
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    return compacted * _mask(new_len, T).astype(x.dtype), new_len
+
+
+def sequence_scatter(x, ids, updates, lengths):
+    """out = x; out[b, ids[b, t]] += updates[b, t] for t < lengths[b].
+
+    ref: sequence_scatter_op.h:60-85 (the += is the reference's rule) —
+    ids/updates are one scatter list per row there (LoD), here padded
+    (B, T) with ``lengths``.  Invalid lanes scatter out of range and
+    drop."""
+    x = jnp.asarray(x)
+    ids = jnp.asarray(ids, jnp.int32)
+    updates = jnp.asarray(updates)
+    B, T = ids.shape
+    dump = x.shape[1]
+    dest = jnp.where(_mask(lengths, T), ids, dump)
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    return x.at[b_idx, dest].add(updates.astype(x.dtype), mode="drop")
+
+
+def sequence_pad(sequences, pad_value=0.0, maxlen=None):
+    """Host-boundary converter: list of per-row arrays → dense (B, maxlen,
+    ...) + lengths.  ref: sequence_pad_op.cc / sequence_lod.py:934 (returns
+    (Out, Length) there too)."""
+    seqs = [np.asarray(s) for s in sequences]
+    lens = np.asarray([s.shape[0] for s in seqs], np.int32)
+    T = int(maxlen) if maxlen is not None else int(lens.max(initial=0))
+    feat = seqs[0].shape[1:] if seqs else ()
+    out = np.full((len(seqs), T) + feat, pad_value,
+                  seqs[0].dtype if seqs else np.float32)
+    for b, s in enumerate(seqs):
+        out[b, :min(s.shape[0], T)] = s[:T]
+    return jnp.asarray(out), jnp.asarray(np.minimum(lens, T))
+
+
+def sequence_unpad(x, lengths):
+    """Inverse of sequence_pad: dense + lengths → list of valid prefixes
+    (host).  ref: sequence_unpad_op.cc / sequence_lod.py:1055."""
+    x = np.asarray(x)
+    lengths = np.asarray(lengths)
+    return [x[b, :int(lengths[b])] for b in range(x.shape[0])]
+
+
+# ---------------------------------------------------------------- registry
+
+def _np_mask(lengths, T):
+    return np.arange(T)[None, :] < np.asarray(lengths)[:, None]
+
+
+def _np_softmax(x, lengths):
+    x = np.asarray(x, np.float64)
+    m = _np_mask(lengths, x.shape[1])
+    z = np.where(m[..., None] if x.ndim == 3 else m, x, -1e30)
+    z = z - z.max(axis=1, keepdims=True)
+    e = np.exp(z) * (m[..., None] if x.ndim == 3 else m)
+    return (e / np.maximum(e.sum(axis=1, keepdims=True), 1e-30)).astype(
+        np.float32)
+
+
+def _np_pool(x, lengths, pool_type="sum", pad_value=0.0):
+    x = np.asarray(x, np.float64)
+    outs = []
+    for b in range(x.shape[0]):
+        v = x[b, :int(lengths[b])]
+        if v.shape[0] == 0:
+            outs.append(np.full(x.shape[2:], pad_value))
+        elif pool_type == "sum":
+            outs.append(v.sum(0))
+        elif pool_type == "average":
+            outs.append(v.mean(0))
+        elif pool_type == "sqrt":
+            outs.append(v.sum(0) / np.sqrt(v.shape[0]))
+        elif pool_type == "max":
+            outs.append(v.max(0))
+        elif pool_type == "min":
+            outs.append(v.min(0))
+        elif pool_type == "first":
+            outs.append(v[0])
+        elif pool_type == "last":
+            outs.append(v[-1])
+    return np.stack(outs).astype(np.float32)
+
+
+def _np_reverse(x, lengths):
+    x = np.array(x)
+    for b in range(x.shape[0]):
+        n = int(lengths[b])
+        x[b, :n] = x[b, :n][::-1]
+    return x
+
+
+def _np_enumerate(x, lengths, win_size=3, pad_value=0):
+    x = np.asarray(x)
+    B, T = x.shape
+    out = np.full((B, T, win_size), pad_value, x.dtype)
+    for b in range(B):
+        n = int(lengths[b])
+        for t in range(T):
+            for k in range(win_size):
+                if t + k < n:
+                    out[b, t, k] = x[b, t + k]
+    return out
+
+
+def _np_conv(x, lengths, weight, padding_start=None):
+    x = np.asarray(x, np.float64)
+    w = np.asarray(weight, np.float64)
+    B, T, D = x.shape
+    F = w.shape[0] // D
+    if padding_start is None:
+        padding_start = -(F // 2)
+    out = np.zeros((B, T, w.shape[1]))
+    for b in range(B):
+        n = int(lengths[b])
+        for t in range(n):
+            ctx = np.zeros((F, D))
+            for j in range(F):
+                s = t + padding_start + j
+                if 0 <= s < n:
+                    ctx[j] = x[b, s]
+            out[b, t] = ctx.reshape(-1) @ w
+    return out.astype(np.float32)
+
+
+def _np_slice(x, lengths, offset, length):
+    x = np.asarray(x)
+    out = np.zeros_like(x)
+    offset = np.broadcast_to(np.asarray(offset), (x.shape[0],))
+    length = np.broadcast_to(np.asarray(length), (x.shape[0],))
+    for b in range(x.shape[0]):
+        n = int(length[b])
+        out[b, :n] = x[b, int(offset[b]):int(offset[b]) + n]
+    return out
+
+
+def _np_concat(xs, lengths_list):
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    out = np.zeros((B, T_out) + xs[0].shape[2:], xs[0].dtype)
+    lens = np.zeros((B,), np.int32)
+    for b in range(B):
+        pos = 0
+        for x, l in zip(xs, lengths_list):
+            n = int(np.asarray(l)[b])
+            out[b, pos:pos + n] = np.asarray(x)[b, :n]
+            pos += n
+        lens[b] = pos
+    return out, lens
+
+
+def _np_erase(x, lengths, tokens):
+    x = np.asarray(x)
+    out = np.zeros_like(x)
+    lens = np.zeros((x.shape[0],), np.int32)
+    for b in range(x.shape[0]):
+        kept = [v for v in x[b, :int(lengths[b])] if v not in tokens]
+        out[b, :len(kept)] = kept
+        lens[b] = len(kept)
+    return out, lens
+
+
+def _np_scatter(x, ids, updates, lengths):
+    out = np.array(x, np.float64)
+    for b in range(ids.shape[0]):
+        for t in range(int(lengths[b])):
+            out[b, int(ids[b, t])] += updates[b, t]
+    return out.astype(np.float32)
+
+
+def _register():
+    rs = np.random.RandomState(20260731)
+    B, T, D = 4, 7, 6
+    lens = np.array([7, 4, 1, 0], np.int32)
+    xf = rs.randn(B, T, D).astype(np.float32)
+    xi = rs.randint(1, 9, (B, T)).astype(np.int32)
+
+    register_op("sequence_softmax", sequence_softmax, "sequence",
+                np_ref=lambda x, l: _np_softmax(x, l),
+                sample_args=lambda: ((xf, lens), {}),
+                ref="fluid/layers/sequence_lod.py:191")
+    for pt in ("sum", "average", "sqrt", "max", "min", "first", "last"):
+        register_op(
+            f"sequence_pool_{pt}" if pt != "sum" else "sequence_pool",
+            sequence_pool, "sequence",
+            np_ref=(lambda p: lambda x, l: _np_pool(x, l, p))(pt),
+            sample_args=(lambda p: lambda: ((xf, lens), {"pool_type": p}))(
+                pt),
+            test_fn=(lambda p: lambda x, l, pool_type=None:
+                     sequence_pool(x, l, p))(pt),
+            ref="fluid/layers/sequence_lod.py:278")
+    register_op("sequence_first_step", sequence_first_step, "sequence",
+                np_ref=lambda x, l: _np_pool(x, l, "first"),
+                sample_args=lambda: ((xf, lens), {}),
+                ref="fluid/layers/sequence_lod.py:464")
+    register_op("sequence_last_step", sequence_last_step, "sequence",
+                np_ref=lambda x, l: _np_pool(x, l, "last"),
+                sample_args=lambda: ((xf, lens), {}),
+                ref="fluid/layers/sequence_lod.py:522")
+    register_op("sequence_reverse", sequence_reverse, "sequence",
+                np_ref=lambda x, l: _np_reverse(x, l),
+                sample_args=lambda: ((xf, lens), {}),
+                ref="fluid/layers/sequence_lod.py:1434")
+    register_op("sequence_enumerate", sequence_enumerate, "sequence",
+                np_ref=lambda x, l: _np_enumerate(x, l, 3, 0),
+                sample_args=lambda: ((xi, lens), {"win_size": 3}),
+                differentiable=False,
+                ref="fluid/layers/sequence_lod.py:1301")
+    wconv = rs.randn(3 * D, 5).astype(np.float32)
+    register_op("sequence_conv", sequence_conv, "sequence",
+                np_ref=lambda x, l, w: _np_conv(x, l, w),
+                sample_args=lambda: ((xf, lens, wconv), {}),
+                ref="fluid/layers/sequence_lod.py:51")
+    x1 = rs.randn(B, D).astype(np.float32)
+    register_op("sequence_expand_as", sequence_expand_as, "sequence",
+                np_ref=lambda x, l: np.where(
+                    _np_mask(l, 7)[..., None], np.asarray(x)[:, None], 0.0
+                ).astype(np.float32),
+                sample_args=lambda: ((x1, lens), {"maxlen": 7}),
+                test_fn=lambda x, l, maxlen=7: sequence_expand_as(
+                    x, l, maxlen)[0],
+                ref="fluid/layers/sequence_lod.py:814")
+    reps = np.array([2, 0, 1, 3], np.int32)
+    register_op("sequence_expand", sequence_expand, "sequence",
+                np_ref=lambda x, l, r: np.stack(
+                    [np.asarray(x)[b] for b in range(len(r))
+                     for _ in range(int(r[b]))]),
+                sample_args=lambda: ((xf, lens, reps), {}),
+                test_fn=lambda x, l, r: sequence_expand(x, l, r)[0],
+                jit_ok=False, differentiable=False,
+                ref="fluid/layers/sequence_lod.py:675")
+    register_op("sequence_reshape", sequence_reshape, "sequence",
+                np_ref=lambda x, l: np.asarray(x).reshape(B, T * 2, D // 2),
+                sample_args=lambda: ((xf, lens), {"new_dim": D // 2}),
+                test_fn=lambda x, l, new_dim=D // 2: sequence_reshape(
+                    x, l, new_dim)[0],
+                ref="fluid/layers/sequence_lod.py:1136")
+    offs = np.array([0, 1, 0, 0], np.int32)
+    slens = np.array([3, 2, 1, 0], np.int32)
+    register_op("sequence_slice", sequence_slice, "sequence",
+                np_ref=lambda x, l, o, n: _np_slice(x, l, o, n),
+                sample_args=lambda: ((xf, lens, offs, slens), {}),
+                test_fn=lambda x, l, o, n: sequence_slice(x, l, o, n)[0],
+                ref="fluid/layers/sequence_lod.py:581")
+    x2 = rs.randn(B, 5, D).astype(np.float32)
+    lens2 = np.array([2, 5, 0, 3], np.int32)
+    register_op("sequence_concat", sequence_concat, "sequence",
+                np_ref=lambda x, l: _np_concat([x, x2], [l, lens2])[0],
+                sample_args=lambda: ((xf, lens), {}),
+                test_fn=lambda x, l: sequence_concat(
+                    [x, x2], [l, lens2])[0],
+                ref="fluid/layers/sequence_lod.py:396")
+    register_op("sequence_erase", sequence_erase, "sequence",
+                np_ref=lambda x, l: _np_erase(x, l, (2, 5))[0].astype(
+                    np.int32),
+                sample_args=lambda: ((xi, lens), {}),
+                test_fn=lambda x, l: sequence_erase(x, l, (2, 5))[0],
+                differentiable=False,
+                ref="operators/sequence_ops/sequence_erase_op.cc")
+    tgt = rs.randn(B, 10).astype(np.float32)
+    ids = rs.randint(0, 10, (B, T)).astype(np.int32)
+    upd = rs.randn(B, T).astype(np.float32)
+    register_op("sequence_scatter", sequence_scatter, "sequence",
+                np_ref=lambda x, i, u, l: _np_scatter(x, i, u, l),
+                sample_args=lambda: ((tgt, ids, upd, lens), {}),
+                ref="operators/sequence_ops/sequence_scatter_op.h:60")
+    ragged = [rs.randn(5, 3).astype(np.float32),
+              rs.randn(2, 3).astype(np.float32)]
+    register_op("sequence_pad", sequence_pad, "sequence",
+                np_ref=lambda: np.stack(
+                    [np.pad(ragged[0], ((0, 0), (0, 0))),
+                     np.pad(ragged[1], ((0, 3), (0, 0)))]),
+                sample_args=lambda: ((), {}),
+                test_fn=lambda: sequence_pad(ragged)[0],
+                jit_ok=False, differentiable=False,
+                ref="fluid/layers/sequence_lod.py:934")
+    register_op("sequence_unpad", sequence_unpad, "sequence",
+                np_ref=lambda x, l: np.concatenate(
+                    [np.asarray(x)[b, :int(l[b])].reshape(-1)
+                     for b in range(len(l))]),
+                sample_args=lambda: ((xf, lens), {}),
+                test_fn=lambda x, l: np.concatenate(
+                    [np.asarray(p).reshape(-1)
+                     for p in sequence_unpad(x, l)]),
+                jit_ok=False, differentiable=False,
+                ref="fluid/layers/sequence_lod.py:1055")
+
+
+_register()

@@ -8,7 +8,12 @@ import jax.numpy as jnp
 
 from paddle_tpu.io.dataset import Dataset
 
-__all__ = ["viterbi_decode", "SyntheticTextDataset", "Imdb", "UCIHousing", "Conll05st"]
+from paddle_tpu.text.tokenizer import (  # noqa: F401
+    BasicTokenizer, BertTokenizer, WordpieceTokenizer, load_vocab)
+
+__all__ = ["viterbi_decode", "SyntheticTextDataset", "Imdb", "UCIHousing",
+           "Conll05st", "BasicTokenizer", "BertTokenizer",
+           "WordpieceTokenizer", "load_vocab"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

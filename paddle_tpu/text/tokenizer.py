@@ -1,0 +1,182 @@
+"""BERT-family tokenizer: the FasterTokenizer analog (ref:
+paddle/fluid/operators/string/faster_tokenizer_op.{h,cc} — an in-graph C++
+wordpiece tokenizer so serving takes raw strings end-to-end).
+
+TPU-form: tokenization is byte/codepoint work with data-dependent output
+shapes — exactly what does NOT belong inside an XLA program — so it runs on
+the host as part of the input/serving pipeline (same split the reference
+makes between CPU-only tokenizer op and device model), and its OUTPUT is the
+dense padded (ids, token_type_ids, lengths) batch the jitted model consumes.
+``paddle_tpu.inference.Predictor`` / the decode engine take these directly.
+
+Semantics follow the reference op: BasicTokenizer (unicode clean, optional
+lower+accent-strip, CJK spacing, punctuation split) then greedy
+longest-match WordPiece against a vocab, with [CLS]/[SEP] assembly and
+truncation (faster_tokenizer_op.h:BertTokenizer::Encode).
+"""
+
+import unicodedata
+
+import numpy as np
+
+__all__ = ["load_vocab", "BasicTokenizer", "WordpieceTokenizer",
+           "BertTokenizer"]
+
+
+def load_vocab(path):
+    """vocab.txt (one token per line, id = line number) → dict."""
+    vocab = {}
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\n")
+            if tok:
+                vocab[tok] = i
+    return vocab
+
+
+def _is_punct(ch):
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp):
+    return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+            or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+            or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+            or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F)
+
+
+class BasicTokenizer:
+    """ref: faster_tokenizer_op.h BasicTokenizer — clean, lower/strip
+    accents, space out CJK, split on whitespace and punctuation."""
+
+    def __init__(self, do_lower_case=True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text):
+        out = []
+        for ch in text:
+            cp = ord(ch)
+            if cp == 0 or cp == 0xFFFD or (
+                    unicodedata.category(ch) in ("Cc", "Cf")
+                    and ch not in "\t\n\r"):
+                continue
+            if _is_cjk(cp):
+                out.append(f" {ch} ")
+            elif ch in "\t\n\r" or unicodedata.category(ch) == "Zs":
+                out.append(" ")
+            else:
+                out.append(ch)
+        text = "".join(out)
+        if self.do_lower_case:
+            text = text.lower()
+            text = unicodedata.normalize("NFD", text)
+            text = "".join(c for c in text
+                           if unicodedata.category(c) != "Mn")
+        tokens = []
+        for word in text.split():
+            cur = []
+            for ch in word:
+                if _is_punct(ch):
+                    if cur:
+                        tokens.append("".join(cur))
+                        cur = []
+                    tokens.append(ch)
+                else:
+                    cur.append(ch)
+            if cur:
+                tokens.append("".join(cur))
+        return tokens
+
+
+class WordpieceTokenizer:
+    """ref: faster_tokenizer_op.h WordPieceTokenizer — greedy longest-match
+    from each position; continuation pieces prefixed '##'; whole word →
+    [UNK] if any position fails."""
+
+    def __init__(self, vocab, unk_token="[UNK]", max_chars_per_word=100):
+        self.vocab = vocab
+        self.unk_token = unk_token
+        self.max_chars_per_word = max_chars_per_word
+
+    def tokenize(self, word):
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_token]
+        pieces, start = [], 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+
+class BertTokenizer:
+    """End-to-end encoder: raw text (pairs) → padded id batches.
+
+    ref: faster_tokenizer_op.h BertTokenizer::Encode/BatchEncode — the op's
+    outputs are exactly these two dense int64 tensors (InputIds,
+    SegmentIds); here lengths ride along instead of relying on pad id 0."""
+
+    def __init__(self, vocab, do_lower_case=True, unk_token="[UNK]",
+                 cls_token="[CLS]", sep_token="[SEP]", pad_token="[PAD]"):
+        if isinstance(vocab, str):
+            vocab = load_vocab(vocab)
+        self.vocab = vocab
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordpieceTokenizer(vocab, unk_token)
+        self.cls_id = vocab[cls_token]
+        self.sep_id = vocab[sep_token]
+        self.pad_id = vocab.get(pad_token, 0)
+        self.inv_vocab = {i: t for t, i in vocab.items()}
+
+    def tokenize(self, text):
+        return [p for w in self.basic.tokenize(text)
+                for p in self.wordpiece.tokenize(w)]
+
+    def convert_tokens_to_ids(self, tokens):
+        return [self.vocab[t] for t in tokens]
+
+    def __call__(self, texts, text_pairs=None, max_seq_len=128):
+        """→ dict of np arrays: input_ids, token_type_ids (B, max_seq_len)
+        int32 + seq_len (B,) — the jitted model's feed, no further host
+        work."""
+        if isinstance(texts, str):
+            texts = [texts]
+        if text_pairs is not None and isinstance(text_pairs, str):
+            text_pairs = [text_pairs]
+        B = len(texts)
+        ids = np.full((B, max_seq_len), self.pad_id, np.int32)
+        seg = np.zeros((B, max_seq_len), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for b in range(B):
+            a = self.convert_tokens_to_ids(self.tokenize(texts[b]))
+            p = (self.convert_tokens_to_ids(self.tokenize(text_pairs[b]))
+                 if text_pairs is not None else [])
+            # truncate longest-first to fit specials (ref Encode truncation)
+            has_pair = text_pairs is not None
+            budget = max_seq_len - (3 if has_pair else 2)
+            while len(a) + len(p) > budget:
+                (a if len(a) > len(p) else p).pop()
+            row = [self.cls_id] + a + [self.sep_id]
+            types = [0] * len(row)
+            if has_pair:
+                row += p + [self.sep_id]
+                types += [1] * (len(p) + 1)
+            ids[b, :len(row)] = row
+            seg[b, :len(types)] = types
+            lens[b] = len(row)
+        return {"input_ids": ids, "token_type_ids": seg, "seq_len": lens}

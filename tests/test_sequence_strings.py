@@ -1,0 +1,236 @@
+"""Sequence ops (dense+lengths LoD replacement), strings, and the
+FasterTokenizer analog (ref: sequence_ops/, phi/kernels/strings/,
+operators/string/faster_tokenizer_op.cc).  Value oracles for each sequence
+op live in the op suite; here: lengths outputs, chaining, jit, and
+behavioural parity (tokenizer vs the HuggingFace BertTokenizer oracle)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.tensor as T
+from paddle_tpu import strings
+from paddle_tpu.text import BertTokenizer
+
+
+def _batch():
+    rs = np.random.RandomState(7)
+    x = rs.randn(3, 5, 2).astype(np.float32)
+    lens = np.array([5, 2, 0], np.int32)
+    return x, lens
+
+
+def test_concat_lengths_and_values():
+    x, lens = _batch()
+    y = np.ones((3, 4, 2), np.float32)
+    ylens = np.array([1, 4, 2], np.int32)
+    out, olens = T.sequence_concat([x, y], [lens, ylens])
+    assert out.shape == (3, 9, 2)
+    np.testing.assert_array_equal(np.asarray(olens), [6, 6, 2])
+    np.testing.assert_allclose(np.asarray(out)[0, :5], x[0, :5])
+    np.testing.assert_allclose(np.asarray(out)[0, 5:6], y[0, :1])
+    np.testing.assert_allclose(np.asarray(out)[2, :2], y[2, :2])
+    # padding stays zero
+    assert float(jnp.abs(out[2, 2:]).sum()) == 0.0
+
+
+def test_erase_lengths():
+    x = np.array([[4, 2, 7, 2, 9], [2, 2, 2, 1, 1]], np.int32)
+    lens = np.array([5, 3], np.int32)
+    out, olens = T.sequence_erase(x, lens, (2,))
+    np.testing.assert_array_equal(np.asarray(olens), [3, 0])
+    np.testing.assert_array_equal(np.asarray(out)[0, :3], [4, 7, 9])
+    np.testing.assert_array_equal(np.asarray(out)[1], [0, 0, 0, 0, 0])
+
+
+def test_reshape_lengths_scale():
+    x, lens = _batch()
+    out, olens = T.sequence_reshape(x, lens, 1)
+    assert out.shape == (3, 10, 1)
+    np.testing.assert_array_equal(np.asarray(olens), [10, 4, 0])
+
+
+def test_pad_unpad_roundtrip():
+    rows = [np.arange(4, dtype=np.float32).reshape(4, 1),
+            np.arange(2, dtype=np.float32).reshape(2, 1)]
+    padded, lens = T.sequence_pad(rows, pad_value=-1.0)
+    assert padded.shape == (2, 4, 1)
+    assert float(padded[1, 3, 0]) == -1.0
+    back = T.sequence_unpad(padded, lens)
+    for a, b in zip(rows, back):
+        np.testing.assert_allclose(a, np.asarray(b))
+
+
+def test_expand_ragged_batch():
+    x, lens = _batch()
+    out, olens = T.sequence_expand(x, lens, np.array([2, 0, 1], np.int32))
+    assert out.shape == (3, 5, 2)
+    np.testing.assert_array_equal(np.asarray(olens), [5, 5, 0])
+    np.testing.assert_allclose(np.asarray(out)[1], x[0])
+
+
+def test_pad_truncation_clamps_lengths():
+    padded, lens = T.sequence_pad([np.ones((5, 1), np.float32)], maxlen=3)
+    np.testing.assert_array_equal(np.asarray(lens), [3])
+    out = T.sequence_pool(padded, lens, "average")
+    np.testing.assert_allclose(np.asarray(out), [[1.0]])
+
+
+def test_expand_as_clamps_lengths_to_maxlen():
+    x = np.ones((1, 2), np.float32)
+    out, lens = T.sequence_expand_as(x, np.array([5], np.int32), maxlen=3)
+    assert out.shape == (1, 3, 2)
+    np.testing.assert_array_equal(np.asarray(lens), [3])
+
+
+def test_reshape_rejects_indivisible_row_lengths():
+    x = np.ones((1, 4, 3), np.float32)
+    with pytest.raises(ValueError, match="not\\s+divisible"):
+        T.sequence_reshape(x, np.array([3], np.int32), 2)
+
+
+def test_slice_rejects_out_of_range_window():
+    x = np.ones((1, 5, 1), np.float32)
+    with pytest.raises(ValueError, match="exceeds"):
+        T.sequence_slice(x, np.array([5]), np.array([3]), np.array([4]))
+
+
+def test_sequence_chain_under_jit():
+    """reverse→softmax→pool chains as ONE traced program (the point of the
+    dense representation: no host offsets between ops)."""
+    x, lens = _batch()
+
+    @jax.jit
+    def f(x, lens):
+        r = T.sequence_reverse(x, lens)
+        s = T.sequence_softmax(r, lens)
+        return T.sequence_pool(s, lens, "sum")
+
+    out = np.asarray(f(x, lens))
+    # softmax sums to 1 over valid steps → pooled sum = 1 per feature
+    np.testing.assert_allclose(out[0], np.ones(2), rtol=1e-5)
+    np.testing.assert_allclose(out[2], np.zeros(2), atol=1e-7)  # empty row
+
+
+def test_strings_case_roundtrip():
+    texts = ["Hello, World!", "ΣΊΣΥΦΟΣ", "Привет Мир", "mixed ÄöÜ ß"]
+    st = strings.to_string_tensor(texts)
+    low = strings.lower(st).to_strings()
+    upp = strings.upper(st).to_strings()
+    for t, l, u in zip(texts, low, upp):
+        want_l = "".join(c.lower() if len(c.lower()) == 1 else c
+                         for c in t)
+        want_u = "".join(c.upper() if len(c.upper()) == 1 else c
+                         for c in t)
+        assert l == want_l
+        assert u == want_u
+
+
+def test_strings_full_bmp_case_table():
+    st = strings.to_string_tensor(["ＡＢＣ", "ꙀꙂ"])  # fullwidth, Cyr Ext-B
+    assert strings.lower(st).to_strings() == ["ａｂｃ", "ꙁꙃ"]
+
+
+def test_strings_equal_and_length():
+    a = strings.to_string_tensor(["abc", "defg", ""])
+    b = strings.to_string_tensor(["abc", "defx", ""])
+    np.testing.assert_array_equal(np.asarray(strings.equal(a, b)),
+                                  [True, False, True])
+    np.testing.assert_array_equal(np.asarray(strings.length(a)), [3, 4, 0])
+
+
+def test_strings_lower_is_jit_safe():
+    st = strings.to_string_tensor(["ABC", "ÄÖÜ"])
+    out = jax.jit(lambda cp, ln: strings.lower(
+        strings.StringTensor(cp, ln)).codepoints)(st.codepoints, st.lengths)
+    assert strings.StringTensor(out, st.lengths).to_strings() == \
+        ["abc", "äöü"]
+
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "quick",
+         "brown", "fox", "jump", "##s", "##ed", "over", "lazy", "dog",
+         "un", "##believ", "##able", ",", ".", "!", "ca", "##n't", "'",
+         "t", "n", "##ca"]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def hf(vocab_file):
+    try:
+        from transformers import BertTokenizer as HFBert
+    except Exception:
+        pytest.skip("transformers unavailable")
+    return HFBert(vocab_file, do_lower_case=True)
+
+
+def test_tokenizer_matches_huggingface(vocab_file, hf):
+    """The wordpiece algorithm (faster_tokenizer_op.h) against the
+    canonical implementation, token-for-token."""
+    tok = BertTokenizer(vocab_file)
+    cases = [
+        "The quick brown fox jumps over the lazy dog.",
+        "unbelievable!",
+        "The UNKNOWNWORD jumped, unbelievably.",
+        "the  quick\tbrown\nfox",
+        "ÜBER the fox",   # accent strip + lower
+    ]
+    for text in cases:
+        assert tok.tokenize(text) == hf.tokenize(text), text
+
+
+def test_tokenizer_batch_encoding_matches_huggingface(vocab_file, hf):
+    tok = BertTokenizer(vocab_file)
+    texts = ["the quick brown fox", "unbelievable!"]
+    pairs = ["the lazy dog.", "the fox jumps"]
+    enc = tok(texts, pairs, max_seq_len=16)
+    for b in range(2):
+        want = hf.encode(texts[b], pairs[b])
+        n = int(enc["seq_len"][b])
+        assert list(enc["input_ids"][b, :n]) == want
+        sep1 = want.index(tok.sep_id)
+        assert list(enc["token_type_ids"][b, :n]) == \
+            [0] * (sep1 + 1) + [1] * (n - sep1 - 1)
+    # padding beyond seq_len is pad_id
+    assert (enc["input_ids"][0, int(enc["seq_len"][0]):] == 0).all()
+
+
+def test_tokenizer_empty_pair_matches_huggingface(vocab_file, hf):
+    tok = BertTokenizer(vocab_file)
+    enc = tok(["the fox"], [""], max_seq_len=8)
+    n = int(enc["seq_len"][0])
+    assert list(enc["input_ids"][0, :n]) == hf.encode("the fox", "")
+
+
+def test_tokenizer_truncation_matches_huggingface(vocab_file, hf):
+    tok = BertTokenizer(vocab_file)
+    text = "the quick brown fox jumps over the lazy dog"
+    pair = "unbelievable unbelievable unbelievable"
+    enc = tok([text], [pair], max_seq_len=12)
+    want = hf.encode(text, pair, truncation="longest_first", max_length=12)
+    n = int(enc["seq_len"][0])
+    assert n == 12
+    assert list(enc["input_ids"][0, :n]) == want
+
+
+def test_tokenizer_feeds_model_directly(vocab_file):
+    """Tokenizer output is the jitted model's feed — the end-to-end
+    serving property the reference's in-graph tokenizer op exists for."""
+    tok = BertTokenizer(vocab_file)
+    enc = tok(["the quick fox", "dog"], max_seq_len=8)
+
+    @jax.jit
+    def embed_sum(ids, lens):
+        emb = jnp.take(jnp.ones((len(VOCAB), 4)) *
+                       jnp.arange(len(VOCAB))[:, None], ids, axis=0)
+        m = (jnp.arange(ids.shape[1])[None, :] < lens[:, None])
+        return (emb * m[..., None]).sum((1, 2))
+
+    out = embed_sum(enc["input_ids"], enc["seq_len"])
+    assert out.shape == (2,) and float(out[0]) > 0
