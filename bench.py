@@ -152,6 +152,19 @@ def main():
     try:
         import jax
         import jax.numpy as jnp
+        # persistent compile cache: the expensive tunnel-side compiles
+        # (1.3B train step ≈ tens of minutes cold) are paid once; every
+        # re-bench afterwards (opportunistic prober, driver end-of-round)
+        # loads the cached executable instead
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("PT_XLA_CACHE_DIR",
+                               "/root/.cache/pt_xla_cache"))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass  # older jax without the knob: cold compiles only
         peak = _peak_flops(jax.devices()[0])
     except Exception as e:  # unhealthy runtime must still emit the line
         acquired.set()
@@ -162,9 +175,19 @@ def main():
         return 1
     acquired.set()
     mark(f"device acquired: {jax.devices()[0]}")
-    mark("start gpt")
-    result = bench_gpt(jax, jnp, peak)
-    mark(f"gpt done: {result.get('metric')}")
+
+    # selective runs (PT_BENCH_ONLY=bert,resnet50): re-capture specific
+    # sub-benches without paying the flagship compile again — the
+    # opportunistic-capture path when the tunnel's uptime is uncertain
+    only = {s.strip() for s in os.environ.get("PT_BENCH_ONLY", "").split(
+        ",") if s.strip()}
+    if only and "gpt" not in only:
+        result = {"metric": "partial_bench", "value": 1, "unit": "",
+                  "vs_baseline": 0}
+    else:
+        mark("start gpt")
+        result = bench_gpt(jax, jnp, peak)
+        mark(f"gpt done: {result.get('metric')}")
 
     # stay inside the driver's bench budget: skip sub-benches once the
     # clock runs long (the headline metric is already secured)
@@ -172,6 +195,9 @@ def main():
     extra = result.setdefault("extra", {})
     for sub in (bench_decode, bench_bert, bench_resnet50, bench_ppyoloe,
                 bench_pp):
+        name = sub.__name__.replace("bench_", "")
+        if only and name not in only:
+            continue
         if time.perf_counter() - t_start > budget:
             extra[sub.__name__ + "_skipped"] = "bench budget exhausted"
             continue
@@ -564,8 +590,12 @@ def bench_decode(jax, jnp, peak, smoke=False):
         from paddle_tpu.inference.decode_engine import (
             DecodeEngine, decode_roofline_tokens_per_sec)
         slots, s_pf, n_new2 = (2, 8, 4) if smoke else (8, 128, 128)
+        # chunked device-side stepping: one dispatch per 16 tokens/slot —
+        # without it, host/tunnel dispatch latency (not the model) bounds
+        # the measurement
         eng = DecodeEngine(model, max_slots=slots,
-                           max_len=s_pf + n_new2 + 128)
+                           max_len=s_pf + n_new2 + 128,
+                           steps_per_call=2 if smoke else 16)
         rs = np.random.RandomState(1)
         prompts = [rs.randint(0, cfg.vocab_size, s_pf) for _ in range(slots)]
         for p in prompts:  # warm both compiles + prefill

@@ -24,6 +24,12 @@ leaves to paddle-serving:
   (deterministic recompute — identical K/V values land in place).
 - **Continuous admission**: new requests join between decode steps —
   nothing waits for a "generation batch" to drain.
+- **Chunked device-side stepping** (``steps_per_call > 1``): the decode
+  loop runs as a lax.scan INSIDE one dispatch, with per-slot eos/budget
+  early-stop computed on device; admissions happen between chunks. One
+  host round-trip per chunk instead of per token — the serving loop
+  belongs on the device (the reference's analog keeps its loop inside
+  one CUDA graph).
 - **Speculative decoding** (``speculative_k > 0``, greedy only): each
   step verifies K candidate tokens per slot in ONE pass
   (`GPTBlock.verify_step`), so weights + KV prefix are read once per
@@ -100,7 +106,7 @@ class DecodeEngine:
                  buckets: Optional[Sequence[int]] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
                  top_k: int = 0, seed: int = 0, cache_dtype=None,
-                 speculative_k: int = 0):
+                 speculative_k: int = 0, steps_per_call: int = 1):
         cfg = model.cfg
         if any(model.blocks[i].moe is not None
                for i in range(cfg.n_layers)):
@@ -156,12 +162,20 @@ class DecodeEngine:
                 raise NotImplementedError(
                     "speculative decoding is greedy-only (lossless "
                     "acceptance needs argmax determinism)")
+        self.chunk = int(steps_per_call)
+        if self.chunk < 1:
+            raise ValueError("steps_per_call must be >= 1")
+        if self.chunk > 1 and self.spec_k:
+            raise NotImplementedError(
+                "steps_per_call > 1 with speculative decoding: pick one "
+                "(both amortize dispatches; spec also amortizes HBM)")
         self.steps = 0          # device round-trips (the spec-decode win)
         self.tokens_emitted = 0
 
         # caches donated: the engine rebinds them every call, and donation
         # lets XLA update the multi-GB buffers in place
         self._step_fn = jax.jit(self._step_impl, donate_argnums=(2, 3))
+        self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(2, 3))
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=(2, 3))
         self._verify_fn = jax.jit(self._verify_impl,
@@ -176,7 +190,10 @@ class DecodeEngine:
              else head["lm_head"])
         return x @ w
 
-    def _step_impl(self, head, stacked, kc, vc, lengths, last, active, rng):
+    def _one_token(self, head, stacked, kc, vc, lengths, last, active,
+                   rng):
+        """Advance every active slot one token: the shared body of the
+        single-step and chunked-step entry points."""
         temperature, top_p, top_k = self.sample
         x = jnp.take(head["wte"], last, axis=0)
         if head["wpe"] is not None:   # rope models position in attention
@@ -196,6 +213,39 @@ class DecodeEngine:
         nxt = jnp.where(active, nxt, last)
         lengths = lengths + active.astype(jnp.int32)
         return kc, vc, lengths, nxt, rng
+
+    def _step_impl(self, head, stacked, kc, vc, lengths, last, active, rng):
+        return self._one_token(head, stacked, kc, vc, lengths, last,
+                               active, rng)
+
+    def _multi_impl(self, head, stacked, kc, vc, lengths, last, active,
+                    remaining, eos, rng):
+        """``chunk`` decode steps in ONE dispatch (lax.scan over
+        _one_token), with per-slot early stop device-side: a slot stops
+        advancing when it hits its eos id or exhausts its token budget.
+
+        Serving loops belong on the device — host round-trip latency
+        (worst over a remote PJRT tunnel, still microseconds locally)
+        otherwise bounds tokens/sec regardless of model speed. The
+        reference's analog is the fused-multi-transformer loop staying
+        inside one CUDA graph. Emits (chunk, S) tokens + emit flags;
+        the host applies them in order between dispatches."""
+
+        def one(carry, _):
+            kc, vc, lengths, last, active, remaining, rng = carry
+            kc, vc, lengths, nxt, rng = self._one_token(
+                head, stacked, kc, vc, lengths, last, active, rng)
+            emit = active
+            remaining = remaining - active.astype(jnp.int32)
+            hit_eos = (nxt == eos) & (eos >= 0)
+            active = active & ~hit_eos & (remaining > 0)
+            return (kc, vc, lengths, nxt, active, remaining, rng), \
+                (nxt, emit)
+
+        (kc, vc, lengths, last, active, remaining, rng), (toks, flags) = \
+            lax.scan(one, (kc, vc, lengths, last, active, remaining, rng),
+                     None, length=self.chunk)
+        return kc, vc, lengths, last, active, remaining, rng, toks, flags
 
     def _verify_impl(self, head, stacked, kc, vc, lengths, cand, last,
                      active):
@@ -359,6 +409,8 @@ class DecodeEngine:
         self.steps += 1
         if self.spec_k:
             n = self._spec_step(live)
+        elif self.chunk > 1:
+            n = self._chunk_step(live)
         else:
             (self.kc, self.vc, self.lengths, self.last,
              self._rng) = self._step_fn(
@@ -370,6 +422,35 @@ class DecodeEngine:
             n = len(live)
         self.tokens_emitted += n
         return n
+
+    def _chunk_step(self, live) -> int:
+        """One dispatch advancing every live slot up to ``chunk`` tokens,
+        early-stopping per slot device-side (eos / budget)."""
+        remaining = np.zeros((self.S,), np.int32)
+        eos = np.full((self.S,), -1, np.int32)
+        for slot, req in live:
+            remaining[slot] = req.max_new_tokens - len(req.tokens)
+            if req.eos_id is not None:
+                eos[slot] = req.eos_id
+        (self.kc, self.vc, self.lengths, self.last, self.active,
+         _, self._rng, toks, flags) = self._multi_fn(
+            self._head, self._stacked, self.kc, self.vc, self.lengths,
+            self.last, self.active, jnp.asarray(remaining),
+            jnp.asarray(eos), self._rng)
+        toks = np.asarray(toks)
+        flags = np.asarray(flags)
+        total = 0
+        for slot, req in live:
+            for j in range(self.chunk):
+                if flags[j, slot]:
+                    req.tokens.append(int(toks[j, slot]))
+                    total += 1
+            if len(req.tokens) >= req.max_new_tokens or (
+                    req.eos_id is not None and req.tokens
+                    and req.tokens[-1] == req.eos_id):
+                req.done = True
+                self._slot_req[slot] = None
+        return total
 
     def _spec_step(self, live) -> int:
         K = self.spec_k

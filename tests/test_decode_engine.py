@@ -106,6 +106,56 @@ def test_mid_flight_join_is_continuous():
     assert all(r.done for r in reqs)
 
 
+def test_chunked_steps_parity_with_per_token():
+    """steps_per_call>1 runs the decode loop device-side (one dispatch per
+    chunk); tokens must be identical to the per-token engine, including
+    staggered admissions between chunks."""
+    model = _model()
+    rs = np.random.RandomState(5)
+    prompts = [list(rs.randint(0, 96, size=n)) for n in (3, 9, 17, 5)]
+    n_new = [6, 4, 13, 5]
+    eng = DecodeEngine(model, max_slots=2, max_len=128, steps_per_call=4)
+    r0 = eng.submit(prompts[0], n_new[0])
+    r1 = eng.submit(prompts[1], n_new[1])
+    eng.step()
+    r2 = eng.submit(prompts[2], n_new[2])
+    r3 = eng.submit(prompts[3], n_new[3])
+    eng.run()
+    for req, p, n in zip((r0, r1, r2, r3), prompts, n_new):
+        assert req.done
+        assert req.tokens == _reference_tokens(model, p, n), \
+            f"prompt {p} diverged under chunked stepping"
+
+
+def test_chunked_steps_fewer_dispatches():
+    model = _model()
+    rs = np.random.RandomState(6)
+    eng = DecodeEngine(model, max_slots=2, max_len=128, steps_per_call=8)
+    reqs = [eng.submit(list(rs.randint(0, 96, size=4)),
+                       max_new_tokens=16) for _ in range(2)]
+    eng.run()
+    assert all(r.done for r in reqs)
+    # 16 tokens after the prefill-sampled first one → 15 decode steps →
+    # 2 chunked dispatches (vs 15 per-token)
+    assert eng.steps <= 3
+    assert eng._multi_fn._cache_size() == 1, "chunked step recompiled"
+
+
+def test_chunked_eos_stops_mid_chunk():
+    """A slot hitting eos inside a chunk must emit nothing after it, and
+    its budget/eos accounting must match the per-token engine."""
+    model = _model()
+    prompt = [1, 2, 3]
+    ref = _reference_tokens(model, prompt, 8)
+    eos = ref[2]
+    cut = ref.index(eos) + 1
+    eng = DecodeEngine(model, max_slots=1, max_len=128, steps_per_call=8)
+    req = eng.submit(prompt, max_new_tokens=8, eos_id=eos)
+    eng.run()
+    assert req.done and req.tokens == ref[:cut]
+    assert eng.num_active == 0
+
+
 def test_tail_chunk_never_overruns_cache():
     """Code-review regression: a 276-token prompt with buckets (16, 256)
     and T=384 used to pick a 256 bucket at start=256 → the write window
