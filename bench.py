@@ -181,6 +181,8 @@ def main():
     # opportunistic-capture path when the tunnel's uptime is uncertain
     only = {s.strip() for s in os.environ.get("PT_BENCH_ONLY", "").split(
         ",") if s.strip()}
+    if "decode" in only:
+        only.add("gpt")  # bench_decode reuses the flagship run's model
     if only and "gpt" not in only:
         result = {"metric": "partial_bench", "value": 1, "unit": "",
                   "vs_baseline": 0}

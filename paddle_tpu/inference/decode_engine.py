@@ -174,7 +174,7 @@ class DecodeEngine:
 
         # caches donated: the engine rebinds them every call, and donation
         # lets XLA update the multi-GB buffers in place
-        self._step_fn = jax.jit(self._step_impl, donate_argnums=(2, 3))
+        self._step_fn = jax.jit(self._one_token, donate_argnums=(2, 3))
         self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(2, 3))
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=(2, 3))
@@ -213,10 +213,6 @@ class DecodeEngine:
         nxt = jnp.where(active, nxt, last)
         lengths = lengths + active.astype(jnp.int32)
         return kc, vc, lengths, nxt, rng
-
-    def _step_impl(self, head, stacked, kc, vc, lengths, last, active, rng):
-        return self._one_token(head, stacked, kc, vc, lengths, last,
-                               active, rng)
 
     def _multi_impl(self, head, stacked, kc, vc, lengths, last, active,
                     remaining, eos, rng):
