@@ -18,26 +18,41 @@ Transport is the RPC layer (distributed/rpc.py): handlers are module-level
 functions executed in the server's rpc pool; table state lives in the
 server process's ``_TABLES`` registry.
 
-**Scale envelope (deliberate non-parity).** This is an in-memory,
-single-socket-per-peer PS: tables live in server RAM, the wire is the
-framework RPC over TCP, and sharding is id-hash only. The reference's
-production machinery — brpc services with rpc compression, SSD-backed
-tables (ssd_sparse_table), geo-async sync, heterogeneous PS
-(cpu+gpu, heter_ps/), GPUPS HBM embedding caches — is out of scope
-here: those exist to serve trillion-row embeddings at datacenter QPS,
+Production features carried over (beyond the in-memory core):
+
+- **Disk-backed sparse tables** (``SSDSparseTable`` ≙
+  distributed/ps/table/ssd_sparse_table.cc): a bounded hot-row LRU in
+  RAM, cold rows spilled to a per-table sqlite file — table capacity is
+  disk, not server RAM, and the file doubles as crash persistence.
+- **Geo-async SGD** (``GeoSGDClient`` ≙ fleet geo mode,
+  distributed/ps/service/communicator.cc GeoCommunicator): workers train
+  on local replicas and exchange accumulated parameter DELTAS every
+  ``geo_step`` steps; the server sums deltas from all workers, so sync
+  traffic is O(params/geo_step) and workers never block each other.
+- **Table save/load** (``PSClient.save/load`` ≙ fleet
+  save_persistables in PS mode): server-side snapshot of every table to
+  npz, reloadable into a fresh job.
+
+**Scale envelope (deliberate non-parity).** The wire is the framework
+RPC over TCP and sharding is id-hash only. The reference's remaining
+production machinery — brpc services with rpc compression,
+heterogeneous PS (cpu+gpu, heter_ps/), GPUPS HBM embedding caches —
+is out of scope: those serve trillion-row embeddings at datacenter QPS,
 which is not a TPU-training bottleneck this framework targets. The API
-surface (push/pull dense+sparse, server-side optimizers) matches, so
-models port; the capacity ceiling (≈ server RAM, ≈ thousands of QPS)
-does not.
+surface matches, so models port; the QPS ceiling does not.
 """
 
+import collections
+import os
+import sqlite3
 import threading
 
 import numpy as np
 
 from paddle_tpu.distributed import rpc
 
-__all__ = ["PSClient", "init_server_tables", "DenseTable", "SparseTable"]
+__all__ = ["PSClient", "GeoSGDClient", "init_server_tables", "DenseTable",
+           "SparseTable", "SSDSparseTable"]
 
 _TABLES = {}
 _TLOCK = threading.Lock()
@@ -64,6 +79,20 @@ class DenseTable:
                 self.w -= self.lr * grad / (np.sqrt(self.acc) + 1e-8)
             else:
                 self.w -= self.lr * grad
+
+    def apply_delta(self, delta):
+        """Geo-async: ``w += delta`` (worker-side optimizer already ran)."""
+        with self.lock:
+            self.w += np.asarray(delta, np.float32)
+
+    def state(self):
+        with self.lock:
+            return {"w": self.w.copy(), "acc": self.acc.copy()}
+
+    def load_state(self, st):
+        with self.lock:
+            self.w[...] = st["w"]
+            self.acc[...] = st["acc"]
 
 
 class SparseTable:
@@ -110,6 +139,133 @@ class SparseTable:
         with self.lock:
             return len(self.rows)
 
+    def apply_delta(self, ids, deltas):
+        """Geo-async: server-side ``row += delta`` (no optimizer — the
+        worker already applied its optimizer locally)."""
+        deltas = np.asarray(deltas, np.float32)
+        with self.lock:
+            for i, d in zip(ids, deltas):
+                self._row(int(i))[...] += d
+
+    def state(self):
+        """Snapshot → dict of arrays (save_persistables analog)."""
+        with self.lock:
+            ids = np.asarray(sorted(self.rows), np.int64)
+            return {"ids": ids,
+                    "rows": np.stack([self.rows[int(i)] for i in ids])
+                    if len(ids) else np.zeros((0, self.dim), np.float32),
+                    "acc": np.stack([self.acc[int(i)] for i in ids])
+                    if len(ids) else np.zeros((0, self.dim), np.float32)}
+
+    def load_state(self, st):
+        with self.lock:
+            # rows absent from the snapshot reset to lazy init
+            self.rows.clear()
+            self.acc.clear()
+            for i, r, a in zip(st["ids"], st["rows"], st["acc"]):
+                self.rows[int(i)] = np.array(r, np.float32)
+                self.acc[int(i)] = np.array(a, np.float32)
+
+
+class SSDSparseTable(SparseTable):
+    """Disk-backed sparse table: hot rows in a bounded RAM LRU, cold rows
+    in a per-table sqlite file (≙ ssd_sparse_table.cc — RocksDB there;
+    sqlite here for a zero-dependency store with the same contract:
+    capacity = disk, RAM = cache, file = persistence).
+
+    Same pull/push/optimizer semantics as SparseTable — eviction and
+    fault-in are invisible to the protocol."""
+
+    def __init__(self, dim, path, cache_rows=4096, **kwargs):
+        super().__init__(dim, **kwargs)
+        self.rows = collections.OrderedDict()   # hot LRU (id → row)
+        self.cache_rows = int(cache_rows)
+        self.path = path
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("CREATE TABLE IF NOT EXISTS rows ("
+                         "id INTEGER PRIMARY KEY, w BLOB, acc BLOB)")
+        self._db.commit()
+
+    def _row(self, i):
+        r = self.rows.get(i)
+        if r is not None:
+            self.rows.move_to_end(i)
+            return r
+        got = self._db.execute(
+            "SELECT w, acc FROM rows WHERE id=?", (i,)).fetchone()
+        if got is not None:  # fault the cold row in
+            r = np.frombuffer(got[0], np.float32).copy()
+            a = np.frombuffer(got[1], np.float32).copy()
+        else:                # first touch: deterministic lazy init
+            rs = np.random.RandomState(
+                (self.seed * 1000003 + i) & 0x7FFFFFFF)
+            r = (rs.normal(size=(self.dim,)) * self.init_std).astype(
+                np.float32)
+            a = np.zeros((self.dim,), np.float32)
+        self.rows[i] = r
+        self.acc[i] = a
+        evicted = False
+        while len(self.rows) > self.cache_rows:
+            old, w = self.rows.popitem(last=False)
+            self._write_db(old, w, self.acc.pop(old))
+            evicted = True
+        if evicted:
+            # commit immediately: evicted rows must survive a crash (the
+            # file IS the persistence story), and an open implicit
+            # transaction would also lock out other connections
+            self._db.commit()
+        return r
+
+    def _write_db(self, i, w, a):
+        self._db.execute(
+            "INSERT OR REPLACE INTO rows (id, w, acc) VALUES (?, ?, ?)",
+            (i, w.tobytes(), a.tobytes()))
+
+    def flush(self):
+        """Write every hot row to disk (kept hot). Called by save and at
+        any point durability is wanted."""
+        with self.lock:
+            for i, w in self.rows.items():
+                self._write_db(i, w, self.acc[i])
+            self._db.commit()
+
+    def size(self):
+        with self.lock:
+            hot = set(self.rows)
+            n_db = self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()[0]
+            n_db_hot = self._db.execute(
+                "SELECT COUNT(*) FROM rows WHERE id IN (%s)" %
+                ",".join(map(str, hot))).fetchone()[0] if hot else 0
+            return n_db + len(hot) - n_db_hot
+
+    def state(self):
+        self.flush()
+        with self.lock:
+            got = self._db.execute(
+                "SELECT id, w, acc FROM rows ORDER BY id").fetchall()
+        ids = np.asarray([g[0] for g in got], np.int64)
+        return {"ids": ids,
+                "rows": np.stack([np.frombuffer(g[1], np.float32)
+                                  for g in got])
+                if len(got) else np.zeros((0, self.dim), np.float32),
+                "acc": np.stack([np.frombuffer(g[2], np.float32)
+                                 for g in got])
+                if len(got) else np.zeros((0, self.dim), np.float32)}
+
+    def load_state(self, st):
+        with self.lock:
+            # exact restore: rows absent from the snapshot reset to lazy
+            # init, and the hot cache (which shadows the db on fault-in)
+            # may hold rows newer than the snapshot — drop both
+            self._db.execute("DELETE FROM rows")
+            for i, r, a in zip(st["ids"], st["rows"], st["acc"]):
+                self._write_db(int(i), np.asarray(r, np.float32),
+                               np.asarray(a, np.float32))
+            self._db.commit()
+            self.rows.clear()
+            self.acc.clear()
+
 
 # -- server-side rpc handlers (module-level → picklable by reference) -------
 
@@ -124,6 +280,13 @@ def init_server_tables(specs):
                 _TABLES[name] = DenseTable(arg, **kwargs)
             elif kind == "sparse":
                 _TABLES[name] = SparseTable(arg, **kwargs)
+            elif kind == "ssd_sparse":
+                kw = dict(kwargs)
+                # per-server file: several servers may share a filesystem
+                kw["path"] = os.path.join(
+                    kw.pop("dir"), f"{name}.{rpc.get_worker_info().name}"
+                    ".sqlite")
+                _TABLES[name] = SSDSparseTable(arg, **kw)
             else:
                 raise ValueError(kind)
     return sorted(_TABLES)
@@ -149,6 +312,40 @@ def _push_sparse(name, ids, grads):
 
 def _sparse_size(name):
     return _TABLES[name].size()
+
+
+def _apply_dense_delta(name, delta):
+    _TABLES[name].apply_delta(delta)
+    return True
+
+
+def _apply_sparse_delta(name, ids, deltas):
+    _TABLES[name].apply_delta(ids, deltas)
+    return True
+
+
+def _save_tables(path):
+    """Snapshot every local table to ``path/<table>.<server>.npz``
+    (≙ fleet.save_persistables in PS mode)."""
+    me = rpc.get_worker_info().name
+    os.makedirs(path, exist_ok=True)
+    saved = []
+    for name, t in sorted(_TABLES.items()):
+        f = os.path.join(path, f"{name}.{me}.npz")
+        np.savez(f, **t.state())
+        saved.append(f)
+    return saved
+
+
+def _load_tables(path):
+    me = rpc.get_worker_info().name
+    loaded = []
+    for name, t in sorted(_TABLES.items()):
+        f = os.path.join(path, f"{name}.{me}.npz")
+        if os.path.exists(f):
+            t.load_state(dict(np.load(f)))
+            loaded.append(f)
+    return loaded
 
 
 class PSClient:
@@ -245,3 +442,127 @@ class PSClient:
     def sparse_size(self, name):
         return sum(rpc.rpc_sync(s, _sparse_size, args=(name,))
                    for s in self.servers)
+
+    def save(self, path):
+        """Snapshot every table on every server (save_persistables)."""
+        return [f for s in self.servers
+                for f in rpc.rpc_sync(s, _save_tables, args=(path,))]
+
+    def load(self, path):
+        return [f for s in self.servers
+                for f in rpc.rpc_sync(s, _load_tables, args=(path,))]
+
+    def apply_dense_delta(self, name, delta):
+        """Geo-mode wire op: server-side ``w += delta``."""
+        from paddle_tpu import stats
+        delta = np.asarray(delta, np.float32)
+        stats.add("ps/pushes")
+        stats.add("ps/push_bytes", delta.nbytes)
+        return rpc.rpc_sync(self._dense_home(name), _apply_dense_delta,
+                            args=(name, delta))
+
+    def apply_sparse_delta(self, name, ids, deltas):
+        """Geo-mode wire op: ``row[id] += delta``, id-sharded like
+        push_sparse (same fan-out, same counters)."""
+        from paddle_tpu import stats
+        ids = np.asarray(ids, np.int64)
+        deltas = np.asarray(deltas, np.float32)
+        stats.add("ps/pushes")
+        stats.add("ps/push_bytes", deltas.nbytes)
+        n = len(self.servers)
+        futs = []
+        for s_idx in range(n):
+            mask = (ids % n) == s_idx
+            if mask.any():
+                futs.append(rpc.rpc_async(
+                    self.servers[s_idx], _apply_sparse_delta,
+                    args=(name, ids[mask], deltas[mask])))
+        for f in futs:
+            f.wait(120.0)
+
+
+class GeoSGDClient:
+    """Geo-async worker replica (≙ fleet geo mode / GeoCommunicator).
+
+    The worker trains against LOCAL replicas; every ``geo_step`` local
+    updates it ships the accumulated parameter delta to the servers
+    (which just sum deltas — each worker already ran its optimizer) and
+    refreshes its replica, picking up the other workers' progress.
+    Between syncs nothing blocks and nothing crosses the wire.
+
+        geo = GeoSGDClient(PSClient(servers), geo_step=16)
+        w = geo.register_dense("w")          # local replica (np array)
+        for step ...:
+            w -= lr * grad                    # any local optimizer
+            geo.step()                        # counts; syncs every 16
+
+    Sparse replicas track touched rows only (pull-on-touch, delta-push).
+    """
+
+    def __init__(self, client: "PSClient", geo_step: int = 16):
+        self.client = client
+        self.geo_step = int(geo_step)
+        self._dense = {}    # name → local replica
+        self._dense_base = {}
+        self._sparse = {}   # name → {id: row}
+        self._sparse_base = {}
+        self._dirty = {}    # name → ids updated since the last sync
+        self._steps = 0
+
+    def register_dense(self, name):
+        w = np.asarray(self.client.pull_dense(name), np.float32)
+        self._dense[name] = w
+        self._dense_base[name] = w.copy()
+        return w
+
+    def pull_sparse(self, name, ids):
+        """Rows from the local replica, faulting unseen ids from the
+        servers."""
+        cache = self._sparse.setdefault(name, {})
+        base = self._sparse_base.setdefault(name, {})
+        missing = [int(i) for i in np.asarray(ids).reshape(-1)
+                   if int(i) not in cache]
+        if missing:
+            rows = self.client.pull_sparse(name, np.asarray(missing))
+            for i, r in zip(missing, rows):
+                cache[i] = np.array(r, np.float32)
+                base[i] = cache[i].copy()
+        return np.stack([cache[int(i)]
+                         for i in np.asarray(ids).reshape(-1)])
+
+    def update_sparse(self, name, ids, new_rows):
+        cache = self._sparse[name]
+        dirty = self._dirty.setdefault(name, set())
+        for i, r in zip(np.asarray(ids).reshape(-1),
+                        np.asarray(new_rows, np.float32)):
+            cache[int(i)][...] = r
+            dirty.add(int(i))
+
+    def step(self):
+        self._steps += 1
+        if self._steps % self.geo_step == 0:
+            self.sync()
+
+    def sync(self):
+        """Push deltas for rows dirtied since the last sync, then refresh
+        those replicas (other workers' deltas land here). Sync traffic is
+        O(dirty rows), not O(ever-touched rows); rows not touched since
+        their pull stay stale until re-dirtied — standard geo staleness."""
+        for name, w in self._dense.items():
+            delta = w - self._dense_base[name]
+            self.client.apply_dense_delta(name, delta)
+            fresh = np.asarray(self.client.pull_dense(name), np.float32)
+            w[...] = fresh
+            self._dense_base[name] = fresh.copy()
+        for name, cache in self._sparse.items():
+            base = self._sparse_base[name]
+            ids = np.asarray(sorted(self._dirty.get(name, ())), np.int64)
+            if not len(ids):
+                continue
+            deltas = np.stack([cache[int(i)] - base[int(i)] for i in ids])
+            self.client.apply_sparse_delta(name, ids, deltas)
+            fresh = self.client.pull_sparse(name, ids)
+            for i, r in zip(ids, fresh):
+                cache[int(i)][...] = r
+                base[int(i)] = cache[int(i)].copy()
+            self._dirty[name].clear()

@@ -71,6 +71,53 @@ def worker(rank, world, port, tmpdir):
         np.testing.assert_allclose(rows1, rows0 - 0.1, atol=1e-5)
         assert client.sparse_size("emb") == 5
 
+        # --- disk-backed sparse table over the same protocol ---
+        client.create_tables({
+            "big_emb": ("ssd_sparse", 4, {"dir": tmpdir, "cache_rows": 3,
+                                          "lr": 0.5, "optimizer": "sgd",
+                                          "seed": 7})})
+        big_ids = np.arange(20, dtype=np.int64)   # >> per-server cache
+        b0 = client.pull_sparse("big_emb", big_ids)
+        client.push_sparse("big_emb", big_ids,
+                           np.ones((20, 4), np.float32))
+        b1 = client.pull_sparse("big_emb", big_ids)
+        np.testing.assert_allclose(b1, b0 - 0.5, atol=1e-6)
+        assert client.sparse_size("big_emb") == 20
+
+        # --- save / mutate / load round trip (save_persistables) ---
+        snap = os.path.join(tmpdir, "snap")
+        files = client.save(snap)
+        assert len(files) >= 3  # dense + emb×2 + big_emb×2 shards
+        client.push_dense("dense_w", g)           # diverge after snapshot
+        client.push_sparse("big_emb", big_ids,
+                           np.ones((20, 4), np.float32))
+        client.load(snap)
+        np.testing.assert_allclose(client.pull_dense("dense_w"), w1,
+                                   atol=1e-6)
+        np.testing.assert_allclose(client.pull_sparse("big_emb", big_ids),
+                                   b1, atol=1e-6)
+
+        # --- geo-async: two worker replicas exchange deltas ---
+        geo_a = ps.GeoSGDClient(client, geo_step=2)
+        geo_b = ps.GeoSGDClient(client, geo_step=2)
+        wa = geo_a.register_dense("dense_w")
+        wb = geo_b.register_dense("dense_w")
+        start = wa.copy()
+        wa -= 0.25   # worker A's local optimizer steps
+        geo_a.step()
+        geo_a.step()                 # hits geo_step → pushes delta -0.25
+        wb -= 0.5    # worker B trained concurrently on the OLD replica
+        geo_b.sync()                 # pushes -0.5, pulls A's too
+        np.testing.assert_allclose(wb, start - 0.75, atol=1e-6)
+        geo_a.sync()                 # A refreshes: sees B's delta now
+        np.testing.assert_allclose(wa, start - 0.75, atol=1e-6)
+        # sparse geo: touch, train locally, sync
+        ra = geo_a.pull_sparse("emb", [1, 5])
+        geo_a.update_sparse("emb", [1, 5], ra + 2.0)
+        geo_a.sync()
+        np.testing.assert_allclose(client.pull_sparse("emb", [1, 5]),
+                                   ra + 2.0, atol=1e-5)
+
         with open(os.path.join(tmpdir, "ok_trainer"), "w") as f:
             f.write("1")
 

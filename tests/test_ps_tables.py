@@ -1,0 +1,98 @@
+"""In-process PS table semantics: SSD-backed sparse table (eviction,
+fault-in, persistence, parity with the RAM table) and geo-delta /
+state-snapshot plumbing (≙ ssd_sparse_table.cc, GeoCommunicator,
+save_persistables).  The cross-process protocol is test_rpc_ps.py."""
+
+import numpy as np
+
+from paddle_tpu.distributed.ps import (DenseTable, SparseTable,
+                                       SSDSparseTable)
+
+
+def test_ssd_matches_ram_table_through_eviction(tmp_path):
+    """Same ids, same pushes → same rows, even when the SSD table's hot
+    cache (4 rows) is a fraction of the 32-row working set."""
+    ram = SparseTable(8, lr=0.1, optimizer="adagrad", seed=3)
+    ssd = SSDSparseTable(8, str(tmp_path / "t.sqlite"), cache_rows=4,
+                         lr=0.1, optimizer="adagrad", seed=3)
+    rs = np.random.RandomState(0)
+    for _ in range(10):
+        ids = rs.randint(0, 32, size=6)
+        g = rs.randn(6, 8).astype(np.float32)
+        np.testing.assert_allclose(ram.pull(ids), ssd.pull(ids), atol=1e-6)
+        ram.push(ids, g)
+        ssd.push(ids, g)
+    allids = np.arange(32)
+    np.testing.assert_allclose(ram.pull(allids), ssd.pull(allids),
+                               atol=1e-6)
+    assert ssd.size() == ram.size() == 32
+    assert len(ssd.rows) <= 4  # the LRU actually bounded RAM
+
+
+def test_ssd_persistence_across_reopen(tmp_path):
+    path = str(tmp_path / "p.sqlite")
+    t1 = SSDSparseTable(4, path, cache_rows=2, lr=0.5, optimizer="sgd",
+                        seed=1)
+    ids = np.array([1, 2, 3])
+    before = t1.pull(ids)
+    t1.push(ids, np.ones((3, 4), np.float32))
+    after = t1.pull(ids)
+    t1.flush()
+    # a NEW table over the same file sees the trained rows, not lazy init
+    t2 = SSDSparseTable(4, path, cache_rows=2, lr=0.5, optimizer="sgd",
+                        seed=1)
+    np.testing.assert_allclose(t2.pull(ids), after, atol=1e-6)
+    assert not np.allclose(after, before)
+
+
+def test_ssd_evictions_survive_without_flush(tmp_path):
+    """Code-review regression: evicted rows must be COMMITTED at eviction
+    time — crash persistence can't depend on a clean flush()."""
+    path = str(tmp_path / "c.sqlite")
+    t1 = SSDSparseTable(4, path, cache_rows=2, lr=0.5, optimizer="sgd",
+                        seed=1)
+    ids = np.arange(8)
+    t1.pull(ids)
+    t1.push(ids, np.ones((8, 4), np.float32))
+    trained = t1.pull(ids)
+    # NO flush: a second connection (≙ the restarted server) must still
+    # see every evicted row
+    t2 = SSDSparseTable(4, path, cache_rows=8, lr=0.5, optimizer="sgd",
+                        seed=1)
+    evicted = [i for i in range(8) if i not in t1.rows]
+    assert len(evicted) >= 6
+    np.testing.assert_allclose(t2.pull(evicted),
+                               trained[np.asarray(evicted)], atol=1e-6)
+
+
+def test_state_snapshot_roundtrip(tmp_path):
+    for make in (lambda: SparseTable(4, seed=2),
+                 lambda: SSDSparseTable(
+                     4, str(tmp_path / f"s{np.random.randint(1e9)}.sqlite"),
+                     cache_rows=2, seed=2)):
+        t = make()
+        ids = np.array([0, 5, 9])
+        t.push(ids, np.full((3, 4), 2.0, np.float32))
+        want = t.pull(ids)
+        st = t.state()
+        fresh = make()
+        fresh.load_state(st)
+        np.testing.assert_allclose(fresh.pull(ids), want, atol=1e-6)
+
+    d = DenseTable((3, 2), lr=0.1, seed=4)
+    d.push(np.ones((3, 2), np.float32))
+    st = d.state()
+    d2 = DenseTable((3, 2), lr=0.1, seed=9)
+    d2.load_state(st)
+    np.testing.assert_allclose(d2.pull(), d.pull())
+
+
+def test_geo_delta_application():
+    d = DenseTable((2, 2), lr=0.1, seed=0)
+    w0 = d.pull()
+    d.apply_delta(np.full((2, 2), 0.5, np.float32))
+    np.testing.assert_allclose(d.pull(), w0 + 0.5)
+    s = SparseTable(3, seed=0)
+    r0 = s.pull([7])
+    s.apply_delta([7], np.full((1, 3), -1.0, np.float32))
+    np.testing.assert_allclose(s.pull([7]), r0 - 1.0)
