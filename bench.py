@@ -33,10 +33,66 @@ def _sync(x):
     return float(x)
 
 
+def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
+    """The one GPT train-step measurement recipe (shared by bench_gpt and
+    bench_longctx): build model + bf16-moment AdamW, AOT-compile once (the
+    same executable serves cost analysis and the timed loop -- a second
+    trace/compile would double the tunnel-side compile cost), time, and
+    report tokens/s + MFU. Returns (model, metrics)."""
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.models import gpt
+
+    model = gpt.GPT(cfg, seed=0)
+    opt = optim.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                      moment_dtype=jnp.bfloat16)
+    params, opt_state = gpt.init_train_state(model, opt)
+    step = gpt.build_train_step(model, opt)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (batch, cfg.max_seq_len)), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+
+    compiled = step.lower(params, opt_state, tokens, rng).compile()
+    try:
+        hw_flops = compiled.cost_analysis().get("flops", 0.0)
+    except Exception:
+        hw_flops = 0.0
+    # peak-memory evidence for the fused blockwise CE (the (B,S,V) logits
+    # never exist in HBM in either direction): XLA's own analysis of THE
+    # executable that will run
+    try:
+        ma = compiled.memory_analysis()
+        step_peak_mb = round((ma.temp_size_in_bytes
+                              + ma.output_size_in_bytes) / 2**20)
+    except Exception:
+        step_peak_mb = None
+
+    for _ in range(warmup):
+        params, opt_state, loss = compiled(params, opt_state, tokens, rng)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = compiled(params, opt_state, tokens, rng)
+    _sync(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = batch * cfg.max_seq_len / dt
+    mfu = cfg.flops_per_token() * tokens_per_sec / peak
+    return model, {
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu_model_flops": round(mfu, 4),
+        "hw_util_cost_analysis": round(hw_flops / dt / peak, 4)
+        if hw_flops else None,
+        "step_ms": round(dt * 1e3, 2),
+        "step_peak_mb": step_peak_mb,
+        "batch": batch,
+        "seq": cfg.max_seq_len,
+    }
+
+
 def bench_gpt(jax, jnp, peak):
     """GPT-3 1.3B (north-star config) single-chip train step; falls back to
     350M when HBM is too small."""
-    from paddle_tpu import optimizer as optim
     from paddle_tpu.models import gpt
 
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -55,71 +111,25 @@ def bench_gpt(jax, jnp, peak):
     last_err = None
     for name, cfg, batch in trials:
         try:
-            model = gpt.GPT(cfg, seed=0)
-            opt = optim.AdamW(learning_rate=1e-4, weight_decay=0.01,
-                              moment_dtype=jnp.bfloat16)
-            params, opt_state = gpt.init_train_state(model, opt)
-            step = gpt.build_train_step(model, opt)
-            tokens = jnp.asarray(
-                np.random.RandomState(0).randint(
-                    0, cfg.vocab_size, (batch, cfg.max_seq_len)), jnp.int32)
-            rng = jax.random.PRNGKey(0)
-
-            # AOT-compile once; the same executable serves cost analysis
-            # and the timed loop (a second trace/compile would double the
-            # tunnel-side compile cost)
-            compiled = step.lower(params, opt_state, tokens, rng).compile()
-            try:
-                hw_flops = compiled.cost_analysis().get("flops", 0.0)
-            except Exception:
-                hw_flops = 0.0
-            # peak-memory evidence for the fused blockwise CE (the
-            # (B,S,V) logits no longer exist in HBM): XLA's own analysis
-            # of THE executable that will run
-            try:
-                ma = compiled.memory_analysis()
-                step_peak_mb = round((ma.temp_size_in_bytes
-                                      + ma.output_size_in_bytes) / 2**20)
-            except Exception:
-                step_peak_mb = None
-            step = compiled
-
-            for _ in range(warmup):
-                params, opt_state, loss = step(params, opt_state, tokens,
-                                               rng)
-            _sync(loss)
-
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                params, opt_state, loss = step(params, opt_state, tokens,
-                                               rng)
-            _sync(loss)
-            dt = (time.perf_counter() - t0) / iters
-
-            tokens_per_sec = batch * cfg.max_seq_len / dt
-            mfu = cfg.flops_per_token() * tokens_per_sec / peak
+            model, m = _timed_gpt_train_step(jax, jnp, peak, cfg, batch,
+                                             warmup, iters)
             bench_gpt.model = model  # reused by bench_decode (params
-            # already resident on the chip — the tunnel transfer is slow)
+            # already resident on the chip -- the tunnel transfer is slow)
             return {
                 "metric": f"{name}_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
+                "value": m.pop("tokens_per_sec"),
                 "unit": "tokens/s",
-                "vs_baseline": round(mfu / 0.35, 4),
-                "extra": {
-                    "mfu_model_flops": round(mfu, 4),
-                    "hw_util_cost_analysis": round(hw_flops / dt / peak, 4)
-                    if hw_flops else None,
-                    "step_ms": round(dt * 1e3, 2),
-                    "step_peak_mb": step_peak_mb,
-                    "batch": batch,
-                    "seq": cfg.max_seq_len,
-                },
+                "vs_baseline": round(m["mfu_model_flops"] / 0.35, 4),
+                "extra": m,
             }
-        except Exception as e:  # OOM etc. → try next config
-            last_err = e
+        except Exception as e:  # OOM etc. -> try next config
+            # keep only the text: the exception's traceback would pin the
+            # failed trial's whole train state (helper frame locals) in
+            # HBM while the fallback config compiles
+            last_err = str(e)
             continue
     return {"metric": "bench_failed", "value": 0, "unit": "",
-            "vs_baseline": 0, "error": str(last_err)[:200]}
+            "vs_baseline": 0, "error": (last_err or "")[:200]}
 
 
 def main():
@@ -195,8 +205,10 @@ def main():
     # clock runs long (the headline metric is already secured)
     budget = float(os.environ.get("PT_BENCH_BUDGET_S", 480))
     extra = result.setdefault("extra", {})
-    for sub in (bench_decode, bench_bert, bench_resnet50, bench_ppyoloe,
-                bench_pp):
+    # cheap BASELINE rows first (~6 min total): a tight budget then
+    # truncates the decode suite, not the headline coverage
+    for sub in (bench_bert, bench_resnet50, bench_ppyoloe, bench_pp,
+                bench_decode, bench_longctx):
         name = sub.__name__.replace("bench_", "")
         if only and name not in only:
             continue
@@ -535,6 +547,34 @@ def bench_bert(jax, jnp, peak, smoke=False):
     return out
 
 
+def bench_longctx(jax, jnp, peak, smoke=False):
+    """Long-context train step (SURVEY §5.7): GPT-350M at 4k/8k tokens,
+    flash-attention path + remat — tokens/s/chip and MFU per sequence
+    length. MFU holding up as seq grows is the whole point of the online-
+    softmax kernel (attention FLOPs grow quadratically and are counted)."""
+    if jax.default_backend() in ("cpu",) and not smoke:
+        return {}
+    from paddle_tpu.models import gpt
+
+    out = {}
+    trials = (((64, 2),) if smoke else ((4096, 2), (8192, 1)))
+    for seq, batch in trials:
+        try:
+            cfg = (gpt.gpt_tiny(max_seq_len=seq) if smoke
+                   else gpt.gpt3_350m(max_seq_len=seq, remat=True))
+            model, m = _timed_gpt_train_step(jax, jnp, peak, cfg, batch,
+                                             warmup=2, iters=3)
+            out[f"longctx_{seq}_tokens_per_sec"] = m["tokens_per_sec"]
+            out[f"longctx_{seq}_mfu"] = m["mfu_model_flops"]
+            # release this trial's train state before the next sequence
+            # length compiles (the 1.3B flagship model is still resident
+            # for bench_decode; stacking two 350M states on top OOMs)
+            del model, m
+        except Exception as e:
+            out[f"longctx_{seq}_error"] = str(e)[:120]
+    return out
+
+
 def bench_decode(jax, jnp, peak, smoke=False):
     """KV-cache autoregressive decode throughput (serving path). Reuses the
     train bench's model so the 2.6GB param transfer over the tunnel is not
@@ -543,23 +583,30 @@ def bench_decode(jax, jnp, peak, smoke=False):
     if model is None or (jax.default_backend() in ("cpu",) and not smoke):
         return {}
     cfg = model.cfg
+    import os
+    sections = {s.strip() for s in os.environ.get(
+        "PT_DECODE_SECTIONS", "generate,int8,engine,spec").split(",")}
     b, s0, new = (2, 8, 4) if smoke else (8, 128, 64)
+    res = {"decode_batch": b, "decode_prefill": s0, "decode_new": new}
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size, (b, s0)),
         jnp.int32)
-    out = model.generate(tokens, max_new_tokens=new, max_len=s0 + new)
-    _sync(out[0, -1])  # warm/compile
-    t0 = time.perf_counter()
-    out = model.generate(tokens, max_new_tokens=new, max_len=s0 + new)
-    _sync(out[0, -1])
-    dt = time.perf_counter() - t0
     name = "1p3b" if cfg.d_model >= 2048 else "gpt"
-    res = {f"decode_{name}_tokens_per_sec": round(b * new / dt, 1),
-           "decode_batch": b, "decode_prefill": s0, "decode_new": new}
+    out = None
+    if "generate" in sections:
+        out = model.generate(tokens, max_new_tokens=new, max_len=s0 + new)
+        _sync(out[0, -1])  # warm/compile
+        t0 = time.perf_counter()
+        out = model.generate(tokens, max_new_tokens=new, max_len=s0 + new)
+        _sync(out[0, -1])
+        dt = time.perf_counter() - t0
+        res[f"decode_{name}_tokens_per_sec"] = round(b * new / dt, 1)
 
     # weight-only int8 serving path (decode is HBM-bandwidth bound: int8
-    # weights are the dominant read)
-    try:
+    # weights are the dominant read); token agreement needs the baseline
+    # generate output
+    if "int8" in sections and out is not None:
+      try:
         from paddle_tpu import quantization as quant
         qmodel = quant.quantize_for_inference(model)
         qout = qmodel.generate(tokens, max_new_tokens=new, max_len=s0 + new)
@@ -583,21 +630,26 @@ def bench_decode(jax, jnp, peak, smoke=False):
                * jnp.linalg.norm(lg_q, axis=-1) + 1e-9)
         res["decode_int8_logit_cosine"] = round(float(jnp.mean(num / den)),
                                                 5)
-    except Exception as e:
-        res["decode_int8_error"] = str(e)[:120]
+      except Exception as e:
+          res["decode_int8_error"] = str(e)[:120]
 
     # continuous-batching engine throughput vs the HBM roofline (VERDICT
     # r4 item 2: r02's generate-loop decode sat at ~43% of roofline)
+    roof = None
     try:
+      if "engine" in sections:
         from paddle_tpu.inference.decode_engine import (
             DecodeEngine, decode_roofline_tokens_per_sec)
         slots, s_pf, n_new2 = (2, 8, 4) if smoke else (8, 128, 128)
-        # chunked device-side stepping: one dispatch per 16 tokens/slot —
-        # without it, host/tunnel dispatch latency (not the model) bounds
-        # the measurement
+        # chunked device-side stepping: one dispatch per 64 tokens/slot
+        # — without it, host/tunnel dispatch latency (not the model)
+        # bounds the measurement
+        # cache sized to the workload exactly (T = 256, a 128-multiple):
+        # decode is HBM-bound and every padded cache block beyond the
+        # valid lengths that still gets fetched is pure wasted bandwidth
         eng = DecodeEngine(model, max_slots=slots,
-                           max_len=s_pf + n_new2 + 128,
-                           steps_per_call=2 if smoke else 16)
+                           max_len=s_pf + n_new2,
+                           steps_per_call=2 if smoke else 64)
         rs = np.random.RandomState(1)
         prompts = [rs.randint(0, cfg.vocab_size, s_pf) for _ in range(slots)]
         for p in prompts:  # warm both compiles + prefill
@@ -606,6 +658,7 @@ def bench_decode(jax, jnp, peak, smoke=False):
         reqs = [eng.submit(p, max_new_tokens=n_new2) for p in prompts]
         eng.step()  # admissions (prefill) excluded from the decode timing
         pre = sum(len(r.tokens) for r in reqs)
+        d0 = eng.steps
         t0 = time.perf_counter()
         eng.run()
         dt = time.perf_counter() - t0
@@ -615,6 +668,7 @@ def bench_decode(jax, jnp, peak, smoke=False):
         roof = decode_roofline_tokens_per_sec(
             cfg, slots, s_pf + n_new2 // 2, hbm)
         res["decode_engine_tokens_per_sec"] = round(tps, 1)
+        res["decode_engine_dispatches"] = eng.steps - d0  # timed run only
         res["decode_engine_vs_roofline"] = round(tps / roof, 4)
         res["decode_roofline_tokens_per_sec"] = round(roof, 1)
         # free the baseline engine's stacked weights + KV caches before
@@ -624,13 +678,13 @@ def bench_decode(jax, jnp, peak, smoke=False):
         del eng
     except Exception as e:
         res["decode_engine_error"] = str(e)[:160]
-        roof = None
 
     # speculative decoding on repetition-heavy text (the regime it
     # serves): lossless greedy, so the only change is steps-per-token.
     # Own try/except: a spec regression must not erase the baseline
     # metrics (nor vice versa).
     try:
+      if "spec" in sections:
         from paddle_tpu.inference.decode_engine import DecodeEngine
         k = 4
         slots, s_pf, n_new2 = (2, 8, 4) if smoke else (8, 128, 128)

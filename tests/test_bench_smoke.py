@@ -69,12 +69,19 @@ def test_bench_pp_smoke():
     assert 0 < out["pp2_bubble_theoretical"] < 1
 
 
+def test_bench_longctx_smoke():
+    out = bench.bench_longctx(jax, jnp, PEAK, smoke=True)
+    assert out.get("longctx_64_tokens_per_sec", 0) > 0, out
+    assert "longctx_64_mfu" in out
+
+
 def test_bench_nonsmoke_cpu_guards():
     # driver-mode guards: on CPU the TPU-only sub-benches stay silent
     assert bench.bench_bert(jax, jnp, PEAK) == {}
     assert bench.bench_resnet50(jax, jnp, PEAK) == {}
     assert bench.bench_ppyoloe(jax, jnp, PEAK) == {}
     assert bench.bench_pp(jax, jnp, PEAK) == {}
+    assert bench.bench_longctx(jax, jnp, PEAK) == {}
 
 
 def test_split_params_contract():
