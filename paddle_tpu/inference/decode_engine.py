@@ -13,10 +13,15 @@ leaves to paddle-serving:
   retirement frees it. All shapes are static, so the jitted decode step
   compiles exactly ONCE no matter how requests come and go (the
   no-recompile property tests assert on).
-- **Ragged decode step**: every active slot advances one token per step at
-  its own cache position (`GPTBlock.decode_step`), with the flash-decode
-  Pallas kernel fetching each slot's cache only up to its own length —
-  short sequences don't pay for long ones.
+- **Ragged decode step**: every active slot advances one token per step
+  at its own cache position. The caches ride the layer scan as READ-ONLY
+  xs; each layer emits only its new KV rows (`GPTBlock.decode_rows`,
+  which folds the current token's attention contribution in
+  analytically), and the rows are written back as S small
+  dynamic_update_slices after the scan — the old scan-ys formulation
+  made XLA rebuild the entire (L, S, H, T, D) cache every token (~2x
+  the cache size in pure copy traffic per step, the dominant overhead
+  over the HBM roofline at serving cache lengths).
 - **Bucketed chunked prefill**: prompts run through the cached forward in
   power-of-two buckets (bounded compile set); prompts longer than the
   largest bucket stream through it in chunks, and a tail chunk that would
@@ -38,12 +43,9 @@ leaves to paddle-serving:
   bigram's previous continuation in the slot's own history — no draft
   model), and the scheme is LOSSLESS: acceptance keeps exactly the
   greedy stream of the verify pass's own forward math, whatever the
-  acceptance rate. (The verify pass uses the dense einsum attention;
-  the plain K=1 path may use the flash-decode kernel — argmax ties
-  between the two numerics are the only way outputs can differ from a
-  non-speculative engine, the same tolerance the kernel-vs-einsum
-  parity tests already pin.) No reference analog; the reference decodes
-  strictly one token per launch.
+  acceptance rate (verify and the plain K=1 step share ONE attention
+  definition, `GPTBlock.decode_rows`). No reference analog; the
+  reference decodes strictly one token per launch.
 
 HBM note: the engine runs on a scan-stacked copy of the block weights,
 passed to its jitted functions as arguments (never closure constants).
@@ -106,7 +108,8 @@ class DecodeEngine:
                  buckets: Optional[Sequence[int]] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
                  top_k: int = 0, seed: int = 0, cache_dtype=None,
-                 speculative_k: int = 0, steps_per_call: int = 1):
+                 speculative_k: int = 0, steps_per_call: int = 1,
+                 share_weights_with: "Optional[DecodeEngine]" = None):
         cfg = model.cfg
         if any(model.blocks[i].moe is not None
                for i in range(cfg.n_layers)):
@@ -131,14 +134,24 @@ class DecodeEngine:
 
         # split the weights the jitted bodies actually touch: the embedding
         # / final-ln / head leaves, and ONE scan-stacked copy of the blocks
-        # (passed as arguments, so nothing is baked into executables)
-        self._head = {"wte": model.wte, "wpe": model.wpe,
-                      "lnf_scale": model.lnf_scale,
-                      "lnf_bias": model.lnf_bias,
-                      "lm_head": model.lm_head}
-        self._stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs),
-            *[model.blocks[i] for i in range(cfg.n_layers)])
+        # (passed as arguments, so nothing is baked into executables).
+        # A second engine over the same model (e.g. a speculative one next
+        # to a plain one) shares the stacked copy via share_weights_with —
+        # at 1.3B a redundant copy is 2.4GB of HBM.
+        if share_weights_with is not None:
+            if share_weights_with.cfg is not cfg:
+                raise ValueError(
+                    "share_weights_with engine serves a different model")
+            self._head = share_weights_with._head
+            self._stacked = share_weights_with._stacked
+        else:
+            self._head = {"wte": model.wte, "wpe": model.wpe,
+                          "lnf_scale": model.lnf_scale,
+                          "lnf_bias": model.lnf_bias,
+                          "lm_head": model.lm_head}
+            self._stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs),
+                *[model.blocks[i] for i in range(cfg.n_layers)])
 
         dt = cache_dtype or cfg.dtype
         shape = (cfg.n_layers, self.S, cfg.kv_heads, self.T,
@@ -190,10 +203,30 @@ class DecodeEngine:
              else head["lm_head"])
         return x @ w
 
+    def _write_rows(self, kc, vc, k_rows, v_rows, lengths):
+        """Write each slot's K new KV rows at its own cache position:
+        S small dynamic_update_slices on the carried buffers instead of
+        the full-cache rebuild the old scan-ys formulation paid (~2x the
+        cache size in copy traffic per step).
+
+        k_rows/v_rows: (L, S, K, Hkv, D) stacked layer outputs."""
+        kr = jnp.transpose(k_rows, (0, 1, 3, 2, 4))   # (L, S, Hkv, K, D)
+        vr = jnp.transpose(v_rows, (0, 1, 3, 2, 4))
+        for s in range(self.S):
+            pos = lengths[s]
+            kc = lax.dynamic_update_slice(kc, kr[:, s:s + 1],
+                                          (0, s, 0, pos, 0))
+            vc = lax.dynamic_update_slice(vc, vr[:, s:s + 1],
+                                          (0, s, 0, pos, 0))
+        return kc, vc
+
     def _one_token(self, head, stacked, kc, vc, lengths, last, active,
                    rng):
         """Advance every active slot one token: the shared body of the
-        single-step and chunked-step entry points."""
+        single-step and chunked-step entry points. The caches ride the
+        layer scan as READ-ONLY xs; each layer emits only its new KV
+        rows (`GPTBlock.decode_rows`), written back in one batch after
+        the scan."""
         temperature, top_p, top_k = self.sample
         x = jnp.take(head["wte"], last, axis=0)
         if head["wpe"] is not None:   # rope models position in attention
@@ -202,10 +235,11 @@ class DecodeEngine:
 
         def layer(x, blk_kv):
             blk, k_l, v_l = blk_kv
-            x, (k_l, v_l) = blk.decode_step(x, (k_l, v_l), lengths)
-            return x, (k_l, v_l)
+            y, k_rows, v_rows = blk.decode_rows(x, (k_l, v_l), lengths)
+            return y, (k_rows, v_rows)
 
-        x, (kc, vc) = lax.scan(layer, x, (stacked, kc, vc))
+        x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
+        kc, vc = self._write_rows(kc, vc, k_rows, v_rows, lengths)
         logits = self._lm_head(head, x)[:, 0]
         rng, k = jax.random.split(rng)
         nxt = gpt_lib._sample_token(logits.astype(jnp.float32), k,
@@ -256,10 +290,11 @@ class DecodeEngine:
 
         def layer(x, blk_kv):
             blk, k_l, v_l = blk_kv
-            x, (k_l, v_l) = blk.verify_step(x, (k_l, v_l), lengths)
-            return x, (k_l, v_l)
+            y, k_rows, v_rows = blk.decode_rows(x, (k_l, v_l), lengths)
+            return y, (k_rows, v_rows)
 
-        x, (kc, vc) = lax.scan(layer, x, (stacked, kc, vc))
+        x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
+        kc, vc = self._write_rows(kc, vc, k_rows, v_rows, lengths)
         logits = self._lm_head(head, x).astype(jnp.float32)  # (S, K, V)
         pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # candidate j (cand[:, j], j>=1) is accepted iff it equals the

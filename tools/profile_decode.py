@@ -1,0 +1,81 @@
+"""Decode-engine A/B harness for real hardware.
+
+Times the full engine loop for the bench workload (1.3B, 8 slots, T=256,
+chunk=64) with the flash-decode kernel enabled and disabled, against the
+HBM roofline. PD_SIZE=350m for a smaller model.
+
+Measurement notes learned the hard way (r5):
+- On the tunneled PJRT backend ``jax.block_until_ready`` does NOT block;
+  sync by fetching a scalar (the engine's own host loop does this
+  naturally).
+- Per-dispatch tunnel RTT is ~4 ms; only in-jit loops (the engine's
+  ``steps_per_call`` chunking) measure device time. For sub-step
+  breakdowns, time a lax.scan of K steps at two K values and use the
+  slope.
+- Run-to-run variance on the shared chip is +-1.5 ms/step; use min over
+  several runs for A/B decisions.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import flags
+from paddle_tpu.models import gpt
+from paddle_tpu.inference.decode_engine import (
+    DecodeEngine, decode_roofline_tokens_per_sec)
+
+
+def run_engine(model, use_kernel: bool, chunk: int = 64, slots: int = 8,
+               s_pf: int = 128, n_new: int = 128):
+    flags.set_flags({"use_pallas_kernels": use_kernel})
+    cfg = model.cfg
+    eng = DecodeEngine(model, max_slots=slots, max_len=s_pf + n_new,
+                       steps_per_call=chunk)
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, cfg.vocab_size, s_pf) for _ in range(slots)]
+    for p in prompts:
+        eng.submit(p, max_new_tokens=2)
+    eng.run()  # warm compile
+    reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+    eng.step()
+    pre = sum(len(r.tokens) for r in reqs)
+    t0 = time.perf_counter()
+    eng.run()
+    dt = time.perf_counter() - t0
+    toks = sum(len(r.tokens) for r in reqs) - pre
+    eng.kc = eng.vc = eng._stacked = None
+    del eng
+    return toks / dt, dt, toks
+
+
+def main():
+    size = os.environ.get("PD_SIZE", "1p3b")
+    cfg = (gpt.gpt3_1p3b(max_seq_len=2048) if size == "1p3b"
+           else gpt.gpt3_350m(max_seq_len=1024))
+    print("building model", size, flush=True)
+    model = gpt.GPT(cfg, seed=0)
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+
+    from paddle_tpu.cost_model import _peak
+    hbm = _peak(dev)[1] / 1e9
+    roof = decode_roofline_tokens_per_sec(cfg, 8, 192, hbm)
+    print(f"roofline @ctx192 b8: {roof:.1f} tok/s (hbm {hbm:.0f} GB/s)",
+          flush=True)
+
+    for use_kernel in (False, True):
+        tps, dt, toks = run_engine(model, use_kernel)
+        print(f"kernel={use_kernel}: {tps:.1f} tok/s "
+              f"({toks} toks in {dt:.2f}s) vs_roofline={tps / roof:.3f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
