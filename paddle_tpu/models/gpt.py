@@ -353,8 +353,11 @@ class GPTBlock(Module):
         att_new = jnp.where(causal, att_new.astype(jnp.float32), -jnp.inf)
         full = jax.nn.softmax(
             jnp.concatenate([att, att_new], axis=-1), axis=-1)
-        p_cache = full[..., :T].astype(v_cache.dtype)
-        p_new = full[..., T:].astype(v.dtype)
+        # probabilities stay in the COMPUTE dtype (only K/V round-trip
+        # through the cache dtype): quantized-cache configs must not
+        # also truncate the attention weights
+        p_cache = full[..., :T].astype(x.dtype)
+        p_new = full[..., T:].astype(x.dtype)
         attn = (jnp.einsum("bhgkt,bhtd->bkhgd", p_cache, v_cache)
                 + jnp.einsum("bhgki,bihd->bkhgd", p_new, v))
         attn = attn.reshape(b, K, d).astype(x.dtype)

@@ -1,8 +1,9 @@
-"""Decode-engine A/B harness for real hardware.
+"""Decode-engine throughput probe for real hardware.
 
-Times the full engine loop for the bench workload (1.3B, 8 slots, T=256,
-chunk=64) with the flash-decode kernel enabled and disabled, against the
-HBM roofline. PD_SIZE=350m for a smaller model.
+Times the full continuous-batching engine loop against the HBM roofline
+across (slots, cache length, chunk) points — the knobs that matter for
+serving. PD_SIZE=350m for a smaller model; PD_SPEC=1 adds a chunked
+speculative run on repetitive prompts.
 
 Measurement notes learned the hard way (r5):
 - On the tunneled PJRT backend ``jax.block_until_ready`` does NOT block;
@@ -24,35 +25,41 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import jax.numpy as jnp
 
-from paddle_tpu import flags
 from paddle_tpu.models import gpt
 from paddle_tpu.inference.decode_engine import (
     DecodeEngine, decode_roofline_tokens_per_sec)
 
 
-def run_engine(model, use_kernel: bool, chunk: int = 64, slots: int = 8,
-               s_pf: int = 128, n_new: int = 128):
-    flags.set_flags({"use_pallas_kernels": use_kernel})
+def run_engine(model, slots=8, s_pf=128, n_new=128, chunk=64, spec_k=0):
     cfg = model.cfg
-    eng = DecodeEngine(model, max_slots=slots, max_len=s_pf + n_new,
-                       steps_per_call=chunk)
+    eng = DecodeEngine(model, max_slots=slots,
+                       max_len=s_pf + n_new + (128 + spec_k if spec_k
+                                               else 0),
+                       steps_per_call=chunk, speculative_k=spec_k)
     rs = np.random.RandomState(1)
-    prompts = [rs.randint(0, cfg.vocab_size, s_pf) for _ in range(slots)]
+    if spec_k:   # repetition-heavy prompts: the regime spec serves
+        loops = [list(rs.randint(0, cfg.vocab_size, 8))
+                 for _ in range(slots)]
+        prompts = [(lp * (s_pf // 8 + 1))[:s_pf] for lp in loops]
+    else:
+        prompts = [rs.randint(0, cfg.vocab_size, s_pf)
+                   for _ in range(slots)]
     for p in prompts:
         eng.submit(p, max_new_tokens=2)
     eng.run()  # warm compile
     reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
     eng.step()
     pre = sum(len(r.tokens) for r in reqs)
+    d0 = eng.steps
     t0 = time.perf_counter()
     eng.run()
     dt = time.perf_counter() - t0
     toks = sum(len(r.tokens) for r in reqs) - pre
+    dispatches = eng.steps - d0
     eng.kc = eng.vc = eng._stacked = None
     del eng
-    return toks / dt, dt, toks
+    return toks / dt, dispatches
 
 
 def main():
@@ -66,15 +73,20 @@ def main():
 
     from paddle_tpu.cost_model import _peak
     hbm = _peak(dev)[1] / 1e9
-    roof = decode_roofline_tokens_per_sec(cfg, 8, 192, hbm)
-    print(f"roofline @ctx192 b8: {roof:.1f} tok/s (hbm {hbm:.0f} GB/s)",
-          flush=True)
 
-    for use_kernel in (False, True):
-        tps, dt, toks = run_engine(model, use_kernel)
-        print(f"kernel={use_kernel}: {tps:.1f} tok/s "
-              f"({toks} toks in {dt:.2f}s) vs_roofline={tps / roof:.3f}",
-          flush=True)
+    for slots, s_pf, n_new in ((8, 128, 128), (16, 128, 128)):
+        roof = decode_roofline_tokens_per_sec(
+            cfg, slots, s_pf + n_new // 2, hbm)
+        tps, disp = run_engine(model, slots=slots, s_pf=s_pf, n_new=n_new)
+        print(f"slots={slots} ctx={s_pf}+{n_new}: {tps:.1f} tok/s "
+              f"({disp} dispatches) roofline={roof:.0f} "
+              f"ratio={tps / roof:.3f}", flush=True)
+
+    if os.environ.get("PD_SPEC", "0") == "1":
+        roof = decode_roofline_tokens_per_sec(cfg, 8, 192, hbm)
+        tps, disp = run_engine(model, chunk=16, spec_k=4)
+        print(f"spec k=4 chunk=16: {tps:.1f} tok/s ({disp} dispatches) "
+              f"vs roofline={roof:.0f} ratio={tps / roof:.3f}", flush=True)
 
 
 if __name__ == "__main__":
