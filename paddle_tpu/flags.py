@@ -99,6 +99,18 @@ define_flag("conv_workspace_limit_mb", 512,
 define_flag("use_pallas_kernels", True,
             "Use Pallas TPU kernels for fused ops (flash attention etc.) "
             "when running on TPU; falls back to XLA-fused reference impls.")
+define_flag("decode_kernel_min_t", 1024,
+            "Cache length at/above which the decode engine's one-token "
+            "step routes attention through the flash-decode kernel "
+            "(reads only valid prefix blocks) instead of the dense "
+            "einsum over the whole cache. Short caches stay on the "
+            "einsum — the kernel's per-program overhead beats the "
+            "bandwidth saving there.")
+define_flag("scan_layers", True,
+            "Run homogeneous transformer stacks as lax.scan over stacked "
+            "block weights (one compiled layer body instead of L unrolled "
+            "copies — L-fold faster XLA compiles). Off restores the "
+            "unrolled Python loop.")
 define_flag("log_level", "warning", "Framework log level.")
 define_flag("stats_at_exit", False,
             "Dump the StatRegistry table to stderr at process exit "

@@ -98,11 +98,6 @@ def fused_multi_head_attention(
     from paddle_tpu.nn import functional as F
     from paddle_tpu.nn.functional.common import fold_ctx_key
 
-    if cache_kv is not None:
-        raise NotImplementedError(
-            "fused_multi_head_attention(cache_kv=...) incremental decode "
-            "is served by the model-level KV caches (models/gpt.py "
-            "generate); the raw-weight cache form is not implemented")
     x = jnp.asarray(x)
     qkv_w = jnp.asarray(qkv_weight)
     assert qkv_w.ndim == 4 and qkv_w.shape[0] == 3, qkv_w.shape
@@ -119,27 +114,58 @@ def fused_multi_head_attention(
     if rng_key is None:
         rng_key = fold_ctx_key(salt=101)  # context RNG, like the sibling
     k1, k2 = jax.random.split(rng_key)
-    attn = F.scaled_dot_product_attention(
-        q, k, v, attn_mask=attn_mask, is_causal=False,
-        dropout_p=attn_dropout_rate if training else 0.0,
-        training=training, rng_key=k1)
+    cache_out = None
+    if cache_kv is not None:
+        # incremental decode (≙ fused_attention_op CacheKV): cache_kv
+        # (2, B, H, T_past, dh) holds the past; the step's K/V append
+        # and the query attends to past + self (causal within the new
+        # block). Reference-parity concat semantics — the growing shape
+        # recompiles per length, so production serving uses the
+        # static-slot DecodeEngine; this form exists for porting
+        # parity with fused_transformer.py:462.
+        ck = jnp.asarray(cache_kv)
+        assert ck.ndim == 5 and ck.shape[0] == 2, ck.shape
+        t_past = ck.shape[3]
+        k_hmaj = jnp.swapaxes(k, 1, 2)       # (B, H, Sq, dh)
+        v_hmaj = jnp.swapaxes(v, 1, 2)
+        k_full = jnp.concatenate([ck[0], k_hmaj.astype(ck.dtype)], 2)
+        v_full = jnp.concatenate([ck[1], v_hmaj.astype(ck.dtype)], 2)
+        cache_out = jnp.stack([k_full, v_full])
+        causal = (t_past + jnp.arange(sq)[:, None]
+                  >= jnp.arange(t_past + sq)[None, :])
+        bias = jnp.where(causal, 0.0, -jnp.inf)[None, None]
+        if attn_mask is not None:
+            bias = bias + jnp.asarray(attn_mask)
+        attn = F.scaled_dot_product_attention(
+            q, jnp.swapaxes(k_full, 1, 2).astype(q.dtype),
+            jnp.swapaxes(v_full, 1, 2).astype(q.dtype),
+            attn_mask=bias, is_causal=False,
+            dropout_p=attn_dropout_rate if training else 0.0,
+            training=training, rng_key=k1)
+    else:
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=attn_dropout_rate if training else 0.0,
+            training=training, rng_key=k1)
     out = attn.reshape(b, sq, h * dh) @ jnp.asarray(linear_weight)
     if not pre_layer_norm and add_residual:
         # the whole tail IS the sibling fused op → Pallas fused-LN kernel
         import jax as _jax
         seed = _jax.random.bits(k2, (), jnp.uint32).astype(jnp.int32)
-        return fused_bias_dropout_residual_layer_norm(
+        out = fused_bias_dropout_residual_layer_norm(
             out, residual, bias=linear_bias, ln_scale=ln_scale,
             ln_bias=ln_bias, dropout_rate=dropout_rate,
             ln_epsilon=ln_epsilon, training=training, dropout_seed=seed)
-    if linear_bias is not None:
-        out = out + jnp.asarray(linear_bias)
-    out = _dropout(out, dropout_rate, training, k2, mode)
-    if add_residual:
-        out = residual + out
-    if not pre_layer_norm:
-        out = _ln(out, ln_scale, ln_bias, ln_epsilon)
-    return out
+    else:
+        if linear_bias is not None:
+            out = out + jnp.asarray(linear_bias)
+        out = _dropout(out, dropout_rate, training, k2, mode)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _ln(out, ln_scale, ln_bias, ln_epsilon)
+    # reference parity: (out, cache_kv_out) in the incremental form
+    return out if cache_out is None else (out, cache_out)
 
 
 def fused_feedforward(

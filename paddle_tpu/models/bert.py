@@ -192,11 +192,30 @@ class Bert(Module):
             is_prefix = jnp.all(
                 keep == (jnp.arange(s)[None, :] < lens[:, None]), axis=-1)
             kv_lens = jnp.where(is_prefix, lens, s)
-        for i in range(self.cfg.n_layers):
-            k = (jax.random.fold_in(rng_key, i)
-                 if rng_key is not None else None)
-            x = self.layers[i](x, attn_bias=attn_bias, rng_key=k,
-                               kv_lens=kv_lens)
+        from paddle_tpu import flags as _flags
+        if self.cfg.n_layers > 1 and _flags.get_flag("scan_layers"):
+            # one compiled encoder-layer body instead of L unrolled
+            # copies (L-fold faster XLA compile — same rationale and
+            # helper as the GPT stack)
+            from paddle_tpu.models.gpt import stack_block_weights
+            stacked = stack_block_weights(
+                [self.layers[i] for i in range(self.cfg.n_layers)])
+
+            def body(h, lyr_i):
+                lyr, i = lyr_i
+                k = (jax.random.fold_in(rng_key, i)
+                     if rng_key is not None else None)
+                return lyr(h, attn_bias=attn_bias, rng_key=k,
+                           kv_lens=kv_lens), None
+
+            x, _ = jax.lax.scan(
+                body, x, (stacked, jnp.arange(self.cfg.n_layers)))
+        else:
+            for i in range(self.cfg.n_layers):
+                k = (jax.random.fold_in(rng_key, i)
+                     if rng_key is not None else None)
+                x = self.layers[i](x, attn_bias=attn_bias, rng_key=k,
+                                   kv_lens=kv_lens)
         pooled = jnp.tanh(x[:, 0] @ self.pooler_w + self.pooler_b)
         return x, pooled
 

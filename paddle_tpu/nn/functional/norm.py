@@ -34,10 +34,16 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     if training:
         # statistics in fp32 (bf16 accumulations drift); output is cast
         # back to the input dtype so bf16 activations stay bf16 through
-        # the conv stack (mixed-precision norm convention)
+        # the conv stack (mixed-precision norm convention).
+        # One-pass moments (E[x^2] - E[x]^2, the fused-BN convention):
+        # jnp.var's two-pass form reads the activation twice — at
+        # ResNet batch sizes that is a full extra HBM sweep per BN.
+        # Post-conv activations are near zero-centered, so the f32
+        # cancellation risk of the one-pass form is immaterial here.
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=reduce_axes)
-        var = jnp.var(xf, axis=reduce_axes)
+        m2 = jnp.mean(jnp.square(xf), axis=reduce_axes)
+        var = jnp.maximum(m2 - jnp.square(mean), 0.0)
         rm, rv = jnp.asarray(running_mean), jnp.asarray(running_var)
         n = x.size // x.shape[c_axis]
         unbiased = var * n / max(n - 1, 1)

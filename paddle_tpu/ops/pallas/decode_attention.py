@@ -42,8 +42,14 @@ _LANES = 128
 _NEG_INF = float("-inf")
 
 
-def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-            scale, block_k, hkv):
+def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, block_k,
+            hkv, with_stats):
+    # the stats output ref exists only when requested (out_specs are
+    # built conditionally), so the trailing refs shift
+    if with_stats:
+        ml_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        ml_ref, (acc_ref, m_ref, l_ref) = None, rest
     bh = pl.program_id(0)
     j = pl.program_id(1)
     nk = pl.num_programs(1)
@@ -88,6 +94,13 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[...]
                     / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        if with_stats:
+            # column 0: running max; column 1: softmax denominator —
+            # lets the caller fold extra columns (e.g. the current
+            # token's fresh KV row) into the softmax analytically
+            ml = jnp.concatenate(
+                [m_ref[:, :1], l, l_ref[:, 2:]], axis=1)
+            ml_ref[0] = ml
 
 
 def _pick_block(T: int, block_k: int) -> int:
@@ -122,7 +135,7 @@ def decode_attention_reference(q, k_cache, v_cache, lengths, scale=None):
 
 
 def decode_attention(q, k_cache, v_cache, lengths, scale=None,
-                     block_k=512, interpret=None):
+                     block_k=512, interpret=None, return_stats=False):
     """One decode step of cached attention for B sequences at once.
 
     Args:
@@ -135,8 +148,13 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
       scale: softmax scale, default 1/sqrt(D).
       block_k: KV block size streamed through VMEM (shrunk to divide T).
       interpret: defaults to True off-TPU so tests run on CPU.
+      return_stats: also return the online-softmax running max ``m`` and
+        denominator ``l`` (each (B, Hq) f32) so the caller can fold
+        extra attention columns in analytically — the decode engine
+        adds the current token's fresh KV row this way, letting the
+        kernel read ONLY the prefix.
 
-    Returns (B, Hq, D) in q's dtype.
+    Returns (B, Hq, D) in q's dtype; with return_stats, (o, m, l).
     """
     q = jnp.asarray(q)
     k_cache, v_cache = jnp.asarray(k_cache), jnp.asarray(v_cache)
@@ -168,6 +186,14 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
         return (bb, bh % hkv, jnp.minimum(j, nb - 1), 0)
 
     lengths = jnp.asarray(lengths, jnp.int32)
+    out_specs = [pl.BlockSpec((1, gp, d), lambda bh, j, lens: (bh, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * hkv, gp, d), q.dtype)]
+    if return_stats:  # stats output only exists when asked for — the
+        # per-token serving hot path must not allocate a dead buffer
+        out_specs.append(pl.BlockSpec((1, gp, _LANES),
+                                      lambda bh, j, lens: (bh, 0, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * hkv, gp, _LANES), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b * hkv, nk),
@@ -176,20 +202,26 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
             pl.BlockSpec((1, 1, bk, d), kv_index),
             pl.BlockSpec((1, 1, bk, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, gp, d), lambda bh, j, lens: (bh, 0, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((gp, d), jnp.float32),
             pltpu.VMEM((gp, _LANES), jnp.float32),
             pltpu.VMEM((gp, _LANES), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         functools.partial(_kernel, scale=float(scale), block_k=bk,
-                          hkv=hkv),
+                          hkv=hkv, with_stats=return_stats),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * hkv, gp, d), q.dtype),
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(lengths, qg, k_cache, v_cache)
-    return out[:, :group, :].reshape(b, hq, d)
+    o = res[0][:, :group, :].reshape(b, hq, d)
+    if not return_stats:
+        return o
+    ml = res[1]
+    m = ml[:, :group, 0].reshape(b, hq)
+    l = ml[:, :group, 1].reshape(b, hq)
+    return o, m, l

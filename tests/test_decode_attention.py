@@ -106,3 +106,66 @@ def test_jit_and_traced_lengths():
     want = decode_attention_reference(q, k, v, lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_return_stats_fold_extra_column():
+    """(o, m, l) stats let a caller fold an extra KV column into the
+    softmax analytically — must equal attention over the extended
+    cache. This is the decode engine's kernel route for long caches."""
+    rs = np.random.RandomState(7)
+    b, h, T, d = 2, 4, 128, 32
+    q = jnp.asarray(rs.randn(b, h, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, h, T, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, h, T, d), jnp.float32)
+    lengths = jnp.asarray([5, 90], jnp.int32)
+    k_new = jnp.asarray(rs.randn(b, h, d), jnp.float32)
+    v_new = jnp.asarray(rs.randn(b, h, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    o, m, l = decode_attention(q, k, v, lengths, return_stats=True)
+    s_new = jnp.einsum("bhd,bhd->bh", q, k_new) * scale
+    m2 = jnp.maximum(m, s_new)
+    w_pre = l * jnp.exp(m - m2)
+    w_new = jnp.exp(s_new - m2)
+    got = (o * w_pre[..., None] + v_new * w_new[..., None]) \
+        / (w_pre + w_new)[..., None]
+
+    # oracle: extend the cache by one column at each row's position
+    def put(c, new, pos):
+        return jax.lax.dynamic_update_slice(c, new[:, None], (0, pos, 0))
+    k2 = jax.vmap(put)(k, k_new, lengths)
+    v2 = jax.vmap(put)(v, v_new, lengths)
+    want = decode_attention_reference(q, k2, v2, lengths + 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_decode_rows_kernel_route_matches_einsum():
+    """GPTBlock.decode_rows: kernel route (long caches) == dense einsum
+    route, ragged lengths, GQA and MHA."""
+    from paddle_tpu import flags
+    from paddle_tpu.models import gpt
+
+    for kvh in (4, 2):
+        cfg = gpt.GPTConfig(vocab_size=64, max_seq_len=256, d_model=128,
+                            n_layers=1, n_heads=4, n_kv_heads=kvh,
+                            dtype=jnp.float32)
+        model = gpt.GPT(cfg, seed=0)
+        blk = model.blocks[0]
+        rs = np.random.RandomState(3)
+        b, T = 2, 256
+        x = jnp.asarray(rs.randn(b, 1, cfg.d_model), jnp.float32)
+        kc = jnp.asarray(rs.randn(b, kvh, T, cfg.head_dim), jnp.float32)
+        vc = jnp.asarray(rs.randn(b, kvh, T, cfg.head_dim), jnp.float32)
+        pos = jnp.asarray([7, 201], jnp.int32)
+
+        flags.set_flags({"decode_kernel_min_t": 128})
+        try:
+            y_k, krow_k, vrow_k = blk.decode_rows(x, (kc, vc), pos)
+        finally:
+            flags.set_flags({"decode_kernel_min_t": 1024})
+        y_e, krow_e, vrow_e = blk.decode_rows(x, (kc, vc), pos)
+        np.testing.assert_allclose(np.asarray(y_k), np.asarray(y_e),
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_array_equal(np.asarray(krow_k),
+                                      np.asarray(krow_e))

@@ -219,3 +219,49 @@ def test_roofline_model():
     assert eight > 4 * one
     # and a longer context can only lower per-step throughput
     assert decode_roofline_tokens_per_sec(c, 8, 2048, 819) < eight
+
+
+def test_int8_weight_engine_exact_on_grid_model():
+    """weight_dtype='int8': snap a model's matmul weights to the int8
+    grid first; the int8 engine must then emit EXACTLY the fp engine's
+    greedy stream (the quantize/dequantize round-trip is lossless on
+    grid weights, so any divergence is a wiring bug)."""
+    import jax.numpy as jnp
+    from paddle_tpu.quantization import quantize_tensor
+    from paddle_tpu.models import gpt as gpt_lib
+
+    cfg = gpt_lib.GPTConfig(vocab_size=96, max_seq_len=128, d_model=32,
+                            n_layers=2, n_heads=4, dtype=jnp.float32)
+    model = gpt_lib.GPT(cfg, seed=0)
+    for i in range(cfg.n_layers):
+        blk = model.blocks[i]
+        for name in ("wqkv", "wo", "wup", "wdown"):
+            w = getattr(blk, name)
+            object.__setattr__(blk, name,
+                               quantize_tensor(w, axis=-1).dequantize())
+
+    rs = np.random.RandomState(0)
+    prompts = [list(rs.randint(0, 96, size=n)) for n in (6, 14)]
+
+    fp = DecodeEngine(model, max_slots=2, max_len=64)
+    r_fp = [fp.submit(p, max_new_tokens=8) for p in prompts]
+    fp.run()
+
+    # model=None + share_weights_with composes with weight_dtype: the
+    # int8 copy is quantized FROM the donor's stack without mutating it
+    q8 = DecodeEngine(None, max_slots=2, max_len=64,
+                      share_weights_with=fp, weight_dtype="int8")
+    assert not hasattr(fp._stacked.wqkv, "dequantize")  # donor untouched
+    assert hasattr(q8._stacked.wqkv, "dequantize")
+    r_q8 = [q8.submit(p, max_new_tokens=8) for p in prompts]
+    q8.run()
+
+    for a, b in zip(r_fp, r_q8):
+        assert a.tokens == b.tokens, (a.tokens, b.tokens)
+
+    # donor still serves correctly after the int8 engine was built
+    fp2 = DecodeEngine(model, max_slots=2, max_len=64)
+    r_fp2 = [fp2.submit(p, max_new_tokens=8) for p in prompts]
+    fp2.run()
+    for a, b in zip(r_fp, r_fp2):
+        assert a.tokens == b.tokens

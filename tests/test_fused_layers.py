@@ -248,3 +248,37 @@ def test_fused_ops_dropout_and_jit():
     a = f(x, jax.random.PRNGKey(0))
     b = f(x, jax.random.PRNGKey(1))
     assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_fused_mha_cache_kv_incremental_matches_full():
+    """cache_kv decode (ref fused_transformer.py:462 CacheKV form):
+    feeding tokens one at a time through the growing cache must match
+    the full causal-masked run position by position."""
+    from paddle_tpu.incubate.nn import functional as IF
+    rs = np.random.RandomState(3)
+    b, s, h, dh = 2, 6, 2, 4
+    d = h * dh
+    x = rs.randn(b, s, d).astype(np.float32)
+    qkv_w = rs.randn(3, h, dh, d).astype(np.float32) * 0.2
+    lin_w = rs.randn(d, d).astype(np.float32) * 0.2
+    ln_s = np.ones(d, np.float32)
+    ln_b = np.zeros(d, np.float32)
+    kw = dict(dropout_rate=0.0, attn_dropout_rate=0.0, training=False,
+              ln_scale=jnp.asarray(ln_s), ln_bias=jnp.asarray(ln_b))
+
+    causal = np.triu(np.full((s, s), -np.inf, np.float32), 1)[None, None]
+    full = IF.fused_multi_head_attention(
+        jnp.asarray(x), jnp.asarray(qkv_w), jnp.asarray(lin_w),
+        attn_mask=jnp.asarray(causal), **kw)
+
+    cache = jnp.zeros((2, b, h, 0, dh), jnp.float32)
+    outs = []
+    for t in range(s):
+        out_t, cache = IF.fused_multi_head_attention(
+            jnp.asarray(x[:, t:t + 1]), jnp.asarray(qkv_w),
+            jnp.asarray(lin_w), cache_kv=cache, **kw)
+        outs.append(np.asarray(out_t)[:, 0])
+    got = np.stack(outs, axis=1)
+    assert cache.shape == (2, b, h, s, dh)
+    np.testing.assert_allclose(got, np.asarray(full), rtol=2e-4,
+                               atol=2e-4)
