@@ -593,7 +593,8 @@ def bench_decode(jax, jnp, peak, smoke=False):
     import os
     sections = {s.strip() for s in os.environ.get(
         "PT_DECODE_SECTIONS",
-        "generate,int8,engine,engine_int8,spec").split(",")}
+        "generate,int8,engine,engine_longctx,engine_int8,spec"
+        ).split(",")}
     b, s0, new = (2, 8, 4) if smoke else (8, 128, 64)
     res = {"decode_batch": b, "decode_prefill": s0, "decode_new": new}
     tokens = jnp.asarray(
@@ -683,24 +684,25 @@ def bench_decode(jax, jnp, peak, smoke=False):
       except Exception as e:
         res["decode_spec_error"] = str(e)[:160]
     want_int8 = "engine_int8" in sections
-    if want_int8 and eng is None and eng2 is None:
-      try:  # int8 alone still needs a bf16 donor stack to quantize from
+    want_longctx = "engine_longctx" in sections and not smoke
+    if (want_int8 or want_longctx) and eng is None and eng2 is None:
+      try:  # these sections need a bf16 donor stack even without 'engine'
         eng = DecodeEngine(model, max_slots=slots, max_len=s_pf + n_new2,
                            steps_per_call=2 if smoke else 64)
       except Exception as e:
         res["decode_engine_int8_error"] = str(e)[:160]
-        want_int8 = False
+        want_int8 = want_longctx = False
     if eng is not None or eng2 is not None:
         if getattr(bench_gpt, "model", None) is model:
             del bench_gpt.model
         del model
 
-    def _time_engine(e):
+    def _time_engine(e, prompt_lens=None):
         """Warm (compiles + prefill), then time a drain of n_new2 tokens
         per slot — admissions excluded. Returns (tok/s, dispatches)."""
         rs = np.random.RandomState(1)
-        prompts = [rs.randint(0, cfg.vocab_size, s_pf)
-                   for _ in range(slots)]
+        lens = prompt_lens or [s_pf] * slots
+        prompts = [rs.randint(0, cfg.vocab_size, n) for n in lens]
         for p in prompts:
             e.submit(p, max_new_tokens=2)
         e.run()
@@ -726,6 +728,32 @@ def bench_decode(jax, jnp, peak, smoke=False):
         res["decode_roofline_tokens_per_sec"] = round(roof, 1)
     except Exception as e:
         res["decode_engine_error"] = str(e)[:160]
+
+    engL = None
+    try:
+      if want_longctx:
+        donor = eng if eng is not None else eng2
+        # ragged long-cache serving: mixed 128/896-token prompts in a
+        # T=1024 cache — the flash-decode kernel route (cache length >=
+        # decode_kernel_min_t) reads each slot's valid prefix blocks
+        # only, so short slots don't pay for long ones (the einsum path
+        # reads the whole cache for every slot)
+        lens_lc = [128 if i % 2 == 0 else 896 for i in range(slots)]
+        engL = DecodeEngine(None, max_slots=slots, max_len=1024,
+                            steps_per_call=64, share_weights_with=donor)
+        tps, _ = _time_engine(engL, prompt_lens=lens_lc)
+        ctx_mean = sum(lens_lc) / slots + n_new2 // 2
+        roof_lc = decode_roofline_tokens_per_sec(
+            cfg, slots, ctx_mean, _hbm_gbps(jax.devices()[0]))
+        res["decode_engine_longctx_tokens_per_sec"] = round(tps, 1)
+        res["decode_engine_longctx_vs_roofline"] = round(tps / roof_lc, 4)
+    except Exception as e:
+        res["decode_engine_longctx_error"] = str(e)[:160]
+    finally:
+        if engL is not None:
+            # the T=1024 caches must not pressure the int8/spec timings
+            engL.kc = engL.vc = None
+            del engL
 
     try:
       if want_int8 and (eng is not None or eng2 is not None):

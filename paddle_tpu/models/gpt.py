@@ -755,7 +755,7 @@ def _stacked_forward_cached(m: GPT, stacked, tokens, kc, vc, pos):
     """Cached forward with the layer loop as lax.scan over stacked weights:
     the compiled decode program contains ONE layer body instead of L
     unrolled copies — at 1.3B this cuts serving compile time ~L×.
-    kc/vc: (L, B, T, H, D)."""
+    kc/vc: (L, B, Hkv, T, D)."""
     x = m.embed_at(tokens, pos)
 
     def layer(x, blk_kv):
@@ -764,6 +764,32 @@ def _stacked_forward_cached(m: GPT, stacked, tokens, kc, vc, pos):
         return x, (k_l, v_l)
 
     x, (kc, vc) = lax.scan(layer, x, (stacked, kc, vc))
+    return m.head(x), kc, vc
+
+
+def _stacked_decode_rows(m: GPT, stacked, cur, kc, vc, pos):
+    """One-token cached decode with the caches as READ-ONLY scan xs:
+    each layer emits only its new KV row (`GPTBlock.decode_rows`), and
+    because every batch row decodes at the same scalar ``pos``, ONE
+    dynamic_update_slice per cache writes all (L, B) rows. The previous
+    formulation carried the caches through the scan as ys, making XLA
+    rebuild the whole (L, B, Hkv, T, D) buffer every token (~2x the
+    cache size in copy traffic — the dominant decode overhead measured
+    on hardware, r5)."""
+    b = cur.shape[0]
+    x = m.embed_at(cur[:, None], pos)
+    positions = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+
+    def layer(x, blk_kv):
+        blk, k_l, v_l = blk_kv
+        y, k_rows, v_rows = blk.decode_rows(x, (k_l, v_l), positions)
+        return y, (k_rows, v_rows)
+
+    x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
+    kr = jnp.transpose(k_rows, (0, 1, 3, 2, 4))   # (L, B, Hkv, 1, D)
+    vr = jnp.transpose(v_rows, (0, 1, 3, 2, 4))
+    kc = lax.dynamic_update_slice(kc, kr, (0, 0, 0, pos, 0))
+    vc = lax.dynamic_update_slice(vc, vr, (0, 0, 0, pos, 0))
     return m.head(x), kc, vc
 
 
@@ -869,8 +895,8 @@ def _generate_scan(m: GPT, b, s0, T, max_new_tokens, temperature, top_p,
 
     def step(carry, _):
         kc, vc, cur, pos, rng, done = carry
-        logits, kc, vc = _stacked_forward_cached(
-            m, stacked, cur[:, None], kc, vc, pos)
+        logits, kc, vc = _stacked_decode_rows(
+            m, stacked, cur, kc, vc, pos)
         rng, k = jax.random.split(rng)
         nx = _sample_token(logits[:, -1].astype(jnp.float32), k,
                            temperature, top_p, top_k)

@@ -35,15 +35,22 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # statistics in fp32 (bf16 accumulations drift); output is cast
         # back to the input dtype so bf16 activations stay bf16 through
         # the conv stack (mixed-precision norm convention).
-        # One-pass moments (E[x^2] - E[x]^2, the fused-BN convention):
-        # jnp.var's two-pass form reads the activation twice — at
-        # ResNet batch sizes that is a full extra HBM sweep per BN.
-        # Post-conv activations are near zero-centered, so the f32
-        # cancellation risk of the one-pass form is immaterial here.
+        # SHIFTED one-pass moments: jnp.var's two-pass form reads the
+        # activation twice — at ResNet batch sizes that is a full extra
+        # HBM sweep per BN. The naive E[x^2]-E[x]^2 cancels
+        # catastrophically in f32 when |mean| >> std, so one sample per
+        # channel (a free read) is subtracted first: Var[x] =
+        # E[(x-s)^2] - E[x-s]^2 is exact for ANY shift s, and any
+        # in-distribution s kills the DC offset that drives the
+        # cancellation. Both reductions fuse into ONE pass over x.
         xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=reduce_axes)
-        m2 = jnp.mean(jnp.square(xf), axis=reduce_axes)
-        var = jnp.maximum(m2 - jnp.square(mean), 0.0)
+        shift = jax.lax.stop_gradient(xf[tuple(
+            slice(None) if i == c_axis else 0 for i in range(x.ndim))])
+        d = xf - shift.reshape(shape)
+        mean_d = jnp.mean(d, axis=reduce_axes)
+        m2_d = jnp.mean(jnp.square(d), axis=reduce_axes)
+        var = jnp.maximum(m2_d - jnp.square(mean_d), 0.0)
+        mean = shift + mean_d
         rm, rv = jnp.asarray(running_mean), jnp.asarray(running_var)
         n = x.size // x.shape[c_axis]
         unbiased = var * n / max(n - 1, 1)

@@ -166,3 +166,34 @@ def test_inplace_aliases_and_rnnbase():
     import paddle_tpu.nn as nn
     assert F.relu_ is F.relu and F.elu_ is F.elu and F.softmax_ is F.softmax
     assert issubclass(nn.LSTM, nn.RNNBase)
+
+
+def test_batch_norm_training_torch_parity_with_dc_offset():
+    """Shifted one-pass BN moments: parity with torch even when the
+    activations carry a large DC offset (the naive E[x^2]-E[x]^2 form
+    cancels catastrophically there) — including the cold-start case
+    where the running mean has not caught up."""
+    import torch
+    from paddle_tpu.nn import functional as F
+
+    rs = np.random.RandomState(0)
+    for offset in (0.0, 1000.0):
+        x = (rs.randn(4, 8, 5, 5).astype(np.float32) * 0.1 + offset)
+        w = rs.randn(8).astype(np.float32)
+        b = rs.randn(8).astype(np.float32)
+        rm = np.zeros(8, np.float32)   # cold start
+        rv = np.abs(rs.randn(8)).astype(np.float32) + 0.5
+        out, nm, nv = F.batch_norm(
+            jnp.asarray(x), jnp.asarray(rm), jnp.asarray(rv),
+            jnp.asarray(w), jnp.asarray(b), training=True, momentum=0.9)
+        rm_t = torch.tensor(rm)
+        rv_t = torch.tensor(rv)
+        want = torch.nn.functional.batch_norm(
+            torch.tensor(x), rm_t, rv_t, torch.tensor(w),
+            torch.tensor(b), training=True, momentum=0.1)
+        np.testing.assert_allclose(np.asarray(out), want.numpy(),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(nv), rv_t.numpy(),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(nm), rm_t.numpy(),
+                                   rtol=1e-4, atol=1e-4)

@@ -426,3 +426,35 @@ def test_interleaved_moe_pipeline_trains(mesh8):
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(float(aux_vpp), float(aux_p),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_scan_layers_matches_unrolled_loop():
+    """The default lax.scan layer loop and the scan_layers=False
+    unrolled escape hatch must train identically — including remat and
+    per-layer dropout rng (fold_in by layer index in both paths)."""
+    from paddle_tpu import flags, optimizer as optim
+
+    for remat, dropout in ((False, 0.0), (True, 0.0), (False, 0.1)):
+        cfg = gpt.GPTConfig(vocab_size=128, max_seq_len=16, d_model=32,
+                            n_layers=3, n_heads=2, dtype=jnp.float32,
+                            remat=remat, dropout=dropout)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 128, (2, 16)), jnp.int32)
+        losses = {}
+        for scan in (True, False):
+            flags.set_flags({"scan_layers": scan})
+            try:
+                model = gpt.GPT(cfg, seed=0)
+                opt = optim.AdamW(learning_rate=1e-3)
+                params, opt_state = gpt.init_train_state(model, opt)
+                step = gpt.build_train_step(model, opt)
+                ls = []
+                for i in range(3):
+                    params, opt_state, loss = step(
+                        params, opt_state, toks, jax.random.PRNGKey(i))
+                    ls.append(float(loss))
+                losses[scan] = ls
+            finally:
+                flags.set_flags({"scan_layers": True})
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=1e-6, atol=1e-6)

@@ -120,16 +120,24 @@ def run_bench(device: str):
 
     # phase budget strictly below the subprocess kill timeout, so
     # bench.py's graceful budget truncation (partial rows + JSON line)
-    # engages before the hard kill would discard everything
+    # engages before the hard kill would discard everything — clamped
+    # even when the operator exports a larger PT_BENCH_BUDGET_S
+    def _budget(cap):
+        try:
+            return str(min(int(float(env.get("PT_BENCH_BUDGET_S", cap))),
+                           cap))
+        except ValueError:
+            return str(cap)
+
     env_a = dict(env, PT_BENCH_ONLY="bert,resnet50,ppyoloe,pp",
-                 PT_BENCH_BUDGET_S=env.get("PT_BENCH_BUDGET_S", "1500"))
+                 PT_BENCH_BUDGET_S=_budget(1500))
     cheap = _run_one(env_a, "cheap-rows", 1800)
     if cheap is not None and not _existing_is_full():
         _write_result(device, cheap, "cheap BASELINE rows only; flagship "
                       "phase pending")
 
     env_b = dict(env, PT_BENCH_ONLY="gpt,decode,longctx",
-                 PT_BENCH_BUDGET_S=env.get("PT_BENCH_BUDGET_S", "4500"))
+                 PT_BENCH_BUDGET_S=_budget(4500))
     flag = _run_one(env_b, "flagship", 5400)
     if flag is not None:
         if cheap is not None:
