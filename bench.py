@@ -97,8 +97,11 @@ def bench_gpt(jax, jnp, peak):
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
-        # 1.3B on 16GB HBM: bf16 Adam moments + remat + donation
-        trials = [("gpt_1p3b", gpt.gpt3_1p3b(remat=True), 4),
+        # 1.3B on 16GB HBM: bf16 Adam moments + remat + donation.
+        # batch 6 first (bigger matmuls -> higher MFU; r05-start b4
+        # peaked 8.9GB, so 6 should fit) with b4 as the proven fallback
+        trials = [("gpt_1p3b", gpt.gpt3_1p3b(remat=True), 6),
+                  ("gpt_1p3b", gpt.gpt3_1p3b(remat=True), 4),
                   ("gpt_350m", gpt.gpt3_350m(max_seq_len=1024, remat=True),
                    16),
                   ("gpt_125m", gpt.gpt3_125m(max_seq_len=1024, remat=True),

@@ -106,6 +106,9 @@ class DecodeEngine:
         r1.tokens, r2.tokens
 
     Greedy by default; temperature/top-k/top-p mirror `gpt.generate`.
+    Pass ``mesh`` (a tp-axis Mesh) for tensor-parallel serving: weights
+    place per PARTITION_RULES, caches shard over heads, and GSPMD
+    partitions the jitted bodies (≙ HybridParallelInference).
     """
 
     def __init__(self, model, max_slots: int = 8,
@@ -115,7 +118,7 @@ class DecodeEngine:
                  top_k: int = 0, seed: int = 0, cache_dtype=None,
                  speculative_k: int = 0, steps_per_call: int = 1,
                  share_weights_with: "Optional[DecodeEngine]" = None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None, mesh=None):
         if model is None:
             if share_weights_with is None:
                 raise ValueError(
@@ -178,6 +181,15 @@ class DecodeEngine:
             raise ValueError(
                 f"weight_dtype must be None or 'int8', "
                 f"got {weight_dtype!r}")
+        self.mesh = mesh
+        if mesh is not None:
+            if share_weights_with is not None:
+                raise NotImplementedError(
+                    "mesh + share_weights_with: the placement would "
+                    "duplicate the shared stack on the mesh — place one "
+                    "engine and share FROM it instead")
+            if weight_dtype is not None:
+                raise NotImplementedError("mesh + weight_dtype")
 
         dt = cache_dtype or cfg.dtype
         shape = (cfg.n_layers, self.S, cfg.kv_heads, self.T,
@@ -193,6 +205,8 @@ class DecodeEngine:
         # prompt-lookup drafts — speculative stepping never syncs the
         # host mid-chunk.
         self.toks = jnp.zeros((self.S, self.T), jnp.int32)
+        if mesh is not None:
+            self._place_on_mesh(model, mesh)
         self._rng = jax.random.PRNGKey(seed)
 
         self._slot_req: List[Optional[Request]] = [None] * self.S
@@ -221,6 +235,47 @@ class DecodeEngine:
                                    donate_argnums=(2, 3, 4))
         self._verify_fn = jax.jit(self._spec_multi_impl,
                                   donate_argnums=(2, 3, 4))
+
+    def _place_on_mesh(self, model, mesh):
+        """Tensor-parallel serving (≙ HybridParallelInference,
+        fleet/utils/hybrid_parallel_inference.py): place the stacked
+        weights per PARTITION_RULES (leading layer axis replicated) and
+        the KV caches head-sharded over 'tp'; GSPMD then partitions the
+        jitted decode bodies and inserts the attention/MLP psums. Only
+        the 'tp' axis may exceed 1 — slots stay whole so admission's
+        per-slot cache slicing never crosses a shard boundary."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shape = dict(mesh.shape)
+        tp = shape.get("tp", 1)
+        extra = {k: v for k, v in shape.items() if k != "tp" and v > 1}
+        if extra:
+            raise ValueError(
+                f"DecodeEngine mesh supports a tp axis only, got {extra}")
+        if self.cfg.n_heads % tp or self.cfg.kv_heads % tp:
+            raise ValueError(
+                f"tp={tp} must divide heads "
+                f"({self.cfg.n_heads}/{self.cfg.kv_heads})")
+        sleaves, treedef, specs = gpt_lib.stacked_partition_specs(
+            self._stacked, model.blocks[0])
+        placed = [jax.device_put(
+            leaf, NamedSharding(mesh, gpt_lib.mesh_safe_spec(spec, mesh)))
+            for leaf, spec in zip(sleaves, specs)]
+        self._stacked = jax.tree_util.tree_unflatten(treedef, placed)
+        self._head = {
+            k: (None if v is None else jax.device_put(
+                jnp.asarray(v),
+                NamedSharding(mesh, gpt_lib.mesh_safe_spec(
+                    gpt_lib.partition_spec(k), mesh))))
+            for k, v in self._head.items()}
+        kv_spec = NamedSharding(mesh, P(None, None, "tp", None, None))
+        self.kc = jax.device_put(self.kc, kv_spec)
+        self.vc = jax.device_put(self.vc, kv_spec)
+        rep = NamedSharding(mesh, P())
+        self.lengths = jax.device_put(self.lengths, rep)
+        self.last = jax.device_put(self.last, rep)
+        self.active = jax.device_put(self.active, rep)
+        self.toks = jax.device_put(self.toks, rep)
 
     def _quantize_stacked_int8(self):
         """Replace the stacked blocks' matmul weights with int8
@@ -289,7 +344,9 @@ class DecodeEngine:
 
         def layer(x, blk_kv):
             blk, k_l, v_l = blk_kv
-            y, k_rows, v_rows = blk.decode_rows(x, (k_l, v_l), lengths)
+            y, k_rows, v_rows = blk.decode_rows(
+                x, (k_l, v_l), lengths,
+                allow_kernel=self.mesh is None)
             return y, (k_rows, v_rows)
 
         x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
@@ -344,7 +401,9 @@ class DecodeEngine:
 
         def layer(x, blk_kv):
             blk, k_l, v_l = blk_kv
-            y, k_rows, v_rows = blk.decode_rows(x, (k_l, v_l), lengths)
+            y, k_rows, v_rows = blk.decode_rows(
+                x, (k_l, v_l), lengths,
+                allow_kernel=self.mesh is None)
             return y, (k_rows, v_rows)
 
         x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
