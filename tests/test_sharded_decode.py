@@ -116,3 +116,28 @@ def test_engine_mesh_rejects_non_tp_axes():
     finally:
         mesh_lib.set_topology(None)
     assert raised
+
+
+def test_tp_sharded_chunked_speculative_engine_lossless():
+    """TP mesh x speculative_k x steps_per_call: the full composition
+    stays bit-identical to the single-device greedy engine."""
+    from paddle_tpu.inference.decode_engine import DecodeEngine
+
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=128, d_model=32,
+                        n_layers=2, n_heads=8, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    prompt = [7, 21, 3] * 8
+    ref = DecodeEngine(model, max_slots=1, max_len=128)
+    r0 = ref.submit(prompt, max_new_tokens=12)
+    ref.run()
+
+    topo = dist.init_mesh(tp=8)
+    try:
+        sp = DecodeEngine(model, max_slots=1, max_len=128,
+                          speculative_k=4, steps_per_call=3,
+                          mesh=topo.mesh)
+        r1 = sp.submit(prompt, max_new_tokens=12)
+        sp.run()
+    finally:
+        mesh_lib.set_topology(None)
+    assert r0.tokens == r1.tokens
