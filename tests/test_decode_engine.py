@@ -265,3 +265,26 @@ def test_int8_weight_engine_exact_on_grid_model():
     fp2.run()
     for a, b in zip(r_fp, r_fp2):
         assert a.tokens == b.tokens
+
+
+def test_chunked_step_has_no_cache_sized_temps():
+    """The no-rebuild property, asserted on XLA's own memory analysis:
+    the chunked decode dispatch must not allocate cache-sized
+    temporaries (the old scan-ys formulation double-buffered the whole
+    KV cache every step; the row-write formulation's temps stay well
+    under the cache size)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models import gpt as gpt_lib
+
+    cfg = gpt_lib.GPTConfig(vocab_size=128, max_seq_len=512, d_model=64,
+                            n_layers=4, n_heads=4, dtype=jnp.float32)
+    model = gpt_lib.GPT(cfg, seed=0)
+    eng = DecodeEngine(model, max_slots=4, max_len=512, steps_per_call=8)
+    lowered = eng._multi_fn.lower(
+        eng._head, eng._stacked, eng.kc, eng.vc, eng.lengths, eng.last,
+        eng.active, jnp.zeros((4,), jnp.int32),
+        jnp.zeros((4,), jnp.int32), eng._rng)
+    ma = lowered.compile().memory_analysis()
+    cache = eng.kc.nbytes + eng.vc.nbytes
+    assert ma.temp_size_in_bytes < 0.75 * cache, (
+        ma.temp_size_in_bytes, cache)
