@@ -33,6 +33,35 @@ def _sync(x):
     return float(x)
 
 
+def _tune_flash(jax, jnp, b, s, heads, dh, dtype, causal=False,
+                kv_lens=None, bias=None):
+    """Flash-attention block-size sweep on the exact step shapes
+    (fwd+bwd), shared by the GPT and BERT benches: the winner persists
+    in the autotune cache and every later `flash_attention` trace on
+    these shapes picks it up; a warm cache skips the sweep. Returns a
+    reportable dict ({'blocks', 'sweep_ms', 'cache_hit'} or
+    {'error': ...}) — a silently broken tune must be visible in the
+    bench JSON, not degrade the headline MFU invisibly."""
+    if jax.default_backend() != "tpu":
+        return None
+    try:
+        from paddle_tpu.ops.pallas.flash_attention import (
+            tune_flash_attention)
+        rs = np.random.RandomState(7)
+        qt, kt, vt = (jnp.asarray(rs.randn(b, s, heads, dh), dtype)
+                      for _ in range(3))
+        best, timings = tune_flash_attention(
+            qt, kt, vt, causal=causal, kv_lens=kv_lens, bias=bias,
+            candidates=[(256, 512), (512, 512), (256, 256), (512, 256)],
+            iters=2)
+        return {"blocks": list(best),
+                "sweep_ms": {f"{bq}x{bk}": round(t * 1e3, 2)
+                             for (bq, bk), t in timings.items()},
+                "cache_hit": not timings}
+    except Exception as e:
+        return {"error": str(e)[:120]}
+
+
 def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
     """The one GPT train-step measurement recipe (shared by bench_gpt and
     bench_longctx): build model + bf16-moment AdamW, AOT-compile once (the
@@ -51,6 +80,9 @@ def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
         np.random.RandomState(0).randint(
             0, cfg.vocab_size, (batch, cfg.max_seq_len)), jnp.int32)
     rng = jax.random.PRNGKey(0)
+
+    tuned = _tune_flash(jax, jnp, batch, cfg.max_seq_len, cfg.n_heads,
+                        cfg.head_dim, cfg.dtype, causal=True)
 
     compiled = step.lower(params, opt_state, tokens, rng).compile()
     try:
@@ -87,6 +119,7 @@ def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
         "step_peak_mb": step_peak_mb,
         "batch": batch,
         "seq": cfg.max_seq_len,
+        **({"flash_autotune": tuned} if tuned else {}),
     }
 
 
@@ -510,29 +543,13 @@ def bench_bert(jax, jnp, peak, smoke=False):
     args = (tokens, type_ids, attn, labels, nsp, rng)
 
     tuned = None
-    if not smoke and jax.default_backend() == "tpu":
+    if not smoke:
         # block-size autotune on the encoder's exact attention shapes
-        # (VERDICT r3 item 8): the winner lands in the persistent cache,
-        # and the jitted step's trace-time lookup picks it up below. A
-        # second bench run hits the cache and skips the sweep entirely.
-        try:
-            from paddle_tpu.ops.pallas.flash_attention import (
-                tune_flash_attention)
-            dh = cfg.d_model // cfg.n_heads
-            rs2 = np.random.RandomState(7)
-            qt, kt, vt = (jnp.asarray(rs2.randn(b, s, cfg.n_heads, dh),
-                                      jnp.bfloat16) for _ in range(3))
-            best, timings = tune_flash_attention(
-                qt, kt, vt, kv_lens=jnp.full((b,), s, jnp.int32),
-                bias=jnp.zeros((b, 1, 1, s), jnp.float32),
-                candidates=[(256, 512), (512, 512), (256, 256),
-                            (512, 256)], iters=2)
-            tuned = {"blocks": list(best),
-                     "sweep_ms": {f"{bq}x{bk}": round(t * 1e3, 2)
-                                  for (bq, bk), t in timings.items()},
-                     "cache_hit": not timings}
-        except Exception as e:
-            tuned = {"error": str(e)[:120]}
+        # (VERDICT r3 item 8); shared helper with the GPT bench
+        tuned = _tune_flash(jax, jnp, b, s, cfg.n_heads,
+                            cfg.d_model // cfg.n_heads, jnp.bfloat16,
+                            kv_lens=jnp.full((b,), s, jnp.int32),
+                            bias=jnp.zeros((b, 1, 1, s), jnp.float32))
 
     compiled = step.lower(params, opt_state, *args).compile()
     for _ in range(2):
