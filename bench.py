@@ -119,6 +119,11 @@ def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
         "step_peak_mb": step_peak_mb,
         "batch": batch,
         "seq": cfg.max_seq_len,
+        # which layer-loop form this number was measured with (the
+        # scan form compiles ~L-fold faster; PT_FLAGS_SCAN_LAYERS=0
+        # restores the unrolled loop for an A/B)
+        "scan_layers": bool(__import__("paddle_tpu").flags.get_flag(
+            "scan_layers")),
         **({"flash_autotune": tuned} if tuned else {}),
     }
 
