@@ -68,6 +68,7 @@ def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
     same executable serves cost analysis and the timed loop -- a second
     trace/compile would double the tunnel-side compile cost), time, and
     report tokens/s + MFU. Returns (model, metrics)."""
+    from paddle_tpu import flags as pt_flags
     from paddle_tpu import optimizer as optim
     from paddle_tpu.models import gpt
 
@@ -122,8 +123,7 @@ def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
         # which layer-loop form this number was measured with (the
         # scan form compiles ~L-fold faster; PT_FLAGS_SCAN_LAYERS=0
         # restores the unrolled loop for an A/B)
-        "scan_layers": bool(__import__("paddle_tpu").flags.get_flag(
-            "scan_layers")),
+        "scan_layers": bool(pt_flags.get_flag("scan_layers")),
         **({"flash_autotune": tuned} if tuned else {}),
     }
 

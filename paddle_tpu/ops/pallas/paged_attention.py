@@ -1,0 +1,268 @@
+"""Paged flash-decode attention: block-table KV cache as a Pallas TPU
+kernel (the vLLM-style serving memory model — no reference analog; the
+reference's fused_multi_transformer serves one contiguous CacheKV per
+sequence).
+
+Why paged: a slot-contiguous cache must reserve max_len for every slot,
+so HBM bounds in-flight sequences by the WORST length. A paged pool
+shares fixed-size pages across sequences; a sequence holds
+ceil(len/page) pages and frees them at retirement — memory scales with
+the sum of actual lengths, not slots x max_len.
+
+TPU mapping: the page table rides as a scalar-prefetch operand and the
+KV BlockSpec index maps translate (sequence, block j) -> pool page id
+at DMA-schedule time, so the kernel streams exactly the pages a
+sequence owns — same online-softmax inner loop as decode_attention,
+same clamp trick (a repeated page index is not re-fetched) for rows
+shorter than the longest.
+
+Forward-only (generation never differentiates through the cache).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_decode_attention", "paged_decode_attention_reference",
+           "PagedKVCache"]
+
+_LANES = 128
+_NEG_INF = float("-inf")
+
+
+def _kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+            m_ref, l_ref, *, scale, page, hkv):
+    # table_ref is consumed by the BlockSpec index maps (scalar
+    # prefetch), not the body; it still appears in the kernel ABI
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    b = bh // hkv
+
+    from paddle_tpu.ops.pallas.decode_attention import (
+        online_softmax_finalize, online_softmax_init,
+        online_softmax_step)
+
+    @pl.when(j == 0)
+    def _init():
+        online_softmax_init(acc_ref, m_ref, l_ref)
+
+    length = len_ref[b]
+
+    # beyond the row's last valid page the index map re-presents that
+    # SAME page (DMA elided); the compute must not run again
+    @pl.when(j * page < length)
+    def _body():
+        online_softmax_step(q_ref[0], k_ref[0], v_ref[0], j * page,
+                            length, acc_ref, m_ref, l_ref, scale)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finalize():
+        online_softmax_finalize(o_ref, acc_ref, l_ref)
+
+
+def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
+                                     lengths, scale=None):
+    """XLA oracle: gather each row's pages contiguous, then full masked
+    softmax. q: (B, Hq, D); pools (P, Hkv, page, D); page_table
+    (B, max_pages) int32; lengths (B,)."""
+    b, hq, d = q.shape
+    hkv, page = k_pages.shape[1], k_pages.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # (B, max_pages, Hkv, page, D) -> (B, Hkv, max_pages*page, D)
+    kg = jnp.swapaxes(k_pages[page_table], 1, 2)
+    vg = jnp.swapaxes(v_pages[page_table], 1, 2)
+    kc = kg.reshape(b, hkv, -1, d)
+    vc = vg.reshape(b, hkv, -1, d)
+    group = hq // hkv
+    qg = q.reshape(b, hkv, group, d)
+    s = jnp.einsum("bhgd,bhtd->bhgt", qg, kc).astype(jnp.float32) * scale
+    T = kc.shape[2]
+    mask = jnp.arange(T)[None, None, None, :] < lengths[:, None, None,
+                                                        None]
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgt,bhtd->bhgd", p.astype(vc.dtype), vc)
+    return o.reshape(b, hq, d).astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=None, interpret=None):
+    """One decode step of cached attention over a PAGED KV pool.
+
+    Args:
+      q: (B, Hq, D) — each sequence's current-position query.
+      k_pages, v_pages: (P, Hkv, page_size, D) shared page pools;
+        page_size must be a multiple of 128.
+      page_table: (B, max_pages) int32 — row b's i-th page id in the
+        pool; entries beyond ceil(lengths[b]/page_size) are ignored.
+      lengths: (B,) int32 — row b attends to its first lengths[b]
+        tokens. Pages beyond a row's length are not fetched from HBM
+        (clamped scalar-prefetch index map).
+      scale: softmax scale, default 1/sqrt(D).
+      interpret: defaults to True off-TPU so tests run on CPU.
+
+    Returns (B, Hq, D) in q's dtype.
+    """
+    q = jnp.asarray(q)
+    k_pages, v_pages = jnp.asarray(k_pages), jnp.asarray(v_pages)
+    b, hq, d = q.shape
+    hkv, page = k_pages.shape[1], k_pages.shape[2]
+    max_pages = page_table.shape[1]
+    if hq % hkv:
+        raise ValueError(f"GQA needs Hq % Hkv == 0, got {hq} vs {hkv}")
+    if page % _LANES:
+        raise ValueError(f"page_size {page} must be a multiple of "
+                         f"{_LANES}")
+    group = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    sub = 16 if q.dtype in (jnp.bfloat16, jnp.float16) else 8
+    gp = max(sub, (group + sub - 1) // sub * sub)
+    qg = q.reshape(b * hkv, group, d)
+    qg = jnp.pad(qg, ((0, 0), (0, gp - group), (0, 0)))
+
+    def kv_index(bh, j, lens, table):
+        bb = bh // hkv
+        used = jnp.maximum((lens[bb] + page - 1) // page, 1)
+        jj = jnp.minimum(j, used - 1)
+        return (table[bb * max_pages + jj], bh % hkv, 0, 0)
+
+    lengths = jnp.asarray(lengths, jnp.int32)
+    table_flat = jnp.asarray(page_table, jnp.int32).reshape(-1)
+    # pools are indexed (page, head) -> (page, D): merge Hkv into the
+    # leading dim via a head-major view so one block = one (page, D)
+    # tile. (P, Hkv, page, D) -> (P*Hkv, page, D) with id p*Hkv+h.
+    kp = k_pages.reshape(-1, page, d)
+    vp = v_pages.reshape(-1, page, d)
+
+    def kv_index_flat(bh, j, lens, table):
+        p, h, _, _ = kv_index(bh, j, lens, table)
+        return (p * hkv + h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * hkv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, gp, d), lambda bh, j, lens, table:
+                         (bh, 0, 0)),
+            pl.BlockSpec((1, page, d), kv_index_flat),
+            pl.BlockSpec((1, page, d), kv_index_flat),
+        ],
+        out_specs=pl.BlockSpec((1, gp, d), lambda bh, j, lens, table:
+                               (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((gp, d), jnp.float32),
+            pltpu.VMEM((gp, _LANES), jnp.float32),
+            pltpu.VMEM((gp, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=float(scale), page=page,
+                          hkv=hkv),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, gp, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(lengths, table_flat, qg, kp, vp)
+    return out[:, :group, :].reshape(b, hq, d)
+
+
+class PagedKVCache:
+    """Host-side page pool + tables (the allocator half of paged
+    serving; the kernel half is `paged_decode_attention`).
+
+    One pool per model: k/v pages (P, Hkv, page, D) per layer stacked
+    as (L, P, Hkv, page, D). Sequences allocate pages on demand and
+    free them at retirement; `write_rows` places one decode step's new
+    KV rows at each sequence's current position (page id + offset
+    resolved host-side, written with per-sequence dynamic updates).
+    """
+
+    def __init__(self, n_layers, n_pages, kv_heads, page_size, head_dim,
+                 dtype=jnp.bfloat16, max_pages_per_seq=None):
+        if page_size % _LANES:
+            raise ValueError(f"page_size {page_size} must be a multiple "
+                             f"of {_LANES}")
+        self.page = int(page_size)
+        self.n_pages = int(n_pages)
+        shape = (n_layers, n_pages, kv_heads, page_size, head_dim)
+        self.kp = jnp.zeros(shape, dtype)
+        self.vp = jnp.zeros(shape, dtype)
+        self._free = list(range(n_pages - 1, -1, -1))
+        self.max_pages = int(max_pages_per_seq or 0)
+        self.tables = {}        # seq id -> [page ids]
+        self.lengths = {}       # seq id -> tokens written
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    def alloc_seq(self, seq_id, n_tokens=0):
+        if seq_id in self.tables:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        self.tables[seq_id] = []
+        self.lengths[seq_id] = 0
+        if n_tokens:
+            self.reserve(seq_id, n_tokens)
+
+    def reserve(self, seq_id, n_tokens):
+        """Ensure capacity for ``n_tokens`` total tokens."""
+        need = (n_tokens + self.page - 1) // self.page
+        tab = self.tables[seq_id]
+        while len(tab) < need:
+            if not self._free:
+                raise MemoryError("page pool exhausted")
+            if self.max_pages and len(tab) >= self.max_pages:
+                raise MemoryError(
+                    f"sequence exceeds max_pages_per_seq={self.max_pages}")
+            tab.append(self._free.pop())
+
+    def free_seq(self, seq_id):
+        self._free.extend(reversed(self.tables.pop(seq_id)))
+        self.lengths.pop(seq_id)
+
+    def write_rows(self, seq_id, k_rows, v_rows):
+        """Append one step's KV rows for every layer: k_rows/v_rows
+        (L, Hkv, K, D) land at the sequence's current length. Writes
+        go per touched PAGE RUN (rows within one page are contiguous),
+        not per token — ceil(K/page)+1 updates instead of K."""
+        K = k_rows.shape[2]
+        pos = self.lengths[seq_id]
+        self.reserve(seq_id, pos + K)
+        tab = self.tables[seq_id]
+        t = 0
+        while t < K:
+            pid = tab[(pos + t) // self.page]
+            off = (pos + t) % self.page
+            run = min(K - t, self.page - off)
+            self.kp = jax.lax.dynamic_update_slice(
+                self.kp, k_rows[:, None, :, t:t + run, :],
+                (0, pid, 0, off, 0))
+            self.vp = jax.lax.dynamic_update_slice(
+                self.vp, v_rows[:, None, :, t:t + run, :],
+                (0, pid, 0, off, 0))
+            t += run
+        self.lengths[seq_id] = pos + K
+
+    def gather_args(self, seq_ids, layer):
+        """(page_table, lengths) padded over ``seq_ids`` plus the
+        layer's pools — the kernel-call operands for one layer."""
+        import numpy as np
+        mx = max(1, max(len(self.tables[s]) for s in seq_ids))
+        table = np.zeros((len(seq_ids), mx), np.int32)
+        lens = np.zeros((len(seq_ids),), np.int32)
+        for i, s in enumerate(seq_ids):
+            tab = self.tables[s]
+            table[i, :len(tab)] = tab
+            lens[i] = self.lengths[s]
+        return (jnp.asarray(table), jnp.asarray(lens),
+                self.kp[layer], self.vp[layer])
