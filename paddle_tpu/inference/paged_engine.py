@@ -1,0 +1,423 @@
+"""Paged continuous-batching decode engine: serving over a shared page
+pool (the memory model half of vLLM-style serving; no reference analog —
+the reference's fused_multi_transformer serves one contiguous CacheKV
+per sequence).
+
+Where `DecodeEngine` reserves max_len cache for every slot, this engine
+holds ceil(len/page) pages per sequence from one pool and frees them at
+retirement — HBM scales with the sum of LIVE tokens, so many more
+sequences fit in flight at mixed lengths.
+
+TPU design decisions:
+
+- **Layer-folded pool**: the per-layer pools are one (L*P, Hkv, page, D)
+  array; layer l's view of page p is id ``l*P + p``. The paged kernel
+  receives the WHOLE pool and the per-layer table (``l*P + table``)
+  selects its pages at DMA-schedule time — no per-layer slicing of the
+  pool (a lax.dynamic_slice there would copy the full layer pool every
+  step).
+- **Write-first decode step**: each layer writes the current token's KV
+  row into its page (per-slot dynamic updates at table-resolved
+  positions), then attends over [0, len] via `paged_decode_attention`
+  — no analytic fold needed, mirroring `_qkv_write` semantics.
+- **One-pass bucketed prefill**: a prompt attends only to itself
+  (causal), so prefill needs NO cache reads — the whole prompt runs
+  through the dense forward at a power-of-two bucket and the valid KV
+  rows bulk-write into the sequence's pages per page-run. Prompts are
+  therefore capped at the largest bucket (512) — longer prompts belong
+  to the slot-contiguous `DecodeEngine`, which chunk-prefills.
+- **Chunked device-side stepping**: like `DecodeEngine`, ``chunk``
+  tokens per dispatch with per-slot eos/budget early-stop; pages for
+  the whole chunk are reserved up front so the table is static inside
+  the dispatch.
+
+Greedy only (the paged pool is a serving-memory feature; sampling policy
+work stays in `DecodeEngine`).
+"""
+
+import collections
+import math
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.models import gpt as gpt_lib
+from paddle_tpu.inference.decode_engine import Request
+from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+__all__ = ["PagedDecodeEngine"]
+
+
+class PagedDecodeEngine:
+    """Continuous-batching greedy generation over a paged KV pool.
+
+        eng = PagedDecodeEngine(model, n_pages=64, max_slots=8)
+        r = eng.submit(prompt, max_new_tokens=64, eos_id=2)
+        eng.run()                                  # r.tokens
+    """
+
+    def __init__(self, model, n_pages: int, max_slots: int = 8,
+                 page_size: int = 128, steps_per_call: int = 1,
+                 buckets=(16, 32, 64, 128, 256, 512)):
+        cfg = model.cfg
+        if any(model.blocks[i].moe is not None
+               for i in range(cfg.n_layers)):
+            raise NotImplementedError("paged engine serves dense stacks")
+        if page_size % 128:
+            raise ValueError("page_size must be a multiple of 128")
+        self.cfg = cfg
+        self.S = int(max_slots)
+        self.page = int(page_size)
+        self.P = int(n_pages)
+        self.chunk = int(steps_per_call)
+        self.buckets = sorted(b for b in buckets
+                              if b <= cfg.max_seq_len)
+        for b in self.buckets:
+            if b > self.page and b % self.page:
+                # the prefill page-run copy slices fixed page windows
+                # out of the bucket; a non-dividing page size would
+                # clamp the source start and copy the wrong rows
+                raise ValueError(
+                    f"page_size {self.page} must divide every bucket "
+                    f"above it (bucket {b})")
+        self._head = {"wte": model.wte, "wpe": model.wpe,
+                      "lnf_scale": model.lnf_scale,
+                      "lnf_bias": model.lnf_bias,
+                      "lm_head": model.lm_head}
+        self._stacked = gpt_lib.stack_block_weights(
+            [model.blocks[i] for i in range(cfg.n_layers)])
+        L = cfg.n_layers
+        # layer-folded pools: page p of layer l lives at row l*P + p.
+        # ONE extra row at the very end is the scratch page: idle slots'
+        # step writes land there instead of corrupting pool page 0
+        # (their padded tables point at page id 0).
+        shape = (L * self.P + 1, cfg.kv_heads, self.page, cfg.head_dim)
+        self.kp = jnp.zeros(shape, cfg.dtype)
+        self.vp = jnp.zeros(shape, cfg.dtype)
+        self._scratch = L * self.P
+        from paddle_tpu.ops.pallas.paged_attention import PageAllocator
+        self._alloc = PageAllocator(self.P, self.page)
+        self._tables: List[List[int]] = [[] for _ in range(self.S)]
+        self.lengths = jnp.zeros((self.S,), jnp.int32)
+        self.last = jnp.zeros((self.S,), jnp.int32)
+        self.active = jnp.zeros((self.S,), bool)
+        self._slot_req: List[Optional[Request]] = [None] * self.S
+        self._waiting: collections.deque = collections.deque()
+        self.steps = 0
+        self.tokens_emitted = 0
+        self._prefill_fn = jax.jit(self._prefill_impl,
+                                   donate_argnums=(2, 3))
+        self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(2, 3))
+
+    # -- pool bookkeeping ---------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return self._alloc.free_pages
+
+    def _reserve(self, slot: int, n_tokens: int):
+        self._alloc.reserve(self._tables[slot], n_tokens)
+
+    def _release(self, slot: int):
+        self._alloc.release(self._tables[slot])
+
+    def _table_array(self) -> jnp.ndarray:
+        """(S, max_pages) padded page table at a FIXED width
+        (ceil(max_seq_len/page)) so the chunked step never recompiles
+        as sequences grow; zeros beyond each slot's pages are never
+        dereferenced thanks to the kernel's clamp."""
+        mx = (self.cfg.max_seq_len + self.page - 1) // self.page
+        out = np.zeros((self.S, mx), np.int32)
+        for s, t in enumerate(self._tables):
+            out[s, :len(t)] = t
+        return jnp.asarray(out)
+
+    # -- jitted bodies ------------------------------------------------------
+
+    def _lm_head(self, head, x):
+        x = gpt_lib.final_ln(x, head["lnf_scale"], head["lnf_bias"])
+        w = (head["wte"].T if head["lm_head"] is None
+             else head["lm_head"])
+        return x @ w
+
+    def _write_step_rows(self, kp, vp, k_rows, v_rows, table, lengths,
+                         active, layer):
+        """Write each ACTIVE slot's one new KV row into its page with a
+        single batched scatter per cache: k_rows (S, Hkv, D) at position
+        lengths[s] of slot s, layer ``layer`` (page ids are
+        layer-folded). Inactive slots scatter into the scratch page —
+        their padded tables point at pool page 0, which a live sequence
+        may own."""
+        offs = lengths % self.page
+        pidx = lengths // self.page
+        pids = layer * self.P + jnp.take_along_axis(
+            table, pidx[:, None], axis=1)[:, 0]
+        pids = jnp.where(active, pids, self._scratch)
+        kp = kp.at[pids, :, offs, :].set(k_rows)
+        vp = vp.at[pids, :, offs, :].set(v_rows)
+        return kp, vp
+
+    def _one_token(self, head, stacked, kp, vp, table, lengths, last,
+                   active):
+        """Advance every active slot one token (write-first paged
+        attention per layer)."""
+        x = jnp.take(head["wte"], last, axis=0)
+        if head["wpe"] is not None:
+            x = x + jnp.take(head["wpe"], lengths, axis=0)
+        x = x[:, None, :]
+        L = self.cfg.n_layers
+        scale = 1.0 / math.sqrt(self.cfg.head_dim)
+
+        def layer_body(carry, blk_i):
+            h, kp, vp = carry
+            blk, i = blk_i
+            q, k, v = blk._qkv(h, lengths)
+            kp, vp = self._write_step_rows(
+                kp, vp, k[:, 0].astype(kp.dtype),
+                v[:, 0].astype(vp.dtype), table, lengths, active, i)
+            attn = paged_decode_attention(
+                q[:, 0].astype(kp.dtype), kp, vp, i * self.P + table,
+                lengths + 1, scale=scale)
+            attn = attn.astype(h.dtype).reshape(h.shape)
+            h = blk._block_tail(h, attn)
+            return (h, kp, vp), None
+
+        (x, kp, vp), _ = lax.scan(
+            layer_body, (x, kp, vp),
+            (stacked, jnp.arange(L)))
+        logits = self._lm_head(head, x)[:, 0]
+        nxt = jnp.argmax(logits.astype(jnp.float32), -1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, last)
+        lengths = lengths + active.astype(jnp.int32)
+        return kp, vp, lengths, nxt
+
+    def _multi_impl(self, head, stacked, kp, vp, table, lengths, last,
+                    active, remaining, eos):
+        """``chunk`` decode steps in one dispatch, per-slot eos/budget
+        early-stop device-side (pages for the whole chunk are reserved
+        before the dispatch, so ``table`` is static here)."""
+
+        def one(carry, _):
+            kp, vp, lengths, last, active, remaining = carry
+            kp, vp, lengths, nxt = self._one_token(
+                head, stacked, kp, vp, table, lengths, last, active)
+            emit = active
+            remaining = remaining - active.astype(jnp.int32)
+            hit_eos = (nxt == eos) & (eos >= 0)
+            active = active & ~hit_eos & (remaining > 0)
+            return (kp, vp, lengths, nxt, active, remaining), (nxt, emit)
+
+        (kp, vp, lengths, last, active, remaining), (toks, flags) = \
+            lax.scan(one, (kp, vp, lengths, last, active, remaining),
+                     None, length=self.chunk)
+        return kp, vp, lengths, last, active, remaining, toks, flags
+
+    def _prefill_impl(self, head, stacked, kp, vp, tokens, true_len,
+                      write_segments):
+        """One-pass prefill of ONE prompt (1, bucket): the prompt
+        attends only to itself (causal), so no cache reads; the valid
+        KV rows bulk-write into the sequence's pages per page-run.
+        ``write_segments``: (n_seg, L, 3) int32 rows (dst_page_row,
+        src_start, run) per layer — page-run copies resolved host-side
+        (statically shaped per bucket: n_seg = ceil(bucket/page) + 1,
+        padded with run=0)."""
+        cfg = self.cfg
+        x = jnp.take(head["wte"], tokens, axis=0)
+        if head["wpe"] is not None:
+            x = x + head["wpe"][None, :tokens.shape[1]]
+
+        rows = []
+
+        def layer_body(h, blk):
+            q, k, v = blk._qkv(h, jnp.zeros((1,), jnp.int32))
+            attn = gpt_lib.F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=0.0)
+            attn = attn.reshape(h.shape).astype(h.dtype)
+            return blk._block_tail(h, attn), (k[0], v[0])
+
+        x, (ks, vs) = lax.scan(layer_body, x, stacked)
+        # ks: (L, bucket, Hkv, D) -> (L, Hkv, bucket, D); pad the token
+        # dim to at least one page so every page-window copy below has a
+        # full source window (segments start page-aligned, so windows
+        # never straddle the padded end)
+        ks = jnp.swapaxes(ks, 1, 2).astype(kp.dtype)
+        vs = jnp.swapaxes(vs, 1, 2).astype(vp.dtype)
+        if ks.shape[2] < self.page:
+            pad = self.page - ks.shape[2]
+            ks = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            vs = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+        def write_seg(i, kvp):
+            kp, vp = kvp
+
+            def write_layer(l, kvp):
+                kp, vp = kvp
+                dst, src, run = (write_segments[i, l, 0],
+                                 write_segments[i, l, 1],
+                                 write_segments[i, l, 2])
+                # a zero-run segment writes a zero-length slice (no-op
+                # via clamped dynamic_slice of size page then masked
+                # merge): instead gate on run>0 with lax.cond
+                def do(kvp):
+                    kp, vp = kvp
+                    # run is traced; copy a full page window and merge
+                    # the first `run` rows (static window, masked merge)
+                    ksrc = lax.dynamic_slice(
+                        ks, (l, 0, src, 0),
+                        (1, self.cfg.kv_heads, self.page,
+                         self.cfg.head_dim))
+                    vsrc = lax.dynamic_slice(
+                        vs, (l, 0, src, 0),
+                        (1, self.cfg.kv_heads, self.page,
+                         self.cfg.head_dim))
+                    old_k = lax.dynamic_slice(
+                        kp, (dst, 0, 0, 0),
+                        (1, self.cfg.kv_heads, self.page,
+                         self.cfg.head_dim))
+                    old_v = lax.dynamic_slice(
+                        vp, (dst, 0, 0, 0),
+                        (1, self.cfg.kv_heads, self.page,
+                         self.cfg.head_dim))
+                    m = (jnp.arange(self.page) < run)[None, None, :,
+                                                      None]
+                    km = jnp.where(m, ksrc, old_k)
+                    vm = jnp.where(m, vsrc, old_v)
+                    kp2 = lax.dynamic_update_slice(kp, km,
+                                                   (dst, 0, 0, 0))
+                    vp2 = lax.dynamic_update_slice(vp, vm,
+                                                   (dst, 0, 0, 0))
+                    return kp2, vp2
+
+                return lax.cond(run > 0, do, lambda kvp: kvp, (kp, vp))
+
+            return lax.fori_loop(0, self.cfg.n_layers, write_layer,
+                                 (kp, vp))
+
+        n_seg = write_segments.shape[0]
+        kp, vp = lax.fori_loop(0, n_seg, write_seg, (kp, vp))
+        idx = jnp.clip(true_len - 1, 0, tokens.shape[1] - 1)
+        logits = self._lm_head(head, x[:, idx][:, None])[:, 0]
+        nxt = jnp.argmax(logits.astype(jnp.float32), -1).astype(
+            jnp.int32)[0]
+        return kp, vp, nxt
+
+    # -- scheduler ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_id: Optional[int] = None) -> Request:
+        prompt = list(np.asarray(prompt).reshape(-1))
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.buckets[-1]:
+            raise ValueError(
+                f"paged prefill caps prompts at {self.buckets[-1]} "
+                f"tokens (got {len(prompt)}); use DecodeEngine for "
+                f"longer prompts")
+        if len(prompt) + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError("prompt + new tokens exceed max_seq_len")
+        req = Request(prompt, max_new_tokens, eos_id)
+        self._waiting.append(req)
+        return req
+
+    def _free_slot(self) -> Optional[int]:
+        for s, r in enumerate(self._slot_req):
+            if r is None:
+                return s
+        return None
+
+    def _admit(self, req: Request, slot: int):
+        prompt = np.asarray(req.prompt, np.int32)
+        n = len(prompt)
+        bucket = next(b for b in self.buckets if b >= n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt
+        self._reserve(slot, n)
+        tab = self._tables[slot]
+        # page-run copy plan: valid rows [0, n) split at page boundaries
+        max_seg = bucket // self.page + 1
+        segs = np.zeros((max_seg, self.cfg.n_layers, 3), np.int32)
+        t, i = 0, 0
+        while t < n:
+            pid = tab[t // self.page]
+            run = min(n - t, self.page - (t % self.page))
+            for l in range(self.cfg.n_layers):
+                segs[i, l] = (l * self.P + pid, t, run)
+            t += run
+            i += 1
+        self.kp, self.vp, nxt = self._prefill_fn(
+            self._head, self._stacked, self.kp, self.vp,
+            jnp.asarray(padded), jnp.int32(n), jnp.asarray(segs))
+        self.lengths = self.lengths.at[slot].set(n)
+        self.last = self.last.at[slot].set(int(nxt))
+        self.active = self.active.at[slot].set(True)
+        self._slot_req[slot] = req
+        self._emit(slot, req, int(nxt))
+
+    def _emit(self, slot: int, req: Request, token: int):
+        req.tokens.append(token)
+        if ((req.eos_id is not None and token == req.eos_id)
+                or len(req.tokens) >= req.max_new_tokens):
+            req.done = True
+            self._slot_req[slot] = None
+            self._release(slot)
+            self.active = self.active.at[slot].set(False)
+
+    def step(self) -> int:
+        while self._waiting:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self._waiting.popleft()
+            try:
+                self._admit(req, slot)
+            except MemoryError:
+                # not enough pages right now: return the partial
+                # reservation, requeue, and keep decoding — page
+                # retirements will make room
+                self._release(slot)
+                self._waiting.appendleft(req)
+                if not any(r is not None for r in self._slot_req):
+                    raise MemoryError(
+                        f"page pool ({self.P} pages of {self.page}) too "
+                        f"small for even one request of "
+                        f"{len(req.prompt)} tokens")
+                break
+        live = [(s, r) for s, r in enumerate(self._slot_req)
+                if r is not None]
+        if not live:
+            return 0
+        # reserve pages for the whole chunk so the table is static
+        lens_host = np.asarray(self.lengths)
+        for slot, req in live:
+            budget = min(self.chunk,
+                         req.max_new_tokens - len(req.tokens))
+            self._reserve(slot, int(lens_host[slot]) + budget + 1)
+        remaining = np.zeros((self.S,), np.int32)
+        eos = np.full((self.S,), -1, np.int32)
+        for slot, req in live:
+            remaining[slot] = req.max_new_tokens - len(req.tokens)
+            if req.eos_id is not None:
+                eos[slot] = req.eos_id
+        self.steps += 1
+        (self.kp, self.vp, self.lengths, self.last, self.active, _,
+         toks, flags) = self._multi_fn(
+            self._head, self._stacked, self.kp, self.vp,
+            self._table_array(), self.lengths, self.last, self.active,
+            jnp.asarray(remaining), jnp.asarray(eos))
+        toks = np.asarray(toks)
+        flags = np.asarray(flags)
+        total = 0
+        for slot, req in live:
+            for j in range(self.chunk):
+                if flags[j, slot] and not req.done:
+                    self._emit(slot, req, int(toks[j, slot]))
+                    total += 1
+        self.tokens_emitted += total
+        return total
+
+    def run(self) -> None:
+        while self._waiting or any(r is not None for r in self._slot_req):
+            self.step()

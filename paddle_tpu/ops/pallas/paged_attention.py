@@ -176,6 +176,40 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     return out[:, :group, :].reshape(b, hq, d)
 
 
+class PageAllocator:
+    """LIFO free-list page allocator: the ONE reserve/release
+    implementation shared by `PagedKVCache` and the paged serving
+    engine."""
+
+    def __init__(self, n_pages: int, page_size: int,
+                 max_pages_per_seq: int = 0):
+        self.page = int(page_size)
+        self.n_pages = int(n_pages)
+        self.max_pages = int(max_pages_per_seq)
+        self._free = list(range(n_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def reserve(self, table, n_tokens):
+        """Grow ``table`` (a list of page ids) to cover ``n_tokens``."""
+        need = (n_tokens + self.page - 1) // self.page
+        while len(table) < need:
+            if not self._free:
+                raise MemoryError("page pool exhausted")
+            if self.max_pages and len(table) >= self.max_pages:
+                raise MemoryError(
+                    f"sequence exceeds max_pages_per_seq="
+                    f"{self.max_pages}")
+            table.append(self._free.pop())
+        return table
+
+    def release(self, table):
+        self._free.extend(reversed(table))
+        table.clear()
+
+
 class PagedKVCache:
     """Host-side page pool + tables (the allocator half of paged
     serving; the kernel half is `paged_decode_attention`).
@@ -197,14 +231,14 @@ class PagedKVCache:
         shape = (n_layers, n_pages, kv_heads, page_size, head_dim)
         self.kp = jnp.zeros(shape, dtype)
         self.vp = jnp.zeros(shape, dtype)
-        self._free = list(range(n_pages - 1, -1, -1))
-        self.max_pages = int(max_pages_per_seq or 0)
+        self._alloc = PageAllocator(n_pages, page_size,
+                                    max_pages_per_seq or 0)
         self.tables = {}        # seq id -> [page ids]
         self.lengths = {}       # seq id -> tokens written
 
     @property
     def free_pages(self):
-        return len(self._free)
+        return self._alloc.free_pages
 
     def alloc_seq(self, seq_id, n_tokens=0):
         if seq_id in self.tables:
@@ -216,18 +250,11 @@ class PagedKVCache:
 
     def reserve(self, seq_id, n_tokens):
         """Ensure capacity for ``n_tokens`` total tokens."""
-        need = (n_tokens + self.page - 1) // self.page
-        tab = self.tables[seq_id]
-        while len(tab) < need:
-            if not self._free:
-                raise MemoryError("page pool exhausted")
-            if self.max_pages and len(tab) >= self.max_pages:
-                raise MemoryError(
-                    f"sequence exceeds max_pages_per_seq={self.max_pages}")
-            tab.append(self._free.pop())
+        self._alloc.reserve(self.tables[seq_id], n_tokens)
 
     def free_seq(self, seq_id):
-        self._free.extend(reversed(self.tables.pop(seq_id)))
+        self._alloc.release(self.tables[seq_id])
+        self.tables.pop(seq_id)
         self.lengths.pop(seq_id)
 
     def write_rows(self, seq_id, k_rows, v_rows):
