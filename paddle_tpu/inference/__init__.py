@@ -26,10 +26,12 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Config", "Predictor", "create_predictor", "DynamicBatcher",
-           "DecodeEngine", "decode_roofline_tokens_per_sec"]
+           "DecodeEngine", "PagedDecodeEngine",
+           "decode_roofline_tokens_per_sec"]
 
 from paddle_tpu.inference.decode_engine import (  # noqa: E402
     DecodeEngine, decode_roofline_tokens_per_sec)
+from paddle_tpu.inference.paged_engine import PagedDecodeEngine
 
 
 class Config:
