@@ -108,11 +108,12 @@ def _write_result(device, parsed, note):
 
 
 def run_bench(device: str):
-    """Two-phase capture: the cheap BASELINE rows land on disk FIRST
-    (~6 min), then the flagship + decode + longctx run merges on top —
-    a tunnel death mid-flagship-compile no longer loses the round's
-    hardware evidence (r3/r4 failure mode). A cheap-only result never
-    overwrites an earlier FULL capture."""
+    """Two-phase capture, NEW information first: the flagship + decode
+    + longctx phase (the round's changed code paths) runs the moment
+    the tunnel answers and lands on disk immediately; the cheap
+    BASELINE rows (already banked at round start in
+    BENCH_r05_roundstart.json) refresh second and merge in. A
+    partial result never overwrites an earlier FULL capture."""
     env = dict(os.environ)
     # The tunnel just answered, so a wedged acquisition now means it died
     # mid-bench — fail fast enough to resume probing.
@@ -129,25 +130,27 @@ def run_bench(device: str):
         except ValueError:
             return str(cap)
 
-    env_a = dict(env, PT_BENCH_ONLY="bert,resnet50,ppyoloe,pp",
-                 PT_BENCH_BUDGET_S=_budget(1500))
-    cheap = _run_one(env_a, "cheap-rows", 1800)
-    if cheap is not None and not _existing_is_full():
-        _write_result(device, cheap, "cheap BASELINE rows only; flagship "
-                      "phase pending")
-
-    env_b = dict(env, PT_BENCH_ONLY="gpt,decode,longctx",
+    env_a = dict(env, PT_BENCH_ONLY="gpt,decode,longctx",
                  PT_BENCH_BUDGET_S=_budget(4500))
-    flag = _run_one(env_b, "flagship", 5400)
+    flag = _run_one(env_a, "flagship", 5400)
     if flag is not None:
-        if cheap is not None:
-            merged_extra = dict(cheap.get("extra", {}))
-            merged_extra.update(flag.get("extra", {}))
-            flag = dict(flag, extra=merged_extra)
-        _write_result(device, flag, "flagship + decode + longctx merged "
-                      "over same-session cheap rows")
-    # flagship missing => retry on the short DOWN interval, whatever the
-    # cheap phase did
+        _write_result(device, flag, "flagship + decode + longctx; cheap "
+                      "rows phase pending")
+
+    env_b = dict(env, PT_BENCH_ONLY="bert,resnet50,ppyoloe,pp",
+                 PT_BENCH_BUDGET_S=_budget(1500))
+    cheap = _run_one(env_b, "cheap-rows", 1800)
+    if cheap is not None:
+        if flag is not None:
+            merged_extra = dict(flag.get("extra", {}))
+            merged_extra.update(cheap.get("extra", {}))
+            _write_result(device, dict(flag, extra=merged_extra),
+                          "flagship + decode + longctx merged with "
+                          "same-session cheap rows")
+        elif not _existing_is_full():
+            _write_result(device, cheap, "cheap BASELINE rows only "
+                          "(flagship phase failed this cycle)")
+    # flagship missing => retry on the short DOWN interval
     return flag is not None
 
 
