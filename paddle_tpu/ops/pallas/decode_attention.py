@@ -42,6 +42,42 @@ _LANES = 128
 _NEG_INF = float("-inf")
 
 
+def online_softmax_step(q, k, v, col0, length, acc_ref, m_ref, l_ref,
+                        scale):
+    """One KV-block update of the online softmax: masked scores against
+    columns [col0, col0+block) valid below ``length``, then the running
+    (m, l, acc) rescale-and-accumulate. Shared by the contiguous and
+    the paged decode kernels — ONE numerics definition."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    col = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < length, s, _NEG_INF)
+
+    m_prev = m_ref[...]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    m_cur = jnp.maximum(m_cur, -1e30)  # fully-masked block → p = 0
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.exp(s - m_cur[:, :1])
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = (acc_ref[...] * alpha[:, :1]
+                    + jax.lax.dot(p.astype(v.dtype), v,
+                                  preferred_element_type=jnp.float32))
+    m_ref[...] = m_cur
+
+
+def online_softmax_init(acc_ref, m_ref, l_ref):
+    m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+
+def online_softmax_finalize(o_ref, acc_ref, l_ref):
+    l = l_ref[:, :1]
+    o_ref[0] = (acc_ref[...]
+                / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, block_k,
             hkv, with_stats):
     # the stats output ref exists only when requested (out_specs are
@@ -57,9 +93,7 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, block_k,
 
     @pl.when(j == 0)
     def _init():
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        online_softmax_init(acc_ref, m_ref, l_ref)
 
     length = len_ref[b]
 
@@ -68,33 +102,15 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, block_k,
     # DMA), so the compute must not run again.
     @pl.when(j * block_k < length)
     def _body():
-        q = q_ref[0]          # (Gp, D)
-        k = k_ref[0, 0]       # (block_k, D)
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        col = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(col < length, s, _NEG_INF)
-
-        m_prev = m_ref[...]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        m_cur = jnp.maximum(m_cur, -1e30)  # fully-masked block → p = 0
-        alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur[:, :1])
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[...] = (acc_ref[...] * alpha[:, :1]
-                        + jax.lax.dot(p.astype(v.dtype), v,
-                                      preferred_element_type=jnp.float32))
-        m_ref[...] = m_cur
+        online_softmax_step(q_ref[0], k_ref[0, 0], v_ref[0, 0],
+                            j * block_k, length, acc_ref, m_ref, l_ref,
+                            scale)
 
     @pl.when(j == nk - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        o_ref[0] = (acc_ref[...]
-                    / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+        online_softmax_finalize(o_ref, acc_ref, l_ref)
         if with_stats:
+            l = l_ref[:, :1]
             # column 0: running max; column 1: softmax denominator —
             # lets the caller fold extra columns (e.g. the current
             # token's fresh KV row) into the softmax analytically
