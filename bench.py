@@ -618,8 +618,8 @@ def bench_decode(jax, jnp, peak, smoke=False):
     import os
     sections = {s.strip() for s in os.environ.get(
         "PT_DECODE_SECTIONS",
-        "generate,int8,engine,engine_longctx,engine_int8,spec"
-        ).split(",")}
+        "generate,int8,engine,engine_longctx,engine_paged,engine_int8,"
+        "spec").split(",")}
     b, s0, new = (2, 8, 4) if smoke else (8, 128, 64)
     res = {"decode_batch": b, "decode_prefill": s0, "decode_new": new}
     tokens = jnp.asarray(
@@ -710,13 +710,15 @@ def bench_decode(jax, jnp, peak, smoke=False):
         res["decode_spec_error"] = str(e)[:160]
     want_int8 = "engine_int8" in sections
     want_longctx = "engine_longctx" in sections and not smoke
-    if (want_int8 or want_longctx) and eng is None and eng2 is None:
+    want_paged = "engine_paged" in sections and not smoke
+    if (want_int8 or want_longctx or want_paged) \
+            and eng is None and eng2 is None:
       try:  # these sections need a bf16 donor stack even without 'engine'
         eng = DecodeEngine(model, max_slots=slots, max_len=s_pf + n_new2,
                            steps_per_call=2 if smoke else 64)
       except Exception as e:
         res["decode_engine_int8_error"] = str(e)[:160]
-        want_int8 = want_longctx = False
+        want_int8 = want_longctx = want_paged = False
     if eng is not None or eng2 is not None:
         if getattr(bench_gpt, "model", None) is model:
             del bench_gpt.model
@@ -779,6 +781,28 @@ def bench_decode(jax, jnp, peak, smoke=False):
             # the T=1024 caches must not pressure the int8/spec timings
             engL.kc = engL.vc = None
             del engL
+
+    try:
+      if want_paged and (eng is not None or eng2 is not None):
+        # paged serving engine on the same workload: first on-hardware
+        # exercise of the block-table kernel; memory claim = pages for
+        # live tokens only (vs slots x max_len in the contiguous engine)
+        from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+        engP = PagedDecodeEngine(
+            None, n_pages=slots * ((s_pf + n_new2) // 128 + 1) + 2,
+            max_slots=slots, steps_per_call=64,
+            share_weights_with=(eng if eng is not None else eng2))
+        tps, _ = _time_engine(engP)
+        res["decode_engine_paged_tokens_per_sec"] = round(tps, 1)
+        if roof is None:
+            roof = decode_roofline_tokens_per_sec(
+                cfg, slots, s_pf + n_new2 // 2,
+                _hbm_gbps(jax.devices()[0]))
+        res["decode_engine_paged_vs_roofline"] = round(tps / roof, 4)
+        engP.kp = engP.vp = None
+        del engP
+    except Exception as e:
+        res["decode_engine_paged_error"] = str(e)[:160]
 
     try:
       if want_int8 and (eng is not None or eng2 is not None):

@@ -79,6 +79,39 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def resolve_engine_weights(model, share_weights_with):
+    """The ONE donor-or-build protocol shared by the contiguous and the
+    paged engines: returns (cfg, head dict, scan-stacked blocks). With a
+    donor, weights alias the donor's (no second copy); otherwise they
+    are built from ``model`` (which must be a dense stack)."""
+    if model is None:
+        if share_weights_with is None:
+            raise ValueError(
+                "model=None requires share_weights_with (the donor "
+                "engine supplies config + weights)")
+        cfg = share_weights_with.cfg
+    else:
+        cfg = model.cfg
+        if any(model.blocks[i].moe is not None
+               for i in range(cfg.n_layers)):
+            raise NotImplementedError(
+                "engines serve dense stacks (MoE decode goes through "
+                "gpt.generate)")
+    if share_weights_with is not None:
+        if share_weights_with.cfg is not cfg:
+            raise ValueError(
+                "share_weights_with engine serves a different model")
+        return (cfg, share_weights_with._head,
+                share_weights_with._stacked)
+    head = {"wte": model.wte, "wpe": model.wpe,
+            "lnf_scale": model.lnf_scale,
+            "lnf_bias": model.lnf_bias,
+            "lm_head": model.lm_head}
+    stacked = gpt_lib.stack_block_weights(
+        [model.blocks[i] for i in range(cfg.n_layers)])
+    return cfg, head, stacked
+
+
 class Request:
     """One in-flight generation request."""
 
@@ -119,19 +152,8 @@ class DecodeEngine:
                  speculative_k: int = 0, steps_per_call: int = 1,
                  share_weights_with: "Optional[DecodeEngine]" = None,
                  weight_dtype: Optional[str] = None, mesh=None):
-        if model is None:
-            if share_weights_with is None:
-                raise ValueError(
-                    "model=None requires share_weights_with (the donor "
-                    "engine supplies config + weights)")
-            cfg = share_weights_with.cfg
-        else:
-            cfg = model.cfg
-            if any(model.blocks[i].moe is not None
-                   for i in range(cfg.n_layers)):
-                raise NotImplementedError(
-                    "DecodeEngine serves dense stacks (MoE decode goes "
-                    "through gpt.generate)")
+        cfg, head, stacked = resolve_engine_weights(model,
+                                                    share_weights_with)
         self.cfg = cfg
         # prefer a 128-multiple cache length (keeps the flash-decode kernel
         # engaged) but never exceed the position table — jnp.take would
@@ -148,25 +170,13 @@ class DecodeEngine:
             raise ValueError(
                 f"bucket {self.buckets[-1]} exceeds cache length {self.T}")
 
-        # split the weights the jitted bodies actually touch: the embedding
-        # / final-ln / head leaves, and ONE scan-stacked copy of the blocks
-        # (passed as arguments, so nothing is baked into executables).
-        # A second engine over the same model (e.g. a speculative one next
-        # to a plain one) shares the stacked copy via share_weights_with —
-        # at 1.3B a redundant copy is 2.4GB of HBM.
-        if share_weights_with is not None:
-            if share_weights_with.cfg is not cfg:
-                raise ValueError(
-                    "share_weights_with engine serves a different model")
-            self._head = share_weights_with._head
-            self._stacked = share_weights_with._stacked
-        else:
-            self._head = {"wte": model.wte, "wpe": model.wpe,
-                          "lnf_scale": model.lnf_scale,
-                          "lnf_bias": model.lnf_bias,
-                          "lm_head": model.lm_head}
-            self._stacked = gpt_lib.stack_block_weights(
-                [model.blocks[i] for i in range(cfg.n_layers)])
+        # the weights the jitted bodies actually touch: the embedding /
+        # final-ln / head leaves, and ONE scan-stacked copy of the
+        # blocks (passed as arguments, so nothing is baked into
+        # executables). A second engine over the same model shares the
+        # stacked copy via share_weights_with — at 1.3B a redundant
+        # copy is 2.4GB of HBM (resolved by resolve_engine_weights).
+        self._head, self._stacked = head, stacked
         if weight_dtype == "int8":
             # weight-only int8 serving: decode is HBM-bandwidth bound,
             # so halving the dominant read (block matmul weights stream

@@ -61,11 +61,12 @@ class PagedDecodeEngine:
 
     def __init__(self, model, n_pages: int, max_slots: int = 8,
                  page_size: int = 128, steps_per_call: int = 1,
-                 buckets=(16, 32, 64, 128, 256, 512)):
-        cfg = model.cfg
-        if any(model.blocks[i].moe is not None
-               for i in range(cfg.n_layers)):
-            raise NotImplementedError("paged engine serves dense stacks")
+                 buckets=(16, 32, 64, 128, 256, 512),
+                 share_weights_with=None):
+        from paddle_tpu.inference.decode_engine import (
+            resolve_engine_weights)
+        cfg, head, stacked = resolve_engine_weights(model,
+                                                    share_weights_with)
         if page_size % 128:
             raise ValueError("page_size must be a multiple of 128")
         self.cfg = cfg
@@ -83,12 +84,7 @@ class PagedDecodeEngine:
                 raise ValueError(
                     f"page_size {self.page} must divide every bucket "
                     f"above it (bucket {b})")
-        self._head = {"wte": model.wte, "wpe": model.wpe,
-                      "lnf_scale": model.lnf_scale,
-                      "lnf_bias": model.lnf_bias,
-                      "lm_head": model.lm_head}
-        self._stacked = gpt_lib.stack_block_weights(
-            [model.blocks[i] for i in range(cfg.n_layers)])
+        self._head, self._stacked = head, stacked
         L = cfg.n_layers
         # layer-folded pools: page p of layer l lives at row l*P + p.
         # ONE extra row at the very end is the scratch page: idle slots'

@@ -126,3 +126,24 @@ def test_page_size_must_divide_buckets():
     model = _model()
     with pytest.raises(ValueError):
         PagedDecodeEngine(model, n_pages=4, max_slots=1, page_size=384)
+
+
+def test_paged_share_weights_with_decode_engine_donor():
+    """The bench path: a PagedDecodeEngine built from a DecodeEngine's
+    stacked weights (no model, no duplicate copy) serves identically."""
+    from paddle_tpu.inference.decode_engine import DecodeEngine
+
+    model = _model()
+    rs = np.random.RandomState(4)
+    prompts = [list(rs.randint(0, 96, size=n)) for n in (9, 130)]
+    donor = DecodeEngine(model, max_slots=2, max_len=192)
+    r_ref = [donor.submit(p, max_new_tokens=8) for p in prompts]
+    donor.run()
+
+    eng = PagedDecodeEngine(None, n_pages=8, max_slots=2,
+                            steps_per_call=3, share_weights_with=donor)
+    assert eng._stacked is donor._stacked
+    r = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run()
+    for a, b in zip(r_ref, r):
+        assert a.tokens == b.tokens
