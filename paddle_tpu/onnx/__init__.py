@@ -1,14 +1,312 @@
-"""ONNX export shim (ref: python/paddle/onnx/export.py delegates to external
-paddle2onnx). The TPU-native interchange format is StableHLO
-(paddle_tpu.jit.save); ONNX export is available when the optional onnx
-package exists, else raises with guidance."""
+"""ONNX export (ref: python/paddle/onnx/export.py).
 
-__all__ = ["export"]
+The reference delegates to the external paddle2onnx package; this
+environment has neither it nor the ``onnx`` package, so export here is
+self-contained: a structural walk over the layer tree emits ONNX
+ModelProto bytes through the wire-format writer in ``_wire.py``. The
+TPU-native interchange format remains StableHLO (``paddle_tpu.jit.save``);
+ONNX export covers the feed-forward layer subset interchange tooling
+actually consumes (MLPs / CNNs: Linear, Conv2D, BatchNorm, pooling,
+Flatten, the common activations). Layers outside the subset raise with
+guidance rather than exporting something silently wrong.
+
+Verification story: ``load_model`` parses the emitted bytes back and
+``paddle_tpu.onnx._numpy_eval.run_model`` executes them per the ONNX
+operator spec; tests/test_onnx_export.py pins numeric parity between the
+exported file and ``layer(x)``.
+"""
+
+import math
+
+import numpy as np
+
+from paddle_tpu.onnx import _wire
+
+__all__ = ["export", "load_model"]
+
+_SUPPORTED = ("Linear, Conv2D, BatchNorm/1D/2D, ReLU, LeakyReLU, GELU "
+              "(exact), Sigmoid, Tanh, Softmax, MaxPool2D, AvgPool2D, "
+              "AdaptiveAvgPool2D(1), Flatten(start_axis=1), Dropout "
+              "(inference no-op), Identity, Sequential")
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+def _pair(v):
+    if isinstance(v, str):
+        raise ValueError(
+            f"string padding mode {v!r} does not export: ONNX Conv/pool "
+            "pads are explicit integers — construct the layer with numeric "
+            "padding")
+    if isinstance(v, (list, tuple)):
+        if len(v) != 2:
+            raise ValueError(f"expected 2 spatial values, got {v}")
+        return [int(v[0]), int(v[1])]
+    return [int(v), int(v)]
+
+
+def _pads4(padding):
+    ph, pw = _pair(padding)
+    return [ph, pw, ph, pw]
+
+
+class _Ctx:
+    def __init__(self, sym_batch=False):
+        self.nodes = []
+        self.initializers = []
+        self.value_infos = []
+        self.counter = {}
+        self.sym_batch = sym_batch  # input batch dim is symbolic "N"
+
+    def name(self, op):
+        i = self.counter.get(op, 0)
+        self.counter[op] = i + 1
+        return f"{op.lower()}_{i}"
+
+    def add_init(self, name, array):
+        self.initializers.append(_wire.tensor(name, np.asarray(array)))
+        return name
+
+    def sym_shape(self, shape):
+        # every op in the exportable subset preserves the batch dim, so a
+        # symbolic input batch stays symbolic through all recorded shapes
+        if self.sym_batch and shape:
+            return ["N"] + list(shape[1:])
+        return shape
+
+    def emit(self, op, inputs, out_shape, **attrs):
+        nm = self.name(op)
+        out = f"{nm}_out"
+        self.nodes.append(_wire.node(op, inputs, [out], name=nm, **attrs))
+        self.value_infos.append(_wire.value_info(out, _wire.FLOAT,
+                                                 self.sym_shape(out_shape)))
+        return out, out_shape
+
+
+def _conv_out(size, k, p, s, d):
+    return (size + 2 * p - d * (k - 1) - 1) // s + 1
+
+
+def _emit_layer(layer, x, shape, ctx):
+    """Append nodes for one leaf layer; returns (tensor name, shape)."""
+    from paddle_tpu import nn
+    from paddle_tpu.nn.layer.norm import _BatchNormBase
+
+    cls = type(layer).__name__
+
+    if isinstance(layer, nn.Sequential):
+        for sub in layer:
+            x, shape = _emit_layer(sub, x, shape, ctx)
+        return x, shape
+
+    if isinstance(layer, nn.Linear):
+        if len(shape) != 2:
+            raise ValueError(
+                f"Linear expects a 2D tensor at export (got rank "
+                f"{len(shape)}); insert nn.Flatten() before it")
+        w = ctx.add_init(ctx.name("w"), np.asarray(layer.weight, np.float32))
+        ins = [x, w]
+        if layer.bias is not None:
+            ins.append(ctx.add_init(ctx.name("b"),
+                                    np.asarray(layer.bias, np.float32)))
+        # weight layout (in, out) → Gemm transB=0
+        return ctx.emit("Gemm", ins, [shape[0], layer.out_features],
+                        alpha=1.0, beta=1.0, transA=0, transB=0)
+
+    if isinstance(layer, nn.Conv2D):
+        if layer.transpose or layer.data_format != "NCHW":
+            raise ValueError("only plain NCHW Conv2D exports to ONNX")
+        strides = _pair(layer.stride)
+        dil = _pair(layer.dilation)
+        pads = _pads4(layer.padding)
+        kh, kw = layer.kernel_size
+        n, _, H, W = shape
+        oshape = [n, layer.out_channels,
+                  _conv_out(H, kh, pads[0], strides[0], dil[0]),
+                  _conv_out(W, kw, pads[1], strides[1], dil[1])]
+        w = ctx.add_init(ctx.name("w"), np.asarray(layer.weight, np.float32))
+        ins = [x, w]
+        if layer.bias is not None:
+            ins.append(ctx.add_init(ctx.name("b"),
+                                    np.asarray(layer.bias, np.float32)))
+        return ctx.emit("Conv", ins, oshape, kernel_shape=[kh, kw],
+                        strides=strides, pads=pads, dilations=dil,
+                        group=layer.groups)
+
+    if isinstance(layer, _BatchNormBase):
+        if layer.data_format != "NCHW":
+            raise ValueError(
+                "only channel-first batch norm exports (ONNX "
+                "BatchNormalization normalizes axis 1); got data_format="
+                f"{layer.data_format!r}")
+        scale = np.ones(layer.num_features, np.float32) \
+            if layer.weight is None else np.asarray(layer.weight, np.float32)
+        bias = np.zeros(layer.num_features, np.float32) \
+            if layer.bias is None else np.asarray(layer.bias, np.float32)
+        ins = [x,
+               ctx.add_init(ctx.name("scale"), scale),
+               ctx.add_init(ctx.name("bn_bias"), bias),
+               ctx.add_init(ctx.name("mean"),
+                            np.asarray(layer._mean, np.float32)),
+               ctx.add_init(ctx.name("var"),
+                            np.asarray(layer._variance, np.float32))]
+        return ctx.emit("BatchNormalization", ins, shape,
+                        epsilon=float(layer.epsilon))
+
+    if isinstance(layer, (nn.MaxPool2D, nn.AvgPool2D)):
+        k = _pair(layer.kernel_size)
+        s = _pair(layer.stride if layer.stride is not None
+                  else layer.kernel_size)
+        pads = _pads4(layer.padding)
+        if layer.kwargs.get("ceil_mode"):
+            raise ValueError("ceil_mode pooling is not exported")
+        if layer.kwargs.get("divisor_override") is not None:
+            raise ValueError("divisor_override has no ONNX AveragePool "
+                             "analog and is not exported")
+        n, c, H, W = shape
+        oshape = [n, c, _conv_out(H, k[0], pads[0], s[0], 1),
+                  _conv_out(W, k[1], pads[1], s[1], 1)]
+        if isinstance(layer, nn.MaxPool2D):
+            if layer.kwargs.get("return_mask"):
+                raise ValueError("MaxPool2D(return_mask=True) returns "
+                                 "(values, indices); only the single-output "
+                                 "form exports")
+            return ctx.emit("MaxPool", [x], oshape, kernel_shape=k,
+                            strides=s, pads=pads)
+        # F.avg_pool2d exclusive=True default == count_include_pad=0
+        include = 0 if layer.kwargs.get("exclusive", True) else 1
+        return ctx.emit("AveragePool", [x], oshape, kernel_shape=k,
+                        strides=s, pads=pads, count_include_pad=include)
+
+    if cls == "AdaptiveAvgPool2D":
+        osz = _pair(layer.output_size)
+        if osz != [1, 1]:
+            raise ValueError("only AdaptiveAvgPool2D(output_size=1) exports "
+                             "(→ GlobalAveragePool)")
+        return ctx.emit("GlobalAveragePool", [x], [shape[0], shape[1], 1, 1])
+
+    if isinstance(layer, nn.Flatten):
+        if layer.start_axis != 1 or layer.stop_axis not in (-1,
+                                                            len(shape) - 1):
+            raise ValueError("only Flatten(start_axis=1, stop_axis=-1) "
+                             "exports (ONNX Flatten emits 2D)")
+        flat = 1
+        for d in shape[1:]:
+            flat *= d
+        return ctx.emit("Flatten", [x], [shape[0], flat], axis=1)
+
+    if isinstance(layer, (nn.Dropout, nn.Identity)):
+        return ctx.emit("Identity", [x], shape)
+
+    if cls == "ReLU":
+        return ctx.emit("Relu", [x], shape)
+    if cls == "LeakyReLU":
+        alpha = layer._args[0] if layer._args else \
+            layer._kwargs.get("negative_slope", 0.01)
+        return ctx.emit("LeakyRelu", [x], shape, alpha=float(alpha))
+    if cls == "Sigmoid":
+        return ctx.emit("Sigmoid", [x], shape)
+    if cls == "Tanh":
+        return ctx.emit("Tanh", [x], shape)
+    if cls == "Softmax":
+        axis = layer._args[0] if layer._args else \
+            layer._kwargs.get("axis", -1)
+        return ctx.emit("Softmax", [x], shape, axis=int(axis))
+    if cls == "GELU":
+        approx = layer._args[0] if layer._args else \
+            layer._kwargs.get("approximate", False)
+        if approx:
+            raise ValueError("only exact GELU exports (erf decomposition)")
+        # 0.5 * x * (1 + erf(x / sqrt(2))) — core-opset decomposition
+        sqrt2 = ctx.add_init(ctx.name("c"),
+                             np.float32(math.sqrt(2.0)).reshape(()))
+        half = ctx.add_init(ctx.name("c"), np.float32(0.5).reshape(()))
+        one = ctx.add_init(ctx.name("c"), np.float32(1.0).reshape(()))
+        t, _ = ctx.emit("Div", [x, sqrt2], shape)
+        t, _ = ctx.emit("Erf", [t], shape)
+        t, _ = ctx.emit("Add", [t, one], shape)
+        t, _ = ctx.emit("Mul", [x, t], shape)
+        return ctx.emit("Mul", [t, half], shape)
+
     raise NotImplementedError(
-        "ONNX export is delegated to external tooling in the reference "
-        "(python/paddle/onnx/export.py → paddle2onnx). paddle_tpu's native "
-        "serving format is StableHLO: use paddle_tpu.jit.save(layer, path, "
-        "input_spec=...) and serve via any StableHLO-consuming runtime.")
+        f"layer {cls} has no ONNX export rule. Exportable subset: "
+        f"{_SUPPORTED}. For full-model deployment use paddle_tpu.jit.save "
+        f"(StableHLO).")
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export a feed-forward layer to an ONNX file; returns the path.
+
+    ``input_spec``: a single InputSpec, a shape tuple (batch dim may be
+    None or -1 → symbolic "N"), or an example array. Signature matches the
+    reference (python/paddle/onnx/export.py); extra ``**configs`` are
+    accepted for parity and ignored.
+    """
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.version import __version__
+
+    if opset_version < 13:
+        raise ValueError(
+            f"opset_version={opset_version} unsupported: the emitted graph "
+            "uses opset-13 semantics (negative Softmax axis, N-D Softmax); "
+            "pass opset_version >= 13")
+    if input_spec is None:
+        raise ValueError("input_spec is required (shape tuple, InputSpec, "
+                         "or example array)")
+    # reference call sites pass a LIST of specs; ours is single-input, so
+    # unwrap a one-element list of spec/shape/array and refuse multi-input
+    if isinstance(input_spec, (list, tuple)) and input_spec and (
+            isinstance(input_spec[0], (InputSpec, list, tuple))
+            or hasattr(input_spec[0], "shape")):
+        if len(input_spec) > 1:
+            raise ValueError(
+                f"got {len(input_spec)} input specs; only single-input "
+                "models export to ONNX here (multi-input graphs: use "
+                "paddle_tpu.jit.save)")
+        input_spec = input_spec[0]
+    if isinstance(input_spec, InputSpec):
+        in_shape = list(input_spec.shape)
+    elif hasattr(input_spec, "shape"):
+        in_shape = list(input_spec.shape)
+    else:
+        in_shape = list(input_spec)
+    if any(d is None or d == -1 for d in in_shape[1:]):
+        raise ValueError(
+            "only the batch dim may be dynamic: spatial/feature sizes "
+            "drive conv/pool/Flatten shape propagation, so they must be "
+            f"concrete (got {tuple(in_shape)})")
+    sym_batch = in_shape[0] is None or in_shape[0] == -1
+    sym_shape = (["N"] if sym_batch else [int(in_shape[0])]) \
+        + [int(d) for d in in_shape[1:]]
+    # shape propagation needs concrete sizes; the batch dim is never
+    # load-bearing in the exportable subset, so a symbolic batch
+    # propagates as 1 and is re-symbolized in every recorded value_info
+    calc_shape = [1 if isinstance(d, str) else d for d in sym_shape]
+
+    ctx = _Ctx(sym_batch=sym_batch)
+    out_name, out_shape = _emit_layer(layer, "input", calc_shape, ctx)
+    if not ctx.nodes:
+        raise ValueError(
+            "layer produced no ONNX nodes (empty container?) — a graph "
+            "whose output is its input is rejected by ONNX checkers")
+    out_shape = ctx.sym_shape(out_shape)
+
+    g = _wire.graph(
+        type(layer).__name__,
+        ctx.nodes,
+        [_wire.value_info("input", _wire.FLOAT, sym_shape)],
+        [_wire.value_info(out_name, _wire.FLOAT, out_shape)],
+        ctx.initializers,
+        ctx.value_infos[:-1])
+    buf = _wire.model(g, opset_version, "paddle_tpu", __version__)
+
+    if not str(path).endswith(".onnx"):
+        path = str(path) + ".onnx"
+    with open(path, "wb") as f:
+        f.write(buf)
+    return path
+
+
+def load_model(path):
+    """Parse an ONNX file written by :func:`export` into a plain dict
+    (nodes / initializers / graph io) — inspection + test surface."""
+    with open(path, "rb") as f:
+        return _wire.parse_model(f.read())

@@ -1,0 +1,141 @@
+"""Reference interpreter for the ONNX op subset the exporter emits.
+
+Each op follows the ONNX operator spec (numpy, plain loops — tiny shapes
+only). This is a deliberately independent execution path from the jax
+layers: the export-parity tests run the *parsed file* through this
+interpreter and compare against ``layer(x)``, so a wrong attribute, a
+mislabeled tensor, or a wrong weight layout in the exporter shows up as a
+numeric mismatch, not just a structural one.
+"""
+
+import math
+
+import numpy as np
+
+_ERF = np.vectorize(math.erf)
+
+
+def _pad2d(x, pads):
+    # ONNX pads = [h_begin, w_begin, h_end, w_end]
+    hb, wb, he, we = pads
+    return np.pad(x, ((0, 0), (0, 0), (hb, he), (wb, we)))
+
+
+def _conv(x, w, b, attrs):
+    group = attrs.get("group", 1)
+    sh, sw = attrs.get("strides", [1, 1])
+    dh, dw = attrs.get("dilations", [1, 1])
+    xp = _pad2d(x, attrs.get("pads", [0, 0, 0, 0]))
+    n, cin, H, W = xp.shape
+    m, cin_g, kh, kw = w.shape
+    oh = (H - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W - (dw * (kw - 1) + 1)) // sw + 1
+    out = np.zeros((n, m, oh, ow), np.float64)
+    m_g = m // group
+    for g in range(group):
+        xs = xp[:, g * cin_g:(g + 1) * cin_g]
+        for oc in range(g * m_g, (g + 1) * m_g):
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xs[:, :, i * sh:i * sh + dh * (kh - 1) + 1:dh,
+                               j * sw:j * sw + dw * (kw - 1) + 1:dw]
+                    out[:, oc, i, j] = np.sum(
+                        patch * w[oc][None], axis=(1, 2, 3))
+    if b is not None:
+        out += b[None, :, None, None]
+    return out
+
+
+def _pool(x, attrs, mode):
+    kh, kw = attrs["kernel_shape"]
+    sh, sw = attrs.get("strides", [kh, kw])
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    fill = -np.inf if mode == "max" else 0.0
+    hb, wb, he, we = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (hb, he), (wb, we)),
+                constant_values=fill)
+    n, c, H, W = xp.shape
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    out = np.zeros((n, c, oh, ow), np.float64)
+    include_pad = attrs.get("count_include_pad", 0)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if mode == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            elif include_pad:
+                out[:, :, i, j] = win.mean(axis=(2, 3))
+            else:  # divisor = count of non-pad elements in this window
+                h0, w0 = i * sh, j * sw
+                vh = min(h0 + kh, hb + x.shape[2]) - max(h0, hb)
+                vw = min(w0 + kw, wb + x.shape[3]) - max(w0, wb)
+                out[:, :, i, j] = win.sum(axis=(2, 3)) / float(vh * vw)
+    return out
+
+
+def run_model(parsed: dict, feeds: dict):
+    """Execute a parse_model() dict; returns list of graph-output arrays."""
+    g = parsed["graph"]
+    env = {k: np.asarray(v, np.float64)
+           for k, v in g["initializers"].items()}
+    env.update({k: np.asarray(v, np.float64) for k, v in feeds.items()})
+
+    for nd in g["nodes"]:
+        op, ins, attrs = nd["op_type"], nd["input"], nd["attrs"]
+        x = env[ins[0]] if ins else None
+        if op == "Gemm":
+            a, bm = env[ins[0]], env[ins[1]]
+            if attrs.get("transA", 0):
+                a = a.T
+            if attrs.get("transB", 0):
+                bm = bm.T
+            y = attrs.get("alpha", 1.0) * (a @ bm)
+            if len(ins) > 2:
+                y = y + attrs.get("beta", 1.0) * env[ins[2]]
+        elif op == "Conv":
+            y = _conv(x, env[ins[1]],
+                      env[ins[2]] if len(ins) > 2 else None, attrs)
+        elif op == "MaxPool":
+            y = _pool(x, attrs, "max")
+        elif op == "AveragePool":
+            y = _pool(x, attrs, "avg")
+        elif op == "GlobalAveragePool":
+            y = x.mean(axis=(2, 3), keepdims=True)
+        elif op == "BatchNormalization":
+            scale, bias, mean, var = (env[i] for i in ins[1:5])
+            eps = attrs.get("epsilon", 1e-5)
+            shp = (1, -1) + (1,) * (x.ndim - 2)
+            y = ((x - mean.reshape(shp))
+                 / np.sqrt(var.reshape(shp) + eps)) \
+                * scale.reshape(shp) + bias.reshape(shp)
+        elif op == "Relu":
+            y = np.maximum(x, 0)
+        elif op == "LeakyRelu":
+            y = np.where(x >= 0, x, attrs.get("alpha", 0.01) * x)
+        elif op == "Sigmoid":
+            y = 1.0 / (1.0 + np.exp(-x))
+        elif op == "Tanh":
+            y = np.tanh(x)
+        elif op == "Erf":
+            y = _ERF(x)
+        elif op == "Softmax":
+            ax = attrs.get("axis", -1)
+            e = np.exp(x - x.max(axis=ax, keepdims=True))
+            y = e / e.sum(axis=ax, keepdims=True)
+        elif op == "Flatten":
+            ax = attrs.get("axis", 1)
+            y = x.reshape(int(np.prod(x.shape[:ax]) or 1), -1)
+        elif op == "Identity":
+            y = x
+        elif op == "Add":
+            y = env[ins[0]] + env[ins[1]]
+        elif op == "Mul":
+            y = env[ins[0]] * env[ins[1]]
+        elif op == "Div":
+            y = env[ins[0]] / env[ins[1]]
+        else:
+            raise NotImplementedError(f"interpreter lacks op {op}")
+        env[nd["output"][0]] = y
+
+    return [env[o["name"]] for o in g["outputs"]]

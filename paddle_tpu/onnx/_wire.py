@@ -1,0 +1,312 @@
+"""Minimal ONNX protobuf wire-format writer/reader.
+
+The environment has no ``onnx`` package, and the reference delegates ONNX
+export to external tooling (python/paddle/onnx/export.py → paddle2onnx).
+Rather than keeping a raise-only stub, this module emits spec-conformant
+ONNX ModelProto bytes directly: protobuf's wire format is just
+``(field_number << 3 | wire_type)`` tags followed by varints / fixed32 /
+length-delimited payloads, so a self-contained encoder for the handful of
+ONNX messages we need is small and dependency-free.
+
+Field numbers follow onnx/onnx.proto (the stable public schema):
+
+  ModelProto:    1 ir_version, 2 producer_name, 3 producer_version,
+                 4 domain, 5 model_version, 7 graph, 8 opset_import
+  OperatorSetId: 1 domain, 2 version
+  GraphProto:    1 node, 2 name, 5 initializer, 11 input, 12 output,
+                 13 value_info
+  NodeProto:     1 input, 2 output, 3 name, 4 op_type, 5 attribute
+  AttributeProto:1 name, 2 f, 3 i, 4 s, 7 floats, 8 ints, 20 type
+                 (type enum: FLOAT=1 INT=2 STRING=3 FLOATS=6 INTS=7)
+  TensorProto:   1 dims, 2 data_type, 8 name, 9 raw_data
+                 (data_type: FLOAT=1 INT32=6 INT64=7)
+  ValueInfoProto:1 name, 2 type
+  TypeProto:     1 tensor_type {1 elem_type, 2 shape}
+  TensorShape:   1 dim {1 dim_value, 2 dim_param}
+
+The reader below parses exactly what the writer emits (used by
+``paddle_tpu.onnx.load_model`` and the export-parity tests); it is a
+generic tag/value walker, so models written by other exporters with the
+same subset of fields also load.
+"""
+
+import struct
+
+FLOAT, INT32, INT64 = 1, 6, 7
+_ATTR_FLOAT, _ATTR_INT, _ATTR_STRING = 1, 2, 3
+_ATTR_FLOATS, _ATTR_INTS = 6, 7
+
+
+# ---------------------------------------------------------------- encoding
+
+def _varint(v: int) -> bytes:
+    if v < 0:  # protobuf int64: negative values use 10-byte two's complement
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+def _f_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _f_str(field: int, s: str) -> bytes:
+    return _f_bytes(field, s.encode("utf-8"))
+
+
+def _f_fixed32(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto bytes; type inferred from the python value."""
+    body = _f_str(1, name)
+    if isinstance(value, bool):
+        raise TypeError("use int for ONNX int attributes")
+    if isinstance(value, float):
+        body += _f_fixed32(2, value) + _f_varint(20, _ATTR_FLOAT)
+    elif isinstance(value, int):
+        body += _f_varint(3, value) + _f_varint(20, _ATTR_INT)
+    elif isinstance(value, str):
+        body += _f_bytes(4, value.encode("utf-8")) + _f_varint(20,
+                                                               _ATTR_STRING)
+    elif isinstance(value, (list, tuple)) and value and isinstance(
+            value[0], float):
+        packed = b"".join(struct.pack("<f", v) for v in value)
+        body += _f_bytes(7, packed) + _f_varint(20, _ATTR_FLOATS)
+    elif isinstance(value, (list, tuple)):
+        packed = b"".join(_varint(int(v)) for v in value)
+        body += _f_bytes(8, packed) + _f_varint(20, _ATTR_INTS)
+    else:
+        raise TypeError(f"unsupported attribute value {value!r}")
+    return body
+
+
+def node(op_type: str, inputs, outputs, name: str = "", **attrs) -> bytes:
+    body = b"".join(_f_str(1, i) for i in inputs)
+    body += b"".join(_f_str(2, o) for o in outputs)
+    if name:
+        body += _f_str(3, name)
+    body += _f_str(4, op_type)
+    for k in sorted(attrs):
+        body += _f_bytes(5, attribute(k, attrs[k]))
+    return body
+
+
+def tensor(name: str, array) -> bytes:
+    """TensorProto from a numpy array (float32/int32/int64 raw_data)."""
+    import numpy as np
+    a = np.ascontiguousarray(array)
+    kind = {"float32": FLOAT, "int32": INT32, "int64": INT64}.get(str(a.dtype))
+    if kind is None:
+        a = a.astype(np.float32)
+        kind = FLOAT
+    body = b"".join(_f_varint(1, d) for d in a.shape)
+    body += _f_varint(2, kind)
+    body += _f_str(8, name)
+    body += _f_bytes(9, a.tobytes())
+    return body
+
+
+def value_info(name: str, elem_type: int, shape) -> bytes:
+    """shape entries: int (fixed) or str (symbolic dim_param)."""
+    dims = b""
+    for d in shape:
+        dims += _f_bytes(1, _f_str(2, d) if isinstance(d, str)
+                         else _f_varint(1, int(d)))
+    tensor_type = _f_varint(1, elem_type) + _f_bytes(2, dims)
+    return _f_str(1, name) + _f_bytes(2, _f_bytes(1, tensor_type))
+
+
+def graph(name: str, nodes, inputs, outputs, initializers,
+          value_infos=()) -> bytes:
+    body = b"".join(_f_bytes(1, n) for n in nodes)
+    body += _f_str(2, name)
+    body += b"".join(_f_bytes(5, t) for t in initializers)
+    body += b"".join(_f_bytes(11, vi) for vi in inputs)
+    body += b"".join(_f_bytes(12, vi) for vi in outputs)
+    body += b"".join(_f_bytes(13, vi) for vi in value_infos)
+    return body
+
+
+def model(graph_bytes: bytes, opset_version: int, producer: str,
+          producer_version: str) -> bytes:
+    opset = _f_str(1, "") + _f_varint(2, opset_version)
+    return (_f_varint(1, 8)                 # ir_version 8 (ONNX 1.13 line)
+            + _f_str(2, producer)
+            + _f_str(3, producer_version)
+            + _f_bytes(7, graph_bytes)
+            + _f_bytes(8, opset))
+
+
+# ---------------------------------------------------------------- decoding
+
+def _read_varint(buf, pos):
+    shift = v = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if v >= 1 << 63:  # negative int64
+                v -= 1 << 64
+            return v, pos
+        shift += 7
+
+
+def _walk(buf):
+    """Yield (field_number, wire_type, value) over a message's fields."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, v
+
+
+def _parse_attr(buf):
+    import numpy as np
+    name = atype = None
+    raw = {}
+    for f, _, v in _walk(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 20:
+            atype = v
+        else:
+            raw[f] = v
+    if atype == _ATTR_FLOAT:
+        val = raw[2]
+    elif atype == _ATTR_INT:
+        val = raw[3]
+    elif atype == _ATTR_STRING:
+        val = raw[4].decode()
+    elif atype == _ATTR_FLOATS:
+        val = list(np.frombuffer(raw[7], "<f4"))
+    elif atype == _ATTR_INTS:
+        ints, pos = [], 0
+        while pos < len(raw[8]):
+            x, pos = _read_varint(raw[8], pos)
+            ints.append(x)
+        val = ints
+    else:
+        raise ValueError(f"unsupported attribute type {atype}")
+    return name, val
+
+
+def _parse_node(buf):
+    n = {"input": [], "output": [], "op_type": "", "name": "", "attrs": {}}
+    for f, _, v in _walk(buf):
+        if f == 1:
+            n["input"].append(v.decode())
+        elif f == 2:
+            n["output"].append(v.decode())
+        elif f == 3:
+            n["name"] = v.decode()
+        elif f == 4:
+            n["op_type"] = v.decode()
+        elif f == 5:
+            k, val = _parse_attr(v)
+            n["attrs"][k] = val
+    return n
+
+
+def _parse_tensor(buf):
+    import numpy as np
+    dims, dtype, name, raw = [], FLOAT, "", b""
+    for f, _, v in _walk(buf):
+        if f == 1:
+            dims.append(v)
+        elif f == 2:
+            dtype = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    np_dtype = {FLOAT: "<f4", INT32: "<i4", INT64: "<i8"}[dtype]
+    return name, np.frombuffer(raw, np_dtype).reshape(dims)
+
+
+def _parse_value_info(buf):
+    name, elem, shape = "", None, []
+    for f, _, v in _walk(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            for f2, _, tt in _walk(v):
+                if f2 != 1:
+                    continue
+                for f3, _, v3 in _walk(tt):
+                    if f3 == 1:
+                        elem = v3
+                    elif f3 == 2:
+                        for f4, _, dim in _walk(v3):
+                            if f4 != 1:
+                                continue
+                            for f5, _, dv in _walk(dim):
+                                shape.append(dv.decode() if f5 == 2 else dv)
+    return {"name": name, "elem_type": elem, "shape": shape}
+
+
+def parse_model(buf: bytes) -> dict:
+    """Decode ModelProto bytes → plain dict (nodes/initializers/io/meta)."""
+    out = {"ir_version": None, "producer_name": "", "producer_version": "",
+           "opset": None, "graph": None}
+    for f, _, v in _walk(buf):
+        if f == 1:
+            out["ir_version"] = v
+        elif f == 2:
+            out["producer_name"] = v.decode()
+        elif f == 3:
+            out["producer_version"] = v.decode()
+        elif f == 8:
+            for f2, _, v2 in _walk(v):
+                if f2 == 2:
+                    out["opset"] = v2
+        elif f == 7:
+            g = {"name": "", "nodes": [], "initializers": {}, "inputs": [],
+                 "outputs": [], "value_info": []}
+            for f2, _, v2 in _walk(v):
+                if f2 == 1:
+                    g["nodes"].append(_parse_node(v2))
+                elif f2 == 2:
+                    g["name"] = v2.decode()
+                elif f2 == 5:
+                    name, arr = _parse_tensor(v2)
+                    g["initializers"][name] = arr
+                elif f2 == 11:
+                    g["inputs"].append(_parse_value_info(v2))
+                elif f2 == 12:
+                    g["outputs"].append(_parse_value_info(v2))
+                elif f2 == 13:
+                    g["value_info"].append(_parse_value_info(v2))
+            out["graph"] = g
+    return out
