@@ -75,7 +75,14 @@ def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
     model = gpt.GPT(cfg, seed=0)
     opt = optim.AdamW(learning_rate=1e-4, weight_decay=0.01,
                       moment_dtype=jnp.bfloat16)
-    params, opt_state = gpt.init_train_state(model, opt)
+    # pre-stacked block weights: the scan-over-layers step consumes the
+    # state directly instead of stacking (and grad-unstacking) a full
+    # copy of every block weight inside the program — the in-trace form
+    # OOMed the 1.3B step on 16GB HBM where the unrolled form fit
+    use_stacked = (cfg.moe_experts == 0 and cfg.n_layers > 1
+                   and bool(pt_flags.get_flag("scan_layers")))
+    params, opt_state = gpt.init_train_state(model, opt,
+                                             stacked=use_stacked)
     step = gpt.build_train_step(model, opt)
     tokens = jnp.asarray(
         np.random.RandomState(0).randint(

@@ -581,6 +581,23 @@ class GPT(Module):
         else:
             self.lm_head = None
 
+    def merge_params(self, params):
+        """Module.merge_params plus stacked-state awareness: when the
+        state carries ``_stacked_blocks`` (init_train_state(stacked=True)),
+        ALSO rebind each per-layer block to a sliced view of the stack —
+        otherwise every consumer outside the scan forward (decode
+        forward_cached, generate, state_dict export) would silently read
+        the init-time weights still sitting in self.blocks. Inside jit
+        the unconsumed slices are dead code XLA eliminates; outside jit
+        they materialize only if actually used."""
+        new = Module.merge_params(self, params)
+        st = getattr(new, "_stacked_blocks", None)
+        if st is not None:
+            for i in range(new.cfg.n_layers):
+                blk = jax.tree_util.tree_map(lambda x, i=i: x[i], st)
+                object.__setattr__(new.blocks, f"item_{i}", blk)
+        return new
+
     def embed(self, tokens):
         s = tokens.shape[-1]
         if _tp_sharded_vocab(tokens.shape[0], s, self.cfg.vocab_size,
@@ -619,9 +636,18 @@ class GPT(Module):
         x = self.embed(tokens)
         L = self.cfg.n_layers
         dense = all(self.blocks[i].moe is None for i in range(L))
-        if dense and L > 1 and _flag("scan_layers"):
-            stacked = stack_block_weights(
-                [self.blocks[i] for i in range(L)])
+        prestacked = getattr(self, "_stacked_blocks", None)
+        if prestacked is not None or (dense and L > 1
+                                      and _flag("scan_layers")):
+            # in-trace stacking copies every block weight (and its grad
+            # transpose un-stacks) — ~2x block-param HBM the unrolled
+            # loop never needed; a state built by
+            # init_train_state(stacked=True) carries the weights
+            # pre-stacked so the scan consumes them with ZERO extra
+            # in-program buffers (this is what made the 1.3B step OOM
+            # on 16GB while the round-start unrolled form fit)
+            stacked = prestacked if prestacked is not None else \
+                stack_block_weights([self.blocks[i] for i in range(L)])
 
             def body(h, blk_i):
                 blk, i = blk_i
@@ -1099,8 +1125,40 @@ def build_train_step(model: GPT, optimizer, mesh: Optional[Mesh] = None,
     return jax.jit(step, **kw)
 
 
-def init_train_state(model: GPT, optimizer, mesh: Optional[Mesh] = None):
-    """Params + optimizer state, sharded onto the mesh if given."""
+def init_train_state(model: GPT, optimizer, mesh: Optional[Mesh] = None,
+                     stacked: bool = False):
+    """Params + optimizer state, sharded onto the mesh if given.
+
+    ``stacked=True`` (dense single-chip models only): block weights enter
+    the state PRE-stacked along a leading layer axis, under one
+    ``_stacked_blocks`` key that merge_params binds back onto the model.
+    The scan-over-layers forward then reads them directly — without this,
+    the in-trace ``stack_block_weights`` materializes a full copy of
+    every block weight inside the step (plus the stacked cotangent on the
+    way back), which pushed the 1.3B train step past 16GB HBM."""
+    if stacked:
+        if mesh is not None and mesh.size > 1:
+            raise ValueError("stacked layout is the single-chip fast "
+                             "path; sharded meshes use the per-layer "
+                             "state")
+        L = model.cfg.n_layers
+        if any(model.blocks[i].moe is not None for i in range(L)):
+            raise ValueError("MoE stacks are heterogeneous; stacked "
+                             "layout needs a dense model")
+        if getattr(optimizer, "apply_decay_param_fun", None) is not None:
+            raise ValueError(
+                "apply_decay_param_fun masks decay by per-param NAME; the "
+                "stacked layout folds all block weights under one "
+                "'_stacked_blocks' entry, so the mask cannot resolve — "
+                "use the per-layer state (stacked=False) with it")
+        params, _ = model.split_params()
+        # jnp.stack allocates fresh buffers, so donation in the train
+        # step never frees the module's own arrays
+        params = {k: jnp.copy(v) for k, v in params.items()
+                  if not k.startswith("blocks.")}
+        params["_stacked_blocks"] = stack_block_weights(
+            [model.blocks[i] for i in range(L)])
+        return params, optimizer.init(params)
     params, _ = model.split_params()
     if mesh is not None and mesh.size > 1:
         params = shard_params(params, mesh)
