@@ -16,10 +16,14 @@ TPU design decisions:
   selects its pages at DMA-schedule time — no per-layer slicing of the
   pool (a lax.dynamic_slice there would copy the full layer pool every
   step).
-- **Write-first decode step**: each layer writes the current token's KV
-  row into its page (per-slot dynamic updates at table-resolved
-  positions), then attends over [0, len] via `paged_decode_attention`
-  — no analytic fold needed, mirroring `_qkv_write` semantics.
+- **Read-only-pool decode step**: each layer attends over the existing
+  prefix via `paged_decode_attention(return_stats=True)` and folds the
+  current token's fresh KV row in analytically (`fold_fresh_row` — the
+  same formulation as the contiguous engine); the per-layer rows come
+  out of the layer scan as tiny ys and land in the pools with ONE
+  batched scatter per cache per token. The original write-first form
+  (per-layer scatter with the pools as layer-scan carry) measured
+  ~0.05x of the HBM roofline on hardware; this one measured 0.17x.
 - **One-pass bucketed prefill**: a prompt attends only to itself
   (causal), so prefill needs NO cache reads — the whole prompt runs
   through the dense forward at a power-of-two bucket and the valid KV
@@ -46,6 +50,7 @@ from jax import lax
 
 from paddle_tpu.models import gpt as gpt_lib
 from paddle_tpu.inference.decode_engine import Request
+from paddle_tpu.ops.pallas.decode_attention import fold_fresh_row
 from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
 
 __all__ = ["PagedDecodeEngine"]
@@ -60,18 +65,16 @@ class PagedDecodeEngine:
 
     Status (r5 hardware): output is bit-identical to ``gpt.generate``
     across page/chunk geometries and serving HBM scales with live
-    tokens, but the first on-chip exercise measured ~0.05x of the HBM
-    roofline (vs 0.53x for the contiguous DecodeEngine on the same
-    workload). Known suspects for the next optimization pass, in
-    order: (1) the page pools ride the LAYER scan as carry with one
-    scatter per layer per token — moving to the contiguous engine's
-    read-only-cache formulation (attend over existing tokens with
-    ``return_stats``, fold the fresh row analytically, write all L
-    rows once per token outside the layer scan) removes any carry
-    copies XLA fails to alias; (2) one pallas launch per layer per
-    token over a mostly-masked fixed-width table is dispatch-heavy at
-    short cache lengths — a table-width-bucketed kernel or a dense
-    fallback below ~page_size tokens would cut it."""
+    tokens. The first on-chip exercise of the write-first form (pools
+    as layer-scan carry, one scatter per layer per token) measured
+    ~0.05x of the HBM roofline; the current read-only-pool
+    formulation (analytic fresh-row fold + one scatter per token)
+    measured 0.17x on the same workload, vs 0.53x for the contiguous
+    DecodeEngine. The remaining known gap: one pallas launch per
+    layer per token over a mostly-masked fixed-width table is
+    dispatch-heavy at short cache lengths — a table-width-bucketed
+    kernel or a dense fallback below ~page_size tokens is the next
+    optimization."""
 
     def __init__(self, model, n_pages: int, max_slots: int = 8,
                  page_size: int = 128, steps_per_call: int = 1,
@@ -153,27 +156,45 @@ class PagedDecodeEngine:
              else head["lm_head"])
         return x @ w
 
-    def _write_step_rows(self, kp, vp, k_rows, v_rows, table, lengths,
-                         active, layer):
-        """Write each ACTIVE slot's one new KV row into its page with a
-        single batched scatter per cache: k_rows (S, Hkv, D) at position
-        lengths[s] of slot s, layer ``layer`` (page ids are
-        layer-folded). Inactive slots scatter into the scratch page —
-        their padded tables point at pool page 0, which a live sequence
-        may own."""
+    def _write_token_rows(self, kp, vp, k_rows, v_rows, table, lengths,
+                          active):
+        """Write one decode step's new KV rows for EVERY layer at once:
+        k_rows/v_rows (L, S, Hkv, D) land at position lengths[s] of
+        slot s (page ids are layer-folded), in a single batched scatter
+        per cache. Writing once per token OUTSIDE the layer scan keeps
+        the pools read-only inside it — carrying the pools through the
+        layer scan with a per-layer scatter is what the first hardware
+        exercise measured at ~0.05x roofline. Inactive slots scatter
+        into the scratch page — their padded tables point at pool page
+        0, which a live sequence may own."""
+        L = k_rows.shape[0]
         offs = lengths % self.page
         pidx = lengths // self.page
-        pids = layer * self.P + jnp.take_along_axis(
-            table, pidx[:, None], axis=1)[:, 0]
-        pids = jnp.where(active, pids, self._scratch)
-        kp = kp.at[pids, :, offs, :].set(k_rows)
-        vp = vp.at[pids, :, offs, :].set(v_rows)
+        base = jnp.take_along_axis(table, pidx[:, None], axis=1)[:, 0]
+        pids = (jnp.arange(L, dtype=jnp.int32)[:, None] * self.P
+                + base[None, :])
+        pids = jnp.where(active[None, :], pids, self._scratch)
+        offs_all = jnp.broadcast_to(offs[None, :], pids.shape)
+        S = k_rows.shape[1]
+        kp = kp.at[pids.reshape(-1), :, offs_all.reshape(-1), :].set(
+            k_rows.reshape(L * S, *k_rows.shape[2:]))
+        vp = vp.at[pids.reshape(-1), :, offs_all.reshape(-1), :].set(
+            v_rows.reshape(L * S, *v_rows.shape[2:]))
         return kp, vp
 
     def _one_token(self, head, stacked, kp, vp, table, lengths, last,
                    active):
-        """Advance every active slot one token (write-first paged
-        attention per layer)."""
+        """Advance every active slot one token.
+
+        The pools are READ-ONLY inside the layer scan: each layer's
+        attention runs the paged kernel over the existing prefix
+        (``lengths`` tokens) with ``return_stats``, and the current
+        token's fresh KV row is folded into the online softmax
+        analytically — the same formulation that took the contiguous
+        engine from 0.19x to 0.53x of the HBM roofline. The per-layer
+        rows come out as scan ys ((L, S, Hkv, D) — tiny) and land in
+        the pools with ONE batched scatter per cache per token, after
+        the scan."""
         x = jnp.take(head["wte"], last, axis=0)
         if head["wpe"] is not None:
             x = x + jnp.take(head["wpe"], lengths, axis=0)
@@ -181,23 +202,24 @@ class PagedDecodeEngine:
         L = self.cfg.n_layers
         scale = 1.0 / math.sqrt(self.cfg.head_dim)
 
-        def layer_body(carry, blk_i):
-            h, kp, vp = carry
+        def layer_body(h, blk_i):
             blk, i = blk_i
             q, k, v = blk._qkv(h, lengths)
-            kp, vp = self._write_step_rows(
-                kp, vp, k[:, 0].astype(kp.dtype),
-                v[:, 0].astype(vp.dtype), table, lengths, active, i)
-            attn = paged_decode_attention(
+            k_row = k[:, 0].astype(kp.dtype)
+            v_row = v[:, 0].astype(vp.dtype)
+            o, m, l = paged_decode_attention(
                 q[:, 0].astype(kp.dtype), kp, vp, i * self.P + table,
-                lengths + 1, scale=scale)
+                lengths, scale=scale, return_stats=True)
+            attn = fold_fresh_row(o, m, l, q[:, 0], k_row, v_row,
+                                  scale, blk.n_heads // blk.kv_heads)
             attn = attn.astype(h.dtype).reshape(h.shape)
             h = blk._block_tail(h, attn)
-            return (h, kp, vp), None
+            return h, (k_row, v_row)
 
-        (x, kp, vp), _ = lax.scan(
-            layer_body, (x, kp, vp),
-            (stacked, jnp.arange(L)))
+        x, (k_rows, v_rows) = lax.scan(
+            layer_body, x, (stacked, jnp.arange(L)))
+        kp, vp = self._write_token_rows(kp, vp, k_rows, v_rows, table,
+                                        lengths, active)
         logits = self._lm_head(head, x)[:, 0]
         nxt = jnp.argmax(logits.astype(jnp.float32), -1).astype(jnp.int32)
         nxt = jnp.where(active, nxt, last)

@@ -364,23 +364,12 @@ class GPTBlock(Module):
             # whole T whatever the lengths — at serving cache lengths
             # that is the dominant wasted bandwidth.
             from paddle_tpu.ops.pallas.decode_attention import (
-                decode_attention)
+                decode_attention, fold_fresh_row)
             o, m, l = decode_attention(
                 q[:, 0].astype(k_cache.dtype), k_cache, v_cache,
                 positions, scale=scale, return_stats=True)
-            group = self.n_heads // self.kv_heads
-            qg = q[:, 0].reshape(b, self.kv_heads, group, self.head_dim)
-            s_new = jnp.einsum(
-                "bhgd,bhd->bhg", qg.astype(jnp.float32),
-                k[:, 0].astype(jnp.float32)) * scale
-            s_new = s_new.reshape(b, self.n_heads)
-            m2 = jnp.maximum(m, s_new)
-            w_pre = l * jnp.exp(m - m2)
-            w_new = jnp.exp(s_new - m2)
-            v_exp = jnp.repeat(v[:, 0].astype(jnp.float32), group, axis=1)
-            attn = ((o.astype(jnp.float32) * w_pre[..., None]
-                     + v_exp * w_new[..., None])
-                    / (w_pre + w_new)[..., None])
+            attn = fold_fresh_row(o, m, l, q[:, 0], k[:, 0], v[:, 0],
+                                  scale, self.n_heads // self.kv_heads)
             attn = attn.reshape(b, K, d).astype(x.dtype)
             return self._block_tail(x, attn), k, v
         # GQA via grouped einsum against the UN-expanded cache (query

@@ -78,6 +78,42 @@ def online_softmax_finalize(o_ref, acc_ref, l_ref):
                 / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
+def online_softmax_write_stats(ml_ref, m_ref, l_ref):
+    """Pack the running (m, l) into the optional stats output: column 0
+    = running max, column 1 = softmax denominator (columns 2+ are
+    don't-care). ONE packing definition shared by the contiguous and
+    paged decode kernels — the host-side unpack in both callers reads
+    exactly these two columns."""
+    l = l_ref[:, :1]
+    ml_ref[0] = jnp.concatenate([m_ref[:, :1], l, l_ref[:, 2:]], axis=1)
+
+
+def fold_fresh_row(o, m, l, q, k_row, v_row, scale, group):
+    """Fold ONE extra KV column per row (its fresh k/v) into a decode
+    kernel result obtained with ``return_stats``: the output equals a
+    softmax over [prefix + fresh row], so the kernel only ever reads
+    the existing prefix and the caches/pools stay READ-ONLY in the
+    caller's layer loop. q (B, Hq, D); o/m/l from the kernel; k_row/
+    v_row (B, Hkv, D) in cache dtype. Returns (B, Hq, D) float32. ONE
+    numerics definition shared by the contiguous engine path
+    (gpt.GPTBlock.decode_rows) and the paged engine. Zero-length rows
+    are safe: l == 0 and m == -inf degrade to attention over just the
+    fresh row."""
+    b, hq, d = q.shape
+    hkv = k_row.shape[1]
+    qg = q.reshape(b, hkv, group, d)
+    s_new = jnp.einsum("bhgd,bhd->bhg", qg.astype(jnp.float32),
+                       k_row.astype(jnp.float32)) * scale
+    s_new = s_new.reshape(b, hq)
+    m2 = jnp.maximum(m, s_new)
+    w_pre = l * jnp.exp(m - m2)
+    w_new = jnp.exp(s_new - m2)
+    v_exp = jnp.repeat(v_row.astype(jnp.float32), group, axis=1)
+    return ((o.astype(jnp.float32) * w_pre[..., None]
+             + v_exp * w_new[..., None])
+            / (w_pre + w_new)[..., None])
+
+
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, block_k,
             hkv, with_stats):
     # the stats output ref exists only when requested (out_specs are
@@ -110,13 +146,7 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *rest, scale, block_k,
     def _finalize():
         online_softmax_finalize(o_ref, acc_ref, l_ref)
         if with_stats:
-            l = l_ref[:, :1]
-            # column 0: running max; column 1: softmax denominator —
-            # lets the caller fold extra columns (e.g. the current
-            # token's fresh KV row) into the softmax analytically
-            ml = jnp.concatenate(
-                [m_ref[:, :1], l, l_ref[:, 2:]], axis=1)
-            ml_ref[0] = ml
+            online_softmax_write_stats(ml_ref, m_ref, l_ref)
 
 
 def _pick_block(T: int, block_k: int) -> int:

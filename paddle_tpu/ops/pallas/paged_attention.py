@@ -34,17 +34,24 @@ _LANES = 128
 _NEG_INF = float("-inf")
 
 
-def _kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
-            m_ref, l_ref, *, scale, page, hkv):
+def _kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref, *rest,
+            scale, page, hkv, with_stats):
     # table_ref is consumed by the BlockSpec index maps (scalar
-    # prefetch), not the body; it still appears in the kernel ABI
+    # prefetch), not the body; it still appears in the kernel ABI.
+    # The stats output ref exists only when requested (out_specs are
+    # built conditionally), so the trailing refs shift — same
+    # convention as the contiguous decode kernel.
+    if with_stats:
+        ml_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        ml_ref, (acc_ref, m_ref, l_ref) = None, rest
     bh = pl.program_id(0)
     j = pl.program_id(1)
     b = bh // hkv
 
     from paddle_tpu.ops.pallas.decode_attention import (
         online_softmax_finalize, online_softmax_init,
-        online_softmax_step)
+        online_softmax_step, online_softmax_write_stats)
 
     @pl.when(j == 0)
     def _init():
@@ -62,6 +69,8 @@ def _kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
     @pl.when(j == pl.num_programs(1) - 1)
     def _finalize():
         online_softmax_finalize(o_ref, acc_ref, l_ref)
+        if with_stats:
+            online_softmax_write_stats(ml_ref, m_ref, l_ref)
 
 
 def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
@@ -91,7 +100,8 @@ def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
 
 
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
-                           scale=None, interpret=None):
+                           scale=None, interpret=None,
+                           return_stats=False):
     """One decode step of cached attention over a PAGED KV pool.
 
     Args:
@@ -105,8 +115,13 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
         (clamped scalar-prefetch index map).
       scale: softmax scale, default 1/sqrt(D).
       interpret: defaults to True off-TPU so tests run on CPU.
+      return_stats: also return the online-softmax running max ``m``
+        and denominator ``l`` (each (B, Hq) f32) so the caller can
+        fold extra attention columns in analytically — the paged
+        engine adds the current token's fresh KV row this way, keeping
+        the pools READ-ONLY inside its layer scan.
 
-    Returns (B, Hq, D) in q's dtype.
+    Returns (B, Hq, D) in q's dtype; with return_stats, (o, m, l).
     """
     q = jnp.asarray(q)
     k_pages, v_pages = jnp.asarray(k_pages), jnp.asarray(v_pages)
@@ -147,6 +162,15 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
         p, h, _, _ = kv_index(bh, j, lens, table)
         return (p * hkv + h, 0, 0)
 
+    out_specs = [pl.BlockSpec((1, gp, d), lambda bh, j, lens, table:
+                              (bh, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * hkv, gp, d), q.dtype)]
+    if return_stats:  # stats output only exists when asked for
+        out_specs.append(pl.BlockSpec((1, gp, _LANES),
+                                      lambda bh, j, lens, table:
+                                      (bh, 0, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * hkv, gp, _LANES), jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b * hkv, max_pages),
@@ -156,24 +180,29 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
             pl.BlockSpec((1, page, d), kv_index_flat),
             pl.BlockSpec((1, page, d), kv_index_flat),
         ],
-        out_specs=pl.BlockSpec((1, gp, d), lambda bh, j, lens, table:
-                               (bh, 0, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((gp, d), jnp.float32),
             pltpu.VMEM((gp, _LANES), jnp.float32),
             pltpu.VMEM((gp, _LANES), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         functools.partial(_kernel, scale=float(scale), page=page,
-                          hkv=hkv),
+                          hkv=hkv, with_stats=return_stats),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * hkv, gp, d), q.dtype),
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(lengths, table_flat, qg, kp, vp)
-    return out[:, :group, :].reshape(b, hq, d)
+    o = res[0][:, :group, :].reshape(b, hq, d)
+    if not return_stats:
+        return o
+    ml = res[1]
+    m = ml[:, :group, 0].reshape(b, hq)
+    l = ml[:, :group, 1].reshape(b, hq)
+    return o, m, l
 
 
 class PageAllocator:

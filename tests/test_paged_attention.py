@@ -37,6 +37,47 @@ def test_kernel_matches_gather_oracle():
                                atol=1e-5, rtol=1e-5)
 
 
+def test_kernel_stats_fold_fresh_row():
+    """return_stats lets a caller fold one extra KV column analytically:
+    folding the fresh row into (o, m, l) must equal re-running the
+    kernel with the row already written into the pool (lengths + 1) —
+    the read-only-pool decode formulation the paged engine uses."""
+    rs = np.random.RandomState(3)
+    P, hkv, page, d = 10, 2, 128, 32
+    group = 3
+    hq = hkv * group
+    b, max_pages = 3, 2
+    k, v = _pool(rs, P, hkv, page, d)
+    q = jnp.asarray(rs.randn(b, hq, d), jnp.float32)
+    table = jnp.asarray([[0, 5], [7, 1], [9, 4]], jnp.int32)
+    lengths = jnp.asarray([130, 128, 0], jnp.int32)  # incl. page edge + empty
+    k_row = jnp.asarray(rs.randn(b, hkv, d), jnp.float32)
+    v_row = jnp.asarray(rs.randn(b, hkv, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    o, m, l = paged_decode_attention(q, k, v, table, lengths,
+                                     return_stats=True)
+    qg = q.reshape(b, hkv, group, d)
+    s_new = jnp.einsum("bhgd,bhd->bhg", qg, k_row).reshape(b, hq) * scale
+    m2 = jnp.maximum(m, s_new)
+    w_pre = l * jnp.exp(m - m2)
+    w_new = jnp.exp(s_new - m2)
+    v_exp = jnp.repeat(v_row, group, axis=1)
+    folded = ((o * w_pre[..., None] + v_exp * w_new[..., None])
+              / (w_pre + w_new)[..., None])
+
+    # oracle: write each row at its position, re-run over lengths + 1
+    k2, v2 = k, v
+    for i in range(b):
+        pid = int(table[i, int(lengths[i]) // page])
+        off = int(lengths[i]) % page
+        k2 = k2.at[pid, :, off, :].set(k_row[i])
+        v2 = v2.at[pid, :, off, :].set(v_row[i])
+    want = paged_decode_attention(q, k2, v2, table, lengths + 1)
+    np.testing.assert_allclose(np.asarray(folded), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_kernel_gqa_and_jit_traced_operands():
     rs = np.random.RandomState(1)
     P, hkv, page, d = 8, 2, 128, 16
