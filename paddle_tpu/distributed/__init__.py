@@ -32,7 +32,11 @@ from paddle_tpu.distributed.sharding import (
     group_sharded_parallel, group_sharded_specs, build_group_sharded_step,
     init_group_sharded_state, GroupShardedSpecs)
 from paddle_tpu.distributed.checkpoint import (
-    save_state, load_state, AutoCheckpoint)
+    save_state, load_state, verify_checkpoint, AutoCheckpoint)
+from paddle_tpu.distributed import resilience
+from paddle_tpu.distributed.resilience import (
+    RetryPolicy, Deadline, DeadlineExceeded, CollectiveStallError,
+    CollectiveWatchdog, with_deadline)
 from paddle_tpu.distributed.mp_ops import (
     parallel_cross_entropy, vocab_parallel_embedding, axis_rng_key)
 from paddle_tpu.distributed.recompute import (
@@ -75,7 +79,9 @@ __all__ = ["FleetExecutor", "rendezvous_endpoints", "rpc", "ps", "fleet",
            "sequence_parallel_attention", "group_sharded_parallel",
            "group_sharded_specs", "build_group_sharded_step",
            "init_group_sharded_state", "GroupShardedSpecs", "save_state",
-           "load_state", "AutoCheckpoint", "TCPStore",
+           "load_state", "verify_checkpoint", "AutoCheckpoint", "TCPStore",
+           "resilience", "RetryPolicy", "Deadline", "DeadlineExceeded",
+           "CollectiveStallError", "CollectiveWatchdog", "with_deadline",
            "parallel_cross_entropy", "vocab_parallel_embedding",
            "axis_rng_key", "recompute", "recompute_sequential",
            "checkpoint_name", "alltoall", "alltoall_single", "reduce",

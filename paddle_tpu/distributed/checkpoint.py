@@ -5,12 +5,24 @@ state_dicts + auto_parallel dist_saver.py / converter.py (per-rank
 programs+params with dist attrs, resharded on load), and the op-version
 registry (framework/op_version_registry.h:397) → the format_version field.
 
-Format (one directory per checkpoint):
-    meta.json             format_version, per-array {shape, dtype, shards}
+Format v2 (one directory per checkpoint):
+    meta.json             format_version, per-array {shape, dtype, shards},
+                          merged per-shard sha256 checksums
     skeleton.pkl          pytree structure with ARRAY_n placeholders
     data/ARRAY_n.s{k}.npy one file per saved shard (its global index range
                           recorded in meta) — only ONE copy of each distinct
                           shard is written (replicated arrays write once)
+    checksums.{p}.json    per-process {shard file: sha256} sidecars (each
+                          process can only hash the bytes it wrote; proc 0
+                          merges them into meta.json after the save barrier)
+    COMMIT                commit marker written LAST by proc 0: a truncated
+                          or interrupted save can never masquerade as
+                          complete. Contains the sha256 of the final
+                          meta.json, so meta tampering/corruption is also
+                          detected.
+
+v1 checkpoints (no checksums, no COMMIT) remain readable; verification of
+a v1 directory degrades to shard-existence checks.
 
 Resharding on load: the loader assembles each *needed* slice from whichever
 saved shard files overlap it via jax.make_array_from_callback, so a
@@ -21,9 +33,12 @@ rewrites states (SURVEY §7.3 hard-part 5); here resharding is native.
 """
 
 import dataclasses
+import glob
+import hashlib
 import json
 import os
 import pickle
+import sys
 from typing import Any, Callable, Dict, Optional, Union
 
 import numpy as np
@@ -31,10 +46,20 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["save_state", "load_state", "AutoCheckpoint"]
+__all__ = ["save_state", "load_state", "verify_checkpoint",
+           "AutoCheckpoint"]
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 _MIN_READABLE_VERSION = 1
+_COMMIT_FILE = "COMMIT"
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
 
 
 class _Py:
@@ -101,24 +126,85 @@ def save_state(state, path: str):
     under a range-derived filename identical on all processes; meta.json
     and skeleton.pkl (whose content is process-independent) are written by
     process 0 only, and a cross-host barrier closes the save so the
-    checkpoint is complete when any process returns."""
+    checkpoint is complete when any process returns.
+
+    Format v2 integrity: each process records a sha256 per shard it wrote
+    (checksums.{p}.json); after the barrier proves every write landed,
+    process 0 merges the sidecars into meta.json and writes the COMMIT
+    marker — so `verify_checkpoint` can reject truncated, bit-flipped, or
+    never-committed directories and `AutoCheckpoint.restore` can fall back
+    to the newest checkpoint that still verifies."""
+    # every process must reach BOTH barriers even if its local write (or
+    # proc0's commit) failed — a process that raised between them would
+    # leave every peer blocked forever; the exception is re-raised after
+    # the last barrier (and the launcher tears the job down). A peer
+    # failure that proc0 cannot see here leaves a COMMIT over missing
+    # shards/sidecars — verify_checkpoint rejects that directory.
+    exc = None
     try:
         _save_state_local(state, path)
-    finally:
-        # every process must reach the barrier even if its local write
-        # failed — otherwise peers hang forever; the local exception still
-        # propagates (and the launcher tears the job down)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+    except BaseException as e:
+        exc = e
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+    if exc is None and jax.process_index() == 0:
+        try:
+            _commit(path)
+        except BaseException as e:
+            exc = e
+    if jax.process_count() > 1:
+        # peers must not return before COMMIT exists, or a crash in
+        # this window would leave them believing the save completed
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_commit_mark:{path}")
+    if exc is not None:
+        raise exc
+
+
+def _commit(path: str):
+    """Merge per-process checksum sidecars into meta.json, then write the
+    COMMIT marker (containing meta's own sha256) — strictly last."""
+    mp = os.path.join(path, "meta.json")
+    with open(mp) as f:
+        meta = json.load(f)
+    checksums = {}
+    for side in sorted(glob.glob(os.path.join(path, "checksums.*.json"))):
+        with open(side) as f:
+            checksums.update(json.load(f))
+    meta["checksums"] = checksums
+    tmp = mp + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, mp)
+    commit = {"format_version": meta.get("format_version", FORMAT_VERSION),
+              "meta_sha256": _sha256_file(mp)}
+    ctmp = os.path.join(path, _COMMIT_FILE + ".tmp")
+    with open(ctmp, "w") as f:
+        json.dump(commit, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(ctmp, os.path.join(path, _COMMIT_FILE))
 
 
 def _save_state_local(state, path: str):
+    from paddle_tpu.testing import faults
+
     os.makedirs(os.path.join(path, "data"), exist_ok=True)
     proc0 = jax.process_index() == 0
     leaves, treedef = _flatten(state)
     meta = {"format_version": FORMAT_VERSION, "arrays": {}}
     skeleton = []
+    checksums = {}
+
+    def _write_shard(fn, data):
+        fp = os.path.join(path, "data", fn)
+        np.save(fp, data, allow_pickle=False)
+        checksums[fn] = _sha256_file(fp)
+        # injection AFTER the hash is recorded: simulates post-write disk
+        # corruption, which verification must catch
+        faults.corrupt_file("ckpt.shard", fp)
+
     for i, leaf in enumerate(leaves):
         name = f"ARRAY_{i}"
         if isinstance(leaf, jax.Array) and not isinstance(leaf, jax.core.Tracer):
@@ -128,16 +214,13 @@ def _save_state_local(state, path: str):
                                  "range": [list(r) for r in k]}
                                 for k in layout]}
             for key, data in _owned_shards(leaf).items():
-                np.save(os.path.join(path, "data",
-                                     f"{name}.{_range_tag(key)}.npy"),
-                        data, allow_pickle=False)
+                _write_shard(f"{name}.{_range_tag(key)}.npy", data)
             meta["arrays"][name] = entry
             skeleton.append(name)
         elif isinstance(leaf, np.ndarray):
             fn = f"{name}.s0.npy"
             if proc0:
-                np.save(os.path.join(path, "data", fn), leaf,
-                        allow_pickle=False)
+                _write_shard(fn, leaf)
             meta["arrays"][name] = {
                 "shape": list(leaf.shape), "dtype": str(leaf.dtype),
                 "shards": [{"file": fn,
@@ -145,6 +228,9 @@ def _save_state_local(state, path: str):
             skeleton.append(name)
         else:
             skeleton.append(_Py(leaf))
+    with open(os.path.join(
+            path, f"checksums.{jax.process_index()}.json"), "w") as f:
+        json.dump(checksums, f, indent=1)
     if proc0:
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(meta, f, indent=1)
@@ -257,11 +343,66 @@ def _boxes_cover(boxes, target) -> bool:
     return True
 
 
+def verify_checkpoint(path: str):
+    """Integrity-check a checkpoint directory WITHOUT loading it.
+
+    Returns ``(ok, reason)``. For format v2: the COMMIT marker must
+    exist, meta.json must hash to the committed sha256, every shard in
+    meta must exist, and every shard with a recorded checksum must hash
+    to it (catching truncation and bit-flips). v1 directories (no
+    COMMIT/checksums) degrade to existence checks — they were written
+    before commit markers existed and must stay restorable.
+    """
+    mp = os.path.join(path, "meta.json")
+    if not os.path.exists(mp):
+        return False, "meta.json missing"
+    try:
+        with open(mp) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"meta.json unreadable: {e}"
+    ver = meta.get("format_version", 0)
+    if not (_MIN_READABLE_VERSION <= ver <= FORMAT_VERSION):
+        return False, f"format_version {ver} unsupported"
+    if not os.path.exists(os.path.join(path, "skeleton.pkl")):
+        return False, "skeleton.pkl missing"
+    if ver >= 2:
+        cp = os.path.join(path, _COMMIT_FILE)
+        if not os.path.exists(cp):
+            return False, "COMMIT marker missing (save never completed)"
+        try:
+            with open(cp) as f:
+                commit = json.load(f)
+        except (OSError, ValueError) as e:
+            return False, f"COMMIT unreadable: {e}"
+        want = commit.get("meta_sha256")
+        if want and _sha256_file(mp) != want:
+            return False, "meta.json does not match committed sha256"
+    checksums = meta.get("checksums", {})
+    for name, entry in meta.get("arrays", {}).items():
+        for sh in entry["shards"]:
+            fp = os.path.join(path, "data", sh["file"])
+            if not os.path.exists(fp):
+                return False, f"shard {sh['file']} missing"
+            want = checksums.get(sh["file"])
+            if want is None:
+                if ver >= 2:
+                    return False, f"shard {sh['file']} has no checksum"
+                continue
+            if _sha256_file(fp) != want:
+                return False, (f"shard {sh['file']} checksum mismatch "
+                               f"(truncated or corrupted)")
+    return True, "ok"
+
+
 def load_state(path: str,
                shardings: Optional[Union[Dict[str, Any],
                                          Callable[[str], Any]]] = None,
-               template=None):
+               template=None, verify: bool = False):
     """Load a checkpoint directory.
+
+    verify=True: run `verify_checkpoint` first and raise ValueError with
+    the failure reason instead of restoring from a damaged directory.
 
     shardings: None → jnp arrays on the default device;
     a pytree matching the saved structure (leaves NamedSharding / None), or
@@ -269,6 +410,13 @@ def load_state(path: str,
     `template` instead for name-free placement: a pytree of shardings with
     the same structure as the saved state.
     """
+    if verify:
+        ok, reason = verify_checkpoint(path)
+        if not ok:
+            from paddle_tpu import stats
+            stats.add("ckpt/verify_failures")
+            raise ValueError(
+                f"checkpoint {path} failed verification: {reason}")
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     ver = meta.get("format_version", 0)
@@ -320,6 +468,9 @@ def load_state(path: str,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+_UNDECIDED = object()  # last_verified_epoch not yet computed
+
+
 @dataclasses.dataclass
 class AutoCheckpoint:
     """Epoch-range auto checkpoint ≙ the reference's TrainEpochRange
@@ -339,6 +490,36 @@ class AutoCheckpoint:
     def __post_init__(self):
         self.dir = os.path.join(self.root, self.job_id)
         os.makedirs(self.dir, exist_ok=True)
+        # memoized verify verdicts: a resume calls restore() AND
+        # next_epoch, and hashing every shard of a multi-GB checkpoint
+        # twice (plus double-counting failure stats) is pure waste
+        self._verify_cache: Dict[int, bool] = {}
+        self._decided_epoch = _UNDECIDED
+        self._gc_orphaned_tmp()
+        if jax.process_count() > 1:
+            # construction barrier: no peer may start a save (writing
+            # into a fresh .tmp_epoch_* dir) until proc 0's GC above has
+            # finished sweeping — otherwise a fast peer's live tmp dir
+            # could be rmtree'd as "orphaned"
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt_init:{self.dir}")
+
+    def _gc_orphaned_tmp(self):
+        """Startup GC: a worker killed between `save_state(tmp)` and the
+        commit rename leaves a `.tmp_epoch_*` directory that will never
+        be completed — delete it so retries of the same epoch start
+        clean and dead bytes don't accumulate across preemptions."""
+        if jax.process_index() != 0:
+            return
+        import shutil
+        from paddle_tpu import stats
+        for d in os.listdir(self.dir):
+            if d.startswith(".tmp_epoch_"):
+                shutil.rmtree(os.path.join(self.dir, d),
+                              ignore_errors=True)
+                stats.add("ckpt/tmp_gc")
+                print(f"[ckpt] GC'd orphaned {d} (interrupted save)",
+                      file=sys.stderr)
 
     def _epochs_on_disk(self):
         eps = []
@@ -348,17 +529,74 @@ class AutoCheckpoint:
                 eps.append(int(d.split("_")[1]))
         return sorted(eps)
 
+    def _epoch_dir(self, epoch: int) -> str:
+        return os.path.join(self.dir, f"epoch_{epoch}")
+
+    def _verified(self, epoch: int) -> bool:
+        if epoch in self._verify_cache:
+            return self._verify_cache[epoch]
+        from paddle_tpu import stats
+        ok, reason = verify_checkpoint(self._epoch_dir(epoch))
+        if not ok:
+            stats.add("ckpt/verify_failures")
+            self._verify_reason = reason
+        self._verify_cache[epoch] = ok
+        return ok
+
+    def last_verified_epoch(self) -> Optional[int]:
+        """Newest epoch whose directory passes `verify_checkpoint`, or
+        None. Damaged newer epochs are reported (and counted, once) but
+        skipped — the resume path must never trust an unverified
+        directory just because it is newest.
+
+        Multi-host: process 0 decides and broadcasts the epoch, so every
+        rank restores the SAME one — per-rank verification over a shared
+        FS with visibility skew could disagree (and would hash every
+        shard once per host). The broadcast is a COLLECTIVE: the first
+        call after construction (or after a save) must happen on every
+        rank. The verdict is cached per instance, so later rank-local
+        accesses (logging, conditionals) are plain lookups."""
+        if self._decided_epoch is not _UNDECIDED:
+            return self._decided_epoch
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            e = self._last_verified_local() if jax.process_index() == 0 \
+                else None
+            e = int(multihost_utils.broadcast_one_to_all(
+                np.int32(-1 if e is None else e)))
+            e = None if e < 0 else e
+        else:
+            e = self._last_verified_local()
+        self._decided_epoch = e
+        return e
+
+    def _last_verified_local(self) -> Optional[int]:
+        from paddle_tpu import stats
+        for e in reversed(self._epochs_on_disk()):
+            if self._verified(e):
+                return e
+            stats.add("ckpt/restore_fallbacks")
+            print(f"[ckpt] epoch_{e} failed verification "
+                  f"({getattr(self, '_verify_reason', 'unknown')}); "
+                  f"falling back", file=sys.stderr)
+        return None
+
     @property
     def next_epoch(self) -> int:
-        eps = self._epochs_on_disk()
-        return (eps[-1] + 1) if eps else 0
+        """First epoch to (re)run: one past the newest VERIFIED epoch —
+        a corrupt newest checkpoint is re-trained, not skipped with
+        stale state."""
+        e = self.last_verified_epoch()
+        return 0 if e is None else e + 1
 
     def restore(self, shardings=None, template=None):
-        """Latest epoch's state, or None if nothing saved yet."""
-        eps = self._epochs_on_disk()
-        if not eps:
+        """Newest VERIFIED epoch's state, or None if no epoch passes
+        verification (truncated shard, checksum mismatch, missing
+        COMMIT marker all disqualify — see `verify_checkpoint`)."""
+        e = self.last_verified_epoch()
+        if e is None:
             return None
-        return load_state(os.path.join(self.dir, f"epoch_{eps[-1]}"),
+        return load_state(self._epoch_dir(e),
                           shardings=shardings, template=template)
 
     def restore_like(self, fresh_state, mesh: Optional[Mesh] = None):
@@ -386,17 +624,38 @@ class AutoCheckpoint:
         return self.restore(template=tmpl)
 
     def save(self, state, epoch: int):
+        from paddle_tpu.testing import faults
+
         tmp = os.path.join(self.dir, f".tmp_epoch_{epoch}")
         final = os.path.join(self.dir, f"epoch_{epoch}")
         save_state(state, tmp)  # barriers internally on multi-host
+        # kill-injection window: dying here orphans the .tmp dir, which
+        # the next startup's GC must collect (site: ckpt.tmp_saved)
+        faults.fire("ckpt.tmp_saved")
+        self._verify_cache.pop(epoch, None)  # dir contents replaced
+        self._decided_epoch = _UNDECIDED     # epoch set changed
         try:
             if jax.process_index() == 0:
                 import shutil
                 if os.path.exists(final):
                     shutil.rmtree(final)
                 os.rename(tmp, final)
-                for e in self._epochs_on_disk()[:-self.keep]:
-                    shutil.rmtree(os.path.join(self.dir, f"epoch_{e}"))
+                # the full save+commit protocol just completed — no need
+                # to re-hash what we wrote when the prune quota (or a
+                # later restore) asks
+                self._verify_cache[epoch] = True
+                # retention counts only VERIFIED epochs toward `keep`:
+                # when the newest dirs are corrupt (the exact scenario
+                # the fallback exists for), pruning by raw age would
+                # delete the only restorable epochs while keeping rot
+                kept = 0
+                for e in reversed(self._epochs_on_disk()):
+                    if kept < self.keep and self._verified(e):
+                        kept += 1
+                    elif kept >= self.keep:
+                        self._verify_cache.pop(e, None)
+                        shutil.rmtree(os.path.join(self.dir,
+                                                   f"epoch_{e}"))
         finally:
             # reach the barrier even if the proc0 commit failed (peers must
             # not hang); the exception still propagates on proc0

@@ -18,13 +18,18 @@ _initialized = False
 
 
 def init_parallel_env(coordinator_address=None, num_processes=None,
-                      process_id=None):
+                      process_id=None, setup_deadline=None):
     """ref: paddle.distributed.init_parallel_env (distributed/parallel.py:98).
 
     Single-process (the common TPU case — all local chips visible): no-op.
     Multi-host: wires jax.distributed.initialize from args or the
     PT_COORDINATOR/PT_NUM_PROCESSES/PT_PROCESS_ID env contract set by
     ``paddle_tpu.distributed.launch``.
+
+    Collective setup runs deadline-guarded (`resilience.with_deadline`):
+    a coordinator that never comes up fails within ``setup_deadline``
+    seconds (env PT_INIT_DEADLINE, default 120) with retries/backoff on
+    transient connection errors, instead of blocking a relaunch forever.
     """
     global _initialized
     if _initialized:
@@ -32,11 +37,42 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
     coordinator_address = coordinator_address or os.environ.get(
         "PT_COORDINATOR")
     if coordinator_address:
+        from paddle_tpu.distributed import resilience
+        from paddle_tpu.testing import faults
+
         num_processes = num_processes or int(os.environ["PT_NUM_PROCESSES"])
         process_id = process_id if process_id is not None else int(
             os.environ["PT_PROCESS_ID"])
-        jax.distributed.initialize(coordinator_address, num_processes,
-                                   process_id)
+        if setup_deadline is None:
+            setup_deadline = float(os.environ.get("PT_INIT_DEADLINE", 120))
+
+        def _connect():
+            faults.fire("collective.init")
+            # initialization_timeout bounds the blocking connect INSIDE
+            # jax (default 300s) — without it the outer deadline could
+            # only be checked between attempts
+            try:
+                jax.distributed.initialize(
+                    coordinator_address, num_processes, process_id,
+                    initialization_timeout=max(1, int(setup_deadline)))
+            except Exception:
+                # a failed attempt leaves jax's global client/service
+                # assigned, which would turn every retry into
+                # "initialize should only be called once" — reset so the
+                # retry really reconnects
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                raise
+
+        # RuntimeError included: jax wraps grpc UNAVAILABLE in
+        # XlaRuntimeError (a RuntimeError), and _connect's shutdown
+        # cleanup makes a re-initialize legal
+        resilience.with_deadline(
+            _connect, seconds=setup_deadline, op="collective_init",
+            retry_on=(TimeoutError, ConnectionError, OSError,
+                      RuntimeError))()
     _initialized = True
 
 

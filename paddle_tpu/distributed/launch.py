@@ -256,7 +256,8 @@ def _launch_elastic(args):
             print(f"[launch] elastic round {version}: world={world} "
                   f"local={n} start_rank={start}", file=sys.stderr)
             procs = [_spawn(args, i, rank=start + i, world=world,
-                            extra_env={"PT_ELASTIC_VERSION": str(version)})
+                            extra_env={"PT_ELASTIC_VERSION": str(version),
+                                       "PT_RESTART_ATTEMPT": str(attempt)})
                      for i in range(n)]
 
             def reform_requested():
@@ -338,13 +339,21 @@ def launch(argv):
         return _launch_elastic(args)
     attempt = 0
     while True:
-        procs = [_spawn(args, i) for i in range(args.nproc_per_node)]
+        # PT_RESTART_ATTEMPT is the auto-resume contract: workers (re)started
+        # by the same launcher see which attempt they are, so training
+        # scripts unconditionally AutoCheckpoint.restore() and attempt 1+
+        # resumes from the last VERIFIED checkpoint with no operator action
+        procs = [_spawn(args, i,
+                        extra_env={"PT_RESTART_ATTEMPT": str(attempt)})
+                 for i in range(args.nproc_per_node)]
         rc = _watch(procs)
         if rc == 0:
             return 0
         attempt += 1
         if attempt > args.max_restarts:
             return rc
+        from paddle_tpu import stats
+        stats.add("launch/restarts")
         print(f"[launch] worker failed rc={rc}; restart "
               f"{attempt}/{args.max_restarts}", file=sys.stderr)
 

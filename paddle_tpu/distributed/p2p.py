@@ -43,10 +43,17 @@ class _P2PState:
 
 def init_p2p(rank: Optional[int] = None, world_size: Optional[int] = None,
              master_endpoint: Optional[str] = None,
-             host: str = "127.0.0.1"):
-    """Rendezvous the eager p2p group (rank 0 hosts the store)."""
+             host: str = "127.0.0.1", rendezvous_deadline: float = 60.0):
+    """Rendezvous the eager p2p group (rank 0 hosts the store).
+
+    Every store read runs deadline-guarded with retry/backoff
+    (`resilience.store_get`): a peer that never publishes its address
+    fails the rendezvous with a `DeadlineExceeded` naming its key within
+    ``rendezvous_deadline`` seconds, instead of wedging the whole group
+    behind one raw 60s get."""
     global _state
     from paddle_tpu import native
+    from paddle_tpu.distributed import resilience
 
     rank = int(os.environ.get("PT_PROCESS_ID", 0)) if rank is None else rank
     world_size = int(os.environ.get("PT_NUM_PROCESSES", 1)) \
@@ -59,10 +66,16 @@ def init_p2p(rank: Optional[int] = None, world_size: Optional[int] = None,
     store = native.TCPStore(mhost if rank else "127.0.0.1", port,
                             is_master=(rank == 0), timeout=60.0)
     endpoint = native.P2PEndpoint()
-    store.set(f"p2p/addr/{rank}", f"{host}:{endpoint.port}".encode())
+    resilience.store_set(store, f"p2p/addr/{rank}",
+                         f"{host}:{endpoint.port}".encode(),
+                         op="p2p_rendezvous_set")
     peers = []
+    dl = resilience.Deadline(rendezvous_deadline)
     for r in range(world_size):
-        raw = store.get(f"p2p/addr/{r}", timeout=60.0).decode()
+        raw = resilience.store_get(
+            store, f"p2p/addr/{r}",
+            deadline=dl.budget(rendezvous_deadline),
+            op="p2p_rendezvous_get").decode()
         h, p = raw.rsplit(":", 1)
         peers.append((h, int(p)))
     with _lock:
@@ -127,14 +140,33 @@ def _next_send_seq(st, dst):
         return st.send_seq[dst]
 
 
-def send(tensor, dst: int, _seq=None):
-    """ref: paddle.distributed.send — blocking eager send to rank dst."""
+def send(tensor, dst: int, _seq=None, deadline: Optional[float] = 30.0):
+    """ref: paddle.distributed.send — blocking eager send to rank dst.
+
+    Transient connection failures (peer restarting, listen backlog full)
+    retry with backoff under ``deadline``; a dropped-message fault
+    (site ``p2p.send``) skips the wire write so receiver-side timeout
+    recovery can be exercised deterministically."""
     from paddle_tpu import stats
+    from paddle_tpu.distributed import resilience
+    from paddle_tpu.testing import faults
     st = _require()
+    # the drop check must precede the seq claim: a dropped send that
+    # consumed a sequence number would permanently desync the stream
+    # (the receiver's rolled-back recv retries seq N forever while the
+    # sender only ever sends N+1) — dropping BEFORE the claim models a
+    # send that never happened, which the recv-timeout path can recover
+    if faults.enabled() and faults.fire("p2p.send") == "drop":
+        stats.add("p2p/dropped_sends")
+        return
     seq = _next_send_seq(st, dst) if _seq is None else _seq
     h, p = st.peers[dst]
     payload = _pack(tensor)
-    st.endpoint.send(h, p, _tag(st.rank, dst, seq), payload)
+    resilience.DEFAULT_POLICY.run(
+        lambda: st.endpoint.send(h, p, _tag(st.rank, dst, seq), payload),
+        op="p2p_send",
+        retry_on=(ConnectionError,),
+        deadline=resilience.Deadline(deadline))
     stats.add("p2p/send_msgs")              # §5.5 (≙ monitor.h STAT_ADD)
     stats.add("p2p/send_bytes", len(payload))
 
@@ -143,7 +175,10 @@ def recv(tensor=None, src: int = 0, timeout: float = 120.0):
     """ref: paddle.distributed.recv — blocking receive from rank src.
     Returns the received array (also copied into ``tensor`` when a numpy
     array is passed, matching the reference's out-param style)."""
+    from paddle_tpu.testing import faults
     st = _require()
+    if faults.enabled():
+        faults.fire("p2p.recv")  # delay/raise BEFORE the seq claim
     # claim a DISTINCT seq per call (concurrent irecvs must not share a
     # tag); on timeout, roll the claim back if no later recv claimed past
     # us, so a retry still matches the sender's sequence

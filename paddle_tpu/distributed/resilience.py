@@ -1,0 +1,301 @@
+"""Resilient distributed runtime: retry/backoff/deadline policies and a
+collective watchdog.
+
+Reference analog: the reference's production serving stack assumes
+workers die and stores partition — fleet/elastic/manager.py restarts
+trainer groups, the brpc layer retries RPCs with timeouts — but each
+caller hand-rolls its own policy. Here there is ONE policy object
+(`RetryPolicy`: exponential backoff + jitter + an absolute deadline), one
+deadline primitive (`Deadline`), guarded wrappers for the store and
+control-plane ops (`store_get`, `with_deadline`), and a
+`CollectiveWatchdog` that converts "a rank hung inside a barrier" — the
+classic undiagnosable distributed failure — into an exception naming the
+stalled rank(s).
+
+Watchdog design: the same counter-not-clock trick as `elastic.py`
+heartbeats. Each rank bumps a per-rank progress counter in the TCPStore
+when it *enters* guarded collective #k, then waits (bounded by the
+deadline) for every peer's counter to reach k before running the real
+collective. A peer that never arrives leaves its counter behind, so the
+waiting ranks raise `CollectiveStallError` naming exactly the laggards —
+instead of blocking forever inside an un-interruptible native collective.
+Cross-host clock skew cannot fake a stall because only counter *progress*
+is judged, against the local monotonic clock.
+
+Every retry / timeout / stall increments a `resilience/*` counter in
+`paddle_tpu.stats` (§5.5 observability surface; see docs/resilience.md).
+"""
+
+import dataclasses
+import random as _random
+import time
+from typing import Callable, Optional, Tuple
+
+__all__ = ["Deadline", "DeadlineExceeded", "CollectiveStallError",
+           "RetryPolicy", "with_deadline", "store_get", "store_set",
+           "CollectiveWatchdog", "DEFAULT_POLICY"]
+
+
+class DeadlineExceeded(TimeoutError):
+    """An operation (including all its retries) overran its absolute
+    deadline. Subclasses TimeoutError so existing timeout handlers
+    (p2p recv rollback, elastic liveness) treat it uniformly."""
+
+
+class CollectiveStallError(RuntimeError):
+    """A guarded collective was entered by this rank but one or more
+    peers never arrived within the deadline. ``stalled_ranks`` names
+    them; the message includes each laggard's last observed progress."""
+
+    def __init__(self, message: str, stalled_ranks=()):
+        super().__init__(message)
+        self.stalled_ranks = tuple(stalled_ranks)
+
+
+class Deadline:
+    """Absolute time budget, measured on the local monotonic clock.
+
+    ``seconds=None`` means unbounded (remaining() == None, never
+    expired) so call sites can thread one object through both bounded
+    and unbounded paths.
+    """
+
+    __slots__ = ("seconds", "_t0")
+
+    def __init__(self, seconds: Optional[float]):
+        self.seconds = None if seconds is None else float(seconds)
+        self._t0 = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self._t0
+
+    def remaining(self) -> Optional[float]:
+        if self.seconds is None:
+            return None
+        return self.seconds - self.elapsed()
+
+    @property
+    def expired(self) -> bool:
+        r = self.remaining()
+        return r is not None and r <= 0
+
+    def budget(self, want: float, floor: float = 0.001) -> float:
+        """Clamp a per-attempt timeout to what's left of the deadline
+        (never below ``floor`` — native calls reject non-positive
+        timeouts)."""
+        r = self.remaining()
+        return max(floor, want if r is None else min(want, r))
+
+    def check(self, op: str = "operation"):
+        if self.expired:
+            raise DeadlineExceeded(
+                f"{op} exceeded its {self.seconds}s deadline "
+                f"(elapsed {self.elapsed():.2f}s)")
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff + jitter + absolute deadline.
+
+        policy = RetryPolicy(max_attempts=5, deadline=30.0)
+        value = policy.run(lambda: store.get(key), op="rendezvous_get")
+
+    The deadline bounds the WHOLE call including every backoff sleep —
+    a caller holding a peer at a barrier must fail within a known
+    budget, not after max_attempts of unbounded waits. Retries and
+    deadline overruns surface as ``resilience/retries`` /
+    ``resilience/deadline_exceeded`` (plus per-op variants) in
+    `paddle_tpu.stats`.
+    """
+
+    max_attempts: int = 5
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25          # +- fraction of the computed delay
+    deadline: Optional[float] = 30.0
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based)."""
+        d = min(self.base_delay * self.multiplier ** (attempt - 1),
+                self.max_delay)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * _random.random() - 1.0)
+        return max(0.0, d)
+
+    def run(self, fn: Callable, *, op: str = "op",
+            retry_on: Tuple = (TimeoutError, ConnectionError, OSError),
+            deadline: Optional["Deadline"] = None):
+        from paddle_tpu import stats
+        dl = deadline or Deadline(self.deadline)
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except DeadlineExceeded:
+                raise           # an inner deadline is final, never retried
+            except retry_on as e:
+                attempt += 1
+                stats.add("resilience/retries")
+                stats.add(f"resilience/{op}/retries")
+                if attempt >= self.max_attempts:
+                    stats.add("resilience/retries_exhausted")
+                    raise
+                if dl.expired:
+                    stats.add("resilience/deadline_exceeded")
+                    raise DeadlineExceeded(
+                        f"{op} failed after {attempt} attempts over "
+                        f"{dl.elapsed():.2f}s (deadline {dl.seconds}s): "
+                        f"{e!r}") from e
+                time.sleep(dl.budget(self.delay_for(attempt), floor=0.0))
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+def with_deadline(fn: Callable, seconds: Optional[float],
+                  op: Optional[str] = None,
+                  policy: Optional[RetryPolicy] = None,
+                  retry_on: Tuple = (TimeoutError, ConnectionError,
+                                     OSError)) -> Callable:
+    """Wrap a zero-arg-compatible callable so every invocation runs
+    under a fresh ``seconds`` deadline with retry/backoff on transient
+    errors (``retry_on``, default TimeoutError / ConnectionError /
+    OSError — widen it for libraries that wrap transport failures in
+    RuntimeError, e.g. jax's XlaRuntimeError).
+
+        guarded = with_deadline(lambda: jax.distributed.initialize(...),
+                                seconds=120.0, op="collective_init")
+        guarded()
+    """
+    name = op or getattr(fn, "__name__", "op")
+    pol = policy or DEFAULT_POLICY
+
+    def wrapped(*args, **kwargs):
+        return pol.run(lambda: fn(*args, **kwargs), op=name,
+                       retry_on=retry_on, deadline=Deadline(seconds))
+
+    wrapped.__name__ = f"with_deadline[{name}]"
+    return wrapped
+
+
+def store_get(store, key: str, *, deadline: float = 30.0,
+              policy: Optional[RetryPolicy] = None, op: str = "store_get"):
+    """Deadline-guarded TCPStore get: each attempt's native timeout is
+    the deadline *remainder* (so retries after transient connection
+    errors cannot extend the total budget), and the whole call fails
+    with `DeadlineExceeded` naming the key. Fault site: ``store.get``."""
+    from paddle_tpu import stats
+    from paddle_tpu.testing import faults
+
+    pol = policy or DEFAULT_POLICY
+    dl = Deadline(deadline)
+
+    def attempt():
+        faults.fire("store.get")
+        dl.check(f"store.get({key!r})")
+        return store.get(key, timeout=dl.budget(deadline))
+
+    try:
+        return pol.run(attempt, op=op, deadline=dl)
+    except TimeoutError as e:
+        if isinstance(e, DeadlineExceeded):
+            raise
+        stats.add("resilience/deadline_exceeded")
+        raise DeadlineExceeded(
+            f"store.get({key!r}) exceeded its {deadline}s deadline") from e
+
+
+def store_set(store, key: str, value, *,
+              policy: Optional[RetryPolicy] = None, op: str = "store_set"):
+    """Retried TCPStore set (transient connection errors only — set has
+    no wait semantics, so no deadline remainder to thread)."""
+    pol = policy or DEFAULT_POLICY
+    return pol.run(lambda: store.set(key, value), op=op)
+
+
+class CollectiveWatchdog:
+    """Progress-counter watchdog for host-level collectives.
+
+        wd = CollectiveWatchdog(store, rank=r, world_size=n,
+                                deadline=30.0)
+        with wd.guard("allreduce"):        # raises CollectiveStallError
+            ...run the real collective...  # if a peer never arrives
+
+    Each ``guard`` entry bumps this rank's counter
+    (``resilience/wd/{group}/{rank}``) in the store and then waits —
+    bounded by ``deadline`` — for every peer's counter to reach the
+    same height. Because the counter only moves when a rank reaches the
+    guard, a hung/dead peer is distinguishable from a slow one by lack
+    of progress, and the error names exactly the ranks that never
+    arrived (with their last observed progress), turning an infinite
+    hang into a diagnosable failure. Fault site: ``watchdog.enter``
+    (delay a rank to simulate a straggler)."""
+
+    def __init__(self, store, rank: int, world_size: int,
+                 group: str = "default", deadline: float = 30.0,
+                 poll: float = 0.05):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.group = group
+        self.deadline = float(deadline)
+        self.poll = float(poll)
+
+    def _key(self, rank: int) -> str:
+        return f"resilience/wd/{self.group}/{rank}"
+
+    def _progress(self, rank: int) -> int:
+        from paddle_tpu.native import decode_counter
+        try:
+            return decode_counter(
+                self.store.get(self._key(rank), timeout=self.poll))
+        except (TimeoutError, ValueError):
+            return 0            # not yet registered → no progress
+
+    def progress(self) -> dict:
+        """Last observed per-rank progress counters (diagnostics)."""
+        return {r: self._progress(r) for r in range(self.world_size)}
+
+    def guard(self, op: str = "collective"):
+        wd = self
+
+        class _Guard:
+            def __enter__(self):
+                from paddle_tpu import stats
+                from paddle_tpu.testing import faults
+                faults.fire("watchdog.enter")
+                seq = wd.store.add(wd._key(wd.rank), 1)
+                dl = Deadline(wd.deadline)
+                behind = {}
+                while True:
+                    behind = {r: c for r, c in
+                              ((r, wd._progress(r))
+                               for r in range(wd.world_size))
+                              if c < seq and r != wd.rank}
+                    if not behind:
+                        break
+                    if dl.expired:
+                        stats.add("resilience/watchdog_stalls")
+                        ranks = sorted(behind)
+                        raise CollectiveStallError(
+                            f"collective {op!r} #{seq}: rank(s) {ranks} "
+                            f"stalled — progress "
+                            f"{ {r: behind[r] for r in ranks} } after "
+                            f"{wd.deadline}s (this rank={wd.rank}, "
+                            f"world={wd.world_size})",
+                            stalled_ranks=ranks)
+                    time.sleep(wd.poll)
+                stats.add("resilience/watchdog_syncs")
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        return _Guard()
+
+    def barrier(self, op: str = "barrier"):
+        """A guarded no-op collective: returns once every rank arrives,
+        raises `CollectiveStallError` otherwise."""
+        with self.guard(op):
+            pass

@@ -113,23 +113,83 @@ def resolve_engine_weights(model, share_weights_with):
 
 
 class Request:
-    """One in-flight generation request."""
+    """One in-flight generation request.
 
-    __slots__ = ("prompt", "max_new_tokens", "eos_id", "tokens", "done")
+    ``deadline`` (monotonic, absolute) bounds the request's wall time in
+    the engine; past it the scheduler evicts ONLY this request (slot
+    freed, batch peers unaffected) with ``error`` set. ``error`` is also
+    set when the non-finite-logit guard evicts a poisoned request —
+    callers must check it before trusting ``tokens``."""
 
-    def __init__(self, prompt, max_new_tokens, eos_id):
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "tokens", "done",
+                 "deadline", "error")
+
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.tokens: List[int] = []   # generated only
         self.done = False
+        self.deadline = deadline      # absolute time.monotonic() budget
+        self.error: Optional[str] = None
+
+    @property
+    def failed(self) -> bool:
+        return self.error is not None
 
     @property
     def output(self) -> List[int]:
         return self.prompt + self.tokens
 
 
-class DecodeEngine:
+class ResilientScheduler:
+    """Shared degradation bookkeeping for the serving engines: evict ONE
+    request (deadline overrun or non-finite logits) without disturbing
+    its batch peers. Engines override `_on_evict` to reclaim their own
+    per-slot resources (the paged engine returns the slot's pages)."""
+
+    def _on_evict(self, slot: int):
+        self.active = self.active.at[slot].set(False)
+
+    def _fail(self, req: Request, reason: str, slot: Optional[int] = None,
+              stat: str = "serve/deadline_evictions"):
+        from paddle_tpu import stats
+        req.done = True
+        req.error = reason
+        if slot is not None:
+            self._slot_req[slot] = None
+            self._on_evict(slot)
+        stats.add(stat)
+
+    def _evict_expired(self):
+        """Deadline sweep (queue + live slots) run at each step entry."""
+        import time
+        now = time.monotonic()
+        for req in [r for r in self._waiting
+                    if r.deadline is not None and now > r.deadline]:
+            self._waiting.remove(req)
+            self._fail(req, "deadline exceeded while queued")
+        for slot, req in enumerate(self._slot_req):
+            if (req is not None and req.deadline is not None
+                    and now > req.deadline):
+                self._fail(req, "deadline exceeded", slot=slot)
+
+    def _poison_mask(self):
+        """Injection mask for this dispatch (site engine.poison_logits).
+        With no fault plan installed this returns one cached all-False
+        device array — the production hot path pays no per-step host
+        allocation or transfer."""
+        from paddle_tpu.testing import faults
+        if not faults.enabled():
+            mask = getattr(self, "_no_poison", None)
+            if mask is None:
+                mask = self._no_poison = jnp.zeros((self.S,), bool)
+            return mask
+        return jnp.asarray(faults.slot_mask("engine.poison_logits",
+                                            self.S))
+
+
+class DecodeEngine(ResilientScheduler):
     """Continuous-batching generation over a dense GPT model.
 
         eng = DecodeEngine(model, max_slots=8, max_len=512)
@@ -340,12 +400,17 @@ class DecodeEngine:
         return kc, vc
 
     def _one_token(self, head, stacked, kc, vc, lengths, last, active,
-                   rng):
+                   rng, poison):
         """Advance every active slot one token: the shared body of the
         single-step and chunked-step entry points. The caches ride the
         layer scan as READ-ONLY xs; each layer emits only its new KV
         rows (`GPTBlock.decode_rows`), written back in one batch after
-        the scan."""
+        the scan.
+
+        Degradation guard: per-slot ``bad`` flags any non-finite logits
+        (a poisoned request — NaN/Inf from a numerical blowup or fault
+        injection via ``poison``). A bad slot emits nothing and does not
+        advance; the host evicts only that request from the batch."""
         temperature, top_p, top_k = self.sample
         x = jnp.take(head["wte"], last, axis=0)
         if head["wpe"] is not None:   # rope models position in attention
@@ -362,18 +427,22 @@ class DecodeEngine:
         x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
         kc, vc = self._write_rows(kc, vc, k_rows, v_rows, lengths)
         logits = self._lm_head(head, x)[:, 0]
+        logits = jnp.where(poison[:, None], jnp.nan, logits)
+        bad = active & ~jnp.all(jnp.isfinite(logits), axis=-1)
         rng, k = jax.random.split(rng)
         nxt = gpt_lib._sample_token(logits.astype(jnp.float32), k,
                                     temperature, top_p, top_k)
-        nxt = jnp.where(active, nxt, last)
-        lengths = lengths + active.astype(jnp.int32)
-        return kc, vc, lengths, nxt, rng
+        nxt = jnp.where(active & ~bad, nxt, last)
+        lengths = lengths + (active & ~bad).astype(jnp.int32)
+        return kc, vc, lengths, nxt, rng, bad
 
     def _multi_impl(self, head, stacked, kc, vc, lengths, last, active,
-                    remaining, eos, rng):
+                    remaining, eos, rng, poison):
         """``chunk`` decode steps in ONE dispatch (lax.scan over
         _one_token), with per-slot early stop device-side: a slot stops
-        advancing when it hits its eos id or exhausts its token budget.
+        advancing when it hits its eos id or exhausts its token budget,
+        and a slot whose logits go non-finite stops emitting immediately
+        (its ``bad`` flag tells the host to evict the request).
 
         Serving loops belong on the device — host round-trip latency
         (worst over a remote PJRT tunnel, still microseconds locally)
@@ -384,25 +453,28 @@ class DecodeEngine:
 
         def one(carry, _):
             kc, vc, lengths, last, active, remaining, rng = carry
-            kc, vc, lengths, nxt, rng = self._one_token(
-                head, stacked, kc, vc, lengths, last, active, rng)
-            emit = active
-            remaining = remaining - active.astype(jnp.int32)
+            kc, vc, lengths, nxt, rng, bad = self._one_token(
+                head, stacked, kc, vc, lengths, last, active, rng, poison)
+            emit = active & ~bad
+            remaining = remaining - emit.astype(jnp.int32)
             hit_eos = (nxt == eos) & (eos >= 0)
-            active = active & ~hit_eos & (remaining > 0)
+            active = active & ~bad & ~hit_eos & (remaining > 0)
             return (kc, vc, lengths, nxt, active, remaining, rng), \
-                (nxt, emit)
+                (nxt, emit, bad)
 
-        (kc, vc, lengths, last, active, remaining, rng), (toks, flags) = \
+        (kc, vc, lengths, last, active, remaining, rng), \
+            (toks, flags, bads) = \
             lax.scan(one, (kc, vc, lengths, last, active, remaining, rng),
                      None, length=self.chunk)
-        return kc, vc, lengths, last, active, remaining, rng, toks, flags
+        return (kc, vc, lengths, last, active, remaining, rng, toks,
+                flags, bads)
 
-    def _verify_impl(self, head, stacked, kc, vc, lengths, cand):
+    def _verify_impl(self, head, stacked, kc, vc, lengths, cand, poison):
         """One speculative verify: K candidate tokens per slot through
-        one pass. Returns the model's predictions (S, K) and the
-        accepted-prefix length n_acc (0..K-1); the chunked wrapper
-        applies eos/budget truncation and advances the state."""
+        one pass. Returns the model's predictions (S, K), the
+        accepted-prefix length n_acc (0..K-1), and the per-slot
+        non-finite ``bad`` flag; the chunked wrapper applies eos/budget
+        truncation and advances the state."""
         S, K = cand.shape
         x = jnp.take(head["wte"], cand, axis=0)
         if head["wpe"] is not None:
@@ -419,13 +491,15 @@ class DecodeEngine:
         x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
         kc, vc = self._write_rows(kc, vc, k_rows, v_rows, lengths)
         logits = self._lm_head(head, x).astype(jnp.float32)  # (S, K, V)
+        logits = jnp.where(poison[:, None, None], jnp.nan, logits)
+        bad = ~jnp.all(jnp.isfinite(logits), axis=(1, 2))
         pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # candidate j (cand[:, j], j>=1) is accepted iff it equals the
         # model's prediction at the previous position — cumulative
         match = jnp.cumprod(
             (cand[:, 1:] == pred[:, :-1]).astype(jnp.int32), axis=1)
         n_acc = jnp.sum(match, axis=1)                 # 0..K-1
-        return kc, vc, pred, n_acc
+        return kc, vc, pred, n_acc, bad
 
     def _draft_device(self, toks, lengths, last):
         """On-device prompt-lookup drafts: continuation of the most
@@ -452,7 +526,7 @@ class DecodeEngine:
         return jnp.concatenate([last[:, None], tail], axis=1)
 
     def _spec_multi_impl(self, head, stacked, kc, vc, toks, lengths,
-                         last, active, remaining, eos):
+                         last, active, remaining, eos, poison):
         """``chunk`` speculative steps in ONE dispatch: draft on device
         from the history buffer, verify K candidates per slot in one
         pass, accept the longest greedy-matching run, early-stop per
@@ -467,9 +541,13 @@ class DecodeEngine:
         def one(carry, _):
             kc, vc, toks, lengths, last, active, remaining = carry
             cand = self._draft_device(toks, lengths, last)
-            kc, vc, pred, n_acc = self._verify_impl(
-                head, stacked, kc, vc, lengths, cand)
-            n_raw = n_acc + 1
+            kc, vc, pred, n_acc, bad = self._verify_impl(
+                head, stacked, kc, vc, lengths, cand, poison)
+            # inactive slots keep computing from stale state inside the
+            # chunk; a non-finite there must not retroactively fail a
+            # request that already completed (same mask as _one_token)
+            bad = bad & active
+            n_raw = jnp.where(bad, 0, n_acc + 1)
             # eos truncation: keep tokens up to and including the first
             # eos among the accepted run
             j = jnp.arange(K)[None, :]
@@ -495,15 +573,16 @@ class DecodeEngine:
             remaining = remaining - n_eff
             lengths = lengths + n_eff
             emitted_eos = any_eos & (first_eos < n_eff)
-            active = active & ~emitted_eos & (remaining > 0)
+            active = active & ~bad & ~emitted_eos & (remaining > 0)
             return (kc, vc, toks, lengths, last, active, remaining), \
-                (pred, n_eff)
+                (pred, n_eff, bad)
 
-        (kc, vc, toks, lengths, last, active, remaining), (preds, effs) \
+        (kc, vc, toks, lengths, last, active, remaining), \
+            (preds, effs, bads) \
             = lax.scan(one, (kc, vc, toks, lengths, last, active,
                              remaining), None, length=self.chunk)
         return (kc, vc, toks, lengths, last, active, remaining, preds,
-                effs)
+                effs, bads)
 
     def _prefill_impl(self, head, stacked, kc, vc, toks, lengths, last,
                       active, slot, tokens, start, true_total, is_final,
@@ -556,7 +635,12 @@ class DecodeEngine:
     # -- scheduler ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
-               eos_id: Optional[int] = None) -> Request:
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """``deadline_s``: wall-time budget for this request (queue wait
+        included). A request past its deadline is evicted alone — the
+        batch keeps serving its peers."""
+        import time
         prompt = list(np.asarray(prompt).reshape(-1))
         if not prompt:
             raise ValueError("empty prompt")
@@ -570,7 +654,9 @@ class DecodeEngine:
                 f"speculative window: prompt + new + K-1 "
                 f"({len(prompt)}+{max_new_tokens}+{self.spec_k - 1}) "
                 f"exceed cache length {self.T}")
-        req = Request(prompt, max_new_tokens, eos_id)
+        req = Request(prompt, max_new_tokens, eos_id,
+                      deadline=(None if deadline_s is None
+                                else time.monotonic() + deadline_s))
         self._waiting.append(req)
         return req
 
@@ -619,8 +705,10 @@ class DecodeEngine:
             self.active = self.active.at[slot].set(False)
 
     def step(self) -> int:
-        """Admit what fits, then advance every active slot (one token,
-        or up to K with speculative decoding). Returns tokens emitted."""
+        """Evict past-deadline requests, admit what fits, then advance
+        every active slot (one token, or up to K with speculative
+        decoding). Returns tokens emitted."""
+        self._evict_expired()
         while self._waiting:
             slot = self._free_slot()
             if slot is None:
@@ -637,13 +725,19 @@ class DecodeEngine:
             n = self._chunk_step(live)
         else:
             (self.kc, self.vc, self.lengths, self.last,
-             self._rng) = self._step_fn(
+             self._rng, bad) = self._step_fn(
                 self._head, self._stacked, self.kc, self.vc, self.lengths,
-                self.last, self.active, self._rng)
+                self.last, self.active, self._rng, self._poison_mask())
             emitted = np.asarray(self.last)
+            bad = np.asarray(bad)
+            n = 0
             for slot, req in live:
-                self._emit(slot, req, int(emitted[slot]))
-            n = len(live)
+                if bad[slot]:
+                    self._fail(req, "non-finite logits", slot=slot,
+                               stat="serve/nonfinite_evictions")
+                else:
+                    self._emit(slot, req, int(emitted[slot]))
+                    n += 1
         self.tokens_emitted += n
         return n
 
@@ -669,20 +763,26 @@ class DecodeEngine:
 
     def _chunk_step(self, live) -> int:
         """One dispatch advancing every live slot up to ``chunk`` tokens,
-        early-stopping per slot device-side (eos / budget)."""
+        early-stopping per slot device-side (eos / budget / non-finite
+        logits — the last evicting only the poisoned request)."""
         remaining, eos = self._marshal_limits(live)
         (self.kc, self.vc, self.lengths, self.last, self.active,
-         _, self._rng, toks, flags) = self._multi_fn(
+         _, self._rng, toks, flags, bads) = self._multi_fn(
             self._head, self._stacked, self.kc, self.vc, self.lengths,
-            self.last, self.active, remaining, eos, self._rng)
+            self.last, self.active, remaining, eos, self._rng,
+            self._poison_mask())
         toks = np.asarray(toks)
         flags = np.asarray(flags)
+        bads = np.asarray(bads)
         total = 0
         for slot, req in live:
             for j in range(self.chunk):
                 if flags[j, slot]:
                     req.tokens.append(int(toks[j, slot]))
                     total += 1
+            if bads[:, slot].any():
+                self._fail(req, "non-finite logits", slot=slot,
+                           stat="serve/nonfinite_evictions")
         self._retire_done(live)
         return total
 
@@ -692,17 +792,22 @@ class DecodeEngine:
         replays the emitted (step, slot, count) runs into Requests."""
         remaining, eos = self._marshal_limits(live)
         (self.kc, self.vc, self.toks, self.lengths, self.last,
-         self.active, _, preds, effs) = self._verify_fn(
+         self.active, _, preds, effs, bads) = self._verify_fn(
             self._head, self._stacked, self.kc, self.vc, self.toks,
-            self.lengths, self.last, self.active, remaining, eos)
+            self.lengths, self.last, self.active, remaining, eos,
+            self._poison_mask())
         preds = np.asarray(preds)      # (chunk, S, K)
         effs = np.asarray(effs)        # (chunk, S)
+        bads = np.asarray(bads)        # (chunk, S)
         total = 0
         for slot, req in live:
             for j in range(self.chunk):
                 for t in range(int(effs[j, slot])):
                     req.tokens.append(int(preds[j, slot, t]))
                     total += 1
+            if bads[:, slot].any():
+                self._fail(req, "non-finite logits", slot=slot,
+                           stat="serve/nonfinite_evictions")
         self._retire_done(live)
         return total
 

@@ -49,14 +49,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.models import gpt as gpt_lib
-from paddle_tpu.inference.decode_engine import Request
+from paddle_tpu.inference.decode_engine import (Request,
+                                                ResilientScheduler)
 from paddle_tpu.ops.pallas.decode_attention import fold_fresh_row
 from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
 
 __all__ = ["PagedDecodeEngine"]
 
 
-class PagedDecodeEngine:
+class PagedDecodeEngine(ResilientScheduler):
     """Continuous-batching greedy generation over a paged KV pool.
 
         eng = PagedDecodeEngine(model, n_pages=64, max_slots=8)
@@ -183,8 +184,10 @@ class PagedDecodeEngine:
         return kp, vp
 
     def _one_token(self, head, stacked, kp, vp, table, lengths, last,
-                   active):
-        """Advance every active slot one token.
+                   active, poison):
+        """Advance every active slot one token. Per-slot ``bad`` flags
+        non-finite logits (numerical blowup or injected poison) — the
+        slot stops advancing and the host evicts only that request.
 
         The pools are READ-ONLY inside the layer scan: each layer's
         attention runs the paged kernel over the existing prefix
@@ -221,31 +224,35 @@ class PagedDecodeEngine:
         kp, vp = self._write_token_rows(kp, vp, k_rows, v_rows, table,
                                         lengths, active)
         logits = self._lm_head(head, x)[:, 0]
+        logits = jnp.where(poison[:, None], jnp.nan, logits)
+        bad = active & ~jnp.all(jnp.isfinite(logits), axis=-1)
         nxt = jnp.argmax(logits.astype(jnp.float32), -1).astype(jnp.int32)
-        nxt = jnp.where(active, nxt, last)
-        lengths = lengths + active.astype(jnp.int32)
-        return kp, vp, lengths, nxt
+        nxt = jnp.where(active & ~bad, nxt, last)
+        lengths = lengths + (active & ~bad).astype(jnp.int32)
+        return kp, vp, lengths, nxt, bad
 
     def _multi_impl(self, head, stacked, kp, vp, table, lengths, last,
-                    active, remaining, eos):
-        """``chunk`` decode steps in one dispatch, per-slot eos/budget
-        early-stop device-side (pages for the whole chunk are reserved
-        before the dispatch, so ``table`` is static here)."""
+                    active, remaining, eos, poison):
+        """``chunk`` decode steps in one dispatch, per-slot eos/budget/
+        non-finite early-stop device-side (pages for the whole chunk are
+        reserved before the dispatch, so ``table`` is static here)."""
 
         def one(carry, _):
             kp, vp, lengths, last, active, remaining = carry
-            kp, vp, lengths, nxt = self._one_token(
-                head, stacked, kp, vp, table, lengths, last, active)
-            emit = active
-            remaining = remaining - active.astype(jnp.int32)
+            kp, vp, lengths, nxt, bad = self._one_token(
+                head, stacked, kp, vp, table, lengths, last, active,
+                poison)
+            emit = active & ~bad
+            remaining = remaining - emit.astype(jnp.int32)
             hit_eos = (nxt == eos) & (eos >= 0)
-            active = active & ~hit_eos & (remaining > 0)
-            return (kp, vp, lengths, nxt, active, remaining), (nxt, emit)
+            active = active & ~bad & ~hit_eos & (remaining > 0)
+            return (kp, vp, lengths, nxt, active, remaining), \
+                (nxt, emit, bad)
 
-        (kp, vp, lengths, last, active, remaining), (toks, flags) = \
+        (kp, vp, lengths, last, active, remaining), (toks, flags, bads) = \
             lax.scan(one, (kp, vp, lengths, last, active, remaining),
                      None, length=self.chunk)
-        return kp, vp, lengths, last, active, remaining, toks, flags
+        return kp, vp, lengths, last, active, remaining, toks, flags, bads
 
     def _prefill_impl(self, head, stacked, kp, vp, tokens, true_len,
                       write_segments):
@@ -339,7 +346,9 @@ class PagedDecodeEngine:
     # -- scheduler ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
-               eos_id: Optional[int] = None) -> Request:
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        import time
         prompt = list(np.asarray(prompt).reshape(-1))
         if not prompt:
             raise ValueError("empty prompt")
@@ -350,7 +359,9 @@ class PagedDecodeEngine:
                 f"longer prompts")
         if len(prompt) + max_new_tokens > self.cfg.max_seq_len:
             raise ValueError("prompt + new tokens exceed max_seq_len")
-        req = Request(prompt, max_new_tokens, eos_id)
+        req = Request(prompt, max_new_tokens, eos_id,
+                      deadline=(None if deadline_s is None
+                                else time.monotonic() + deadline_s))
         self._waiting.append(req)
         return req
 
@@ -359,6 +370,12 @@ class PagedDecodeEngine:
             if r is None:
                 return s
         return None
+
+    def _on_evict(self, slot: int):
+        """Eviction also returns the slot's pages to the pool (the dead
+        sequence's memory is reclaimable at once)."""
+        self._release(slot)
+        super()._on_evict(slot)
 
     def _admit(self, req: Request, slot: int):
         prompt = np.asarray(req.prompt, np.int32)
@@ -398,6 +415,7 @@ class PagedDecodeEngine:
             self.active = self.active.at[slot].set(False)
 
     def step(self) -> int:
+        self._evict_expired()
         while self._waiting:
             slot = self._free_slot()
             if slot is None:
@@ -435,18 +453,23 @@ class PagedDecodeEngine:
                 eos[slot] = req.eos_id
         self.steps += 1
         (self.kp, self.vp, self.lengths, self.last, self.active, _,
-         toks, flags) = self._multi_fn(
+         toks, flags, bads) = self._multi_fn(
             self._head, self._stacked, self.kp, self.vp,
             self._table_array(), self.lengths, self.last, self.active,
-            jnp.asarray(remaining), jnp.asarray(eos))
+            jnp.asarray(remaining), jnp.asarray(eos),
+            self._poison_mask())
         toks = np.asarray(toks)
         flags = np.asarray(flags)
+        bads = np.asarray(bads)
         total = 0
         for slot, req in live:
             for j in range(self.chunk):
                 if flags[j, slot] and not req.done:
                     self._emit(slot, req, int(toks[j, slot]))
                     total += 1
+            if bads[:, slot].any() and not req.done:
+                self._fail(req, "non-finite logits", slot=slot,
+                           stat="serve/nonfinite_evictions")
         self.tokens_emitted += total
         return total
 

@@ -13,6 +13,25 @@ or a formatted table.
     with stats.timer("my/io"):
         ...
     print(stats.table())
+
+Resilience counter namespace (docs/resilience.md) — every retry, timeout,
+fallback, and degradation event in the fault-tolerant runtime lands here
+so operators can tell a healthy job from one limping through failures:
+
+    resilience/retries[, /<op>/retries]   guarded-op retries (RetryPolicy)
+    resilience/retries_exhausted          gave up after max_attempts
+    resilience/deadline_exceeded          absolute deadline overruns
+    resilience/watchdog_syncs             guarded collectives that synced
+    resilience/watchdog_stalls            stalled collectives detected
+    ckpt/verify_failures                  checkpoint dirs failing verify
+    ckpt/restore_fallbacks                restores skipping a bad epoch
+    ckpt/tmp_gc                           orphaned .tmp_epoch_* collected
+    p2p/recv_timeouts, p2p/dropped_sends  p2p degradation events
+    serve/deadline_evictions              requests evicted past deadline
+    serve/nonfinite_evictions             poisoned-logit requests evicted
+    launch/restarts                       launcher worker-group restarts
+
+``snapshot("resilience/")`` / ``table("ckpt/")`` filter by prefix.
 """
 
 import threading
@@ -94,7 +113,7 @@ class StatRegistry:
         return _Ctx()
 
     # -- export ---------------------------------------------------------------
-    def snapshot(self) -> Dict[str, float]:
+    def snapshot(self, prefix: Optional[str] = None) -> Dict[str, float]:
         with self._lock:
             out = dict(self._counters)
             out.update(self._gauges)
@@ -103,10 +122,12 @@ class StatRegistry:
                 out[f"{name}.count"] = t.count
                 out[f"{name}.mean_s"] = t.mean_s
                 out[f"{name}.max_s"] = t.max_s
-            return out
+        if prefix is not None:
+            out = {k: v for k, v in out.items() if k.startswith(prefix)}
+        return out
 
-    def table(self) -> str:
-        snap = self.snapshot()
+    def table(self, prefix: Optional[str] = None) -> str:
+        snap = self.snapshot(prefix)
         if not snap:
             return "(no stats recorded)"
         width = max(len(k) for k in snap)
@@ -150,12 +171,12 @@ def timer(name: str):
     return _DEFAULT.timer(name)
 
 
-def snapshot() -> Dict[str, float]:
-    return _DEFAULT.snapshot()
+def snapshot(prefix: Optional[str] = None) -> Dict[str, float]:
+    return _DEFAULT.snapshot(prefix)
 
 
-def table() -> str:
-    return _DEFAULT.table()
+def table(prefix: Optional[str] = None) -> str:
+    return _DEFAULT.table(prefix)
 
 
 def reset(prefix: Optional[str] = None):

@@ -1,0 +1,9 @@
+"""Test-support runtime: deterministic fault injection for the resilience
+subsystem (``paddle_tpu.testing.faults``). Production code fires injection
+sites that are no-ops unless a fault plan is installed, so every recovery
+path in `distributed/resilience.py`, `distributed/checkpoint.py`, and the
+serving engines has a deterministic test."""
+
+from paddle_tpu.testing import faults
+
+__all__ = ["faults"]

@@ -1,0 +1,42 @@
+"""Spawned workers for fault-injection p2p tests (ISSUE 2 satellite:
+recv timeout rollback regression)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+
+def recv_timeout_worker(rank, port, tmpdir):
+    """Rank 0: a recv that times out (nothing sent yet) must roll its
+    sequence claim back and bump p2p/recv_timeouts exactly once; the
+    two messages rank 1 then sends must arrive IN ORDER on the retried
+    recvs (a leaked claim would make recv wait on seq 2/3 while the
+    sender used 1/2 — permanent desync)."""
+    from paddle_tpu import stats
+    from paddle_tpu.distributed import p2p
+
+    p2p.init_p2p(rank=rank, world_size=2,
+                 master_endpoint=f"127.0.0.1:{port}")
+    if rank == 0:
+        try:
+            p2p.recv(src=1, timeout=0.5)
+            raise AssertionError("recv should have timed out")
+        except TimeoutError:
+            pass
+        assert stats.get("p2p/recv_timeouts") == 1, \
+            stats.snapshot("p2p/")
+        # barrier: releases rank 1 to send only after the timeout
+        p2p.all_gather_object([], {"r": rank})
+        first = p2p.recv(src=1, timeout=30.0)
+        second = p2p.recv(src=1, timeout=30.0)
+        np.testing.assert_array_equal(first, np.arange(3))
+        np.testing.assert_array_equal(second, np.arange(3) * 10)
+        assert stats.get("p2p/recv_timeouts") == 1  # exactly once
+    else:
+        p2p.all_gather_object([], {"r": rank})
+        p2p.send(np.arange(3), dst=0)
+        p2p.send(np.arange(3) * 10, dst=0)
+    p2p.destroy_process_group()
+    open(os.path.join(tmpdir, f"ok{rank}"), "w").close()

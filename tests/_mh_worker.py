@@ -11,6 +11,20 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import numpy as np  # noqa: E402
 
 
+def _set_cpu_device_count(n):
+    """Per-process CPU device count, pre-backend-init. jax >= 0.5 has a
+    config option; older jax only honors the XLA flag (these lines run
+    before any backend initializes, so mutating XLA_FLAGS still takes)."""
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
 def worker(tmpdir):
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -20,7 +34,7 @@ def worker(tmpdir):
     # process — conftest's xla_force_host_platform_device_count=8 leaks
     # into spawned children through the environment.
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.config.update("jax_num_cpu_devices", 1)
+    _set_cpu_device_count(1)
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from jax.experimental.shard_map import shard_map
@@ -131,7 +145,7 @@ def gpt_worker(tmpdir):
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.config.update("jax_num_cpu_devices", 4)
+    _set_cpu_device_count(4)
 
     import paddle_tpu.distributed as dist
 
@@ -192,7 +206,7 @@ def fe_worker(tmpdir, store_port):
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.config.update("jax_num_cpu_devices", 4)
+    _set_cpu_device_count(4)
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

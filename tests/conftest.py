@@ -21,6 +21,15 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 runs")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / resilience test (fast CPU smoke: "
+        "tools/ci.sh faults)")
+
 # attach numpy oracles to every registered op (OpTest backbone, SURVEY §4);
 # test-only scaffolding, deliberately NOT run on production import
 import paddle_tpu  # noqa: E402,F401
@@ -33,10 +42,12 @@ _oracles.attach_all()
 def _seed():
     import paddle_tpu as pt
     from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.testing import faults
     pt.seed(1234)
     np.random.seed(1234)
     mesh_lib.set_topology(None)  # no cross-test global-mesh leakage
     yield
+    faults.clear()               # no fault-rule leakage across tests
 
 
 @pytest.fixture

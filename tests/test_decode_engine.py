@@ -283,7 +283,7 @@ def test_chunked_step_has_no_cache_sized_temps():
     lowered = eng._multi_fn.lower(
         eng._head, eng._stacked, eng.kc, eng.vc, eng.lengths, eng.last,
         eng.active, jnp.zeros((4,), jnp.int32),
-        jnp.zeros((4,), jnp.int32), eng._rng)
+        jnp.zeros((4,), jnp.int32), eng._rng, jnp.zeros((4,), bool))
     ma = lowered.compile().memory_analysis()
     cache = eng.kc.nbytes + eng.vc.nbytes
     assert ma.temp_size_in_bytes < 0.75 * cache, (

@@ -1,0 +1,110 @@
+"""End-to-end resilience under fault injection (ISSUE 2 acceptance):
+a worker killed mid-training auto-resumes from the last VERIFIED
+checkpoint via ``launch.py --max_restarts``; a corrupted newest
+checkpoint falls back to the previous one with no manual intervention;
+an interrupted save's orphan .tmp dir is GC'd on the resumed run.
+
+Subprocess-driven through the real launcher (the reference's own test
+pattern — test_parallel_dygraph_dataparallel.py shells out through the
+launch CLI)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Training script under test: restore-or-init, train to epoch 6, verify
+# the final state in-process. Fault rules (PT_FAULTS) are installed on
+# the FIRST attempt only — relaunches run clean, so each test's recovery
+# path is exercised exactly once and deterministically.
+TRAIN_BODY = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+from paddle_tpu.testing import faults
+
+attempt = int(os.environ.get("PT_RESTART_ATTEMPT", "0"))
+if attempt == 0:
+    faults.install_from_env()
+
+ck = AutoCheckpoint(r"{root}", job_id="job", keep=4)
+state = ck.restore() or {{"w": jnp.zeros((4,)), "epoch": -1}}
+for epoch in range(ck.next_epoch, 6):
+    faults.fire("train.step")
+    state = {{"w": state["w"] + 1.0, "epoch": epoch}}
+    ck.save(state, epoch)
+
+final = ck.restore()
+assert int(final["epoch"]) == 5, final
+np.testing.assert_allclose(np.asarray(final["w"]), np.full((4,), 6.0))
+open(r"{marker}", "w").close()
+"""
+
+
+def _run_launch(tmp_path, extra_env=None, max_restarts="1"):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(TRAIN_BODY).format(
+        root=str(tmp_path / "ckpts"), marker=str(tmp_path / "done")))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restarts", max_restarts,
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+
+
+def test_kill_mid_training_autoresumes_from_verified_checkpoint(tmp_path):
+    """Worker killed at epoch 3 (PT_FAULTS kill); the relaunch must
+    restore epoch 2's verified state and finish epochs 3..5 — final
+    state identical to an uninterrupted run."""
+    r = _run_launch(tmp_path,
+                    extra_env={"PT_FAULTS": "train.step:kill:after=3"})
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    assert (tmp_path / "done").exists()
+    assert "restart 1/1" in r.stderr
+    # epochs 0..2 came from attempt 0, 3..5 from the resumed attempt
+    assert sorted(os.listdir(tmp_path / "ckpts" / "job")) == [
+        "epoch_2", "epoch_3", "epoch_4", "epoch_5"]
+
+
+def test_corrupt_newest_checkpoint_falls_back_without_intervention(
+        tmp_path):
+    """Run to completion, corrupt the newest checkpoint's shard (disk
+    rot while the job was down), rerun: restore must skip the damaged
+    epoch_5, fall back to epoch_4, and re-train epoch 5 — no operator
+    action, final state still correct."""
+    import glob
+    r1 = _run_launch(tmp_path, max_restarts="0")
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    (tmp_path / "done").unlink()
+    shard, = glob.glob(str(tmp_path / "ckpts/job/epoch_5/data/*.npy"))
+    with open(shard, "r+b") as f:
+        f.truncate(8)
+    r2 = _run_launch(tmp_path, max_restarts="0")
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert (tmp_path / "done").exists()
+    assert "falling back" in r2.stderr
+
+
+def test_kill_during_commit_orphan_tmp_is_gcd_on_resume(tmp_path):
+    """Kill between save_state(tmp) and the commit rename (site
+    ckpt.tmp_saved): epoch 2's .tmp dir is orphaned; the relaunch must
+    GC it, resume from the last committed epoch, and finish."""
+    r = _run_launch(tmp_path,
+                    extra_env={"PT_FAULTS": "ckpt.tmp_saved:kill:after=2"})
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    assert (tmp_path / "done").exists()
+    assert "GC'd orphaned .tmp_epoch_2" in r.stderr
+    leftovers = [d for d in os.listdir(tmp_path / "ckpts" / "job")
+                 if d.startswith(".tmp_")]
+    assert leftovers == []

@@ -1,0 +1,286 @@
+"""Resilience subsystem: RetryPolicy/Deadline semantics, fault-injection
+harness determinism, deadline-guarded store ops, collective watchdog
+stall detection, and p2p recv timeout rollback (ISSUE 2 tentpole)."""
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import native, stats
+from paddle_tpu.distributed import resilience
+from paddle_tpu.distributed.resilience import (
+    CollectiveStallError, CollectiveWatchdog, Deadline, DeadlineExceeded,
+    RetryPolicy, store_get, with_deadline)
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+needs_native = pytest.mark.skipif(not native.is_available(),
+                                  reason="native toolchain unavailable")
+
+
+# -- Deadline / RetryPolicy --------------------------------------------------
+
+def test_deadline_budget_and_expiry():
+    dl = Deadline(0.05)
+    assert dl.budget(10.0) <= 0.05
+    assert not dl.expired
+    time.sleep(0.06)
+    assert dl.expired
+    with pytest.raises(DeadlineExceeded, match="frob"):
+        dl.check("frob")
+    # unbounded deadline never expires and passes the want through
+    un = Deadline(None)
+    assert un.remaining() is None and not un.expired
+    assert un.budget(7.0) == 7.0
+
+
+def test_retry_policy_recovers_after_transient_failures():
+    stats.reset("resilience/")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.001, jitter=0.0,
+                        deadline=5.0)
+    assert policy.run(flaky, op="unit") == "ok"
+    assert calls["n"] == 3
+    assert stats.get("resilience/retries") == 2
+    assert stats.get("resilience/unit/retries") == 2
+
+
+def test_retry_policy_exhausts_attempts():
+    policy = RetryPolicy(max_attempts=3, base_delay=0.001, deadline=5.0)
+    with pytest.raises(TimeoutError):
+        policy.run(lambda: (_ for _ in ()).throw(TimeoutError("always")),
+                   op="unit")
+    assert stats.get("resilience/retries_exhausted") >= 1
+
+
+def test_retry_policy_absolute_deadline_beats_attempts():
+    """With a tiny deadline the policy must give up long before
+    max_attempts of backoff, raising DeadlineExceeded."""
+    policy = RetryPolicy(max_attempts=1000, base_delay=0.02, jitter=0.0,
+                        deadline=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        policy.run(lambda: (_ for _ in ()).throw(TimeoutError("x")),
+                   op="unit")
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_with_deadline_wrapper():
+    calls = {"n": 0}
+
+    def sometimes(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("first try fails")
+        return x * 2
+
+    guarded = with_deadline(sometimes, seconds=5.0, op="wrapped",
+                            policy=RetryPolicy(base_delay=0.001))
+    assert guarded(21) == 42
+
+
+# -- fault-injection harness -------------------------------------------------
+
+def test_faults_deterministic_after_count_window():
+    seen = []
+    with faults.inject("unit.site", "drop", after=1, count=2):
+        for _ in range(5):
+            seen.append(faults.fire("unit.site"))
+    assert seen == [None, "drop", "drop", None, None]
+    assert faults.fire("unit.site") is None  # rule removed with the ctx
+
+
+def test_faults_raise_and_env_parsing():
+    n = faults.install_from_env(
+        {"PT_FAULTS": "a.b:raise:exc=ConnectionError,after=1;c.d:delay"})
+    assert n == 2
+    assert faults.fire("a.b") is None        # index 0 < after
+    with pytest.raises(ConnectionError, match="injected"):
+        faults.fire("a.b")
+    faults.clear()
+    assert faults.fire("a.b") is None
+
+
+def test_faults_transform_corruptions():
+    payload = bytes(range(64))
+    with faults.inject("t.bits", "bitflip", offset=3, bit=2):
+        out = faults.transform("t.bits", payload)
+    assert out[3] == payload[3] ^ 4 and len(out) == 64
+    with faults.inject("t.cut", "truncate", keep=10):
+        assert faults.transform("t.cut", payload) == payload[:10]
+    import numpy as np
+    with faults.inject("t.nan", "nan"):
+        arr = faults.transform("t.nan", np.ones(4, np.float32))
+    assert np.isnan(arr).any()
+
+
+def test_faults_slot_mask():
+    import numpy as np
+    with faults.inject("t.slots", "nan", slot=2, count=1):
+        m1 = faults.slot_mask("t.slots", 4)
+        m2 = faults.slot_mask("t.slots", 4)
+    np.testing.assert_array_equal(m1, [False, False, True, False])
+    assert not m2.any()                      # count=1: one dispatch only
+
+
+def test_faults_corrupt_file(tmp_path):
+    p = tmp_path / "blob"
+    p.write_bytes(bytes(100))
+    with faults.inject("t.file", "truncate", keep=7):
+        faults.corrupt_file("t.file", str(p))
+    assert p.stat().st_size == 7
+
+
+# -- deadline-guarded store ops ---------------------------------------------
+
+@needs_native
+def test_store_get_deadline_exceeded_names_key():
+    master = native.TCPStore(is_master=True)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded, match="never/set"):
+            store_get(master, "never/set", deadline=0.3)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        master.close()
+
+
+@needs_native
+def test_store_get_retries_injected_transient_error():
+    master = native.TCPStore(is_master=True)
+    try:
+        master.set("k", b"v")
+        policy = RetryPolicy(max_attempts=5, base_delay=0.001,
+                             deadline=5.0)
+        with faults.inject("store.get", "raise", exc="ConnectionError",
+                           count=2):
+            assert store_get(master, "k", deadline=5.0,
+                             policy=policy) == b"v"
+    finally:
+        master.close()
+
+
+# -- collective watchdog -----------------------------------------------------
+
+@needs_native
+def test_watchdog_all_ranks_arrive():
+    master = native.TCPStore(is_master=True)
+    try:
+        stores = [native.TCPStore(port=master.port) for _ in range(2)]
+        wds = [CollectiveWatchdog(s, rank=r, world_size=2, group="g1",
+                                  deadline=10.0, poll=0.02)
+               for r, s in enumerate(stores)]
+        errs = []
+
+        def run(r):
+            try:
+                for _ in range(3):
+                    with wds[r].guard("allreduce"):
+                        pass
+            except Exception as e:        # surfaced to the main thread
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=30) for t in ts]
+        assert not errs
+        assert stats.get("resilience/watchdog_syncs") >= 6
+        for s in stores:
+            s.close()
+    finally:
+        master.close()
+
+
+@needs_native
+def test_watchdog_names_stalled_rank():
+    """Rank 1 never enters the guarded collective: rank 0 must raise
+    CollectiveStallError naming rank 1 within the deadline instead of
+    hanging (the acceptance criterion)."""
+    master = native.TCPStore(is_master=True)
+    try:
+        s0 = native.TCPStore(port=master.port)
+        wd0 = CollectiveWatchdog(s0, rank=0, world_size=2, group="g2",
+                                 deadline=1.0, poll=0.02)
+        t0 = time.monotonic()
+        with pytest.raises(CollectiveStallError) as ei:
+            with wd0.guard("barrier"):
+                pass
+        assert time.monotonic() - t0 < 10.0
+        assert ei.value.stalled_ranks == (1,)
+        assert "rank(s) [1]" in str(ei.value)
+        assert stats.get("resilience/watchdog_stalls") >= 1
+        s0.close()
+    finally:
+        master.close()
+
+
+@needs_native
+def test_watchdog_straggler_within_deadline_passes():
+    """A rank delayed (injected straggle) but inside the deadline must
+    NOT trip the watchdog."""
+    master = native.TCPStore(is_master=True)
+    try:
+        stores = [native.TCPStore(port=master.port) for _ in range(2)]
+        wds = [CollectiveWatchdog(s, rank=r, world_size=2, group="g3",
+                                  deadline=10.0, poll=0.02)
+               for r, s in enumerate(stores)]
+        errs = []
+
+        def slow_rank():
+            try:
+                time.sleep(0.3)
+                with wds[1].guard("ar"):
+                    pass
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=slow_rank)
+        t.start()
+        with wds[0].guard("ar"):
+            pass
+        t.join(timeout=30)
+        assert not errs
+        for s in stores:
+            s.close()
+    finally:
+        master.close()
+
+
+# -- p2p recv timeout rollback (satellite regression) ------------------------
+
+@needs_native
+def test_p2p_recv_timeout_rolls_back_and_recovers(tmp_path):
+    """A timed-out recv must roll its sequence claim back (stat bumped
+    exactly once) and a subsequent recv still receives messages in
+    order — exercised over real processes via tests/_fault_worker.py."""
+    import multiprocessing as mp
+    import os
+    import _fault_worker
+
+    ctx = mp.get_context("spawn")
+    # pid-derived: a previous aborted run's TIME_WAIT socket must not
+    # collide with this run's store port
+    port = 25300 + (os.getpid() % 400) * 2
+    procs = [ctx.Process(target=_fault_worker.recv_timeout_worker,
+                         args=(r, port, str(tmp_path)))
+             for r in range(2)]
+    try:
+        [p.start() for p in procs]
+        [p.join(timeout=120) for p in procs]
+        assert all(p.exitcode == 0 for p in procs), \
+            [(p.pid, p.exitcode) for p in procs]
+        assert os.path.exists(tmp_path / "ok0")
+        assert os.path.exists(tmp_path / "ok1")
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()

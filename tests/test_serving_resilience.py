@@ -1,0 +1,149 @@
+"""Serving-side degradation (ISSUE 2 tentpole, part 4): per-request
+deadlines and the non-finite-logit guard must evict ONLY the affected
+request — batch peers keep decoding and produce exactly the tokens an
+undisturbed run produces."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import stats
+from paddle_tpu.inference.decode_engine import DecodeEngine
+from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+from paddle_tpu.models import gpt
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+
+def _model(max_seq=256):
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=max_seq, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    return gpt.GPT(cfg, seed=0)
+
+
+def _reference_tokens(model, prompt, n_new):
+    toks = jnp.asarray(np.asarray(prompt, np.int32)[None])
+    out = model.generate(toks, max_new_tokens=n_new,
+                         max_len=len(prompt) + n_new)
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+@pytest.mark.parametrize("engine_cls", ["contiguous", "paged"])
+def test_expired_deadline_evicts_only_that_request(engine_cls):
+    model = _model()
+    if engine_cls == "contiguous":
+        eng = DecodeEngine(model, max_slots=4, max_len=128)
+    else:
+        eng = PagedDecodeEngine(model, n_pages=16, max_slots=4)
+    stats.reset("serve/")
+    rs = np.random.RandomState(0)
+    p_ok = list(rs.randint(0, 96, size=5))
+    p_dead = list(rs.randint(0, 96, size=5))
+    r_ok = eng.submit(p_ok, max_new_tokens=6)
+    r_dead = eng.submit(p_dead, max_new_tokens=6, deadline_s=0.0)
+    eng.run()
+    assert r_dead.done and r_dead.failed
+    assert "deadline" in r_dead.error
+    assert r_dead.tokens == []
+    assert r_ok.done and not r_ok.failed
+    assert r_ok.tokens == _reference_tokens(model, p_ok, 6)
+    assert stats.get("serve/deadline_evictions") == 1
+
+
+def test_live_request_deadline_evicts_mid_flight():
+    """A request whose deadline passes AFTER admission is evicted on
+    the next step; its slot frees for waiting work."""
+    model = _model()
+    eng = DecodeEngine(model, max_slots=1, max_len=128)
+    rs = np.random.RandomState(1)
+    r1 = eng.submit(list(rs.randint(0, 96, size=4)), max_new_tokens=50,
+                    deadline_s=1e-4)
+    r2 = eng.submit(list(rs.randint(0, 96, size=4)), max_new_tokens=3)
+    eng.step()            # admits r1 (deadline checked at NEXT entry)
+    import time
+    time.sleep(0.01)
+    eng.run()
+    assert r1.failed and "deadline" in r1.error
+    assert r2.done and not r2.failed and len(r2.tokens) == 3
+
+
+@pytest.mark.parametrize("chunk", [1, 4])
+def test_poisoned_logits_evict_only_poisoned_request(chunk):
+    model = _model()
+    rs = np.random.RandomState(2)
+    p0 = list(rs.randint(0, 96, size=5))
+    p1 = list(rs.randint(0, 96, size=7))
+    stats.reset("serve/")
+    eng = DecodeEngine(model, max_slots=2, max_len=128,
+                       steps_per_call=chunk)
+    r0 = eng.submit(p0, max_new_tokens=6)
+    r1 = eng.submit(p1, max_new_tokens=6)
+    eng.step()            # both admitted + first decode dispatch, clean
+    with faults.inject("engine.poison_logits", "nan", slot=1, count=1):
+        eng.step()        # slot 1's logits go NaN this dispatch
+    eng.run()
+    assert r1.failed and r1.error == "non-finite logits"
+    assert r0.done and not r0.failed
+    assert r0.tokens == _reference_tokens(model, p0, 6)
+    assert stats.get("serve/nonfinite_evictions") == 1
+    # the poisoned request emitted nothing from the bad dispatch on
+    assert len(r1.tokens) < 6
+
+
+def test_poisoned_logits_paged_engine():
+    model = _model()
+    rs = np.random.RandomState(3)
+    p0 = list(rs.randint(0, 96, size=5))
+    p1 = list(rs.randint(0, 96, size=6))
+    stats.reset("serve/")
+    eng = PagedDecodeEngine(model, n_pages=16, max_slots=2)
+    r0 = eng.submit(p0, max_new_tokens=6)
+    r1 = eng.submit(p1, max_new_tokens=6)
+    free_before = None
+    eng.step()
+    free_before = eng.free_pages
+    with faults.inject("engine.poison_logits", "nan", slot=1, count=1):
+        eng.step()
+    assert r1.failed and r1.error == "non-finite logits"
+    eng.run()
+    assert r0.done and not r0.failed
+    assert r0.tokens == _reference_tokens(model, p0, 6)
+    assert stats.get("serve/nonfinite_evictions") == 1
+    # the evicted request's pages went back to the pool
+    assert eng.free_pages > free_before
+
+
+def test_poisoned_logits_speculative_path():
+    model = _model()
+    rs = np.random.RandomState(4)
+    p0 = list(rs.randint(0, 96, size=5))
+    p1 = list(rs.randint(0, 96, size=5))
+    stats.reset("serve/")
+    eng = DecodeEngine(model, max_slots=2, max_len=128, speculative_k=3)
+    r0 = eng.submit(p0, max_new_tokens=6)
+    r1 = eng.submit(p1, max_new_tokens=6)
+    eng.step()
+    with faults.inject("engine.poison_logits", "nan", slot=1, count=1):
+        eng.step()
+    eng.run()
+    assert r1.failed and r1.error == "non-finite logits"
+    assert r0.done and not r0.failed
+    assert r0.tokens == _reference_tokens(model, p0, 6)
+
+
+def test_clean_run_unaffected_by_guards():
+    """With no faults and no deadlines the guards must be inert: exact
+    parity with gpt.generate, zero degradation counters."""
+    model = _model()
+    stats.reset("serve/")
+    eng = DecodeEngine(model, max_slots=2, max_len=128)
+    rs = np.random.RandomState(5)
+    prompts = [list(rs.randint(0, 96, size=n)) for n in (3, 8)]
+    reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.run()
+    for req, p in zip(reqs, prompts):
+        assert not req.failed
+        assert req.tokens == _reference_tokens(model, p, 5)
+    assert stats.get("serve/deadline_evictions") == 0
+    assert stats.get("serve/nonfinite_evictions") == 0

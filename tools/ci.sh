@@ -3,10 +3,22 @@
 # full suite — including the bench smoke tests that execute every bench_*
 # code path on tiny shapes — and fail on any red. Run this before every
 # snapshot/commit ritual.
+#
+#   tools/ci.sh            full suite
+#   tools/ci.sh faults     fast fault-injection smoke: only the resilience /
+#                          fault-injection tests (pytest -m faults), tier-1
+#                          compatible (CPU, 'not slow') — proves every
+#                          recovery path still recovers in a couple minutes
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+if [[ "${1:-}" == "faults" ]]; then
+    shift
+    exec python -m pytest tests/ -q -m "faults and not slow" \
+        --durations=10 -p no:cacheprovider "$@"
+fi
 
 python -m pytest tests/ -q --durations=15 "$@"
