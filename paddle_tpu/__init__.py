@@ -20,9 +20,16 @@ import jax as _jax
 
 if not hasattr(_jax, "shard_map"):
     # jax < 0.5 ships shard_map under experimental only; the framework
-    # targets the stable `jax.shard_map` spelling
+    # targets the stable `jax.shard_map` spelling, including the renamed
+    # replication-check kwarg (check_vma, formerly check_rep)
     from jax.experimental.shard_map import shard_map as _shard_map
-    _jax.shard_map = _shard_map
+
+    def _shard_map_compat(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+    _jax.shard_map = _shard_map_compat
 
 if not hasattr(_jax.lax, "axis_size"):
     # jax < 0.5: the static bound-axis size lives on the axis frame
@@ -93,6 +100,7 @@ import paddle_tpu.linalg as linalg
 import paddle_tpu.fft as fft
 import paddle_tpu.signal as signal
 import paddle_tpu.stats as stats
+import paddle_tpu.observability as observability
 import paddle_tpu.onnx as onnx
 import paddle_tpu.inference as inference
 import paddle_tpu.jit as jit  # callable module: paddle_tpu.jit(fn) / jit.to_static
@@ -113,6 +121,7 @@ __all__ = (
      "distributed", "vision", "profiler", "incubate", "static", "sparse",
      "quantization",
      "distribution", "text", "audio", "geometric", "linalg", "fft", "signal", "stats",
+     "observability",
      "onnx", "hub", "device", "reader", "dataset", "utils",
      "sysconfig", "regularizer", "batch", "version", "cost_model",
      "Tensor", "to_tensor", "is_tensor", "jit", "no_grad", "grad",

@@ -140,24 +140,38 @@ def save_state(state, path: str):
     # the last barrier (and the launcher tears the job down). A peer
     # failure that proc0 cannot see here leaves a COMMIT over missing
     # shards/sidecars — verify_checkpoint rejects that directory.
+    import time as _time
+    from paddle_tpu import stats
+    from paddle_tpu.observability import trace
+
+    t_start = _time.perf_counter()
     exc = None
-    try:
-        _save_state_local(state, path)
-    except BaseException as e:
-        exc = e
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"ckpt_save:{path}")
-    if exc is None and jax.process_index() == 0:
+    with trace.span("ckpt/save", path=os.path.basename(path)):
         try:
-            _commit(path)
+            with trace.span("ckpt/save/write_shards"), \
+                    stats.timer("ckpt/save_write"):
+                _save_state_local(state, path)
         except BaseException as e:
             exc = e
-    if jax.process_count() > 1:
-        # peers must not return before COMMIT exists, or a crash in
-        # this window would leave them believing the save completed
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"ckpt_commit_mark:{path}")
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            with trace.span("ckpt/save/barrier"):
+                multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+        if exc is None and jax.process_index() == 0:
+            try:
+                with trace.span("ckpt/save/commit"), \
+                        stats.timer("ckpt/save_commit"):
+                    _commit(path)
+            except BaseException as e:
+                exc = e
+        if jax.process_count() > 1:
+            # peers must not return before COMMIT exists, or a crash in
+            # this window would leave them believing the save completed
+            from jax.experimental import multihost_utils
+            with trace.span("ckpt/save/commit_barrier"):
+                multihost_utils.sync_global_devices(
+                    f"ckpt_commit_mark:{path}")
+    stats.observe("ckpt/save_s", _time.perf_counter() - t_start)
     if exc is not None:
         raise exc
 
@@ -353,6 +367,18 @@ def verify_checkpoint(path: str):
     COMMIT/checksums) degrade to existence checks — they were written
     before commit markers existed and must stay restorable.
     """
+    import time as _time
+    from paddle_tpu import stats
+    from paddle_tpu.observability import trace
+    with trace.span("ckpt/verify", path=os.path.basename(path)):
+        t0 = _time.perf_counter()
+        try:
+            return _verify_checkpoint_impl(path)
+        finally:
+            stats.observe("ckpt/verify_s", _time.perf_counter() - t0)
+
+
+def _verify_checkpoint_impl(path: str):
     mp = os.path.join(path, "meta.json")
     if not os.path.exists(mp):
         return False, "meta.json missing"
@@ -410,13 +436,23 @@ def load_state(path: str,
     `template` instead for name-free placement: a pytree of shardings with
     the same structure as the saved state.
     """
+    import time as _time
+    from paddle_tpu import stats
+    from paddle_tpu.observability import trace
     if verify:
         ok, reason = verify_checkpoint(path)
         if not ok:
-            from paddle_tpu import stats
             stats.add("ckpt/verify_failures")
             raise ValueError(
                 f"checkpoint {path} failed verification: {reason}")
+    t_restore = _time.perf_counter()
+    with trace.span("ckpt/restore", path=os.path.basename(path)):
+        out = _load_state_impl(path, shardings, template)
+    stats.observe("ckpt/restore_s", _time.perf_counter() - t_restore)
+    return out
+
+
+def _load_state_impl(path, shardings, template):
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     ver = meta.get("format_version", 0)

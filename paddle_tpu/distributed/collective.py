@@ -13,6 +13,8 @@ vocabulary, (b) centralize axis-name defaults.
 Inside shard_map-ed functions, `axis` accepts a mesh axis name or tuple.
 """
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -32,21 +34,45 @@ class ReduceOp:
     AVG = "avg"
 
 
+def _issue_span(name, x, axis):
+    """Observability for the collective wrappers. These run at TRACE
+    time (the op executes on-device inside the jitted program, where
+    XLA owns the clock), so the span marks when the host issued the
+    collective and tags its payload size — per-compilation, not
+    per-step; on-device durations live in the XLA trace. Byte counters
+    land in stats so an operator can attribute ICI traffic per op kind
+    (the EQuARX-style question: which collective moves what)."""
+    from paddle_tpu import stats
+    from paddle_tpu.observability import trace
+    try:
+        nbytes = int(x.size) * int(jnp.dtype(x.dtype).itemsize)
+    except Exception:
+        nbytes = 0
+    stats.add(f"collective/{name}_calls")
+    if nbytes:
+        stats.add(f"collective/{name}_bytes", nbytes)
+    if not trace.enabled():
+        return contextlib.nullcontext()
+    return trace.span(f"collective/{name}", axis=str(axis),
+                      bytes=nbytes)
+
+
 def all_reduce(x, op=ReduceOp.SUM, axis="dp"):
     """ref: paddle.distributed.all_reduce → c_allreduce_{sum,max,min,prod}
     (operators/collective/c_allreduce_*). Must run inside shard_map/pjit."""
-    if op == ReduceOp.SUM:
-        return lax.psum(x, axis)
-    if op == ReduceOp.MAX:
-        return lax.pmax(x, axis)
-    if op == ReduceOp.MIN:
-        return lax.pmin(x, axis)
-    if op == ReduceOp.AVG:
-        return lax.pmean(x, axis)
-    if op == ReduceOp.PROD:
-        # gather-then-multiply: sign-correct for negatives/zeros (an
-        # exp(psum(log)) trick would NaN on non-positive elements)
-        return jnp.prod(lax.all_gather(x, axis), axis=0)
+    with _issue_span("all_reduce", x, axis):
+        if op == ReduceOp.SUM:
+            return lax.psum(x, axis)
+        if op == ReduceOp.MAX:
+            return lax.pmax(x, axis)
+        if op == ReduceOp.MIN:
+            return lax.pmin(x, axis)
+        if op == ReduceOp.AVG:
+            return lax.pmean(x, axis)
+        if op == ReduceOp.PROD:
+            # gather-then-multiply: sign-correct for negatives/zeros (an
+            # exp(psum(log)) trick would NaN on non-positive elements)
+            return jnp.prod(lax.all_gather(x, axis), axis=0)
     raise ValueError(op)
 
 
@@ -59,27 +85,31 @@ ppermute = lax.ppermute
 
 def all_gather(x, axis="dp", tiled_axis=0):
     """ref: c_allgather (operators/collective/c_allgather_op.cc)."""
-    return lax.all_gather(x, axis, axis=tiled_axis, tiled=True)
+    with _issue_span("all_gather", x, axis):
+        return lax.all_gather(x, axis, axis=tiled_axis, tiled=True)
 
 
 def reduce_scatter(x, axis="dp", scatter_axis=0):
     """ref: c_reducescatter."""
-    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
-                            tiled=True)
+    with _issue_span("reduce_scatter", x, axis):
+        return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                                tiled=True)
 
 
 def all_to_all(x, axis="ep", split_axis=0, concat_axis=0):
     """ref: alltoall op / global_scatter+global_gather MoE dispatch
     (operators/collective/global_scatter_op.cc)."""
-    return lax.all_to_all(x, axis, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    with _issue_span("all_to_all", x, axis):
+        return lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(x, src=0, axis="dp"):
     """ref: c_broadcast. Select src's shard and replicate."""
-    idx = lax.axis_index(axis)
-    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
-    return lax.psum(masked, axis)
+    with _issue_span("broadcast", x, axis):
+        idx = lax.axis_index(axis)
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis)
 
 
 def axis_index(axis):
@@ -100,10 +130,19 @@ def send_recv_ring(x, axis="pp", shift=1):
 
 def barrier(axis=None):
     """ref: barrier op. Inside SPMD programs ordering is data-flow-driven;
-    host-level barrier syncs all host processes."""
+    host-level barrier syncs all host processes. The span times the REAL
+    host-side wait — a straggling rank shows up as one long barrier lane
+    on every healthy rank's timeline."""
     if axis is None:
+        import time as _time
         import jax.experimental.multihost_utils as mhu
-        mhu.sync_global_devices("paddle_tpu_barrier")
+        from paddle_tpu import stats
+        from paddle_tpu.observability import trace
+        with trace.span("collective/barrier"):
+            t0 = _time.perf_counter()
+            mhu.sync_global_devices("paddle_tpu_barrier")
+            stats.observe("collective/barrier_s",
+                          _time.perf_counter() - t0)
 
 
 class Group:

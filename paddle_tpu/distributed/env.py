@@ -43,6 +43,10 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
         num_processes = num_processes or int(os.environ["PT_NUM_PROCESSES"])
         process_id = process_id if process_id is not None else int(
             os.environ["PT_PROCESS_ID"])
+        # observability rank tagging (trace pid lanes, stats.export rank)
+        # reads PT_PROCESS_ID — publish it for callers that passed
+        # process_id explicitly instead of via the launch env contract
+        os.environ.setdefault("PT_PROCESS_ID", str(process_id))
         if setup_deadline is None:
             setup_deadline = float(os.environ.get("PT_INIT_DEADLINE", 120))
 

@@ -18,6 +18,14 @@ Env contract written for each worker (read by env.init_parallel_env):
     PT_PROCESS_ID      global rank of this worker
     PT_LOCAL_RANK      rank within this node
     PT_NNODES          node count
+
+Observability contract (docs/observability.md): with PT_TRACE_DIR set
+on the launcher, each worker gets PT_TRACE_FILE =
+``$PT_TRACE_DIR/trace_rank{rank}.json`` and on exit the launcher merges
+every rank file into ``trace_merged.json`` — one Perfetto timeline with
+a lane per rank. With PT_STATSZ_PORT set, worker rank r serves its live
+statsz on ``base + 1 + r`` (the launcher itself holds ``base``), so a
+node's whole worker group is scrapeable from adjacent ports.
 """
 
 import argparse
@@ -31,6 +39,43 @@ import time
 __all__ = ["launch", "main"]
 
 ELASTIC_EXIT_CODE = 101  # ≙ fleet/elastic/manager.py:32
+
+
+def _obs_env(rank):
+    """Per-rank observability env (module docstring: per-rank trace
+    file; statsz at base + 1 + rank so worker 0 never collides with the
+    launcher's own server on base)."""
+    out = {}
+    tdir = os.environ.get("PT_TRACE_DIR")
+    if tdir:
+        out["PT_TRACE_FILE"] = os.path.join(
+            tdir, f"trace_rank{rank}.json")
+    base = os.environ.get("PT_STATSZ_PORT")
+    if base:
+        try:
+            out["PT_STATSZ_PORT"] = str(int(base) + 1 + rank)
+        except ValueError:
+            pass
+    return out
+
+
+def _merge_traces_on_exit():
+    """Fold every rank's trace file in PT_TRACE_DIR into ONE Perfetto
+    timeline (trace_merged.json, rank → pid lane). Runs after the
+    worker group exits; a worker that died before exporting simply
+    contributes no lane — merging must never mask the job's own exit
+    code, so failures only warn."""
+    tdir = os.environ.get("PT_TRACE_DIR")
+    if not tdir:
+        return
+    try:
+        from paddle_tpu.observability import merge
+        out = merge.merge_rank_traces(tdir)
+        if out:
+            print(f"[launch] merged rank traces -> {out}",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"[launch] trace merge failed: {e}", file=sys.stderr)
 
 
 def _parse(argv):
@@ -89,6 +134,7 @@ def _spawn(args, local_rank, rank=None, world=None, extra_env=None):
         "PT_LOCAL_RANK": str(local_rank),
         "PT_NNODES": str(args.nnodes),
     })
+    env.update(_obs_env(rank))
     if extra_env:
         env.update(extra_env)
     cmd = [sys.executable, args.training_script,
@@ -335,8 +381,31 @@ def _master_wait_members(store, table, version, reform_seen,
 
 def launch(argv):
     args = _parse(argv)
-    if args.elastic:
-        return _launch_elastic(args)
+    tdir = os.environ.get("PT_TRACE_DIR")
+    if tdir:
+        # the launcher itself has no PT_PROCESS_ID: its atexit export
+        # would land on trace_rank0.json and clobber worker 0's file —
+        # repoint it to a launcher-named lane file
+        from paddle_tpu.observability import trace as _trace
+        _trace._TRACER.out_path = os.path.join(tdir,
+                                               "trace_launcher.json")
+        # a reused trace dir must not leak a previous (possibly larger)
+        # run's rank files into this run's merge as ghost lanes
+        import glob as _glob
+        for stale in _glob.glob(os.path.join(tdir, "trace_rank*.json")):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+    try:
+        if args.elastic:
+            return _launch_elastic(args)
+        return _launch_static(args)
+    finally:
+        _merge_traces_on_exit()
+
+
+def _launch_static(args):
     attempt = 0
     while True:
         # PT_RESTART_ATTEMPT is the auto-resume contract: workers (re)started

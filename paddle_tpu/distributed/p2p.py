@@ -147,8 +147,10 @@ def send(tensor, dst: int, _seq=None, deadline: Optional[float] = 30.0):
     retry with backoff under ``deadline``; a dropped-message fault
     (site ``p2p.send``) skips the wire write so receiver-side timeout
     recovery can be exercised deterministically."""
+    import time as _time
     from paddle_tpu import stats
     from paddle_tpu.distributed import resilience
+    from paddle_tpu.observability import trace
     from paddle_tpu.testing import faults
     st = _require()
     # the drop check must precede the seq claim: a dropped send that
@@ -161,12 +163,17 @@ def send(tensor, dst: int, _seq=None, deadline: Optional[float] = 30.0):
         return
     seq = _next_send_seq(st, dst) if _seq is None else _seq
     h, p = st.peers[dst]
-    payload = _pack(tensor)
-    resilience.DEFAULT_POLICY.run(
-        lambda: st.endpoint.send(h, p, _tag(st.rank, dst, seq), payload),
-        op="p2p_send",
-        retry_on=(ConnectionError,),
-        deadline=resilience.Deadline(deadline))
+    with trace.span("p2p/send", dst=dst, seq=seq) as sp:
+        payload = _pack(tensor)
+        sp.attrs["bytes"] = len(payload)
+        t0 = _time.perf_counter()
+        resilience.DEFAULT_POLICY.run(
+            lambda: st.endpoint.send(h, p, _tag(st.rank, dst, seq),
+                                     payload),
+            op="p2p_send",
+            retry_on=(ConnectionError,),
+            deadline=resilience.Deadline(deadline))
+        stats.observe("p2p/send_s", _time.perf_counter() - t0)
     stats.add("p2p/send_msgs")              # §5.5 (≙ monitor.h STAT_ADD)
     stats.add("p2p/send_bytes", len(payload))
 
@@ -182,11 +189,16 @@ def recv(tensor=None, src: int = 0, timeout: float = 120.0):
     # claim a DISTINCT seq per call (concurrent irecvs must not share a
     # tag); on timeout, roll the claim back if no later recv claimed past
     # us, so a retry still matches the sender's sequence
+    import time as _time
+    from paddle_tpu.observability import trace
     with _lock:
         seq = st.recv_seq.get(src, 0) + 1
         st.recv_seq[src] = seq
+    t0 = _time.perf_counter()
     try:
-        payload = st.endpoint.recv(_tag(src, st.rank, seq), timeout)
+        with trace.span("p2p/recv", src=src, seq=seq) as sp:
+            payload = st.endpoint.recv(_tag(src, st.rank, seq), timeout)
+            sp.attrs["bytes"] = len(payload)
     except TimeoutError:
         with _lock:
             if st.recv_seq.get(src) == seq:
@@ -195,6 +207,7 @@ def recv(tensor=None, src: int = 0, timeout: float = 120.0):
         stats.add("p2p/recv_timeouts")
         raise
     from paddle_tpu import stats
+    stats.observe("p2p/recv_s", _time.perf_counter() - t0)
     stats.add("p2p/recv_msgs")
     stats.add("p2p/recv_bytes", len(payload))
     out = _unpack(payload)
@@ -234,16 +247,20 @@ def all_gather_object(obj_list, obj, timeout: float = 120.0):
     the honest transport for objects."""
     import json
 
+    from paddle_tpu.observability import trace
+
     st = _require()
     # per-call round id: a LOCAL counter — every rank calls
     # all_gather_object collectively, so local counts agree
     st.ago_round += 1
     key = st.ago_round
-    st.store.set(f"p2p/ago/{key}/{st.rank}", json.dumps(obj).encode())
-    del obj_list[:]
-    for r in range(st.world):
-        raw = st.store.get(f"p2p/ago/{key}/{r}", timeout=timeout)
-        obj_list.append(json.loads(raw.decode()))
+    with trace.span("p2p/all_gather_object", round=key):
+        st.store.set(f"p2p/ago/{key}/{st.rank}",
+                     json.dumps(obj).encode())
+        del obj_list[:]
+        for r in range(st.world):
+            raw = st.store.get(f"p2p/ago/{key}/{r}", timeout=timeout)
+            obj_list.append(json.loads(raw.decode()))
     return obj_list
 
 

@@ -292,16 +292,23 @@ class Model:
             for step, batch in enumerate(train_loader):
                 x, y = batch[0], batch[1]
                 cbks.on_batch_begin("train", step, {})
-                res = self.train_batch(
-                    x, y,
-                    update=(step + 1) % accumulate_grad_batches == 0)
+                from paddle_tpu import stats
+                from paddle_tpu.observability import trace
+                t_step = time.perf_counter()
+                with trace.span("train/step", epoch=epoch, step=step):
+                    res = self.train_batch(
+                        x, y,
+                        update=(step + 1) % accumulate_grad_batches == 0)
+                dt = time.perf_counter() - t_step
                 loss = res[0] if isinstance(res, list) else res
                 logs = {"loss": loss, "step": step}
                 cbks.on_batch_end("train", step, logs)
-                from paddle_tpu import stats
                 stats.add("hapi/train_steps", 1)
                 stats.add("hapi/train_samples", _batch_len(x))
                 stats.set_value("hapi/last_loss", float(loss))
+                stats.observe("train/step_s", dt)
+                stats.set_value("train/ips", _batch_len(x) / dt
+                                if dt > 0 else 0.0)
                 it_count += 1
                 if num_iters is not None and it_count >= num_iters:
                     break

@@ -119,12 +119,18 @@ class Request:
     the engine; past it the scheduler evicts ONLY this request (slot
     freed, batch peers unaffected) with ``error`` set. ``error`` is also
     set when the non-finite-logit guard evicts a poisoned request —
-    callers must check it before trusting ``tokens``."""
+    callers must check it before trusting ``tokens``.
+
+    ``t_submit``/``t_first`` (perf_counter seconds, set by the engine)
+    carry the serving-latency bookkeeping: TTFT = t_first - t_submit
+    lands in the ``serve/ttft_s`` histogram, and the completed request's
+    submit→done lifetime is recorded as a ``serve/request`` trace span."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "tokens", "done",
-                 "deadline", "error")
+                 "deadline", "error", "t_submit", "t_first", "_obs_ended")
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline=None):
+        import time
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -132,6 +138,15 @@ class Request:
         self.done = False
         self.deadline = deadline      # absolute time.monotonic() budget
         self.error: Optional[str] = None
+        self.t_submit = time.perf_counter()
+        self.t_first: Optional[float] = None
+        self._obs_ended = False
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Queue wait + prefill up to the first generated token."""
+        return (None if self.t_first is None
+                else self.t_first - self.t_submit)
 
     @property
     def failed(self) -> bool:
@@ -146,7 +161,12 @@ class ResilientScheduler:
     """Shared degradation bookkeeping for the serving engines: evict ONE
     request (deadline overrun or non-finite logits) without disturbing
     its batch peers. Engines override `_on_evict` to reclaim their own
-    per-slot resources (the paged engine returns the slot's pages)."""
+    per-slot resources (the paged engine returns the slot's pages).
+
+    Also the shared serving-observability surface (docs/observability.md
+    ``serve/*``): per-request TTFT and lifetime, per-step queue depth and
+    batch occupancy, per-token latency — the numbers a serving operator
+    scrapes to answer "what is p99 TTFT and are we admission-bound"."""
 
     def _on_evict(self, slot: int):
         self.active = self.active.at[slot].set(False)
@@ -160,6 +180,40 @@ class ResilientScheduler:
             self._slot_req[slot] = None
             self._on_evict(slot)
         stats.add(stat)
+        self._obs_request_end(req)
+
+    # -- serving metrics (shared by both engines) ---------------------------
+    def _obs_first_token(self, req: Request):
+        """Called at the request's FIRST generated token."""
+        import time
+        from paddle_tpu import stats
+        if req.t_first is None:
+            req.t_first = time.perf_counter()
+            stats.observe("serve/ttft_s", req.t_first - req.t_submit)
+
+    def _obs_request_end(self, req: Request):
+        """Request left the engine (done or evicted): close its span —
+        an after-the-fact submit→now interval on the rank timeline.
+        Idempotent: eviction and retirement may both see the request."""
+        from paddle_tpu.observability import trace
+        if req._obs_ended:
+            return
+        req._obs_ended = True
+        trace.complete("serve/request", req.t_submit,
+                       prompt=len(req.prompt), tokens=len(req.tokens),
+                       error=req.error)
+
+    def _obs_step(self, t0: float, emitted: int, live: int):
+        """Per-step serving telemetry: queue depth / batch occupancy
+        histograms and the per-token latency histogram (step wall time
+        amortized over the tokens it emitted)."""
+        import time
+        from paddle_tpu import stats
+        stats.observe("serve/queue_depth", len(self._waiting))
+        stats.observe("serve/batch_occupancy", live / max(1, self.S))
+        if emitted > 0:
+            stats.observe("serve/token_s",
+                          (time.perf_counter() - t0) / emitted)
 
     def _evict_expired(self):
         """Deadline sweep (queue + live slots) run at each step entry."""
@@ -667,77 +721,93 @@ class DecodeEngine(ResilientScheduler):
         return None
 
     def _admit(self, req: Request, slot: int):
+        from paddle_tpu.observability import trace
         prompt = np.asarray(req.prompt, np.int32)
         total = len(prompt)
         start = 0
-        while start < total:
-            remaining = total - start
-            bucket = next((x for x in self.buckets if x >= remaining),
-                          self.buckets[-1])
-            s0 = start
-            if s0 + bucket > self.T:
-                # tail window would overrun the cache: slide it back over
-                # already-prefilled positions — same tokens at the same
-                # positions recompute the identical K/V, so the overlapped
-                # rewrite is a no-op and the write stays in bounds
-                s0 = self.T - bucket
-            n = min(total - s0, bucket)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :n] = prompt[s0:s0 + n]
-            is_final = s0 + n >= total
-            (self.kc, self.vc, self.toks, self.lengths, self.last,
-             self.active, self._rng) = self._prefill_fn(
-                self._head, self._stacked, self.kc, self.vc, self.toks,
-                self.lengths, self.last, self.active, jnp.int32(slot),
-                jnp.asarray(padded), jnp.int32(s0), jnp.int32(total),
-                jnp.asarray(is_final), self._rng)
-            start = s0 + n
+        with trace.span("serve/admit", slot=slot, prompt=total):
+            while start < total:
+                remaining = total - start
+                bucket = next((x for x in self.buckets if x >= remaining),
+                              self.buckets[-1])
+                s0 = start
+                if s0 + bucket > self.T:
+                    # tail window would overrun the cache: slide it back
+                    # over already-prefilled positions — same tokens at the
+                    # same positions recompute the identical K/V, so the
+                    # overlapped rewrite is a no-op and the write stays in
+                    # bounds
+                    s0 = self.T - bucket
+                n = min(total - s0, bucket)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :n] = prompt[s0:s0 + n]
+                is_final = s0 + n >= total
+                with trace.span("serve/prefill", bucket=bucket):
+                    (self.kc, self.vc, self.toks, self.lengths, self.last,
+                     self.active, self._rng) = self._prefill_fn(
+                        self._head, self._stacked, self.kc, self.vc,
+                        self.toks, self.lengths, self.last, self.active,
+                        jnp.int32(slot), jnp.asarray(padded),
+                        jnp.int32(s0), jnp.int32(total),
+                        jnp.asarray(is_final), self._rng)
+                start = s0 + n
         self._slot_req[slot] = req
         # the prefill's sampled token is the first generated token
         self._emit(slot, req, int(np.asarray(self.last)[slot]))
 
     def _emit(self, slot: int, req: Request, token: int):
         req.tokens.append(token)
+        self._obs_first_token(req)
         hit_eos = req.eos_id is not None and token == req.eos_id
         if hit_eos or len(req.tokens) >= req.max_new_tokens:
             req.done = True
             self._slot_req[slot] = None
             self.active = self.active.at[slot].set(False)
+            self._obs_request_end(req)
 
     def step(self) -> int:
         """Evict past-deadline requests, admit what fits, then advance
         every active slot (one token, or up to K with speculative
         decoding). Returns tokens emitted."""
-        self._evict_expired()
-        while self._waiting:
-            slot = self._free_slot()
-            if slot is None:
-                break
-            self._admit(self._waiting.popleft(), slot)
-        live = [(s, r) for s, r in enumerate(self._slot_req)
-                if r is not None]
-        if not live:
-            return 0
-        self.steps += 1
-        if self.spec_k:
-            n = self._spec_step(live)
-        elif self.chunk > 1:
-            n = self._chunk_step(live)
-        else:
-            (self.kc, self.vc, self.lengths, self.last,
-             self._rng, bad) = self._step_fn(
-                self._head, self._stacked, self.kc, self.vc, self.lengths,
-                self.last, self.active, self._rng, self._poison_mask())
-            emitted = np.asarray(self.last)
-            bad = np.asarray(bad)
-            n = 0
-            for slot, req in live:
-                if bad[slot]:
-                    self._fail(req, "non-finite logits", slot=slot,
-                               stat="serve/nonfinite_evictions")
-                else:
-                    self._emit(slot, req, int(emitted[slot]))
-                    n += 1
+        import time
+        from paddle_tpu.observability import trace
+        t0 = time.perf_counter()
+        with trace.span("serve/step") as sp:
+            self._evict_expired()
+            while self._waiting:
+                slot = self._free_slot()
+                if slot is None:
+                    break
+                self._admit(self._waiting.popleft(), slot)
+            live = [(s, r) for s, r in enumerate(self._slot_req)
+                    if r is not None]
+            if not live:
+                return 0
+            self.steps += 1
+            if self.spec_k:
+                n = self._spec_step(live)
+            elif self.chunk > 1:
+                n = self._chunk_step(live)
+            else:
+                with trace.span("serve/dispatch", kind="single"):
+                    (self.kc, self.vc, self.lengths, self.last,
+                     self._rng, bad) = self._step_fn(
+                        self._head, self._stacked, self.kc, self.vc,
+                        self.lengths, self.last, self.active, self._rng,
+                        self._poison_mask())
+                emitted = np.asarray(self.last)
+                bad = np.asarray(bad)
+                n = 0
+                for slot, req in live:
+                    if bad[slot]:
+                        self._fail(req, "non-finite logits", slot=slot,
+                                   stat="serve/nonfinite_evictions")
+                    else:
+                        self._emit(slot, req, int(emitted[slot]))
+                        n += 1
+            sp.attrs["active"] = len(live)
+            sp.attrs["tokens"] = n
+        self._obs_step(t0, n, len(live))
         self.tokens_emitted += n
         return n
 
@@ -760,17 +830,20 @@ class DecodeEngine(ResilientScheduler):
                     and req.tokens[-1] == req.eos_id):
                 req.done = True
                 self._slot_req[slot] = None
+                self._obs_request_end(req)
 
     def _chunk_step(self, live) -> int:
         """One dispatch advancing every live slot up to ``chunk`` tokens,
         early-stopping per slot device-side (eos / budget / non-finite
         logits — the last evicting only the poisoned request)."""
+        from paddle_tpu.observability import trace
         remaining, eos = self._marshal_limits(live)
-        (self.kc, self.vc, self.lengths, self.last, self.active,
-         _, self._rng, toks, flags, bads) = self._multi_fn(
-            self._head, self._stacked, self.kc, self.vc, self.lengths,
-            self.last, self.active, remaining, eos, self._rng,
-            self._poison_mask())
+        with trace.span("serve/dispatch", kind="chunk", chunk=self.chunk):
+            (self.kc, self.vc, self.lengths, self.last, self.active,
+             _, self._rng, toks, flags, bads) = self._multi_fn(
+                self._head, self._stacked, self.kc, self.vc, self.lengths,
+                self.last, self.active, remaining, eos, self._rng,
+                self._poison_mask())
         toks = np.asarray(toks)
         flags = np.asarray(flags)
         bads = np.asarray(bads)
@@ -790,12 +863,15 @@ class DecodeEngine(ResilientScheduler):
         """One dispatch of ``chunk`` speculative steps: drafts, verify,
         acceptance, eos/budget early-stop all on device; the host only
         replays the emitted (step, slot, count) runs into Requests."""
+        from paddle_tpu.observability import trace
         remaining, eos = self._marshal_limits(live)
-        (self.kc, self.vc, self.toks, self.lengths, self.last,
-         self.active, _, preds, effs, bads) = self._verify_fn(
-            self._head, self._stacked, self.kc, self.vc, self.toks,
-            self.lengths, self.last, self.active, remaining, eos,
-            self._poison_mask())
+        with trace.span("serve/dispatch", kind="spec", k=self.spec_k,
+                        chunk=self.chunk):
+            (self.kc, self.vc, self.toks, self.lengths, self.last,
+             self.active, _, preds, effs, bads) = self._verify_fn(
+                self._head, self._stacked, self.kc, self.vc, self.toks,
+                self.lengths, self.last, self.active, remaining, eos,
+                self._poison_mask())
         preds = np.asarray(preds)      # (chunk, S, K)
         effs = np.asarray(effs)        # (chunk, S)
         bads = np.asarray(bads)        # (chunk, S)

@@ -378,6 +378,7 @@ class PagedDecodeEngine(ResilientScheduler):
         super()._on_evict(slot)
 
     def _admit(self, req: Request, slot: int):
+        from paddle_tpu.observability import trace
         prompt = np.asarray(req.prompt, np.int32)
         n = len(prompt)
         bucket = next(b for b in self.buckets if b >= n)
@@ -396,9 +397,11 @@ class PagedDecodeEngine(ResilientScheduler):
                 segs[i, l] = (l * self.P + pid, t, run)
             t += run
             i += 1
-        self.kp, self.vp, nxt = self._prefill_fn(
-            self._head, self._stacked, self.kp, self.vp,
-            jnp.asarray(padded), jnp.int32(n), jnp.asarray(segs))
+        with trace.span("serve/admit", slot=slot, prompt=n,
+                        bucket=bucket):
+            self.kp, self.vp, nxt = self._prefill_fn(
+                self._head, self._stacked, self.kp, self.vp,
+                jnp.asarray(padded), jnp.int32(n), jnp.asarray(segs))
         self.lengths = self.lengths.at[slot].set(n)
         self.last = self.last.at[slot].set(int(nxt))
         self.active = self.active.at[slot].set(True)
@@ -407,14 +410,30 @@ class PagedDecodeEngine(ResilientScheduler):
 
     def _emit(self, slot: int, req: Request, token: int):
         req.tokens.append(token)
+        self._obs_first_token(req)
         if ((req.eos_id is not None and token == req.eos_id)
                 or len(req.tokens) >= req.max_new_tokens):
             req.done = True
             self._slot_req[slot] = None
             self._release(slot)
             self.active = self.active.at[slot].set(False)
+            self._obs_request_end(req)
 
     def step(self) -> int:
+        import time
+        from paddle_tpu.observability import trace
+        t0 = time.perf_counter()
+        with trace.span("serve/step") as sp:
+            total, n_live = self._step_inner(sp)
+        if n_live:
+            # idle polls record nothing (matching DecodeEngine): zero
+            # occupancy/queue samples from an empty engine would read
+            # as "admission-bound" on the dashboards
+            self._obs_step(t0, total, n_live)
+        return total
+
+    def _step_inner(self, sp):
+        """Returns (tokens emitted, live slot count) for the obs hooks."""
         self._evict_expired()
         while self._waiting:
             slot = self._free_slot()
@@ -438,7 +457,8 @@ class PagedDecodeEngine(ResilientScheduler):
         live = [(s, r) for s, r in enumerate(self._slot_req)
                 if r is not None]
         if not live:
-            return 0
+            return 0, 0
+        from paddle_tpu.observability import trace
         # reserve pages for the whole chunk so the table is static
         lens_host = np.asarray(self.lengths)
         for slot, req in live:
@@ -452,12 +472,14 @@ class PagedDecodeEngine(ResilientScheduler):
             if req.eos_id is not None:
                 eos[slot] = req.eos_id
         self.steps += 1
-        (self.kp, self.vp, self.lengths, self.last, self.active, _,
-         toks, flags, bads) = self._multi_fn(
-            self._head, self._stacked, self.kp, self.vp,
-            self._table_array(), self.lengths, self.last, self.active,
-            jnp.asarray(remaining), jnp.asarray(eos),
-            self._poison_mask())
+        with trace.span("serve/dispatch", kind="paged",
+                        chunk=self.chunk):
+            (self.kp, self.vp, self.lengths, self.last, self.active, _,
+             toks, flags, bads) = self._multi_fn(
+                self._head, self._stacked, self.kp, self.vp,
+                self._table_array(), self.lengths, self.last, self.active,
+                jnp.asarray(remaining), jnp.asarray(eos),
+                self._poison_mask())
         toks = np.asarray(toks)
         flags = np.asarray(flags)
         bads = np.asarray(bads)
@@ -470,8 +492,10 @@ class PagedDecodeEngine(ResilientScheduler):
             if bads[:, slot].any() and not req.done:
                 self._fail(req, "non-finite logits", slot=slot,
                            stat="serve/nonfinite_evictions")
+        sp.attrs["active"] = len(live)
+        sp.attrs["tokens"] = total
         self.tokens_emitted += total
-        return total
+        return total, len(live)
 
     def run(self) -> None:
         while self._waiting or any(r is not None for r in self._slot_req):

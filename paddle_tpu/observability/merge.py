@@ -1,0 +1,79 @@
+"""Merge per-rank Chrome-trace files into ONE Perfetto timeline.
+
+Each rank exports ``trace_rank{N}.json`` with pid = rank (trace.py), so
+merging is: concatenate every rank's ``traceEvents``, keep exactly one
+``process_name``/``process_sort_index`` metadata pair per rank, and
+write a single valid Chrome-trace document — Perfetto shows one lane
+per rank, nested host spans inside each. The launcher calls this on
+exit when ``PT_TRACE_DIR`` is set; ``tools/trace_merge.py`` is the
+offline CLI for log dirs collected from multi-host jobs.
+"""
+
+import glob
+import json
+import os
+import re
+from typing import List, Optional, Sequence
+
+__all__ = ["merge_trace_files", "merge_rank_traces", "MERGED_NAME"]
+
+MERGED_NAME = "trace_merged.json"
+_RANK_RE = re.compile(r"trace_rank(\d+)\.json$")
+
+
+def _load_events(path: str):
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    if not isinstance(evs, list):
+        raise ValueError(f"{path}: no traceEvents array")
+    return evs
+
+
+def merge_trace_files(paths: Sequence[str], out_path: str) -> str:
+    """Merge explicit per-rank trace files. A file whose events carry no
+    pid (hand-rolled traces) gets its pid inferred from the
+    ``trace_rank{N}`` filename, default 0."""
+    events = []
+    seen_meta = set()
+    for path in sorted(paths):
+        m = _RANK_RE.search(os.path.basename(path))
+        fallback_pid = int(m.group(1)) if m else 0
+        for ev in _load_events(path):
+            pid = ev.get("pid", fallback_pid)
+            ev["pid"] = pid
+            if ev.get("ph") == "M":
+                key = (pid, ev.get("name"))
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            events.append(ev)
+    # guarantee a named lane per rank even for hand-rolled inputs
+    for pid in sorted({e["pid"] for e in events}):
+        if (pid, "process_name") not in seen_meta:
+            events.insert(0, {"name": "process_name", "ph": "M",
+                              "pid": pid, "tid": 0,
+                              "args": {"name": f"rank{pid}"}})
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"merged_from": [os.path.basename(p)
+                                         for p in sorted(paths)]}}
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def merge_rank_traces(trace_dir: str,
+                      out_path: Optional[str] = None) -> Optional[str]:
+    """Merge every ``trace_rank*.json`` under ``trace_dir`` into
+    ``trace_merged.json`` (or ``out_path``). Returns None when the dir
+    holds no rank traces (nothing to merge is not an error — a worker
+    may have died before exporting)."""
+    paths: List[str] = sorted(
+        glob.glob(os.path.join(trace_dir, "trace_rank*.json")))
+    if not paths:
+        return None
+    return merge_trace_files(
+        paths, out_path or os.path.join(trace_dir, MERGED_NAME))

@@ -1,0 +1,93 @@
+"""Live /statsz endpoint: a thread HTTP server scraping tools hit for the
+process's current stats snapshot.
+
+Reference analog: the monitor-stat scrape surface (platform/monitor.h
+counters dumped by tools) crossed with the *z-page idiom (statusz/varz)
+production servers expose. Opt-in: set ``PT_STATSZ_PORT`` or call
+``start_statsz()``. Under the launch CLI the launcher holds the base
+port and worker rank r serves on ``base + 1 + r`` — a 4-worker node is
+scrapeable at base+1..base+4 (launch.py module doc).
+
+Routes:
+    /statsz         structured JSON: rank + counters/gauges/timers/
+                    histograms (the ``stats.export()`` form — directly
+                    feedable to ``stats.merge`` for cross-rank
+                    aggregation)
+    /statsz?flat=1  flat name→value map (``stats.snapshot()``)
+    /               plain-text ``stats.table()`` for humans/curl
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse, parse_qs
+
+__all__ = ["StatszServer", "start_statsz", "stop_statsz"]
+
+_server_lock = threading.Lock()
+_server: Optional["StatszServer"] = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        from paddle_tpu import stats
+        u = urlparse(self.path)
+        if u.path in ("/statsz", "/statsz/"):
+            q = parse_qs(u.query)
+            if q.get("flat"):
+                body = json.dumps(stats.snapshot())
+            else:
+                body = json.dumps(stats.export())
+            ctype = "application/json"
+        elif u.path == "/":
+            body = stats.table() + "\n"
+            ctype = "text/plain; charset=utf-8"
+        else:
+            self.send_error(404, "try /statsz or /")
+            return
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *args):  # quiet: scrapes must not spam stderr
+        pass
+
+
+class StatszServer:
+    """ThreadingHTTPServer on a daemon thread; ``port=0`` binds an
+    ephemeral port (read ``.port`` after construction — tests use this)."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pt-statsz",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_statsz(port: int = 0, host: str = "0.0.0.0") -> StatszServer:
+    """Start (or return the already-running) statsz server."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            _server = StatszServer(port, host)
+        return _server
+
+
+def stop_statsz():
+    global _server
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()

@@ -121,48 +121,30 @@ def format_table(collector: SpanCollector, step_times=None,
     return "\n".join(lines)
 
 
-class StatRegistry:
-    """Named int64 counters (≙ platform/monitor.h StatRegistry + STAT_ADD).
-    The reference exports GPU memory stats through this surface; here any
-    subsystem can bump counters (dataloader batches, collective calls,
-    checkpoint bytes) and tooling reads them in one place."""
+# -- named counters: ONE registry process-wide ------------------------------
+# Historically this module carried its own StatRegistry shadowing
+# paddle_tpu.stats — profiler counters (mem/* gauges) and the runtime's
+# resilience/serving counters then lived in two places no tool could see
+# together. Now a thin re-export: stat_registry IS stats.default_registry(),
+# so STAT_ADD-style bumps from anywhere land in the same scrape surface
+# (stats.snapshot / statsz / launch-side merge).
+#
+# Semantics that changed with the merge — reset() now follows
+# stats.StatRegistry: no-arg reset() clears EVERY subsystem's metrics
+# (it is the process-wide registry now), and reset(name) is a PREFIX
+# match (incl. derived .total_s/.p99 names), not an exact-key pop.
+# Scope clears with a prefix: stat_registry.reset("mem/").
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._stats: Dict[str, int] = {}
+from paddle_tpu import stats as _stats  # noqa: E402
 
-    def add(self, name: str, value: int = 1) -> int:
-        with self._lock:
-            self._stats[name] = self._stats.get(name, 0) + int(value)
-            return self._stats[name]
-
-    def set(self, name: str, value: int):
-        with self._lock:
-            self._stats[name] = int(value)
-
-    def get(self, name: str) -> int:
-        with self._lock:
-            return self._stats.get(name, 0)
-
-    def stats(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._stats)
-
-    def reset(self, name: Optional[str] = None):
-        with self._lock:
-            if name is None:
-                self._stats.clear()
-            else:
-                self._stats.pop(name, None)
-
-
-stat_registry = StatRegistry()
+StatRegistry = _stats.StatRegistry
+stat_registry = _stats.default_registry()
 
 
 def stat_add(name: str, value: int = 1) -> int:
     """≙ STAT_ADD(name, value) (monitor.h:121)."""
-    return stat_registry.add(name, value)
+    return int(stat_registry.add(name, int(value)))
 
 
 def stat_get(name: str) -> int:
-    return stat_registry.get(name)
+    return stat_registry.get(name, 0)

@@ -52,7 +52,12 @@ class Benchmark:
     def step(self, num_samples=None):
         now = time.perf_counter()
         if self._last is not None:
-            self.times.append((now - self._last, num_samples))
+            dt = now - self._last
+            self.times.append((dt, num_samples))
+            # per-step latency histogram: table() then shows train-loop
+            # p50/p99 alongside the serving ones (docs/observability.md)
+            from paddle_tpu import stats
+            stats.observe("train/step_s", dt)
         self._last = now
 
     def end(self):
@@ -94,6 +99,11 @@ class Benchmark:
             # NaN publishes too: gauges are last-value-wins, and a stale
             # number from a previous run is worse than an honest NaN
             stats.set_value(f"benchmark/{k}", v)
+        # train-loop gauges under the observability namespace: when
+        # num_samples counts tokens, ips IS tokens/s (the LM-training
+        # convention BENCH uses); MFU rides along for the capacity view
+        stats.set_value("train/tokens_per_s", out["ips"])
+        stats.set_value("train/mfu", out["mfu"])
         return out
 
 

@@ -288,3 +288,62 @@ def test_chunked_step_has_no_cache_sized_temps():
     cache = eng.kc.nbytes + eng.vc.nbytes
     assert ma.temp_size_in_bytes < 0.75 * cache, (
         ma.temp_size_in_bytes, cache)
+
+
+def test_serving_metrics_and_request_spans(tmp_path):
+    """ISSUE 3: the engine emits the serving observability surface —
+    serve/ttft_s histogram (one sample per request), queue-depth and
+    batch-occupancy histograms, per-token latency, and (with tracing
+    on) nested serve/step → serve/dispatch spans plus one
+    serve/request lifetime span per request."""
+    import json
+    from paddle_tpu import stats
+    from paddle_tpu.observability import trace
+
+    stats.reset("serve/")
+    trace.clear(capacity=4096)
+    trace.enable(str(tmp_path))
+    try:
+        model = _model()
+        eng = DecodeEngine(model, max_slots=2, max_len=128)
+        reqs = [eng.submit([1, 2, 3], max_new_tokens=4),
+                eng.submit([4, 5], max_new_tokens=3),
+                eng.submit([6, 7, 8, 9], max_new_tokens=2)]  # queues
+        eng.run()
+        assert all(r.done and not r.failed for r in reqs)
+        assert all(r.ttft_s is not None and r.ttft_s > 0 for r in reqs)
+
+        snap = stats.snapshot("serve/")
+        assert snap["serve/ttft_s.count"] == 3
+        assert 0 < snap["serve/ttft_s.p50"] <= snap["serve/ttft_s.p99"]
+        assert snap["serve/queue_depth.count"] >= 1
+        assert snap["serve/batch_occupancy.count"] >= 1
+        assert snap["serve/token_s.count"] >= 1
+        assert snap["serve/token_s.p50"] > 0
+        # the queue was over capacity at some point: max depth >= 1
+        assert snap["serve/queue_depth.max"] >= 1
+        assert "serve/ttft_s.p99" in stats.table("serve/")
+
+        path = trace.export(str(tmp_path / "eng.json"))
+        with open(path) as f:
+            evs = [e for e in json.load(f)["traceEvents"]
+                   if e.get("ph") == "X"]
+        by_name = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+        assert len(by_name["serve/request"]) == 3
+        assert len(by_name["serve/step"]) >= 1
+        assert len(by_name["serve/dispatch"]) >= 1
+        assert len(by_name["serve/admit"]) == 3
+        # dispatch nests under a step span
+        step_ids = {e["args"]["span_id"] for e in by_name["serve/step"]}
+        assert all(e["args"]["parent_id"] in step_ids
+                   for e in by_name["serve/dispatch"])
+        # request spans carry token counts and no error
+        for e in by_name["serve/request"]:
+            assert e["args"]["tokens"] >= 2
+            assert e["args"]["error"] is None
+    finally:
+        trace.disable()
+        trace.clear()
+        stats.reset("serve/")

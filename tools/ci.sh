@@ -9,6 +9,10 @@
 #                          fault-injection tests (pytest -m faults), tier-1
 #                          compatible (CPU, 'not slow') — proves every
 #                          recovery path still recovers in a couple minutes
+#   tools/ci.sh obs        observability smoke: runs a traced mini
+#                          train+decode+checkpoint step and asserts a
+#                          non-empty schema-valid trace file, serving
+#                          percentiles, and a live statsz endpoint
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -19,6 +23,11 @@ if [[ "${1:-}" == "faults" ]]; then
     shift
     exec python -m pytest tests/ -q -m "faults and not slow" \
         --durations=10 -p no:cacheprovider "$@"
+fi
+
+if [[ "${1:-}" == "obs" ]]; then
+    shift
+    exec python tools/obs_smoke.py "$@"
 fi
 
 python -m pytest tests/ -q --durations=15 "$@"

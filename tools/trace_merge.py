@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Offline merge of per-rank trace files into one Perfetto timeline.
+
+The launcher merges automatically on exit (PT_TRACE_DIR); this CLI is
+for the multi-host case — scp every host's ``trace_rank*.json`` into
+one directory, merge, and open the result at https://ui.perfetto.dev
+(or chrome://tracing). Ranks appear as process lanes.
+
+    python tools/trace_merge.py LOGDIR                 # -> LOGDIR/trace_merged.json
+    python tools/trace_merge.py -o out.json a.json b.json
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="trace_merge",
+        description="merge per-rank Chrome-trace JSON files into one "
+                    "Perfetto timeline (rank -> process lane)")
+    p.add_argument("inputs", nargs="+",
+                   help="trace_rank*.json files, or ONE directory "
+                        "containing them")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: trace_merged.json next "
+                        "to the inputs)")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.observability import merge
+
+    if len(args.inputs) == 1 and os.path.isdir(args.inputs[0]):
+        out = merge.merge_rank_traces(args.inputs[0], args.out)
+        if out is None:
+            print(f"no trace_rank*.json under {args.inputs[0]}",
+                  file=sys.stderr)
+            return 1
+    else:
+        out = merge.merge_trace_files(
+            args.inputs,
+            args.out or os.path.join(
+                os.path.dirname(os.path.abspath(args.inputs[0])),
+                merge.MERGED_NAME))
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
