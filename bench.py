@@ -213,16 +213,17 @@ def main():
         # persistent compile cache: the expensive tunnel-side compiles
         # (1.3B train step ≈ tens of minutes cold) are paid once; every
         # re-bench afterwards (opportunistic prober, driver end-of-round)
-        # loads the cached executable instead
+        # loads the cached executable instead. The guarded helper counts
+        # flaky cache reads (r05 logged RESOURCE_EXHAUSTED warnings from
+        # mid-bench cache reads) into serve/compile_cache_errors and
+        # falls back to cold compiles instead of aborting.
         try:
-            jax.config.update(
-                "jax_compilation_cache_dir",
+            from paddle_tpu import compile_cache
+            compile_cache.enable(
                 os.environ.get("PT_XLA_CACHE_DIR",
                                "/root/.cache/pt_xla_cache"))
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 1.0)
         except Exception:
-            pass  # older jax without the knob: cold compiles only
+            pass  # bench must start even if the helper import fails
         peak = _peak_flops(jax.devices()[0])
     except Exception as e:  # unhealthy runtime must still emit the line
         acquired.set()

@@ -1,0 +1,92 @@
+"""Persistent-compilation-cache hardening (ISSUE 4 satellite).
+
+BENCH r05 logged ``RESOURCE_EXHAUSTED: TPU backend error`` UserWarnings
+from persistent-cache reads mid-bench: jax treats a failed cache
+read/write as a warning and recompiles, which is the right fallback —
+but a serving process then prints one warning line per flaky entry
+(spam), and an operator has no counter to tell a degraded cache from a
+healthy one. This module:
+
+- ``guard()`` — routes jax's per-entry compilation-cache failure
+  warnings into the stats registry (``serve/compile_cache_errors``),
+  printing only the FIRST occurrence; every other warning passes
+  through untouched. Installed idempotently by both decode engines at
+  construction.
+- ``enable(cache_dir)`` — points jax at a persistent cache dir with a
+  fallback: a missing config knob (older jax) or a broken dir counts
+  into the same counter and returns False instead of raising — cold
+  compiles are a slowdown, not an outage.
+
+docs/serving.md documents the operator contract.
+"""
+
+import os
+import re
+import threading
+import warnings
+
+__all__ = ["guard", "enable"]
+
+# matches jax's "Error reading persistent compilation cache entry ..."
+# and "Error writing persistent compilation cache entry ..." warnings
+_MATCH = re.compile(r"persistent compilation cache", re.IGNORECASE)
+_lock = threading.Lock()
+_hook = None
+_printed = False
+
+
+def guard() -> None:
+    """Idempotently intercept compilation-cache failure warnings: every
+    occurrence increments ``serve/compile_cache_errors``; only the
+    first is shown. Never raises.
+
+    The hook and its "always" filter mutate process-global ``warnings``
+    state (an intervening ``warnings.catch_warnings()`` context restores
+    the previous hook on exit, so guard() re-installs whenever it finds
+    itself displaced — every engine construction calls it). Set
+    ``PT_COMPILE_CACHE_GUARD=0`` to opt out entirely (e.g. a process
+    run under ``-W ignore`` that wants no cache-failure line at all)."""
+    global _hook
+    if os.environ.get("PT_COMPILE_CACHE_GUARD", "1") == "0":
+        return
+    with _lock:
+        if _hook is not None and warnings.showwarning is _hook:
+            return   # still installed
+        prev = warnings.showwarning
+
+        def _showwarning(message, category, filename, lineno,
+                         file=None, line=None):
+            global _printed
+            if _MATCH.search(str(message)):
+                from paddle_tpu import stats
+                stats.add("serve/compile_cache_errors")
+                if _printed:
+                    return
+                _printed = True
+            prev(message, category, filename, lineno, file, line)
+
+        warnings.showwarning = _showwarning
+        _hook = _showwarning
+        # the default "once per call site" filter would hide repeats
+        # from the hook above — the hook dedupes the printing itself
+        warnings.filterwarnings(
+            "always", message=".*persistent compilation cache.*")
+
+
+def enable(cache_dir, min_compile_secs: float = 1.0) -> bool:
+    """Enable jax's persistent compilation cache at ``cache_dir``,
+    tolerating failure (counter + one warning instead of an abort).
+    Returns True when the cache was configured."""
+    guard()
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        return True
+    except Exception as e:  # older jax without the knob / unusable dir
+        from paddle_tpu import stats
+        stats.add("serve/compile_cache_errors")
+        warnings.warn(f"compile cache unavailable ({e}); continuing "
+                      f"with cold compiles")
+        return False

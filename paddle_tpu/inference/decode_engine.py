@@ -35,6 +35,25 @@ leaves to paddle-serving:
   host round-trip per chunk instead of per token — the serving loop
   belongs on the device (the reference's analog keeps its loop inside
   one CUDA graph).
+- **Pipelined dispatch** (``PT_SERVE_INFLIGHT``, default 2): ``step()``
+  is split into a dispatch half (enqueue the next jitted call on the
+  still-on-device carry) and a harvest half (pull a PREVIOUS dispatch's
+  packed results to host). JAX's async dispatch then overlaps the
+  host-side bookkeeping of step N with the device execution of step
+  N+1 — the eager ``np.asarray`` after every dispatch was the last
+  host↔device sync in the hot loop (VERDICT r4 measured decode at ~43%
+  of the HBM roofline with the TPU idling on host gaps). Each harvest
+  costs exactly ONE transfer: tokens/emit-flags/non-finite flags ride
+  one packed int32 array. Request budgets and eos ids live in
+  persistent device arrays (``remaining``/``eos_ids``) so consecutive
+  dispatches need no host marshalling at all; the host keeps a shadow
+  of per-slot budgets only to decide when to stop dispatching.
+  Admission rides the pipeline (prefill updates all per-slot device
+  state inside the jitted call); deadline eviction — a host-side
+  mutation of device state — drains it first. Long prompts' prefill
+  chunks interleave with decode dispatches under a per-step token
+  budget (``PT_SERVE_PREFILL_TOKENS``), so a long admission no longer
+  stalls live slots for its whole prefill. docs/serving.md.
 - **Speculative decoding** (``speculative_k > 0``, greedy only): each
   step verifies K candidate tokens per slot in ONE pass, so weights +
   KV prefix are read once per accepted run instead of once per token —
@@ -63,6 +82,7 @@ active slot's KV prefix).
 """
 
 import collections
+import os
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -157,6 +177,19 @@ class Request:
         return self.prompt + self.tokens
 
 
+class _Inflight:
+    """One in-flight dispatch awaiting harvest: the live (slot, request)
+    snapshot it covered, the packed on-device result array, and the
+    dispatch timestamp. ``kind`` is 'prefill' (payload: the sampled
+    first token), 'decode' (packed (3, chunk, S): tokens / emit flags /
+    non-finite flags) or 'spec' (packed (chunk, S, K+2))."""
+
+    __slots__ = ("kind", "live", "payload", "t")
+
+    def __init__(self, kind, live, payload, t):
+        self.kind, self.live, self.payload, self.t = kind, live, payload, t
+
+
 class ResilientScheduler:
     """Shared degradation bookkeeping for the serving engines: evict ONE
     request (deadline overrun or non-finite logits) without disturbing
@@ -179,8 +212,159 @@ class ResilientScheduler:
         if slot is not None:
             self._slot_req[slot] = None
             self._on_evict(slot)
+            self._disp_rem[slot] = 0
         stats.add(stat)
         self._obs_request_end(req)
+
+    # -- pipelined dispatch (shared by both engines) ------------------------
+    def _init_pipeline(self, inflight):
+        """In-flight depth (how many dispatches may be enqueued before
+        the oldest is harvested): ctor arg beats PT_SERVE_INFLIGHT beats
+        the default 2. Depth 1 is the fully synchronous baseline the
+        bit-identity tests compare against."""
+        depth = (int(inflight) if inflight is not None
+                 else int(os.environ.get("PT_SERVE_INFLIGHT", "2")))
+        if depth < 1:
+            raise ValueError(f"in-flight depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._pending: collections.deque = collections.deque()
+        # host shadow of per-slot dispatch budgets: how many more tokens
+        # are worth dispatching for, given the dispatches already in
+        # flight. Decides ONLY when to stop dispatching — the truth
+        # (remaining/eos/active) lives on device.
+        self._disp_rem = np.zeros((self.S,), np.int64)
+        self._t_disp_end: Optional[float] = None
+
+    def _pending_cover(self):
+        """slot -> number of in-flight DECODE dispatches covering it."""
+        cover: dict = {}
+        for rec in self._pending:
+            if rec.kind != "prefill":
+                for s, _ in rec.live:
+                    cover[s] = cover.get(s, 0) + 1
+        return cover
+
+    def _resync_budgets(self, live, cover=None):
+        """Re-anchor the host budget shadow to harvested truth: the
+        request's true remaining minus the guaranteed progress (at
+        least ``chunk`` tokens each) of dispatches still in flight.
+        Exact for the plain/chunked paths; a safe lower bound for
+        speculative (whose per-dispatch yield varies), where a few
+        no-op dispatches at the tail are bounded by the depth."""
+        if cover is None:
+            cover = self._pending_cover()
+        for slot, req in live:
+            if req.done or self._slot_req[slot] is not req:
+                continue
+            rem = req.max_new_tokens - len(req.tokens)
+            self._disp_rem[slot] = max(
+                0, rem - self.chunk * cover.get(slot, 0))
+
+    def _obs_host_gap(self):
+        """Host-side bubble between finishing one dispatch enqueue and
+        issuing the next — the time the device risks idling on the host
+        at depth 1; the pipeline's job is to hide it."""
+        import time
+        from paddle_tpu import stats
+        if self._t_disp_end is not None:
+            stats.observe("serve/host_gap_s",
+                          time.perf_counter() - self._t_disp_end)
+
+    def _finish_dispatch(self, kind, live, payload):
+        """Post-enqueue bookkeeping shared by both engines: charge the
+        budget shadows, queue the in-flight record, stamp the gap
+        timer, publish the gauge."""
+        import time
+        from paddle_tpu import stats
+        for s, _ in live:
+            self._disp_rem[s] = max(0, self._disp_rem[s] - self.chunk)
+        self._pending.append(_Inflight(kind, live, payload,
+                                       time.perf_counter()))
+        self._t_disp_end = time.perf_counter()
+        stats.set_value("serve/inflight", len(self._pending))
+
+    def _pump(self, dispatched: bool):
+        """The harvest policy: keep at most ``depth`` dispatches in
+        flight after a dispatch (depth 1 = fully synchronous), pop one
+        when there was nothing to dispatch (drain tail). An idle step
+        also resets the host-gap timer so traffic gaps never pollute
+        serve/host_gap_s."""
+        if dispatched:
+            while len(self._pending) >= self.depth:
+                self._harvest_one()
+        else:
+            self._t_disp_end = None
+            if self._pending:
+                self._harvest_one()
+
+    def _harvest_one(self) -> int:
+        """Pull the OLDEST in-flight dispatch's packed results to host
+        (ONE transfer) and replay them into Requests. While the
+        transfer blocks, younger dispatches keep the device busy — that
+        overlap is the pipeline's entire win."""
+        from paddle_tpu import stats
+        from paddle_tpu.observability import trace
+        rec = self._pending.popleft()
+        with trace.span("serve/harvest", kind=rec.kind,
+                        inflight=len(self._pending)) as sp:
+            arr = np.asarray(rec.payload)
+            emitted = self._replay(rec, arr)
+            sp.attrs["tokens"] = emitted
+        stats.set_value("serve/inflight", len(self._pending))
+        self.tokens_emitted += emitted
+        return emitted
+
+    def _drain(self):
+        """Harvest every in-flight dispatch — the hard pipeline
+        boundary: a host-side mutation of device state (deadline
+        eviction) must see fully-applied results first."""
+        while self._pending:
+            self._harvest_one()
+
+    def _replay(self, rec, arr) -> int:
+        """Apply one harvested dispatch's packed results to its live
+        snapshot ('prefill' and 'decode' records; the speculative kind
+        is DecodeEngine-only and overrides). Requests retired or
+        evicted since the dispatch are skipped — the device had already
+        deactivated their slots, so their flags in ``arr`` are all
+        False. Engines customize via ``_apply_token`` (what one emitted
+        token does) and ``_after_replay`` (post-loop retirement)."""
+        if rec.kind == "prefill":
+            slot, req = rec.live[0]
+            if not req.done and self._slot_req[slot] is req:
+                # the prefill's sampled token is the first generated one
+                self._emit(slot, req, int(arr))
+            self._resync_budgets(rec.live)
+            return 0
+        toks = arr[0]
+        flags = arr[1].astype(bool)
+        bads = arr[2].astype(bool)
+        total = 0
+        for slot, req in rec.live:
+            if req.done or self._slot_req[slot] is not req:
+                continue
+            for j in range(self.chunk):
+                if flags[j, slot] and not req.done:
+                    self._apply_token(slot, req, int(toks[j, slot]))
+                    total += 1
+            if bads[:, slot].any() and not req.done:
+                self._fail(req, "non-finite logits", slot=slot,
+                           stat="serve/nonfinite_evictions")
+        self._after_replay(rec)
+        self._resync_budgets(rec.live)
+        return total
+
+    def _apply_token(self, slot: int, req: Request, token: int):
+        raise NotImplementedError
+
+    def _after_replay(self, rec):
+        pass
+
+    def drain(self) -> None:
+        """Block until every in-flight dispatch is harvested and applied
+        (the pipeline analog of jax.block_until_ready). Request state
+        (``tokens``/``done``) is exact after this returns."""
+        self._drain()
 
     # -- serving metrics (shared by both engines) ---------------------------
     def _obs_first_token(self, req: Request):
@@ -216,17 +400,26 @@ class ResilientScheduler:
                           (time.perf_counter() - t0) / emitted)
 
     def _evict_expired(self):
-        """Deadline sweep (queue + live slots) run at each step entry."""
+        """Deadline sweep (queue + live slots) run at each step entry.
+        Evicting a LIVE slot mutates device state mid-pipeline (active
+        flags, the paged engine's pages), so the pipeline drains first:
+        in-flight results are applied, then whatever is still expired
+        is evicted. Queued evictions touch no device state and need no
+        drain."""
         import time
         now = time.monotonic()
         for req in [r for r in self._waiting
                     if r.deadline is not None and now > r.deadline]:
             self._waiting.remove(req)
             self._fail(req, "deadline exceeded while queued")
-        for slot, req in enumerate(self._slot_req):
-            if (req is not None and req.deadline is not None
-                    and now > req.deadline):
-                self._fail(req, "deadline exceeded", slot=slot)
+        if any(req is not None and req.deadline is not None
+               and now > req.deadline for req in self._slot_req):
+            self._drain()
+            now = time.monotonic()
+            for slot, req in enumerate(self._slot_req):
+                if (req is not None and req.deadline is not None
+                        and now > req.deadline):
+                    self._fail(req, "deadline exceeded", slot=slot)
 
     def _poison_mask(self):
         """Injection mask for this dispatch (site engine.poison_logits).
@@ -265,7 +458,11 @@ class DecodeEngine(ResilientScheduler):
                  top_k: int = 0, seed: int = 0, cache_dtype=None,
                  speculative_k: int = 0, steps_per_call: int = 1,
                  share_weights_with: "Optional[DecodeEngine]" = None,
-                 weight_dtype: Optional[str] = None, mesh=None):
+                 weight_dtype: Optional[str] = None, mesh=None,
+                 inflight: Optional[int] = None, warmup: bool = False,
+                 prefill_tokens: Optional[int] = None):
+        from paddle_tpu import compile_cache
+        compile_cache.guard()
         cfg, head, stacked = resolve_engine_weights(model,
                                                     share_weights_with)
         self.cfg = cfg
@@ -329,6 +526,12 @@ class DecodeEngine(ResilientScheduler):
         # prompt-lookup drafts — speculative stepping never syncs the
         # host mid-chunk.
         self.toks = jnp.zeros((self.S, self.T), jnp.int32)
+        # per-slot token budgets + eos ids as PERSISTENT device state:
+        # set by the prefill dispatch, decremented by the decode
+        # dispatches — consecutive dispatches need no host marshalling,
+        # which is what lets them pipeline
+        self.remaining = jnp.zeros((self.S,), jnp.int32)
+        self.eos_ids = jnp.full((self.S,), -1, jnp.int32)
         if mesh is not None:
             self._place_on_mesh(model, mesh)
         self._rng = jax.random.PRNGKey(seed)
@@ -352,13 +555,27 @@ class DecodeEngine(ResilientScheduler):
         self.tokens_emitted = 0
 
         # caches donated: the engine rebinds them every call, and donation
-        # lets XLA update the multi-GB buffers in place
-        self._step_fn = jax.jit(self._one_token, donate_argnums=(2, 3))
+        # lets XLA update the multi-GB buffers in place. The plain path
+        # is the chunk=1 instance of _multi_impl — every decode dispatch
+        # goes through it (or the speculative wrapper), so eos/budget
+        # early-stop always lives on device and results always come
+        # back as one packed array.
         self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(2, 3))
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=(2, 3, 4))
         self._verify_fn = jax.jit(self._spec_multi_impl,
                                   donate_argnums=(2, 3, 4))
+
+        self._init_pipeline(inflight)
+        self._admitting: collections.deque = collections.deque()
+        if prefill_tokens is None:
+            prefill_tokens = int(os.environ.get(
+                "PT_SERVE_PREFILL_TOKENS", "0")) or self.buckets[-1]
+        # per-step prompt-token budget for interleaved prefill (at least
+        # one bucket so an open admission always progresses)
+        self._prefill_budget = max(int(prefill_tokens), self.buckets[0])
+        if warmup:
+            self.warmup()
 
     def _place_on_mesh(self, model, mesh):
         """Tensor-parallel serving (≙ HybridParallelInference,
@@ -400,6 +617,8 @@ class DecodeEngine(ResilientScheduler):
         self.last = jax.device_put(self.last, rep)
         self.active = jax.device_put(self.active, rep)
         self.toks = jax.device_put(self.toks, rep)
+        self.remaining = jax.device_put(self.remaining, rep)
+        self.eos_ids = jax.device_put(self.eos_ids, rep)
 
     def _quantize_stacked_int8(self):
         """Replace the stacked blocks' matmul weights with int8
@@ -436,21 +655,30 @@ class DecodeEngine(ResilientScheduler):
              else head["lm_head"])
         return x @ w
 
-    def _write_rows(self, kc, vc, k_rows, v_rows, lengths):
-        """Write each slot's K new KV rows at its own cache position:
-        S small dynamic_update_slices on the carried buffers instead of
-        the full-cache rebuild the old scan-ys formulation paid (~2x the
-        cache size in copy traffic per step).
+    def _write_rows(self, kc, vc, k_rows, v_rows, lengths, active):
+        """Write each ACTIVE slot's K new KV rows at its own cache
+        position: S small dynamic_update_slices on the carried buffers
+        instead of the full-cache rebuild the old scan-ys formulation
+        paid (~2x the cache size in copy traffic per step).
+
+        An INACTIVE slot rewrites its existing row (a read-select-write
+        identity — the contiguous analog of the paged engine's scratch
+        page): its device ``lengths`` is stale, and with interleaved
+        admission a decode dispatch enqueued between prefill chunks
+        would otherwise clobber a prompt row the prefill already wrote.
 
         k_rows/v_rows: (L, S, K, Hkv, D) stacked layer outputs."""
         kr = jnp.transpose(k_rows, (0, 1, 3, 2, 4))   # (L, S, Hkv, K, D)
         vr = jnp.transpose(v_rows, (0, 1, 3, 2, 4))
         for s in range(self.S):
             pos = lengths[s]
-            kc = lax.dynamic_update_slice(kc, kr[:, s:s + 1],
-                                          (0, s, 0, pos, 0))
-            vc = lax.dynamic_update_slice(vc, vr[:, s:s + 1],
-                                          (0, s, 0, pos, 0))
+            win = (0, s, 0, pos, 0)
+            old_k = lax.dynamic_slice(kc, win, kr[:, s:s + 1].shape)
+            old_v = lax.dynamic_slice(vc, win, vr[:, s:s + 1].shape)
+            kc = lax.dynamic_update_slice(
+                kc, jnp.where(active[s], kr[:, s:s + 1], old_k), win)
+            vc = lax.dynamic_update_slice(
+                vc, jnp.where(active[s], vr[:, s:s + 1], old_v), win)
         return kc, vc
 
     def _one_token(self, head, stacked, kc, vc, lengths, last, active,
@@ -479,7 +707,8 @@ class DecodeEngine(ResilientScheduler):
             return y, (k_rows, v_rows)
 
         x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
-        kc, vc = self._write_rows(kc, vc, k_rows, v_rows, lengths)
+        kc, vc = self._write_rows(kc, vc, k_rows, v_rows, lengths,
+                                  active)
         logits = self._lm_head(head, x)[:, 0]
         logits = jnp.where(poison[:, None], jnp.nan, logits)
         bad = active & ~jnp.all(jnp.isfinite(logits), axis=-1)
@@ -502,8 +731,9 @@ class DecodeEngine(ResilientScheduler):
         (worst over a remote PJRT tunnel, still microseconds locally)
         otherwise bounds tokens/sec regardless of model speed. The
         reference's analog is the fused-multi-transformer loop staying
-        inside one CUDA graph. Emits (chunk, S) tokens + emit flags;
-        the host applies them in order between dispatches."""
+        inside one CUDA graph. Emits the (chunk, S) tokens, emit flags
+        and non-finite flags PACKED into one int32 array so the lagged
+        harvest pays exactly one device→host transfer."""
 
         def one(carry, _):
             kc, vc, lengths, last, active, remaining, rng = carry
@@ -520,10 +750,12 @@ class DecodeEngine(ResilientScheduler):
             (toks, flags, bads) = \
             lax.scan(one, (kc, vc, lengths, last, active, remaining, rng),
                      None, length=self.chunk)
-        return (kc, vc, lengths, last, active, remaining, rng, toks,
-                flags, bads)
+        packed = jnp.stack([toks, flags.astype(jnp.int32),
+                            bads.astype(jnp.int32)])
+        return (kc, vc, lengths, last, active, remaining, rng, packed)
 
-    def _verify_impl(self, head, stacked, kc, vc, lengths, cand, poison):
+    def _verify_impl(self, head, stacked, kc, vc, lengths, cand, active,
+                     poison):
         """One speculative verify: K candidate tokens per slot through
         one pass. Returns the model's predictions (S, K), the
         accepted-prefix length n_acc (0..K-1), and the per-slot
@@ -543,7 +775,8 @@ class DecodeEngine(ResilientScheduler):
             return y, (k_rows, v_rows)
 
         x, (k_rows, v_rows) = lax.scan(layer, x, (stacked, kc, vc))
-        kc, vc = self._write_rows(kc, vc, k_rows, v_rows, lengths)
+        kc, vc = self._write_rows(kc, vc, k_rows, v_rows, lengths,
+                                  active)
         logits = self._lm_head(head, x).astype(jnp.float32)  # (S, K, V)
         logits = jnp.where(poison[:, None, None], jnp.nan, logits)
         bad = ~jnp.all(jnp.isfinite(logits), axis=(1, 2))
@@ -588,15 +821,16 @@ class DecodeEngine(ResilientScheduler):
         one-step-per-dispatch version paid 2+ tunnel round-trips per
         verify, which dominated the measurement on remote PJRT).
 
-        Emits (chunk, S, K) predictions + (chunk, S) accepted counts;
-        the host applies them in order after the dispatch."""
+        Emits the (chunk, S, K) predictions, (chunk, S) accepted counts
+        and non-finite flags packed into ONE (chunk, S, K+2) int32
+        array — one transfer per lagged harvest."""
         K = self.spec_k
 
         def one(carry, _):
             kc, vc, toks, lengths, last, active, remaining = carry
             cand = self._draft_device(toks, lengths, last)
             kc, vc, pred, n_acc, bad = self._verify_impl(
-                head, stacked, kc, vc, lengths, cand, poison)
+                head, stacked, kc, vc, lengths, cand, active, poison)
             # inactive slots keep computing from stale state inside the
             # chunk; a non-finite there must not retroactively fail a
             # request that already completed (same mask as _one_token)
@@ -620,10 +854,16 @@ class DecodeEngine(ResilientScheduler):
             # n_eff is overwritten by the next step's window or masked
             # by lengths on read); at the very end of a slot's budget
             # the window can touch [T-K, T) via DUS clamping — the slot
-            # is retiring, its history is never read again.
+            # is retiring, its history is never read again. INACTIVE
+            # slots rewrite their existing window (same guard as
+            # _write_rows): a mid-admission slot's stale lengths would
+            # otherwise clobber prompt history a prefill chunk already
+            # wrote, corrupting the prompt-lookup drafts.
             for s in range(self.S):
+                win = (s, lengths[s] + 1)
+                old = lax.dynamic_slice(toks, win, (1, K))
                 toks = lax.dynamic_update_slice(
-                    toks, pred[s:s + 1], (s, lengths[s] + 1))
+                    toks, jnp.where(active[s], pred[s:s + 1], old), win)
             remaining = remaining - n_eff
             lengths = lengths + n_eff
             emitted_eos = any_eos & (first_eos < n_eff)
@@ -635,17 +875,24 @@ class DecodeEngine(ResilientScheduler):
             (preds, effs, bads) \
             = lax.scan(one, (kc, vc, toks, lengths, last, active,
                              remaining), None, length=self.chunk)
-        return (kc, vc, toks, lengths, last, active, remaining, preds,
-                effs, bads)
+        packed = jnp.concatenate(
+            [preds, effs[..., None], bads[..., None].astype(jnp.int32)],
+            axis=-1)
+        return (kc, vc, toks, lengths, last, active, remaining, packed)
 
     def _prefill_impl(self, head, stacked, kc, vc, toks, lengths, last,
-                      active, slot, tokens, start, true_total, is_final,
-                      rng):
+                      active, remaining, eos_ids, slot, tokens, start,
+                      true_total, is_final, rem0, eos0, rng):
         """Run one prompt chunk through the slot's cache slice; on the
-        final chunk, sample the first generated token and activate the
-        slot. `tokens` is (1, bucket) — one compile per bucket size.
-        The chunk is also recorded in the device history buffer (the
-        speculative path drafts from it)."""
+        final chunk, sample the first generated token, activate the
+        slot, and install its token budget (``rem0``, the budget net of
+        this first token) and eos id into the persistent device arrays
+        — so decode dispatches already enqueued behind this prefill
+        pick the slot up with NO host round-trip. `tokens` is
+        (1, bucket) — one compile per bucket size. The chunk is also
+        recorded in the device history buffer (the speculative path
+        drafts from it). Returns the sampled token as an extra output;
+        the scheduler harvests it lag-one like any other dispatch."""
         cfg = self.cfg
         L, bucket = cfg.n_layers, tokens.shape[1]
         sl = (L, 1, cfg.kv_heads, self.T, cfg.head_dim)
@@ -681,10 +928,18 @@ class DecodeEngine(ResilientScheduler):
                                      (slot, true_total)), toks)
         onehot = jnp.arange(self.S) == slot
         upd = jnp.logical_and(onehot, is_final)
+        # a request whose whole budget was the first token, or whose
+        # first token IS its eos, never activates — the device-side
+        # analog of the host _emit retiring at admission
+        alive = jnp.logical_and(
+            rem0 > 0, jnp.logical_or(eos0 < 0, nxt != eos0))
         lengths = jnp.where(upd, true_total, lengths)
         last = jnp.where(upd, nxt, last)
-        active = jnp.logical_or(active, upd)
-        return kc, vc, toks, lengths, last, active, rng
+        active = jnp.logical_or(active, jnp.logical_and(upd, alive))
+        remaining = jnp.where(upd, rem0, remaining)
+        eos_ids = jnp.where(upd, eos0, eos_ids)
+        return (kc, vc, toks, lengths, last, active, remaining, eos_ids,
+                rng, nxt)
 
     # -- scheduler ----------------------------------------------------------
 
@@ -720,40 +975,90 @@ class DecodeEngine(ResilientScheduler):
                 return s
         return None
 
-    def _admit(self, req: Request, slot: int):
+    def _admit_next(self) -> bool:
+        """Move the next waiting request into a free slot as an
+        INCREMENTAL prefill job: its chunks dispatch under the per-step
+        token budget, interleaved with decode dispatches, so a long
+        prompt no longer stalls live slots for its whole prefill."""
+        import time
+        slot = self._free_slot()
+        if slot is None or not self._waiting:
+            return False
+        req = self._waiting.popleft()
+        self._slot_req[slot] = req      # reserve; decode skips it until
+        self._disp_rem[slot] = 0        # the final chunk flips it live
+        self._admitting.append({
+            "req": req, "slot": slot, "start": 0,
+            "prompt": np.asarray(req.prompt, np.int32),
+            "t0": time.perf_counter()})
+        return True
+
+    def _dispatch_prefill_chunk(self, job):
+        """Dispatch ONE bucket-sized prompt chunk. On the final chunk
+        the jitted body flips the slot live on device (lengths / last /
+        active / remaining / eos_ids) and the sampled first token rides
+        the harvest queue as a 'prefill' record. Returns (bucket tokens
+        consumed, finished)."""
+        import time
         from paddle_tpu.observability import trace
-        prompt = np.asarray(req.prompt, np.int32)
+        req, slot = job["req"], job["slot"]
+        prompt, start = job["prompt"], job["start"]
         total = len(prompt)
-        start = 0
-        with trace.span("serve/admit", slot=slot, prompt=total):
-            while start < total:
-                remaining = total - start
-                bucket = next((x for x in self.buckets if x >= remaining),
-                              self.buckets[-1])
-                s0 = start
-                if s0 + bucket > self.T:
-                    # tail window would overrun the cache: slide it back
-                    # over already-prefilled positions — same tokens at the
-                    # same positions recompute the identical K/V, so the
-                    # overlapped rewrite is a no-op and the write stays in
-                    # bounds
-                    s0 = self.T - bucket
-                n = min(total - s0, bucket)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :n] = prompt[s0:s0 + n]
-                is_final = s0 + n >= total
-                with trace.span("serve/prefill", bucket=bucket):
-                    (self.kc, self.vc, self.toks, self.lengths, self.last,
-                     self.active, self._rng) = self._prefill_fn(
-                        self._head, self._stacked, self.kc, self.vc,
-                        self.toks, self.lengths, self.last, self.active,
-                        jnp.int32(slot), jnp.asarray(padded),
-                        jnp.int32(s0), jnp.int32(total),
-                        jnp.asarray(is_final), self._rng)
-                start = s0 + n
-        self._slot_req[slot] = req
-        # the prefill's sampled token is the first generated token
-        self._emit(slot, req, int(np.asarray(self.last)[slot]))
+        remaining = total - start
+        bucket = next((x for x in self.buckets if x >= remaining),
+                      self.buckets[-1])
+        s0 = start
+        if s0 + bucket > self.T:
+            # tail window would overrun the cache: slide it back over
+            # already-prefilled positions — same tokens at the same
+            # positions recompute the identical K/V, so the overlapped
+            # rewrite is a no-op and the write stays in bounds
+            s0 = self.T - bucket
+        n = min(total - s0, bucket)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt[s0:s0 + n]
+        is_final = s0 + n >= total
+        rem0 = req.max_new_tokens - 1
+        eos0 = -1 if req.eos_id is None else int(req.eos_id)
+        with trace.span("serve/prefill", bucket=bucket, slot=slot):
+            (self.kc, self.vc, self.toks, self.lengths, self.last,
+             self.active, self.remaining, self.eos_ids, self._rng,
+             nxt) = self._prefill_fn(
+                self._head, self._stacked, self.kc, self.vc, self.toks,
+                self.lengths, self.last, self.active, self.remaining,
+                self.eos_ids, jnp.int32(slot), jnp.asarray(padded),
+                jnp.int32(s0), jnp.int32(total), jnp.asarray(is_final),
+                jnp.int32(rem0), jnp.int32(eos0), self._rng)
+        job["start"] = s0 + n
+        if is_final:
+            self._disp_rem[slot] = rem0
+            self._pending.append(_Inflight("prefill", [(slot, req)], nxt,
+                                           time.perf_counter()))
+            trace.complete("serve/admit", job["t0"], slot=slot,
+                           prompt=total)
+        return bucket, is_final
+
+    def _advance_admissions(self):
+        """Dispatch up to ``_prefill_budget`` prompt tokens of waiting
+        requests' prefill chunks (always at least one chunk when a job
+        is open), pulling new requests into free slots as jobs
+        finish."""
+        if self._admitting:
+            # a job whose request was deadline-evicted mid-admission is
+            # abandoned (its slot is already free and may be re-used by
+            # the next job; the partial prefill is inert — the slot
+            # never activated and a successor overwrites it)
+            self._admitting = collections.deque(
+                j for j in self._admitting if not j["req"].done)
+        budget = self._prefill_budget
+        while budget > 0:
+            if not self._admitting and not self._admit_next():
+                return
+            used, finished = self._dispatch_prefill_chunk(
+                self._admitting[0])
+            budget -= used
+            if finished:
+                self._admitting.popleft()
 
     def _emit(self, slot: int, req: Request, token: int):
         req.tokens.append(token)
@@ -766,131 +1071,157 @@ class DecodeEngine(ResilientScheduler):
             self._obs_request_end(req)
 
     def step(self) -> int:
-        """Evict past-deadline requests, admit what fits, then advance
-        every active slot (one token, or up to K with speculative
-        decoding). Returns tokens emitted."""
+        """Advance the serving pipeline: evict expired requests (a hard
+        drain boundary), dispatch waiting prefill chunks and one decode
+        dispatch, then harvest the OLDEST in-flight dispatch once the
+        pipeline holds ``depth`` of them — lag-one at the default depth
+        2, fully synchronous at depth 1. Returns tokens applied to
+        Requests this call; at depth>1 they come from an earlier
+        dispatch, so drain with run() (or ``drain()``) before reading
+        final Request state."""
         import time
         from paddle_tpu.observability import trace
         t0 = time.perf_counter()
+        base = self.tokens_emitted
         with trace.span("serve/step") as sp:
             self._evict_expired()
-            while self._waiting:
-                slot = self._free_slot()
-                if slot is None:
-                    break
-                self._admit(self._waiting.popleft(), slot)
-            live = [(s, r) for s, r in enumerate(self._slot_req)
-                    if r is not None]
-            if not live:
-                return 0
-            self.steps += 1
-            if self.spec_k:
-                n = self._spec_step(live)
-            elif self.chunk > 1:
-                n = self._chunk_step(live)
-            else:
-                with trace.span("serve/dispatch", kind="single"):
-                    (self.kc, self.vc, self.lengths, self.last,
-                     self._rng, bad) = self._step_fn(
-                        self._head, self._stacked, self.kc, self.vc,
-                        self.lengths, self.last, self.active, self._rng,
-                        self._poison_mask())
-                emitted = np.asarray(self.last)
-                bad = np.asarray(bad)
-                n = 0
-                for slot, req in live:
-                    if bad[slot]:
-                        self._fail(req, "non-finite logits", slot=slot,
-                                   stat="serve/nonfinite_evictions")
-                    else:
-                        self._emit(slot, req, int(emitted[slot]))
-                        n += 1
-            sp.attrs["active"] = len(live)
+            self._advance_admissions()
+            self._pump(self._dispatch_decode())
+            live = self.num_active
+            n = self.tokens_emitted - base
+            sp.attrs["active"] = live
             sp.attrs["tokens"] = n
-        self._obs_step(t0, n, len(live))
-        self.tokens_emitted += n
+        if live or n:
+            self._obs_step(t0, n, live)
         return n
 
-    def _marshal_limits(self, live):
-        """Per-slot token budgets + eos ids for a chunked dispatch."""
-        remaining = np.zeros((self.S,), np.int32)
-        eos = np.full((self.S,), -1, np.int32)
-        for slot, req in live:
-            remaining[slot] = req.max_new_tokens - len(req.tokens)
-            if req.eos_id is not None:
-                eos[slot] = req.eos_id
-        return jnp.asarray(remaining), jnp.asarray(eos)
+    def _dispatch_decode(self) -> bool:
+        """Enqueue ONE decode dispatch over every live slot (chunked or
+        speculative; the plain path is the chunk=1 instance). Pure
+        enqueue — nothing is pulled back to host here; the packed
+        results join the harvest queue."""
+        from paddle_tpu.observability import trace
+        live = [(s, r) for s, r in enumerate(self._slot_req)
+                if r is not None and self._disp_rem[s] > 0]
+        if not live:
+            return False
+        self.steps += 1
+        self._obs_host_gap()
+        if self.spec_k:
+            with trace.span("serve/dispatch", kind="spec", k=self.spec_k,
+                            chunk=self.chunk,
+                            inflight=len(self._pending)):
+                (self.kc, self.vc, self.toks, self.lengths, self.last,
+                 self.active, self.remaining, packed) = self._verify_fn(
+                    self._head, self._stacked, self.kc, self.vc,
+                    self.toks, self.lengths, self.last, self.active,
+                    self.remaining, self.eos_ids, self._poison_mask())
+            kind = "spec"
+        else:
+            with trace.span("serve/dispatch", kind="chunk",
+                            chunk=self.chunk,
+                            inflight=len(self._pending)):
+                (self.kc, self.vc, self.lengths, self.last, self.active,
+                 self.remaining, self._rng, packed) = self._multi_fn(
+                    self._head, self._stacked, self.kc, self.vc,
+                    self.lengths, self.last, self.active, self.remaining,
+                    self.eos_ids, self._rng, self._poison_mask())
+            kind = "decode"
+        self._finish_dispatch(kind, live, packed)
+        return True
 
     def _retire_done(self, live):
         """Free slots whose request hit its budget or eos (mirrors the
-        device-side early-stop) — shared by both chunked paths."""
+        device-side early-stop) — shared by both harvest paths. Guards
+        against stale snapshots: a slot already freed and re-admitted
+        must not be clobbered by an older dispatch's record."""
         for slot, req in live:
+            if req.done or self._slot_req[slot] is not req:
+                continue
             if len(req.tokens) >= req.max_new_tokens or (
                     req.eos_id is not None and req.tokens
                     and req.tokens[-1] == req.eos_id):
                 req.done = True
                 self._slot_req[slot] = None
+                self._disp_rem[slot] = 0
                 self._obs_request_end(req)
 
-    def _chunk_step(self, live) -> int:
-        """One dispatch advancing every live slot up to ``chunk`` tokens,
-        early-stopping per slot device-side (eos / budget / non-finite
-        logits — the last evicting only the poisoned request)."""
-        from paddle_tpu.observability import trace
-        remaining, eos = self._marshal_limits(live)
-        with trace.span("serve/dispatch", kind="chunk", chunk=self.chunk):
-            (self.kc, self.vc, self.lengths, self.last, self.active,
-             _, self._rng, toks, flags, bads) = self._multi_fn(
-                self._head, self._stacked, self.kc, self.vc, self.lengths,
-                self.last, self.active, remaining, eos, self._rng,
-                self._poison_mask())
-        toks = np.asarray(toks)
-        flags = np.asarray(flags)
-        bads = np.asarray(bads)
+    def _replay(self, rec, arr) -> int:
+        """Speculative records unpack (chunk, S, K+2); everything else
+        (prefill/decode) is the shared base replay."""
+        if rec.kind != "spec":
+            return super()._replay(rec, arr)
+        K = self.spec_k
+        preds, effs = arr[..., :K], arr[..., K]
+        bads = arr[..., K + 1].astype(bool)
         total = 0
-        for slot, req in live:
-            for j in range(self.chunk):
-                if flags[j, slot]:
-                    req.tokens.append(int(toks[j, slot]))
-                    total += 1
-            if bads[:, slot].any():
-                self._fail(req, "non-finite logits", slot=slot,
-                           stat="serve/nonfinite_evictions")
-        self._retire_done(live)
-        return total
-
-    def _spec_step(self, live) -> int:
-        """One dispatch of ``chunk`` speculative steps: drafts, verify,
-        acceptance, eos/budget early-stop all on device; the host only
-        replays the emitted (step, slot, count) runs into Requests."""
-        from paddle_tpu.observability import trace
-        remaining, eos = self._marshal_limits(live)
-        with trace.span("serve/dispatch", kind="spec", k=self.spec_k,
-                        chunk=self.chunk):
-            (self.kc, self.vc, self.toks, self.lengths, self.last,
-             self.active, _, preds, effs, bads) = self._verify_fn(
-                self._head, self._stacked, self.kc, self.vc, self.toks,
-                self.lengths, self.last, self.active, remaining, eos,
-                self._poison_mask())
-        preds = np.asarray(preds)      # (chunk, S, K)
-        effs = np.asarray(effs)        # (chunk, S)
-        bads = np.asarray(bads)        # (chunk, S)
-        total = 0
-        for slot, req in live:
+        for slot, req in rec.live:
+            if req.done or self._slot_req[slot] is not req:
+                continue
             for j in range(self.chunk):
                 for t in range(int(effs[j, slot])):
-                    req.tokens.append(int(preds[j, slot, t]))
+                    self._apply_token(slot, req, int(preds[j, slot, t]))
                     total += 1
             if bads[:, slot].any():
                 self._fail(req, "non-finite logits", slot=slot,
                            stat="serve/nonfinite_evictions")
-        self._retire_done(live)
+        self._after_replay(rec)
+        self._resync_budgets(rec.live)
         return total
 
+    def _apply_token(self, slot: int, req: Request, token: int):
+        req.tokens.append(token)
+
+    def _after_replay(self, rec):
+        self._retire_done(rec.live)
+
+    def warmup(self):
+        """Pre-trace and compile every jitted function this engine can
+        dispatch — one prefill per bucket plus the decode path — on
+        throwaway state mirrors, so the first requests pay no compile
+        latency. The KV caches transiently exist twice while warming
+        (the mirrors are donated through the chain and freed at the
+        end); with a persistent compilation cache the compiles
+        themselves are amortized across processes."""
+        import time
+        from paddle_tpu import stats
+        t0 = time.perf_counter()
+        kc, vc = jnp.zeros_like(self.kc), jnp.zeros_like(self.vc)
+        toks = jnp.zeros_like(self.toks)
+        lengths = jnp.zeros_like(self.lengths)
+        last = jnp.zeros_like(self.last)
+        active = jnp.zeros_like(self.active)
+        remaining = jnp.zeros_like(self.remaining)
+        eos_ids = jnp.zeros_like(self.eos_ids)
+        rng = jax.random.PRNGKey(0)
+        for b in self.buckets:
+            (kc, vc, toks, lengths, last, active, remaining, eos_ids,
+             rng, _) = self._prefill_fn(
+                self._head, self._stacked, kc, vc, toks, lengths, last,
+                active, remaining, eos_ids, jnp.int32(0),
+                jnp.zeros((1, b), jnp.int32), jnp.int32(0), jnp.int32(1),
+                jnp.asarray(False), jnp.int32(0), jnp.int32(-1), rng)
+        poison = jnp.zeros((self.S,), bool)
+        if self.spec_k:
+            out = self._verify_fn(self._head, self._stacked, kc, vc,
+                                  toks, lengths, last, active, remaining,
+                                  eos_ids, poison)
+        else:
+            out = self._multi_fn(self._head, self._stacked, kc, vc,
+                                 lengths, last, active, remaining,
+                                 eos_ids, rng, poison)
+        jax.block_until_ready(out)
+        stats.observe("serve/warmup_s", time.perf_counter() - t0)
+
     def run(self) -> None:
-        """Drain: run steps until every submitted request is done."""
+        """Drain: run steps until every submitted request is done, then
+        harvest any trailing no-op dispatches (all requests can retire
+        while younger dispatches are still in flight — their flags are
+        all False, but their device buffers must not outlive the
+        work)."""
         while self._waiting or any(r is not None for r in self._slot_req):
             self.step()
+        self._drain()
 
     @property
     def num_active(self) -> int:

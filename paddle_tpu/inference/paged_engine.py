@@ -34,6 +34,16 @@ TPU design decisions:
   tokens per dispatch with per-slot eos/budget early-stop; pages for
   the whole chunk are reserved up front so the table is static inside
   the dispatch.
+- **Pipelined dispatch** (``PT_SERVE_INFLIGHT``, default 2): dispatch
+  and harvest halves exactly as in `DecodeEngine` — each harvested
+  dispatch costs ONE packed device→host transfer (the old `_step_inner`
+  materialized lengths, tokens, flags and bads separately), budgets/eos
+  ids persist on device, and page reservation runs against a host
+  shadow of per-slot lengths (`_host_len` exact at harvest, `_proj_len`
+  an upper bound over in-flight dispatches, capped at the request's
+  prompt+budget so projection never over-reserves the pool). The page
+  table uploads only when a reservation actually grows a table.
+  docs/serving.md.
 
 Greedy only (the paged pool is a serving-memory feature; sampling policy
 work stays in `DecodeEngine`).
@@ -50,7 +60,8 @@ from jax import lax
 
 from paddle_tpu.models import gpt as gpt_lib
 from paddle_tpu.inference.decode_engine import (Request,
-                                                ResilientScheduler)
+                                                ResilientScheduler,
+                                                _Inflight)
 from paddle_tpu.ops.pallas.decode_attention import fold_fresh_row
 from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
 
@@ -80,9 +91,12 @@ class PagedDecodeEngine(ResilientScheduler):
     def __init__(self, model, n_pages: int, max_slots: int = 8,
                  page_size: int = 128, steps_per_call: int = 1,
                  buckets=(16, 32, 64, 128, 256, 512),
-                 share_weights_with=None):
+                 share_weights_with=None, inflight=None,
+                 warmup: bool = False):
+        from paddle_tpu import compile_cache
         from paddle_tpu.inference.decode_engine import (
             resolve_engine_weights)
+        compile_cache.guard()
         cfg, head, stacked = resolve_engine_weights(model,
                                                     share_weights_with)
         if page_size % 128:
@@ -118,6 +132,10 @@ class PagedDecodeEngine(ResilientScheduler):
         self.lengths = jnp.zeros((self.S,), jnp.int32)
         self.last = jnp.zeros((self.S,), jnp.int32)
         self.active = jnp.zeros((self.S,), bool)
+        # budgets / eos ids persist on device across dispatches (set at
+        # admission) — pipelined dispatches need no host marshalling
+        self.remaining = jnp.zeros((self.S,), jnp.int32)
+        self.eos_ids = jnp.full((self.S,), -1, jnp.int32)
         self._slot_req: List[Optional[Request]] = [None] * self.S
         self._waiting: collections.deque = collections.deque()
         self.steps = 0
@@ -125,6 +143,16 @@ class PagedDecodeEngine(ResilientScheduler):
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=(2, 3))
         self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(2, 3))
+        self._init_pipeline(inflight)
+        # host shadows for page reservation: _host_len is the harvested
+        # (exact) device length; _proj_len an upper bound including
+        # in-flight dispatches (each grows a slot by <= chunk tokens)
+        self._host_len = np.zeros((self.S,), np.int64)
+        self._proj_len = np.zeros((self.S,), np.int64)
+        self._table_dev = None       # cached device page table
+        self._table_dirty = True
+        if warmup:
+            self.warmup()
 
     # -- pool bookkeeping ---------------------------------------------------
 
@@ -133,9 +161,14 @@ class PagedDecodeEngine(ResilientScheduler):
         return self._alloc.free_pages
 
     def _reserve(self, slot: int, n_tokens: int):
+        before = len(self._tables[slot])
         self._alloc.reserve(self._tables[slot], n_tokens)
+        if len(self._tables[slot]) != before:
+            self._table_dirty = True
 
     def _release(self, slot: int):
+        if self._tables[slot]:
+            self._table_dirty = True
         self._alloc.release(self._tables[slot])
 
     def _table_array(self) -> jnp.ndarray:
@@ -148,6 +181,16 @@ class PagedDecodeEngine(ResilientScheduler):
         for s, t in enumerate(self._tables):
             out[s, :len(t)] = t
         return jnp.asarray(out)
+
+    def _table(self) -> jnp.ndarray:
+        """The device page table, re-uploaded only when a reservation or
+        release actually changed a table — steady-state decode reuses
+        the cached device copy instead of paying a host→device transfer
+        per dispatch."""
+        if self._table_dirty or self._table_dev is None:
+            self._table_dev = self._table_array()
+            self._table_dirty = False
+        return self._table_dev
 
     # -- jitted bodies ------------------------------------------------------
 
@@ -235,7 +278,10 @@ class PagedDecodeEngine(ResilientScheduler):
                     active, remaining, eos, poison):
         """``chunk`` decode steps in one dispatch, per-slot eos/budget/
         non-finite early-stop device-side (pages for the whole chunk are
-        reserved before the dispatch, so ``table`` is static here)."""
+        reserved before the dispatch, so ``table`` is static here).
+        Tokens, emit flags and non-finite flags come back PACKED into
+        one (3, chunk, S) int32 array — the lagged harvest pays exactly
+        one device→host transfer."""
 
         def one(carry, _):
             kp, vp, lengths, last, active, remaining = carry
@@ -252,7 +298,9 @@ class PagedDecodeEngine(ResilientScheduler):
         (kp, vp, lengths, last, active, remaining), (toks, flags, bads) = \
             lax.scan(one, (kp, vp, lengths, last, active, remaining),
                      None, length=self.chunk)
-        return kp, vp, lengths, last, active, remaining, toks, flags, bads
+        packed = jnp.stack([toks, flags.astype(jnp.int32),
+                            bads.astype(jnp.int32)])
+        return kp, vp, lengths, last, active, remaining, packed
 
     def _prefill_impl(self, head, stacked, kp, vp, tokens, true_len,
                       write_segments):
@@ -378,6 +426,12 @@ class PagedDecodeEngine(ResilientScheduler):
         super()._on_evict(slot)
 
     def _admit(self, req: Request, slot: int):
+        """Reserve pages, dispatch the one-pass prefill, and flip the
+        slot live — WITHOUT syncing on the sampled first token: it
+        stays on device (`.at[].set(nxt)`) and rides the harvest queue
+        as a 'prefill' record, so admission enqueues behind in-flight
+        decode dispatches instead of draining them."""
+        import time
         from paddle_tpu.observability import trace
         prompt = np.asarray(req.prompt, np.int32)
         n = len(prompt)
@@ -402,11 +456,23 @@ class PagedDecodeEngine(ResilientScheduler):
             self.kp, self.vp, nxt = self._prefill_fn(
                 self._head, self._stacked, self.kp, self.vp,
                 jnp.asarray(padded), jnp.int32(n), jnp.asarray(segs))
+        rem0 = req.max_new_tokens - 1
+        eos0 = -1 if req.eos_id is None else int(req.eos_id)
+        # a budget-of-one request (or one whose first token is eos)
+        # never activates — the device analog of _emit retiring it
+        alive = jnp.logical_and(
+            rem0 > 0, jnp.logical_or(eos0 < 0, nxt != eos0))
         self.lengths = self.lengths.at[slot].set(n)
-        self.last = self.last.at[slot].set(int(nxt))
-        self.active = self.active.at[slot].set(True)
+        self.last = self.last.at[slot].set(nxt)
+        self.active = self.active.at[slot].set(alive)
+        self.remaining = self.remaining.at[slot].set(rem0)
+        self.eos_ids = self.eos_ids.at[slot].set(eos0)
         self._slot_req[slot] = req
-        self._emit(slot, req, int(nxt))
+        self._host_len[slot] = n
+        self._proj_len[slot] = n
+        self._disp_rem[slot] = rem0
+        self._pending.append(_Inflight("prefill", [(slot, req)], nxt,
+                                       time.perf_counter()))
 
     def _emit(self, slot: int, req: Request, token: int):
         req.tokens.append(token)
@@ -423,80 +489,144 @@ class PagedDecodeEngine(ResilientScheduler):
         import time
         from paddle_tpu.observability import trace
         t0 = time.perf_counter()
+        base = self.tokens_emitted
         with trace.span("serve/step") as sp:
-            total, n_live = self._step_inner(sp)
-        if n_live:
+            n_live = self._step_inner(sp)
+            n = self.tokens_emitted - base
+            sp.attrs["tokens"] = n
+        if n_live or n:
             # idle polls record nothing (matching DecodeEngine): zero
             # occupancy/queue samples from an empty engine would read
             # as "admission-bound" on the dashboards
-            self._obs_step(t0, total, n_live)
-        return total
+            self._obs_step(t0, n, n_live)
+        return n
 
-    def _step_inner(self, sp):
-        """Returns (tokens emitted, live slot count) for the obs hooks."""
+    def _step_inner(self, sp) -> int:
+        """One pipeline step — evict (drain boundary), admit, dispatch,
+        harvest lag-one. Each harvested dispatch costs exactly ONE
+        packed device→host transfer. Returns the live slot count for
+        the obs hooks."""
         self._evict_expired()
+        self._admit_waiting()
+        self._pump(self._dispatch_decode())
+        live = sum(r is not None for r in self._slot_req)
+        sp.attrs["active"] = live
+        return live
+
+    def _admit_waiting(self):
+        drained = False
         while self._waiting:
             slot = self._free_slot()
             if slot is None:
-                break
+                return
             req = self._waiting.popleft()
             try:
                 self._admit(req, slot)
             except MemoryError:
                 # not enough pages right now: return the partial
-                # reservation, requeue, and keep decoding — page
-                # retirements will make room
+                # reservation and requeue. Retired pages may be stuck
+                # in unharvested dispatches — drain once and retry
+                # before falling back to decode-until-room
                 self._release(slot)
                 self._waiting.appendleft(req)
+                if self._pending and not drained:
+                    self._drain()
+                    drained = True
+                    continue
                 if not any(r is not None for r in self._slot_req):
                     raise MemoryError(
                         f"page pool ({self.P} pages of {self.page}) too "
                         f"small for even one request of "
                         f"{len(req.prompt)} tokens")
-                break
-        live = [(s, r) for s, r in enumerate(self._slot_req)
-                if r is not None]
-        if not live:
-            return 0, 0
+                return
+
+    def _reserve_chunk(self, live):
+        """Reserve pages for one chunk per live slot against the
+        PROJECTED length (host shadow + in-flight growth), capped at
+        the request's true maximum (prompt + budget) so projection
+        slack never demands pages the request cannot use."""
+        for slot, req in live:
+            cap = len(req.prompt) + req.max_new_tokens
+            need = min(int(self._proj_len[slot]) + self.chunk + 1, cap)
+            self._reserve(slot, need)
+
+    def _dispatch_decode(self) -> bool:
         from paddle_tpu.observability import trace
-        # reserve pages for the whole chunk so the table is static
-        lens_host = np.asarray(self.lengths)
-        for slot, req in live:
-            budget = min(self.chunk,
-                         req.max_new_tokens - len(req.tokens))
-            self._reserve(slot, int(lens_host[slot]) + budget + 1)
-        remaining = np.zeros((self.S,), np.int32)
-        eos = np.full((self.S,), -1, np.int32)
-        for slot, req in live:
-            remaining[slot] = req.max_new_tokens - len(req.tokens)
-            if req.eos_id is not None:
-                eos[slot] = req.eos_id
+
+        def _live():
+            return [(s, r) for s, r in enumerate(self._slot_req)
+                    if r is not None and self._disp_rem[s] > 0]
+
+        live = _live()
+        if not live:
+            return False
+        try:
+            self._reserve_chunk(live)
+        except MemoryError:
+            # pool pressure: retired pages may sit in unharvested
+            # dispatches — drain, re-anchor the shadows, retry once
+            if not self._pending:
+                raise
+            self._drain()
+            live = _live()
+            if not live:
+                return False
+            self._reserve_chunk(live)
         self.steps += 1
-        with trace.span("serve/dispatch", kind="paged",
-                        chunk=self.chunk):
-            (self.kp, self.vp, self.lengths, self.last, self.active, _,
-             toks, flags, bads) = self._multi_fn(
+        self._obs_host_gap()
+        with trace.span("serve/dispatch", kind="paged", chunk=self.chunk,
+                        inflight=len(self._pending)):
+            (self.kp, self.vp, self.lengths, self.last, self.active,
+             self.remaining, packed) = self._multi_fn(
                 self._head, self._stacked, self.kp, self.vp,
-                self._table_array(), self.lengths, self.last, self.active,
-                jnp.asarray(remaining), jnp.asarray(eos),
-                self._poison_mask())
-        toks = np.asarray(toks)
-        flags = np.asarray(flags)
-        bads = np.asarray(bads)
-        total = 0
+                self._table(), self.lengths, self.last, self.active,
+                self.remaining, self.eos_ids, self._poison_mask())
+        for s, _ in live:
+            self._proj_len[s] += self.chunk
+        self._finish_dispatch("decode", live, packed)
+        return True
+
+    def _resync_budgets(self, live, cover=None):
+        if cover is None:
+            cover = self._pending_cover()
+        super()._resync_budgets(live, cover)
         for slot, req in live:
-            for j in range(self.chunk):
-                if flags[j, slot] and not req.done:
-                    self._emit(slot, req, int(toks[j, slot]))
-                    total += 1
-            if bads[:, slot].any() and not req.done:
-                self._fail(req, "non-finite logits", slot=slot,
-                           stat="serve/nonfinite_evictions")
-        sp.attrs["active"] = len(live)
-        sp.attrs["tokens"] = total
-        self.tokens_emitted += total
-        return total, len(live)
+            if req.done or self._slot_req[slot] is not req:
+                continue
+            self._proj_len[slot] = (self._host_len[slot]
+                                    + self.chunk * cover.get(slot, 0))
+
+    def _apply_token(self, slot, req, token):
+        """Harvested token (shared base replay): emit — which retires
+        the request and releases its pages the moment budget/eos hits —
+        and advance the exact host length shadow (device lengths grew
+        by one for every emitted flag)."""
+        self._emit(slot, req, token)
+        self._host_len[slot] += 1
+
+    def warmup(self):
+        """Pre-trace/compile every (bucket, decode) jitted function on
+        throwaway pool mirrors (the pool transiently exists twice) so
+        first requests pay no compile latency."""
+        import time
+        from paddle_tpu import stats
+        t0 = time.perf_counter()
+        kp, vp = jnp.zeros_like(self.kp), jnp.zeros_like(self.vp)
+        for b in self.buckets:
+            segs = np.zeros((b // self.page + 1, self.cfg.n_layers, 3),
+                            np.int32)
+            kp, vp, _ = self._prefill_fn(
+                self._head, self._stacked, kp, vp,
+                jnp.zeros((1, b), jnp.int32), jnp.int32(1),
+                jnp.asarray(segs))
+        out = self._multi_fn(
+            self._head, self._stacked, kp, vp, self._table(),
+            self.lengths, self.last, self.active, self.remaining,
+            self.eos_ids, jnp.zeros((self.S,), bool))
+        jax.block_until_ready(out)
+        stats.observe("serve/warmup_s", time.perf_counter() - t0)
 
     def run(self) -> None:
         while self._waiting or any(r is not None for r in self._slot_req):
             self.step()
+        self._drain()   # trailing no-op dispatches (see DecodeEngine.run)

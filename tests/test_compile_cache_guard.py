@@ -1,0 +1,100 @@
+"""Persistent-compilation-cache hardening (ISSUE 4 satellite): flaky
+cache entries (BENCH r05's RESOURCE_EXHAUSTED read warnings) must be
+COUNTED into serve/compile_cache_errors and printed once, never spam or
+abort a serving process; enabling a broken cache falls back to cold
+compiles instead of raising."""
+
+import warnings
+
+import pytest
+
+from paddle_tpu import compile_cache, stats
+
+
+@pytest.fixture
+def fresh_guard(monkeypatch):
+    """Reinstall the guard over a recording stub so the test sees what
+    would reach the user, regardless of prior installs in-process."""
+    shown = []
+    monkeypatch.setattr(
+        warnings, "showwarning",
+        lambda message, *a, **k: shown.append(str(message)))
+    monkeypatch.setattr(compile_cache, "_hook", None)
+    monkeypatch.setattr(compile_cache, "_printed", False)
+    compile_cache.guard()
+    return shown
+
+
+def test_cache_warnings_counted_and_printed_once(fresh_guard):
+    shown = fresh_guard
+    stats.reset("serve/compile_cache_errors")
+    msg = ("Error reading persistent compilation cache entry for "
+           "'jit_convert_element_type': JaxRuntimeError: "
+           "RESOURCE_EXHAUSTED: TPU backend error (ResourceExhausted).")
+    for _ in range(3):
+        warnings.warn(msg)
+    assert stats.get("serve/compile_cache_errors") == 3
+    assert sum("persistent compilation cache" in s for s in shown) == 1
+
+    # unrelated warnings pass through untouched and uncounted
+    warnings.warn("something else entirely", stacklevel=1)
+    assert any("something else" in s for s in shown)
+    assert stats.get("serve/compile_cache_errors") == 3
+
+
+def test_guard_is_idempotent(fresh_guard):
+    hook = warnings.showwarning
+    compile_cache.guard()
+    compile_cache.guard()
+    assert warnings.showwarning is hook
+
+
+def test_guard_reinstalls_after_displacement(fresh_guard):
+    """A warnings.catch_warnings() exit (or any library swapping
+    showwarning) displaces the hook; the next guard() call — every
+    engine construction — must re-install it."""
+    displaced = []
+    warnings.showwarning = lambda message, *a, **k: \
+        displaced.append(str(message))
+    compile_cache.guard()
+    assert warnings.showwarning is compile_cache._hook
+    from paddle_tpu import stats
+    stats.reset("serve/compile_cache_errors")
+    warnings.warn("Error reading persistent compilation cache entry")
+    assert stats.get("serve/compile_cache_errors") == 1
+    assert displaced   # chained through to the displaced hook
+
+
+def test_guard_env_opt_out(fresh_guard, monkeypatch):
+    monkeypatch.setenv("PT_COMPILE_CACHE_GUARD", "0")
+    hook = warnings.showwarning
+    warnings.showwarning = hook2 = lambda *a, **k: None
+    compile_cache.guard()
+    assert warnings.showwarning is hook2   # untouched
+    warnings.showwarning = hook
+
+
+def test_enable_falls_back_instead_of_raising(fresh_guard, monkeypatch):
+    import jax
+
+    stats.reset("serve/compile_cache_errors")
+
+    def boom(*a, **k):
+        raise RuntimeError("cache backend unavailable")
+
+    monkeypatch.setattr(jax.config, "update", boom)
+    assert compile_cache.enable("/nonexistent/cache/dir") is False
+    assert stats.get("serve/compile_cache_errors") == 1
+
+
+def test_engines_install_guard(monkeypatch):
+    import jax.numpy as jnp
+    from paddle_tpu.inference.decode_engine import DecodeEngine
+    from paddle_tpu.models import gpt
+
+    monkeypatch.setattr(compile_cache, "_hook", None)
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=64, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    DecodeEngine(gpt.GPT(cfg, seed=0), max_slots=1, max_len=64)
+    assert (compile_cache._hook is not None
+            and warnings.showwarning is compile_cache._hook)

@@ -128,6 +128,47 @@ def test_page_size_must_divide_buckets():
         PagedDecodeEngine(model, n_pages=4, max_slots=1, page_size=384)
 
 
+@pytest.mark.parametrize("depth", [2, 3])
+def test_paged_pipelined_depths_bit_identical(depth):
+    """ISSUE 4: the pipelined paged engine (lag-one harvest, one packed
+    transfer per dispatch) serves byte-identical streams to depth=1,
+    with every page back in the pool at drain."""
+    model = _model()
+    rs = np.random.RandomState(6)
+    prompts = [list(rs.randint(0, 96, size=n)) for n in (5, 170, 23)]
+
+    def run(d):
+        eng = PagedDecodeEngine(model, n_pages=12, max_slots=2,
+                                steps_per_call=4, inflight=d)
+        reqs = [eng.submit(p, max_new_tokens=9) for p in prompts]
+        eng.step()
+        eng.run()
+        assert eng.free_pages == 12
+        assert all(r.done and not r.failed for r in reqs)
+        return [list(r.tokens) for r in reqs]
+
+    base = run(1)
+    for got, p in zip(base, prompts):
+        assert got == _reference(model, p, 9), len(p)
+    assert run(depth) == base
+
+
+def test_paged_warmup_pretraces():
+    model = _model()
+    eng = PagedDecodeEngine(model, n_pages=8, max_slots=2,
+                            steps_per_call=2, buckets=(16, 32),
+                            warmup=True)
+    assert eng._prefill_fn._cache_size() == 2
+    assert eng._multi_fn._cache_size() == 1
+    rs = np.random.RandomState(7)
+    p = list(rs.randint(0, 96, size=20))
+    r = eng.submit(p, max_new_tokens=6)
+    eng.run()
+    assert r.tokens == _reference(model, p, 6)
+    assert eng._prefill_fn._cache_size() == 2, "serving recompiled"
+    assert eng._multi_fn._cache_size() == 1, "serving recompiled"
+
+
 def test_paged_share_weights_with_decode_engine_donor():
     """The bench path: a PagedDecodeEngine built from a DecodeEngine's
     stacked weights (no model, no duplicate copy) serves identically."""

@@ -105,6 +105,7 @@ def test_poisoned_logits_paged_engine():
     free_before = eng.free_pages
     with faults.inject("engine.poison_logits", "nan", slot=1, count=1):
         eng.step()
+    eng.drain()   # pipelined: the poisoned dispatch lands at harvest
     assert r1.failed and r1.error == "non-finite logits"
     eng.run()
     assert r0.done and not r0.failed
@@ -130,6 +131,148 @@ def test_poisoned_logits_speculative_path():
     assert r1.failed and r1.error == "non-finite logits"
     assert r0.done and not r0.failed
     assert r0.tokens == _reference_tokens(model, p0, 6)
+
+
+# -- ISSUE 4: degradation under the pipelined (depth >= 2) runtime -----------
+
+@pytest.mark.parametrize("chunk,spec_k", [(1, 0), (4, 0), (2, 3)],
+                         ids=["plain", "chunked", "speculative"])
+def test_midpipeline_poison_eviction_matches_depth1(chunk, spec_k):
+    """PT_FAULTS-style nan poison landing while dispatches are in
+    flight: the poisoned request is evicted at harvest, the survivor's
+    stream is BYTE-identical to the synchronous depth=1 engine's."""
+    model = _model()
+    rs = np.random.RandomState(7)
+    p0 = list(rs.randint(0, 96, size=5))
+    p1 = list(rs.randint(0, 96, size=7))
+
+    def run(depth):
+        stats.reset("serve/")
+        faults.clear()   # reset the per-site call index between depths
+        eng = DecodeEngine(model, max_slots=2, max_len=160,
+                           steps_per_call=chunk, speculative_k=spec_k,
+                           inflight=depth)
+        r0 = eng.submit(p0, max_new_tokens=8)
+        r1 = eng.submit(p1, max_new_tokens=8)
+        eng.step()
+        with faults.inject("engine.poison_logits", "nan", slot=1,
+                           count=1):
+            eng.step()
+        eng.run()
+        assert r1.failed and r1.error == "non-finite logits"
+        assert not r0.failed
+        assert stats.get("serve/nonfinite_evictions") == 1
+        return list(r0.tokens)
+
+    base = run(1)
+    assert base == _reference_tokens(model, p0, 8)
+    for depth in (2, 3):
+        assert run(depth) == base, f"depth {depth} survivor diverged"
+
+
+def test_midpipeline_deadline_eviction_drains_first():
+    """A live request expiring while dispatches are in flight: the
+    pipeline drains (in-flight tokens applied), the expired request is
+    evicted alone, and the surviving peer still matches the
+    reference."""
+    import time
+    model = _model()
+    rs = np.random.RandomState(8)
+    p_ok = list(rs.randint(0, 96, size=5))
+    p_dead = list(rs.randint(0, 96, size=5))
+    eng = DecodeEngine(model, max_slots=2, max_len=128, inflight=3)
+    r_ok = eng.submit(p_ok, max_new_tokens=20)
+    # a budget far beyond what fits in the deadline window, so the
+    # request can never finish before the sweep evicts it
+    r_dead = eng.submit(p_dead, max_new_tokens=100, deadline_s=0.02)
+    eng.step()
+    eng.step()          # pipeline holds in-flight dispatches now
+    time.sleep(0.03)
+    eng.run()
+    assert r_dead.failed and "deadline" in r_dead.error
+    assert len(eng._pending) == 0
+    assert r_ok.done and not r_ok.failed
+    assert r_ok.tokens == _reference_tokens(model, p_ok, 20)
+
+
+def test_pt_faults_env_nan_poison_pipelined(monkeypatch):
+    """The PT_FAULTS env route (subprocess contract) composes with the
+    pipeline: a nan rule installed from the environment evicts exactly
+    one request at harvest; peers serve the reference stream."""
+    model = _model()
+    rs = np.random.RandomState(11)
+    p0 = list(rs.randint(0, 96, size=5))
+    p1 = list(rs.randint(0, 96, size=6))
+    monkeypatch.setenv("PT_FAULTS",
+                       "engine.poison_logits:nan:slot=1,after=1,count=1")
+    faults.clear()
+    assert faults.install_from_env() == 1
+    try:
+        stats.reset("serve/")
+        eng = DecodeEngine(model, max_slots=2, max_len=128, inflight=2)
+        r0 = eng.submit(p0, max_new_tokens=6)
+        r1 = eng.submit(p1, max_new_tokens=6)
+        eng.run()
+        assert r1.failed and r1.error == "non-finite logits"
+        assert stats.get("serve/nonfinite_evictions") == 1
+        assert r0.done and not r0.failed
+        assert r0.tokens == _reference_tokens(model, p0, 6)
+    finally:
+        faults.clear()
+
+
+def test_deadline_eviction_mid_admission_abandons_prefill():
+    """A request evicted while its chunked prefill is still dispatching
+    (interleaved admission) must be abandoned cleanly: no tokens, its
+    open prefill job dropped, and the slot re-admits the next request
+    which serves exactly."""
+    import time
+    model = _model()
+    rs = np.random.RandomState(10)
+    long_p = list(rs.randint(0, 96, size=120))   # 8 chunks of 16
+    nxt_p = list(rs.randint(0, 96, size=6))
+    eng = DecodeEngine(model, max_slots=1, max_len=160, buckets=(16,),
+                       prefill_tokens=16, inflight=2)
+    r_dead = eng.submit(long_p, max_new_tokens=5, deadline_s=0.01)
+    r_ok = eng.submit(nxt_p, max_new_tokens=5)
+    eng.step()          # admission opens; one chunk dispatched
+    time.sleep(0.02)
+    eng.run()
+    assert r_dead.failed and "deadline" in r_dead.error
+    assert r_dead.tokens == []
+    assert not eng._admitting
+    assert r_ok.done and not r_ok.failed
+    assert r_ok.tokens == _reference_tokens(model, nxt_p, 5)
+
+
+def test_paged_pipelined_poison_and_parity():
+    """Paged-engine parity under the pipeline: depth 3 serves the same
+    streams as depth 1, and a poisoned request's pages return to the
+    pool at harvest without disturbing peers."""
+    model = _model()
+    rs = np.random.RandomState(9)
+    p0 = list(rs.randint(0, 96, size=5))
+    p1 = list(rs.randint(0, 96, size=6))
+
+    def run(depth):
+        stats.reset("serve/")
+        faults.clear()   # reset the per-site call index between depths
+        eng = PagedDecodeEngine(model, n_pages=16, max_slots=2,
+                                steps_per_call=2, inflight=depth)
+        r0 = eng.submit(p0, max_new_tokens=8)
+        r1 = eng.submit(p1, max_new_tokens=8)
+        eng.step()
+        with faults.inject("engine.poison_logits", "nan", slot=1,
+                           count=1):
+            eng.step()
+        eng.run()
+        assert r1.failed and r1.error == "non-finite logits"
+        assert eng.free_pages == 16   # every page back in the pool
+        return list(r0.tokens)
+
+    base = run(1)
+    assert base == _reference_tokens(model, p0, 8)
+    assert run(3) == base
 
 
 def test_clean_run_unaffected_by_guards():

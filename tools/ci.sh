@@ -13,6 +13,10 @@
 #                          train+decode+checkpoint step and asserts a
 #                          non-empty schema-valid trace file, serving
 #                          percentiles, and a live statsz endpoint
+#   tools/ci.sh serve      pipelined-serving smoke: decode under fault
+#                          injection at in-flight depth 1 vs 3 must
+#                          produce byte-identical survivor streams on
+#                          every path (plain/chunked/spec/paged)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -28,6 +32,11 @@ fi
 if [[ "${1:-}" == "obs" ]]; then
     shift
     exec python tools/obs_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "serve" ]]; then
+    shift
+    exec python tools/serve_smoke.py "$@"
 fi
 
 python -m pytest tests/ -q --durations=15 "$@"

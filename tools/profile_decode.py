@@ -31,12 +31,46 @@ from paddle_tpu.inference.decode_engine import (
     DecodeEngine, decode_roofline_tokens_per_sec)
 
 
-def run_engine(model, slots=8, s_pf=128, n_new=128, chunk=64, spec_k=0):
+def pipeline_report(eng):
+    """ISSUE 4: in-flight depth, per-step host gap, and dispatch/harvest
+    overlap, measured from the trace ring + stats histograms of the run
+    just finished. 'overlap' = fraction of harvests that blocked while
+    at least one younger dispatch was already enqueued (the lag-one
+    win); 'host_gap' = host-side bubble between consecutive dispatch
+    enqueues — what the device idles on at depth 1."""
+    from paddle_tpu import stats
+    from paddle_tpu.observability import trace
+    snap = stats.snapshot("serve/")
+    evs, _ = trace.events()
+    spans = [e for e in evs if e is not None]
+    disp = [e for e in spans if e[0] == "serve/dispatch"]
+    harv = [e for e in spans if e[0] == "serve/harvest"]
+    # overlap over DECODE harvests only (prefill records are admission
+    # plumbing): the fraction whose blocking readback ran while a
+    # younger dispatch was already keeping the device busy
+    dec = [e for e in harv if (e[6] or {}).get("kind") != "prefill"]
+    overlapped = sum(1 for e in dec
+                     if (e[6] or {}).get("inflight", 0) >= 1)
+    return {
+        "depth": eng.depth,
+        "host_gap_p50_ms": snap.get("serve/host_gap_s.p50", 0) * 1e3,
+        "host_gap_p99_ms": snap.get("serve/host_gap_s.p99", 0) * 1e3,
+        "dispatch_ms": sum(e[2] for e in disp) / 1e6,
+        "harvest_ms": sum(e[2] for e in harv) / 1e6,
+        "overlap": overlapped / max(1, len(dec)),
+    }
+
+
+def run_engine(model, slots=8, s_pf=128, n_new=128, chunk=64, spec_k=0,
+               inflight=None, warmup=False):
+    from paddle_tpu import stats
+    from paddle_tpu.observability import trace
     cfg = model.cfg
     eng = DecodeEngine(model, max_slots=slots,
                        max_len=s_pf + n_new + (128 + spec_k if spec_k
                                                else 0),
-                       steps_per_call=chunk, speculative_k=spec_k)
+                       steps_per_call=chunk, speculative_k=spec_k,
+                       inflight=inflight, warmup=warmup)
     rs = np.random.RandomState(1)
     if spec_k:   # repetition-heavy prompts: the regime spec serves
         loops = [list(rs.randint(0, cfg.vocab_size, 8))
@@ -47,7 +81,10 @@ def run_engine(model, slots=8, s_pf=128, n_new=128, chunk=64, spec_k=0):
                    for _ in range(slots)]
     for p in prompts:
         eng.submit(p, max_new_tokens=2)
-    eng.run()  # warm compile
+    eng.run()  # warm compile (no-op with warmup=True)
+    stats.reset("serve/")
+    trace.clear(capacity=65536)
+    trace.enable()          # in-memory ring only: no file unless asked
     reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
     eng.step()
     pre = sum(len(r.tokens) for r in reqs)
@@ -57,14 +94,18 @@ def run_engine(model, slots=8, s_pf=128, n_new=128, chunk=64, spec_k=0):
     dt = time.perf_counter() - t0
     toks = sum(len(r.tokens) for r in reqs) - pre
     dispatches = eng.steps - d0
+    rep = pipeline_report(eng)
+    trace.disable()
+    trace.clear()
     eng.kc = eng.vc = eng._stacked = None
     del eng
-    return toks / dt, dispatches
+    return toks / dt, dispatches, rep
 
 
 def main():
     size = os.environ.get("PD_SIZE", "1p3b")
     cfg = (gpt.gpt3_1p3b(max_seq_len=2048) if size == "1p3b"
+           else gpt.gpt_tiny(max_seq_len=512) if size == "tiny"
            else gpt.gpt3_350m(max_seq_len=1024))
     print("building model", size, flush=True)
     model = gpt.GPT(cfg, seed=0)
@@ -74,19 +115,37 @@ def main():
     from paddle_tpu.cost_model import _peak
     hbm = _peak(dev)[1] / 1e9
 
+    def show(label, tps, disp, roof, rep):
+        print(f"{label}: {tps:.1f} tok/s ({disp} dispatches) "
+              f"roofline={roof:.0f} ratio={tps / roof:.3f}", flush=True)
+        print(f"  pipeline: depth={rep['depth']} "
+              f"host_gap p50={rep['host_gap_p50_ms']:.2f}ms "
+              f"p99={rep['host_gap_p99_ms']:.2f}ms "
+              f"dispatch={rep['dispatch_ms']:.1f}ms "
+              f"harvest={rep['harvest_ms']:.1f}ms "
+              f"overlap={rep['overlap']:.0%}", flush=True)
+
+    # PD_INFLIGHT sweeps explicit depths (e.g. PD_INFLIGHT=1,2,4) to
+    # A/B the pipeline against the synchronous baseline; unset uses the
+    # engine default (PT_SERVE_INFLIGHT or 2)
+    sweep = [int(x) for x in os.environ.get("PD_INFLIGHT", "").split(",")
+             if x.strip()] or [None]
+
     for slots, s_pf, n_new in ((8, 128, 128), (16, 128, 128)):
         roof = decode_roofline_tokens_per_sec(
             cfg, slots, s_pf + n_new // 2, hbm)
-        tps, disp = run_engine(model, slots=slots, s_pf=s_pf, n_new=n_new)
-        print(f"slots={slots} ctx={s_pf}+{n_new}: {tps:.1f} tok/s "
-              f"({disp} dispatches) roofline={roof:.0f} "
-              f"ratio={tps / roof:.3f}", flush=True)
+        for depth in sweep:
+            tps, disp, rep = run_engine(model, slots=slots, s_pf=s_pf,
+                                        n_new=n_new, inflight=depth)
+            show(f"slots={slots} ctx={s_pf}+{n_new}", tps, disp, roof,
+                 rep)
 
     if os.environ.get("PD_SPEC", "0") == "1":
         roof = decode_roofline_tokens_per_sec(cfg, 8, 192, hbm)
-        tps, disp = run_engine(model, chunk=16, spec_k=4)
-        print(f"spec k=4 chunk=16: {tps:.1f} tok/s ({disp} dispatches) "
-              f"vs roofline={roof:.0f} ratio={tps / roof:.3f}", flush=True)
+        for depth in sweep:
+            tps, disp, rep = run_engine(model, chunk=16, spec_k=4,
+                                        inflight=depth)
+            show("spec k=4 chunk=16", tps, disp, roof, rep)
 
 
 if __name__ == "__main__":
