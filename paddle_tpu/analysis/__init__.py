@@ -1,0 +1,31 @@
+"""ptlint — TPU-aware static analysis for paddle_tpu.
+
+Pure-``ast`` (no jax import): lints the tree for the invariant classes
+the profiler only catches after the fact. Rule families:
+
+======  =====================================================
+PT001   host syncs in traced / serving-dispatch code
+PT002   jit retrace & recompile hazards
+PT003   side effects (stats/trace/faults, mutation) in traced code
+PT004   rank-divergent collective ordering (static deadlock)
+PT005   PT_* env vars missing from the flags.py contract registry
+======  =====================================================
+
+Library use::
+
+    from paddle_tpu.analysis import load_project, run
+    project = load_project(["paddle_tpu"])
+    findings = run(project)
+
+CLI: ``python tools/ptlint.py paddle_tpu`` (exits nonzero on findings
+not in tools/ptlint_baseline.json). Suppress a deliberate finding inline
+with ``# ptlint: disable=PT001 -- why`` (docs/static-analysis.md).
+"""
+
+from paddle_tpu.analysis.engine import (FileContext, Finding, Project,
+                                        Rule, default_rules,
+                                        load_project, run)
+from paddle_tpu.analysis import baseline
+
+__all__ = ["FileContext", "Finding", "Project", "Rule",
+           "default_rules", "load_project", "run", "baseline"]

@@ -1,0 +1,74 @@
+"""Baseline (grandfather) file for ptlint findings.
+
+A finding's **fingerprint** is content-anchored, not line-anchored:
+``sha1(rule | path | symbol | normalized source line | k)`` where ``k``
+disambiguates identical lines within one (rule, path, symbol) group by
+order of appearance. Unrelated edits that shift line numbers therefore
+do NOT invalidate the baseline; editing the flagged line itself does —
+which is the desired behavior (the finding should be re-triaged).
+
+The checked-in file (tools/ptlint_baseline.json) records deliberate,
+explained findings; ``tools/ptlint.py --error-on-new`` fails only on
+findings NOT in it. Prefer inline ``# ptlint: disable=`` suppressions
+for deliberate sites (self-documenting); use the baseline for bulk
+grandfathering when adopting a new rule.
+"""
+
+import hashlib
+import json
+from typing import Dict, List, Tuple
+
+BASELINE_VERSION = 1
+
+
+def _normalize(text: str) -> str:
+    return "".join(text.split())
+
+
+def fingerprint_all(findings, project) -> None:
+    """Fill ``finding.fingerprint`` for every finding (in place)."""
+    seen: Dict[Tuple[str, str, str, str], int] = {}
+    for f in findings:
+        ctx = project.file(f.path)
+        norm = _normalize(ctx.line_text(f.line)) if ctx else ""
+        key = (f.rule, f.path, f.symbol, norm)
+        k = seen.get(key, 0)
+        seen[key] = k + 1
+        raw = "|".join((f.rule, f.path, f.symbol, norm, str(k)))
+        f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+def write(path: str, findings) -> None:
+    payload = {
+        "version": BASELINE_VERSION,
+        "findings": [
+            {"fingerprint": f.fingerprint, "rule": f.rule, "path": f.path,
+             "symbol": f.symbol, "message": f.message}
+            for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load(path: str) -> Dict[str, dict]:
+    """fingerprint -> recorded entry. Missing file = empty baseline."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    if payload.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version "
+            f"{payload.get('version')!r} (want {BASELINE_VERSION})")
+    return {e["fingerprint"]: e for e in payload.get("findings", [])}
+
+
+def partition(findings, baseline: Dict[str, dict]):
+    """(new, known): findings absent from / present in the baseline."""
+    new: List = []
+    known: List = []
+    for f in findings:
+        (known if f.fingerprint in baseline else new).append(f)
+    return new, known

@@ -1,0 +1,214 @@
+"""ptlint core: file loading, suppression handling, rule driving.
+
+The analysis engine is deliberately dependency-free (pure ``ast`` — no
+jax import), so ``tools/ptlint.py`` runs in milliseconds on a CPU-only
+CI shard and can lint the tree even when the accelerator stack is
+broken.
+
+Model:
+
+- A :class:`FileContext` is one parsed source file plus its suppression
+  comments.
+- A :class:`Project` is the set of files under lint plus the package
+  call graph (``paddle_tpu.analysis.callgraph``) rules share.
+- A :class:`Rule` contributes :class:`Finding`\\ s; the engine filters
+  suppressed ones and hands the rest to the baseline layer
+  (``paddle_tpu.analysis.baseline``) which decides what is NEW.
+
+Suppressions (checked per finding line):
+
+    x = np.asarray(pkt)   # ptlint: disable=PT001 -- the ONE harvest copy
+    # ptlint: disable=PT003 -- next-line form
+    stats.add("collective/calls")
+
+and a whole-file form near the top of the file::
+
+    # ptlint: disable-file=PT004
+
+``disable=all`` silences every rule for the line/file.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ptlint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_,\s]+?)(?:\s*--.*)?$")
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str                 # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    symbol: str = ""          # enclosing function qualname, if any
+    fingerprint: str = ""     # filled by baseline.fingerprint_all
+
+    def format(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.severity}: {self.message}{sym}")
+
+
+class FileContext:
+    """One source file under lint."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of rule ids (or {"all"}) suppressed on that line
+        self.line_suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self._scan_suppressions()
+
+    def _scan_suppressions(self):
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            kind, rules = m.group(1), m.group(2)
+            ids = {r.strip().upper() for r in rules.split(",") if r.strip()}
+            if kind == "disable-file":
+                self.file_suppressions |= ids
+            elif text.strip().startswith("#"):
+                # standalone comment: applies to the next CODE line
+                # (explanations may span several comment lines)
+                j = i + 1
+                while j <= len(self.lines) and (
+                        not self.lines[j - 1].strip()
+                        or self.lines[j - 1].strip().startswith("#")):
+                    j += 1
+                self.line_suppressions.setdefault(j, set()).update(ids)
+            else:
+                self.line_suppressions.setdefault(i, set()).update(ids)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if {"ALL", rule.upper()} & self.file_suppressions:
+            return True
+        ids = self.line_suppressions.get(line, ())
+        return "ALL" in ids or rule.upper() in ids
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def segment(self, node: ast.AST) -> str:
+        try:
+            return ast.get_source_segment(self.source, node) or ""
+        except Exception:
+            return ""
+
+
+class Project:
+    """Every file under lint + the shared call graph."""
+
+    def __init__(self, files: List[FileContext], root: str):
+        self.files = files
+        self.root = root
+        self.by_relpath = {f.relpath: f for f in files}
+        from paddle_tpu.analysis import callgraph
+        self.callgraph = callgraph.CallGraph(files)
+
+    def file(self, relpath: str) -> Optional[FileContext]:
+        return self.by_relpath.get(relpath)
+
+
+@dataclass
+class Rule:
+    """Base rule: subclasses set ``id``/``severity`` and implement
+    :meth:`check`."""
+
+    id: str = "PT000"
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: FileContext,
+              project: Project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str,
+                symbol: str = "", severity: Optional[str] = None
+                ) -> Finding:
+        return Finding(rule=self.id,
+                       severity=severity or self.severity,
+                       path=ctx.relpath,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message, symbol=symbol)
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def load_project(paths: Sequence[str],
+                 root: Optional[str] = None) -> Project:
+    """Parse every .py under ``paths`` into a Project. Files that fail
+    to parse are skipped with a note on the returned project
+    (``project.parse_errors``)."""
+    root = os.path.abspath(root or os.getcwd())
+    files, errors = [], []
+    for path in iter_py_files(paths):
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, root)
+        try:
+            with open(ap, "r", encoding="utf-8") as f:
+                src = f.read()
+            files.append(FileContext(ap, rel, src))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append((rel, f"{type(e).__name__}: {e}"))
+    project = Project(files, root)
+    project.parse_errors = errors
+    return project
+
+
+def default_rules() -> List[Rule]:
+    from paddle_tpu.analysis import (rules_collectives, rules_env,
+                                     rules_host_sync, rules_retrace,
+                                     rules_side_effects)
+    return [rules_host_sync.HostSyncRule(),
+            rules_retrace.RetraceHazardRule(),
+            rules_side_effects.TracedSideEffectRule(),
+            rules_collectives.CollectiveOrderRule(),
+            rules_env.EnvContractRule()]
+
+
+def run(project: Project,
+        rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run ``rules`` over every file; suppressed findings are dropped;
+    the rest come back sorted and fingerprinted."""
+    from paddle_tpu.analysis import baseline
+    rules = list(rules) if rules is not None else default_rules()
+    findings: List[Finding] = []
+    for ctx in project.files:
+        for rule in rules:
+            for f in rule.check(ctx, project):
+                if not ctx.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    baseline.fingerprint_all(findings, project)
+    return findings

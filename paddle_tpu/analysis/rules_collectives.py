@@ -1,0 +1,122 @@
+"""PT004 — collective-ordering divergence under rank conditionals.
+
+SPMD collectives (psum, all_gather, broadcast, host barriers, …) are
+rendezvous points: EVERY rank must issue the same collectives in the
+same order, or the program deadlocks (EQuARX-style collective rewrites
+assume exactly this invariant; so does XLA's scheduler). The classic way
+to break it is rank-conditional code::
+
+    if jax.process_index() == 0:
+        meta = broadcast_one_to_all(meta)     # ranks 1.. never arrive
+    ...
+
+This rule walks ``if``/ternary branches whose condition mentions a
+rank-like quantity (``rank``, ``process_index``, ``process_id``,
+``axis_index``, ``local_rank``, ``node_rank``, ``is_master``,
+``coordinator``) and flags any collective that appears in one arm but
+not the other — including the no-else case, where the collective runs
+on a strict subset of ranks by construction.
+
+The correct shape — rank-0-only *local* work between collectives that
+all ranks reach (checkpoint save's commit protocol) — does not trip the
+rule: the collectives sit outside the conditional.
+"""
+
+import ast
+import re
+from typing import Set
+
+from paddle_tpu.analysis import callgraph
+from paddle_tpu.analysis.engine import Rule
+
+COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "psum_scatter",
+    "all_gather", "all_reduce", "all_to_all", "reduce_scatter",
+    "broadcast", "broadcast_one_to_all", "sync_global_devices",
+    "barrier", "group_reduce", "group_all_gather", "alltoall",
+    "alltoall_single", "send_recv_ring", "process_allgather",
+}
+
+_RANK_RE = re.compile(
+    r"\b(rank|local_rank|node_rank|process_id|process_index|"
+    r"axis_index|is_master|coordinator|pid0|is_main)\b", re.IGNORECASE)
+
+
+def _rank_conditional(ctx, test_node) -> bool:
+    seg = ctx.segment(test_node)
+    if seg and _RANK_RE.search(seg):
+        return True
+    for node in ast.walk(test_node):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and _RANK_RE.search(name):
+            return True
+    return False
+
+
+def _collectives_in(subtree) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(subtree):
+        if isinstance(node, ast.Call):
+            name = callgraph.terminal_name(node.func)
+            if name in COLLECTIVES:
+                out.add(name)
+    return out
+
+
+def _collective_calls(subtree):
+    for node in ast.walk(subtree):
+        if isinstance(node, ast.Call):
+            name = callgraph.terminal_name(node.func)
+            if name in COLLECTIVES:
+                yield name, node
+
+
+class CollectiveOrderRule(Rule):
+    def __init__(self):
+        super().__init__(id="PT004", severity="error",
+                         description="rank-divergent collective order")
+
+    def check(self, ctx, project):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.If):
+                if _rank_conditional(ctx, node.test):
+                    yield from self._diff(ctx, node, node.test,
+                                          node.body, node.orelse)
+            elif isinstance(node, ast.IfExp):
+                if _rank_conditional(ctx, node.test):
+                    yield from self._diff(ctx, node, node.test,
+                                          [node.body], [node.orelse])
+
+    def _diff(self, ctx, if_node, test, body, orelse):
+        cond = " ".join(ctx.segment(test).split()) or "<rank cond>"
+        body_set = set()
+        for stmt in body:
+            body_set |= _collectives_in(stmt)
+        else_set = set()
+        for stmt in orelse:
+            else_set |= _collectives_in(stmt)
+        if body_set == else_set:
+            return
+        reported = set()
+        for arm, other, stmts, arm_name in (
+                (body_set, else_set, body, "true"),
+                (else_set, body_set, orelse, "false")):
+            for name in sorted(arm - other):
+                for cname, cnode in self._arm_calls(stmts):
+                    if cname == name and (name, arm_name) not in reported:
+                        reported.add((name, arm_name))
+                        yield self.finding(
+                            ctx, cnode,
+                            f"collective '{name}' is issued only on the "
+                            f"{arm_name} arm of rank-conditional "
+                            f"`{cond}` — ranks taking the other arm "
+                            f"never rendezvous (static deadlock)")
+
+    @staticmethod
+    def _arm_calls(stmts):
+        for stmt in stmts:
+            yield from _collective_calls(stmt)

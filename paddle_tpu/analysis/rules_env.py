@@ -1,0 +1,129 @@
+"""PT005 — PT_* environment-variable contract drift.
+
+Every ``os.environ`` / ``os.getenv`` read (or write) of a ``PT_*`` name
+must be declared in the ``paddle_tpu/flags.py`` env registry
+(``declare_env`` / ``declare_env_prefix``), which is also what the
+docs/observability.md contract table is generated from. Undeclared reads
+are how knobs like ``PT_SERVE_INFLIGHT`` silently fork from their
+documentation.
+
+The declared set is parsed from the AST of the ``flags.py`` found in the
+linted tree (falling back to ``<root>/paddle_tpu/flags.py`` when linting
+a subtree), never imported — the linter stays jax-free.
+"""
+
+import ast
+import os
+import re
+from typing import Optional, Set, Tuple
+
+from paddle_tpu.analysis import callgraph
+from paddle_tpu.analysis.engine import Rule
+
+_PT_NAME_RE = re.compile(r"^PT_[A-Z0-9_]*$")
+
+
+def _declared_from_tree(tree) -> Tuple[Set[str], Set[str]]:
+    names: Set[str] = set()
+    prefixes: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = callgraph.terminal_name(node.func)
+        if fname not in ("declare_env", "declare_env_prefix"):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            val = node.args[0].value
+            (prefixes if fname == "declare_env_prefix"
+             else names).add(val)
+    return names, prefixes
+
+
+def _env_name_of(node, ctx) -> Optional[Tuple[str, ast.AST]]:
+    """(PT_* name, anchor node) when ``node`` reads/writes a PT_* env
+    var, else None."""
+    # os.environ["PT_X"] / env["PT_X"]  (load or store)
+    if isinstance(node, ast.Subscript):
+        key = node.slice
+        if (isinstance(key, ast.Constant) and isinstance(key.value, str)
+                and _PT_NAME_RE.match(key.value)
+                and _looks_env(node.value, ctx)):
+            return key.value, node
+        return None
+    # os.environ.get / .setdefault / os.getenv
+    if isinstance(node, ast.Call) and node.args:
+        arg0 = node.args[0]
+        if not (isinstance(arg0, ast.Constant)
+                and isinstance(arg0.value, str)
+                and _PT_NAME_RE.match(arg0.value)):
+            return None
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "getenv":
+                return arg0.value, node
+            if (node.func.attr in ("get", "setdefault", "pop")
+                    and _looks_env(node.func.value, ctx)):
+                return arg0.value, node
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id == "getenv":
+            return arg0.value, node
+    return None
+
+
+def _looks_env(base, ctx) -> bool:
+    """Does ``base`` plausibly denote an environment mapping?"""
+    seg = ctx.segment(base) or ""
+    if "environ" in seg:
+        return True
+    name = callgraph.terminal_name(base)
+    return name in ("environ", "env", "_env")
+
+
+class EnvContractRule(Rule):
+    def __init__(self, extra_declared: Optional[Set[str]] = None):
+        super().__init__(id="PT005", severity="error",
+                         description="undeclared PT_* env var")
+        self.extra_declared = set(extra_declared or ())
+
+    def _declared(self, project) -> Tuple[Set[str], Set[str]]:
+        cached = getattr(project, "_pt005_declared", None)
+        if cached is not None:
+            return cached
+        names: Set[str] = set(self.extra_declared)
+        prefixes: Set[str] = set()
+        found = False
+        for f in project.files:
+            if os.path.basename(f.relpath) == "flags.py":
+                n, p = _declared_from_tree(f.tree)
+                if n or p:
+                    found = True
+                names |= n
+                prefixes |= p
+        if not found:
+            # linting a subtree: pull the package registry off disk
+            cand = os.path.join(project.root, "paddle_tpu", "flags.py")
+            if os.path.exists(cand):
+                try:
+                    with open(cand, "r", encoding="utf-8") as fh:
+                        n, p = _declared_from_tree(ast.parse(fh.read()))
+                    names |= n
+                    prefixes |= p
+                except (SyntaxError, OSError):
+                    pass
+        project._pt005_declared = (names, prefixes)
+        return names, prefixes
+
+    def check(self, ctx, project):
+        names, prefixes = self._declared(project)
+        for node in ast.walk(ctx.tree):
+            hit = _env_name_of(node, ctx)
+            if hit is None:
+                continue
+            var, anchor = hit
+            if var in names or any(var.startswith(p) for p in prefixes):
+                continue
+            yield self.finding(
+                ctx, anchor,
+                f"undeclared env var '{var}': add a "
+                f"flags.declare_env(...) entry (and the "
+                f"docs/observability.md table row it generates)")

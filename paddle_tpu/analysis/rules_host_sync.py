@@ -1,0 +1,265 @@
+"""PT001 — host syncs in traced or dispatch-path code.
+
+The framework's central performance invariant (docs/serving.md): the
+serving hot loop enqueues device work and harvests results lag-one with
+exactly ONE packed device→host transfer — any other sync serializes the
+pipeline; and code that runs at trace time cannot concretize a tracer at
+all. This rule machine-checks both:
+
+- **jit scope** (functions handed to ``jax.jit``/``pjit``/``shard_map``
+  plus everything they reach — parameters are tracers): ``.item()``,
+  ``jax.device_get``, ``block_until_ready``, ``np.asarray``/``np.array``
+  on array-derived values, and ``int()/float()/bool()`` on
+  array-derived values are errors.
+- **dispatch scope** (``step``/``run``/``drain`` methods of the
+  engines in ``paddle_tpu/inference/`` and everything they reach —
+  the lag-one pipeline): the same sinks are errors when the operand
+  provably derives from a device computation; a bare
+  ``np.asarray``/``np.array`` whose operand the analysis cannot type
+  is a *warning* in ``inference/`` files (prove it host-resident and
+  suppress inline, or restructure).
+- **anywhere**: ``np.asarray(x).shape`` / ``.ndim`` / ``.size`` /
+  ``.dtype`` — a full host copy to read metadata that ``np.shape(x)``
+  reads for free — is an error regardless of scope.
+
+Taint is a simple intra-function forward pass: results of
+``jnp.*``/``jax.*``/``lax.*`` calls (and of ``self._x_fn(...)`` where
+``self._x_fn = jax.jit(...)`` appears in the same file) are
+array-valued; assignment propagates; ``.shape``/``.size``/``.ndim``/
+``.dtype`` reads are static metadata and break the chain.
+"""
+
+import ast
+from typing import Dict, Optional, Set
+
+from paddle_tpu.analysis import callgraph
+from paddle_tpu.analysis.engine import Rule
+
+METADATA_ATTRS = {"shape", "ndim", "size", "dtype", "nbytes", "itemsize",
+                  "sharding", "aval", "weak_type"}
+# dotted prefixes whose call results live on device. Deliberately NOT
+# bare "jax." — jax.devices(), jax.tree_util.* etc. return host values.
+ARRAY_PREFIXES = ("jnp.", "lax.", "jax.numpy.", "jax.lax.",
+                  "jax.random.", "jax.nn.")
+ARRAY_EXACT = {"jax.device_put"}
+SYNC_CALL_NAMES = {"device_get", "block_until_ready"}
+NP_CONVERTERS = {"asarray", "array", "ascontiguousarray", "copy"}
+DISPATCH_ROOT_NAMES = {"step", "run", "drain"}
+DISPATCH_FILES = ("inference/decode_engine.py", "inference/paged_engine.py")
+
+
+def _np_converter_call(node: ast.Call) -> bool:
+    """np.asarray(x) / numpy.array(x)-style conversion call."""
+    d = callgraph.dotted(node.func)
+    if not d or "." not in d:
+        return False
+    mod, _, name = d.rpartition(".")
+    return mod.split(".")[0] in ("np", "numpy") and name in NP_CONVERTERS
+
+
+def _jit_valued_attrs(ctx) -> Set[str]:
+    """Attribute names assigned from jit-wrapper calls anywhere in the
+    file (``self._multi_fn = jax.jit(...)``) — calling one yields a
+    device value."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            if callgraph.terminal_name(
+                    node.value.func) in callgraph.JIT_ROOT_NAMES:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        out.add(tgt.attr)
+    return out
+
+
+class _Taint:
+    def __init__(self, fn_node, params_tainted: bool,
+                 jit_attrs: Set[str]):
+        self.names: Set[str] = set()
+        self.jit_attrs = jit_attrs
+        if params_tainted:
+            a = fn_node.args if hasattr(fn_node, "args") else None
+            if a is not None:
+                for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                    self.names.add(arg.arg)
+                for va in (a.vararg, a.kwarg):
+                    if va is not None:
+                        self.names.add(va.arg)
+        # forward-propagate through assignments to a fixpoint (bounded)
+        assigns = [n for n in callgraph.iter_own_nodes(fn_node)
+                   if isinstance(n, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign))]
+        for _ in range(4):
+            changed = False
+            for node in assigns:
+                value = node.value
+                if value is None or not self.expr(value):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    changed |= self._mark_target(t)
+            if not changed:
+                break
+
+    def _mark_target(self, t) -> bool:
+        changed = False
+        if isinstance(t, ast.Name) and t.id not in self.names:
+            self.names.add(t.id)
+            changed = True
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                changed |= self._mark_target(e)
+        return changed
+
+    def expr(self, node) -> bool:
+        """Is this expression array-derived?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in METADATA_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            d = callgraph.dotted(node.func)
+            if d:
+                name = d.rsplit(".", 1)[-1]
+                if name not in SYNC_CALL_NAMES and (
+                        d in ARRAY_EXACT
+                        or any(d.startswith(p) for p in ARRAY_PREFIXES)):
+                    return True
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.jit_attrs):
+                return True
+            # method calls on tainted values (x.astype(), x.at[..].set())
+            if isinstance(node.func, ast.Attribute):
+                return self.expr(node.func.value)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.expr(node.left) or any(
+                self.expr(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        return False
+
+
+class HostSyncRule(Rule):
+    def __init__(self):
+        super().__init__(id="PT001", severity="error",
+                         description="host sync in traced or "
+                                     "dispatch-path code")
+
+    # -- scopes -------------------------------------------------------------
+    def _dispatch_scope(self, project):
+        g = project.callgraph
+        roots = g.functions_matching(
+            lambda f: f.ctx.relpath.endswith(DISPATCH_FILES) and f.cls
+            and f.name in DISPATCH_ROOT_NAMES)
+        return g.reachable(roots)
+
+    def check(self, ctx, project):
+        g = project.callgraph
+        jit_scope = g.jit_scope()
+        dispatch_scope = getattr(project, "_pt001_dispatch", None)
+        if dispatch_scope is None:
+            dispatch_scope = project._pt001_dispatch = \
+                self._dispatch_scope(project)
+        jit_attrs = _jit_valued_attrs(ctx)
+
+        for fn in g.by_file.get(ctx.relpath, []):
+            in_jit = fn in jit_scope
+            in_disp = fn in dispatch_scope
+            yield from self._check_fn(ctx, fn, in_jit, in_disp,
+                                      jit_attrs)
+        # metadata-via-copy applies to module-level code too
+        yield from self._check_meta_copy(ctx, None, ctx.tree,
+                                         module_level=True)
+
+    # -- per-function -------------------------------------------------------
+    def _check_fn(self, ctx, fn, in_jit, in_disp, jit_attrs):
+        yield from self._check_meta_copy(ctx, fn, fn.node)
+        if not (in_jit or in_disp):
+            return
+        where = ("traced (jit/shard_map) code" if in_jit
+                 else "the serving dispatch path")
+        taint = _Taint(fn.node, params_tainted=in_jit, jit_attrs=jit_attrs)
+        for node in callgraph.iter_own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            # x.item()
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                yield self.finding(
+                    ctx, node,
+                    f".item() blocks on the device inside {where}",
+                    symbol=fn.qual)
+                continue
+            # jax.device_get / block_until_ready
+            name = callgraph.terminal_name(node.func)
+            if name in SYNC_CALL_NAMES:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() forces a device→host sync inside {where}",
+                    symbol=fn.qual)
+                continue
+            # np.asarray / np.array
+            if _np_converter_call(node) and node.args:
+                if taint.expr(node.args[0]):
+                    yield self.finding(
+                        ctx, node,
+                        f"{callgraph.dotted(node.func)} on a device "
+                        f"value is a blocking device→host copy inside "
+                        f"{where}",
+                        symbol=fn.qual)
+                elif in_disp and ctx.relpath.endswith(DISPATCH_FILES):
+                    yield self.finding(
+                        ctx, node,
+                        f"{callgraph.dotted(node.func)} in the dispatch "
+                        f"path: a device-resident operand would sync "
+                        f"the pipeline — prove it host-resident and "
+                        f"suppress, or hoist it off the hot loop",
+                        symbol=fn.qual, severity="warning")
+                continue
+            # int()/float()/bool() on array-derived values
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float", "bool")
+                    and node.args and taint.expr(node.args[0])):
+                yield self.finding(
+                    ctx, node,
+                    f"{node.func.id}() concretizes a device value "
+                    f"inside {where}",
+                    symbol=fn.qual)
+
+    # -- anywhere: np.asarray(x).shape --------------------------------------
+    def _check_meta_copy(self, ctx, fn, root, module_level=False):
+        nodes = (self._module_level_nodes(root) if module_level
+                 else callgraph.iter_own_nodes(root))
+        for node in nodes:
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in ("shape", "ndim", "size", "dtype")
+                    and isinstance(node.value, ast.Call)
+                    and _np_converter_call(node.value)):
+                yield self.finding(
+                    ctx, node,
+                    f"full host copy just to read .{node.attr} — "
+                    f"np.shape()/np.ndim() read array metadata without "
+                    f"a transfer",
+                    symbol=fn.qual if fn else "<module>")
+
+    @staticmethod
+    def _module_level_nodes(tree):
+        stack = list(ast.iter_child_nodes(tree))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,215 @@
+"""PT002 — retrace / recompile hazards.
+
+A jitted function should compile ONCE per static signature; the engines'
+no-recompile property tests assert exactly that. Four hazard shapes this
+rule catches statically:
+
+1. ``jax.jit(...)`` (or ``pjit``/``shard_map``) called inside a
+   ``for``/``while`` loop — a fresh wrapper per iteration defeats jax's
+   C++ dispatch cache at best and recompiles at worst. Hoist the wrapper
+   and reuse it.
+2. A jit-wrapped function reading a module-level global that some other
+   code rebinds (``global X`` + assignment, or repeated module-level
+   assignment): the closure baked the trace-time value — later mutation
+   silently does NOT reach the compiled program.
+3. ``static_argnums``/``static_argnames`` whose call sites pass
+   list/dict/set literals — unhashable statics raise at dispatch, and
+   per-call fresh containers would retrace every call even when
+   hashable.
+4. Per-call shape-string cache keys (``cache[f"...{x.shape}"]`` or
+   ``cache[str(x.shape)]``) — building the key from a live array every
+   call invites one compiled program per observed string; key on a
+   static tuple (or bucket the shapes).
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from paddle_tpu.analysis import callgraph
+from paddle_tpu.analysis.engine import Rule
+
+_WRAP_NAMES = {"jit", "pjit", "shard_map"}
+
+
+def _is_jit_wrap_call(node: ast.Call) -> bool:
+    return callgraph.terminal_name(node.func) in _WRAP_NAMES
+
+
+def _static_positions(node: ast.Call) -> Optional[List[int]]:
+    """Literal static_argnums positions of a jit call, if present."""
+    for kw in node.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if (isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)):
+                        out.append(e.value)
+                return out
+    return None
+
+
+def _unhashable_literal(node) -> bool:
+    return isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp))
+
+
+class RetraceHazardRule(Rule):
+    def __init__(self):
+        super().__init__(id="PT002", severity="error",
+                         description="jit retrace/recompile hazard")
+
+    def check(self, ctx, project):
+        yield from self._check_jit_in_loop(ctx)
+        yield from self._check_mutated_global_closures(ctx, project)
+        yield from self._check_static_argnums(ctx)
+        yield from self._check_shape_keys(ctx)
+
+    # -- 1: jit under a loop ------------------------------------------------
+    def _check_jit_in_loop(self, ctx):
+        hits = []
+
+        def walk(node, loop_depth):
+            for child in ast.iter_child_nodes(node):
+                d = loop_depth
+                if isinstance(child, (ast.For, ast.While, ast.AsyncFor,
+                                      ast.ListComp, ast.SetComp,
+                                      ast.DictComp, ast.GeneratorExp)):
+                    d += 1
+                if (isinstance(child, ast.Call)
+                        and _is_jit_wrap_call(child) and d > 0):
+                    hits.append(child)
+                walk(child, d)
+
+        walk(ctx.tree, 0)
+        for node in hits:
+            name = callgraph.terminal_name(node.func)
+            yield self.finding(
+                ctx, node,
+                f"{name}(...) inside a loop builds a fresh traced "
+                f"wrapper per iteration (dispatch-cache miss / "
+                f"recompile); hoist it out and reuse one wrapper")
+
+    # -- 2: jit roots over mutated globals ----------------------------------
+    def _mutated_globals(self, ctx) -> Set[str]:
+        module_assigns: Dict[str, int] = {}
+        mutated: Set[str] = set()
+        for stmt in ctx.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    module_assigns[t.id] = module_assigns.get(t.id, 0) + 1
+                    if (isinstance(stmt, ast.AugAssign)
+                            or module_assigns[t.id] > 1):
+                        mutated.add(t.id)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Global):
+                for name in node.names:
+                    if name in module_assigns:
+                        mutated.add(name)
+        return mutated
+
+    def _check_mutated_global_closures(self, ctx, project):
+        mutated = self._mutated_globals(ctx)
+        if not mutated:
+            return
+        g = project.callgraph
+        roots = [f for f in g.jit_roots if f.ctx is ctx]
+        for fn in roots:
+            assigned = {n.id for n in ast.walk(fn.node)
+                        if isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Store)}
+            reported = set()   # one finding per (fn, global) is enough
+            for node in ast.walk(fn.node):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in mutated
+                        and node.id not in assigned
+                        and node.id not in reported):
+                    reported.add(node.id)
+                    yield self.finding(
+                        ctx, node,
+                        f"jit-wrapped '{fn.name}' closes over global "
+                        f"'{node.id}' which is rebound elsewhere — the "
+                        f"compiled program keeps the trace-time value; "
+                        f"pass it as an argument instead",
+                        symbol=fn.qual)
+
+    # -- 3: unhashable static args ------------------------------------------
+    def _check_static_argnums(self, ctx):
+        jitted: Dict[str, List[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                call = node.value
+                if not _is_jit_wrap_call(call):
+                    continue
+                pos = _static_positions(call)
+                if pos is None:
+                    continue
+                for t in node.targets:
+                    name = callgraph.terminal_name(t)
+                    if name:
+                        jitted[name] = pos
+            # immediate call: jax.jit(f, static_argnums=(0,))(bad, ...)
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Call)
+                    and _is_jit_wrap_call(node.func)):
+                pos = _static_positions(node.func)
+                for p in (pos or []):
+                    if (p < len(node.args)
+                            and _unhashable_literal(node.args[p])):
+                        yield self.finding(
+                            ctx, node.args[p],
+                            f"static arg {p} is an unhashable literal — "
+                            f"static_argnums values must be hashable "
+                            f"(and fresh containers retrace per call)")
+        if not jitted:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = callgraph.terminal_name(node.func)
+            if name not in jitted:
+                continue
+            for p in jitted[name]:
+                if p < len(node.args) and _unhashable_literal(
+                        node.args[p]):
+                    yield self.finding(
+                        ctx, node.args[p],
+                        f"call passes an unhashable literal at static "
+                        f"position {p} of jitted '{name}'")
+
+    # -- 4: per-call shape-string keys --------------------------------------
+    def _shapey(self, node) -> bool:
+        """f-string / str() built from a .shape read."""
+        if isinstance(node, ast.JoinedStr):
+            return any(
+                isinstance(v, ast.FormattedValue)
+                and any(isinstance(a, ast.Attribute) and a.attr == "shape"
+                        for a in ast.walk(v.value))
+                for v in node.values)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "str" and node.args):
+            return any(isinstance(a, ast.Attribute) and a.attr == "shape"
+                       for a in ast.walk(node.args[0]))
+        return False
+
+    def _check_shape_keys(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Subscript) and self._shapey(
+                    node.slice):
+                yield self.finding(
+                    ctx, node,
+                    "per-call shape-string cache key — key on the "
+                    "static shape tuple (or bucket shapes) so the "
+                    "compile set stays bounded",
+                    severity="warning")

@@ -1,0 +1,178 @@
+"""PT003 — side effects inside traced code.
+
+A function handed to ``jax.jit``/``shard_map``/``pjit`` executes its
+Python body once per COMPILE, not once per step. A ``stats.add`` /
+``trace.span`` / ``faults.fire`` call there looks like per-step
+telemetry but records per-trace; mutating enclosing state
+(``results.append(...)`` on a closure list, ``self.x = ...``) bakes
+tracers into objects that outlive the trace. Both are bugs the profiler
+only exposes as "metric never moves" / "leaked tracer" much later.
+
+Scope: the call graph's jit scope (roots + everything reachable).
+Sinks:
+
+- calls into ``paddle_tpu.stats`` (add/observe/set_value/timer/reset),
+  ``paddle_tpu.observability.trace`` (span/begin/end/complete/instant),
+  ``paddle_tpu.testing.faults`` (fire/transform/slot_mask/inject) —
+  resolved through each file's imports, so aliases work;
+- ``print(...)``;
+- mutation of non-local state: mutating method calls
+  (append/extend/update/...) or subscript/attribute assignment whose
+  base is a closure/global name or ``self`` — locals are fine (building
+  a list of scan ys at trace time is idiomatic).
+"""
+
+import ast
+from typing import Dict, Set
+
+from paddle_tpu.analysis import callgraph
+from paddle_tpu.analysis.engine import Rule
+
+SIDE_EFFECT_MODULES = {
+    "stats": ({"paddle_tpu.stats", "stats"},
+              {"add", "observe", "set_value", "timer", "reset",
+               "snapshot", "export"}),
+    "trace": ({"paddle_tpu.observability.trace", "observability.trace",
+               "trace"},
+              {"span", "begin", "end", "complete", "instant", "export"}),
+    "faults": ({"paddle_tpu.testing.faults", "testing.faults", "faults"},
+               {"fire", "transform", "slot_mask", "inject",
+                "corrupt_file", "install_rule", "clear"}),
+}
+
+# unambiguous container mutators only — generic names (update, add,
+# pop, clear, remove) collide with optimizer.update(), stats.add(), ...
+MUTATORS = {"append", "extend", "insert", "setdefault", "popitem",
+            "appendleft", "popleft"}
+
+
+def _local_names(fn_node) -> Set[str]:
+    out: Set[str] = set()
+    a = getattr(fn_node, "args", None)
+    if a is not None:
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+            out.add(arg.arg)
+        for va in (a.vararg, a.kwarg):
+            if va is not None:
+                out.add(va.arg)
+    for node in callgraph.iter_own_nodes(fn_node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            for t in ast.walk(node.optional_vars):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class TracedSideEffectRule(Rule):
+    def __init__(self):
+        super().__init__(id="PT003", severity="error",
+                         description="side effect inside traced code")
+
+    def _module_kind(self, ctx, project, base_name: str):
+        """'stats' / 'trace' / 'faults' when ``base_name`` is an import
+        alias of one of the observability modules in this file."""
+        imports = project.callgraph.imports.get(ctx.relpath, {})
+        target = imports.get(base_name)
+        if target is None:
+            return None
+        for kind, (modnames, _) in SIDE_EFFECT_MODULES.items():
+            if target in modnames or target.endswith("." + kind):
+                return kind
+        return None
+
+    def check(self, ctx, project):
+        g = project.callgraph
+        jit_scope = g.jit_scope()
+        for fn in g.by_file.get(ctx.relpath, []):
+            if fn not in jit_scope:
+                continue
+            if fn.name in ("__init__", "__new__", "__post_init__"):
+                # constructing a fresh object at trace time is idiomatic
+                # (pytree containers); it mutates nothing that outlives
+                # the object being built
+                continue
+            locals_ = _local_names(fn.node)
+            for node in callgraph.iter_own_nodes(fn.node):
+                yield from self._check_node(ctx, project, fn, node,
+                                            locals_)
+
+    def _check_node(self, ctx, project, fn, node, locals_):
+        # print()
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            yield self.finding(
+                ctx, node,
+                "print() inside traced code runs once per compile, not "
+                "per step (use jax.debug.print for runtime values)",
+                symbol=fn.qual)
+            return
+        # stats./trace./faults. calls
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)):
+            kind = self._module_kind(ctx, project, node.func.value.id)
+            if kind is not None:
+                _, methods = SIDE_EFFECT_MODULES[kind]
+                if node.func.attr in methods:
+                    yield self.finding(
+                        ctx, node,
+                        f"{node.func.value.id}.{node.func.attr}() "
+                        f"inside traced code records at TRACE time "
+                        f"(once per compile) — hoist it to the host "
+                        f"side of the dispatch",
+                        symbol=fn.qual)
+                    return
+        # container mutation on non-local state
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATORS):
+            base = node.func.value
+            if isinstance(base, ast.Name) and base.id not in locals_:
+                yield self.finding(
+                    ctx, node,
+                    f"mutating closure/global '{base.id}' inside "
+                    f"traced code leaks trace-time values (and "
+                    f"re-runs only on retrace)",
+                    symbol=fn.qual, severity="warning")
+            elif (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                yield self.finding(
+                    ctx, node,
+                    f"mutating self.{base.attr} inside traced code "
+                    f"leaks trace-time values into the instance",
+                    symbol=fn.qual, severity="warning")
+            return
+        # subscript/attribute assignment to non-local state
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id not in locals_):
+                    yield self.finding(
+                        ctx, t,
+                        f"assigning into closure/global "
+                        f"'{t.value.id}[...]' inside traced code bakes "
+                        f"the trace-time value",
+                        symbol=fn.qual, severity="warning")
+                elif (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    yield self.finding(
+                        ctx, t,
+                        f"assigning self.{t.attr} inside traced code "
+                        f"stores a tracer on the instance (leaks the "
+                        f"trace; mutate state via carried values "
+                        f"instead)",
+                        symbol=fn.qual, severity="warning")

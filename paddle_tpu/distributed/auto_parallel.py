@@ -104,8 +104,12 @@ class Engine:
             degrees["dp"] *= n // world
             return degrees
         from paddle_tpu.distributed.planner import suggest_mesh
-        tokens = int(np.prod(np.asarray(sample_x).shape[:2])) \
-            if np.asarray(sample_x).ndim >= 2 else np.asarray(sample_x).size
+        # np.shape reads metadata without copying — the old
+        # np.asarray(sample_x).shape forced a full device→host transfer
+        # of the sample batch just to count its tokens (ptlint PT001)
+        xshape = np.shape(sample_x)
+        tokens = (int(np.prod(xshape[:2])) if len(xshape) >= 2
+                  else int(np.prod(xshape)))
         # 6·N·tokens step-FLOPs estimate: without it the cost model sees
         # zero compute, which disables the grad-sync overlap credit and
         # skews the search toward needless tp

@@ -16,6 +16,71 @@ from typing import Any, Callable, Dict, Optional
 _lock = threading.Lock()
 
 
+# ---------------------------------------------------------------------------
+# PT_* environment-variable contract registry.
+#
+# Every ``os.environ`` / ``os.getenv`` read of a ``PT_*`` name anywhere in
+# the package must have a ``declare_env`` entry here (enforced by ptlint
+# rule PT005 — paddle_tpu/analysis/rules_env.py). The registry is the one
+# source of truth the docs table in docs/observability.md is generated
+# from (``env_contract_markdown``), so a knob like PT_SERVE_INFLIGHT can
+# never silently fork from its documentation.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EnvVar:
+    name: str
+    help: str
+    default: Optional[str] = None
+    owner: str = ""          # module that consumes it (doc pointer)
+
+
+_ENV_REGISTRY: Dict[str, EnvVar] = {}
+_ENV_PREFIXES: Dict[str, EnvVar] = {}
+
+
+def declare_env(name: str, help: str, default: Optional[str] = None,
+                owner: str = "") -> None:
+    """Register one PT_* environment variable in the contract."""
+    if not name.startswith("PT_"):
+        raise ValueError(f"env contract covers PT_* names, got {name!r}")
+    _ENV_REGISTRY[name] = EnvVar(name, help, default, owner)
+
+
+def declare_env_prefix(prefix: str, help: str, owner: str = "") -> None:
+    """Register a PT_* name FAMILY (e.g. ``PT_FLAGS_<flag>``)."""
+    if not prefix.startswith("PT_"):
+        raise ValueError(f"env contract covers PT_* names, got {prefix!r}")
+    _ENV_PREFIXES[prefix] = EnvVar(prefix + "*", help, None, owner)
+
+
+def env_registry() -> Dict[str, EnvVar]:
+    out = dict(_ENV_REGISTRY)
+    out.update({k + "*": v for k, v in _ENV_PREFIXES.items()})
+    return out
+
+
+def env_declared(name: str) -> bool:
+    """True iff ``name`` is covered by the contract (exact or prefix)."""
+    if name in _ENV_REGISTRY:
+        return True
+    return any(name.startswith(p) for p in _ENV_PREFIXES)
+
+
+def env_contract_markdown() -> str:
+    """The docs/observability.md env-contract table, generated from the
+    registry (regenerate with
+    ``python -c "import paddle_tpu.flags as f; print(f.env_contract_markdown())"``)."""
+    rows = sorted(env_registry().values(), key=lambda v: v.name)
+    lines = ["| variable | default | consumed by | meaning |",
+             "|---|---|---|---|"]
+    for v in rows:
+        default = "—" if v.default is None else f"`{v.default}`"
+        owner = f"`{v.owner}`" if v.owner else "—"
+        lines.append(f"| `{v.name}` | {default} | {owner} | {v.help} |")
+    return "\n".join(lines)
+
+
 @dataclass
 class _Flag:
     name: str
@@ -118,3 +183,75 @@ define_flag("stats_at_exit", False,
 define_flag("allocator_strategy", "xla",
             "Kept for API parity (ref auto_growth/naive_best_fit); on TPU the "
             "XLA/PJRT runtime owns HBM allocation.")
+
+
+# ---------------------------------------------------------------------------
+# The PT_* env contract (ptlint PT005 checks every read against this;
+# the table in docs/observability.md is generated from it).
+# ---------------------------------------------------------------------------
+
+# -- multi-process topology (launch CLI → workers) --
+declare_env("PT_COORDINATOR", "jax.distributed coordinator 'host:port'.",
+            owner="distributed/env.py")
+declare_env("PT_NUM_PROCESSES", "Total worker processes across nodes.",
+            default="1", owner="distributed/env.py")
+declare_env("PT_PROCESS_ID", "Global rank of this worker.", default="0",
+            owner="distributed/env.py")
+declare_env("PT_LOCAL_RANK", "Rank within this node.", default="0",
+            owner="distributed/launch.py")
+declare_env("PT_NNODES", "Node count.", default="1",
+            owner="distributed/launch.py")
+declare_env("PT_RANK", "RPC agent rank (rpc.init_rpc fallback).",
+            default="0", owner="distributed/rpc.py")
+declare_env("PT_WORLD_SIZE", "RPC / fleet world size fallback.",
+            default="1", owner="distributed/rpc.py")
+declare_env("PT_TRAINER_ENDPOINTS", "Comma-separated worker endpoints "
+            "(fleet API parity).", owner="distributed/fleet")
+declare_env("PT_MASTER", "Elastic store endpoint 'host:port'.",
+            owner="distributed/elastic.py")
+declare_env("PT_ELASTIC_VERSION", "Elastic job generation counter "
+            "(set by the elastic manager on re-launch).",
+            owner="distributed/elastic.py")
+declare_env("PT_INIT_DEADLINE", "Seconds init_parallel_env may spend in "
+            "rendezvous before CollectiveWatchdog raises.", default="120",
+            owner="distributed/env.py")
+declare_env("PT_RESTART_ATTEMPT", "Which auto-restart attempt this worker "
+            "is (launch --max_restarts exports it; 0 = first run).",
+            default="0", owner="distributed/launch.py")
+
+# -- observability --
+declare_env("PT_TRACE_DIR", "Enable tracing; rank traces land here as "
+            "trace_rank{N}.json and the launcher merges them.",
+            owner="observability/trace.py")
+declare_env("PT_TRACE_FILE", "Exact trace output path (wins over "
+            "PT_TRACE_DIR).", owner="observability/trace.py")
+declare_env("PT_TRACE_RING", "Trace ring-buffer capacity in events.",
+            default="65536", owner="observability/trace.py")
+declare_env("PT_STATSZ_PORT", "Serve live /statsz snapshots on this port "
+            "(launcher hands rank r port base+1+r).",
+            owner="observability/statsz.py")
+
+# -- serving --
+declare_env("PT_SERVE_INFLIGHT", "Decode-engine pipeline depth: how many "
+            "dispatches may be in flight before the oldest is harvested "
+            "(1 = synchronous).", default="2",
+            owner="inference/decode_engine.py")
+declare_env("PT_SERVE_PREFILL_TOKENS", "Per-step prompt-token budget for "
+            "interleaved chunked prefill (0 = largest bucket).",
+            default="0", owner="inference/decode_engine.py")
+
+# -- compilation / data / testing --
+declare_env("PT_COMPILE_CACHE_GUARD", "0 disables the persistent-compile-"
+            "cache failure guard (compile_cache.guard).", default="1",
+            owner="compile_cache.py")
+declare_env("PT_XLA_CACHE_DIR", "Persistent XLA compilation cache "
+            "directory (compile_cache.enable).", owner="compile_cache.py")
+declare_env("PT_AUTOTUNE_CACHE", "Kernel autotuner cache file path.",
+            owner="ops/autotune.py")
+declare_env("PT_DATA_DIR", "Root directory for bundled datasets.",
+            owner="vision/datasets.py")
+declare_env("PT_FAULTS", "Fault-injection plan: ';'-separated "
+            "site:action[:k=v,...] rules (testing/faults.py).",
+            owner="testing/faults.py")
+declare_env_prefix("PT_FLAGS_", "Per-flag override of any define_flag "
+                   "entry, e.g. PT_FLAGS_SCAN_LAYERS=0.", owner="flags.py")

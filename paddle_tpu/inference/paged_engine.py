@@ -433,6 +433,8 @@ class PagedDecodeEngine(ResilientScheduler):
         decode dispatches instead of draining them."""
         import time
         from paddle_tpu.observability import trace
+        # ptlint: disable=PT001 -- req.prompt is a host int list
+        # (submit coerced it); this is an upload, never a sync
         prompt = np.asarray(req.prompt, np.int32)
         n = len(prompt)
         bucket = next(b for b in self.buckets if b >= n)

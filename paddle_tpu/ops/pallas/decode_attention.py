@@ -256,6 +256,8 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
         ],
     )
     res = pl.pallas_call(
+        # ptlint: disable=PT001 -- scale is a static Python float kwarg
+        # (a tracer here would already fail partial-binding)
         functools.partial(_kernel, scale=float(scale), block_k=bk,
                           hkv=hkv, with_stats=return_stats),
         grid_spec=grid_spec,

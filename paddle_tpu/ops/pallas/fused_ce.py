@@ -261,5 +261,6 @@ def fused_softmax_cross_entropy(x, w, labels, block_n: int = 128,
         x = _pad_rows(x, n_pad)
         labels = _pad_rows(labels, n_pad, fill=-1)
     lab2 = jnp.broadcast_to(labels[:, None], (labels.shape[0], _LANES))
+    # ptlint: disable=PT001 -- interpret is a static Python flag
     loss = _fused_ce(x, w, lab2, bn, bv, bool(interpret))
     return loss[:n] if n_pad else loss

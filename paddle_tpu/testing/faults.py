@@ -72,8 +72,8 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["inject", "install_rule", "install_from_env", "clear",
-           "enabled", "fire", "transform", "slot_mask", "corrupt_file",
-           "Rule"]
+           "reset_counts", "enabled", "fire", "transform", "slot_mask",
+           "corrupt_file", "Rule"]
 
 _EXCEPTIONS = {
     "TimeoutError": TimeoutError,
@@ -152,19 +152,46 @@ def clear():
         _enabled = False
 
 
+def reset_counts(site: Optional[str] = None):
+    """Reset per-site call counters, keeping installed rules: all sites
+    when ``site`` is None, else just that one. :class:`inject` resets
+    only ITS site on entry (fresh=True default) so a fault plan replays
+    identically however many injects ran before it — without rewinding
+    the firing windows of rules installed for other sites."""
+    with _lock:
+        if site is None:
+            _counts.clear()
+        else:
+            _counts.pop(site, None)
+
+
 class inject:
     """Context manager installing one rule for the ``with`` body.
 
         with faults.inject("p2p.send", "drop", after=1, count=1):
             ...
-    """
 
-    def __init__(self, site: str, action: str, **kw):
+    Entry resets the call counter of ITS site only (PR 4 footgun:
+    ``after=`` silently counted calls from EARLIER inject blocks in the
+    same test, so a second run of the same plan fired at different
+    indices unless the test remembered to call ``clear()`` between
+    runs). Each inject block therefore replays identically by
+    construction, and a nested inject for a different site leaves the
+    outer rule's firing window untouched. Pass ``fresh=False`` to opt
+    out and keep accumulated indices — only meaningful when composing
+    with rules installed via :func:`install_rule`, whose firing windows
+    are anchored to the existing counters."""
+
+    def __init__(self, site: str, action: str, fresh: bool = True,
+                 **kw):
         self._args = (site, action, kw)
+        self._fresh = fresh
         self._rule = None
 
     def __enter__(self) -> Rule:
         site, action, kw = self._args
+        if self._fresh:
+            reset_counts(site)
         self._rule = install_rule(site, action, **kw)
         return self._rule
 

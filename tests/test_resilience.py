@@ -99,6 +99,40 @@ def test_faults_deterministic_after_count_window():
     assert faults.fire("unit.site") is None  # rule removed with the ctx
 
 
+def test_inject_replays_identically_without_manual_clear():
+    """PR 4 footgun: per-site call indices used to persist across
+    inject blocks, so a second identical plan fired at shifted indices
+    unless the test remembered faults.clear(). inject() now resets the
+    counters on entry; fresh=False restores the accumulating behavior."""
+    def plan():
+        seen = []
+        with faults.inject("unit.replay", "drop", after=1, count=1):
+            for _ in range(3):
+                seen.append(faults.fire("unit.replay"))
+        return seen
+
+    first = plan()
+    assert first == [None, "drop", None]
+    assert plan() == first          # no faults.clear() in between
+    # opt-out: counters accumulate, so the window never re-fires
+    with faults.inject("unit.replay", "drop", after=1, count=1,
+                       fresh=False):
+        assert [faults.fire("unit.replay") for _ in range(3)] == \
+            [None] * 3
+
+
+def test_nested_inject_keeps_other_sites_counters():
+    """Entry resets only the entered site: a nested inject for a
+    different site must not rewind the outer rule's after= window."""
+    seen = []
+    with faults.inject("unit.outer", "drop", after=2, count=1):
+        seen.append(faults.fire("unit.outer"))     # idx 0
+        seen.append(faults.fire("unit.outer"))     # idx 1
+        with faults.inject("unit.inner", "delay", seconds=0.0):
+            seen.append(faults.fire("unit.outer"))  # idx 2 -> fires
+    assert seen == [None, None, "drop"]
+
+
 def test_faults_raise_and_env_parsing():
     n = faults.install_from_env(
         {"PT_FAULTS": "a.b:raise:exc=ConnectionError,after=1;c.d:delay"})

@@ -148,7 +148,8 @@ def test_midpipeline_poison_eviction_matches_depth1(chunk, spec_k):
 
     def run(depth):
         stats.reset("serve/")
-        faults.clear()   # reset the per-site call index between depths
+        # no faults.clear() needed between depths: inject() resets the
+        # per-site call index on entry
         eng = DecodeEngine(model, max_slots=2, max_len=160,
                            steps_per_call=chunk, speculative_k=spec_k,
                            inflight=depth)
@@ -256,7 +257,8 @@ def test_paged_pipelined_poison_and_parity():
 
     def run(depth):
         stats.reset("serve/")
-        faults.clear()   # reset the per-site call index between depths
+        # no faults.clear() needed between depths: inject() resets the
+        # per-site call index on entry
         eng = PagedDecodeEngine(model, n_pages=16, max_slots=2,
                                 steps_per_call=2, inflight=depth)
         r0 = eng.submit(p0, max_new_tokens=8)

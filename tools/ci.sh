@@ -4,7 +4,11 @@
 # code path on tiny shapes — and fail on any red. Run this before every
 # snapshot/commit ritual.
 #
-#   tools/ci.sh            full suite
+#   tools/ci.sh            ptlint gate, then the full suite
+#   tools/ci.sh lint       static analysis only: tools/ptlint.py over the
+#                          package, failing on any non-baselined finding
+#                          (add --stats to print findings-per-rule for
+#                          BENCH tracking)
 #   tools/ci.sh faults     fast fault-injection smoke: only the resilience /
 #                          fault-injection tests (pytest -m faults), tier-1
 #                          compatible (CPU, 'not slow') — proves every
@@ -23,6 +27,11 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
+if [[ "${1:-}" == "lint" ]]; then
+    shift
+    exec python tools/ptlint.py paddle_tpu --error-on-new "$@"
+fi
+
 if [[ "${1:-}" == "faults" ]]; then
     shift
     exec python -m pytest tests/ -q -m "faults and not slow" \
@@ -39,4 +48,7 @@ if [[ "${1:-}" == "serve" ]]; then
     exec python tools/serve_smoke.py "$@"
 fi
 
+# lint gate runs BEFORE the test shards: a host-sync or env-contract
+# regression fails in seconds, not after a 30-minute suite
+python tools/ptlint.py paddle_tpu --error-on-new
 python -m pytest tests/ -q --durations=15 "$@"

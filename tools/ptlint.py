@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""ptlint CLI — lint the tree with paddle_tpu.analysis.
+
+    python tools/ptlint.py [paths ...]            # default: paddle_tpu
+    python tools/ptlint.py paddle_tpu --stats     # findings per rule
+    python tools/ptlint.py paddle_tpu --write-baseline
+    python tools/ptlint.py paddle_tpu --error-on-new   # (the default)
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when
+NEW findings exist (use --no-error to always exit 0), 2 on usage/parse
+errors. ``--stats`` prints per-rule totals (baselined included) so
+BENCH runs can track the count trending to zero.
+
+The analysis package is loaded standalone (no ``import paddle_tpu``),
+so linting works — and stays fast — even when jax or the accelerator
+stack is broken.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "ptlint_baseline.json")
+
+
+def _load_analysis():
+    """Import paddle_tpu.analysis WITHOUT executing paddle_tpu/__init__
+    (which drags in jax). Falls back to the normal import when the
+    package is already loaded."""
+    if "paddle_tpu.analysis" in sys.modules:
+        return sys.modules["paddle_tpu.analysis"]
+    pkg_dir = os.path.join(ROOT, "paddle_tpu", "analysis")
+    if "paddle_tpu" not in sys.modules:
+        stub = types.ModuleType("paddle_tpu")
+        stub.__path__ = [os.path.join(ROOT, "paddle_tpu")]
+        sys.modules["paddle_tpu"] = stub
+    spec = importlib.util.spec_from_file_location(
+        "paddle_tpu.analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu.analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ptlint", description="TPU-aware static analysis")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: paddle_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default tools/"
+                         "ptlint_baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record the current findings as the baseline")
+    ap.add_argument("--error-on-new", action="store_true",
+                    help="exit 1 on non-baselined findings (default)")
+    ap.add_argument("--no-error", action="store_true",
+                    help="report only; always exit 0")
+    ap.add_argument("--stats", action="store_true",
+                    help="print findings-per-rule totals")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (e.g. "
+                         "PT001,PT005)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+    paths = args.paths or [os.path.join(ROOT, "paddle_tpu")]
+    project = analysis.load_project(paths, root=ROOT)
+    parse_errors = list(getattr(project, "parse_errors", []))
+    for rel, err in parse_errors:
+        print(f"ptlint: skipped {rel}: {err}", file=sys.stderr)
+
+    rules = analysis.default_rules()
+    if args.rules:
+        keep = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in rules if r.id in keep]
+        if not rules:
+            print(f"ptlint: no such rules {sorted(keep)}",
+                  file=sys.stderr)
+            return 2
+    findings = analysis.run(project, rules)
+
+    if args.write_baseline:
+        if parse_errors:
+            print("ptlint: refusing to write a baseline from a tree "
+                  "with parse errors", file=sys.stderr)
+            return 2
+        analysis.baseline.write(args.baseline, findings)
+        print(f"ptlint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(args.baseline, ROOT)}")
+        return 0
+
+    known_map = analysis.baseline.load(args.baseline)
+    new, known = analysis.baseline.partition(findings, known_map)
+
+    if args.format == "json":
+        print(json.dumps(
+            {"new": [vars(f) for f in new],
+             "baselined": [vars(f) for f in known]}, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        if known:
+            print(f"ptlint: {len(known)} baselined finding(s) "
+                  f"suppressed (see {os.path.relpath(args.baseline, ROOT)})")
+
+    if args.stats:
+        per_rule = {}
+        for f in findings:
+            per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+        print("ptlint stats (baselined included):")
+        for rule in sorted(set(list(per_rule) +
+                               [r.id for r in rules])):
+            print(f"  {rule}: {per_rule.get(rule, 0)}")
+        print(f"  total: {len(findings)}  new: {len(new)}  "
+              f"baselined: {len(known)}")
+
+    if new:
+        print(f"ptlint: {len(new)} new finding(s)", file=sys.stderr)
+        return 0 if args.no_error else 1
+    if parse_errors and not args.no_error:
+        # an unparseable file means the tree was NOT actually checked —
+        # a green exit here would let the CI lint gate pass on exactly
+        # the most broken trees
+        print(f"ptlint: {len(parse_errors)} file(s) could not be "
+              "parsed", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
