@@ -626,8 +626,8 @@ def bench_decode(jax, jnp, peak, smoke=False):
     import os
     sections = {s.strip() for s in os.environ.get(
         "PT_DECODE_SECTIONS",
-        "generate,int8,engine,engine_longctx,engine_paged,engine_int8,"
-        "spec").split(",")}
+        "generate,int8,engine,engine_longctx,engine_paged,"
+        "engine_paged_prefix,engine_int8,spec").split(",")}
     b, s0, new = (2, 8, 4) if smoke else (8, 128, 64)
     res = {"decode_batch": b, "decode_prefill": s0, "decode_new": new}
     tokens = jnp.asarray(
@@ -719,14 +719,15 @@ def bench_decode(jax, jnp, peak, smoke=False):
     want_int8 = "engine_int8" in sections
     want_longctx = "engine_longctx" in sections and not smoke
     want_paged = "engine_paged" in sections and not smoke
-    if (want_int8 or want_longctx or want_paged) \
+    want_pfx = "engine_paged_prefix" in sections and not smoke
+    if (want_int8 or want_longctx or want_paged or want_pfx) \
             and eng is None and eng2 is None:
       try:  # these sections need a bf16 donor stack even without 'engine'
         eng = DecodeEngine(model, max_slots=slots, max_len=s_pf + n_new2,
                            steps_per_call=2 if smoke else 64)
       except Exception as e:
         res["decode_engine_int8_error"] = str(e)[:160]
-        want_int8 = want_longctx = want_paged = False
+        want_int8 = want_longctx = want_paged = want_pfx = False
     if eng is not None or eng2 is not None:
         if getattr(bench_gpt, "model", None) is model:
             del bench_gpt.model
@@ -811,6 +812,79 @@ def bench_decode(jax, jnp, peak, smoke=False):
         del engP
     except Exception as e:
         res["decode_engine_paged_error"] = str(e)[:160]
+
+    try:
+      if want_pfx and (eng is not None or eng2 is not None):
+        # paged_prefix ladder row (ISSUE 6): shared-system-prompt
+        # workload. Every slot's prompt = one page-aligned 128-token
+        # shared prefix + a distinct 32-token tail; the cold round
+        # registers the prefix chain in the radix cache, the warm round
+        # (same prefix, NEW tails) must prefill only the tails. A
+        # prefix-cache regression shows up as hit_tokens collapsing and
+        # the warm/cold admission+drain speedup falling toward 1.0.
+        from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+        from paddle_tpu import stats as _stats
+        page, tail = 128, 32
+        need = page + tail + n_new2
+        engPP = PagedDecodeEngine(
+            None, n_pages=2 + slots * (need // page + 3) + 4,
+            max_slots=slots, steps_per_call=64,
+            share_weights_with=(eng if eng is not None else eng2))
+        rs = np.random.RandomState(3)
+        shared = list(rs.randint(0, cfg.vocab_size, page))
+        # compile warm-up on a TRIE-DISJOINT prefix at the exact timed
+        # geometry: the first submit traces the full prefill (cold
+        # shape), the second — same warm prefix, new tail — traces the
+        # suffix prefill (warm shape), so the timed rounds measure
+        # prefill/decode work rather than jit compilation
+        warm_pfx = list(rs.randint(0, cfg.vocab_size, page))
+        for _ in range(2):
+            engPP.submit(
+                warm_pfx + list(rs.randint(0, cfg.vocab_size, tail)),
+                max_new_tokens=n_new2)
+            engPP.run()
+
+        def _prefix_round(prompts):
+            _stats.reset("serve/prefix")
+            t0 = time.perf_counter()
+            reqs = [engPP.submit(p, max_new_tokens=n_new2)
+                    for p in prompts]
+            engPP.run()
+            dt = time.perf_counter() - t0
+            toks = sum(len(r.tokens) for r in reqs)
+            hits = int(_stats.snapshot("serve/prefix").get(
+                "serve/prefix_hit_tokens", 0))
+            return toks / dt, hits
+
+        # registration pass (untimed): make the shared chain canonical
+        # BEFORE the timed rounds. Admission is sequential, so timing a
+        # round that also registers would leave only slot 0 cold —
+        # slots 1..N hit the chain slot 0 just registered and the
+        # "cold" number would be mostly warm.
+        engPP.submit(shared + list(rs.randint(0, cfg.vocab_size, tail)),
+                     max_new_tokens=2)
+        engPP.run()
+        # cold baseline: per-slot DISJOINT prefixes — every admission
+        # prefills its full prompt (hit_tokens stays 0)
+        tps_cold, _ = _prefix_round(
+            [list(rs.randint(0, cfg.vocab_size, page + tail))
+             for _ in range(slots)])
+        # warm round: the shared prefix + fresh tails — only the tails
+        # prefill, every shared token served from the radix cache
+        tps_warm, hits = _prefix_round(
+            [shared + list(rs.randint(0, cfg.vocab_size, tail))
+             for _ in range(slots)])
+        res["decode_engine_paged_prefix_tokens_per_sec"] = round(
+            tps_warm, 1)
+        res["decode_engine_paged_prefix_cold_tokens_per_sec"] = round(
+            tps_cold, 1)
+        res["decode_engine_paged_prefix_hit_tokens"] = hits
+        res["decode_engine_paged_prefix_hit_rate"] = round(
+            hits / (slots * (page + tail)), 4)
+        engPP.kp = engPP.vp = None
+        del engPP
+    except Exception as e:
+        res["decode_engine_paged_prefix_error"] = str(e)[:160]
 
     try:
       if want_int8 and (eng is not None or eng2 is not None):

@@ -239,6 +239,18 @@ declare_env("PT_SERVE_INFLIGHT", "Decode-engine pipeline depth: how many "
 declare_env("PT_SERVE_PREFILL_TOKENS", "Per-step prompt-token budget for "
             "interleaved chunked prefill (0 = largest bucket).",
             default="0", owner="inference/decode_engine.py")
+declare_env("PT_PAGED_FUSED", "0 disables the fused append+attend paged "
+            "decode kernel, restoring the read-only-pool + one-scatter-"
+            "per-token formulation (the parity reference).", default="1",
+            owner="inference/paged_engine.py")
+declare_env("PT_PAGED_PREFIX", "0 disables prefix (radix) caching over "
+            "the page pool — every prompt prefills cold and retirement "
+            "frees pages instead of keeping them warm.", default="1",
+            owner="inference/paged_engine.py")
+declare_env("PT_PAGED_TUNE", "1 runs paged-kernel autotuning "
+            "(pages_per_program, head_block) from the engine "
+            "constructor, before any trace picks up the config.",
+            default="0", owner="inference/paged_engine.py")
 
 # -- compilation / data / testing --
 declare_env("PT_COMPILE_CACHE_GUARD", "0 disables the persistent-compile-"

@@ -16,14 +16,32 @@ TPU design decisions:
   selects its pages at DMA-schedule time — no per-layer slicing of the
   pool (a lax.dynamic_slice there would copy the full layer pool every
   step).
-- **Read-only-pool decode step**: each layer attends over the existing
-  prefix via `paged_decode_attention(return_stats=True)` and folds the
-  current token's fresh KV row in analytically (`fold_fresh_row` — the
-  same formulation as the contiguous engine); the per-layer rows come
-  out of the layer scan as tiny ys and land in the pools with ONE
-  batched scatter per cache per token. The original write-first form
+- **Fused append+attend decode step** (default; ``PT_PAGED_FUSED=0``
+  falls back): each layer calls `paged_append_attend`, which folds the
+  current token's fresh KV row into the online softmax AND writes it
+  into its pool page inside the same kernel launch
+  (``input_output_aliases`` on the layer-folded pools; the write target
+  is derived from the block table + per-slot length, inactive slots
+  write the scratch page). The separate one-batched-scatter-per-cache-
+  per-token the read-only formulation paid (`_write_token_rows`) is
+  gone from the dispatch path. History: the original write-first form
   (per-layer scatter with the pools as layer-scan carry) measured
-  ~0.05x of the HBM roofline on hardware; this one measured 0.17x.
+  ~0.05x of the HBM roofline on hardware; the read-only-pool form
+  (`paged_decode_attention(return_stats=True)` + `fold_fresh_row` +
+  one scatter per token) measured 0.17x; fusing the write removes the
+  remaining extra pool traffic per token (ISSUE 6).
+- **Prefix/radix caching** (default; ``PT_PAGED_PREFIX=0`` disables):
+  the page pool doubles as a shared radix store
+  (`inference/prefix_cache.py`). ``submit``'s admission looks up the
+  longest cached prefix by page-aligned token-hash chain, maps those
+  pages into the slot's table READ-ONLY (refcounted; copy-on-write on
+  the first partial page when an exact-multiple prompt matches in
+  full), and prefills ONLY the suffix — each layer writes the suffix
+  KV rows into the slot's pages and attends over [cached prefix +
+  suffix causal] via the paged kernel with one query row per suffix
+  position. Retirement decrements refcounts instead of freeing;
+  refcount-zero prefix pages sit in an LRU and are reclaimed under
+  pool pressure.
 - **One-pass bucketed prefill**: a prompt attends only to itself
   (causal), so prefill needs NO cache reads — the whole prompt runs
   through the dense forward at a power-of-two bucket and the valid KV
@@ -51,6 +69,7 @@ work stays in `DecodeEngine`).
 
 import collections
 import math
+import os
 from typing import List, Optional
 
 import numpy as np
@@ -62,8 +81,10 @@ from paddle_tpu.models import gpt as gpt_lib
 from paddle_tpu.inference.decode_engine import (Request,
                                                 ResilientScheduler,
                                                 _Inflight)
+from paddle_tpu.inference.prefix_cache import PrefixCache
 from paddle_tpu.ops.pallas.decode_attention import fold_fresh_row
-from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+from paddle_tpu.ops.pallas.paged_attention import (paged_append_attend,
+                                                   paged_decode_attention)
 
 __all__ = ["PagedDecodeEngine"]
 
@@ -92,7 +113,8 @@ class PagedDecodeEngine(ResilientScheduler):
                  page_size: int = 128, steps_per_call: int = 1,
                  buckets=(16, 32, 64, 128, 256, 512),
                  share_weights_with=None, inflight=None,
-                 warmup: bool = False):
+                 warmup: bool = False, fused: Optional[bool] = None,
+                 prefix: Optional[bool] = None):
         from paddle_tpu import compile_cache
         from paddle_tpu.inference.decode_engine import (
             resolve_engine_weights)
@@ -128,7 +150,19 @@ class PagedDecodeEngine(ResilientScheduler):
         self._scratch = L * self.P
         from paddle_tpu.ops.pallas.paged_attention import PageAllocator
         self._alloc = PageAllocator(self.P, self.page)
+        # fused append+attend is the default; PT_PAGED_FUSED=0 restores
+        # the read-only-pool + one-scatter-per-token formulation (the
+        # parity reference the fused path is tested against)
+        self.fused = (os.environ.get("PT_PAGED_FUSED", "1") != "0"
+                      if fused is None else bool(fused))
+        prefix_on = (os.environ.get("PT_PAGED_PREFIX", "1") != "0"
+                     if prefix is None else bool(prefix))
+        self._prefix = (PrefixCache(self._alloc, self.page)
+                        if prefix_on else None)
         self._tables: List[List[int]] = [[] for _ in range(self.S)]
+        # slots evicted for non-finite logits: their pages are scrubbed
+        # (zeroed) as they return to the free list (see _release)
+        self._tainted: set = set()
         self.lengths = jnp.zeros((self.S,), jnp.int32)
         self.last = jnp.zeros((self.S,), jnp.int32)
         self.active = jnp.zeros((self.S,), bool)
@@ -142,6 +176,8 @@ class PagedDecodeEngine(ResilientScheduler):
         self.tokens_emitted = 0
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=(2, 3))
+        self._prefill_sfx_fn = jax.jit(self._prefill_suffix_impl,
+                                       donate_argnums=(2, 3))
         self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(2, 3))
         self._init_pipeline(inflight)
         # host shadows for page reservation: _host_len is the harvested
@@ -151,6 +187,12 @@ class PagedDecodeEngine(ResilientScheduler):
         self._proj_len = np.zeros((self.S,), np.int64)
         self._table_dev = None       # cached device page table
         self._table_dirty = True
+        self._update_pool_gauges()
+        if os.environ.get("PT_PAGED_TUNE", "0") == "1":
+            # tune BEFORE any trace: the kernels read the tuned
+            # (pages_per_program, head_block) from the autotune cache
+            # at trace time, so warmup traces pick it up
+            self.autotune()
         if warmup:
             self.warmup()
 
@@ -160,16 +202,100 @@ class PagedDecodeEngine(ResilientScheduler):
     def free_pages(self) -> int:
         return self._alloc.free_pages
 
+    def _update_pool_gauges(self):
+        from paddle_tpu import stats
+        stats.set_value("serve/pool_pages_free", self._alloc.free_pages)
+        if self._prefix is not None:
+            stats.set_value("serve/pool_pages_shared",
+                            self._prefix.shared_pages)
+            stats.set_value("serve/pool_pages_cached",
+                            self._prefix.cached_pages)
+
     def _reserve(self, slot: int, n_tokens: int):
         before = len(self._tables[slot])
-        self._alloc.reserve(self._tables[slot], n_tokens)
-        if len(self._tables[slot]) != before:
+        tab = self._tables[slot]
+        try:
+            self._alloc.reserve(tab, n_tokens)
+        except MemoryError:
+            # pool pressure: reclaim LRU refcount-zero prefix pages
+            # (warm cache, not live sequences) before giving up
+            need = (n_tokens + self.page - 1) // self.page - len(tab)
+            if (self._prefix is None or self._prefix.reclaim(
+                    need - self._alloc.free_pages) == 0):
+                raise
+            self._alloc.reserve(tab, n_tokens)
+        if len(tab) != before:
             self._table_dirty = True
+            self._update_pool_gauges()
 
     def _release(self, slot: int):
-        if self._tables[slot]:
+        tab = self._tables[slot]
+        if tab:
             self._table_dirty = True
-        self._alloc.release(self._tables[slot])
+        scrub: List[int] = []
+        if self._prefix is not None:
+            # cached (trie-held) pages are refcounted, not freed: at
+            # zero they move to the reclaimable LRU with their KV warm.
+            # (Filter on the PRE-unref keep set: unref of an invalidated
+            # page frees it and drops ownership, and re-testing owns()
+            # afterwards would double-release it to the allocator.)
+            keep = [p for p in tab if self._prefix.owns(p)]
+            for p in keep:
+                if self._prefix.unref(p) is not None:
+                    scrub.append(p)
+            kept = set(keep)
+            tab[:] = [p for p in tab if p not in kept]
+        if slot in self._tainted:
+            # non-finite eviction: the slot's private pages hold KV
+            # computed from poisoned activations — scrub them on the
+            # way back to the free list, or the nan rows resurface as
+            # masked-row residue in whatever sequence reuses the page
+            # (additive attention masking keeps nan alive: nan+bias=nan)
+            self._tainted.discard(slot)
+            scrub.extend(tab)
+        self._alloc.release(tab)
+        if scrub:
+            self._scrub_pages(scrub)
+        self._update_pool_gauges()
+
+    def _scrub_pages(self, pids):
+        """Zero ``pids``' KV rows in both pools (every layer's view).
+        Only the poison path pays this: the pool is recycled without
+        zero-on-alloc, so pages freed from a non-finite-evicted slot or
+        an invalidated prefix must not carry their nan rows into the
+        next sequence that maps them."""
+        # ptlint: disable=PT001 -- pids is a host int list (slot table
+        # entries); this builds an index upload, never a device sync
+        pid_rows = np.asarray(pids, np.int32)[None, :]
+        ids = (np.arange(self.cfg.n_layers)[:, None] * self.P
+               + pid_rows).ravel()
+        self.kp = self.kp.at[ids].set(0)
+        self.vp = self.vp.at[ids].set(0)
+
+    def autotune(self, iters: int = 3, candidates=None):
+        """Measure paged-kernel geometry candidates on this engine's
+        shape family ((page, Hkv, D, dtype, group)) and persist the
+        winner in the autotune cache (`ops/pallas/autotune.py`). Run
+        BEFORE ``warmup()`` / the first request: Pallas grids are
+        trace-time constants, so already-traced dispatch functions keep
+        whatever config they saw. ``PT_PAGED_TUNE=1`` runs this from
+        the constructor automatically."""
+        from paddle_tpu.ops.pallas.paged_attention import (
+            tune_paged_attention)
+        cfg = self.cfg
+        mx = (cfg.max_seq_len + self.page - 1) // self.page
+        # representative shapes: full batch, mid-length rows, distinct
+        # in-range pages (page ids only steer DMA addresses; the values
+        # don't change the kernel's work)
+        q = jnp.zeros((self.S, cfg.n_heads, cfg.head_dim), cfg.dtype)
+        table = jnp.asarray(
+            np.arange(self.S * mx, dtype=np.int32).reshape(self.S, mx)
+            % self.P)
+        lengths = jnp.full((self.S,), max(1, cfg.max_seq_len // 2),
+                           jnp.int32)
+        return tune_paged_attention(q, self.kp, self.vp, table, lengths,
+                                    fused=self.fused, iters=iters,
+                                    candidates=candidates)
 
     def _table_array(self) -> jnp.ndarray:
         """(S, max_pages) padded page table at a FIXED width
@@ -232,15 +358,19 @@ class PagedDecodeEngine(ResilientScheduler):
         non-finite logits (numerical blowup or injected poison) — the
         slot stops advancing and the host evicts only that request.
 
-        The pools are READ-ONLY inside the layer scan: each layer's
-        attention runs the paged kernel over the existing prefix
-        (``lengths`` tokens) with ``return_stats``, and the current
-        token's fresh KV row is folded into the online softmax
-        analytically — the same formulation that took the contiguous
-        engine from 0.19x to 0.53x of the HBM roofline. The per-layer
-        rows come out as scan ys ((L, S, Hkv, D) — tiny) and land in
-        the pools with ONE batched scatter per cache per token, after
-        the scan."""
+        FUSED path (default): each layer calls `paged_append_attend` —
+        the fresh KV row is folded into the online softmax AND written
+        into its pool page inside the kernel (input/output-aliased
+        pools carried through the layer scan; inactive slots' writes
+        target the scratch page). No per-token scatter remains in the
+        dispatch.
+
+        Fallback (``PT_PAGED_FUSED=0``): the pools stay READ-ONLY
+        inside the layer scan — `paged_decode_attention(return_stats)`
+        plus the analytic `fold_fresh_row`, per-layer rows out as scan
+        ys, ONE batched scatter per cache per token after the scan
+        (the 0.17x-roofline formulation the fused path is parity-tested
+        against)."""
         x = jnp.take(head["wte"], last, axis=0)
         if head["wpe"] is not None:
             x = x + jnp.take(head["wpe"], lengths, axis=0)
@@ -248,24 +378,47 @@ class PagedDecodeEngine(ResilientScheduler):
         L = self.cfg.n_layers
         scale = 1.0 / math.sqrt(self.cfg.head_dim)
 
-        def layer_body(h, blk_i):
-            blk, i = blk_i
-            q, k, v = blk._qkv(h, lengths)
-            k_row = k[:, 0].astype(kp.dtype)
-            v_row = v[:, 0].astype(vp.dtype)
-            o, m, l = paged_decode_attention(
-                q[:, 0].astype(kp.dtype), kp, vp, i * self.P + table,
-                lengths, scale=scale, return_stats=True)
-            attn = fold_fresh_row(o, m, l, q[:, 0], k_row, v_row,
-                                  scale, blk.n_heads // blk.kv_heads)
-            attn = attn.astype(h.dtype).reshape(h.shape)
-            h = blk._block_tail(h, attn)
-            return h, (k_row, v_row)
+        if self.fused:
+            pidx = jnp.minimum(lengths // self.page, table.shape[1] - 1)
+            base = jnp.take_along_axis(table, pidx[:, None],
+                                       axis=1)[:, 0]
 
-        x, (k_rows, v_rows) = lax.scan(
-            layer_body, x, (stacked, jnp.arange(L)))
-        kp, vp = self._write_token_rows(kp, vp, k_rows, v_rows, table,
-                                        lengths, active)
+            def layer_body_fused(carry, blk_i):
+                h, kp, vp = carry
+                blk, i = blk_i
+                q, k, v = blk._qkv(h, lengths)
+                k_row = k[:, 0].astype(kp.dtype)
+                v_row = v[:, 0].astype(vp.dtype)
+                wpids = jnp.where(active, i * self.P + base,
+                                  self._scratch)
+                o, kp, vp = paged_append_attend(
+                    q[:, 0].astype(kp.dtype), kp, vp, k_row, v_row,
+                    i * self.P + table, wpids, lengths, scale=scale)
+                attn = o.astype(h.dtype).reshape(h.shape)
+                h = blk._block_tail(h, attn)
+                return (h, kp, vp), None
+
+            (x, kp, vp), _ = lax.scan(layer_body_fused, (x, kp, vp),
+                                      (stacked, jnp.arange(L)))
+        else:
+            def layer_body(h, blk_i):
+                blk, i = blk_i
+                q, k, v = blk._qkv(h, lengths)
+                k_row = k[:, 0].astype(kp.dtype)
+                v_row = v[:, 0].astype(vp.dtype)
+                o, m, l = paged_decode_attention(
+                    q[:, 0].astype(kp.dtype), kp, vp, i * self.P + table,
+                    lengths, scale=scale, return_stats=True)
+                attn = fold_fresh_row(o, m, l, q[:, 0], k_row, v_row,
+                                      scale, blk.n_heads // blk.kv_heads)
+                attn = attn.astype(h.dtype).reshape(h.shape)
+                h = blk._block_tail(h, attn)
+                return h, (k_row, v_row)
+
+            x, (k_rows, v_rows) = lax.scan(
+                layer_body, x, (stacked, jnp.arange(L)))
+            kp, vp = self._write_token_rows(kp, vp, k_rows, v_rows,
+                                            table, lengths, active)
         logits = self._lm_head(head, x)[:, 0]
         logits = jnp.where(poison[:, None], jnp.nan, logits)
         bad = active & ~jnp.all(jnp.isfinite(logits), axis=-1)
@@ -391,6 +544,115 @@ class PagedDecodeEngine(ResilientScheduler):
             jnp.int32)[0]
         return kp, vp, nxt
 
+    def _prefill_suffix_impl(self, head, stacked, kp, vp, tokens, sp,
+                             true_n, segs, cow_src, cow_dst, table_row):
+        """Suffix-only prefill over a CACHED prefix (one prompt whose
+        first ``sp`` tokens' KV already sit in shared pages mapped into
+        ``table_row``). The cached prefix's forward is never recomputed:
+        per layer, the suffix tokens' KV rows are written into the
+        slot's pages FIRST (page-run segments ``segs``: (pid, dst_off,
+        src, run) int32, run=0 padding), then the paged kernel runs with
+        ONE QUERY ROW PER SUFFIX POSITION — row t's length is
+        ``sp + t + 1``, so it attends over [cached prefix + suffix
+        causal] exactly (its own row included, already written).
+
+        ``cow_src``/``cow_dst`` (-1 = none) implement copy-on-write for
+        the exact-page-multiple full match: the last matched page is
+        copied into a private page before the final token's KV row is
+        written inside it.
+
+        tokens: (1, bucket) suffix zero-padded; sp/true_n scalars
+        (suffix = prompt[sp:true_n]); table_row: (max_pages,) this
+        slot's UNFOLDED page table row."""
+        cfg = self.cfg
+        bucket = tokens.shape[1]
+        L = cfg.n_layers
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+        mx = table_row.shape[0]
+
+        def do_cow(kvp):
+            kp, vp = kvp
+            src = jnp.arange(L, dtype=jnp.int32) * self.P + cow_src
+            dst = jnp.arange(L, dtype=jnp.int32) * self.P + cow_dst
+            return kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src])
+
+        kp, vp = lax.cond(cow_src >= 0, do_cow, lambda kvp: kvp,
+                          (kp, vp))
+
+        x = jnp.take(head["wte"], tokens, axis=0)
+        if head["wpe"] is not None:
+            # per-row clamped gather (not dynamic_slice: its clamped
+            # START would shift REAL rows when sp + bucket overruns the
+            # table; here only pad rows clamp, and they are unused)
+            pos = jnp.clip(sp + jnp.arange(bucket), 0,
+                           head["wpe"].shape[0] - 1)
+            x = x + jnp.take(head["wpe"], pos, axis=0)[None]
+
+        # row t of the suffix attends over min(sp + t + 1, n) tokens
+        lens_t = jnp.minimum(
+            sp + 1 + jnp.arange(bucket, dtype=jnp.int32), true_n)
+        table_b = jnp.broadcast_to(table_row[None], (bucket, mx))
+
+        def layer_body(carry, blk_i):
+            h, kp, vp = carry
+            blk, i = blk_i
+            q, k, v = blk._qkv(h, jnp.reshape(sp, (1,)))
+            # (1, bucket, Hkv, D) -> (Hkv, bucket, D), padded one page
+            # on each side so every segment's full-page source window
+            # (start = page + src - dst_off) stays in bounds
+            ks = jnp.swapaxes(k, 1, 2)[0].astype(kp.dtype)
+            vs = jnp.swapaxes(v, 1, 2)[0].astype(vp.dtype)
+            ks = jnp.pad(ks, ((0, 0), (self.page, self.page), (0, 0)))
+            vs = jnp.pad(vs, ((0, 0), (self.page, self.page), (0, 0)))
+
+            def write_seg(j, kvp):
+                kp, vp = kvp
+                pid, off, src, run = (segs[j, 0], segs[j, 1],
+                                      segs[j, 2], segs[j, 3])
+                dst = i * self.P + pid
+
+                def do(kvp):
+                    kp, vp = kvp
+                    start = self.page + src - off
+                    kwin = lax.dynamic_slice(
+                        ks, (0, start, 0),
+                        (cfg.kv_heads, self.page, cfg.head_dim))
+                    vwin = lax.dynamic_slice(
+                        vs, (0, start, 0),
+                        (cfg.kv_heads, self.page, cfg.head_dim))
+                    old_k = lax.dynamic_slice(
+                        kp, (dst, 0, 0, 0),
+                        (1, cfg.kv_heads, self.page, cfg.head_dim))
+                    old_v = lax.dynamic_slice(
+                        vp, (dst, 0, 0, 0),
+                        (1, cfg.kv_heads, self.page, cfg.head_dim))
+                    ar = jnp.arange(self.page)
+                    m = ((ar >= off) & (ar < off + run))[None, :, None]
+                    km = jnp.where(m, kwin, old_k[0])[None]
+                    vm = jnp.where(m, vwin, old_v[0])[None]
+                    return (lax.dynamic_update_slice(kp, km,
+                                                     (dst, 0, 0, 0)),
+                            lax.dynamic_update_slice(vp, vm,
+                                                     (dst, 0, 0, 0)))
+
+                return lax.cond(run > 0, do, lambda kvp: kvp, (kp, vp))
+
+            kp, vp = lax.fori_loop(0, segs.shape[0], write_seg,
+                                   (kp, vp))
+            o = paged_decode_attention(
+                q[0].astype(kp.dtype), kp, vp, i * self.P + table_b,
+                lens_t, scale=scale)
+            attn = o.astype(h.dtype).reshape(h.shape)
+            return (blk._block_tail(h, attn), kp, vp), None
+
+        (x, kp, vp), _ = lax.scan(layer_body, (x, kp, vp),
+                                  (stacked, jnp.arange(L)))
+        idx = jnp.clip(true_n - sp - 1, 0, bucket - 1)
+        logits = self._lm_head(head, x[:, idx][:, None])[:, 0]
+        nxt = jnp.argmax(logits.astype(jnp.float32), -1).astype(
+            jnp.int32)[0]
+        return kp, vp, nxt
+
     # -- scheduler ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -425,39 +687,162 @@ class PagedDecodeEngine(ResilientScheduler):
         self._release(slot)
         super()._on_evict(slot)
 
+    def _fail(self, req, reason, slot=None,
+              stat="serve/deadline_evictions"):
+        if slot is not None and stat == "serve/nonfinite_evictions":
+            # a non-finite eviction means this slot's KV is suspect:
+            # taint it so _release scrubs its private pages, and drop
+            # every trie node its table maps, or a poisoned prefix
+            # stays canonical and every future submit of the same
+            # (popular) prompt maps the bad pages and fails — forever.
+            # Current sharers keep their refs and fail loudly at their
+            # own harvest; the next submit prefills cold into scrubbed
+            # pages and re-registers a healthy copy.
+            self._tainted.add(slot)
+            if self._prefix is not None:
+                for p in self._tables[slot]:
+                    # never frees here — the slot's own mapping keeps
+                    # refs >= 1, so the page dies (and is scrubbed)
+                    # at this slot's _release via the unref path
+                    self._prefix.invalidate(p)
+        super()._fail(req, reason, slot, stat)
+
+    def _match_prefix(self, prompt, slot):
+        """Longest-cached-prefix lookup at admission: maps the matched
+        pages into the slot's (empty) table read-only and returns
+        ``(sp, cow_src, chain)`` — the suffix start (tokens served from
+        cache), the COW source page (-1 = none), and the prompt's
+        digest chain (reused by ``register`` so admission hashes the
+        prompt exactly once). An exact-page-multiple full match keeps
+        all but the last page: the final token must re-run for
+        first-token logits and its KV row lands INSIDE the last matched
+        page, so that page is copied to a private one (copy-on-write on
+        the first partial page). Counters for the lookup land in
+        ``_admit`` AFTER the reservation succeeds — a MemoryError-
+        retried admission must not double-count its hit tokens."""
+        chain = self._prefix.chain(prompt)
+        matched = self._prefix.lookup(prompt, chain=chain)
+        n = len(prompt)
+        sp, cow_src = 0, -1
+        if matched and len(matched) * self.page >= n:
+            cow_src = matched[-1]
+            self._prefix.unref(matched[-1])
+            matched = matched[:-1]
+            sp = n - 1
+        elif matched:
+            sp = len(matched) * self.page
+        self._tables[slot][:] = matched
+        if matched:
+            self._table_dirty = True
+        return sp, cow_src, chain
+
+    def _corrupt_shared_pages(self, shared):
+        """Payload fault site ``paged.shared_page``: with a matching
+        nan/bitflip rule installed, corrupt the FIRST shared page this
+        admission mapped (all layers) — the blast-radius probe for
+        prefix sharing: one poisoned page must fail EVERY sharer loudly
+        (each hits the non-finite-logit guard), never silently. Inert
+        (one boolean check) without a fault plan."""
+        from paddle_tpu.testing import faults
+        if not faults.enabled() or not shared:
+            return
+        ids = np.arange(self.cfg.n_layers) * self.P + shared[0]
+        # ptlint: disable=PT001 -- test-only fault injection (gated on
+        # faults.enabled()): reading the page back is the point
+        page_k = np.asarray(self.kp[ids])
+        out = faults.transform("paged.shared_page", page_k)
+        if out is page_k:
+            # byte-payload actions (bitflip) only fire on bytes values;
+            # a nan rule already returned a fresh array above
+            buf = page_k.tobytes()
+            ob = faults.transform("paged.shared_page", buf)
+            if isinstance(ob, (bytes, bytearray)) and bytes(ob) != buf:
+                out = np.frombuffer(
+                    bytearray(bytes(ob).ljust(len(buf), b"\0")),
+                    page_k.dtype).reshape(page_k.shape)
+        if out is not page_k:
+            self.kp = self.kp.at[ids].set(
+                jnp.asarray(out, self.kp.dtype))
+
     def _admit(self, req: Request, slot: int):
-        """Reserve pages, dispatch the one-pass prefill, and flip the
-        slot live — WITHOUT syncing on the sampled first token: it
-        stays on device (`.at[].set(nxt)`) and rides the harvest queue
-        as a 'prefill' record, so admission enqueues behind in-flight
-        decode dispatches instead of draining them."""
+        """Reserve pages, dispatch the one-pass (or suffix-only)
+        prefill, and flip the slot live — WITHOUT syncing on the
+        sampled first token: it stays on device (`.at[].set(nxt)`) and
+        rides the harvest queue as a 'prefill' record, so admission
+        enqueues behind in-flight decode dispatches instead of draining
+        them. With the prefix cache on, the longest cached prefix's
+        pages are mapped read-only and only the suffix is prefilled."""
         import time
         from paddle_tpu.observability import trace
         # ptlint: disable=PT001 -- req.prompt is a host int list
         # (submit coerced it); this is an upload, never a sync
         prompt = np.asarray(req.prompt, np.int32)
         n = len(prompt)
-        bucket = next(b for b in self.buckets if b >= n)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = prompt
+        sp, cow_src, chain = (self._match_prefix(prompt, slot)
+                              if self._prefix is not None
+                              else (0, -1, None))
         self._reserve(slot, n)
         tab = self._tables[slot]
-        # page-run copy plan: valid rows [0, n) split at page boundaries
-        max_seg = bucket // self.page + 1
-        segs = np.zeros((max_seg, self.cfg.n_layers, 3), np.int32)
-        t, i = 0, 0
-        while t < n:
-            pid = tab[t // self.page]
-            run = min(n - t, self.page - (t % self.page))
-            for l in range(self.cfg.n_layers):
-                segs[i, l] = (l * self.P + pid, t, run)
-            t += run
-            i += 1
-        with trace.span("serve/admit", slot=slot, prompt=n,
-                        bucket=bucket):
-            self.kp, self.vp, nxt = self._prefill_fn(
-                self._head, self._stacked, self.kp, self.vp,
-                jnp.asarray(padded), jnp.int32(n), jnp.asarray(segs))
+        if self._prefix is not None:
+            if n >= self.page:
+                # register this prompt's full pages (private ones
+                # become canonical for future hits; already-cached
+                # digests skip)
+                self._prefix.register(prompt, tab, chain=chain)
+                self._update_pool_gauges()
+            # counters only once the reservation held — the
+            # MemoryError-retry path re-runs this whole admission
+            from paddle_tpu import stats
+            stats.add("serve/prefix_lookup")
+            if sp:
+                stats.add("serve/prefix_hit_tokens", sp)
+        self._corrupt_shared_pages(tab[:sp // self.page])
+        bucket = next(b for b in self.buckets if b >= n - sp)
+        if sp:
+            suffix = np.zeros((1, bucket), np.int32)
+            suffix[0, :n - sp] = prompt[sp:]
+            # page-run plan over positions [sp, n): (pid, dst_off,
+            # src-in-suffix, run), run=0 padding
+            segs = np.zeros((bucket // self.page + 2, 4), np.int32)
+            t, i = sp, 0
+            while t < n:
+                pid = tab[t // self.page]
+                off = t % self.page
+                run = min(n - t, self.page - off)
+                segs[i] = (pid, off, t - sp, run)
+                t += run
+                i += 1
+            cow_dst = tab[(n - 1) // self.page] if cow_src >= 0 else -1
+            mx = (self.cfg.max_seq_len + self.page - 1) // self.page
+            row = np.zeros((mx,), np.int32)
+            row[:len(tab)] = tab
+            with trace.span("serve/admit", slot=slot, prompt=n,
+                            bucket=bucket, cached=sp):
+                self.kp, self.vp, nxt = self._prefill_sfx_fn(
+                    self._head, self._stacked, self.kp, self.vp,
+                    jnp.asarray(suffix), jnp.int32(sp), jnp.int32(n),
+                    jnp.asarray(segs), jnp.int32(cow_src),
+                    jnp.int32(cow_dst), jnp.asarray(row))
+        else:
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = prompt
+            # page-run copy plan: valid rows [0, n) at page boundaries
+            max_seg = bucket // self.page + 1
+            segs = np.zeros((max_seg, self.cfg.n_layers, 3), np.int32)
+            t, i = 0, 0
+            while t < n:
+                pid = tab[t // self.page]
+                run = min(n - t, self.page - (t % self.page))
+                for l in range(self.cfg.n_layers):
+                    segs[i, l] = (l * self.P + pid, t, run)
+                t += run
+                i += 1
+            with trace.span("serve/admit", slot=slot, prompt=n,
+                            bucket=bucket, cached=0):
+                self.kp, self.vp, nxt = self._prefill_fn(
+                    self._head, self._stacked, self.kp, self.vp,
+                    jnp.asarray(padded), jnp.int32(n),
+                    jnp.asarray(segs))
         rem0 = req.max_new_tokens - 1
         eos0 = -1 if req.eos_id is None else int(req.eos_id)
         # a budget-of-one request (or one whose first token is eos)
@@ -614,6 +999,7 @@ class PagedDecodeEngine(ResilientScheduler):
         from paddle_tpu import stats
         t0 = time.perf_counter()
         kp, vp = jnp.zeros_like(self.kp), jnp.zeros_like(self.vp)
+        mx = (self.cfg.max_seq_len + self.page - 1) // self.page
         for b in self.buckets:
             segs = np.zeros((b // self.page + 1, self.cfg.n_layers, 3),
                             np.int32)
@@ -621,6 +1007,15 @@ class PagedDecodeEngine(ResilientScheduler):
                 self._head, self._stacked, kp, vp,
                 jnp.zeros((1, b), jnp.int32), jnp.int32(1),
                 jnp.asarray(segs))
+            if self._prefix is not None:
+                # the warm-hit admission path (suffix-only prefill)
+                # compiles per bucket too
+                sfx_segs = np.zeros((b // self.page + 2, 4), np.int32)
+                kp, vp, _ = self._prefill_sfx_fn(
+                    self._head, self._stacked, kp, vp,
+                    jnp.zeros((1, b), jnp.int32), jnp.int32(0),
+                    jnp.int32(1), jnp.asarray(sfx_segs), jnp.int32(-1),
+                    jnp.int32(-1), jnp.zeros((mx,), jnp.int32))
         out = self._multi_fn(
             self._head, self._stacked, kp, vp, self._table(),
             self.lengths, self.last, self.active, self.remaining,

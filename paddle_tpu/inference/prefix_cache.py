@@ -1,0 +1,233 @@
+"""Prefix (radix) caching over the paged KV pool: identical prompt
+prefixes are prefilled ONCE and their pages shared read-only across
+requests (the vLLM "automatic prefix caching" memory model; no reference
+analog — the reference's fused_multi_transformer owns one contiguous
+CacheKV per sequence and cannot share rows between sequences).
+
+Design (ISSUE 6):
+
+- **Page-aligned token-hash chains.** The unit of sharing is one FULL
+  page of prompt tokens. Node ``i`` of a prompt's chain is keyed by
+  ``sha1(parent_digest + tokens[i*page:(i+1)*page])`` — the digest
+  therefore encodes the page's tokens AND its entire left context, which
+  is exactly what determines the page's KV content (attention rows
+  depend on every earlier token; rope positions are the chain depth).
+  Content-addressing by chain digest means a re-registered parent
+  reattaches existing children automatically.
+
+- **Refcounts, not ownership.** ``refs[pid]`` counts the slot tables a
+  cached page is currently mapped into. ``unref`` at slot retirement
+  moves a count-zero page to an LRU of *reclaimable* pages instead of
+  freeing it — the KV stays warm for the next hit (a hit revives it
+  from the LRU). Under pool pressure ``reclaim`` frees LRU-oldest
+  count-zero pages back to the allocator and drops their trie nodes.
+
+- **Read-only mapping + COW.** Matched pages enter a slot's table
+  read-only; the engine guarantees no write ever lands in them because
+  suffix prefill and decode appends only touch positions >= the match
+  boundary, which live in freshly allocated private pages. The one
+  exception is a prompt that is an exact multiple of the page size and
+  matches in full: the final prompt token must still be re-run to
+  produce first-token logits, and its KV row lands INSIDE the last
+  matched page — the engine copies that page to a private one first
+  (copy-on-write on the first partial page; see
+  ``PagedDecodeEngine._admit``).
+
+Everything here is host-side bookkeeping (dict/OrderedDict ops at
+admission and retirement); no jax imports, nothing traced.
+"""
+
+import collections
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+class PrefixCache:
+    """Refcounted prefix trie over a ``PageAllocator``'s page ids.
+
+    The allocator is shared with the engine: pages the trie holds at
+    refcount zero are NOT on the allocator's free list (they are warm
+    cache), and ``reclaim`` is the only way they return to it.
+    """
+
+    def __init__(self, allocator, page_size: int):
+        self._alloc = allocator
+        self.page = int(page_size)
+        self._nodes: Dict[bytes, int] = {}       # chain digest -> pid
+        self._bypid: Dict[int, bytes] = {}       # pid -> chain digest
+        self._refs: Dict[int, int] = {}          # pid -> live mappings
+        # refcount-zero cached pages, oldest-first (LRU reclaim order)
+        self._zero: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        # pages with refcount > 0, maintained incrementally: the engine
+        # reads shared_pages on every reservation/release (gauge
+        # update), which must not scan the refs dict on the host path
+        self._n_shared = 0
+        # invalidated (poisoned-KV) pages still mapped by live sharers:
+        # their trie nodes are gone, and the last unref frees them to
+        # the allocator instead of warming the LRU
+        self._dead: set = set()
+
+    # -- chain hashing ------------------------------------------------------
+
+    def chain(self, tokens) -> List[bytes]:
+        """Cumulative digests of ``tokens``' FULL pages (one per page;
+        the trailing partial page has no digest — it is not shareable)."""
+        toks = np.asarray(tokens, np.int32)
+        out, digest = [], b"paged-prefix-v1"
+        for i in range(len(toks) // self.page):
+            h = hashlib.sha1(digest)
+            h.update(toks[i * self.page:(i + 1) * self.page].tobytes())
+            digest = h.digest()
+            out.append(digest)
+        return out
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def owns(self, pid: int) -> bool:
+        """True iff ``pid`` is a cached (trie-held) page — released via
+        ``unref``, never via the allocator free list."""
+        return pid in self._bypid
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._bypid)
+
+    @property
+    def shared_pages(self) -> int:
+        """Cached pages currently mapped into at least one slot."""
+        return self._n_shared
+
+    @property
+    def reclaimable_pages(self) -> int:
+        return len(self._zero)
+
+    def ref(self, pid: int):
+        if pid not in self._bypid:
+            raise KeyError(f"page {pid} is not cached")
+        before = self._refs.get(pid, 0)
+        if before == 0:
+            self._n_shared += 1
+        self._refs[pid] = before + 1
+        self._zero.pop(pid, None)
+
+    def unref(self, pid: int) -> Optional[int]:
+        """Drop one mapping. Returns ``pid`` when this was the last ref
+        of an INVALIDATED page and it went back to the allocator — the
+        caller owns the KV pool and must scrub the poisoned rows before
+        the page can be reused; returns ``None`` otherwise."""
+        n = self._refs.get(pid, 0) - 1
+        if n < 0:
+            raise ValueError(f"unref of unmapped cached page {pid}")
+        self._refs[pid] = n
+        if n == 0:
+            self._n_shared -= 1
+            if pid in self._dead:
+                # last sharer of an invalidated page: back to the
+                # allocator, never the warm LRU
+                self._dead.discard(pid)
+                self._bypid.pop(pid, None)
+                self._refs.pop(pid, None)
+                self._alloc.release([pid])
+                return pid
+            # warm but reclaimable; most-recently-retired goes to the
+            # LRU tail so reclaim eats the coldest prefix first
+            self._zero[pid] = None
+            self._zero.move_to_end(pid)
+        return None
+
+    def invalidate(self, pid: int) -> Optional[int]:
+        """Drop ``pid``'s trie node so no FUTURE lookup can map it —
+        the poisoned-KV escape hatch: a request evicted for non-finite
+        logits must not leave its prefix pages canonical, or every
+        later submit of the same (popular) prompt would map the
+        poisoned KV and fail forever. Current sharers keep their
+        refcounted mapping (they fail loudly at their own harvest);
+        the page returns to the allocator once the last ref drops.
+        Descendant nodes become unreachable (lookup breaks at the
+        missing parent) and age out of the LRU on their own. Returns
+        ``pid`` when the page was warm/unmapped and went straight back
+        to the allocator (the caller must scrub its KV), else None."""
+        digest = self._bypid.get(pid)
+        if digest is None:
+            return None
+        if self._nodes.get(digest) == pid:
+            # guard against a STALE invalidation: if this pid was
+            # already invalidated and the digest re-registered with a
+            # healthy page (the poisoned prompt re-submitted), a late
+            # sharer's failure must not de-canonicalize the new copy
+            self._nodes.pop(digest)
+        if self._refs.get(pid, 0) == 0:
+            # warm and unmapped: free immediately
+            self._zero.pop(pid, None)
+            self._bypid.pop(pid)
+            self._refs.pop(pid, None)
+            self._alloc.release([pid])
+            return pid
+        self._dead.add(pid)
+        return None
+
+    # -- lookup / registration ----------------------------------------------
+
+    def lookup(self, tokens, chain: Optional[List[bytes]] = None
+               ) -> List[int]:
+        """Longest cached prefix of ``tokens``: the page ids of the
+        leading full pages whose chain digests are all present, each
+        ref'd for the caller (the caller maps them into a slot table
+        and MUST ``unref`` any it decides not to keep). Pass ``chain``
+        (from ``self.chain``) to reuse an already computed digest
+        chain — admission hashes the prompt exactly once."""
+        pids: List[int] = []
+        for digest in (self.chain(tokens) if chain is None else chain):
+            pid = self._nodes.get(digest)
+            if pid is None:
+                break
+            self.ref(pid)
+            pids.append(pid)
+        return pids
+
+    def register(self, tokens, table: List[int],
+                 chain: Optional[List[bytes]] = None) -> int:
+        """Insert ``tokens``' full pages (backed by ``table``'s leading
+        page ids, which the registering slot currently maps) into the
+        trie. Pages whose digest is already present are skipped — the
+        existing copy stays canonical and the caller's private
+        duplicate is freed normally at retirement. Returns the number
+        of pages newly registered (each gains the caller's mapping as
+        its first ref)."""
+        added = 0
+        for i, digest in enumerate(self.chain(tokens)
+                                   if chain is None else chain):
+            if digest in self._nodes:
+                continue
+            pid = table[i]
+            if pid in self._bypid:       # already canonical elsewhere
+                continue
+            self._nodes[digest] = pid
+            self._bypid[pid] = digest
+            self._refs[pid] = 1          # the registering slot's mapping
+            self._n_shared += 1
+            added += 1
+        return added
+
+    # -- reclaim ------------------------------------------------------------
+
+    def reclaim(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` refcount-zero cached pages back to the
+        allocator, LRU-oldest first (their trie nodes are dropped —
+        descendants keyed through them become unreachable and age out
+        of the LRU on their own). Returns the number freed."""
+        freed = 0
+        while freed < n_pages and self._zero:
+            pid, _ = self._zero.popitem(last=False)
+            digest = self._bypid.pop(pid)
+            if self._nodes.get(digest) == pid:
+                del self._nodes[digest]
+            self._refs.pop(pid, None)
+            self._alloc.release([pid])
+            freed += 1
+        return freed

@@ -16,6 +16,28 @@ sequence owns — same online-softmax inner loop as decode_attention,
 same clamp trick (a repeated page index is not re-fetched) for rows
 shorter than the longest.
 
+Two kernel entry points share one body:
+
+- `paged_decode_attention` — read-only pools, optional (m, l) stats so
+  the caller can fold extra columns analytically (the pre-fusion
+  engine formulation).
+- `paged_append_attend` — the FUSED append+attend step: the current
+  token's fresh K/V row is folded into the online softmax *and*
+  written into its pool page inside the kernel, with
+  ``input_output_aliases`` on the pools so the write is in place. The
+  one batched scatter per cache per token the engine used to pay
+  disappears (ISSUE 6 / PAPERS "LLM Inference Acceleration via
+  Efficient Operation Fusion").
+
+Both take an autotunable ``(pages_per_program, head_block)`` config
+(see `tune_paged_attention`): pages_per_program streams several pages
+per grid step (separate BlockSpecs — pool pages are not contiguous, so
+one bigger block cannot express this), head_block processes several
+consecutive KV heads of one page per program (their rows ARE contiguous
+in the head-major pool view). Both shrink the grid — the paged kernel's
+measured overhead at short cache lengths is per-program dispatch over a
+mostly-masked fixed-width table, not bandwidth.
+
 Forward-only (generation never differentiates through the cache).
 """
 
@@ -28,26 +50,74 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["paged_decode_attention", "paged_decode_attention_reference",
-           "PagedKVCache"]
+           "paged_append_attend", "tune_paged_attention", "PagedKVCache"]
 
 _LANES = 128
 _NEG_INF = float("-inf")
 
+# fallback when the autotune cache has no entry for the shape family:
+# one page and one KV head per program (the pre-autotune geometry)
+_DEFAULT_CONFIG = (1, 1)
 
-def _kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref, *rest,
-            scale, page, hkv, with_stats):
-    # table_ref is consumed by the BlockSpec index maps (scalar
-    # prefetch), not the body; it still appears in the kernel ABI.
-    # The stats output ref exists only when requested (out_specs are
-    # built conditionally), so the trailing refs shift — same
-    # convention as the contiguous decode kernel.
-    if with_stats:
-        ml_ref, acc_ref, m_ref, l_ref = rest
+
+def _tune_key(page, hkv, d, dtype, group, fused):
+    from paddle_tpu.ops.pallas.autotune import AutotuneCache
+    return AutotuneCache.key(
+        "paged_append" if fused else "paged_attention",
+        page=page, hkv=hkv, d=d, dtype=str(dtype), group=group)
+
+
+def _resolve_config(ppp, hb, page, hkv, d, dtype, group, max_pages,
+                    fused):
+    """Fill unset config knobs from the autotune cache (trace-time dict
+    read, ≙ flash_attention's block lookup) and clamp to validity:
+    pages_per_program can't exceed the table width, head_block must
+    divide Hkv."""
+    if ppp is None or hb is None:
+        from paddle_tpu.ops.pallas.autotune import get_cache
+        hit = get_cache().get(_tune_key(page, hkv, d, dtype, group,
+                                        fused))
+        t_ppp, t_hb = hit if hit is not None else _DEFAULT_CONFIG
+        ppp = t_ppp if ppp is None else ppp
+        hb = t_hb if hb is None else hb
+    # ptlint: disable=PT001 -- ppp/hb are static Python config knobs
+    # (autotune-cache hits or explicit kwargs; a tracer here would
+    # already have failed the cache lookup), never device values
+    ppp = max(1, min(int(ppp), max_pages))
+    hb = max(1, int(hb))  # ptlint: disable=PT001 -- static config knob
+    while hkv % hb:
+        hb -= 1
+    return ppp, hb
+
+
+def _kernel(*refs, scale, page, hkv, ppp, hb, with_stats, fused):
+    # Ref layout (the table/wpid prefetch refs are consumed by the
+    # BlockSpec index maps, not the body, but still appear in the ABI;
+    # the stats output exists only when requested, so trailing refs
+    # shift — same convention as the contiguous decode kernel):
+    #   plain: len, table, q, k*ppp, v*ppp | o, [ml] | acc, m, l
+    #   fused: len, table, wpid, q, k*ppp, v*ppp, krow, vrow, kwin,
+    #          vwin | o, kw, vw | acc, m, l
+    if fused:
+        len_ref, _table_ref, _wpid_ref, q_ref = refs[:4]
+        rest = refs[4:]
     else:
-        ml_ref, (acc_ref, m_ref, l_ref) = None, rest
+        len_ref, _table_ref, q_ref = refs[:3]
+        rest = refs[3:]
+    k_refs, v_refs = rest[:ppp], rest[ppp:2 * ppp]
+    rest = rest[2 * ppp:]
+    ml_ref = None
+    if fused:
+        (krow_ref, vrow_ref, kwin_ref, vwin_ref,
+         o_ref, kw_ref, vw_ref, acc_ref, m_ref, l_ref) = rest
+    elif with_stats:
+        o_ref, ml_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    nhb = hkv // hb
     bh = pl.program_id(0)
     j = pl.program_id(1)
-    b = bh // hkv
+    b = bh // nhb
 
     from paddle_tpu.ops.pallas.decode_attention import (
         online_softmax_finalize, online_softmax_init,
@@ -61,16 +131,46 @@ def _kernel(len_ref, table_ref, q_ref, k_ref, v_ref, o_ref, *rest,
 
     # beyond the row's last valid page the index map re-presents that
     # SAME page (DMA elided); the compute must not run again
-    @pl.when(j * page < length)
-    def _body():
-        online_softmax_step(q_ref[0], k_ref[0], v_ref[0], j * page,
-                            length, acc_ref, m_ref, l_ref, scale)
+    for i in range(ppp):
+        col0 = (j * ppp + i) * page
+
+        @pl.when(col0 < length)
+        def _body(i=i, col0=col0):
+            for h in range(hb):
+                online_softmax_step(q_ref[h], k_refs[i][h], v_refs[i][h],
+                                    col0, length, acc_ref.at[h],
+                                    m_ref.at[h], l_ref.at[h], scale)
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _finalize():
-        online_softmax_finalize(o_ref, acc_ref, l_ref)
-        if with_stats:
-            online_softmax_write_stats(ml_ref, m_ref, l_ref)
+        if fused:
+            # fold the fresh row as one more single-column online step
+            # (cols length..length+sub-1, only col==length unmasked —
+            # the sublane-pad rows of krow score -inf), then merge it
+            # into its pool page: the aliased write block is the page at
+            # position length, row offset length % page replaced. The
+            # write-back DMA lands after this grid row's last program —
+            # the attend stream only ever read rows < length, so order
+            # does not matter.
+            off = length % page
+            sel = jax.lax.broadcasted_iota(jnp.int32, (page, 1), 0) == off
+            for h in range(hb):
+                online_softmax_step(q_ref[h], krow_ref[h], vrow_ref[h],
+                                    length, length + 1, acc_ref.at[h],
+                                    m_ref.at[h], l_ref.at[h], scale)
+                kw_ref[h] = jnp.where(sel, krow_ref[h][:1], kwin_ref[h])
+                vw_ref[h] = jnp.where(sel, vrow_ref[h][:1], vwin_ref[h])
+        for h in range(hb):
+            # hb == 1 passes the block ref whole: a ``.at[0:1]`` view of
+            # a size-1 dim is a "trivial" transform that jax 0.4.37's
+            # interpret-mode discharge mishandles when stacked under the
+            # helper's integer write
+            ov = o_ref if hb == 1 else o_ref.at[h:h + 1]
+            online_softmax_finalize(ov, acc_ref.at[h], l_ref.at[h])
+            if with_stats:
+                mlv = ml_ref if hb == 1 else ml_ref.at[h:h + 1]
+                online_softmax_write_stats(mlv, m_ref.at[h],
+                                           l_ref.at[h])
 
 
 def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
@@ -99,9 +199,140 @@ def paged_decode_attention_reference(q, k_pages, v_pages, page_table,
     return o.reshape(b, hq, d).astype(q.dtype)
 
 
+def _paged_call(q, k_pages, v_pages, page_table, lengths, scale,
+                interpret, return_stats, pages_per_program, head_block,
+                k_row=None, v_row=None, write_pids=None):
+    """Shared call-site builder for the plain and fused paged kernels
+    (fused ⇔ ``k_row`` is given)."""
+    fused = k_row is not None
+    q = jnp.asarray(q)
+    k_pages, v_pages = jnp.asarray(k_pages), jnp.asarray(v_pages)
+    b, hq, d = q.shape
+    hkv, page = k_pages.shape[1], k_pages.shape[2]
+    max_pages = page_table.shape[1]
+    if hq % hkv:
+        raise ValueError(f"GQA needs Hq % Hkv == 0, got {hq} vs {hkv}")
+    if page % _LANES:
+        raise ValueError(f"page_size {page} must be a multiple of "
+                         f"{_LANES}")
+    group = hq // hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    ppp, hb = _resolve_config(pages_per_program, head_block, page, hkv,
+                              d, q.dtype, group, max_pages, fused)
+    nhb = hkv // hb
+    nj = (max_pages + ppp - 1) // ppp
+
+    sub = 16 if q.dtype in (jnp.bfloat16, jnp.float16) else 8
+    gp = max(sub, (group + sub - 1) // sub * sub)
+    qg = q.reshape(b * hkv, group, d)
+    qg = jnp.pad(qg, ((0, 0), (0, gp - group), (0, 0)))
+
+    lengths = jnp.asarray(lengths, jnp.int32)
+    table_flat = jnp.asarray(page_table, jnp.int32).reshape(-1)
+    # pools are indexed (page, head) -> (page, D): merge Hkv into the
+    # leading dim via a head-major view so one block = hb consecutive
+    # (page, D) tiles of one page. (P, Hkv, page, D) -> (P*Hkv, page, D)
+    # with id p*Hkv+h; the hb-row block at p*nhb + head_block_index is
+    # contiguous because heads vary fastest.
+    kp = k_pages.reshape(-1, page, d)
+    vp = v_pages.reshape(-1, page, d)
+
+    def bh_index(bh, j, *pref):
+        return (bh, 0, 0)
+
+    def kv_index(i):
+        def index(bh, j, lens, table, *maybe_wpid):
+            bb = bh // nhb
+            used = jnp.maximum((lens[bb] + page - 1) // page, 1)
+            jj = jnp.minimum(j * ppp + i, used - 1)
+            return (table[bb * max_pages + jj] * nhb + bh % nhb, 0, 0)
+        return index
+
+    in_specs = [pl.BlockSpec((hb, gp, d), bh_index)]
+    in_specs += [pl.BlockSpec((hb, page, d), kv_index(i))
+                 for i in range(ppp)]
+    in_specs += [pl.BlockSpec((hb, page, d), kv_index(i))
+                 for i in range(ppp)]
+    out_specs = [pl.BlockSpec((hb, gp, d), bh_index)]
+    out_shape = [jax.ShapeDtypeStruct((b * hkv, gp, d), q.dtype)]
+    operands = [qg] + [kp] * ppp + [vp] * ppp
+
+    if fused:
+        def w_index(bh, j, lens, table, wpids):
+            return (wpids[bh // nhb] * nhb + bh % nhb, 0, 0)
+
+        krow = jnp.asarray(k_row).reshape(b * hkv, 1, d)
+        vrow = jnp.asarray(v_row).reshape(b * hkv, 1, d)
+        krow = jnp.pad(krow, ((0, 0), (0, sub - 1), (0, 0)))
+        vrow = jnp.pad(vrow, ((0, 0), (0, sub - 1), (0, 0)))
+        in_specs += [pl.BlockSpec((hb, sub, d), bh_index),
+                     pl.BlockSpec((hb, sub, d), bh_index),
+                     pl.BlockSpec((hb, page, d), w_index),
+                     pl.BlockSpec((hb, page, d), w_index)]
+        out_specs += [pl.BlockSpec((hb, page, d), w_index),
+                      pl.BlockSpec((hb, page, d), w_index)]
+        out_shape += [jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+                      jax.ShapeDtypeStruct(vp.shape, vp.dtype)]
+        operands += [krow, vrow, kp, vp]
+        prefetch = (lengths, table_flat,
+                    jnp.asarray(write_pids, jnp.int32))
+        # the pool write-view operands alias the pool outputs: the
+        # kernel's page write is in place, untouched pages keep their
+        # input values. Operand numbering counts the scalar-prefetch
+        # refs: 3 prefetch + q + 2*ppp streams + krow/vrow.
+        aliases = {3 + 1 + 2 * ppp + 2: 1, 3 + 1 + 2 * ppp + 3: 2}
+    else:
+        if return_stats:  # stats output only exists when asked for
+            out_specs.append(pl.BlockSpec((hb, gp, _LANES), bh_index))
+            out_shape.append(
+                jax.ShapeDtypeStruct((b * hkv, gp, _LANES), jnp.float32))
+        prefetch = (lengths, table_flat)
+        aliases = {}
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(prefetch),
+        grid=(b * nhb, nj),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((hb, gp, d), jnp.float32),
+            pltpu.VMEM((hb, gp, _LANES), jnp.float32),
+            pltpu.VMEM((hb, gp, _LANES), jnp.float32),
+        ],
+    )
+    res = pl.pallas_call(
+        # ptlint: disable=PT001 -- scale is a static Python float kwarg
+        # (a tracer here would already fail partial-binding)
+        functools.partial(_kernel, scale=float(scale), page=page,
+                          hkv=hkv, ppp=ppp, hb=hb,
+                          with_stats=return_stats, fused=fused),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(*prefetch, *operands)
+    o = res[0][:, :group, :].reshape(b, hq, d)
+    if fused:
+        kp_out = res[1].reshape(k_pages.shape)
+        vp_out = res[2].reshape(v_pages.shape)
+        return o, kp_out, vp_out
+    if not return_stats:
+        return o
+    ml = res[1]
+    m = ml[:, :group, 0].reshape(b, hq)
+    l = ml[:, :group, 1].reshape(b, hq)
+    return o, m, l
+
+
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
                            scale=None, interpret=None,
-                           return_stats=False):
+                           return_stats=False, pages_per_program=None,
+                           head_block=None):
     """One decode step of cached attention over a PAGED KV pool.
 
     Args:
@@ -118,93 +349,110 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
       return_stats: also return the online-softmax running max ``m``
         and denominator ``l`` (each (B, Hq) f32) so the caller can
         fold extra attention columns in analytically — the paged
-        engine adds the current token's fresh KV row this way, keeping
-        the pools READ-ONLY inside its layer scan.
+        engine's pre-fusion formulation added the current token's
+        fresh KV row this way, keeping the pools READ-ONLY inside its
+        layer scan.
+      pages_per_program, head_block: kernel geometry; default (None)
+        reads the autotune cache per (page, Hkv, D, dtype, group) key
+        at trace time (`tune_paged_attention` fills it), falling back
+        to (1, 1).
 
     Returns (B, Hq, D) in q's dtype; with return_stats, (o, m, l).
     """
+    return _paged_call(q, k_pages, v_pages, page_table, lengths, scale,
+                       interpret, return_stats, pages_per_program,
+                       head_block)
+
+
+def paged_append_attend(q, k_pages, v_pages, k_row, v_row, page_table,
+                        write_pids, lengths, scale=None, interpret=None,
+                        pages_per_program=None, head_block=None):
+    """FUSED append+attend decode step over a paged KV pool.
+
+    Attends each row over its prefix [0, lengths[b]) **plus** its fresh
+    KV row (``k_row``/``v_row``, the current token's key/value — folded
+    as one extra online-softmax column inside the kernel), and writes
+    that fresh row into pool page ``write_pids[b]`` at row offset
+    ``lengths[b] % page_size`` in the same kernel launch. The pools are
+    input/output-aliased, so the write touches exactly one page per
+    (row, KV-head) — the separate batched scatter per cache per token
+    the paged engine previously dispatched is gone.
+
+    Args:
+      q: (B, Hq, D) current-position queries.
+      k_pages, v_pages: (P, Hkv, page, D) pools (DONATED — aliased into
+        the returned pools; do not reuse the inputs).
+      k_row, v_row: (B, Hkv, D) fresh rows in pool dtype.
+      page_table: (B, max_pages) int32 as in `paged_decode_attention`.
+      write_pids: (B,) int32 — the pool page receiving row b's fresh KV
+        (callers derive it from the block table + per-slot length, and
+        point masked-out rows at a scratch page).
+      lengths: (B,) int32 prefix lengths; the fresh row lands at
+        position lengths[b].
+
+    Returns (o, k_pages, v_pages): o (B, Hq, D) equals a softmax over
+    [prefix + fresh row] (the fused analog of `fold_fresh_row`).
+    """
+    return _paged_call(q, k_pages, v_pages, page_table, lengths, scale,
+                       interpret, False, pages_per_program, head_block,
+                       k_row=k_row, v_row=v_row, write_pids=write_pids)
+
+
+def tune_paged_attention(q, k_pages, v_pages, page_table, lengths,
+                         scale=None, fused=True, candidates=None,
+                         iters=3):
+    """Eagerly measure paged-kernel geometry candidates on the REAL
+    shapes and persist the winner (≙ flash_attention's
+    tune_flash_attention; Pallas grids are trace-time constants, so
+    tuning runs outside jit and later calls pick the tuned
+    ``(pages_per_program, head_block)`` from the cache at trace time —
+    warmup-compatible as long as tuning runs before the engine traces).
+
+    Keyed per (page_size, Hkv, D, dtype, group) shape family — the
+    knobs that set the kernel's per-program work — not per batch/table
+    width, which only clamp the config. Returns (config, timings).
+    """
+    from paddle_tpu.ops.pallas import autotune as at
+
     q = jnp.asarray(q)
-    k_pages, v_pages = jnp.asarray(k_pages), jnp.asarray(v_pages)
+    k_pages = jnp.asarray(k_pages)
     b, hq, d = q.shape
     hkv, page = k_pages.shape[1], k_pages.shape[2]
     max_pages = page_table.shape[1]
-    if hq % hkv:
-        raise ValueError(f"GQA needs Hq % Hkv == 0, got {hq} vs {hkv}")
-    if page % _LANES:
-        raise ValueError(f"page_size {page} must be a multiple of "
-                         f"{_LANES}")
     group = hq // hkv
-    if scale is None:
-        scale = 1.0 / math.sqrt(d)
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+    key = _tune_key(page, hkv, d, q.dtype, group, fused)
+    if candidates is None:
+        candidates = [(ppp, hb)
+                      for ppp in (1, 2, 4) if ppp <= max_pages
+                      for hb in (1, 2, 4) if hkv % hb == 0]
+    if fused:
+        k_row = jnp.zeros((b, hkv, d), k_pages.dtype)
+        v_row = jnp.zeros((b, hkv, d), jnp.asarray(v_pages).dtype)
+        wpids = jnp.asarray(page_table, jnp.int32)[:, 0]
 
-    sub = 16 if q.dtype in (jnp.bfloat16, jnp.float16) else 8
-    gp = max(sub, (group + sub - 1) // sub * sub)
-    qg = q.reshape(b * hkv, group, d)
-    qg = jnp.pad(qg, ((0, 0), (0, gp - group), (0, 0)))
+    jitted = {}
 
-    def kv_index(bh, j, lens, table):
-        bb = bh // hkv
-        used = jnp.maximum((lens[bb] + page - 1) // page, 1)
-        jj = jnp.minimum(j, used - 1)
-        return (table[bb * max_pages + jj], bh % hkv, 0, 0)
-
-    lengths = jnp.asarray(lengths, jnp.int32)
-    table_flat = jnp.asarray(page_table, jnp.int32).reshape(-1)
-    # pools are indexed (page, head) -> (page, D): merge Hkv into the
-    # leading dim via a head-major view so one block = one (page, D)
-    # tile. (P, Hkv, page, D) -> (P*Hkv, page, D) with id p*Hkv+h.
-    kp = k_pages.reshape(-1, page, d)
-    vp = v_pages.reshape(-1, page, d)
-
-    def kv_index_flat(bh, j, lens, table):
-        p, h, _, _ = kv_index(bh, j, lens, table)
-        return (p * hkv + h, 0, 0)
-
-    out_specs = [pl.BlockSpec((1, gp, d), lambda bh, j, lens, table:
-                              (bh, 0, 0))]
-    out_shape = [jax.ShapeDtypeStruct((b * hkv, gp, d), q.dtype)]
-    if return_stats:  # stats output only exists when asked for
-        out_specs.append(pl.BlockSpec((1, gp, _LANES),
-                                      lambda bh, j, lens, table:
-                                      (bh, 0, 0)))
-        out_shape.append(
-            jax.ShapeDtypeStruct((b * hkv, gp, _LANES), jnp.float32))
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b * hkv, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, gp, d), lambda bh, j, lens, table:
-                         (bh, 0, 0)),
-            pl.BlockSpec((1, page, d), kv_index_flat),
-            pl.BlockSpec((1, page, d), kv_index_flat),
-        ],
-        out_specs=out_specs,
-        scratch_shapes=[
-            pltpu.VMEM((gp, d), jnp.float32),
-            pltpu.VMEM((gp, _LANES), jnp.float32),
-            pltpu.VMEM((gp, _LANES), jnp.float32),
-        ],
-    )
-    res = pl.pallas_call(
-        # ptlint: disable=PT001 -- scale is a static Python float kwarg
-        # (a tracer here would already fail partial-binding)
-        functools.partial(_kernel, scale=float(scale), page=page,
-                          hkv=hkv, with_stats=return_stats),
-        grid_spec=grid_spec,
-        out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
-        interpret=interpret,
-    )(lengths, table_flat, qg, kp, vp)
-    o = res[0][:, :group, :].reshape(b, hq, d)
-    if not return_stats:
-        return o
-    ml = res[1]
-    m = ml[:, :group, 0].reshape(b, hq)
-    l = ml[:, :group, 1].reshape(b, hq)
-    return o, m, l
+    def build_and_run(cfg):
+        if cfg not in jitted:
+            ppp, hb = cfg
+            if fused:
+                def fn(q, kp, vp, table, lens, _ppp=ppp, _hb=hb):
+                    o, kp2, vp2 = paged_append_attend(
+                        q, kp, vp, k_row, v_row, table, wpids, lens,
+                        scale=scale, pages_per_program=_ppp,
+                        head_block=_hb)
+                    return jnp.sum(o.astype(jnp.float32) ** 2)
+            else:
+                def fn(q, kp, vp, table, lens, _ppp=ppp, _hb=hb):
+                    o = paged_decode_attention(
+                        q, kp, vp, table, lens, scale=scale,
+                        pages_per_program=_ppp, head_block=_hb)
+                    return jnp.sum(o.astype(jnp.float32) ** 2)
+            jitted[cfg] = jax.jit(fn)
+        out = jitted[cfg](q, k_pages, v_pages, page_table, lengths)
+        float(out)  # sync — the timing loop must see the kernel finish
+    return at.tune("paged_attention", key, candidates, build_and_run,
+                   iters=iters)
 
 
 class PageAllocator:

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from paddle_tpu.ops.pallas.paged_attention import (
-    PagedKVCache, paged_decode_attention,
+    PagedKVCache, paged_append_attend, paged_decode_attention,
     paged_decode_attention_reference)
 
 
@@ -75,6 +75,140 @@ def test_kernel_stats_fold_fresh_row():
         v2 = v2.at[pid, :, off, :].set(v_row[i])
     want = paged_decode_attention(q, k2, v2, table, lengths + 1)
     np.testing.assert_allclose(np.asarray(folded), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def _scatter_oracle(k, v, k_row, v_row, table, write_pids, lengths,
+                    page):
+    """The pre-fusion formulation: write each row's fresh KV at its
+    position with an XLA scatter, then attend over lengths + 1."""
+    k2, v2 = k, v
+    for i in range(k_row.shape[0]):
+        pid = int(write_pids[i])
+        off = int(lengths[i]) % page
+        k2 = k2.at[pid, :, off, :].set(k_row[i])
+        v2 = v2.at[pid, :, off, :].set(v_row[i])
+    return k2, v2
+
+
+@pytest.mark.parametrize("group,cfg", [(1, None), (4, None),
+                                       (1, (2, 2))])
+def test_fused_append_attend_matches_scatter_then_attend(group, cfg):
+    """ISSUE 6 tentpole parity: `paged_append_attend` (fresh KV row
+    folded into the online softmax AND written into its pool page
+    inside the kernel) must be bit-compatible with the scatter-then-
+    attend formulation it replaces — both the attention output and the
+    ENTIRE pool (the fused in-kernel write lands exactly one row;
+    untouched pages identical). Covers page-edge lengths (write lands
+    in a fresh page), an empty row (length 0), GQA, and a non-default
+    (pages_per_program, head_block) geometry."""
+    rs = np.random.RandomState(11)
+    P, hkv, page, d = 10, 2, 128, 32
+    b, max_pages = 3, 3
+    k, v = _pool(rs, P, hkv, page, d)
+    q = jnp.asarray(rs.randn(b, hkv * group, d), jnp.float32)
+    table = jnp.asarray([[0, 5, 2], [7, 1, 3], [9, 4, 6]], jnp.int32)
+    # page edge (write opens page 5), mid-page, empty row
+    lengths = jnp.asarray([128, 140, 0], jnp.int32)
+    k_row = jnp.asarray(rs.randn(b, hkv, d), jnp.float32)
+    v_row = jnp.asarray(rs.randn(b, hkv, d), jnp.float32)
+    wpids = jnp.asarray(
+        [int(table[i, int(lengths[i]) // page]) for i in range(b)],
+        jnp.int32)
+
+    ppp, hb = cfg if cfg else (None, None)
+    o, k_out, v_out = paged_append_attend(
+        q, k, v, k_row, v_row, table, wpids, lengths,
+        pages_per_program=ppp, head_block=hb)
+
+    k2, v2 = _scatter_oracle(k, v, k_row, v_row, table, wpids, lengths,
+                             page)
+    want = paged_decode_attention(q, k2, v2, table, lengths + 1)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k2))
+    np.testing.assert_array_equal(np.asarray(v_out), np.asarray(v2))
+
+
+def test_fused_append_attend_jit_and_scratch_page():
+    """Under jit (the engine's layer scan) with masked rows pointed at
+    a scratch page: the scratch page absorbs the write, every pool page
+    a live row owns stays byte-identical to the scatter oracle."""
+    rs = np.random.RandomState(12)
+    P, hkv, page, d = 6, 2, 128, 16
+    b = 2
+    k, v = _pool(rs, P, hkv, page, d)
+    q = jnp.asarray(rs.randn(b, 4 * hkv, d), jnp.float32)
+    table = jnp.asarray([[1, 3], [2, 4]], jnp.int32)
+    lengths = jnp.asarray([130, 70], jnp.int32)
+    k_row = jnp.asarray(rs.randn(b, hkv, d), jnp.float32)
+    v_row = jnp.asarray(rs.randn(b, hkv, d), jnp.float32)
+    scratch = P - 1                        # row 1 "inactive": write there
+    wpids = jnp.asarray([3, scratch], jnp.int32)
+
+    @jax.jit
+    def f(q, k, v, k_row, v_row, table, wpids, lengths):
+        return paged_append_attend(q, k, v, k_row, v_row, table, wpids,
+                                   lengths)
+
+    o, k_out, v_out = f(q, k, v, k_row, v_row, table, wpids, lengths)
+    # the kernel ALWAYS folds the fresh row into the softmax (a masked
+    # slot's output is discarded by the engine, but must still be
+    # well-defined): the attention oracle writes each row at its TRUE
+    # position; the pool oracle honors wpids (row 1's write → scratch)
+    tpids = jnp.asarray(
+        [int(table[i, int(lengths[i]) // page]) for i in range(b)],
+        jnp.int32)
+    k3, v3 = _scatter_oracle(k, v, k_row, v_row, table, tpids, lengths,
+                             page)
+    want = paged_decode_attention(q, k3, v3, table, lengths + 1)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    k2, v2 = _scatter_oracle(k, v, k_row, v_row, table, wpids, lengths,
+                             page)
+    np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k2))
+    # row 1's own pages untouched (its write went to scratch)
+    for pid in (2, 4):
+        np.testing.assert_array_equal(np.asarray(k_out[pid]),
+                                      np.asarray(k[pid]))
+
+
+def test_paged_autotune_cache_roundtrip(tmp_path, monkeypatch):
+    """`tune_paged_attention` measures candidates eagerly, persists the
+    winner per (page, Hkv, D, dtype, group) key, and the kernels pick
+    the tuned config up from the cache at trace time — every candidate
+    geometry must also be numerically identical."""
+    import paddle_tpu.ops.pallas.autotune as at
+    from paddle_tpu.ops.pallas.paged_attention import (
+        tune_paged_attention)
+
+    monkeypatch.setattr(at, "_GLOBAL", None)
+    monkeypatch.setenv("PT_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    rs = np.random.RandomState(13)
+    P, hkv, page, d = 8, 4, 128, 16
+    b, max_pages = 2, 2
+    k, v = _pool(rs, P, hkv, page, d)
+    q = jnp.asarray(rs.randn(b, hkv, d), jnp.float32)
+    table = jnp.asarray([[0, 5], [7, 1]], jnp.int32)
+    lengths = jnp.asarray([200, 140], jnp.int32)
+
+    for fused in (False, True):
+        cfg, timings = tune_paged_attention(
+            q, k, v, table, lengths, fused=fused, iters=1,
+            candidates=[(1, 1), (2, 2), (1, 4)])
+        assert cfg in timings and len(timings) == 3
+        # cache hit: second call measures nothing
+        cfg2, timings2 = tune_paged_attention(
+            q, k, v, table, lengths, fused=fused, iters=1,
+            candidates=[(1, 1), (2, 2), (1, 4)])
+        assert cfg2 == cfg and timings2 == {}
+
+    # tuned config (read from the cache at trace time) == default
+    want = paged_decode_attention(q, k, v, table, lengths,
+                                  pages_per_program=1, head_block=1)
+    got = paged_decode_attention(q, k, v, table, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5, rtol=1e-5)
 
 

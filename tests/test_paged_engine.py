@@ -18,6 +18,16 @@ def _model(max_seq=512, heads=4):
     return gpt.GPT(cfg, seed=0)
 
 
+def _assert_pool_drained(eng, n_pages):
+    """After every request retires, each pool page is either on the
+    allocator free list or warm in the prefix cache at refcount ZERO
+    (reclaimable) — never still mapped into a slot."""
+    cached = eng._prefix.cached_pages if eng._prefix is not None else 0
+    shared = eng._prefix.shared_pages if eng._prefix is not None else 0
+    assert eng.free_pages + cached == n_pages
+    assert shared == 0
+
+
 def _reference(model, prompt, n_new, eos=None):
     toks = jnp.asarray(np.asarray(prompt, np.int32)[None])
     out = model.generate(toks, max_new_tokens=n_new,
@@ -39,8 +49,9 @@ def test_paged_parity_with_generate_mixed_lengths():
     eng.run()
     for req, p in zip(reqs, prompts):
         assert req.tokens == _reference(model, p, 9), len(p)
-    # everything retired -> every page back in the pool
-    assert eng.free_pages == 12
+    # everything retired -> every page free or warm in the prefix
+    # cache at refcount zero (nothing still mapped)
+    _assert_pool_drained(eng, 12)
 
 
 def test_paged_pages_allocated_on_demand_and_reused():
@@ -103,7 +114,7 @@ def test_paged_admission_waits_for_pages():
     eng.run()
     assert r1.tokens == _reference(model, p1, 6)
     assert r2.tokens == _reference(model, p2, 6)
-    assert eng.free_pages == 3
+    _assert_pool_drained(eng, 3)
 
 
 def test_idle_slot_never_corrupts_live_pages():
@@ -143,7 +154,7 @@ def test_paged_pipelined_depths_bit_identical(depth):
         reqs = [eng.submit(p, max_new_tokens=9) for p in prompts]
         eng.step()
         eng.run()
-        assert eng.free_pages == 12
+        _assert_pool_drained(eng, 12)
         assert all(r.done and not r.failed for r in reqs)
         return [list(r.tokens) for r in reqs]
 
@@ -167,6 +178,290 @@ def test_paged_warmup_pretraces():
     assert r.tokens == _reference(model, p, 6)
     assert eng._prefill_fn._cache_size() == 2, "serving recompiled"
     assert eng._multi_fn._cache_size() == 1, "serving recompiled"
+
+
+def test_fused_vs_scatter_bit_identical_and_no_scatter_dispatch():
+    """ISSUE 6 tentpole: the fused append+attend engine (default) must
+    serve byte-identical streams to the PT_PAGED_FUSED=0 scatter
+    formulation it replaces — and the per-token scatter
+    (`_write_token_rows`) must be GONE from the fused dispatch path
+    (the CPU-verifiable proxy for the removed pool traffic)."""
+    model = _model()
+    rs = np.random.RandomState(20)
+    prompts = [list(rs.randint(0, 96, size=n)) for n in (7, 170, 40)]
+
+    def run(fused):
+        eng = PagedDecodeEngine(model, n_pages=12, max_slots=2,
+                                steps_per_call=4, fused=fused)
+        assert eng.fused is fused
+        if fused:
+            def boom(*a, **k):
+                raise AssertionError(
+                    "fused dispatch called the per-token scatter")
+            eng._write_token_rows = boom
+        reqs = [eng.submit(p, max_new_tokens=9) for p in prompts]
+        eng.run()
+        assert all(r.done and not r.failed for r in reqs)
+        return [list(r.tokens) for r in reqs]
+
+    want = run(False)
+    for got, p in zip(want, prompts):
+        assert got == _reference(model, p, 9), len(p)
+    assert run(True) == want
+
+
+def test_warm_prefix_hit_prefills_only_suffix():
+    """Acceptance: a warm shared-prefix submit must route through the
+    SUFFIX prefill only (the full-prompt prefill is never dispatched)
+    and account every cached token in serve/prefix_hit_tokens."""
+    from paddle_tpu import stats
+
+    model = _model()
+    rs = np.random.RandomState(21)
+    sys_prompt = list(rs.randint(0, 96, size=290))   # 2 full pages + 34
+    tail_a = list(rs.randint(0, 96, size=11))
+    tail_b = list(rs.randint(0, 96, size=17))
+    eng = PagedDecodeEngine(model, n_pages=16, max_slots=1,
+                            steps_per_call=4)
+    calls = {"full": 0, "sfx": 0}
+    full_fn, sfx_fn = eng._prefill_fn, eng._prefill_sfx_fn
+    eng._prefill_fn = (lambda *a: (calls.__setitem__(
+        "full", calls["full"] + 1), full_fn(*a))[1])
+    eng._prefill_sfx_fn = (lambda *a: (calls.__setitem__(
+        "sfx", calls["sfx"] + 1), sfx_fn(*a))[1])
+
+    r1 = eng.submit(sys_prompt + tail_a, max_new_tokens=8)
+    eng.run()
+    assert calls == {"full": 1, "sfx": 0}      # cold: full prefill
+    h0 = stats.get("serve/prefix_hit_tokens")
+
+    r2 = eng.submit(sys_prompt + tail_b, max_new_tokens=8)
+    eng.run()
+    assert calls == {"full": 1, "sfx": 1}      # warm: suffix ONLY
+    # both full pages (256 tokens) served from cache
+    assert stats.get("serve/prefix_hit_tokens") - h0 == 256
+    assert r1.tokens == _reference(model, sys_prompt + tail_a, 8)
+    assert r2.tokens == _reference(model, sys_prompt + tail_b, 8)
+
+
+def test_shared_prefix_pages_read_only_and_divergence():
+    """Refcount/COW correctness: the cached prefix pages a second
+    request maps must stay BIT-IDENTICAL to the cold prefill that wrote
+    them (read-only mapping — the sharer's suffix and decode appends
+    land in private pages), while the streams diverge after the shared
+    point exactly as the dense reference does."""
+    model = _model()
+    rs = np.random.RandomState(22)
+    shared = list(rs.randint(0, 96, size=256))       # exactly 2 pages
+    pa = shared + list(rs.randint(0, 96, size=30))
+    pb = shared + list(rs.randint(0, 96, size=45))
+    eng = PagedDecodeEngine(model, n_pages=16, max_slots=1,
+                            steps_per_call=4)
+    ra = eng.submit(pa, max_new_tokens=8)
+    eng.run()
+    pids = [eng._prefix._nodes[d] for d in eng._prefix.chain(shared)]
+    assert len(pids) == 2
+    L, P = eng.cfg.n_layers, eng.P
+    ids = np.add.outer(np.arange(L) * P, pids).ravel()
+    kp_before = np.asarray(eng.kp[ids])
+    vp_before = np.asarray(eng.vp[ids])
+
+    rb = eng.submit(pb, max_new_tokens=8)
+    eng.run()
+    np.testing.assert_array_equal(np.asarray(eng.kp[ids]), kp_before)
+    np.testing.assert_array_equal(np.asarray(eng.vp[ids]), vp_before)
+    assert ra.tokens == _reference(model, pa, 8)
+    assert rb.tokens == _reference(model, pb, 8)
+
+
+def test_eviction_returns_only_refcount_zero_pages():
+    """Retirement of ONE sharer must not free (or make reclaimable) the
+    prefix pages the other sharer still maps; reclaim frees only
+    refcount-zero pages, and only under explicit pressure."""
+    model = _model()
+    rs = np.random.RandomState(23)
+    shared = list(rs.randint(0, 96, size=256))
+    pa = shared + [1, 2, 3]
+    pb = shared + [4, 5]
+    eng = PagedDecodeEngine(model, n_pages=16, max_slots=2,
+                            steps_per_call=2)
+    ra = eng.submit(pa, max_new_tokens=24)   # long: retires last
+    rb = eng.submit(pb, max_new_tokens=2)    # short: retires first
+    while not rb.done:
+        eng.step()
+    eng.drain()
+    pids = [eng._prefix._nodes[d] for d in eng._prefix.chain(shared)]
+    assert not ra.done
+    # b retired: the shared pages are still mapped by a (refcount 1) —
+    # neither free nor reclaimable
+    assert eng._prefix._refs[pids[0]] == 1
+    assert eng._prefix.reclaimable_pages == 0
+    assert all(p not in eng._alloc._free for p in pids)
+    assert eng._prefix.reclaim(8) == 0       # nothing at refcount zero
+
+    eng.run()
+    assert ra.done and ra.tokens == _reference(model, pa, 24)
+    # a retired too: refcount zero, reclaimable, but still warm (NOT on
+    # the allocator free list) until reclaim is asked for them
+    assert eng._prefix._refs[pids[0]] == 0
+    assert all(p not in eng._alloc._free for p in pids)
+    free0 = eng.free_pages
+    assert eng._prefix.reclaim(1) == 1       # LRU-oldest only
+    assert eng.free_pages == free0 + 1
+
+
+def test_stale_invalidate_keeps_reregistered_chain():
+    """A dead page's SECOND invalidation (a late sharer failing after
+    the poisoned prompt was already re-registered with healthy pages)
+    must not de-canonicalize the new copy's trie node, and a later
+    reclaim of the healthy page must not crash on the missing node."""
+    from paddle_tpu.inference.prefix_cache import PrefixCache
+    from paddle_tpu.ops.pallas.paged_attention import PageAllocator
+
+    alloc = PageAllocator(8, 128)
+    pc = PrefixCache(alloc, 128)
+    toks = list(range(128))
+    tab = alloc.reserve([], 128)
+    pc.register(toks, tab)             # slot A registers: refs=1
+    old = tab[0]
+    pc.ref(old)                        # slot B maps it too: refs=2
+    assert pc.invalidate(old) is None  # A nan-fails: node gone, dead
+    assert pc.lookup(toks) == []       # no longer canonical
+    assert pc.unref(old) is None       # A releases: refs=1 (B holds)
+    tab2 = alloc.reserve([], 128)
+    pc.register(toks, tab2)            # healthy re-registration
+    new = tab2[0]
+    assert pc.invalidate(old) is None  # B fails later: STALE pid
+    got = pc.lookup(toks)
+    assert got == [new], "stale invalidate de-canonicalized the chain"
+    pc.unref(new)                      # drop lookup's ref
+    pc.unref(new)                      # registrant retires: warm LRU
+    assert pc.unref(old) == old        # B releases: dead page freed
+    assert old in alloc._free
+    assert pc.reclaim(8) == 1          # healthy page reclaims cleanly
+    assert new in alloc._free
+    assert pc.lookup(toks) == []
+
+
+def test_poisoned_shared_page_fails_every_sharer_loudly():
+    """Blast-radius probe for prefix sharing: one poisoned shared page
+    must fail EVERY request that has it mapped via the non-finite-logit
+    guard (failed=True, never silent corruption), while a request that
+    shares nothing decodes normally. The poison must NOT outlive its
+    sharers: the eviction drops the prefix's trie nodes and scrubs the
+    freed pages, so the next submit of the same (popular) prompt
+    prefills cold into clean pages and succeeds — one bad page is a
+    loud transient, not a permanent DoS of that prompt."""
+    from paddle_tpu import stats
+    from paddle_tpu.testing import faults
+
+    model = _model()
+    rs = np.random.RandomState(24)
+    shared = list(rs.randint(0, 96, size=256))
+    cold = list(rs.randint(0, 96, size=40))
+    eng = PagedDecodeEngine(model, n_pages=24, max_slots=2,
+                            steps_per_call=2)
+    r0 = eng.submit(shared + [7], max_new_tokens=4)
+    eng.run()                                # establishes the cache
+    assert not r0.failed
+
+    with faults.inject("paged.shared_page", "nan", n=64):
+        # two slots: rb and rc BOTH map the poisoned shared pages
+        # before either harvest detects the damage
+        rb = eng.submit(shared + [8, 9], max_new_tokens=6)
+        rc = eng.submit(shared + [10], max_new_tokens=6)
+        rd = eng.submit(cold, max_new_tokens=6)
+        eng.run()
+    assert rb.failed and rc.failed           # every sharer fails LOUDLY
+    assert rb.error and "non-finite" in rb.error
+    assert rc.error and "non-finite" in rc.error
+    assert not rd.failed                     # non-sharer unaffected
+    assert rd.tokens == _reference(model, cold, 6)
+
+    # self-heal: the fault is gone, the poisoned trie nodes are
+    # invalidated and their pages scrubbed — the SAME prompt recovers
+    # after one cold prefill (no hit) ...
+    h0 = stats.get("serve/prefix_hit_tokens")
+    re_ = eng.submit(shared + [11], max_new_tokens=4)
+    eng.run()
+    assert not re_.failed
+    assert re_.tokens == _reference(model, shared + [11], 4)
+    assert stats.get("serve/prefix_hit_tokens") == h0   # cold re-prefill
+    # ... and its healthy copy is canonical again: the next sharer hits
+    rf = eng.submit(shared + [12], max_new_tokens=4)
+    eng.run()
+    assert not rf.failed
+    assert rf.tokens == _reference(model, shared + [12], 4)
+    assert stats.get("serve/prefix_hit_tokens") - h0 == 256
+
+
+def test_bitflip_on_shared_page_corrupts_visibly():
+    """The bitflip payload variant of the blast-radius probe: a single
+    flipped bit in a shared K page must visibly corrupt the sharer's
+    stream (diverging from the clean reference) — shared-prefix KV is
+    load-bearing state, not a soft hint."""
+    from paddle_tpu.testing import faults
+
+    model = _model()
+    rs = np.random.RandomState(25)
+    shared = list(rs.randint(0, 96, size=256))
+    eng = PagedDecodeEngine(model, n_pages=16, max_slots=1,
+                            steps_per_call=2)
+    eng.submit(shared + [7], max_new_tokens=4)
+    eng.run()
+    pids = [eng._prefix._nodes[d] for d in eng._prefix.chain(shared)]
+    before = np.asarray(eng.kp[pids[0]])
+
+    # flip the sign/exponent bit of a mid-page element on every layer's
+    # view of the first shared page
+    with faults.inject("paged.shared_page", "bitflip", offset=2048,
+                       bit=7):
+        eng.submit(shared + [8], max_new_tokens=4)
+        eng.run()
+    after = np.asarray(eng.kp[pids[0]])
+    assert (before != after).any(), "bitflip never landed in the pool"
+
+
+def test_prefix_cache_off_restores_free_everything():
+    """PT_PAGED_PREFIX=0 restores the pre-ISSUE-6 lifecycle: no trie,
+    retirement frees every page straight back to the allocator."""
+    model = _model()
+    rs = np.random.RandomState(26)
+    p = list(rs.randint(0, 96, size=200))
+    eng = PagedDecodeEngine(model, n_pages=6, max_slots=1,
+                            steps_per_call=4, prefix=False)
+    assert eng._prefix is None
+    r1 = eng.submit(p, max_new_tokens=6)
+    eng.run()
+    assert eng.free_pages == 6
+    r2 = eng.submit(p, max_new_tokens=6)
+    eng.run()
+    assert r1.tokens == r2.tokens == _reference(model, p, 6)
+    assert eng.free_pages == 6
+
+
+def test_pool_pressure_reclaims_warm_prefix_pages():
+    """Admission under pool pressure reclaims LRU refcount-zero prefix
+    pages instead of failing: a pool exactly big enough for one
+    resident request must still serve a second, different prompt after
+    the first retires (its warm pages get reclaimed)."""
+    model = _model()
+    rs = np.random.RandomState(27)
+    pa = list(rs.randint(0, 96, size=256))
+    pb = list(rs.randint(0, 96, size=256))
+    eng = PagedDecodeEngine(model, n_pages=3, max_slots=1,
+                            steps_per_call=2)
+    ra = eng.submit(pa, max_new_tokens=4)
+    eng.run()
+    assert eng._prefix.cached_pages == 2     # pa's pages warm
+    rb = eng.submit(pb, max_new_tokens=4)    # needs reclaim to fit
+    eng.run()
+    assert ra.tokens == _reference(model, pa, 4)
+    assert rb.tokens == _reference(model, pb, 4)
+    # and a warm resubmit of pb still hits whatever stayed cached
+    r2 = eng.submit(pb, max_new_tokens=4)
+    eng.run()
+    assert r2.tokens == rb.tokens
 
 
 def test_paged_share_weights_with_decode_engine_donor():

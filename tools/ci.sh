@@ -21,6 +21,11 @@
 #                          injection at in-flight depth 1 vs 3 must
 #                          produce byte-identical survivor streams on
 #                          every path (plain/chunked/spec/paged)
+#   tools/ci.sh paged      paged-serving smoke: tiny-model fused
+#                          append+attend decode end to end on CPU plus
+#                          the PD_PREFIX repeated-system-prompt sweep —
+#                          fails if a warm shared-prefix submit() stops
+#                          hitting the radix cache
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -46,6 +51,12 @@ fi
 if [[ "${1:-}" == "serve" ]]; then
     shift
     exec python tools/serve_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "paged" ]]; then
+    shift
+    PD_SIZE=tiny PD_SECTIONS=paged PD_PREFIX=1 \
+        exec python tools/profile_decode.py "$@"
 fi
 
 # lint gate runs BEFORE the test shards: a host-sync or env-contract

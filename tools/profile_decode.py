@@ -3,7 +3,10 @@
 Times the full continuous-batching engine loop against the HBM roofline
 across (slots, cache length, chunk) points — the knobs that matter for
 serving. PD_SIZE=350m for a smaller model; PD_SPEC=1 adds a chunked
-speculative run on repetitive prompts.
+speculative run on repetitive prompts; PD_SECTIONS=engine,paged picks
+report sections; PD_PREFIX=1 adds the repeated-system-prompt sweep
+(cold vs warm radix-cache admission, asserted — the `tools/ci.sh
+paged` smoke gate).
 
 Measurement notes learned the hard way (r5):
 - On the tunneled PJRT backend ``jax.block_until_ready`` does NOT block;
@@ -102,6 +105,123 @@ def run_engine(model, slots=8, s_pf=128, n_new=128, chunk=64, spec_k=0,
     return toks / dt, dispatches, rep
 
 
+def run_paged(model, prompts, n_new=128, chunk=64, inflight=None,
+              n_pages=None, max_slots=None):
+    """Paged-engine drain timing (ISSUE 6): submit `prompts`, time the
+    drain, and return (tok/s, dispatches, pipeline report, prefix
+    stats). The engine keeps the prefix radix cache at its default
+    (on), so repeated calls against the same engine measure warm-cache
+    admission; pass fresh random prompts for a cold decode number."""
+    from paddle_tpu import stats
+    from paddle_tpu.observability import trace
+    from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+    page = 128
+    slots = max_slots or len(prompts)
+    if n_pages is None:
+        need = max(len(p) + n_new for p in prompts)
+        n_pages = slots * ((need + page - 1) // page + 1) + 4
+    eng = PagedDecodeEngine(model, n_pages=n_pages, max_slots=slots,
+                            page_size=page, steps_per_call=chunk,
+                            inflight=inflight)
+    # warm the compiles on DISJOINT prompts of the same lengths so the
+    # timed round's trie lookups miss (its tok/s stays a decode number)
+    rs = np.random.RandomState(4242)
+    vocab = eng.cfg.vocab_size
+    for p in prompts:
+        eng.submit(list(rs.randint(0, vocab, len(p))), max_new_tokens=2)
+    eng.run()
+    stats.reset("serve/")
+    trace.clear(capacity=65536)
+    trace.enable()
+    reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+    eng.step()
+    pre = sum(len(r.tokens) for r in reqs)
+    d0 = eng.steps
+    t0 = time.perf_counter()
+    eng.run()
+    dt = time.perf_counter() - t0
+    toks = sum(len(r.tokens) for r in reqs) - pre
+    dispatches = eng.steps - d0
+    rep = pipeline_report(eng)
+    snap = stats.snapshot("serve/")
+    n_prompt = sum(len(p) for p in prompts)
+    pfx = {
+        "hit_tokens": int(snap.get("serve/prefix_hit_tokens", 0)),
+        "lookups": int(snap.get("serve/prefix_lookup", 0)),
+        "hit_rate": snap.get("serve/prefix_hit_tokens", 0)
+        / max(1, n_prompt),
+        "pool_free": int(snap.get("serve/pool_pages_free", 0)),
+        "pool_shared": int(snap.get("serve/pool_pages_shared", 0)),
+    }
+    trace.disable()
+    trace.clear()
+    eng.kp = eng.vp = eng._stacked = None
+    del eng
+    return toks / dt, dispatches, rep, pfx
+
+
+def prefix_sweep(model, slots, shared_len, tail_len, n_new, chunk):
+    """PD_PREFIX=1: repeated-system-prompt sweep. Round 1 submits
+    `slots` prompts sharing one page-aligned `shared_len`-token system
+    prefix (cold: registers the chain); round 2 submits NEW tails
+    behind the same prefix (warm: must prefill only the tails). Prints
+    admission+drain wall time and hit tokens for both rounds and
+    asserts the warm round actually hit — `tools/ci.sh paged` relies
+    on that assert as its regression gate."""
+    from paddle_tpu import stats
+    from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+    cfg = model.cfg
+    page = 128
+    assert shared_len % page == 0, "system prefix must be page-aligned"
+    rs = np.random.RandomState(7)
+    shared = list(rs.randint(0, cfg.vocab_size, shared_len))
+    need = shared_len + tail_len + n_new
+    n_pages = 2 * (shared_len // page) + slots * (
+        (need + page - 1) // page + 1) + 4
+    eng = PagedDecodeEngine(model, n_pages=n_pages, max_slots=slots,
+                            page_size=page, steps_per_call=chunk)
+    # compile warm-up on a TRIE-DISJOINT prefix at the exact timed
+    # geometry: first submit traces the full prefill (the cold round's
+    # shape), the second — same warm prefix, new tail — traces the
+    # suffix prefill (the warm round's shape). The timed rounds then
+    # measure prefill/decode work, not jit compilation.
+    warm_pfx = list(rs.randint(0, cfg.vocab_size, shared_len))
+    for _ in range(2):
+        eng.submit(warm_pfx + list(rs.randint(0, cfg.vocab_size,
+                                              tail_len)),
+                   max_new_tokens=n_new)
+        eng.run()
+
+    def round_(label):
+        stats.reset("serve/prefix")
+        prompts = [shared + list(rs.randint(0, cfg.vocab_size, tail_len))
+                   for _ in range(slots)]
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+        eng.run()
+        dt = time.perf_counter() - t0
+        snap = stats.snapshot("serve/prefix")
+        hits = int(snap.get("serve/prefix_hit_tokens", 0))
+        toks = sum(len(r.tokens) for r in reqs)
+        print(f"  {label}: {dt * 1e3:.1f}ms wall "
+              f"({toks} new tokens, {slots}x({shared_len}+{tail_len}) "
+              f"prompt) prefix_hit_tokens={hits}", flush=True)
+        return hits
+
+    print(f"prefix sweep: shared system prompt {shared_len} tokens, "
+          f"{slots} slots", flush=True)
+    cold = round_("cold")
+    warm = round_("warm")
+    # the warm round must hit at least one full shared page per slot —
+    # the submit path then prefills only the suffix tokens
+    assert warm >= slots * page, (
+        f"warm shared-prefix round hit only {warm} tokens "
+        f"(expected >= {slots * page}): prefix cache regressed")
+    assert warm > cold, "warm round should out-hit the cold round"
+    eng.kp = eng.vp = eng._stacked = None
+    del eng
+
+
 def main():
     size = os.environ.get("PD_SIZE", "1p3b")
     cfg = (gpt.gpt3_1p3b(max_seq_len=2048) if size == "1p3b"
@@ -127,25 +247,63 @@ def main():
 
     # PD_INFLIGHT sweeps explicit depths (e.g. PD_INFLIGHT=1,2,4) to
     # A/B the pipeline against the synchronous baseline; unset uses the
-    # engine default (PT_SERVE_INFLIGHT or 2)
+    # engine default (PT_SERVE_INFLIGHT or 2). PD_SECTIONS picks which
+    # report sections run ("engine,paged" default; `tools/ci.sh paged`
+    # runs sections=paged on the tiny model as its CPU smoke).
     sweep = [int(x) for x in os.environ.get("PD_INFLIGHT", "").split(",")
              if x.strip()] or [None]
+    sections = {s.strip() for s in os.environ.get(
+        "PD_SECTIONS", "engine,paged").split(",") if s.strip()}
 
-    for slots, s_pf, n_new in ((8, 128, 128), (16, 128, 128)):
-        roof = decode_roofline_tokens_per_sec(
-            cfg, slots, s_pf + n_new // 2, hbm)
-        for depth in sweep:
-            tps, disp, rep = run_engine(model, slots=slots, s_pf=s_pf,
-                                        n_new=n_new, inflight=depth)
-            show(f"slots={slots} ctx={s_pf}+{n_new}", tps, disp, roof,
-                 rep)
+    if "engine" in sections:
+        for slots, s_pf, n_new in ((8, 128, 128), (16, 128, 128)):
+            roof = decode_roofline_tokens_per_sec(
+                cfg, slots, s_pf + n_new // 2, hbm)
+            for depth in sweep:
+                tps, disp, rep = run_engine(model, slots=slots,
+                                            s_pf=s_pf, n_new=n_new,
+                                            inflight=depth)
+                show(f"slots={slots} ctx={s_pf}+{n_new}", tps, disp,
+                     roof, rep)
 
-    if os.environ.get("PD_SPEC", "0") == "1":
+    if os.environ.get("PD_SPEC", "0") == "1" and "engine" in sections:
         roof = decode_roofline_tokens_per_sec(cfg, 8, 192, hbm)
         for depth in sweep:
             tps, disp, rep = run_engine(model, chunk=16, spec_k=4,
                                         inflight=depth)
             show("spec k=4 chunk=16", tps, disp, roof, rep)
+
+    if "paged" in sections:
+        # paged decode vs the SAME analytic HBM roofline the contiguous
+        # engine is scored against (decode is bandwidth-bound; paging
+        # changes layout, not bytes-that-must-move) — the gap between
+        # the two ratios is the paged kernel's overhead. Fresh random
+        # prompts per depth keep the timed round prefix-cold so the
+        # tok/s is a decode number, not an admission number.
+        tiny = size == "tiny"
+        slots, s_pf, n_new = (4, 128, 16) if tiny else (8, 128, 128)
+        chunk = 8 if tiny else 64
+        roof = decode_roofline_tokens_per_sec(
+            cfg, slots, s_pf + n_new // 2, hbm)
+        rs = np.random.RandomState(11)
+        for depth in sweep:
+            prompts = [list(rs.randint(0, cfg.vocab_size, s_pf))
+                       for _ in range(slots)]
+            tps, disp, rep, pfx = run_paged(model, prompts, n_new=n_new,
+                                            chunk=chunk, inflight=depth)
+            show(f"paged slots={slots} ctx={s_pf}+{n_new}", tps, disp,
+                 roof, rep)
+            print(f"  prefix: hit_rate={pfx['hit_rate']:.0%} "
+                  f"hit_tokens={pfx['hit_tokens']} "
+                  f"lookups={pfx['lookups']} "
+                  f"pool free={pfx['pool_free']} "
+                  f"shared={pfx['pool_shared']}", flush=True)
+
+        if os.environ.get("PD_PREFIX", "0") == "1":
+            prefix_sweep(model, slots=slots,
+                         shared_len=256 if not tiny else 128,
+                         tail_len=32, n_new=8 if tiny else 32,
+                         chunk=chunk)
 
 
 if __name__ == "__main__":
