@@ -259,8 +259,11 @@ def main():
     extra = result.setdefault("extra", {})
     # cheap BASELINE rows first (~6 min total): a tight budget then
     # truncates the decode suite, not the headline coverage
+    # train_quant_comm runs LAST: on multi-device backends its three
+    # fp32/int8/fp8 trials are not cheap, and the decode/longctx
+    # headline rows must not lose their budget to it
     for sub in (bench_bert, bench_resnet50, bench_ppyoloe, bench_pp,
-                bench_decode, bench_longctx):
+                bench_decode, bench_longctx, bench_train_quant_comm):
         name = sub.__name__.replace("bench_", "")
         if only and name not in only:
             continue
@@ -956,6 +959,84 @@ def bench_decode(jax, jnp, peak, smoke=False):
             res["decode_spec_vs_roofline"] = round(toks2 / sdt / roof, 4)
     except Exception as e:
         res["decode_spec_error"] = str(e)[:160]
+    return res
+
+
+def bench_train_quant_comm(jax, jnp, peak, smoke=False):
+    """Quantized-collective training row (MULTICHIP ladder, ISSUE 7):
+    the SAME dp train step with the gradient sync at fp32 vs the int8/fp8
+    block-scaled wire — step time plus the fixed-seed loss trajectory, so
+    a wire-format regression shows as either a slowdown OR a trajectory
+    split. Also reports the measured comm/bytes_wire compression ratio
+    (≥3.5x is the int8 block-256 acceptance bar)."""
+    n_dev = len(jax.devices())
+    if jax.default_backend() in ("cpu",) and not smoke:
+        return {}
+    if n_dev < 2 and not smoke:
+        return {}  # one chip has no dp axis worth measuring
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import stats as _stats
+    from paddle_tpu.distributed import compression as _comp
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.models import gpt
+    from paddle_tpu import optimizer as optim
+
+    steps, warmup = (6, 1) if smoke else (20, 3)
+    # fixed-seed trajectory compare wants fp32 math on both sides
+    cfg = (gpt.gpt_tiny(max_seq_len=32, dtype=jnp.float32)
+           if smoke or n_dev <= 8
+           else gpt.gpt3_125m(max_seq_len=512, dtype=jnp.float32))
+    model = gpt.GPT(cfg, seed=0)
+    params, _ = model.split_params()
+    tokens = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2 * max(1, n_dev), cfg.max_seq_len)),
+        jnp.int32)
+
+    def loss_fn(p, tok):
+        return gpt.lm_loss(model.merge_params(p)(tok), tok)
+
+    res = {"train_quant_comm_devices": n_dev}
+    prev_topo = mesh_lib.get_topology()
+    try:
+        # set_global=False: the model's GSPMD sharding constraints must
+        # stay off — the compressed step is an explicit shard_map over
+        # dp, where every axis is manual
+        topo = dist.init_mesh(dp=max(1, n_dev), set_global=False)
+        for method in (None, "int8", "fp8"):
+            name = method or "fp32"
+            try:
+                _stats.reset("comm/")
+                opt = optim.SGD(learning_rate=1e-2)
+                p = {k: jnp.copy(v) for k, v in params.items()}
+                st = opt.init(p)
+                ef = (_comp.init_error_feedback(p, topo.mesh)
+                      if method else ())
+                step = _comp.build_compressed_dp_step(
+                    loss_fn, opt, topo.mesh, method)
+                for _ in range(warmup):
+                    p, st, ef, loss = step(p, st, ef, tokens)
+                _sync(loss)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    p, st, ef, loss = step(p, st, ef, tokens)
+                _sync(loss)
+                dt = (time.perf_counter() - t0) / steps
+                res[f"train_quant_comm_{name}_step_ms"] = round(dt * 1e3,
+                                                                2)
+                res[f"train_quant_comm_{name}_loss"] = round(float(loss),
+                                                             5)
+                if method:
+                    ratio = _stats.get("comm/compression_ratio", 0)
+                    res[f"train_quant_comm_{name}_wire_ratio"] = round(
+                        float(ratio), 3)
+                    base = res.get("train_quant_comm_fp32_loss")
+                    if base is not None:
+                        res[f"train_quant_comm_{name}_loss_delta"] = \
+                            round(float(loss) - base, 5)
+            except Exception as e:  # one wire format must not erase the rest
+                res[f"train_quant_comm_{name}_error"] = str(e)[:120]
+    finally:
+        mesh_lib.set_topology(prev_topo)
     return res
 
 

@@ -14,6 +14,7 @@ Inside shard_map-ed functions, `axis` accepts a mesh axis name or tuple.
 """
 
 import contextlib
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -23,7 +24,41 @@ __all__ = ["ReduceOp", "all_reduce", "all_gather", "all_to_all",
            "reduce_scatter", "broadcast", "psum", "pmean", "pmax", "pmin",
            "ppermute", "axis_index", "axis_size", "send_recv_ring",
            "barrier", "Group", "new_group", "get_group", "group_reduce",
-           "group_all_gather"]
+           "group_all_gather", "quantized_wire"]
+
+
+_wire_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def quantized_wire(logical_bytes: int):
+    """Byte-accounting scope for compressed wire formats (the EQuARX
+    question: what actually crossed the link vs what the exchange is
+    worth). Collectives issued inside record their REAL payload bytes
+    (int8/fp8 blocks + scales) into ``comm/bytes_wire``, while
+    ``comm/bytes_logical`` advances once by ``logical_bytes`` — the
+    full-precision volume the same exchange would have moved. Outside any
+    scope the wrappers tick both counters equally, so the two stay
+    directly comparable and ``comm/compression_ratio`` (gauge,
+    cumulative logical/wire) reads 1.0 for an uncompressed program.
+    Like every ``_issue_span`` stat these tick at TRACE time — per
+    compilation, not per step."""
+    from paddle_tpu import stats
+    prev = getattr(_wire_ctx, "active", False)
+    _wire_ctx.active = True
+    try:
+        yield
+    finally:
+        _wire_ctx.active = prev
+        # trace-time accounting BY DESIGN (see docstring): logical_bytes
+        # is a static Python int computed from shapes, never a tracer
+        # ptlint: disable=PT001,PT003 -- per-compilation counters, static arg
+        stats.add("comm/bytes_logical", int(logical_bytes))
+        wire = stats.get("comm/bytes_wire", 0)
+        if wire:
+            # ptlint: disable=PT003 -- per-compilation gauge, documented
+            stats.set_value("comm/compression_ratio",
+                            stats.get("comm/bytes_logical", 0) / wire)
 
 
 class ReduceOp:
@@ -48,11 +83,20 @@ def _issue_span(name, x, axis):
         nbytes = int(x.size) * int(jnp.dtype(x.dtype).itemsize)
     except Exception:
         nbytes = 0
+    # ptlint: disable=PT003 -- trace-time recording is this helper's
+    # documented contract (per-compilation, not per-step; see docstring)
     stats.add(f"collective/{name}_calls")
     if nbytes:
+        # ptlint: disable=PT003 -- per-compilation byte counters
         stats.add(f"collective/{name}_bytes", nbytes)
+        # ptlint: disable=PT003 -- per-compilation byte counters
+        stats.add("comm/bytes_wire", nbytes)
+        if not getattr(_wire_ctx, "active", False):
+            # ptlint: disable=PT003 -- per-compilation byte counters
+            stats.add("comm/bytes_logical", nbytes)
     if not trace.enabled():
         return contextlib.nullcontext()
+    # ptlint: disable=PT003 -- issue-span semantics documented above
     return trace.span(f"collective/{name}", axis=str(axis),
                       bytes=nbytes)
 

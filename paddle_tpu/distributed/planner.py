@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["plan_module", "memory_report", "suggest_mesh",
-           "enumerate_plans", "plan_cost", "rank_plans"]
+           "enumerate_plans", "plan_cost", "rank_plans",
+           "comm_quant_policy"]
 
 _VOCAB_RATIO = 4       # dim0 >= ratio*dim1 → vocab-like table
 _TINY_OUT = 8          # output dims below this are never sharded
@@ -310,6 +311,21 @@ def _axis_tier(degrees: Dict[str, int], axis: str, n_hosts: int) -> str:
               "pp": tp * fsdp * dp}[axis]
     deg = degrees.get(axis, 1)
     return "dcn" if deg > 1 and stride * deg > per_host else "ici"
+
+
+def comm_quant_policy(degrees: Dict[str, int], n_hosts: int = 1,
+                      default_fmt: str = "int8") -> Dict[str, Optional[str]]:
+    """Per-axis wire-format choice for the gradient-sync / weight-gather
+    collectives (EQuARX deployment guidance: quantization pays where the
+    link is slow): axes whose collectives cross host boundaries per
+    :func:`_axis_tier` get ``default_fmt``, ICI-resident axes stay
+    full-precision (None). Only the data axes are candidates — tp/pp
+    move activations, whose quantization is a different (AMP) problem.
+    Consumed by ``compression.resolve_comm_quant`` under
+    ``PT_COMM_QUANT=auto``."""
+    return {ax: (default_fmt
+                 if _axis_tier(degrees, ax, n_hosts) == "dcn" else None)
+            for ax in ("dp", "fsdp")}
 
 
 def plan_cost(module, degrees: Dict[str, int], hbm_bytes: float = 16e9,

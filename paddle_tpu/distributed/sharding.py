@@ -24,6 +24,20 @@ all-gather of the updated shard. The reference's HybridParallelClipGrad
 (global-norm across all groups, hybrid_parallel_optimizer.py:45) needs no
 special code at all: a ClipGradByGlobalNorm inside the jitted step reduces
 over the full (sharded) grad tree and XLA produces the global norm.
+
+**Quantized wire (``comm_quant``)**: when the comm axis rides DCN (or
+``PT_COMM_QUANT`` forces a format — see
+``compression.resolve_comm_quant``), the step is built as an EXPLICIT
+shard_map over the axis instead of GSPMD constraints, so the two big
+movers carry narrow dtypes end to end (distributed/compression.py):
+stage-3's pre-forward param all-gather becomes quantize → int8/fp8
+all-gather → dequant (stateless; the owner shards stay exact fp32, so
+error cannot accumulate step over step), and stage-2/3's gradient
+reduce-scatter becomes a block-quantized all-to-all + local
+dequant-reduce with error feedback. The error-feedback residual rides
+inside ``opt_state["comm_ef"]``, so the step signature is unchanged.
+Stage-2's post-update param rebuild stays full-precision (it is the
+authoritative state, not a per-step estimate).
 """
 
 import dataclasses
@@ -32,11 +46,12 @@ from typing import Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["GroupShardedSpecs", "group_sharded_specs",
            "init_group_sharded_state", "build_group_sharded_step",
-           "group_sharded_parallel", "LEVELS"]
+           "group_sharded_parallel", "attach_comm_ef", "LEVELS"]
 
 LEVELS = ("os", "os_g", "p_g_os")
 
@@ -68,6 +83,9 @@ def _ensure_axis(spec: P, shape, axis: str, axis_size: int) -> P:
     replicated params — ln scales, biases — still spread their optimizer
     state, like the reference's rank-assignment _trainable_param2rank)."""
     dims = _spec_axes(spec)
+    # a spec shorter than the param's rank (P() from the default rules)
+    # leaves trailing dims unsharded — pad so they are candidates too
+    dims += [()] * (len(shape) - len(dims))
     if any(axis in axes for axes in dims):
         return spec
     best, best_len = None, 0
@@ -88,6 +106,8 @@ class GroupShardedSpecs:
     grad: Dict[str, P]
     opt_slot: Dict[str, P]
     mesh: Mesh
+    axis: str = "fsdp"
+    level: str = "p_g_os"
 
     def param_shardings(self):
         return {k: NamedSharding(self.mesh, s)
@@ -124,7 +144,8 @@ def group_sharded_specs(params: Dict[str, jax.Array], mesh: Mesh,
         grad[k] = base if level in ("os_g", "p_g_os") else \
             _strip_axis(base, axis)
         opt_slot[k] = base
-    return GroupShardedSpecs(param, grad, opt_slot, mesh)
+    return GroupShardedSpecs(param, grad, opt_slot, mesh, axis=axis,
+                             level=level)
 
 
 def _constrain_tree(tree, specs: Dict[str, P], mesh: Mesh):
@@ -155,7 +176,9 @@ def init_group_sharded_state(params, optimizer, specs: GroupShardedSpecs):
 
 
 def build_group_sharded_step(loss_fn, optimizer, specs: GroupShardedSpecs,
-                             donate: bool = True):
+                             donate: bool = True,
+                             comm_quant: Optional[str] = None,
+                             comm_block: Optional[int] = None):
     """Jitted train step under the group-sharded policy.
 
     loss_fn(params, *batch) -> scalar. The grad constraint is what turns the
@@ -163,7 +186,25 @@ def build_group_sharded_step(loss_fn, optimizer, specs: GroupShardedSpecs,
     constraints keep the update math sharded so each device updates only its
     shard (≙ GroupShardedOptimizerStage2 updating owned shards then
     broadcasting — the broadcast being XLA's all-gather at next use).
+
+    ``comm_quant`` selects the quantized wire: "bf16"/"int8"/"fp8" swap
+    this GSPMD formulation for the explicit shard_map step whose gradient
+    reduce-scatter and stage-3 param all-gather move narrow blocks
+    (module docstring); "auto" consults ``PT_COMM_QUANT`` / the planner's
+    per-axis DCN tier and quietly keeps the GSPMD path for
+    configurations the explicit step cannot run (grad_clip, level "os",
+    multi-axis specs) — an explicit format raises for those instead.
+    The default None (like "none") keeps the GSPMD
+    path — quantization is OPT-IN here, because the quantized step needs
+    the error-feedback residual in ``opt_state["comm_ef"]`` (build the
+    state with :func:`init_group_sharded_state` + :func:`attach_comm_ef`,
+    or use the one-call :func:`group_sharded_parallel`, whose own
+    ``comm_quant=None`` default DOES auto-resolve and attaches it).
     """
+    policy = _resolve_policy(comm_quant, specs, optimizer)
+    if policy is not None:
+        return _build_quantized_comm_step(loss_fn, optimizer, specs,
+                                          policy, comm_block, donate)
     mesh = specs.mesh
 
     def step(params, opt_state, *batch):
@@ -181,16 +222,220 @@ def build_group_sharded_step(loss_fn, optimizer, specs: GroupShardedSpecs,
     return jax.jit(step, **kw)
 
 
+def _resolve_policy(comm_quant: Optional[str], specs: GroupShardedSpecs,
+                    optimizer=None) -> Optional[str]:
+    """One normalization point for the comm_quant knob: "auto" asks
+    ``compression.resolve_comm_quant`` (PT_COMM_QUANT / planner DCN
+    tier); None/"none"/"fp32" mean full precision; anything else is a
+    wire format passed through. Idempotent, so resolved values survive a
+    second pass unchanged. An AUTO-resolved format additionally falls
+    back to full precision when the explicit path cannot support the
+    configuration (:func:`_quant_unsupported_reason`) — auto must never
+    turn a previously-valid setup into a build-time error; an EXPLICIT
+    format still raises loudly there."""
+    was_auto = comm_quant == "auto"
+    if was_auto:
+        from paddle_tpu.distributed import compression
+        comm_quant = compression.resolve_comm_quant(
+            axis=specs.axis, mesh=specs.mesh)
+    policy = None if comm_quant in (None, "none", "fp32") else comm_quant
+    if policy is not None and was_auto and \
+            _quant_unsupported_reason(optimizer, specs) is not None:
+        return None
+    return policy
+
+
+def _quant_unsupported_reason(optimizer,
+                              specs: GroupShardedSpecs) -> Optional[str]:
+    """Why the explicit quantized step cannot run this configuration
+    (None = supported). Shared by the loud path (explicit format →
+    ValueError) and the quiet one (auto → GSPMD fallback)."""
+    if specs.level not in ("os_g", "p_g_os"):
+        return (f"level {specs.level!r} has no gradient reduce-scatter "
+                f"to quantize (use os_g/p_g_os)")
+    if getattr(optimizer, "grad_clip", None) is not None:
+        return ("grad_clip computes a SHARD norm on the explicit path — "
+                "clip inside loss_fn or use the GSPMD path")
+    mesh_shape = dict(specs.mesh.shape)
+    for name, tree in (("param", specs.param), ("grad", specs.grad),
+                       ("opt_slot", specs.opt_slot)):
+        for k, sp in tree.items():
+            for axes in _spec_axes(sp):
+                for ax in axes:
+                    if ax != specs.axis and mesh_shape.get(ax, 1) > 1:
+                        return (f"shards over {specs.axis!r} only; "
+                                f"{name} spec of {k!r} also uses axis "
+                                f"{ax!r} (size {mesh_shape[ax]})")
+    return None
+
+
+def _shard_dims(specs: GroupShardedSpecs) -> Dict[str, int]:
+    """Per-param dim index carrying the comm axis (from the grad specs —
+    stage 2 and 3 both reduce-scatter there); params the axis never
+    reached (indivisible shapes) are absent and stay replicated."""
+    out = {}
+    for k, sp in specs.grad.items():
+        for i, axes in enumerate(_spec_axes(sp)):
+            if specs.axis in axes:
+                out[k] = i
+                break
+    return out
+
+
+def attach_comm_ef(params, opt_state, specs: GroupShardedSpecs):
+    """Attach the quantized-wire error-feedback residual to the optimizer
+    state (``opt_state["comm_ef"]``). ``params`` are the FULL (unsharded)
+    shapes — call before/alongside :func:`init_group_sharded_state` with
+    the same tree you pass there."""
+    from paddle_tpu.distributed import compression
+    out = dict(opt_state)
+    out["comm_ef"] = compression.init_error_feedback(
+        dict(params), specs.mesh, specs.axis)
+    return out
+
+
+def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
+                               method: str, block: Optional[int],
+                               donate: bool):
+    """The explicit shard_map formulation with a narrow wire: stage-3
+    pre-forward param gather = quantize → all-gather → dequant; gradient
+    reduce-scatter = block-quantized all-to-all + local dequant-mean with
+    error feedback (distributed/compression.py). Supports levels os_g and
+    p_g_os over a single comm axis. A dp axis alongside it splits the
+    batch (leading dim, mean-style losses) and syncs grads with a plain
+    fp32 pmean over dp BEFORE the quantized reduce-scatter — quantizing
+    the dp leg itself is ``build_compressed_dp_step``'s job."""
+    from paddle_tpu.distributed import compression
+    mesh, axis, level = specs.mesh, specs.axis, specs.level
+    reason = _quant_unsupported_reason(optimizer, specs)
+    if reason is not None:
+        raise ValueError(f"comm_quant={method!r}: {reason}")
+    mesh_shape = dict(mesh.shape)
+    n_shard = mesh_shape[axis]
+    sdim = _shard_dims(specs)
+    # a data axis alongside the comm axis splits the batch instead of
+    # silently replicating the whole forward/backward per dp group
+    data_axis = "dp" if axis != "dp" and mesh_shape.get("dp", 1) > 1 \
+        else None
+
+    def _dmean(x):
+        return lax.pmean(x, data_axis) if data_axis else x
+
+    def per_rank(params, opt_state, *batch):
+        idx = lax.axis_index(axis)
+        opt_state = dict(opt_state)
+        ef = jax.tree_util.tree_map(lambda x: x[0],
+                                    opt_state.pop("comm_ef"))
+        ok = jnp.bool_(True)
+        # guard envelopes agreed with ONE pmax per exchange family, not
+        # one scalar collective per param (latency on the slow link)
+        gather_keys = [k for k in params
+                       if level == "p_g_os" and k in sdim]
+        wmax = dict(zip(gather_keys, lax.pmax(jnp.stack(
+            [jnp.max(jnp.abs(params[k])) for k in gather_keys]), axis))) \
+            if gather_keys else {}
+        full = {}
+        for k, p in params.items():
+            if k in wmax:
+                f, okk = compression.quantized_all_gather_dequant(
+                    p, axis, method, block, dim=sdim[k],
+                    vmax_axis=wmax[k])
+                ok = ok & okk
+                full[k] = f
+            else:
+                full[k] = p
+        loss, grads = jax.value_and_grad(
+            lambda q: loss_fn(q, *batch))(full)
+        rs_keys = [k for k in grads if k in sdim]
+        dmeaned = {k: _dmean(grads[k]) for k in rs_keys}
+        gmax = dict(zip(rs_keys, lax.pmax(jnp.stack(
+            [jnp.max(jnp.abs(dmeaned[k].astype(jnp.float32) + ef[k]))
+             for k in rs_keys]), axis))) if rs_keys else {}
+        shard_p, shard_g, new_ef = {}, {}, {}
+        for k, gk in grads.items():
+            if k in sdim:
+                gs, ek, okk = compression.quantized_reduce_scatter_mean(
+                    dmeaned[k], ef[k], axis, method, block, dim=sdim[k],
+                    vmax_axis=gmax[k])
+                ok = ok & okk
+                shard_g[k], new_ef[k] = gs, ek
+                if level == "p_g_os":
+                    shard_p[k] = params[k]
+                else:
+                    d = params[k].shape[sdim[k]] // n_shard
+                    shard_p[k] = lax.dynamic_slice_in_dim(
+                        params[k], idx * d, d, axis=sdim[k])
+            else:
+                shard_g[k] = _dmean(lax.pmean(gk.astype(jnp.float32),
+                                              axis))
+                new_ef[k] = ef[k]
+                shard_p[k] = params[k]
+        new_sp, new_state = optimizer.update(shard_g, opt_state, shard_p)
+        out_p = {}
+        for k in params:
+            if k in sdim and level == "os_g":
+                # post-update rebuild of the replicated copy: the
+                # authoritative state crosses at full precision
+                out_p[k] = lax.all_gather(new_sp[k], axis,
+                                          axis=sdim[k], tiled=True)
+            else:
+                out_p[k] = new_sp[k]
+        new_state = dict(new_state)
+        new_state["comm_ef"] = jax.tree_util.tree_map(
+            lambda x: x[None], new_ef)
+        loss = _dmean(lax.pmean(loss, axis))
+        # fail-loud: a tripped wire guard poisons state on EVERY rank
+        out_p = jax.tree_util.tree_map(
+            lambda x: jnp.where(ok, x, jnp.nan), out_p)
+        loss = jnp.where(ok, loss, jnp.nan)
+        return out_p, new_state, loss
+
+    ef_spec = {k: P(axis) for k in specs.param}
+    state_spec = {"step": P(), "slots": dict(specs.opt_slot),
+                  "comm_ef": ef_spec}
+
+    batch_spec = P(data_axis) if data_axis else P()
+
+    def step(params, opt_state, *batch):
+        # shard_map built per batch arity (jit retraces per arity anyway)
+        smapped = shard_map(
+            per_rank, mesh=mesh,
+            in_specs=(dict(specs.param), state_spec)
+            + (batch_spec,) * len(batch),
+            out_specs=(dict(specs.param), state_spec, P()),
+            check_vma=False)
+        return smapped(params, opt_state, *batch)
+
+    kw = {"donate_argnums": (0, 1)} if donate else {}
+    return jax.jit(step, **kw)
+
+
 def group_sharded_parallel(params, optimizer, loss_fn, mesh: Mesh,
                            level: str = "p_g_os", axis: str = "fsdp",
-                           rules: Optional[Callable[[str], P]] = None):
+                           rules: Optional[Callable[[str], P]] = None,
+                           comm_quant: Optional[str] = None,
+                           comm_block: Optional[int] = None):
     """One-call API ≙ paddle.distributed.sharding.group_sharded_parallel
     (group_sharded.py: level "os" / "os_g" / "p_g_os").
+
+    ``comm_quant``/``comm_block`` select the quantized collective wire
+    (see :func:`build_group_sharded_step`); here ``comm_quant=None``
+    means "auto" — the owner of the state can safely auto-resolve,
+    because when a format results the error-feedback residual is
+    attached to the optimizer state, keeping the step signature
+    identical either way.
 
     Returns (sharded_params, sharded_opt_state, jitted_train_step).
     """
     specs = group_sharded_specs(params, mesh, level=level, axis=axis,
                                 rules=rules)
+    policy = _resolve_policy(
+        "auto" if comm_quant is None else comm_quant, specs, optimizer)
+    full_params = params
     params, opt_state = init_group_sharded_state(params, optimizer, specs)
-    step = build_group_sharded_step(loss_fn, optimizer, specs)
+    if policy is not None:
+        opt_state = attach_comm_ef(full_params, opt_state, specs)
+    step = build_group_sharded_step(loss_fn, optimizer, specs,
+                                    comm_quant=policy,
+                                    comm_block=comm_block)
     return params, opt_state, step

@@ -15,6 +15,11 @@ bfloat16 = jnp.bfloat16
 float16 = jnp.float16
 float32 = jnp.float32
 float64 = jnp.float64
+# fp8 family (the quantized-collective wire + future fp8 matmul work):
+# e4m3 carries the payloads — widest mantissa at ±448 range; e5m2 is the
+# gradient-friendly wide-range variant kept for parity with phi::DataType
+float8_e4m3 = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
 int8 = jnp.int8
 int16 = jnp.int16
 int32 = jnp.int32
@@ -26,7 +31,9 @@ complex128 = jnp.complex128
 
 _NAME2DTYPE = {
     "bfloat16": bfloat16, "float16": float16, "float32": float32,
-    "float64": float64, "int8": int8, "int16": int16, "int32": int32,
+    "float64": float64, "float8_e4m3": float8_e4m3,
+    "float8_e4m3fn": float8_e4m3, "float8_e5m2": float8_e5m2,
+    "int8": int8, "int16": int16, "int32": int32,
     "int64": int64, "uint8": uint8, "bool": bool_,
     "complex64": complex64, "complex128": complex128,
 }

@@ -252,6 +252,20 @@ declare_env("PT_PAGED_TUNE", "1 runs paged-kernel autotuning "
             "constructor, before any trace picks up the config.",
             default="0", owner="inference/paged_engine.py")
 
+# -- cross-chip communication --
+declare_env("PT_COMM_QUANT", "Wire format for the quantized gradient/"
+            "weight collectives: none/bf16/int8/fp8/auto. auto asks "
+            "planner._axis_tier per axis — DCN-crossing axes quantize "
+            "to int8, ICI axes stay full precision.", default="auto",
+            owner="distributed/compression.py")
+declare_env("PT_COMM_BLOCK", "Block size for block-scaled quantization "
+            "on the collective wire (one fp32 scale per block).",
+            default="256", owner="distributed/compression.py")
+declare_env("PT_COMM_QUANT_PSUM", "1 selects the legacy psum wire for "
+            "compressed dp sync (int8 payloads upcast to int32 on the "
+            "wire — the tested parity reference, NOT a volume win).",
+            default="0", owner="distributed/compression.py")
+
 # -- compilation / data / testing --
 declare_env("PT_COMPILE_CACHE_GUARD", "0 disables the persistent-compile-"
             "cache failure guard (compile_cache.guard).", default="1",

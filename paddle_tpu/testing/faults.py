@@ -64,6 +64,13 @@ Sites currently wired into the runtime:
                           commit-rename (kill here orphans a .tmp dir)
     train.step            user training loops (see tests/_resume_worker.py)
     engine.poison_logits  DecodeEngine / PagedDecodeEngine (slot_mask)
+    paged.shared_page     prefix-cache shared KV pages (transform)
+    collective.quant_payload
+                          quantized-collective wire blocks
+                          (distributed/compression.py, :func:`spec`) —
+                          consulted at TRACE time: the bitflip is baked
+                          into the compiled step, so ``after=`` counts
+                          traces, not executions
 """
 
 import os
@@ -73,7 +80,7 @@ from typing import Dict, List, Optional
 
 __all__ = ["inject", "install_rule", "install_from_env", "clear",
            "reset_counts", "enabled", "fire", "transform", "slot_mask",
-           "corrupt_file", "Rule"]
+           "spec", "corrupt_file", "Rule"]
 
 _EXCEPTIONS = {
     "TimeoutError": TimeoutError,
@@ -234,6 +241,9 @@ def install_from_env(env: Optional[Dict[str, str]] = None) -> int:
 def _next_index(site: str) -> int:
     with _lock:
         idx = _counts.get(site, 0)
+        # traced callers (:func:`spec`) consume indices at TRACE time by
+        # documented design — the counter itself is pure host state
+        # ptlint: disable=PT003 -- host-side registry, trace-time by contract
         _counts[site] = idx + 1
         return idx
 
@@ -322,6 +332,24 @@ def slot_mask(site: str, n: int):
         else:
             mask[:] = True
     return mask
+
+
+def spec(site: str, actions=None) -> List[Dict]:
+    """Consult the plan at an IN-GRAPH payload site: returns the kw dicts
+    (plus ``"action"``) of matching rules instead of applying them — for
+    sites inside traced/jitted code, where the payload is a tracer and the
+    corruption must be expressed as graph ops (bit-xor on a bitcast) by
+    the caller. Consumes one call index, like every other site; traced
+    sites are consulted when the program is TRACED, so the rule fires per
+    compilation, not per step (documented at ``collective.quant_payload``).
+    ``actions`` optionally filters to a subset of rule actions."""
+    if not _enabled:
+        return []
+    out = []
+    for rule in _matching(site):
+        if actions is None or rule.action in actions:
+            out.append(dict(rule.kw, action=rule.action))
+    return out
 
 
 def corrupt_file(site: str, path: str):

@@ -43,6 +43,16 @@ def test_bench_decode_smoke():
     assert out.get("decode_spec_tokens_per_step", 0) > 0, out
 
 
+def test_bench_train_quant_comm_smoke():
+    out = bench.bench_train_quant_comm(jax, jnp, PEAK, smoke=True)
+    assert out.get("train_quant_comm_fp32_step_ms", 0) > 0, out
+    assert out.get("train_quant_comm_int8_step_ms", 0) > 0, out
+    # the loss trajectory must stay glued to the fp32 run at fixed seed
+    assert abs(out.get("train_quant_comm_int8_loss_delta", 1)) < 0.1, out
+    # and the wire must actually be narrow (int8 block-256 acceptance)
+    assert out.get("train_quant_comm_int8_wire_ratio", 0) >= 3.5, out
+
+
 def test_bench_bert_smoke():
     out = bench.bench_bert(jax, jnp, PEAK, smoke=True)
     assert out["bert_base_tokens_per_sec_per_chip"] > 0

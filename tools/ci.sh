@@ -26,6 +26,11 @@
 #                          the PD_PREFIX repeated-system-prompt sweep —
 #                          fails if a warm shared-prefix submit() stops
 #                          hitting the radix cache
+#   tools/ci.sh comm       quantized-collective smoke: tiny 2-device
+#                          host-platform mesh runs the int8/fp8 wire —
+#                          convergence parity vs fp32, ≥3.5x bytes_wire
+#                          cut, stage-3 gather tolerance, and the
+#                          bitflipped-scale fail-loud guard
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -57,6 +62,12 @@ if [[ "${1:-}" == "paged" ]]; then
     shift
     PD_SIZE=tiny PD_SECTIONS=paged PD_PREFIX=1 \
         exec python tools/profile_decode.py "$@"
+fi
+
+if [[ "${1:-}" == "comm" ]]; then
+    shift
+    # comm_smoke forces its own 2-device host platform before importing jax
+    exec python tools/comm_smoke.py "$@"
 fi
 
 # lint gate runs BEFORE the test shards: a host-sync or env-contract

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Quantized-collective CPU smoke (``tools/ci.sh comm``).
+
+A tiny 2-device host-platform mesh runs the whole quantized wire in a
+couple of seconds and fails loudly on any of the ISSUE-7 acceptance
+regressions:
+
+- the compressed dp step (int8 AND fp8) converges at parity with the
+  fp32 step on the same seed, with error feedback engaged;
+- ``comm/bytes_wire`` shows ≥3.5x reduction vs ``comm/bytes_logical``
+  for int8 at block 256;
+- the stage-3 quantized weight all-gather reproduces the fp32 gather
+  inside the per-block half-step bound;
+- a bitflipped block scale makes the step RAISE, not drift.
+
+Prints one JSON line with the measured numbers.
+"""
+
+import json
+import os
+import sys
+
+# must precede the jax import: force a small host-platform mesh whatever
+# the caller's XLA_FLAGS said
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=2")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu  # noqa: F401 -- installs the jax.shard_map shim
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu import stats
+    from paddle_tpu.distributed import compression as C
+    from paddle_tpu.testing import faults
+
+    assert len(jax.devices()) >= 2, jax.devices()
+    out = {"devices": len(jax.devices())}
+    topo = dist.init_mesh(dp=2, set_global=False)
+
+    rs = np.random.RandomState(0)
+    w_true = rs.randn(8, 4).astype(np.float32)
+    x = rs.randn(64, 8).astype(np.float32)
+    y = x @ w_true
+    batch = (jnp.asarray(x), jnp.asarray(y))
+
+    def loss_fn(p, b):
+        xb, yb = b
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    def run(method):
+        params = {"w": jnp.zeros((8, 4), jnp.float32)}
+        opt = optim.SGD(learning_rate=0.1)
+        st = opt.init(params)
+        ef = C.init_error_feedback(params, topo.mesh) if method else ()
+        step = C.build_compressed_dp_step(loss_fn, opt, topo.mesh, method)
+        for _ in range(50):
+            params, st, ef, loss = step(params, st, ef, batch)
+        return float(loss), ef
+
+    base, _ = run(None)
+    out["fp32_loss"] = round(base, 6)
+    for method in ("int8", "fp8"):
+        loss, ef = run(method)
+        out[f"{method}_loss"] = round(loss, 6)
+        assert loss <= base * 1.5 + 1e-4, (method, loss, base)
+        assert float(jnp.max(jnp.abs(ef["w"]))) > 0, \
+            f"{method}: error feedback never engaged"
+
+    # wire-volume acceptance: int8 at block 256 moves <= 2/7 of fp32
+    stats.reset("comm/")
+
+    def sync(g, e):
+        m, ef, ok = C.compressed_mean_allgather(
+            {"w": g[0]}, {"w": e[0]}, "dp", "int8", block=256)
+        return m["w"], ef["w"][None], ok
+
+    sm = shard_map(sync, mesh=topo.mesh, in_specs=(P("dp"), P("dp")),
+                   out_specs=(P(), P("dp"), P()), check_vma=False)
+    g = jnp.zeros((2, 64, 256), jnp.float32)
+    jax.jit(sm).lower(g, jnp.zeros_like(g))
+    ratio = stats.get("comm/bytes_logical") / stats.get("comm/bytes_wire")
+    out["int8_wire_ratio"] = round(ratio, 3)
+    assert ratio >= 3.5, ratio
+
+    # stage-3 weight gather parity vs the fp32 gather
+    w = jnp.asarray(rs.randn(16, 64).astype(np.float32))
+
+    def gather(shard):
+        q, ok = C.quantized_all_gather_dequant(shard, "dp", "int8",
+                                               block=64, dim=0)
+        return q, lax.all_gather(shard, "dp", axis=0, tiled=True), ok
+
+    gm = shard_map(gather, mesh=topo.mesh, in_specs=(P("dp"),),
+                   out_specs=(P(), P(), P()), check_vma=False)
+    q, f, ok = jax.jit(gm)(w)
+    assert bool(ok)
+    err = float(jnp.max(jnp.abs(q - f)))
+    bound = float(jnp.max(jnp.abs(w))) * 0.5 / 127 + 1e-7
+    out["stage3_gather_err"] = round(err, 7)
+    assert err <= bound, (err, bound)
+
+    # fail-loud: a bitflipped block scale must raise, not steer
+    with faults.inject("collective.quant_payload", "bitflip", bit=30):
+        params = {"w": jnp.zeros((8, 4), jnp.float32)}
+        opt = optim.SGD(learning_rate=0.1)
+        st = opt.init(params)
+        ef = C.init_error_feedback(params, topo.mesh)
+        step = C.build_compressed_dp_step(loss_fn, opt, topo.mesh, "int8")
+        try:
+            step(params, st, ef, batch)
+            raise AssertionError("bitflipped scale did NOT raise")
+        except RuntimeError:
+            out["bitflip_raises"] = True
+    faults.clear()
+
+    print(json.dumps({"comm_smoke": "ok", **out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
