@@ -63,11 +63,15 @@ def _tune_flash(jax, jnp, b, s, heads, dh, dtype, causal=False,
 
 
 def _timed_gpt_train_step(jax, jnp, peak, cfg, batch, warmup, iters):
-    """The one GPT train-step measurement recipe (shared by bench_gpt and
-    bench_longctx): build model + bf16-moment AdamW, AOT-compile once (the
-    same executable serves cost analysis and the timed loop -- a second
-    trace/compile would double the tunnel-side compile cost), time, and
-    report tokens/s + MFU. Returns (model, metrics)."""
+    """The one single-chip GPT train-step measurement recipe (shared by
+    bench_gpt and bench_longctx): build model + bf16-moment AdamW,
+    AOT-compile once (the same executable serves cost analysis and the
+    timed loop -- a second trace/compile would double the tunnel-side
+    compile cost), time, and report tokens/s + MFU. Returns
+    (model, metrics). The MULTICHIP sharded-stacked row
+    (bench_train_sharded_stacked) keeps its own loop: under a mesh the
+    AOT executable is strict about the output→input sharding fixpoint
+    donation needs, so it times the jitted step instead."""
     from paddle_tpu import flags as pt_flags
     from paddle_tpu import optimizer as optim
     from paddle_tpu.models import gpt
@@ -263,7 +267,8 @@ def main():
     # fp32/int8/fp8 trials are not cheap, and the decode/longctx
     # headline rows must not lose their budget to it
     for sub in (bench_bert, bench_resnet50, bench_ppyoloe, bench_pp,
-                bench_decode, bench_longctx, bench_train_quant_comm):
+                bench_decode, bench_longctx, bench_train_sharded_stacked,
+                bench_train_quant_comm):
         name = sub.__name__.replace("bench_", "")
         if only and name not in only:
             continue
@@ -1035,6 +1040,84 @@ def bench_train_quant_comm(jax, jnp, peak, smoke=False):
                             round(float(loss) - base, 5)
             except Exception as e:  # one wire format must not erase the rest
                 res[f"train_quant_comm_{name}_error"] = str(e)[:120]
+    finally:
+        mesh_lib.set_topology(prev_topo)
+    return res
+
+
+def bench_train_sharded_stacked(jax, jnp, peak, smoke=False):
+    """Sharded scan-over-layers row (MULTICHIP ladder, ISSUE 8): the SAME
+    fsdp×tp GSPMD train step with per-layer vs pre-stacked block weights.
+    Until this round the two were mutually exclusive — stacked refused
+    any mesh with size > 1, so sharded runs paid the in-trace stack copy
+    (~2x block-param HBM) every step. Reports step time, per-chip peak
+    memory (XLA's analysis of the exact executable), and the fixed-seed
+    loss delta: a stacked-layout regression shows as a slowdown, a
+    memory blowup, OR a trajectory split."""
+    n_dev = len(jax.devices())
+    if jax.default_backend() in ("cpu",) and not smoke:
+        return {}
+    if n_dev < 2 and not smoke:
+        return {}
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.models import gpt
+    from paddle_tpu import optimizer as optim
+
+    steps, warmup = (3, 1) if smoke else (10, 3)
+    tp = 2 if n_dev % 2 == 0 else 1
+    fsdp = max(1, n_dev // tp)
+    cfg = (gpt.gpt_tiny(max_seq_len=32, dtype=jnp.float32)
+           if smoke or n_dev <= 8
+           else gpt.gpt3_350m(max_seq_len=1024, remat=True))
+    batch = 2 * fsdp  # batch splits over (dp, fsdp)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, cfg.max_seq_len)), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+
+    res = {"train_sharded_stacked_devices": n_dev,
+           "train_sharded_stacked_mesh": f"fsdp{fsdp}xtp{tp}"}
+    prev_topo = mesh_lib.get_topology()
+    try:
+        topo = mesh_lib.init_mesh(fsdp=fsdp, tp=tp)
+        for name, stacked in (("per_layer", False), ("stacked", True)):
+            try:
+                model = gpt.GPT(cfg, seed=0)
+                opt = optim.AdamW(learning_rate=1e-4, weight_decay=0.01)
+                params, opt_state = gpt.init_train_state(
+                    model, opt, topo.mesh, stacked=stacked)
+                step = gpt.build_train_step(model, opt, topo.mesh)
+                try:
+                    # per-chip peak from XLA's analysis of the lowered
+                    # program (analysis only: the timed loop runs the
+                    # jitted step, which re-specializes if the sharding
+                    # fixed point differs from the init placement)
+                    ma = step.lower(params, opt_state, tokens,
+                                    rng).compile().memory_analysis()
+                    res[f"train_sharded_stacked_{name}_peak_mb"] = round(
+                        (ma.temp_size_in_bytes + ma.output_size_in_bytes)
+                        / 2**20)
+                except Exception:
+                    pass
+                for _ in range(warmup):
+                    params, opt_state, loss = step(params, opt_state,
+                                                   tokens, rng)
+                _sync(loss)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    params, opt_state, loss = step(params, opt_state,
+                                                   tokens, rng)
+                _sync(loss)
+                dt = (time.perf_counter() - t0) / steps
+                res[f"train_sharded_stacked_{name}_step_ms"] = round(
+                    dt * 1e3, 2)
+                res[f"train_sharded_stacked_{name}_loss"] = round(
+                    float(loss), 5)
+            except Exception as e:  # one layout must not erase the other
+                res[f"train_sharded_stacked_{name}_error"] = str(e)[:120]
+        base = res.get("train_sharded_stacked_per_layer_loss")
+        st = res.get("train_sharded_stacked_stacked_loss")
+        if base is not None and st is not None:
+            res["train_sharded_stacked_loss_delta"] = round(st - base, 5)
     finally:
         mesh_lib.set_topology(prev_topo)
     return res

@@ -16,7 +16,8 @@ from paddle_tpu.distributed.env import (init_parallel_env, get_rank,
 from paddle_tpu.distributed import mesh
 from paddle_tpu.distributed.spawn import spawn, ProcessContext
 from paddle_tpu.distributed.mesh import (init_mesh, get_mesh, get_topology,
-                                         HybridTopology)
+                                         HybridTopology, SpecLayout,
+                                         LAYOUT, mesh_safe_spec)
 from paddle_tpu.distributed import collective
 from paddle_tpu.distributed.collective import (
     Group, new_group, get_group, group_reduce, group_all_gather,
@@ -32,7 +33,8 @@ from paddle_tpu.distributed.sharding import (
     group_sharded_parallel, group_sharded_specs, build_group_sharded_step,
     init_group_sharded_state, GroupShardedSpecs)
 from paddle_tpu.distributed.checkpoint import (
-    save_state, load_state, verify_checkpoint, AutoCheckpoint)
+    save_state, load_state, load_resharded, verify_checkpoint,
+    AutoCheckpoint)
 from paddle_tpu.distributed import resilience
 from paddle_tpu.distributed.resilience import (
     RetryPolicy, Deadline, DeadlineExceeded, CollectiveStallError,

@@ -178,10 +178,14 @@ class Engine:
     # -- fit (≙ engine.py fit:749) ------------------------------------------
 
     def _place_batch(self, a):
+        from paddle_tpu.distributed.mesh import LAYOUT
         a = jnp.asarray(a)
         shape = dict(self.mesh.shape)
         kept, prod = [], 1
-        for ax in ("dp", "fsdp"):
+        # the batch dim splits over the canonical data axes (dp, fsdp) —
+        # the same SpecLayout vocabulary the models' activation
+        # constraints use, so operand and constraint shardings agree
+        for ax in LAYOUT.batch_axes:
             deg = shape.get(ax, 1)
             # divisibility is against the PRODUCT of kept axes — checking
             # each axis alone admits dp*fsdp > batch

@@ -38,16 +38,17 @@ import hashlib
 import json
 import os
 import pickle
+import re
 import sys
-from typing import Any, Callable, Dict, Optional, Union
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["save_state", "load_state", "verify_checkpoint",
-           "AutoCheckpoint"]
+__all__ = ["save_state", "load_state", "load_resharded",
+           "name_leaves", "verify_checkpoint", "AutoCheckpoint"]
 
 FORMAT_VERSION = 2
 _MIN_READABLE_VERSION = 1
@@ -659,6 +660,25 @@ class AutoCheckpoint:
             tmpl = jax.tree_util.tree_map(norm, tmpl, is_leaf=is_sh)
         return self.restore(template=tmpl)
 
+    def restore_resharded(self, fresh_state, mesh=None):
+        """Elastic resume across topology AND layout changes: load the
+        newest VERIFIED epoch onto ``fresh_state``'s exact pytree,
+        shardings, and block layout — converting stacked↔per-layer
+        block weights when the checkpoint was saved in the other layout
+        (checkpoint.load_resharded). Each host reads only the saved bytes its
+        own shards need; there is no gather to host 0. Returns None if
+        no epoch verifies. Unlike `restore_like`, this survives a pytree
+        STRUCTURE change between save and resume, not just a mesh
+        change. ``mesh`` normalizes non-spanning template shardings to
+        mesh-replicated, exactly like `restore_like`."""
+        e = self.last_verified_epoch()
+        if e is None:
+            return None
+        # last_verified_epoch already hashed this directory — don't
+        # re-verify every shard a second time inside the load
+        return load_resharded(self._epoch_dir(e), fresh_state,
+                              verify=False, mesh=mesh)
+
     def save(self, state, epoch: int):
         from paddle_tpu.testing import faults
 
@@ -701,3 +721,234 @@ class AutoCheckpoint:
 
     def epochs(self, start: int, end: int):
         return range(start, end)
+
+
+# ---------------------------------------------------------------------------
+# Layout-portable reshard pass (ISSUE 8)
+# ---------------------------------------------------------------------------
+# PAPERS "Memory-efficient array redistribution through portable collective
+# communication" motivates the policy: moving a verified checkpoint between
+# meshes must never stage the full state on one host. load_state already
+# reshards *shardings* natively (each target shard assembled from the saved
+# files overlapping it, per host). What it cannot do is change the state's
+# *layout*: a train state saved with pre-stacked block weights
+# (init_train_state(stacked=True), one '_stacked_blocks' pytree with a
+# leading layer axis) has a different pytree STRUCTURE than the per-layer
+# state ('blocks.item_i.*' keys), so a template-driven load fails
+# structurally even though the bytes are all there. `load_resharded` closes
+# that gap: it name-indexes both the saved skeleton and the target template,
+# matches leaves through the stacked<->per-layer correspondence
+#
+#     <pfx>._stacked_<list>.<rest>  =  <pfx>.<list>.item_{l}.<rest>  (all l)
+#
+# (the convention models.gpt '_stacked_blocks'<->'blocks' and models.bert
+# '_stacked_layers'<->'layers' follow), and reads each target shard's bytes
+# straight out of the overlapping saved files — a stacked target leaf reads
+# layer l's rows from layer l's saved per-layer file, a per-layer target
+# reads its rows from the saved stack's layer-l slice. Optimizer slots
+# convert the same way (their pytree mirrors the params').
+
+def _is_module(o) -> bool:
+    from paddle_tpu.nn.module import Module
+    return isinstance(o, Module)
+
+
+_STACKED_RE = re.compile(r"^(.*?)_stacked_([A-Za-z0-9]+)\.(.+)$")
+_PER_LAYER_RE = re.compile(r"^(.*?)([A-Za-z0-9]+)\.item_(\d+)\.(.+)$")
+
+
+def name_leaves(obj, prefix: str = "") -> Dict[str, Any]:
+    """Flatten a state pytree into ``{dotted-name: leaf}``.
+
+    Modules walk their pytree keys (sorted param/buffer/module names — the
+    same order named_parameters uses), dicts their keys, sequences their
+    indices; so a saved skeleton and a freshly initialized template of the
+    same logical state produce the same names even though one holds
+    'ARRAY_n' placeholders and the other live arrays."""
+    out: Dict[str, Any] = {}
+
+    def walk(o, pfx):
+        if o is None:
+            return  # jax treats None as an empty pytree, not a leaf
+        if isinstance(o, dict):
+            for k in sorted(o):
+                walk(o[k], f"{pfx}.{k}" if pfx else str(k))
+        elif _is_module(o):
+            for k in o._tree_keys():
+                walk(getattr(o, k), f"{pfx}.{k}" if pfx else k)
+        elif isinstance(o, (list, tuple)):
+            for i, v in enumerate(o):
+                walk(v, f"{pfx}.{i}" if pfx else str(i))
+        else:
+            out[pfx] = o
+
+    walk(obj, prefix)
+    return out
+
+
+def _canon_per_layer(name: str) -> Optional[Tuple[str, int]]:
+    """'<pfx>.<list>.item_{l}.<rest>' → ('<pfx>._stacked_<list>.<rest>', l)
+    — the stacked-side name this per-layer leaf corresponds to."""
+    m = _PER_LAYER_RE.match(name)
+    if not m:
+        return None
+    pfx, lst, l, rest = m.groups()
+    return f"{pfx}_stacked_{lst}.{rest}", int(l)
+
+
+def _per_layer_name(stacked_name: str, layer: int) -> Optional[str]:
+    """Inverse of `_canon_per_layer` for one layer index."""
+    m = _STACKED_RE.match(stacked_name)
+    if not m:
+        return None
+    pfx, lst, rest = m.groups()
+    return f"{pfx}{lst}.item_{layer}.{rest}"
+
+
+def _sharding_of(leaf, mesh=None):
+    s = getattr(leaf, "sharding", None)
+    if not isinstance(s, jax.sharding.Sharding):
+        return None
+    if mesh is not None:
+        # normalize leaves whose sharding does not span the whole target
+        # mesh to mesh-replicated (same policy as restore_like):
+        # jit-created states (optimizer.init) can commit scalars/vectors
+        # to one device, and faithfully reproducing that mixed placement
+        # makes the donating train step refuse the restored state
+        try:
+            if len(s.device_set) != mesh.size:
+                return NamedSharding(mesh, P())
+        except Exception:
+            return NamedSharding(mesh, P())
+    return s
+
+
+def load_resharded(path: str, template, verify: bool = True,
+                   mesh: Optional[Mesh] = None):
+    """Load a checkpoint directory onto ``template``'s exact layout.
+
+    ``template``: a pytree of arrays (or ShapeDtypeStructs carrying a
+    ``sharding``) shaped like the TARGET state — typically the output of a
+    fresh ``init_train_state(...)`` on the new mesh. Every array leaf is
+    restored with the template leaf's sharding via
+    ``jax.make_array_from_callback``: each process reads only the saved
+    bytes overlapping its own addressable shards (sharded-read per host,
+    never a host-0 gather), assembling across the stacked↔per-layer
+    layout boundary when the saved state used the other block layout.
+    Dtypes are cast to the template's when they differ (e.g. a changed
+    optimizer moment dtype).
+
+    ``verify=True`` runs `verify_checkpoint` first (v2 sha256 sidecars +
+    COMMIT marker) and raises instead of restoring damaged bytes.
+
+    ``mesh``: when given, template leaves whose sharding does not span
+    the whole mesh (e.g. jit-created optimizer scalars committed to one
+    device) restore mesh-replicated instead — the `restore_like`
+    normalization, so the restored state is consistent for a donating
+    jitted train step.
+    """
+    if verify:
+        ok, reason = verify_checkpoint(path)
+        if not ok:
+            raise ValueError(
+                f"checkpoint {path} failed verification: {reason}")
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    ver = meta.get("format_version", 0)
+    if not (_MIN_READABLE_VERSION <= ver <= FORMAT_VERSION):
+        raise ValueError(f"checkpoint format_version {ver} unsupported")
+    with open(os.path.join(path, "skeleton.pkl"), "rb") as f:
+        skeleton = pickle.load(f)
+
+    saved = name_leaves(skeleton)
+    # per-layer leaves of the SAVED state, grouped under their stacked
+    # name: {'<pfx>._stacked_<list>.<rest>': {layer: saved name}}
+    saved_layers: Dict[str, Dict[int, str]] = {}
+    for n in saved:
+        c = _canon_per_layer(n)
+        if c is not None and isinstance(saved[n], str):
+            saved_layers.setdefault(c[0], {})[c[1]] = n
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    names = list(name_leaves(template))
+    if len(names) != len(leaves):
+        raise ValueError(
+            "template names/leaves mismatch: a Module in the template "
+            "carries non-pytree leaves the walker saw")
+
+    def read_direct(entry, index, out_dtype):
+        shape = tuple(entry["shape"])
+        data = _read_slice(path, entry, index,
+                           shape, _np_dtype(entry["dtype"]))
+        return data if data.dtype == out_dtype else data.astype(out_dtype)
+
+    out = []
+    for name, leaf in zip(names, leaves):
+        if not hasattr(leaf, "shape"):
+            # non-array target slot (python scalar in the skeleton): keep
+            # the saved value when present, else the template's
+            sv = saved.get(name)
+            out.append(sv.v if isinstance(sv, _Py) else leaf)
+            continue
+        shape = tuple(leaf.shape)
+        np_dtype = (leaf.dtype if isinstance(leaf.dtype, np.dtype)
+                    else _np_dtype(str(leaf.dtype)))
+        src = saved.get(name)
+        if isinstance(src, str):
+            entry = meta["arrays"][src]
+            if tuple(entry["shape"]) != shape:
+                raise ValueError(
+                    f"{name}: saved shape {entry['shape']} != template "
+                    f"shape {list(shape)}")
+
+            def cb(index, entry=entry, dt=np_dtype):
+                return read_direct(entry, index, dt)
+        elif name in saved_layers:
+            # target stacked, saved per-layer: leading dim indexes layers
+            per = saved_layers[name]
+            L = shape[0]
+            missing = [l for l in range(L) if l not in per]
+            if missing:
+                raise ValueError(
+                    f"{name}: saved per-layer state lacks layers "
+                    f"{missing} (have {sorted(per)})")
+            entries = {l: meta["arrays"][saved[per[l]]]
+                       for l in range(L)}
+            blk_shape = tuple(entries[0]["shape"])
+            if blk_shape != shape[1:]:
+                raise ValueError(
+                    f"{name}: per-layer saved shape {list(blk_shape)} != "
+                    f"stacked template trailing shape {list(shape[1:])}")
+
+            def cb(index, entries=entries, L=L, dt=np_dtype):
+                l0 = index[0].start or 0
+                l1 = index[0].stop if index[0].stop is not None else L
+                return np.stack([read_direct(entries[l], index[1:], dt)
+                                 for l in range(l0, l1)])
+        else:
+            # target per-layer, saved stacked: read one layer's slice
+            c = _canon_per_layer(name)
+            src_stacked = saved.get(c[0]) if c else None
+            if not isinstance(src_stacked, str):
+                raise ValueError(
+                    f"checkpoint {path} has no source for template leaf "
+                    f"{name!r} (neither direct, per-layer, nor stacked)")
+            layer = c[1]
+            entry = meta["arrays"][src_stacked]
+            if tuple(entry["shape"])[1:] != shape:
+                raise ValueError(
+                    f"{name}: stacked saved shape {entry['shape']} does "
+                    f"not slice to template shape {list(shape)}")
+
+            def cb(index, entry=entry, layer=layer, dt=np_dtype):
+                return read_direct(
+                    entry, (slice(layer, layer + 1),) + tuple(index),
+                    dt)[0]
+
+        sharding = _sharding_of(leaf, mesh)
+        if sharding is None:
+            arr = jnp.asarray(cb(tuple(slice(0, d) for d in shape)))
+        else:
+            arr = jax.make_array_from_callback(shape, sharding, cb)
+        out.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from paddle_tpu.distributed.mesh import LAYOUT
+
 __all__ = ["plan_module", "memory_report", "suggest_mesh",
            "enumerate_plans", "plan_cost", "rank_plans",
            "comm_quant_policy"]
@@ -106,38 +108,41 @@ def plan_module(module, mesh: Optional[Mesh] = None,
             # expert-stacked (E, in, out): ep on experts + col/row rule
             e, din, dout = v.shape
             if din == 1:  # (E, 1, out) expert bias
-                plan[name] = (P("ep", None, "tp") if dout != d_model
-                              else P("ep", None, None))
+                plan[name] = (LAYOUT.expert_column_bias()
+                              if dout != d_model
+                              else LAYOUT.expert_row_bias())
             elif dout >= din:
-                plan[name] = P("ep", "fsdp", "tp")
+                plan[name] = LAYOUT.expert_column()
                 expanded_dims_by_mod.setdefault(mod, set()).add(dout)
             else:
-                plan[name] = P("ep", "tp", "fsdp")
+                plan[name] = LAYOUT.expert_row()
             continue
         d0, d1 = v.shape
         if d1 < _TINY_OUT:  # gating / tiny heads
-            plan[name] = P(None, None) if in_block else P("fsdp", None)
+            plan[name] = (P(None, None) if in_block
+                          else LAYOUT.root_linear())
             continue
         if not in_block:
             if d0 >= _VOCAB_RATIO * d1 and d0 >= 256:
-                plan[name] = P("tp", "fsdp")        # vocab embedding
+                plan[name] = LAYOUT.vocab_embedding()
                 vocab_dims.add(d0)
                 continue
             if d1 >= _VOCAB_RATIO * d0 and d1 >= 256:
-                plan[name] = P("fsdp", "tp")        # untied vocab head
+                plan[name] = LAYOUT.vocab_head()    # untied head
                 vocab_dims.add(d1)
                 continue
             has_bias = any(b in names or f"{mod}.{b}" in names
                            for b in _bias_names(leaf))
             # linear (paired bias) vs table (no bias)
-            plan[name] = P("fsdp", None) if has_bias else P(None, "fsdp")
+            plan[name] = (LAYOUT.root_linear() if has_bias
+                          else LAYOUT.position_table())
             continue
         # in repeated block: dimension-flow column/row
         if d1 > d0:
-            plan[name] = P("fsdp", "tp")            # column parallel
+            plan[name] = LAYOUT.column()
             expanded_dims_by_mod.setdefault(mod, set()).add(d1)
         elif d0 > d1:
-            plan[name] = P("tp", "fsdp")            # row parallel
+            plan[name] = LAYOUT.row()
         else:
             # square: row iff an expanding sibling exists (attention
             # out-proj pattern); else column (v0 limitation, see docstring)
@@ -145,26 +150,26 @@ def plan_module(module, mesh: Optional[Mesh] = None,
                 w.shape[1] > w.shape[0]
                 for n2, w in params
                 if w.ndim == 2 and _split_module(n2)[0] == mod)
-            plan[name] = (P("tp", "fsdp") if mod_has_expand
-                          else P("fsdp", "tp"))
+            plan[name] = (LAYOUT.row() if mod_has_expand
+                          else LAYOUT.column())
 
     # pass 2: 1-D params
     for name, v in params:
         if v.ndim != 1:
             if v.ndim == 4:  # conv OIHW: ZeRO over output channels
-                plan.setdefault(name, P("fsdp"))
+                plan.setdefault(name, LAYOUT.conv_filter())
             elif v.ndim != 2 and v.ndim != 3:
                 plan.setdefault(name, P())
             continue
         mod, leaf = _split_module(name)
         (dim,) = v.shape
         if dim in vocab_dims:
-            plan[name] = P("tp")                    # vocab-size bias
+            plan[name] = LAYOUT.vocab_bias()
         elif _in_repeated_block(name) and \
                 dim in expanded_dims_by_mod.get(mod, ()) and dim != d_model:
-            plan[name] = P("tp")                    # column-output bias
+            plan[name] = LAYOUT.column_bias()
         else:
-            plan[name] = P(None)
+            plan[name] = LAYOUT.row_bias()
 
     if mesh_shape is None and mesh is not None:
         mesh_shape = dict(mesh.shape)

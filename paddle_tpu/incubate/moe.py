@@ -29,11 +29,13 @@ __all__ = ["MoELayer", "top_k_gating", "EXPERT_PARTITION_RULES"]
 
 # regex → spec; the single source of truth for expert-weight sharding
 # (models.gpt composes these into its PARTITION_RULES)
+from paddle_tpu.distributed.mesh import LAYOUT as _LAYOUT
+
 EXPERT_PARTITION_RULES = (
-    (r"moe_w1$", P("ep", "fsdp", "tp")),
-    (r"moe_b1$", P("ep", None, "tp")),
-    (r"moe_w2$", P("ep", "tp", "fsdp")),
-    (r"moe_b2$", P("ep", None, None)),
+    (r"moe_w1$", _LAYOUT.expert_column()),
+    (r"moe_b1$", _LAYOUT.expert_column_bias()),
+    (r"moe_w2$", _LAYOUT.expert_row()),
+    (r"moe_b2$", _LAYOUT.expert_row_bias()),
     (r"gate_w$", P(None, None)),
 )
 

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.distributed.mesh import LAYOUT, mesh_safe_spec
 from paddle_tpu.nn.module import Module, Parameter, LayerList
 from paddle_tpu.nn import functional as F
 
@@ -193,13 +194,30 @@ class Bert(Module):
                 keep == (jnp.arange(s)[None, :] < lens[:, None]), axis=-1)
             kv_lens = jnp.where(is_prefix, lens, s)
         from paddle_tpu import flags as _flags
-        if self.cfg.n_layers > 1 and _flags.get_flag("scan_layers"):
+        prestacked = getattr(self, "_stacked_layers", None)
+        if prestacked is not None or (
+                self.cfg.n_layers > 1 and _flags.get_flag("scan_layers")):
             # one compiled encoder-layer body instead of L unrolled
             # copies (L-fold faster XLA compile — same rationale and
-            # helper as the GPT stack)
-            from paddle_tpu.models.gpt import stack_block_weights
-            stacked = stack_block_weights(
-                [self.layers[i] for i in range(self.cfg.n_layers)])
+            # helper as the GPT stack). A state built by
+            # init_train_state(stacked=True) carries the weights
+            # pre-stacked, so the scan consumes them with zero in-trace
+            # copy (the in-trace stack costs ~2x block-param HBM per
+            # step: the stack forward plus its grad-unstack transpose)
+            from paddle_tpu.models.gpt import (_shard_stacked,
+                                               stack_block_weights)
+            stacked = prestacked if prestacked is not None else \
+                stack_block_weights(
+                    [self.layers[i] for i in range(self.cfg.n_layers)])
+            if prestacked is not None:
+                from paddle_tpu.distributed.mesh import get_mesh
+                mesh = get_mesh()
+                if mesh is not None and mesh.size > 1:
+                    # same rationale as GPT.hidden_states: constrain only
+                    # the PRE-stacked state; in-trace stacks keep
+                    # propagation-only sharding (established numerics)
+                    stacked = _shard_stacked(stacked, self.layers[0],
+                                             mesh, spec_fn=partition_spec)
 
             def body(h, lyr_i):
                 lyr, i = lyr_i
@@ -219,6 +237,24 @@ class Bert(Module):
         pooled = jnp.tanh(x[:, 0] @ self.pooler_w + self.pooler_b)
         return x, pooled
 
+    def merge_params(self, params):
+        new = Module.merge_params(self, params)
+        _bind_stacked(new)
+        return new
+
+
+def _bind_stacked(trunk: "Bert"):
+    """Rebind each per-layer module to a sliced view of the pre-stacked
+    state (same contract as GPT.merge_params): consumers outside the scan
+    forward (state_dict export, unrolled escape hatch) must never read
+    the init-time weights still sitting in ``trunk.layers``. Inside jit
+    the unconsumed slices are dead code XLA eliminates."""
+    st = getattr(trunk, "_stacked_layers", None)
+    if st is not None:
+        for i in range(trunk.cfg.n_layers):
+            lyr = jax.tree_util.tree_map(lambda x, i=i: x[i], st)
+            object.__setattr__(trunk.layers, f"item_{i}", lyr)
+
 
 class BertForPretraining(Module):
     """MLM + NSP heads (decoder tied to wte, ≙ BertPretrainingHeads)."""
@@ -237,6 +273,16 @@ class BertForPretraining(Module):
         self.mlm_bias = Parameter(jnp.zeros((cfg.vocab_size,), jnp.float32))
         self.nsp_w = Parameter(_normal(k2, (d, 2), 0.02, dt))
         self.nsp_b = Parameter(jnp.zeros((2,), dt))
+
+    def merge_params(self, params):
+        new = Module.merge_params(self, params)
+        # subclasses may re-home the trunk (Ernie moves it under
+        # .ernie.bert); only a directly-attached Bert can carry the
+        # pre-stacked state this head's init_train_state produces
+        trunk = getattr(new, "bert", None)
+        if isinstance(trunk, Bert):
+            _bind_stacked(trunk)
+        return new
 
     def mlm_head(self, h):
         """Transform + LN + tied vocab projection over (..., d) states."""
@@ -277,6 +323,16 @@ class BertForSequenceClassification(Module):
                                        0.02, cfg.dtype))
         self.cls_b = Parameter(jnp.zeros((num_classes,), cfg.dtype))
 
+    def merge_params(self, params):
+        new = Module.merge_params(self, params)
+        # subclasses may re-home the trunk (Ernie moves it under
+        # .ernie.bert); only a directly-attached Bert can carry the
+        # pre-stacked state this head's init_train_state produces
+        trunk = getattr(new, "bert", None)
+        if isinstance(trunk, Bert):
+            _bind_stacked(trunk)
+        return new
+
     def forward(self, tokens, token_type_ids=None, attention_mask=None,
                 rng_key=None):
         _, pooled = self.bert(tokens, token_type_ids, attention_mask,
@@ -307,20 +363,21 @@ def pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels):
     return loss + nsp
 
 
-# Megatron TP × ZeRO-3 fsdp rules, mirroring models.gpt.PARTITION_RULES
+# Megatron TP × ZeRO-3 fsdp rules, spelled in the same SpecLayout
+# vocabulary as models.gpt.PARTITION_RULES (distributed.mesh.LAYOUT)
 PARTITION_RULES = (
-    (r"wte$", P("tp", "fsdp")),
-    (r"(wpe|wtype)$", P(None, "fsdp")),
-    (r"wqkv$", P("fsdp", "tp")),
-    (r"bqkv$", P("tp")),
-    (r"wo$", P("tp", "fsdp")),
-    (r"wup$", P("fsdp", "tp")),
-    (r"bup$", P("tp")),
-    (r"wdown$", P("tp", "fsdp")),
-    (r"mlm_transform_w$", P("fsdp", None)),
-    (r"mlm_bias$", P("tp")),
-    (r"(pooler_w|nsp_w|cls_w)$", P("fsdp", None)),
-    (r".*", P()),
+    (r"wte$", LAYOUT.vocab_embedding()),
+    (r"(wpe|wtype)$", LAYOUT.position_table()),
+    (r"wqkv$", LAYOUT.column()),
+    (r"bqkv$", LAYOUT.column_bias()),
+    (r"wo$", LAYOUT.row()),
+    (r"wup$", LAYOUT.column()),
+    (r"bup$", LAYOUT.column_bias()),
+    (r"wdown$", LAYOUT.row()),
+    (r"mlm_transform_w$", LAYOUT.root_linear()),
+    (r"mlm_bias$", LAYOUT.vocab_bias()),
+    (r"(pooler_w|nsp_w|cls_w)$", LAYOUT.root_linear()),
+    (r".*", LAYOUT.replicated()),
 )
 
 
@@ -369,8 +426,59 @@ def build_pretrain_step(model: BertForPretraining, optimizer,
     return jax.jit(step, **kw)
 
 
-def init_train_state(model, optimizer, mesh: Optional[Mesh] = None):
+def _trunk_of(model) -> (Bert, str):
+    """(encoder trunk, its param-path prefix) for any BERT-family head."""
+    if isinstance(model, Bert):
+        return model, ""
+    trunk = getattr(model, "bert", None)
+    if not isinstance(trunk, Bert):
+        raise ValueError(
+            f"{type(model).__name__} does not expose a .bert trunk; the "
+            "stacked layout supports Bert and the Bert* heads")
+    return trunk, "bert."
+
+
+def init_train_state(model, optimizer, mesh: Optional[Mesh] = None,
+                     stacked: bool = False):
+    """Params + optimizer state, sharded onto the mesh if given.
+
+    ``stacked=True``: encoder layers enter the state PRE-stacked under a
+    ``{prefix}_stacked_layers`` key the forward scan consumes directly —
+    the previous in-trace ``stack_block_weights`` copied every layer
+    weight inside the step, the exact cost the GPT path eliminated. Same
+    SpecLayout-aware placement as GPT: under a multi-device mesh each
+    stacked leaf is emitted sharded by its layer-leading PARTITION_RULES
+    spec via the stacking jit's out_shardings."""
+    from paddle_tpu.models.gpt import (register_stacked_decay_mask,
+                                       stack_block_weights,
+                                       stacked_block_specs)
     params, _ = model.split_params()
+    if stacked:
+        trunk, prefix = _trunk_of(model)
+        L = trunk.cfg.n_layers
+        entry = f"{prefix}_stacked_layers"
+        params = {k: v for k, v in params.items()
+                  if not k.startswith(f"{prefix}layers.")}
+        layers = [trunk.layers[i] for i in range(L)]
+        if getattr(optimizer, "apply_decay_param_fun", None) is not None:
+            register_stacked_decay_mask(
+                optimizer, trunk.layers[0], L,
+                lambda i, name: f"{prefix}layers.item_{i}.{name}", entry)
+        if mesh is not None and mesh.size > 1:
+            params = shard_params(params, mesh)
+            _, treedef, specs = stacked_block_specs(trunk.layers[0],
+                                                    partition_spec)
+            sh_tree = jax.tree_util.tree_unflatten(
+                treedef, [NamedSharding(mesh, mesh_safe_spec(s, mesh))
+                          for s in specs])
+            params[entry] = jax.jit(
+                stack_block_weights, out_shardings=sh_tree)(layers)
+            opt_state = jax.jit(optimizer.init)(params)
+        else:
+            params = {k: jnp.copy(v) for k, v in params.items()}
+            params[entry] = stack_block_weights(layers)
+            opt_state = optimizer.init(params)
+        return params, opt_state
     if mesh is not None and mesh.size > 1:
         params = shard_params(params, mesh)
         opt_state = jax.jit(optimizer.init)(params)

@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu import nn
+from paddle_tpu.distributed.mesh import LAYOUT, mesh_safe_spec
 from paddle_tpu.incubate.moe import EXPERT_PARTITION_RULES
 from paddle_tpu.nn.module import Module, Parameter, LayerList
 from paddle_tpu.nn import functional as F
@@ -448,9 +449,9 @@ class GPTBlock(Module):
         if self.bqkv is not None:
             qkv = qkv + self.bqkv
         q, k, v = self._split_qkv(qkv)
-        q = _shard_act(q, P(_BATCH_AXES, "sp", "tp", None))
-        k = _shard_act(k, P(_BATCH_AXES, "sp", "tp", None))
-        v = _shard_act(v, P(_BATCH_AXES, "sp", "tp", None))
+        q = _shard_act(q, LAYOUT.activation("sp", "tp", None))
+        k = _shard_act(k, LAYOUT.activation("sp", "tp", None))
+        v = _shard_act(v, LAYOUT.activation("sp", "tp", None))
         if self.rope:
             q = self._apply_rope(q, jnp.arange(s))
             k = self._apply_rope(k, jnp.arange(s))
@@ -468,15 +469,15 @@ class GPTBlock(Module):
         else:
             h = jax.nn.gelu(h @ self.wup + (self.bup if self.bup is not None
                                             else 0.0))
-            h = _shard_act(h, P(_BATCH_AXES, "sp", "tp"))
+            h = _shard_act(h, LAYOUT.activation("sp", "tp"))
             h = h @ self.wdown
             if self.bdown is not None:
                 h = h + self.bdown
         x = x + _maybe_dropout(h, self.dropout, rng_key, 2)
-        return _shard_act(x, P(_BATCH_AXES, "sp", None))
+        return _shard_act(x, LAYOUT.activation("sp", None))
 
 
-_BATCH_AXES = ("dp", "fsdp")
+_BATCH_AXES = LAYOUT.batch_axes
 
 _PIPELINE_DEPTH = 0
 
@@ -601,13 +602,13 @@ class GPT(Module):
             x = jnp.take(_gathered_table(self.wte), tokens, axis=0)
         if self.wpe is not None:  # rope models position in attention
             x = x + self.wpe[:s]
-        return _shard_act(x, P(_BATCH_AXES, "sp", None))
+        return _shard_act(x, LAYOUT.activation("sp", None))
 
     def head(self, x):
         x = final_ln(x, self.lnf_scale, self.lnf_bias)
         w = self.wte.T if self.lm_head is None else self.lm_head
         logits = x @ w
-        return _shard_act(logits, P(_BATCH_AXES, "sp", "tp"))
+        return _shard_act(logits, LAYOUT.activation("sp", "tp"))
 
     def hidden_states(self, tokens, rng_key=None, aux_acc=None):
         """Final hidden states (B, S, d) — forward minus the LM head (the
@@ -637,6 +638,19 @@ class GPT(Module):
             # on 16GB while the round-start unrolled form fit)
             stacked = prestacked if prestacked is not None else \
                 stack_block_weights([self.blocks[i] for i in range(L)])
+            if prestacked is not None:
+                from paddle_tpu.distributed.mesh import get_mesh
+                mesh = get_mesh()
+                if mesh is not None and mesh.size > 1:
+                    # re-assert the layer-leading PARTITION_RULES specs
+                    # inside the trace: the scanned body then runs
+                    # fsdp/tp-sharded matmuls on each layer slice instead
+                    # of the partitioner falling back to replicating the
+                    # whole (L, ...) stack. (The in-trace-stacked branch
+                    # keeps propagation-only sharding — constraining it
+                    # would perturb the established per-layer numerics.)
+                    stacked = _shard_stacked(stacked, self.blocks[0],
+                                             mesh)
 
             def body(h, blk_i):
                 blk, i = blk_i
@@ -860,40 +874,42 @@ def _decode_mesh(cfg, b):
     return mesh
 
 
-def stacked_partition_specs(stacked, template_blk):
-    """Per-leaf PartitionSpecs for a scan-stacked block pytree: the
-    PARTITION_RULES spec of each template-block param with a leading
-    (replicated) layer axis. Leaf→name mapping goes by object identity
-    against the template block (Module pytree paths are index-keyed).
-    Returns (leaves, treedef, specs) — the ONE spec derivation shared by
-    the sharded generate path and the tensor-parallel DecodeEngine."""
+def stacked_block_specs(template_blk, spec_fn=None):
+    """Per-leaf PartitionSpecs for the scan-stacked form of one template
+    block: each param's PARTITION_RULES spec behind a leading (replicated)
+    layer axis (``LAYOUT.stacked``). Leaf→name mapping goes by object
+    identity against the template block (Module pytree paths are
+    index-keyed). ``spec_fn`` maps a param name to its per-block spec
+    (default: this module's `partition_spec`; models.bert passes its
+    own). Returns (template_leaves, treedef, specs) — derivable BEFORE
+    any stacking happens, so init can place the stacked state with
+    out_shardings instead of re-laying it out afterwards."""
+    spec_fn = spec_fn or partition_spec
     id2name = {id(v): n for n, v in template_blk.named_parameters()}
-    tleaves = jax.tree_util.tree_flatten(template_blk)[0]
+    tleaves, treedef = jax.tree_util.tree_flatten(template_blk)
+    specs = [LAYOUT.stacked(spec_fn(id2name.get(id(t), "")),
+                            ndim=t.ndim + 1)
+             for t in tleaves]
+    return tleaves, treedef, specs
+
+
+def stacked_partition_specs(stacked, template_blk, spec_fn=None):
+    """Per-leaf PartitionSpecs for an already scan-stacked block pytree —
+    the ONE spec derivation shared by the sharded generate path, the
+    tensor-parallel DecodeEngine, and the sharded-stacked train state
+    (which derives them pre-stack via `stacked_block_specs`)."""
+    _, _, specs = stacked_block_specs(template_blk, spec_fn)
     sleaves, streedef = jax.tree_util.tree_flatten(stacked)
-    specs = []
-    for tleaf, leaf in zip(tleaves, sleaves):
-        spec = partition_spec(id2name.get(id(tleaf), ""))
-        if len(spec) >= leaf.ndim:  # the leading L axis consumed the rank
-            spec = P(*tuple(spec)[:leaf.ndim - 1])
-        specs.append(P(None, *tuple(spec)))
     return sleaves, streedef, specs
 
 
-def mesh_safe_spec(spec: P, mesh) -> P:
-    """Drop axes the mesh does not define (e.g. 'fsdp' on a bare
-    ('tp',) Mesh) — the spec then replicates over the missing axis
-    instead of NamedSharding raising."""
-    names = set(mesh.axis_names)
-    return P(*(a if (a is None or a in names) else None
-               for a in tuple(spec)))
-
-
-def _shard_stacked(stacked, template_blk, mesh):
+def _shard_stacked(stacked, template_blk, mesh, spec_fn=None):
     """Constrain stacked per-layer weights by PARTITION_RULES with a
     leading (replicated) layer axis, so the decode jit runs TP-sharded
     matmuls instead of replicating every block."""
     sleaves, streedef, specs = stacked_partition_specs(stacked,
-                                                       template_blk)
+                                                       template_blk,
+                                                       spec_fn)
     out = []
     for leaf, spec in zip(sleaves, specs):
         try:
@@ -1041,19 +1057,21 @@ def fused_lm_loss(m: GPT, tokens, rng_key=None, force: bool = False):
 
 
 # (regex on param path → PartitionSpec). Megatron-style TP composed with
-# ZeRO-3-style fsdp (ref: mp_layers.py + group_sharded_stage3.py).
+# ZeRO-3-style fsdp (ref: mp_layers.py + group_sharded_stage3.py), spelled
+# in the canonical SpecLayout vocabulary (distributed.mesh.LAYOUT) so GPT,
+# BERT, the planner, and auto_parallel all speak one sharding language.
 PARTITION_RULES = (
-    (r"wte$", P("tp", "fsdp")),
-    (r"wpe$", P(None, "fsdp")),
-    (r"lm_head$", P("fsdp", "tp")),
-    (r"wqkv$", P("fsdp", "tp")),
-    (r"bqkv$", P("tp")),
-    (r"wo$", P("tp", "fsdp")),
-    (r"wup$", P("fsdp", "tp")),
-    (r"bup$", P("tp")),
-    (r"wdown$", P("tp", "fsdp")),
-    (r"(bo|bdown)$", P(None)),
-    (r"(ln1|ln2|lnf)_(scale|bias)$", P(None)),
+    (r"wte$", LAYOUT.vocab_embedding()),
+    (r"wpe$", LAYOUT.position_table()),
+    (r"lm_head$", LAYOUT.vocab_head()),
+    (r"wqkv$", LAYOUT.column()),
+    (r"bqkv$", LAYOUT.column_bias()),
+    (r"wo$", LAYOUT.row()),
+    (r"wup$", LAYOUT.column()),
+    (r"bup$", LAYOUT.column_bias()),
+    (r"wdown$", LAYOUT.row()),
+    (r"(bo|bdown)$", LAYOUT.row_bias()),
+    (r"(ln1|ln2|lnf)_(scale|bias)$", LAYOUT.norm()),
 ) + EXPERT_PARTITION_RULES
 
 
@@ -1114,40 +1132,92 @@ def build_train_step(model: GPT, optimizer, mesh: Optional[Mesh] = None,
     return jax.jit(step, **kw)
 
 
+def register_stacked_decay_mask(optimizer, template_blk, n_layers: int,
+                                name_of, entry: str):
+    """Resolve a name-keyed weight-decay mask against the block template
+    ONCE and broadcast it along the layer axis: leaf j of the stacked
+    block pytree gets an (L, 1, ...) float mask whose layer-l entry is
+    ``decay_fn(name_of(l, <param name>))`` — exactly the names the
+    per-layer state presents — registered on the optimizer under the
+    stacked ``entry`` (`AdamW.set_decay_mask`). Layer-varying decisions
+    are preserved (the mask has one row per layer); the common uniform
+    case folds into a broadcast at compile time. Shared by the GPT and
+    BERT stacked layouts."""
+    decay_fn = optimizer.apply_decay_param_fun
+    set_mask = getattr(optimizer, "set_decay_mask", None)
+    if set_mask is None:
+        raise ValueError(
+            f"optimizer {type(optimizer).__name__} sets "
+            "apply_decay_param_fun but has no set_decay_mask(); the "
+            "stacked layout needs the masked update path "
+            "(paddle_tpu.optimizer.AdamW)")
+    id2name = {id(v): n for n, v in template_blk.named_parameters()}
+    tleaves, treedef = jax.tree_util.tree_flatten(template_blk)
+    masks = []
+    for t in tleaves:
+        name = id2name.get(id(t), "")
+        col = [float(bool(decay_fn(name_of(i, name))))
+               for i in range(n_layers)]
+        masks.append(jnp.asarray(col, jnp.float32).reshape(
+            (n_layers,) + (1,) * t.ndim))
+    set_mask(entry, jax.tree_util.tree_unflatten(treedef, masks))
+
+
 def init_train_state(model: GPT, optimizer, mesh: Optional[Mesh] = None,
                      stacked: bool = False):
     """Params + optimizer state, sharded onto the mesh if given.
 
-    ``stacked=True`` (dense single-chip models only): block weights enter
-    the state PRE-stacked along a leading layer axis, under one
-    ``_stacked_blocks`` key that merge_params binds back onto the model.
-    The scan-over-layers forward then reads them directly — without this,
-    the in-trace ``stack_block_weights`` materializes a full copy of
-    every block weight inside the step (plus the stacked cotangent on the
-    way back), which pushed the 1.3B train step past 16GB HBM."""
+    ``stacked=True`` (dense models): block weights enter the state
+    PRE-stacked along a leading layer axis, under one ``_stacked_blocks``
+    key that merge_params binds back onto the model. The scan-over-layers
+    forward then reads them directly — without this, the in-trace
+    ``stack_block_weights`` materializes a full copy of every block
+    weight inside the step (plus the stacked cotangent on the way back),
+    which pushed the 1.3B train step past 16GB HBM.
+
+    With a multi-device ``mesh`` the stacked leaves are placed by their
+    `stacked_block_specs` (PARTITION_RULES behind a replicated layer
+    axis, `LAYOUT.stacked`): the stacking jit emits them directly into
+    that layout via out_shardings, so the scan-over-layers fast path and
+    hybrid dp/fsdp/tp parallelism compose instead of excluding each
+    other. An ``apply_decay_param_fun`` decay mask is resolved against
+    the block template once and broadcast along the layer axis
+    (`AdamW.set_decay_mask`) — the name-keyed fn itself can't see into
+    the folded '_stacked_blocks' entry."""
     if stacked:
-        if mesh is not None and mesh.size > 1:
-            raise ValueError("stacked layout is the single-chip fast "
-                             "path; sharded meshes use the per-layer "
-                             "state")
         L = model.cfg.n_layers
         if any(model.blocks[i].moe is not None for i in range(L)):
             raise ValueError("MoE stacks are heterogeneous; stacked "
                              "layout needs a dense model")
-        if getattr(optimizer, "apply_decay_param_fun", None) is not None:
-            raise ValueError(
-                "apply_decay_param_fun masks decay by per-param NAME; the "
-                "stacked layout folds all block weights under one "
-                "'_stacked_blocks' entry, so the mask cannot resolve — "
-                "use the per-layer state (stacked=False) with it")
         params, _ = model.split_params()
-        # jnp.stack allocates fresh buffers, so donation in the train
-        # step never frees the module's own arrays
-        params = {k: jnp.copy(v) for k, v in params.items()
+        params = {k: v for k, v in params.items()
                   if not k.startswith("blocks.")}
-        params["_stacked_blocks"] = stack_block_weights(
-            [model.blocks[i] for i in range(L)])
-        return params, optimizer.init(params)
+        blocks = [model.blocks[i] for i in range(L)]
+        if getattr(optimizer, "apply_decay_param_fun", None) is not None:
+            register_stacked_decay_mask(
+                optimizer, model.blocks[0], L,
+                lambda i, name: f"blocks.item_{i}.{name}",
+                "_stacked_blocks")
+        if mesh is not None and mesh.size > 1:
+            params = shard_params(params, mesh)
+            tleaves, treedef, specs = stacked_block_specs(model.blocks[0])
+            sh_tree = jax.tree_util.tree_unflatten(
+                treedef, [NamedSharding(mesh, mesh_safe_spec(s, mesh))
+                          for s in specs])
+            # one jit stacks AND places: every stacked leaf lands sharded
+            # by its layer-leading spec — never materialized replicated —
+            # and its buffers are fresh, so step donation can't free the
+            # module's own arrays
+            params["_stacked_blocks"] = jax.jit(
+                stack_block_weights, out_shardings=sh_tree)(blocks)
+            opt_state = jax.jit(optimizer.init)(params)
+        else:
+            # jnp.stack allocates fresh buffers, so donation in the train
+            # step never frees the module's own arrays
+            params = {k: jnp.copy(v) for k, v in params.items()}
+            params["_stacked_blocks"] = stack_block_weights(blocks)
+            opt_state = optimizer.init(params)
+        return params, opt_state
     params, _ = model.split_params()
     if mesh is not None and mesh.size > 1:
         params = shard_params(params, mesh)
@@ -1522,9 +1592,8 @@ def _moe_block_with_aux(blk: GPTBlock, x):
 def pipeline_partition_spec(path: str, n_virtual: int = 1) -> P:
     """Partition spec for a stacked-block param: leading axes (S, lps) —
     or (V, S, lpg) for the interleaved stacking, where only S shards."""
-    base = partition_spec(path.split(".")[-1])
-    lead = ("pp", None) if n_virtual == 1 else (None, "pp", None)
-    return P(*(lead + tuple(base)))
+    return LAYOUT.pipeline_stacked(partition_spec(path.split(".")[-1]),
+                                   n_virtual)
 
 
 def build_pipelined_train_step(model: GPT, optimizer, mesh: Mesh,

@@ -208,6 +208,19 @@ class AdamW(Adam):
                          weight_decay=weight_decay, **kw)
         self._decoupled_wd = True
         self.apply_decay_param_fun = apply_decay_param_fun
+        # pre-resolved decay masks for params-dict entries whose value is
+        # a stacked pytree (per-param names cannot resolve into it):
+        # {entry name: pytree matching the entry, float 0/1 leaves
+        # broadcastable to the param} — see models.gpt's stacked layout
+        self._decay_masks = {}
+
+    def set_decay_mask(self, entry: str, mask):
+        """Register a pre-resolved decay mask for ``params[entry]`` (a
+        pytree structurally matching it, leaves 0/1 floats broadcastable
+        to each param — e.g. (L, 1, ...) along a stacked layer axis).
+        Used when ``apply_decay_param_fun`` is set but the entry folds
+        many named params into one pytree."""
+        self._decay_masks[entry] = mask
 
     def update(self, grads, state, params):
         step = state["step"] + 1
@@ -234,6 +247,18 @@ class AdamW(Adam):
             return new_p.astype(p.dtype), new_s
 
         if self.apply_decay_param_fun is not None and isinstance(params, dict):
+            is_pair = (lambda x: isinstance(x, tuple) and len(x) == 2
+                       and isinstance(x[0], jax.Array))
+
+            def upd_masked(p, g, s, mk):
+                # mk: 0/1 float broadcastable to p (e.g. (L, 1, ...) along
+                # a stacked layer axis) — the name mask resolved up front
+                new_p, new_s = Adam.update_param(
+                    self, p.astype(jnp.float32), g.astype(jnp.float32),
+                    s, lr, step)
+                new_p = new_p - lr * decay_term(p.astype(jnp.float32)) * mk
+                return new_p.astype(p.dtype), new_s
+
             def upd_named(name):
                 def f(p, g, s):
                     new_p, new_s = Adam.update_param(
@@ -246,8 +271,33 @@ class AdamW(Adam):
                 return f
             new_params, new_slots = {}, {}
             for name in params:
-                new_params[name], new_slots[name] = upd_named(name)(
-                    params[name], grads[name], state["slots"][name])
+                p, g, s = params[name], grads[name], state["slots"][name]
+                if not isinstance(p, jax.Array):
+                    # pytree-valued entry (stacked block weights): per-leaf
+                    # decay rides the registered mask. No mask means the
+                    # name fn CANNOT be honored (it can't see into the
+                    # folded entry) — fail loudly rather than silently
+                    # decaying leaves (LN scales, biases) the per-layer
+                    # state would exempt
+                    mask = self._decay_masks.get(name)
+                    if mask is None:
+                        raise ValueError(
+                            f"apply_decay_param_fun is set but params "
+                            f"entry {name!r} is a pytree with no "
+                            f"registered decay mask; resolve the mask "
+                            f"against the block template first "
+                            f"(set_decay_mask — init_train_state("
+                            f"stacked=True) does this)")
+                    out = tree_map(
+                        upd_masked, p, g, s, mask,
+                        is_leaf=lambda x: isinstance(x, jax.Array))
+                    new_params[name] = tree_map(lambda pr: pr[0], out,
+                                                is_leaf=is_pair)
+                    new_slots[name] = tree_map(lambda pr: pr[1], out,
+                                               is_leaf=is_pair)
+                else:
+                    new_params[name], new_slots[name] = upd_named(name)(
+                        p, g, s)
             return new_params, {"step": step, "slots": new_slots}
 
         out = tree_map(upd, params, grads, state["slots"])

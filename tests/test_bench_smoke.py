@@ -53,6 +53,14 @@ def test_bench_train_quant_comm_smoke():
     assert out.get("train_quant_comm_int8_wire_ratio", 0) >= 3.5, out
 
 
+def test_bench_train_sharded_stacked_smoke():
+    out = bench.bench_train_sharded_stacked(jax, jnp, PEAK, smoke=True)
+    assert out.get("train_sharded_stacked_per_layer_step_ms", 0) > 0, out
+    assert out.get("train_sharded_stacked_stacked_step_ms", 0) > 0, out
+    # fixed-seed parity: stacked is the SAME program, just pre-stacked
+    assert abs(out.get("train_sharded_stacked_loss_delta", 1)) < 1e-4, out
+
+
 def test_bench_bert_smoke():
     out = bench.bench_bert(jax, jnp, PEAK, smoke=True)
     assert out["bert_base_tokens_per_sec_per_chip"] > 0
@@ -92,6 +100,7 @@ def test_bench_nonsmoke_cpu_guards():
     assert bench.bench_ppyoloe(jax, jnp, PEAK) == {}
     assert bench.bench_pp(jax, jnp, PEAK) == {}
     assert bench.bench_longctx(jax, jnp, PEAK) == {}
+    assert bench.bench_train_sharded_stacked(jax, jnp, PEAK) == {}
 
 
 def test_split_params_contract():

@@ -513,14 +513,16 @@ def test_stacked_train_state_matches_plain():
                          max_len=16)
     np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
 
-    # guardrails: MoE stacks and name-masked decay refuse the layout
+    # guardrail: MoE stacks are heterogeneous and refuse the layout
     moe_cfg = gpt.GPTConfig(vocab_size=64, max_seq_len=8, d_model=16,
                             n_layers=2, n_heads=2, dtype=jnp.float32,
                             moe_experts=2)
     with pytest.raises(ValueError, match="dense"):
         gpt.init_train_state(gpt.GPT(moe_cfg, seed=0), optim.AdamW(),
                              stacked=True)
-    with pytest.raises(ValueError, match="apply_decay_param_fun"):
-        gpt.init_train_state(
-            model, optim.AdamW(apply_decay_param_fun=lambda n: True),
-            stacked=True)
+    # apply_decay_param_fun no longer refuses: the mask is resolved
+    # against the block template and broadcast along the layer axis
+    # (parity-tested in tests/test_sharded_stacked.py)
+    opt = optim.AdamW(apply_decay_param_fun=lambda n: True)
+    params, _ = gpt.init_train_state(model, opt, stacked=True)
+    assert "_stacked_blocks" in opt._decay_masks

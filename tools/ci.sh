@@ -31,6 +31,12 @@
 #                          convergence parity vs fp32, ≥3.5x bytes_wire
 #                          cut, stage-3 gather tolerance, and the
 #                          bitflipped-scale fail-loud guard
+#   tools/ci.sh shard      sharded-stacked smoke: 4-device CPU mesh runs
+#                          the pre-stacked scan-over-layers train step
+#                          under fsdp×tp (loss parity vs per-layer,
+#                          stacked leaves provably sharded) plus the
+#                          stacked↔per-layer checkpoint-reshard round
+#                          trips — tier-1 fast
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -68,6 +74,16 @@ if [[ "${1:-}" == "comm" ]]; then
     shift
     # comm_smoke forces its own 2-device host platform before importing jax
     exec python tools/comm_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "shard" ]]; then
+    shift
+    # the acceptance topology: a 4-device host-platform mesh (the tests
+    # carve their meshes from devices[:4], so the tier-1 8-device run
+    # exercises the same paths)
+    export XLA_FLAGS="--xla_force_host_platform_device_count=4"
+    exec python -m pytest tests/test_sharded_stacked.py \
+        tests/test_reshard.py -q -p no:cacheprovider "$@"
 fi
 
 # lint gate runs BEFORE the test shards: a host-sync or env-contract

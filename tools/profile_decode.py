@@ -34,6 +34,18 @@ from paddle_tpu.inference.decode_engine import (
     DecodeEngine, decode_roofline_tokens_per_sec)
 
 
+def release_engine(eng):
+    """Drop an engine's big device buffers — the donor weight stack and
+    whichever KV pool attributes the engine variant carries — so the next
+    engine built in this process doesn't OOM against the last one's
+    arrays. The ONE definition (was copy-pasted at three sites): tolerant
+    of attrs a variant lacks and of the sharded stacked state (a pytree
+    of per-device arrays nulls the same way a single-chip stack does)."""
+    for attr in ("kc", "vc", "kp", "vp", "_stacked"):
+        if hasattr(eng, attr):
+            setattr(eng, attr, None)
+
+
 def pipeline_report(eng):
     """ISSUE 4: in-flight depth, per-step host gap, and dispatch/harvest
     overlap, measured from the trace ring + stats histograms of the run
@@ -100,7 +112,7 @@ def run_engine(model, slots=8, s_pf=128, n_new=128, chunk=64, spec_k=0,
     rep = pipeline_report(eng)
     trace.disable()
     trace.clear()
-    eng.kc = eng.vc = eng._stacked = None
+    release_engine(eng)
     del eng
     return toks / dt, dispatches, rep
 
@@ -155,7 +167,7 @@ def run_paged(model, prompts, n_new=128, chunk=64, inflight=None,
     }
     trace.disable()
     trace.clear()
-    eng.kp = eng.vp = eng._stacked = None
+    release_engine(eng)
     del eng
     return toks / dt, dispatches, rep, pfx
 
@@ -218,7 +230,7 @@ def prefix_sweep(model, slots, shared_len, tail_len, n_new, chunk):
         f"warm shared-prefix round hit only {warm} tokens "
         f"(expected >= {slots * page}): prefix cache regressed")
     assert warm > cold, "warm round should out-hit the cold round"
-    eng.kp = eng.vp = eng._stacked = None
+    release_engine(eng)
     del eng
 
 
