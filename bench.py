@@ -266,9 +266,12 @@ def main():
     # train_quant_comm runs LAST: on multi-device backends its three
     # fp32/int8/fp8 trials are not cheap, and the decode/longctx
     # headline rows must not lose their budget to it
+    # bench_serve runs after the decode/longctx headline rows: its four
+    # warmup-compiled engines are not cheap, and a tight budget must
+    # truncate the NEW row, not the established ladder
     for sub in (bench_bert, bench_resnet50, bench_ppyoloe, bench_pp,
-                bench_decode, bench_longctx, bench_train_sharded_stacked,
-                bench_train_quant_comm):
+                bench_decode, bench_longctx, bench_serve,
+                bench_train_sharded_stacked, bench_train_quant_comm):
         name = sub.__name__.replace("bench_", "")
         if only and name not in only:
             continue
@@ -964,6 +967,111 @@ def bench_decode(jax, jnp, peak, smoke=False):
             res["decode_spec_vs_roofline"] = round(toks2 / sdt / roof, 4)
     except Exception as e:
         res["decode_spec_error"] = str(e)[:160]
+    return res
+
+
+def bench_serve(jax, jnp, peak, smoke=False):
+    """SLO serving ladder (BENCH_SERVE, ISSUE 10): deterministic
+    Poisson load through the continuous-batching FRONT-END
+    (paddle_tpu/serving/) at a ladder of offered QPS fractions of the
+    engine's measured capacity. Per rung: p50/p99 TTFT, p99 TPOT,
+    goodput (tokens/s from in-deadline completions), completion
+    fraction, and mean batch occupancy — at sub-saturation the
+    occupancy floor is the "scheduler keeps the pipeline fed, not
+    trickling singletons" check (asserted in test_bench_smoke and
+    tools/ci.sh front). The workload is pinned by
+    PT_SERVE_LOADGEN_SEED, so rungs are comparable across rounds."""
+    if jax.default_backend() in ("cpu",) and not smoke:
+        return {}
+    from paddle_tpu import stats as _stats
+    from paddle_tpu.inference.decode_engine import DecodeEngine
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import FrontEnd, loadgen
+
+    if smoke:
+        cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=128, d_model=32,
+                            n_layers=2, n_heads=4, dtype=jnp.float32)
+        slots, n_req, chunk = 4, 32, 2
+        prompt_len, new_tokens = (4, 24), (8, 16)
+    else:
+        cfg = gpt.gpt3_125m(max_seq_len=1024)
+        slots, n_req, chunk = 8, 64, 16
+        prompt_len, new_tokens = (16, 192), (16, 96)
+    model = gpt.GPT(cfg, seed=0)
+    max_len = prompt_len[1] + new_tokens[1] + 8
+    seed = loadgen.default_seed()
+
+    def make_frontend():
+        eng = DecodeEngine(model, max_slots=slots, max_len=max_len,
+                           steps_per_call=chunk, warmup=True)
+        return FrontEnd(eng)
+
+    res = {"serve_slots": slots, "serve_requests_per_rung": n_req,
+           "serve_loadgen_seed": seed}
+
+    # capacity probe (closed loop, all slots busy): the QPS ladder is
+    # expressed as fractions of THIS, so the rungs stay meaningful
+    # across hardware and model sizes
+    _stats.reset("serve/")
+    fe = make_frontend()
+    probe = loadgen.poisson_trace(
+        n_req, qps=1e9, seed=seed, vocab=cfg.vocab_size,
+        prompt_len=prompt_len, new_tokens=new_tokens)
+    t0 = time.perf_counter()
+    for a in probe:      # qps=1e9 -> all arrivals due immediately
+        fe.submit(a.prompt, max_new_tokens=a.max_new_tokens)
+    fe.run()
+    dt = time.perf_counter() - t0
+    toks = sum(len(r.tokens) for r in fe.results())
+    cap_tps = toks / dt
+    cap_rps = n_req / dt
+    res["serve_capacity_tokens_per_sec"] = round(cap_tps, 1)
+    res["serve_capacity_rps"] = round(cap_rps, 2)
+
+    # sub25/sub75 are BELOW capacity (the SLO-relevant regime: latency
+    # should stay flat); over2x sustains a backlog, where a scheduler
+    # that feeds the pipeline shows near-full batches and one that
+    # trickles singletons shows ~1/slots occupancy
+    for label, frac in (("sub25", 0.25), ("sub75", 0.75),
+                        ("over2x", 2.0)):
+        qps = max(0.1, frac * cap_rps)
+        trace = loadgen.poisson_trace(
+            n_req, qps=qps, seed=seed, vocab=cfg.vocab_size,
+            prompt_len=prompt_len, new_tokens=new_tokens)
+        _stats.reset("serve/")
+        fe = make_frontend()
+        t0 = time.perf_counter()
+        reqs = loadgen.replay(
+            trace,
+            submit=lambda a: fe.submit(a.prompt,
+                                       max_new_tokens=a.max_new_tokens,
+                                       deadline_s=a.deadline_s),
+            pump=fe.step)
+        fe.run()
+        wall = time.perf_counter() - t0
+        snap = _stats.snapshot("serve/")
+        done = [r for r in reqs if r.status == "done"]
+        good_toks = sum(len(r.tokens) for r in done)
+        occ_n = snap.get("serve/batch_occupancy.count", 0)
+        pfx = f"serve_{label}"
+        res[f"{pfx}_offered_qps"] = round(qps, 2)
+        res[f"{pfx}_p50_ttft_ms"] = round(
+            snap.get("serve/ttft_s.p50", 0) * 1e3, 2)
+        res[f"{pfx}_p99_ttft_ms"] = round(
+            snap.get("serve/ttft_s.p99", 0) * 1e3, 2)
+        res[f"{pfx}_p99_tpot_ms"] = round(
+            snap.get("serve/tpot_s.p99", 0) * 1e3, 2)
+        res[f"{pfx}_goodput_tokens_per_sec"] = round(good_toks / wall, 1)
+        res[f"{pfx}_completed_frac"] = round(len(done) / n_req, 4)
+        res[f"{pfx}_occupancy_mean"] = round(
+            snap.get("serve/batch_occupancy.sum", 0) / occ_n, 4) \
+            if occ_n else 0.0
+        fed_n = snap.get("serve/fed_occupancy.count", 0)
+        res[f"{pfx}_fed_occupancy_mean"] = round(
+            snap.get("serve/fed_occupancy.sum", 0) / fed_n, 4) \
+            if fed_n else None
+        res[f"{pfx}_backfills"] = int(
+            _stats.get("serve/queue_backfill", 0))
     return res
 
 

@@ -1,0 +1,98 @@
+"""Serving-replica membership over the native TCPStore.
+
+The serving router (``paddle_tpu/serving/router.py``) needs a lighter
+contract than elastic training membership (``elastic.py``): replicas
+never form a collective — they only need to be *discoverable* (the
+router learns who exists), *describable* (slots, pid, endpoint), and
+*judgeable* (alive or dead, so queued work can be redistributed).
+
+The store has no key-listing op, so announcements go through a counter
+index: ``announce`` bumps ``<ns>/n`` and writes ``<ns>/idx/<i>`` →
+replica id, plus ``<ns>/meta/<rid>`` with the JSON metadata. Liveness
+follows the elastic idiom (ADVICE r1): heartbeats are monotonically
+increasing counters (``store.add``), and a peer is dead when its
+counter stops *progressing* against the OBSERVER's local clock — wall
+clocks never cross the wire, so clock skew cannot fabricate a death.
+"""
+
+import json
+import time
+from typing import Dict, Optional
+
+__all__ = ["ReplicaDirectory"]
+
+
+class ReplicaDirectory:
+    """Announce/discover/judge serving replicas on a shared TCPStore.
+
+    One instance per process; the router polls :meth:`members` +
+    :meth:`alive`, each replica calls :meth:`announce` once and
+    :meth:`heartbeat` from its serve loop.
+    """
+
+    def __init__(self, store, namespace: str = "serve"):
+        self.store = store
+        self.ns = namespace
+        # observer-local liveness state: rid -> (last counter, local
+        # monotonic time that counter last advanced)
+        self._seen: Dict[str, tuple] = {}
+
+    # -- replica side -------------------------------------------------------
+
+    def announce(self, rid: str, meta: Optional[dict] = None):
+        """Register ``rid`` (idempotent for re-announce: metadata is
+        overwritten, the index gains at most one extra pointer)."""
+        self.store.set(f"{self.ns}/meta/{rid}",
+                       json.dumps(meta or {}))
+        i = self.store.add(f"{self.ns}/n", 1)
+        self.store.set(f"{self.ns}/idx/{i}", rid)
+        self.heartbeat(rid)
+
+    def heartbeat(self, rid: str) -> int:
+        return self.store.add(f"{self.ns}/hb/{rid}", 1)
+
+    # -- observer side ------------------------------------------------------
+
+    def members(self) -> Dict[str, dict]:
+        """Every replica ever announced (dead ones included — liveness
+        is :meth:`alive`'s call), rid -> metadata."""
+        from paddle_tpu import native
+        try:
+            n = native.decode_counter(
+                self.store.get(f"{self.ns}/n", timeout=0.05))
+        except (TimeoutError, ValueError):
+            return {}
+        out: Dict[str, dict] = {}
+        for i in range(1, n + 1):
+            try:
+                rid = self.store.get(f"{self.ns}/idx/{i}",
+                                     timeout=0.2).decode()
+                out[rid] = json.loads(
+                    self.store.get(f"{self.ns}/meta/{rid}", timeout=0.2))
+            except (TimeoutError, ValueError):
+                continue
+        return out
+
+    def _counter(self, rid: str) -> Optional[int]:
+        from paddle_tpu import native
+        try:
+            return native.decode_counter(
+                self.store.get(f"{self.ns}/hb/{rid}", timeout=0.2))
+        except (TimeoutError, ValueError):
+            return None
+
+    def alive(self, rid: str, dead_after: float = 2.0) -> bool:
+        """True while ``rid``'s heartbeat counter keeps advancing;
+        False once it stalls for ``dead_after`` seconds of THIS
+        process's monotonic clock. A transient store-read failure never
+        flips a previously-progressing replica dead by itself — only
+        ``dead_after`` seconds without observed progress does."""
+        now = time.monotonic()
+        c = self._counter(rid)
+        prev = self._seen.get(rid)
+        if c is None and prev is None:
+            return False            # never seen a heartbeat at all
+        if prev is None or (c is not None and c != prev[0]):
+            self._seen[rid] = (c, now)
+            return True
+        return now - prev[1] <= dead_after

@@ -239,6 +239,20 @@ declare_env("PT_SERVE_INFLIGHT", "Decode-engine pipeline depth: how many "
 declare_env("PT_SERVE_PREFILL_TOKENS", "Per-step prompt-token budget for "
             "interleaved chunked prefill (0 = largest bucket).",
             default="0", owner="inference/decode_engine.py")
+declare_env("PT_SERVE_QUEUE_DEPTH", "Serving front-end admission-queue "
+            "bound: submissions beyond this many waiting requests are "
+            "rejected (serve/queue_rejects) instead of queued.",
+            default="256", owner="serving/scheduler.py")
+declare_env("PT_SERVE_ADMISSION", "Front-end admission-queue ordering "
+            "policy: fifo (arrival), priority (higher priority= first), "
+            "edf (earliest absolute deadline first).",
+            default="priority", owner="serving/scheduler.py")
+declare_env("PT_SERVE_ROUTER_PORT", "TCPStore port for the multi-"
+            "replica router's control plane (membership, mailboxes, "
+            "results).", default="8997", owner="serving/router.py")
+declare_env("PT_SERVE_LOADGEN_SEED", "Deterministic load-generator "
+            "seed — one knob pinning the exact SLO-bench/CI workload.",
+            default="0", owner="serving/loadgen.py")
 declare_env("PT_PAGED_FUSED", "0 disables the fused append+attend paged "
             "decode kernel, restoring the read-only-pool + one-scatter-"
             "per-token formulation (the parity reference).", default="1",

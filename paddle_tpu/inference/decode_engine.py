@@ -199,7 +199,39 @@ class ResilientScheduler:
     Also the shared serving-observability surface (docs/observability.md
     ``serve/*``): per-request TTFT and lifetime, per-step queue depth and
     batch occupancy, per-token latency — the numbers a serving operator
-    scrapes to answer "what is p99 TTFT and are we admission-bound"."""
+    scrapes to answer "what is p99 TTFT and are we admission-bound".
+
+    Service hooks (the continuous-batching front-end in
+    ``paddle_tpu/serving/scheduler.py`` installs these; docs/serving.md
+    "Front-end"):
+
+    - ``on_token(req, token)`` — called the moment a harvested token is
+      appended to ``req.tokens`` (streaming APIs fan tokens out from
+      here; token order matches the request's stream exactly).
+    - ``on_retire(req)`` — called exactly once when a request leaves
+      the engine (retired, deadline-evicted, or poison-evicted; check
+      ``req.error``). Fires from inside ``step()``'s harvest, i.e. the
+      moment the slot frees — a front-end backfills the empty slot
+      here so the next dispatch is never under-occupied.
+    - ``bucket_policy(engine, remaining)`` — overrides prefill bucket
+      selection (DecodeEngine's chunked prefill): return a bucket size
+      from ``engine.buckets`` for a prefill chunk covering
+      ``remaining`` prompt tokens. None keeps the built-in choice
+      (smallest covering bucket)."""
+
+    on_token = None
+    on_retire = None
+    bucket_policy = None
+
+    @property
+    def free_slots(self) -> int:
+        """Slots with no request bound (admission capacity right now)."""
+        return sum(r is None for r in self._slot_req)
+
+    @property
+    def queued(self) -> int:
+        """Requests submitted but not yet assigned a slot."""
+        return len(self._waiting)
 
     def _on_evict(self, slot: int):
         self.active = self.active.at[slot].set(False)
@@ -379,15 +411,26 @@ class ResilientScheduler:
 
     def _obs_request_end(self, req: Request):
         """Request left the engine (done or evicted): close its span —
-        an after-the-fact submit→now interval on the rank timeline.
-        Idempotent: eviction and retirement may both see the request."""
+        an after-the-fact submit→now interval on the rank timeline —
+        and record its TPOT (decode-phase per-token latency, the SLO
+        bench's second axis next to TTFT). Idempotent: eviction and
+        retirement may both see the request. The ``on_retire`` service
+        hook fires here (same exactly-once guard)."""
+        import time
+        from paddle_tpu import stats
         from paddle_tpu.observability import trace
         if req._obs_ended:
             return
         req._obs_ended = True
+        if req.t_first is not None and len(req.tokens) > 1:
+            stats.observe("serve/tpot_s",
+                          (time.perf_counter() - req.t_first)
+                          / (len(req.tokens) - 1))
         trace.complete("serve/request", req.t_submit,
                        prompt=len(req.prompt), tokens=len(req.tokens),
                        error=req.error)
+        if self.on_retire is not None:
+            self.on_retire(req)
 
     def _obs_step(self, t0: float, emitted: int, live: int):
         """Per-step serving telemetry: queue depth / batch occupancy
@@ -413,7 +456,11 @@ class ResilientScheduler:
         for req in [r for r in self._waiting
                     if r.deadline is not None and now > r.deadline]:
             self._waiting.remove(req)
-            self._fail(req, "deadline exceeded while queued")
+            # distinct from the mid-decode counter: a queue reject
+            # wasted no device work, an eviction abandoned some — the
+            # admission-control dashboards must tell them apart
+            self._fail(req, "deadline exceeded while queued",
+                       stat="serve/queue_deadline_rejects")
         if any(req is not None and req.deadline is not None
                and now > req.deadline for req in self._slot_req):
             self._drain()
@@ -479,6 +526,7 @@ class DecodeEngine(ResilientScheduler):
             buckets = [b for b in (16, 32, 64, 128, 256, 512)
                        if b <= self.T] or [self.T]
         self.buckets = sorted(set(int(b) for b in buckets))
+        self._bucket_set = set(self.buckets)
         if self.buckets[-1] > self.T:
             raise ValueError(
                 f"bucket {self.buckets[-1]} exceeds cache length {self.T}")
@@ -945,6 +993,23 @@ class DecodeEngine(ResilientScheduler):
 
     # -- scheduler ----------------------------------------------------------
 
+    def check_request(self, prompt_len: int, max_new_tokens: int):
+        """Admission feasibility check WITHOUT enqueueing (the serving
+        front-end rejects infeasible requests at its API edge instead
+        of surfacing the error from a later pump). Raises ValueError."""
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if prompt_len + max_new_tokens > self.T:
+            raise ValueError(
+                f"{prompt_len} prompt + {max_new_tokens} new tokens "
+                f"exceed cache length {self.T}")
+        if self.spec_k and (prompt_len + max_new_tokens
+                            + self.spec_k - 1 > self.T):
+            raise ValueError(
+                f"speculative window: prompt + new + K-1 "
+                f"({prompt_len}+{max_new_tokens}+{self.spec_k - 1}) "
+                f"exceed cache length {self.T}")
+
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None) -> Request:
@@ -953,18 +1018,7 @@ class DecodeEngine(ResilientScheduler):
         batch keeps serving its peers."""
         import time
         prompt = list(np.asarray(prompt).reshape(-1))
-        if not prompt:
-            raise ValueError("empty prompt")
-        if len(prompt) + max_new_tokens > self.T:
-            raise ValueError(
-                f"{len(prompt)} prompt + {max_new_tokens} new tokens "
-                f"exceed cache length {self.T}")
-        if self.spec_k and (len(prompt) + max_new_tokens
-                            + self.spec_k - 1 > self.T):
-            raise ValueError(
-                f"speculative window: prompt + new + K-1 "
-                f"({len(prompt)}+{max_new_tokens}+{self.spec_k - 1}) "
-                f"exceed cache length {self.T}")
+        self.check_request(len(prompt), max_new_tokens)
         req = Request(prompt, max_new_tokens, eos_id,
                       deadline=(None if deadline_s is None
                                 else time.monotonic() + deadline_s))
@@ -1009,8 +1063,15 @@ class DecodeEngine(ResilientScheduler):
         prompt, start = job["prompt"], job["start"]
         total = len(prompt)
         remaining = total - start
-        bucket = next((x for x in self.buckets if x >= remaining),
-                      self.buckets[-1])
+        if self.bucket_policy is not None:
+            bucket = int(self.bucket_policy(self, remaining))
+            if bucket not in self._bucket_set:
+                raise ValueError(
+                    f"bucket_policy returned {bucket}, not one of "
+                    f"{self.buckets}")
+        else:
+            bucket = next((x for x in self.buckets if x >= remaining),
+                          self.buckets[-1])
         s0 = start
         if s0 + bucket > self.T:
             # tail window would overrun the cache: slide it back over
@@ -1067,6 +1128,8 @@ class DecodeEngine(ResilientScheduler):
     def _emit(self, slot: int, req: Request, token: int):
         req.tokens.append(token)
         self._obs_first_token(req)
+        if self.on_token is not None:
+            self.on_token(req, token)
         hit_eos = req.eos_id is not None and token == req.eos_id
         if hit_eos or len(req.tokens) >= req.max_new_tokens:
             req.done = True
@@ -1174,7 +1237,11 @@ class DecodeEngine(ResilientScheduler):
         return total
 
     def _apply_token(self, slot: int, req: Request, token: int):
+        # the FIRST generated token always rides a 'prefill' record
+        # (_emit), so TTFT needs no check here — only the stream hook
         req.tokens.append(token)
+        if self.on_token is not None:
+            self.on_token(req, token)
 
     def _after_replay(self, rec):
         self._retire_done(rec.live)

@@ -655,20 +655,24 @@ class PagedDecodeEngine(ResilientScheduler):
 
     # -- scheduler ----------------------------------------------------------
 
+    def check_request(self, prompt_len: int, max_new_tokens: int):
+        """Admission feasibility (see DecodeEngine.check_request)."""
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if prompt_len > self.buckets[-1]:
+            raise ValueError(
+                f"paged prefill caps prompts at {self.buckets[-1]} "
+                f"tokens (got {prompt_len}); use DecodeEngine for "
+                f"longer prompts")
+        if prompt_len + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError("prompt + new tokens exceed max_seq_len")
+
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_id: Optional[int] = None,
                deadline_s: Optional[float] = None) -> Request:
         import time
         prompt = list(np.asarray(prompt).reshape(-1))
-        if not prompt:
-            raise ValueError("empty prompt")
-        if len(prompt) > self.buckets[-1]:
-            raise ValueError(
-                f"paged prefill caps prompts at {self.buckets[-1]} "
-                f"tokens (got {len(prompt)}); use DecodeEngine for "
-                f"longer prompts")
-        if len(prompt) + max_new_tokens > self.cfg.max_seq_len:
-            raise ValueError("prompt + new tokens exceed max_seq_len")
+        self.check_request(len(prompt), max_new_tokens)
         req = Request(prompt, max_new_tokens, eos_id,
                       deadline=(None if deadline_s is None
                                 else time.monotonic() + deadline_s))
@@ -864,6 +868,8 @@ class PagedDecodeEngine(ResilientScheduler):
     def _emit(self, slot: int, req: Request, token: int):
         req.tokens.append(token)
         self._obs_first_token(req)
+        if self.on_token is not None:
+            self.on_token(req, token)
         if ((req.eos_id is not None and token == req.eos_id)
                 or len(req.tokens) >= req.max_new_tokens):
             req.done = True

@@ -1,0 +1,18 @@
+"""Continuous-batching serving front-end (docs/serving.md "Front-end").
+
+The service layer over the decode engines: admission-controlled
+scheduling with deadline-aware queueing and slot backfill
+(``scheduler.FrontEnd``), deterministic Poisson/trace load generation
+(``loadgen``), and multi-replica routing over TCPStore membership
+(``router.Router`` / ``router.serve_replica``).
+"""
+
+from paddle_tpu.serving.scheduler import (FrontEnd, ServeRequest,
+                                          dynamic_bucket, projected_ttft)
+from paddle_tpu.serving.loadgen import (Arrival, poisson_trace,
+                                        from_trace, replay)
+from paddle_tpu.serving.router import Router, serve_replica, router_port
+
+__all__ = ["FrontEnd", "ServeRequest", "dynamic_bucket",
+           "projected_ttft", "Arrival", "poisson_trace", "from_trace",
+           "replay", "Router", "serve_replica", "router_port"]

@@ -1,0 +1,102 @@
+"""Deterministic load generation for the serving front-end.
+
+Two sources, one shape: a list of :class:`Arrival` records (arrival
+offset in seconds, prompt, decode budget, optional deadline/priority).
+
+- :func:`poisson_trace` — synthetic open-loop Poisson traffic at an
+  offered QPS: exponential inter-arrivals, uniform prompt/decode
+  lengths, all drawn from ONE seeded ``RandomState`` so a (seed, qps,
+  n) triple is bit-reproducible across processes and rounds — the SLO
+  bench's ladder rows and the CI smoke replay the identical workload.
+- :func:`from_trace` — trace-driven replay of recorded traffic
+  (dicts with ``t``/``prompt``/``max_new_tokens``...), for feeding
+  production request logs through the scheduler.
+
+:func:`replay` paces the arrivals against the wall clock in open-loop
+style (a late server does NOT slow the generator down — that would
+hide queueing collapse, the thing an SLO bench exists to show) and
+keeps the front-end pumping while it waits.
+"""
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Arrival", "poisson_trace", "from_trace", "replay",
+           "default_seed"]
+
+
+def default_seed() -> int:
+    """The load-generator seed (``PT_SERVE_LOADGEN_SEED``): one knob so
+    bench rows and CI smokes pin the exact same workload."""
+    return int(os.environ.get("PT_SERVE_LOADGEN_SEED", "0"))
+
+
+@dataclass
+class Arrival:
+    t: float                    # seconds after replay start
+    prompt: List[int]
+    max_new_tokens: int
+    deadline_s: Optional[float] = None
+    priority: int = 0
+
+
+def poisson_trace(n: int, qps: float, seed: Optional[int] = None,
+                  vocab: int = 96, prompt_len=(4, 48),
+                  new_tokens=(4, 24),
+                  deadline_s: Optional[float] = None) -> List[Arrival]:
+    """``n`` arrivals at offered rate ``qps`` (exponential
+    inter-arrival gaps), prompts/budgets uniform over the given
+    ``[lo, hi]`` ranges. Deterministic in (seed, n, qps, ranges)."""
+    if n < 1 or qps <= 0:
+        raise ValueError(f"need n >= 1 arrivals at qps > 0, "
+                         f"got n={n} qps={qps}")
+    rs = np.random.RandomState(default_seed() if seed is None else seed)
+    gaps = rs.exponential(1.0 / qps, size=n)
+    gaps[0] = 0.0               # first request lands at t=0
+    times = np.cumsum(gaps)
+    out = []
+    for i in range(n):
+        plen = int(rs.randint(prompt_len[0], prompt_len[1] + 1))
+        out.append(Arrival(
+            t=float(times[i]),
+            prompt=[int(x) for x in rs.randint(0, vocab, size=plen)],
+            max_new_tokens=int(rs.randint(new_tokens[0],
+                                          new_tokens[1] + 1)),
+            deadline_s=deadline_s))
+    return out
+
+
+def from_trace(rows: Sequence[dict]) -> List[Arrival]:
+    """Trace-driven arrivals from recorded rows (``t`` seconds,
+    ``prompt``, ``max_new_tokens``, optional ``deadline_s`` /
+    ``priority``), sorted by arrival time."""
+    out = [Arrival(t=float(r["t"]), prompt=list(r["prompt"]),
+                   max_new_tokens=int(r["max_new_tokens"]),
+                   deadline_s=r.get("deadline_s"),
+                   priority=int(r.get("priority", 0)))
+           for r in rows]
+    return sorted(out, key=lambda a: a.t)
+
+
+def replay(arrivals: Sequence[Arrival], submit: Callable,
+           pump: Optional[Callable] = None, speed: float = 1.0) -> list:
+    """Open-loop replay: submit each arrival at its wall-clock offset
+    (scaled by ``speed``; 2.0 = twice as fast), calling ``pump()``
+    (typically ``frontend.step``) while waiting so the server keeps
+    serving between arrivals. Returns the ``submit`` results in arrival
+    order. Draining after the last arrival is the caller's job."""
+    handles = []
+    t0 = time.perf_counter()
+    for a in arrivals:
+        due = a.t / speed
+        while time.perf_counter() - t0 < due:
+            if pump is not None:
+                pump()
+            else:
+                time.sleep(0.001)
+        handles.append(submit(a))
+    return handles

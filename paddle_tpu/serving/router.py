@@ -1,0 +1,307 @@
+"""Multi-replica request router over TCPStore membership.
+
+One serving *replica* = one process (spawned like any other worker:
+``python -m paddle_tpu.distributed.launch --nproc_per_node 1 replica.py``
+per replica, or any orchestrator) running a decode engine behind a
+:class:`~paddle_tpu.serving.scheduler.FrontEnd` and
+:func:`serve_replica`. The :class:`Router` lives in the API-facing
+process, hosts the TCPStore control plane (``PT_SERVE_ROUTER_PORT``),
+and moves requests with **least-outstanding-requests** placement.
+
+Wire protocol (all JSON on the shared store; the store lives in the
+router process, so results survive any replica's death):
+
+- mailbox: router bumps ``serve/mbox_n/<rid>`` and writes
+  ``serve/mbox/<rid>/<i>``; the replica consumes indices it hasn't
+  seen. Append-only + monotonic counters — no delete/list ops needed.
+- results: replica writes ``serve/done/<req_id>`` once the request is
+  terminal (tokens or error); the router polls outstanding ids.
+- membership: ``distributed/membership.ReplicaDirectory`` (announce +
+  counter heartbeats). A replica whose heartbeat stalls is dead; every
+  outstanding request assigned to it is **redistributed** to the
+  least-loaded survivor (``serve/router_redistributed``). A request
+  the dead replica already finished is not re-sent (its done key
+  persists); a request it was mid-decode on re-executes elsewhere —
+  at-least-once, first result wins, so no request id is ever lost.
+"""
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from paddle_tpu.distributed.membership import ReplicaDirectory
+
+__all__ = ["Router", "serve_replica", "router_port"]
+
+
+def router_port() -> int:
+    """The router control-plane TCPStore port
+    (``PT_SERVE_ROUTER_PORT``)."""
+    return int(os.environ.get("PT_SERVE_ROUTER_PORT", "8997"))
+
+
+class Router:
+    """Client-side router: owns the store, places requests, accounts
+    for every request id until a result lands.
+
+        router = Router()                  # hosts the store
+        ... spawn replica processes (they connect back) ...
+        rid = router.wait_replicas(2)
+        req_id = router.submit(prompt, max_new_tokens=16)
+        results = router.drain(timeout=60)  # req_id -> result dict
+    """
+
+    def __init__(self, store=None, host: str = "127.0.0.1",
+                 port: Optional[int] = None, dead_after: float = 2.0):
+        if store is None:
+            from paddle_tpu import native
+            store = native.TCPStore(
+                host, port if port is not None else router_port(),
+                is_master=True)
+            self._owns_store = True
+        else:
+            self._owns_store = False
+        self.store = store
+        self.directory = ReplicaDirectory(store)
+        self.dead_after = float(dead_after)
+        self._seq = 0
+        self._payload: Dict[str, dict] = {}      # req_id -> request json
+        self._assigned: Dict[str, str] = {}      # req_id -> replica id
+        self._outstanding: Dict[str, int] = {}   # rid -> open requests
+        self.results: Dict[str, dict] = {}       # req_id -> result json
+        self._done_cursor: Dict[str, int] = {}   # rid -> done idx read
+        # replicas whose current death has already been swept — NOT a
+        # permanent blacklist: a false-positive death (heartbeat stalled
+        # by host load, then resumed) re-earns routing eligibility the
+        # moment the counter progresses again; the extra redistribution
+        # is harmless (at-least-once, first result wins)
+        self._swept = set()
+
+    # -- membership ---------------------------------------------------------
+
+    def replicas(self) -> List[str]:
+        """Alive replicas, least-outstanding first."""
+        alive = [rid for rid in self.directory.members()
+                 if self.directory.alive(rid, self.dead_after)]
+        return sorted(alive,
+                      key=lambda r: (self._outstanding.get(r, 0), r))
+
+    def wait_replicas(self, n: int, timeout: float = 60.0) -> List[str]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = self.replicas()
+            if len(got) >= n:
+                return got
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"only {len(self.replicas())}/{n} replicas announced "
+            f"within {timeout}s")
+
+    # -- placement ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> str:
+        from paddle_tpu import stats
+        self._seq += 1
+        req_id = f"rq-{self._seq:06d}"
+        self._payload[req_id] = {
+            "id": req_id, "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens), "eos_id": eos_id,
+            "deadline_s": deadline_s, "priority": int(priority)}
+        self._place(req_id)
+        stats.add("serve/router_requests")
+        return req_id
+
+    def _place(self, req_id: str, wait_s: float = 2.0):
+        alive = self.replicas()
+        deadline = time.monotonic() + wait_s
+        while not alive and time.monotonic() < deadline:
+            # a transient liveness blip (or replicas still announcing)
+            # must not fail a submit outright
+            time.sleep(0.05)
+            alive = self.replicas()
+        if not alive:
+            raise RuntimeError("no alive replicas to route to")
+        rid = alive[0]                   # least outstanding
+        i = self.store.add(f"serve/mbox_n/{rid}", 1)
+        self.store.set(f"serve/mbox/{rid}/{i}",
+                       json.dumps(self._payload[req_id]))
+        self._assigned[req_id] = rid
+        self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
+        from paddle_tpu import stats
+        stats.set_value("serve/router_outstanding",
+                        sum(self._outstanding.values()))
+
+    # -- completion / fault handling ----------------------------------------
+
+    def poll(self) -> Dict[str, dict]:
+        """Collect newly landed results; returns the new ones. Cost is
+        one counter read per KNOWN replica (not one blocking probe per
+        outstanding request): each replica appends completions to its
+        done index (see ``_publish``), and the router fetches only the
+        entries beyond its per-replica cursor."""
+        from paddle_tpu import native, stats
+        fresh = {}
+        for rid in self.directory.members():
+            try:
+                n = native.decode_counter(
+                    self.store.get(f"serve/done_n/{rid}", timeout=0.02))
+            except (TimeoutError, ValueError):
+                continue
+            cursor = self._done_cursor.get(rid, 0)
+            while cursor < n:
+                cursor += 1
+                try:
+                    req_id = self.store.get(
+                        f"serve/done_idx/{rid}/{cursor}",
+                        timeout=1.0).decode()
+                    raw = self.store.get(f"serve/done/{req_id}",
+                                         timeout=1.0)
+                except TimeoutError:
+                    cursor -= 1    # index mid-write; retry next poll
+                    break
+                if req_id in self.results or req_id not in self._payload:
+                    continue       # duplicate completion / foreign key
+                res = json.loads(raw)
+                self.results[req_id] = res
+                fresh[req_id] = res
+                owner = self._assigned.get(req_id)
+                if owner is not None:
+                    self._outstanding[owner] = max(
+                        0, self._outstanding.get(owner, 0) - 1)
+            self._done_cursor[rid] = cursor
+        if fresh:
+            stats.set_value("serve/router_outstanding",
+                            sum(self._outstanding.values()))
+        return fresh
+
+    def check_replicas(self):
+        """Death sweep: redistribute every unfinished request assigned
+        to a replica whose heartbeat stalled. Each death is swept once;
+        a replica whose heartbeat resumes becomes routable again."""
+        from paddle_tpu import stats
+        for rid in list(self.directory.members()):
+            if self.directory.alive(rid, self.dead_after):
+                self._swept.discard(rid)
+                continue
+            if rid in self._swept:
+                continue
+            self._swept.add(rid)
+            self._outstanding.pop(rid, None)
+            orphans = [q for q, r in self._assigned.items()
+                       if r == rid and q not in self.results]
+            for req_id in orphans:
+                self._place(req_id)
+            if orphans:
+                stats.add("serve/router_redistributed", len(orphans))
+
+    def drain(self, timeout: float = 120.0) -> Dict[str, dict]:
+        """Block until every submitted request has a result (or
+        ``timeout``); death sweeps run throughout, so replicas may die
+        mid-drain and the work still completes elsewhere."""
+        deadline = time.monotonic() + timeout
+        while len(self.results) < len(self._payload):
+            if time.monotonic() > deadline:
+                missing = sorted(set(self._payload) - set(self.results))
+                raise TimeoutError(
+                    f"{len(missing)} requests unfinished after "
+                    f"{timeout}s: {missing[:8]}")
+            self.poll()
+            self.check_replicas()
+        return dict(self.results)
+
+    def shutdown(self):
+        """Ask every replica loop to exit (they finish in-flight work
+        first), then release the store if this router owns it."""
+        try:
+            self.store.set("serve/shutdown", "1")
+        except Exception:
+            pass
+
+    def close(self):
+        if self._owns_store:
+            self.store.close()
+
+
+def _publish(store, rid: str, req_id: str, result: dict):
+    """Write one terminal result AND append it to the replica's done
+    index (``serve/done_n/<rid>`` counter + ``serve/done_idx/<rid>/<i>``
+    -> req_id) — the same counter idiom as the mailbox, so the router
+    learns of completions from one counter read per replica instead of
+    one blocking probe per outstanding request."""
+    store.set(f"serve/done/{req_id}", json.dumps(result))
+    i = store.add(f"serve/done_n/{rid}", 1)
+    store.set(f"serve/done_idx/{rid}/{i}", req_id)
+
+
+def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
+                  max_idle_s: Optional[float] = None):
+    """One replica's serve loop: announce, then consume the mailbox,
+    pump the front-end, publish terminal results, heartbeat — until
+    the shutdown key appears (or ``max_idle_s`` with nothing to do).
+
+    ``frontend`` is a :class:`~paddle_tpu.serving.scheduler.FrontEnd`;
+    all admission policy (deadline rejection, backfill, streaming)
+    applies per-replica exactly as single-process serving.
+    """
+    directory = ReplicaDirectory(store)
+    directory.announce(rid, {"pid": os.getpid(),
+                             "slots": frontend.engine.S})
+    seen = 0
+    open_reqs: Dict[str, object] = {}
+    idle_since = time.monotonic()
+    while True:
+        directory.heartbeat(rid)
+        try:
+            store.get("serve/shutdown", timeout=0.001)
+            if not open_reqs and not frontend.busy:
+                return
+        except TimeoutError:
+            pass
+        # mailbox: consume any indices the router appended
+        try:
+            from paddle_tpu import native
+            n = native.decode_counter(
+                store.get(f"serve/mbox_n/{rid}", timeout=0.001))
+        except (TimeoutError, ValueError):
+            n = seen
+        while seen < n:
+            seen += 1
+            msg = json.loads(store.get(f"serve/mbox/{rid}/{seen}",
+                                       timeout=5.0))
+            try:
+                req = frontend.submit(
+                    msg["prompt"], max_new_tokens=msg["max_new_tokens"],
+                    eos_id=msg["eos_id"], deadline_s=msg["deadline_s"],
+                    priority=msg["priority"], req_id=msg["id"])
+            except ValueError as e:
+                # an infeasible request (too long for this engine's
+                # cache, empty prompt) must fail AS A RESULT, never
+                # kill the replica: an uncaught raise here would die,
+                # the router would redistribute the same poison payload
+                # to the next replica, and one bad client request would
+                # cascade through the whole fleet
+                _publish(store, rid, msg["id"], {
+                    "id": msg["id"], "tokens": [],
+                    "status": "rejected-invalid", "error": str(e),
+                    "replica": rid})
+                continue
+            open_reqs[msg["id"]] = req
+        if frontend.busy:
+            frontend.step()
+            idle_since = time.monotonic()
+        else:
+            if (max_idle_s is not None
+                    and time.monotonic() - idle_since > max_idle_s):
+                return
+            time.sleep(poll_s)
+        for req_id, req in list(open_reqs.items()):
+            if req.done:
+                _publish(store, rid, req_id, {
+                    "id": req_id, "tokens": list(req.tokens),
+                    "status": req.status, "error": req.error,
+                    "replica": rid})
+                del open_reqs[req_id]

@@ -1,0 +1,389 @@
+"""Continuous-batching serving front-end: the service layer over the
+decode engines (ROADMAP open item 2, ISSUE 10).
+
+The engines (``inference/decode_engine.py``, ``paged_engine.py``) are
+fast *mechanisms*: slot-based continuous batching, pipelined dispatch,
+deadline/poison eviction. They have no *policy* — their admission queue
+is an unbounded FIFO, so chip occupancy under live traffic is whatever
+order callers happen to ``submit()`` in. ``FrontEnd`` adds the policy
+half a real serving deployment needs:
+
+- **Admission control.** A bounded queue (``PT_SERVE_QUEUE_DEPTH``)
+  with a pluggable ordering policy (``PT_SERVE_ADMISSION``:
+  ``fifo`` / ``priority`` / ``edf`` earliest-deadline-first). Queue
+  wait counts against the request's ``deadline_s``; a request that
+  expires while queued — or whose remaining headroom is already below
+  the engine's observed time-to-first-token — is REJECTED at admission
+  (``rejected-deadline`` status, ``serve/queue_deadline_rejects`` /
+  ``serve/queue_hopeless_rejects``) instead of occupying a slot it can
+  only be evicted from mid-decode. Rejection costs zero device work;
+  eviction abandons a prefill.
+- **Slot backfill.** The engine's ``on_retire`` hook fires from inside
+  the harvest the moment a slot frees; the front-end immediately moves
+  the best queued request into the engine (``serve/queue_backfill``),
+  so the next dispatch is never under-occupied while work waits.
+- **Dynamic bucket selection.** Under load the front-end overrides the
+  engine's prefill bucket choice with :func:`dynamic_bucket`: the
+  bucket minimizing the admission's *projected TTFT* given current
+  occupancy (idle engine → cost is padded prefill work + per-dispatch
+  overhead; busy engine → every extra prefill chunk also puts one more
+  interleaved decode dispatch in the TTFT path, shifting the optimum
+  toward fewer, larger chunks).
+- **Streaming.** ``submit(...).stream()`` iterates tokens as harvests
+  apply them (fed from the engine's ``on_token`` hook). Greedy streams
+  are byte-identical to a direct ``engine.submit()`` + ``run()`` — the
+  front-end only reorders admissions, never per-slot math.
+
+Single-threaded by design, like the engines: callers pump ``step()``
+(or ``run()``, or iterate a stream, which pumps internally). The
+multi-replica layer lives in ``serving/router.py``.
+"""
+
+import math
+import os
+import time
+from typing import Iterator, List, Optional
+
+__all__ = ["FrontEnd", "ServeRequest", "dynamic_bucket",
+           "projected_ttft"]
+
+# terminal statuses a ServeRequest can reach
+_TERMINAL = ("done", "failed", "rejected-queue-full", "rejected-deadline")
+
+
+class ServeRequest:
+    """One request's front-end lifecycle. Status transitions::
+
+        queued -> admitted -> done | failed
+        queued -> rejected-queue-full | rejected-deadline
+
+    ``rejected-*`` means the request never reached a prefill (no device
+    work); ``failed`` means the engine evicted it after admission
+    (deadline mid-decode, non-finite logits) — ``error`` says which.
+    """
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "priority",
+                 "deadline", "t_submit", "seq", "status", "error",
+                 "engine_req", "_buf", "_fe")
+
+    def __init__(self, req_id, prompt, max_new_tokens, eos_id, priority,
+                 deadline, seq, fe):
+        self.id = req_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.priority = priority
+        self.deadline = deadline          # absolute time.monotonic()
+        self.t_submit = time.perf_counter()
+        self.seq = seq
+        self.status = "queued"
+        self.error: Optional[str] = None
+        self.engine_req = None            # inference Request once admitted
+        self._buf: List[int] = []         # stream buffer (harvest order)
+        self._fe = fe
+
+    @property
+    def tokens(self) -> List[int]:
+        return self._buf
+
+    @property
+    def done(self) -> bool:
+        return self.status in _TERMINAL
+
+    @property
+    def failed(self) -> bool:
+        return self.done and self.status != "done"
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        r = self.engine_req
+        return None if r is None else r.ttft_s
+
+    def stream(self) -> Iterator[int]:
+        """Iterate generated tokens in harvest order, pumping the
+        front-end while waiting. Ends when the request completes (or is
+        rejected/evicted — check ``failed``/``error`` afterwards)."""
+        i = 0
+        while True:
+            while i < len(self._buf):
+                yield self._buf[i]
+                i += 1
+            if self.done:
+                # a terminal request's tokens are fully applied (retire
+                # happens at harvest, after the replay loop) — but the
+                # buffer may have grown between the check and now
+                while i < len(self._buf):
+                    yield self._buf[i]
+                    i += 1
+                return
+            self._fe.step()
+
+
+def projected_ttft(engine, remaining: int, bucket: int,
+                   alpha: float = 2e-3, beta: float = 2e-5) -> float:
+    """Analytic TTFT projection for prefilling ``remaining`` prompt
+    tokens in ``bucket``-sized chunks on ``engine`` at its CURRENT
+    occupancy. ``alpha`` is the per-dispatch overhead, ``beta`` the
+    per-token compute cost; only their ratio shapes the argmin, so the
+    defaults need no per-hardware calibration.
+
+    cost = chunks * (alpha + bucket * beta)            # padded prefill
+         + steps  * (alpha + live * chunk * beta)      # interleaved
+                                                       # decode (if any)
+
+    where ``steps`` is how many engine steps the chunks spread over
+    under the per-step prefill token budget — each step a live engine
+    also dispatches one decode chunk into the TTFT path.
+    """
+    chunks = max(1, math.ceil(remaining / bucket))
+    # the paged engine has no chunked-prefill budget (its prefill is
+    # one dispatch and it never consults bucket_policy) — treat it as
+    # one-bucket-per-step if projected directly
+    budget = getattr(engine, "_prefill_budget", engine.buckets[-1])
+    per_step = max(1, budget // bucket)
+    steps = math.ceil(chunks / per_step)
+    live = engine.S - engine.free_slots
+    cost = chunks * (alpha + bucket * beta)
+    if live > 0:
+        cost += steps * (alpha + live * engine.chunk * beta)
+    return cost
+
+
+def dynamic_bucket(engine, remaining: int) -> int:
+    """``engine.bucket_policy`` minimizing :func:`projected_ttft` over
+    the engine's bucket set (ties to the smaller bucket — less padding,
+    shorter peer stall)."""
+    return min(engine.buckets,
+               key=lambda b: (projected_ttft(engine, remaining, b), b))
+
+
+def _queue_depth_default() -> int:
+    return int(os.environ.get("PT_SERVE_QUEUE_DEPTH", "256"))
+
+
+def _admission_default() -> str:
+    return os.environ.get("PT_SERVE_ADMISSION", "priority")
+
+
+class FrontEnd:
+    """Admission control + backfill + streaming over ONE engine.
+
+        fe = FrontEnd(DecodeEngine(model, ...))
+        r = fe.submit(prompt, max_new_tokens=64, deadline_s=2.0)
+        for tok in r.stream():
+            ...
+
+    ``admission``: ``fifo`` (arrival order), ``priority`` (higher
+    ``priority=`` first, then arrival), ``edf`` (earliest absolute
+    deadline first, deadline-less last). ``hopeless_factor`` scales the
+    observed-TTFT bar a deadline must clear at admission (0 disables
+    hopeless rejection; expiry rejection always applies).
+    ``admit_ahead`` extra requests are staged into the engine's own
+    queue beyond visible free slots so admission never waits a step.
+    """
+
+    def __init__(self, engine, queue_depth: Optional[int] = None,
+                 admission: Optional[str] = None,
+                 hopeless_factor: float = 1.0, admit_ahead: int = 1,
+                 dynamic_buckets: bool = True):
+        self.engine = engine
+        self.queue_depth = (queue_depth if queue_depth is not None
+                            else _queue_depth_default())
+        self.admission = admission or _admission_default()
+        if self.admission not in ("fifo", "priority", "edf"):
+            raise ValueError(
+                f"admission policy must be fifo|priority|edf, "
+                f"got {self.admission!r}")
+        self.hopeless_factor = float(hopeless_factor)
+        self.admit_ahead = int(admit_ahead)
+        self._queue: List[ServeRequest] = []
+        self._all: List[ServeRequest] = []
+        self._by_engine_req = {}        # id(engine Request) -> ServeRequest
+        self._seq = 0
+        self._ttft_ema: Optional[float] = None
+        engine.on_token = self._on_token
+        engine.on_retire = self._on_retire
+        if dynamic_buckets and engine.bucket_policy is None:
+            engine.bucket_policy = dynamic_bucket
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None, priority: int = 0,
+               req_id: Optional[str] = None) -> ServeRequest:
+        from paddle_tpu import stats
+        prompt = [int(t) for t in prompt]
+        # infeasible requests fail HERE, not from a later pump
+        self.engine.check_request(len(prompt), int(max_new_tokens))
+        self._seq += 1
+        req = ServeRequest(
+            req_id or f"req-{self._seq:06d}", prompt,
+            int(max_new_tokens), eos_id, int(priority),
+            (None if deadline_s is None
+             else time.monotonic() + float(deadline_s)),
+            self._seq, self)
+        self._all.append(req)
+        if len(self._queue) >= self.queue_depth:
+            req.status = "rejected-queue-full"
+            req.error = (f"admission queue full "
+                         f"({self.queue_depth} waiting)")
+            stats.add("serve/queue_rejects")
+            return req
+        self._queue.append(req)
+        stats.set_value("serve/queue_len", len(self._queue))
+        return req
+
+    # -- engine hooks -------------------------------------------------------
+
+    def _on_token(self, ereq, token: int):
+        sreq = self._by_engine_req.get(id(ereq))
+        if sreq is not None:
+            sreq._buf.append(token)
+
+    def _on_retire(self, ereq):
+        """Engine-side request end (fires from inside the harvest):
+        finalize the front-end record, fold its TTFT into the hopeless-
+        rejection estimate, and BACKFILL the freed slot from the queue
+        at once — the next dispatch must not run under-occupied while
+        admissible work waits."""
+        from paddle_tpu import stats
+        sreq = self._by_engine_req.pop(id(ereq), None)
+        if sreq is not None:
+            if ereq.error is None:
+                sreq.status = "done"
+            else:
+                # a request staged ahead into the engine's own queue
+                # that expired THERE is still a queue reject (the
+                # engine counted it on the queue-reject counter)
+                sreq.status = ("rejected-deadline"
+                               if "while queued" in ereq.error
+                               else "failed")
+                sreq.error = ereq.error
+            if ereq.ttft_s is not None:
+                self._ttft_ema = (
+                    ereq.ttft_s if self._ttft_ema is None
+                    else 0.8 * self._ttft_ema + 0.2 * ereq.ttft_s)
+        if self._queue and self._feed() > 0:
+            stats.add("serve/queue_backfill")
+
+    # -- admission ----------------------------------------------------------
+
+    def _order_key(self, r: ServeRequest):
+        if self.admission == "priority":
+            return (-r.priority, r.seq)
+        if self.admission == "edf":
+            return (r.deadline if r.deadline is not None else math.inf,
+                    r.seq)
+        return (r.seq,)
+
+    def _reject(self, req: ServeRequest, reason: str, stat: str):
+        from paddle_tpu import stats
+        req.status = "rejected-deadline"
+        req.error = reason
+        stats.add(stat)
+
+    def _admissible(self, req: ServeRequest) -> bool:
+        """Deadline screen at the queue->engine boundary: queue wait
+        already spent counts against the budget, and a budget below the
+        engine's observed TTFT (EMA) is hopeless — reject it here, for
+        free, instead of letting the engine evict it mid-decode."""
+        if req.deadline is None:
+            return True
+        headroom = req.deadline - time.monotonic()
+        if headroom <= 0:
+            self._reject(req, "deadline exceeded while queued",
+                         "serve/queue_deadline_rejects")
+            return False
+        if (self.hopeless_factor > 0 and self._ttft_ema is not None
+                and headroom < self.hopeless_factor * self._ttft_ema):
+            self._reject(
+                req, f"deadline hopeless at admission: "
+                     f"{headroom * 1e3:.0f}ms budget vs "
+                     f"~{self._ttft_ema * 1e3:.0f}ms observed TTFT",
+                "serve/queue_hopeless_rejects")
+            return False
+        return True
+
+    def _feed(self, capacity: Optional[int] = None) -> int:
+        """Move the best queued requests into the engine while it has
+        room (free slots plus ``admit_ahead`` staged). Returns how many
+        were admitted."""
+        from paddle_tpu import stats
+        eng = self.engine
+        if capacity is None:
+            capacity = (eng.free_slots + self.admit_ahead - eng.queued)
+        admitted = 0
+        # one sort per feed: the ordering keys (priority / absolute
+        # deadline / arrival seq) are immutable while queued
+        self._queue.sort(key=self._order_key)
+        while capacity > 0 and self._queue:
+            req = self._queue.pop(0)
+            if not self._admissible(req):
+                continue
+            ereq = eng.submit(
+                req.prompt, max_new_tokens=req.max_new_tokens,
+                eos_id=req.eos_id,
+                deadline_s=(None if req.deadline is None
+                            else req.deadline - time.monotonic()))
+            # TTFT must count the front-end queue wait: re-anchor the
+            # engine request's clock to the front-end submission
+            ereq.t_submit = req.t_submit
+            req.engine_req = ereq
+            req.status = "admitted"
+            self._by_engine_req[id(ereq)] = req
+            stats.observe("serve/queue_wait_s",
+                          time.perf_counter() - req.t_submit)
+            capacity -= 1
+            admitted += 1
+        stats.set_value("serve/queue_len", len(self._queue))
+        return admitted
+
+    def _sweep_expired(self):
+        """Reject queued requests whose deadline passed — they must
+        never reach a prefill."""
+        now = time.monotonic()
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now > r.deadline]
+        for req in expired:
+            self._queue.remove(req)
+            self._reject(req, "deadline exceeded while queued",
+                         "serve/queue_deadline_rejects")
+
+    # -- pump ---------------------------------------------------------------
+
+    def step(self) -> int:
+        """One service iteration: reject expired queue entries, feed
+        free capacity, advance the engine one step (which may backfill
+        more via ``on_retire``). Returns tokens applied this call.
+
+        ``serve/fed_occupancy`` samples batch occupancy only on steps
+        whose demand exceeded the free slots — exactly the steps where
+        a scheduler that trickles singletons (no backfill, serial
+        admission) diverges from one that keeps the pipeline fed
+        (stays near 1.0 minus the lag-one backfill step)."""
+        from paddle_tpu import stats
+        self._sweep_expired()
+        self._feed()
+        eng = self.engine
+        backlogged = (len(self._queue) + eng.queued) > eng.free_slots
+        n = eng.step()
+        if backlogged:
+            stats.observe("serve/fed_occupancy",
+                          (eng.S - eng.free_slots) / eng.S)
+        return n
+
+    @property
+    def busy(self) -> bool:
+        eng = self.engine
+        return bool(self._queue or eng.queued
+                    or eng.free_slots < eng.S or eng._pending)
+
+    def run(self) -> None:
+        """Serve until every submitted request is terminal."""
+        while self.busy:
+            self.step()
+        self.engine.drain()
+
+    def results(self) -> List[ServeRequest]:
+        return list(self._all)

@@ -1,0 +1,46 @@
+"""Serving-replica worker for test_serve_router (and the front smoke's
+router phase): one process = one replica, spawned through the real
+``distributed/launch.py`` CLI. Pins the CPU platform at module level —
+the launcher imports this before any jax backend initializes.
+
+Usage (as the launch CLI's training script):
+    python -m paddle_tpu.distributed.launch --nproc_per_node 1 \
+        tests/_serve_worker.py STORE_PORT REPLICA_ID [MAX_NEW_CAP]
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# one replica needs one device; conftest's 8-virtual-device XLA_FLAGS
+# would leak in through the environment and slow startup
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    port = int(sys.argv[1])
+    rid = sys.argv[2]
+    import jax.numpy as jnp
+    from paddle_tpu import native
+    from paddle_tpu.models import gpt
+    from paddle_tpu.inference.decode_engine import DecodeEngine
+    from paddle_tpu.serving import FrontEnd, serve_replica
+
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=128, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    store = native.TCPStore("127.0.0.1", port)
+    fe = FrontEnd(DecodeEngine(model, max_slots=2, max_len=96))
+    try:
+        serve_replica(store, rid, fe, max_idle_s=120.0)
+    finally:
+        store.close()
+
+
+if __name__ == "__main__":
+    main()

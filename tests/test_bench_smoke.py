@@ -43,6 +43,29 @@ def test_bench_decode_smoke():
     assert out.get("decode_spec_tokens_per_step", 0) > 0, out
 
 
+def test_bench_serve_smoke():
+    """BENCH_SERVE ladder (ISSUE 10): the deterministic load generator
+    must drive the front-end through every rung, and at sub-saturation
+    QPS the scheduler must keep the pipeline fed (fed-occupancy well
+    above the 1/slots trickling-singletons floor)."""
+    out = bench.bench_serve(jax, jnp, PEAK, smoke=True)
+    assert out.get("serve_capacity_tokens_per_sec", 0) > 0, out
+    for rung in ("sub25", "sub75", "over2x"):
+        assert out.get(f"serve_{rung}_p99_ttft_ms", 0) > 0, (rung, out)
+        assert out.get(f"serve_{rung}_goodput_tokens_per_sec", 0) > 0, \
+            (rung, out)
+        assert out.get(f"serve_{rung}_completed_frac", 0) == 1.0, \
+            (rung, out)
+    # sub-saturation occupancy floor: when demand exceeded free slots,
+    # slots were actually filled (trickling singletons would sit at
+    # 1/slots = 0.25 here)
+    fed = out.get("serve_sub75_fed_occupancy_mean")
+    assert fed is not None and fed >= 0.5, out
+    assert out.get("serve_over2x_fed_occupancy_mean", 0) >= 0.5, out
+    # sustained backlog must trigger retire-time backfill
+    assert out.get("serve_over2x_backfills", 0) > 0, out
+
+
 def test_bench_train_quant_comm_smoke():
     out = bench.bench_train_quant_comm(jax, jnp, PEAK, smoke=True)
     assert out.get("train_quant_comm_fp32_step_ms", 0) > 0, out

@@ -1,0 +1,276 @@
+"""Serving front-end (paddle_tpu/serving, ISSUE 10): admission
+control, deadline semantics under queue wait, backfill, streaming
+bit-identity, dynamic bucket selection, and the deterministic load
+generator."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu import stats
+from paddle_tpu.models import gpt
+from paddle_tpu.inference.decode_engine import DecodeEngine
+from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+from paddle_tpu.serving import (FrontEnd, dynamic_bucket, loadgen,
+                                projected_ttft)
+
+
+def _model():
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=256, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    return gpt.GPT(cfg, seed=0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model()
+
+
+def _prompts(n, seed=0, lo=3, hi=30):
+    rs = np.random.RandomState(seed)
+    return [list(rs.randint(0, 96, size=int(rs.randint(lo, hi))))
+            for _ in range(n)]
+
+
+ENGINES = {
+    "plain": lambda m: DecodeEngine(m, max_slots=2, max_len=96),
+    "chunked": lambda m: DecodeEngine(m, max_slots=2, max_len=96,
+                                      steps_per_call=4),
+    "speculative": lambda m: DecodeEngine(m, max_slots=2, max_len=96,
+                                          speculative_k=3,
+                                          steps_per_call=2),
+    "paged": lambda m: PagedDecodeEngine(m, n_pages=24, max_slots=2,
+                                         steps_per_call=2),
+}
+
+
+@pytest.mark.parametrize("path", list(ENGINES))
+def test_stream_bit_identity_vs_direct_submit(model, path):
+    """Acceptance: greedy token streams THROUGH the scheduler are
+    byte-identical to direct submit()+run() on every engine path —
+    with more requests than slots, so queueing and backfill are
+    actually exercised."""
+    prompts = _prompts(6, seed=1)
+    direct = ENGINES[path](model)
+    refs = [direct.submit(p, max_new_tokens=8) for p in prompts]
+    direct.run()
+    ref_tokens = [list(r.tokens) for r in refs]
+
+    stats.reset("serve/")
+    fe = FrontEnd(ENGINES[path](model))
+    reqs = [fe.submit(p, max_new_tokens=8) for p in prompts]
+    fe.run()
+    assert [list(r.tokens) for r in reqs] == ref_tokens
+    assert all(r.status == "done" for r in reqs)
+    # 6 requests through 2 slots: retirements must have backfilled
+    assert stats.get("serve/queue_backfill") > 0
+
+
+def test_streaming_iterator_matches_final_tokens(model):
+    prompts = _prompts(3, seed=2)
+    direct = DecodeEngine(model, max_slots=2, max_len=96)
+    refs = [direct.submit(p, max_new_tokens=8) for p in prompts]
+    direct.run()
+
+    fe = FrontEnd(DecodeEngine(model, max_slots=2, max_len=96))
+    reqs = [fe.submit(p, max_new_tokens=8) for p in prompts]
+    # iterate the LAST submitted request first: streaming must pump the
+    # whole front-end (its peers finish too)
+    streamed = list(reqs[-1].stream())
+    assert streamed == list(refs[-1].tokens)
+    fe.run()
+    for got, ref in zip(reqs, refs):
+        assert list(got.tokens) == list(ref.tokens)
+
+
+def test_queued_deadline_rejected_before_prefill(model):
+    """Satellite: a request whose short deadline expires while queued
+    is rejected with a DISTINCT status, never reaches a prefill, and
+    lands on the queue-reject counter — not the eviction counter."""
+    stats.reset("serve/")
+    eng = DecodeEngine(model, max_slots=1, max_len=96)
+    fe = FrontEnd(eng, admit_ahead=0)
+    blocker = fe.submit(_prompts(1, seed=3)[0], max_new_tokens=12)
+    doomed = fe.submit(_prompts(1, seed=4)[0], max_new_tokens=12,
+                       deadline_s=1e-4)
+    time.sleep(0.01)
+    fe.run()
+    assert blocker.status == "done"
+    assert doomed.status == "rejected-deadline"
+    assert "while queued" in doomed.error
+    assert doomed.engine_req is None          # never admitted
+    assert doomed.tokens == []
+    assert stats.get("serve/queue_deadline_rejects") == 1
+    assert stats.get("serve/deadline_evictions") == 0
+
+
+def test_mid_decode_eviction_keeps_distinct_counter(model):
+    """The OTHER side of the satellite: a deadline passing after
+    admission is an eviction (device work abandoned), not a queue
+    reject."""
+    stats.reset("serve/")
+    eng = DecodeEngine(model, max_slots=1, max_len=160)
+    fe = FrontEnd(eng)
+    r = fe.submit(_prompts(1, seed=5)[0], max_new_tokens=120,
+                  deadline_s=0.05)
+    fe.step()                  # admitted and decoding
+    time.sleep(0.08)
+    fe.run()
+    assert r.status == "failed"
+    assert "deadline" in r.error and "queued" not in r.error
+    assert stats.get("serve/deadline_evictions") == 1
+    assert stats.get("serve/queue_deadline_rejects") == 0
+
+
+def test_queue_full_rejects_at_submit(model):
+    stats.reset("serve/")
+    fe = FrontEnd(DecodeEngine(model, max_slots=1, max_len=96),
+                  queue_depth=2)
+    reqs = [fe.submit([5, 6, 7], max_new_tokens=4) for _ in range(4)]
+    rejected = [r for r in reqs if r.status == "rejected-queue-full"]
+    # first fills the queue head... depth 2 bounds the WAITING set
+    assert len(rejected) >= 1
+    assert stats.get("serve/queue_rejects") == len(rejected)
+    fe.run()
+    for r in reqs:
+        if r not in rejected:
+            assert r.status == "done"
+
+
+def test_hopeless_deadline_rejected_at_admission(model):
+    """Tentpole: once the front-end has observed real TTFTs, a queued
+    request whose remaining budget can't plausibly reach a first token
+    is rejected at admission instead of admitted-then-evicted."""
+    stats.reset("serve/")
+    fe = FrontEnd(DecodeEngine(model, max_slots=1, max_len=96))
+    warm = fe.submit(_prompts(1, seed=6)[0], max_new_tokens=6)
+    fe.run()
+    assert warm.status == "done" and fe._ttft_ema is not None
+    hopeless = fe.submit(_prompts(1, seed=7)[0], max_new_tokens=6,
+                         deadline_s=fe._ttft_ema / 1e3)
+    fe.run()
+    assert hopeless.status == "rejected-deadline"
+    assert "hopeless" in hopeless.error
+    assert stats.get("serve/queue_hopeless_rejects") == 1
+    assert stats.get("serve/deadline_evictions") == 0
+
+
+def test_priority_admission_order(model):
+    """Priority policy: with one slot, a later high-priority request
+    is admitted before an earlier low-priority one."""
+    eng = DecodeEngine(model, max_slots=1, max_len=96)
+    fe = FrontEnd(eng, admission="priority", admit_ahead=0)
+    blocker = fe.submit([1, 2, 3], max_new_tokens=6)
+    low = fe.submit([4, 5, 6], max_new_tokens=4, priority=0)
+    high = fe.submit([7, 8, 9], max_new_tokens=4, priority=5)
+    fe.run()
+    assert all(r.status == "done" for r in (blocker, low, high))
+    assert high.engine_req.t_first < low.engine_req.t_first
+
+
+def test_edf_admission_order(model):
+    eng = DecodeEngine(model, max_slots=1, max_len=96)
+    fe = FrontEnd(eng, admission="edf", admit_ahead=0)
+    blocker = fe.submit([1, 2, 3], max_new_tokens=6)
+    late = fe.submit([4, 5, 6], max_new_tokens=4, deadline_s=60.0)
+    soon = fe.submit([7, 8, 9], max_new_tokens=4, deadline_s=30.0)
+    fe.run()
+    assert all(r.status == "done" for r in (blocker, late, soon))
+    assert soon.engine_req.t_first < late.engine_req.t_first
+
+
+def test_invalid_request_fails_at_submit(model):
+    fe = FrontEnd(DecodeEngine(model, max_slots=1, max_len=64))
+    assert fe.engine.T == 128        # 128-multiple rounding
+    with pytest.raises(ValueError):
+        fe.submit([3] * 120, max_new_tokens=32)
+    with pytest.raises(ValueError):
+        fe.submit([], max_new_tokens=4)
+
+
+def test_fed_occupancy_under_backlog(model):
+    """With a standing backlog the scheduler must keep slots full:
+    fed-occupancy (sampled only on demand>free steps) well above the
+    1/slots trickling floor."""
+    stats.reset("serve/")
+    fe = FrontEnd(DecodeEngine(model, max_slots=4, max_len=96))
+    reqs = [fe.submit(p, max_new_tokens=10) for p in _prompts(16, seed=8)]
+    fe.run()
+    assert all(r.status == "done" for r in reqs)
+    snap = stats.snapshot("serve/")
+    n = snap.get("serve/fed_occupancy.count", 0)
+    assert n > 0
+    mean = snap.get("serve/fed_occupancy.sum", 0) / n
+    assert mean >= 0.5, mean
+    assert stats.get("serve/queue_backfill") > 0
+    # queue wait was actually measured
+    assert snap.get("serve/queue_wait_s.count", 0) == 16
+
+
+# -- dynamic bucket selection ----------------------------------------------
+
+def test_dynamic_bucket_idle_picks_covering_bucket(model):
+    eng = DecodeEngine(model, max_slots=4, max_len=256)
+    assert eng.free_slots == 4
+    # idle: a small prompt takes its smallest covering bucket (one
+    # chunk, least padding)
+    for remaining, want in ((5, 16), (17, 32), (120, 128)):
+        assert dynamic_bucket(eng, remaining) == want
+
+
+def test_dynamic_bucket_monotonic_under_load(model):
+    """Occupancy shifts the optimum toward fewer/larger chunks, never
+    smaller: every interleaved decode dispatch rides the TTFT path."""
+    eng = DecodeEngine(model, max_slots=8, max_len=256,
+                       steps_per_call=8)
+    idle_choice = dynamic_bucket(eng, 200)
+    # simulate 7 live slots (free_slots counts None entries)
+    eng._slot_req = [object()] * 7 + [None]
+    busy_choice = dynamic_bucket(eng, 200)
+    assert busy_choice >= idle_choice
+    # the projection itself must charge busy engines more
+    assert (projected_ttft(eng, 200, idle_choice)
+            > 0)
+    eng._slot_req = [None] * 8
+
+
+def test_bucket_policy_validated(model):
+    eng = DecodeEngine(model, max_slots=1, max_len=96)
+    eng.bucket_policy = lambda e, r: 13          # not a bucket
+    eng.submit([1, 2, 3], max_new_tokens=2)
+    with pytest.raises(ValueError):
+        eng.step()
+
+
+# -- load generator ---------------------------------------------------------
+
+def test_poisson_trace_deterministic():
+    a = loadgen.poisson_trace(20, qps=50.0, seed=7)
+    b = loadgen.poisson_trace(20, qps=50.0, seed=7)
+    assert [(x.t, x.prompt, x.max_new_tokens) for x in a] \
+        == [(y.t, y.prompt, y.max_new_tokens) for y in b]
+    c = loadgen.poisson_trace(20, qps=50.0, seed=8)
+    assert [x.prompt for x in a] != [y.prompt for y in c]
+    assert a[0].t == 0.0
+    assert all(y.t >= x.t for x, y in zip(a, a[1:]))
+
+
+def test_from_trace_sorts_and_replays(model):
+    rows = [{"t": 0.02, "prompt": [4, 5], "max_new_tokens": 3},
+            {"t": 0.0, "prompt": [1, 2, 3], "max_new_tokens": 4,
+             "priority": 1}]
+    arrivals = loadgen.from_trace(rows)
+    assert [a.t for a in arrivals] == [0.0, 0.02]
+    fe = FrontEnd(DecodeEngine(model, max_slots=2, max_len=96))
+    reqs = loadgen.replay(
+        arrivals,
+        submit=lambda a: fe.submit(a.prompt,
+                                   max_new_tokens=a.max_new_tokens,
+                                   priority=a.priority),
+        pump=fe.step, speed=10.0)
+    fe.run()
+    assert [r.status for r in reqs] == ["done", "done"]
+    assert len(reqs[0].tokens) == 4 and len(reqs[1].tokens) == 3

@@ -48,7 +48,10 @@ def test_expired_deadline_evicts_only_that_request(engine_cls):
     assert r_dead.tokens == []
     assert r_ok.done and not r_ok.failed
     assert r_ok.tokens == _reference_tokens(model, p_ok, 6)
-    assert stats.get("serve/deadline_evictions") == 1
+    # expired while still in the admission queue: the queue-reject
+    # counter, not the mid-decode eviction counter (ISSUE 10)
+    assert stats.get("serve/queue_deadline_rejects") == 1
+    assert stats.get("serve/deadline_evictions") == 0
 
 
 def test_live_request_deadline_evicts_mid_flight():

@@ -21,6 +21,12 @@
 #                          injection at in-flight depth 1 vs 3 must
 #                          produce byte-identical survivor streams on
 #                          every path (plain/chunked/spec/paged)
+#   tools/ci.sh front      serving front-end smoke: fixed-seed load
+#                          generator through the scheduler on a tiny
+#                          model — stream bit-identity vs direct
+#                          submission, nonzero backfill events, the
+#                          fed-occupancy floor, and the queue-deadline
+#                          reject path (~2 min)
 #   tools/ci.sh paged      paged-serving smoke: tiny-model fused
 #                          append+attend decode end to end on CPU plus
 #                          the PD_PREFIX repeated-system-prompt sweep —
@@ -62,6 +68,11 @@ fi
 if [[ "${1:-}" == "serve" ]]; then
     shift
     exec python tools/serve_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "front" ]]; then
+    shift
+    exec python tools/front_smoke.py "$@"
 fi
 
 if [[ "${1:-}" == "paged" ]]; then

@@ -54,7 +54,10 @@ def _serve(make_engine, depth):
     assert r_dead.failed and "deadline" in r_dead.error
     assert all(r.done and not r.failed for r in r_ok)
     assert stats.get("serve/nonfinite_evictions") == 1
-    assert stats.get("serve/deadline_evictions") == 1
+    # queued expiry lands on the queue-reject counter (distinct from
+    # mid-decode serve/deadline_evictions — no device work was wasted)
+    assert stats.get("serve/queue_deadline_rejects") == 1
+    assert stats.get("serve/deadline_evictions") == 0
     assert stats.get("serve/inflight") == 0
     if depth > 1:
         assert stats.snapshot("serve/").get(
