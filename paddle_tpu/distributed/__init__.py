@@ -60,6 +60,10 @@ launch = launch_module  # ref: paddle.distributed.launch (module)
 from paddle_tpu.distributed import auto_parallel
 from paddle_tpu.distributed.auto_parallel import Engine
 from paddle_tpu.distributed import compression
+from paddle_tpu.distributed import overlap
+from paddle_tpu.distributed.overlap import (
+    overlap_parallel, build_overlap_step, overlap_group_specs,
+    partition_buckets)
 # gloo_* shims: the reference's CPU-barrier plane; the TCPStore covers it
 def gloo_init_parallel_env(*a, **k):
     return None
@@ -92,4 +96,6 @@ __all__ = ["FleetExecutor", "rendezvous_endpoints", "rpc", "ps", "fleet",
            "destroy_process_group", "InMemoryDataset", "QueueDataset",
            "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
            "launch", "gloo_init_parallel_env", "gloo_barrier",
-           "gloo_release"]
+           "gloo_release", "overlap", "overlap_parallel",
+           "build_overlap_step", "overlap_group_specs",
+           "partition_buckets"]

@@ -24,7 +24,7 @@ __all__ = ["ReduceOp", "all_reduce", "all_gather", "all_to_all",
            "reduce_scatter", "broadcast", "psum", "pmean", "pmax", "pmin",
            "ppermute", "axis_index", "axis_size", "send_recv_ring",
            "barrier", "Group", "new_group", "get_group", "group_reduce",
-           "group_all_gather", "quantized_wire"]
+           "group_all_gather", "quantized_wire", "stripe_bytes"]
 
 
 _wire_ctx = threading.local()
@@ -59,6 +59,19 @@ def quantized_wire(logical_bytes: int):
             # ptlint: disable=PT003 -- per-compilation gauge, documented
             stats.set_value("comm/compression_ratio",
                             stats.get("comm/bytes_logical", 0) / wire)
+
+
+def stripe_bytes(tier: str, nbytes: int):
+    """FlexLink-style stripe accounting: wire bytes each stripe class of
+    a striped collective moved (``comm/stripe_bytes_{ici,dcn}``) —
+    per-compilation counters like every ``_issue_span`` stat, so the
+    split a ``compression.quantized_bucket_reduce_scatter`` actually
+    lowered is auditable against ``planner.stripe_plan``'s fraction."""
+    from paddle_tpu import stats
+    # ptlint: disable=PT003 -- per-compilation byte counters (see
+    # quantized_wire: trace-time accounting is this module's contract)
+    # ptlint: disable=PT001 -- nbytes is a static Python byte count
+    stats.add(f"comm/stripe_bytes_{tier}", int(nbytes))
 
 
 class ReduceOp:

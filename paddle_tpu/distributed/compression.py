@@ -76,6 +76,7 @@ __all__ = ["compressed_psum_mean", "compressed_mean_allgather",
            "build_compressed_dp_step", "init_error_feedback",
            "quantize_blocks", "dequantize_blocks",
            "quantized_all_gather_dequant", "quantized_reduce_scatter_mean",
+           "quantized_bucket_reduce_scatter",
            "resolve_comm_quant", "DEFAULT_BLOCK"]
 
 _METHODS = ("bf16", "int8", "fp8")
@@ -394,6 +395,151 @@ def quantized_reduce_scatter_mean(g, e, axis: str, method: str,
         ok = _wire_ok(mine, sr, vmax)
     shard = jnp.moveaxis(mine.reshape(shard_shape), 0, dim)
     return shard, new_e, ok
+
+
+def quantized_bucket_reduce_scatter(grads, ef, axis: str, method,
+                                    block: Optional[int] = None,
+                                    dims=None, vmax_axis=None,
+                                    stripe: Optional[float] = None,
+                                    stripe_min: int = 1 << 16):
+    """ONE wire exchange for a whole gradient BUCKET — the overlap
+    scheduler's launch unit (distributed/overlap.py).
+
+    Every leaf's rank-chunks are concatenated into a single
+    ``(N, total)`` payload that rides one narrow all-to-all (plus its
+    scales) instead of one collective per leaf: fewer launches on
+    exactly the latency-bound link the bucket exists to feed, and the
+    unit the FlexLink-style stripe splits. ``grads``/``ef`` are dicts of
+    this replica's local gradients and residuals; ``dims[k]`` is the dim
+    of leaf ``k`` carrying the comm axis (its size must divide the axis
+    size). ``method`` ``None`` moves fp32 — the scheduling A/B baseline
+    with an exactly-zero residual; "bf16"/"int8"/"fp8" as elsewhere.
+
+    ``stripe`` in (0, 1) splits the payload columns into a leading
+    full-precision ICI stripe and a trailing quantized DCN stripe of
+    that fraction, launched concurrently and recombined on arrival
+    (``planner.stripe_plan`` picks the fraction so both stripes finish
+    together; ``comm/stripe_bytes_{ici,dcn}`` meter the split). Applied
+    only when the bucket holds at least ``stripe_min`` elements — small
+    buckets pay two launch latencies for no bandwidth win.
+
+    Returns ``({k: my shard of mean(g)}, {k: new_ef}, ok)`` with the
+    same error-feedback algebra as
+    :func:`quantized_reduce_scatter_mean`; the fp32 stripe is exact, so
+    its residual range is zero.
+    """
+    from paddle_tpu.distributed import collective as coll
+    if method is not None:
+        _check_method(method)
+    block = _env_block(block)
+    N = lax.axis_size(axis)
+    keys = list(grads)
+    dims = dims or {}
+    xs, chunks, parts, logical = {}, {}, [], 0
+    for k in keys:
+        # ptlint: disable=PT001 -- dims holds static Python dim indices
+        d = int(dims.get(k, 0))
+        v = grads[k].astype(jnp.float32) + ef[k].astype(jnp.float32)
+        x = jnp.moveaxis(v, d, 0)
+        if x.shape[0] % N:
+            raise ValueError(
+                f"bucket leaf {k!r}: dim {d} (size {x.shape[0]}) must "
+                f"divide by axis {axis!r} size {N}")
+        xs[k] = x
+        chunks[k] = x.size // N
+        parts.append(x.reshape(N, -1))
+        logical += 4 * x.size
+    C = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    total = C.shape[1]
+    # stripe / stripe_min are static Python config scalars, total a
+    # static shape — the whole split decision happens at trace time
+    # ptlint: disable=PT001 -- static config knobs, not device values
+    do_stripe = (stripe is not None and 0.0 < float(stripe) < 1.0
+                 # ptlint: disable=PT001 -- stripe_min is a static knob
+                 and N * total >= int(stripe_min))
+    if method is None:
+        # fp32 wire: a stripe still splits into two CONCURRENT launches
+        # ptlint: disable=PT001 -- static config knobs, not device values
+        split = int(round(total * (1.0 - float(stripe)))) if do_stripe \
+            else total
+    else:
+        # ptlint: disable=PT001 -- static config knobs, not device values
+        split = int(round(total * (1.0 - float(stripe)))) if do_stripe \
+            else 0
+    split = min(max(split, 0), total)
+    ok = jnp.bool_(True)
+    mine_parts, own_parts, scales_seen = [], [], None
+    with coll.quantized_wire(logical):
+        if split:
+            # leading stripe crosses at full precision (exact — its
+            # error-feedback contribution is identically zero)
+            ici = C[:, :split]
+            if do_stripe:
+                coll.stripe_bytes("ici", 4 * ici.size)
+            r = coll.all_to_all(ici, axis, split_axis=0, concat_axis=0)
+            mine_parts.append(r.reshape(N, split).mean(0))
+            own_parts.append(ici)
+        rest = total - split
+        if rest:
+            dcn = C[:, split:]
+            if method is None:
+                # fp32 wire with striping: the second stripe is another
+                # concurrent full-precision launch (exact, zero residual)
+                if do_stripe:
+                    coll.stripe_bytes("dcn", 4 * dcn.size)
+                r = coll.all_to_all(dcn, axis, split_axis=0,
+                                    concat_axis=0)
+                mine_parts.append(r.reshape(N, rest).mean(0))
+                own_parts.append(dcn)
+            elif method == "bf16":
+                q = dcn.astype(jnp.bfloat16)
+                if do_stripe:
+                    coll.stripe_bytes("dcn", 2 * q.size)
+                r = coll.all_to_all(q, axis, split_axis=0, concat_axis=0)
+                mine_parts.append(
+                    r.astype(jnp.float32).reshape(N, rest).mean(0))
+                own_parts.append(q.astype(jnp.float32))
+            else:
+                nbc = -(-rest // block)
+                padded = jnp.pad(dcn, ((0, 0), (0, nbc * block - rest)))
+                payload, scales, _ = quantize_blocks(padded, method, block)
+                payload, scales = _inject_wire_fault(payload, scales)
+                if do_stripe:
+                    coll.stripe_bytes(
+                        "dcn", payload.size * payload.dtype.itemsize
+                        + 4 * scales.size)
+                pr = coll.all_to_all(payload, axis, split_axis=0,
+                                     concat_axis=0)
+                sr = coll.all_to_all(scales, axis, split_axis=0,
+                                     concat_axis=0)
+                deq = (pr.astype(jnp.float32) * sr).reshape(
+                    N, nbc * block)
+                mine_parts.append(deq.mean(0)[:rest])
+                own_parts.append((payload.astype(jnp.float32)
+                                  * scales).reshape(
+                                      N, nbc * block)[:, :rest])
+                scales_seen = sr
+    mine = (jnp.concatenate(mine_parts) if len(mine_parts) > 1
+            else mine_parts[0])
+    own = (jnp.concatenate(own_parts, axis=1) if len(own_parts) > 1
+           else own_parts[0])
+    if scales_seen is not None:
+        vmax = vmax_axis if vmax_axis is not None else \
+            lax.pmax(jnp.max(jnp.abs(C)), axis)
+        ok = _wire_ok(mine, scales_seen, vmax)
+    shards, new_ef = {}, {}
+    off = 0
+    for k in keys:
+        # ptlint: disable=PT001 -- dims holds static Python dim indices
+        d = int(dims.get(k, 0))
+        x, c = xs[k], chunks[k]
+        shard_shape = (x.shape[0] // N,) + x.shape[1:]
+        shards[k] = jnp.moveaxis(
+            mine[off:off + c].reshape(shard_shape), 0, d)
+        own_k = own[:, off:off + c].reshape(x.shape)
+        new_ef[k] = jnp.moveaxis(x - own_k, 0, d)
+        off += c
+    return shards, new_ef, ok
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,640 @@
+"""Overlap-aware collective scheduling for the sharded train step.
+
+ROADMAP item 5: PR 7 made the wire ~4x narrower (block-scaled int8/fp8
+collectives); this module makes it *disappear* behind compute — the
+FlexLink direction (stripe one collective across heterogeneous links
+concurrently) plus the sharded-update formulation of "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training"
+(per-bucket reduce-scatter + delayed gather as the natural overlap
+unit).
+
+Three levers, all inside ONE explicit shard_map train step over the
+comm axis (the quantized step of distributed/sharding.py, restructured
+as scans over layer blocks riding PR 8's stacked-weights layout):
+
+- **Bucketed gradient sync**: the backward pass runs as a reverse scan
+  over layer blocks; each layer's grad leaves are partitioned into
+  ~``PT_COMM_BUCKET_MB`` buckets (reverse-layer order — the order
+  backward produces them) and each bucket rides ONE quantized
+  reduce-scatter (``compression.quantized_bucket_reduce_scatter``)
+  issued INSIDE the backward scan body, right after that layer's vjp —
+  so a bucket's wire time hides under the remaining layers' backward
+  compute instead of serializing after it. Per-bucket error-feedback
+  state is sliced from ``opt_state["comm_ef"]`` layer by layer by the
+  scan.
+- **One-layer-ahead weight prefetch** (stage 3): the pre-forward param
+  all-gather for layer l+1 is issued at the TOP of layer l's scan body
+  (double-buffered carry — the carry holds the gathered weights of the
+  layer being computed while the next layer's gather is in flight), so
+  the gather leaves the layer critical path; the backward scan
+  prefetches layer l-1 the same way.
+- **Link striping**: bucket payloads above ``stripe_min`` split into a
+  full-precision ICI stripe and a quantized DCN stripe launched
+  concurrently (fraction per ``planner.stripe_plan`` — proportional to
+  effective link bandwidth so both stripes finish together), recombined
+  on arrival.
+
+``overlap=False`` (or ``PT_COMM_OVERLAP=0``) keeps the IDENTICAL math —
+same per-layer bucket codec, same error-feedback algebra, bit-identical
+parameters (tools/comm_smoke.py asserts this) — but hoists every
+collective out of the compute scans: gathers un-prefetched, bucket
+reduce-scatters in a tail scan after the full backward. That is the A/B
+isolating scheduling from arithmetic, and the baseline the
+``train_overlap`` bench row measures against. Measured target: per-step
+``comm/exposed_s`` (observability.comm — collective wall time no
+concurrent compute span covers) driven toward zero at an unchanged loss
+trajectory.
+"""
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.sharding import (
+    GroupShardedSpecs, group_sharded_specs, init_group_sharded_state,
+    attach_comm_ef, _ensure_axis, _quant_unsupported_reason, _shard_dims,
+    _sharded_update_tail, _strip_axis)
+
+__all__ = ["partition_buckets", "overlap_group_specs", "build_overlap_step",
+           "overlap_parallel", "resolve_stripe", "mlp_block_model",
+           "DEFAULT_BUCKET_MB"]
+
+DEFAULT_BUCKET_MB = 4.0
+
+
+def _env_bucket_mb(bucket_mb: Optional[float]) -> float:
+    if bucket_mb is not None:
+        # ptlint: disable=PT001 -- bucket_mb is a static Python knob
+        return float(bucket_mb)
+    return float(os.environ.get("PT_COMM_BUCKET_MB",
+                                str(DEFAULT_BUCKET_MB)))
+
+
+def _env_overlap() -> bool:
+    return os.environ.get("PT_COMM_OVERLAP", "1").strip().lower() \
+        not in ("0", "off", "false", "no")
+
+
+# effective wire compression of the DCN stripe per format — feeds
+# planner.stripe_plan's q so the auto fraction sizes the stripes to
+# finish together for the wire that will ACTUALLY run (int8/fp8 block
+# 256 ≈ 3.94x measured in PR 7; bf16 = 2x; fp32 = none)
+_STRIPE_RATIO = {"int8": 3.94, "fp8": 3.94, "bf16": 2.0, None: 1.0}
+
+
+def resolve_stripe(stripe, axis: str, mesh: Optional[Mesh] = None,
+                   method: Optional[str] = None) -> Optional[float]:
+    """One normalization point for the striping knob: an explicit arg
+    wins (``None`` falls through to ``PT_COMM_STRIPE``); "0"/"off" →
+    no striping; "1"/"on"/"auto" → :func:`planner.stripe_plan`'s
+    bandwidth-proportional DCN fraction for this axis, sized with the
+    RESOLVED wire format's compression ratio (an int8 stripe carries
+    ~4x the logical bytes per wire byte, so it can absorb a larger
+    payload share than an fp32 one); a number in (0, 1) forces that
+    fraction."""
+    if stripe is None:
+        stripe = os.environ.get("PT_COMM_STRIPE", "0").strip().lower()
+    if isinstance(stripe, str):
+        if stripe in ("", "0", "off", "none", "false", "no"):
+            return None
+        if stripe in ("1", "on", "auto"):
+            from paddle_tpu.distributed import planner
+            degrees = dict(mesh.shape) if mesh is not None else {}
+            n_hosts = int(os.environ.get("PT_NNODES", "1"))
+            return planner.stripe_plan(
+                degrees, n_hosts,
+                quant_ratio=_STRIPE_RATIO.get(method, 1.0)).get(axis)
+        stripe = float(stripe)
+    f = float(stripe)
+    if not 0.0 < f < 1.0:
+        return None
+    return f
+
+
+def partition_buckets(leaves: Sequence[Tuple[str, int]],
+                      bucket_mb: Optional[float] = None,
+                      reverse: bool = True) -> List[List[str]]:
+    """Partition named grad leaves into communication buckets.
+
+    ``leaves``: ``[(name, nbytes)]`` in FORWARD production order.
+    Returns a list of buckets (each a list of names) in REVERSE order —
+    the order backward produces gradients — each closed before a leaf
+    that would push it past ``bucket_mb`` MB. A leaf bigger than the
+    whole budget therefore forms its own bucket rather than splitting:
+    the bucket clamps to the leaf, the same policy as PR 7's quant block
+    clamping to tiny leaves, in the other direction. Tiny leaves keep
+    accumulating until the budget closes the bucket, so a run of biases
+    shares one launch instead of paying per-leaf latency."""
+    budget = max(1.0, _env_bucket_mb(bucket_mb) * 2.0 ** 20)
+    order = list(reversed(list(leaves))) if reverse else list(leaves)
+    buckets: List[List[str]] = []
+    cur: List[str] = []
+    cur_b = 0.0
+    for name, nbytes in order:
+        if cur and cur_b + nbytes > budget:
+            buckets.append(cur)
+            cur, cur_b = [], 0.0
+        cur.append(name)
+        cur_b += float(nbytes)
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def overlap_group_specs(params: Dict[str, jax.Array], mesh: Mesh,
+                        stacked_keys: Sequence[str],
+                        level: str = "p_g_os", axis: str = "fsdp",
+                        rules: Optional[Callable[[str], P]] = None
+                        ) -> GroupShardedSpecs:
+    """:func:`sharding.group_sharded_specs` for the overlap step: stacked
+    block leaves (leading layer dim, PR 8's scan layout) never shard dim
+    0 — the forward/backward scans slice it — so their comm axis is
+    re-derived over the trailing (per-layer) dims."""
+    specs = group_sharded_specs(params, mesh, level=level, axis=axis,
+                                rules=rules)
+    axis_size = dict(mesh.shape)[axis]
+    for k in stacked_keys:
+        if k not in params:
+            raise ValueError(f"stacked key {k!r} not in params")
+        v = params[k]
+        if v.ndim < 2:
+            raise ValueError(f"stacked leaf {k!r} needs a leading layer "
+                             f"dim plus at least one per-layer dim, got "
+                             f"shape {v.shape}")
+        base = rules(k) if rules is not None else P()
+        per_layer = P(*tuple(base)[1:])
+        if axis_size > 1:
+            per_layer = _ensure_axis(per_layer, v.shape[1:], axis,
+                                     axis_size)
+        stacked_spec = P(None, *tuple(per_layer))
+        specs.param[k] = (stacked_spec if level == "p_g_os"
+                          else _strip_axis(stacked_spec, axis))
+        specs.grad[k] = (stacked_spec if level in ("os_g", "p_g_os")
+                         else _strip_axis(stacked_spec, axis))
+        specs.opt_slot[k] = stacked_spec
+    return specs
+
+
+def build_overlap_step(embed_fn: Callable, block_fn: Callable,
+                       loss_fn: Callable, optimizer,
+                       specs: GroupShardedSpecs,
+                       stacked_keys: Sequence[str], *,
+                       comm_quant: Optional[str] = "auto",
+                       comm_block: Optional[int] = None,
+                       bucket_mb: Optional[float] = None,
+                       overlap: Optional[bool] = None,
+                       prefetch: Optional[bool] = None,
+                       stripe=None, stripe_min: int = 1 << 16,
+                       donate: bool = True):
+    """The overlap-scheduled group-sharded train step (module docstring).
+
+    The model arrives in block form — the structure the scheduler needs
+    to interleave collectives with per-layer compute:
+
+    - ``embed_fn(nonblock_params, *batch) -> x0``
+    - ``block_fn(layer_params, x) -> x`` — one layer, where
+      ``layer_params[k]`` is the FULL (gathered) per-layer slice of
+      stacked leaf ``k``
+    - ``loss_fn(nonblock_params, x_final, *batch) -> scalar`` —
+      this replica's local loss (head + criterion)
+
+    ``params`` passed to the returned step hold the stacked block leaves
+    named by ``stacked_keys`` (leading layer dim, specs from
+    :func:`overlap_group_specs`) plus any non-block leaves; the step
+    signature and state layout match the PR 7 quantized step —
+    ``step(params, opt_state, *batch) -> (params, opt_state, loss)``
+    with the error-feedback residual in ``opt_state["comm_ef"]``
+    (:func:`sharding.attach_comm_ef`). ``comm_quant`` ``None``/"fp32"
+    runs the same schedule on an fp32 wire; "auto" consults
+    ``compression.resolve_comm_quant``. ``overlap``/``bucket_mb``/
+    ``stripe`` default to the PT_COMM_OVERLAP / PT_COMM_BUCKET_MB /
+    PT_COMM_STRIPE env knobs.
+
+    ``overlap`` moves the bucket reduce-scatters into the backward scan
+    body; ``prefetch`` (default: follows ``overlap``) double-buffers the
+    stage-3 weight gathers one layer ahead. The two are split because
+    their parity classes differ: toggling ``overlap`` alone is
+    BIT-IDENTICAL (the barriered per-layer compute and the bucket codec
+    are the same subgraphs, only collective placement moves), while
+    ``prefetch`` routes the gathered weights through the scan carry,
+    whose buffer layout legitimately changes the matmuls' FMA order —
+    parity there is float-ulp-level, pinned by the smoke's tolerance.
+    """
+    from paddle_tpu.distributed import collective as coll
+    from paddle_tpu.distributed import compression
+    mesh, axis, level = specs.mesh, specs.axis, specs.level
+    stacked = tuple(stacked_keys)
+    if not stacked:
+        raise ValueError("build_overlap_step needs at least one stacked "
+                         "block leaf (use build_group_sharded_step for "
+                         "unstructured models)")
+    method = comm_quant
+    if method == "auto":
+        method = compression.resolve_comm_quant(axis=axis, mesh=mesh)
+    if method in ("none", "fp32"):
+        method = None
+    reason = _quant_unsupported_reason(optimizer, specs)
+    if reason is not None:
+        raise ValueError(f"overlap step: {reason}")
+    mesh_shape = dict(mesh.shape)
+    n_shard = mesh_shape[axis]
+    sdim = _shard_dims(specs)
+    for k in stacked:
+        if k not in specs.param:
+            raise ValueError(f"stacked key {k!r} not in specs")
+        if sdim.get(k, 1) == 0:
+            raise ValueError(
+                f"stacked leaf {k!r}: the layer dim cannot carry the "
+                f"comm axis (the scans slice it) — build the specs with "
+                f"overlap_group_specs")
+    if overlap is None:
+        overlap = _env_overlap()
+    overlap = bool(overlap)
+    do_prefetch = overlap if prefetch is None else bool(prefetch)
+    do_prefetch = do_prefetch and level == "p_g_os"
+    stripe_f = resolve_stripe(stripe, axis, mesh, method=method)
+    bucket_budget = _env_bucket_mb(bucket_mb)
+    data_axis = "dp" if axis != "dp" and mesh_shape.get("dp", 1) > 1 \
+        else None
+
+    def _dmean(x):
+        return lax.pmean(x, data_axis) if data_axis else x
+
+    # stacked leaves the per-layer bucket reduce-scatter covers, bucketed
+    # on their per-layer byte volume (static — shapes come from specs'
+    # params at trace time); leaves the axis never reached fall to the
+    # replicated pmean group at the tail like in the quantized step
+    rs_blk = [k for k in stacked if k in sdim]
+    raw_blk = [k for k in stacked if k not in sdim]
+    gather_blk = [k for k in stacked
+                  if k in sdim and level == "p_g_os"]
+    quantized = method not in (None, "bf16")
+
+    def per_rank(params, opt_state, *batch):
+        idx = lax.axis_index(axis)
+        opt_state = dict(opt_state)
+        ef = jax.tree_util.tree_map(lambda x: x[0],
+                                    opt_state.pop("comm_ef"))
+        ok = jnp.bool_(True)
+        blk = {k: params[k] for k in stacked}
+        nb = {k: v for k, v in params.items() if k not in blk}
+        L = blk[stacked[0]].shape[0]
+        for k, v in blk.items():
+            if v.shape[0] != L:
+                raise ValueError(f"stacked leaf {k!r} has layer dim "
+                                 f"{v.shape[0]}, expected {L}")
+        buckets = partition_buckets(
+            [(k, 4 * int(np.prod(blk[k].shape[1:]))) for k in rs_blk],
+            bucket_budget, reverse=True)
+
+        # ---- stage-3 gather of the non-block params (batched guard) ----
+        nb_gather = [k for k in nb if level == "p_g_os" and k in sdim]
+        wmax_nb = dict(zip(nb_gather, lax.pmax(jnp.stack(
+            [jnp.max(jnp.abs(nb[k])) for k in nb_gather]), axis))) \
+            if nb_gather and quantized else {}
+        nb_full = {}
+        for k, p in nb.items():
+            if k in nb_gather:
+                if method is None:
+                    nb_full[k] = coll.all_gather(p, axis,
+                                                 tiled_axis=sdim[k])
+                else:
+                    f, okk = compression.quantized_all_gather_dequant(
+                        p, axis, method, comm_block, dim=sdim[k],
+                        vmax_axis=wmax_nb.get(k))
+                    ok = ok & okk
+                    nb_full[k] = f
+            else:
+                nb_full[k] = p
+
+        # per-layer block-weight guard envelopes, batched: ONE pmax for
+        # every (leaf, layer) instead of a scalar collective per gather
+        # inside the scans
+        if gather_blk and quantized:
+            wmax_blk = lax.pmax(jnp.stack(
+                [jnp.max(jnp.abs(blk[k]),
+                         axis=tuple(range(1, blk[k].ndim)))
+                 for k in gather_blk]), axis).T          # (L, n_gather)
+        else:
+            wmax_blk = jnp.zeros((L, max(1, len(gather_blk))),
+                                 jnp.float32)
+
+        def gather_layer(shards_l, vmax_l):
+            """Full per-layer weights from the per-layer shard slices.
+            The output rides an optimization_barrier: the gather subgraph
+            then compiles identically whether it sits in the compute scan
+            (overlap on) or outside it (off) — XLA cannot fuse it into
+            the surrounding layer math and perturb bit-parity — while the
+            barrier is pure dataflow, so the async collective scheduler
+            still hoists the exchange ahead of the compute it feeds."""
+            full, okk = {}, jnp.bool_(True)
+            for i, k in enumerate(gather_blk):
+                d = sdim[k] - 1
+                if method is None:
+                    full[k] = coll.all_gather(shards_l[k], axis,
+                                              tiled_axis=d)
+                else:
+                    f, o = compression.quantized_all_gather_dequant(
+                        shards_l[k], axis, method, comm_block, dim=d,
+                        vmax_axis=vmax_l[i] if quantized else None)
+                    okk = okk & o
+                    full[k] = f
+            for k in stacked:
+                if k not in gather_blk:
+                    full[k] = shards_l[k]
+            if full:
+                full = lax.optimization_barrier(full)
+            return full, okk
+
+        def bucket_sync(dw, ef_l):
+            """The per-layer bucketed reduce-scatter: one pmax for every
+            bucket's guard envelope, then one bucket codec exchange per
+            bucket. Returns ({k: shard}, {k: new_ef}, ok)."""
+            outs_s, outs_e = {}, {}
+            okk = jnp.bool_(True)
+            if not buckets:
+                return outs_s, outs_e, okk
+            dmeaned = {k: _dmean(dw[k].astype(jnp.float32))
+                       for k in rs_blk}
+            bmax = lax.pmax(jnp.stack(
+                [jnp.max(jnp.stack(
+                    [jnp.max(jnp.abs(dmeaned[k] + ef_l[k])) for k in b]))
+                 for b in buckets]), axis) if quantized else None
+            for i, b in enumerate(buckets):
+                sh, ne, o = compression.quantized_bucket_reduce_scatter(
+                    {k: dmeaned[k] for k in b},
+                    {k: ef_l[k] for k in b},
+                    axis, method, comm_block,
+                    dims={k: sdim[k] - 1 for k in b},
+                    vmax_axis=bmax[i] if quantized else None,
+                    stripe=stripe_f, stripe_min=stripe_min)
+                outs_s.update(sh)
+                outs_e.update(ne)
+                okk = okk & o
+            return outs_s, outs_e, okk
+
+        def layer_fwd(w, x):
+            """One layer's forward between optimization_barriers: the
+            compute subgraph is then identical whichever schedule
+            surrounds it, keeping the overlap on/off A/B a
+            scheduling-only change."""
+            w, x = lax.optimization_barrier((w, x))
+            return lax.optimization_barrier(block_fn(w, x))
+
+        def layer_bwd(w, x_l, dx):
+            """One layer's vjp between optimization_barriers (same
+            contract as layer_fwd). Returns (dw, dx_in)."""
+            w, x_l, dx = lax.optimization_barrier((w, x_l, dx))
+            _, bvjp = jax.vjp(block_fn, w, x_l)
+            return lax.optimization_barrier(bvjp(dx))
+
+        # ---- forward: scan over layers, weights one gather ahead ------
+        x0, embed_vjp = jax.vjp(lambda q: embed_fn(q, *batch), nb_full)
+        if do_prefetch and gather_blk:
+            # double-buffered carry: compute layer l with the weights the
+            # PREVIOUS body (or the prologue) gathered, while this body
+            # issues the gather for l+1 — the gather leaves the layer
+            # critical path. The rolled xs make the LAST body gather
+            # layer 0 again (its ok guard keeps it live); that wasted
+            # wraparound gather (one per scan, 1/L of gather traffic —
+            # likewise in backward) is the price of uniform scan bodies:
+            # peeling the final iteration would compile the last layer
+            # as a second body outside the scan, doubling body compiles
+            # and splitting the schedule the jaxpr tests pin down.
+            w0, ok0 = gather_layer({k: blk[k][0] for k in stacked},
+                                   wmax_blk[0])
+            ok = ok & ok0
+
+            def fbody(carry, xsl):
+                x, w, okk = carry
+                sh_next, vm_next = xsl
+                w_next, o = gather_layer(sh_next, vm_next)
+                y = layer_fwd(w, x)
+                return (y, w_next, okk & o), x
+
+            (xN, _, ok), acts = lax.scan(
+                fbody, (x0, w0, ok),
+                ({k: jnp.roll(blk[k], -1, axis=0) for k in stacked},
+                 jnp.roll(wmax_blk, -1, axis=0)))
+        else:
+            def fbody(carry, xsl):
+                x, okk = carry
+                sh_l, vm_l = xsl
+                w, o = gather_layer(sh_l, vm_l)
+                y = layer_fwd(w, x)
+                return (y, okk & o), x
+
+            (xN, ok), acts = lax.scan(
+                fbody, (x0, ok),
+                ({k: blk[k] for k in stacked}, wmax_blk))
+
+        # ---- head loss + backward scan --------------------------------
+        loss, head_vjp = jax.vjp(
+            lambda q, xf: loss_fn(q, xf, *batch), nb_full, xN)
+        dnb, dxN = head_vjp(jnp.ones_like(loss))
+
+        def rev(t):
+            return jnp.flip(t, 0)
+
+        ef_rev = {k: rev(ef[k]) for k in rs_blk}
+        if overlap and do_prefetch and gather_blk:
+            # prologue gathers layer L-1; each body prefetches l-1 and
+            # launches the layer's grad buckets right after its vjp
+            wl, okl = gather_layer({k: blk[k][L - 1] for k in stacked},
+                                   wmax_blk[L - 1])
+            ok = ok & okl
+
+            def bbody(carry, xsl):
+                dx, w, okk = carry
+                x_l, sh_prev, vm_prev, ef_l = xsl
+                w_prev, o = gather_layer(sh_prev, vm_prev)
+                dw, dx_in = layer_bwd(w, x_l, dx)
+                sh_g, new_e, o2 = bucket_sync(dw, ef_l)
+                raw = {k: _dmean(dw[k].astype(jnp.float32))
+                       for k in raw_blk}
+                return (dx_in, w_prev, okk & o & o2), (sh_g, new_e, raw)
+
+            (dx0, _, ok), (sh_rev, efo_rev, raw_rev) = lax.scan(
+                bbody, (dxN, wl, ok),
+                (rev(acts),
+                 {k: jnp.roll(rev(blk[k]), -1, axis=0) for k in stacked},
+                 jnp.roll(rev(wmax_blk), -1, axis=0), ef_rev))
+        elif overlap:
+            # in-body bucket sync without the double-buffered weight
+            # carry: each body re-gathers its own layer, then launches
+            # that layer's grad buckets right after the vjp
+            def bbody(carry, xsl):
+                dx, okk = carry
+                x_l, sh_l, vm_l, ef_l = xsl
+                w, o = gather_layer(sh_l, vm_l)
+                dw, dx_in = layer_bwd(w, x_l, dx)
+                sh_g, new_e, o2 = bucket_sync(dw, ef_l)
+                raw = {k: _dmean(dw[k].astype(jnp.float32))
+                       for k in raw_blk}
+                return (dx_in, okk & o & o2), (sh_g, new_e, raw)
+
+            (dx0, ok), (sh_rev, efo_rev, raw_rev) = lax.scan(
+                bbody, (dxN, ok),
+                (rev(acts), {k: rev(blk[k]) for k in stacked},
+                 rev(wmax_blk), ef_rev))
+        else:
+            # tail-sync baseline: the SAME per-layer math with every
+            # collective hoisted out of the compute scan — backward
+            # first, then a separate scan runs the identical bucket
+            # codec layer by layer (bit-identical parameters vs the
+            # un-prefetched overlap schedule; only collective placement
+            # differs)
+            def bbody(carry, xsl):
+                dx, okk = carry
+                x_l, sh_l, vm_l = xsl
+                w, o = gather_layer(sh_l, vm_l)
+                dw, dx_in = layer_bwd(w, x_l, dx)
+                return (dx_in, okk & o), dw
+
+            (dx0, ok), dw_rev = lax.scan(
+                bbody, (dxN, ok),
+                (rev(acts), {k: rev(blk[k]) for k in stacked},
+                 rev(wmax_blk)))
+
+            def tail(okk, xsl):
+                dw_l, ef_l = xsl
+                sh_g, new_e, o2 = bucket_sync(dw_l, ef_l)
+                raw = {k: _dmean(dw_l[k].astype(jnp.float32))
+                       for k in raw_blk}
+                return okk & o2, (sh_g, new_e, raw)
+
+            ok, (sh_rev, efo_rev, raw_rev) = lax.scan(
+                tail, ok, (dw_rev, ef_rev))
+
+        sh_blk = {k: rev(v) for k, v in sh_rev.items()}
+        new_ef_blk = {k: rev(v) for k, v in efo_rev.items()}
+        raw_g = {k: rev(v) for k, v in raw_rev.items()}
+        (dnb_e,) = embed_vjp(dx0)
+        dnb = jax.tree_util.tree_map(lambda a, b: a + b, dnb, dnb_e)
+
+        # ---- non-block grads: the tail bucket set ---------------------
+        nb_rs = [k for k in nb if k in sdim]
+        nb_buckets = partition_buckets(
+            [(k, 4 * int(np.prod(nb[k].shape))) for k in nb_rs],
+            bucket_budget, reverse=True)
+        shard_g, new_ef = dict(sh_blk), dict(new_ef_blk)
+        if nb_buckets:
+            dmeaned = {k: _dmean(dnb[k].astype(jnp.float32))
+                       for k in nb_rs}
+            nmax = lax.pmax(jnp.stack(
+                [jnp.max(jnp.stack(
+                    [jnp.max(jnp.abs(dmeaned[k] + ef[k])) for k in b]))
+                 for b in nb_buckets]), axis) if quantized else None
+            for i, b in enumerate(nb_buckets):
+                sh, ne, o = compression.quantized_bucket_reduce_scatter(
+                    {k: dmeaned[k] for k in b}, {k: ef[k] for k in b},
+                    axis, method, comm_block,
+                    dims={k: sdim[k] for k in b},
+                    vmax_axis=nmax[i] if quantized else None,
+                    stripe=stripe_f, stripe_min=stripe_min)
+                shard_g.update(sh)
+                new_ef.update(ne)
+                ok = ok & o
+        for k in nb:
+            if k not in sdim:
+                shard_g[k] = _dmean(lax.pmean(
+                    dnb[k].astype(jnp.float32), axis))
+                new_ef[k] = ef[k]
+        for k in raw_blk:
+            shard_g[k] = lax.pmean(raw_g[k], axis)
+            new_ef[k] = ef[k]
+
+        # ---- sharded update (≙ the quantized step's owner update) -----
+        shard_p = {}
+        for k in params:
+            if k in sdim and level == "os_g":
+                d = params[k].shape[sdim[k]] // n_shard
+                shard_p[k] = lax.dynamic_slice_in_dim(
+                    params[k], idx * d, d, axis=sdim[k])
+            else:
+                shard_p[k] = params[k]
+        return _sharded_update_tail(optimizer, opt_state, shard_p,
+                                    shard_g, new_ef, ok, loss,
+                                    level=level, axis=axis, sdim=sdim,
+                                    dmean=_dmean)
+
+    ef_spec = {k: P(axis) for k in specs.param}
+    state_spec = {"step": P(), "slots": dict(specs.opt_slot),
+                  "comm_ef": ef_spec}
+    batch_spec = P(data_axis) if data_axis else P()
+
+    def step(params, opt_state, *batch):
+        smapped = shard_map(
+            per_rank, mesh=mesh,
+            in_specs=(dict(specs.param), state_spec)
+            + (batch_spec,) * len(batch),
+            out_specs=(dict(specs.param), state_spec, P()),
+            check_vma=False)
+        return smapped(params, opt_state, *batch)
+
+    kw = {"donate_argnums": (0, 1)} if donate else {}
+    return jax.jit(step, **kw)
+
+
+def overlap_parallel(params: Dict[str, jax.Array], embed_fn: Callable,
+                     block_fn: Callable, loss_fn: Callable, optimizer,
+                     mesh: Mesh, stacked_keys: Sequence[str],
+                     level: str = "p_g_os", axis: str = "fsdp",
+                     rules: Optional[Callable[[str], P]] = None,
+                     comm_quant: Optional[str] = "auto", **step_kw):
+    """One-call API for the overlap-scheduled step, mirroring
+    :func:`sharding.group_sharded_parallel`: derives the stacked-aware
+    specs, places the state, always attaches the error-feedback residual
+    (zeros stay zeros on an fp32 wire, so the step signature never
+    depends on the resolved format), and builds the step.
+
+    Returns ``(sharded_params, sharded_opt_state, jitted_train_step)``.
+    """
+    specs = overlap_group_specs(params, mesh, stacked_keys, level=level,
+                                axis=axis, rules=rules)
+    full_params = params
+    params, opt_state = init_group_sharded_state(params, optimizer, specs)
+    opt_state = attach_comm_ef(full_params, opt_state, specs)
+    step = build_overlap_step(embed_fn, block_fn, loss_fn, optimizer,
+                              specs, stacked_keys, comm_quant=comm_quant,
+                              **step_kw)
+    return params, opt_state, step
+
+
+def mlp_block_model(n_layers: int = 4, d: int = 16, hidden: int = 32,
+                    k: int = 8, seed: int = 0):
+    """Tiny residual stacked-MLP in the overlap step's block form — the
+    shared harness the overlap tests / comm smoke / ``train_overlap``
+    bench row drive the scheduler with (a real model supplies its own
+    embed/block/loss triple the same way). Returns
+    ``(params, stacked_keys, embed_fn, block_fn, loss_fn)``; the batch
+    is ``(x (B, d), y (B, k))``."""
+    rs = np.random.RandomState(seed)
+    params = {
+        "w_in": jnp.asarray(rs.randn(d, d) * 0.3, jnp.float32),
+        "blocks.w1": jnp.asarray(rs.randn(n_layers, d, hidden) * 0.2,
+                                 jnp.float32),
+        "blocks.b1": jnp.zeros((n_layers, hidden), jnp.float32),
+        "blocks.w2": jnp.asarray(rs.randn(n_layers, hidden, d) * 0.2,
+                                 jnp.float32),
+        "w_out": jnp.asarray(rs.randn(d, k) * 0.3, jnp.float32),
+    }
+    stacked = ("blocks.w1", "blocks.b1", "blocks.w2")
+
+    def embed_fn(nb, x, y):
+        return x @ nb["w_in"]
+
+    def block_fn(w, h):
+        return h + jnp.tanh(h @ w["blocks.w1"]
+                            + w["blocks.b1"]) @ w["blocks.w2"]
+
+    def loss_fn(nb, h, x, y):
+        return jnp.mean((h @ nb["w_out"] - y) ** 2)
+
+    return params, stacked, embed_fn, block_fn, loss_fn

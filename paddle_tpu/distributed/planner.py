@@ -43,7 +43,7 @@ from paddle_tpu.distributed.mesh import LAYOUT
 
 __all__ = ["plan_module", "memory_report", "suggest_mesh",
            "enumerate_plans", "plan_cost", "rank_plans",
-           "comm_quant_policy"]
+           "comm_quant_policy", "stripe_plan"]
 
 _VOCAB_RATIO = 4       # dim0 >= ratio*dim1 → vocab-like table
 _TINY_OUT = 8          # output dims below this are never sharded
@@ -331,6 +331,34 @@ def comm_quant_policy(degrees: Dict[str, int], n_hosts: int = 1,
     return {ax: (default_fmt
                  if _axis_tier(degrees, ax, n_hosts) == "dcn" else None)
             for ax in ("dp", "fsdp")}
+
+
+def stripe_plan(degrees: Dict[str, int], n_hosts: int = 1,
+                cost_model=None, quant_ratio: float = 3.94,
+                axes=("dp", "fsdp")) -> Dict[str, Optional[float]]:
+    """FlexLink-style stripe fractions for the striped bucket
+    collectives (``compression.quantized_bucket_reduce_scatter``).
+
+    For each data axis whose collective crosses hosts
+    (:func:`_axis_tier` says "dcn"), the payload fraction routed on the
+    QUANTIZED DCN stripe so both stripes finish together:
+    ``f = q·B_dcn / (q·B_dcn + B_ici)`` — ``q`` the wire compression the
+    DCN stripe enjoys (int8 block-256 ≈ 3.94x, PR 7's measured ratio),
+    ``B_*`` the link bandwidths from the cost model. The remaining
+    ``1-f`` crosses full-precision on the ICI stripe concurrently,
+    recovering the intra-host links a pure-DCN collective leaves idle.
+    ICI-resident axes get None — a single-tier axis has no second link
+    class to stripe onto."""
+    from paddle_tpu.cost_model import CostModel
+    cm = cost_model or CostModel()
+    out: Dict[str, Optional[float]] = {}
+    for ax in axes:
+        if _axis_tier(degrees, ax, n_hosts) == "dcn":
+            eff = quant_ratio * cm.dcn_bw
+            out[ax] = round(eff / (eff + cm.ici_bw), 4)
+        else:
+            out[ax] = None
+    return out
 
 
 def plan_cost(module, degrees: Dict[str, int], hbm_bytes: float = 16e9,

@@ -38,6 +38,16 @@ dequant-reduce with error feedback. The error-feedback residual rides
 inside ``opt_state["comm_ef"]``, so the step signature is unchanged.
 Stage-2's post-update param rebuild stays full-precision (it is the
 authoritative state, not a per-step estimate).
+
+**Overlap scheduling** (distributed/overlap.py): for models in block
+form (PR 8's stacked-weights layout), :func:`build_overlap_sharded_step`
+restructures this explicit step as scans over layer blocks — per-bucket
+quantized reduce-scatters launched inside the backward scan as each
+layer's grads appear, the stage-3 gather for layer l+1 issued inside
+layer l's scan body (double-buffered carry), and large bucket payloads
+striped across ICI and DCN concurrently. Same state layout
+(``opt_state["comm_ef"]``), same step signature; the wire cost then
+hides under compute instead of serializing after it.
 """
 
 import dataclasses
@@ -51,7 +61,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["GroupShardedSpecs", "group_sharded_specs",
            "init_group_sharded_state", "build_group_sharded_step",
-           "group_sharded_parallel", "attach_comm_ef", "LEVELS"]
+           "build_overlap_sharded_step", "group_sharded_parallel",
+           "attach_comm_ef", "LEVELS"]
 
 LEVELS = ("os", "os_g", "p_g_os")
 
@@ -294,6 +305,35 @@ def attach_comm_ef(params, opt_state, specs: GroupShardedSpecs):
     return out
 
 
+def _sharded_update_tail(optimizer, opt_state, shard_p, shard_g, new_ef,
+                         ok, loss, *, level, axis, sdim, dmean):
+    """The shared owner-update tail of the explicit sharded steps (the
+    PR 7 quantized step below and distributed/overlap.py's scheduler):
+    sharded optimizer update, os_g's post-update rebuild of the
+    replicated copy (the authoritative state crosses at full
+    precision), the error-feedback rewrap into ``opt_state["comm_ef"]``,
+    the dmean'd loss, and the fail-loud NaN poison on a tripped wire
+    guard. One copy — a change to any of these semantics reaches both
+    steps."""
+    new_sp, new_state = optimizer.update(shard_g, opt_state, shard_p)
+    out_p = {}
+    for k in new_sp:
+        if k in sdim and level == "os_g":
+            out_p[k] = lax.all_gather(new_sp[k], axis, axis=sdim[k],
+                                      tiled=True)
+        else:
+            out_p[k] = new_sp[k]
+    new_state = dict(new_state)
+    new_state["comm_ef"] = jax.tree_util.tree_map(
+        lambda x: x[None], new_ef)
+    loss = dmean(lax.pmean(loss, axis))
+    # fail-loud: a tripped wire guard poisons state on EVERY rank
+    out_p = jax.tree_util.tree_map(
+        lambda x: jnp.where(ok, x, jnp.nan), out_p)
+    loss = jnp.where(ok, loss, jnp.nan)
+    return out_p, new_state, loss
+
+
 def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
                                method: str, block: Optional[int],
                                donate: bool):
@@ -370,25 +410,10 @@ def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
                                               axis))
                 new_ef[k] = ef[k]
                 shard_p[k] = params[k]
-        new_sp, new_state = optimizer.update(shard_g, opt_state, shard_p)
-        out_p = {}
-        for k in params:
-            if k in sdim and level == "os_g":
-                # post-update rebuild of the replicated copy: the
-                # authoritative state crosses at full precision
-                out_p[k] = lax.all_gather(new_sp[k], axis,
-                                          axis=sdim[k], tiled=True)
-            else:
-                out_p[k] = new_sp[k]
-        new_state = dict(new_state)
-        new_state["comm_ef"] = jax.tree_util.tree_map(
-            lambda x: x[None], new_ef)
-        loss = _dmean(lax.pmean(loss, axis))
-        # fail-loud: a tripped wire guard poisons state on EVERY rank
-        out_p = jax.tree_util.tree_map(
-            lambda x: jnp.where(ok, x, jnp.nan), out_p)
-        loss = jnp.where(ok, loss, jnp.nan)
-        return out_p, new_state, loss
+        return _sharded_update_tail(optimizer, opt_state, shard_p,
+                                    shard_g, new_ef, ok, loss,
+                                    level=level, axis=axis, sdim=sdim,
+                                    dmean=_dmean)
 
     ef_spec = {k: P(axis) for k in specs.param}
     state_spec = {"step": P(), "slots": dict(specs.opt_slot),
@@ -408,6 +433,16 @@ def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
 
     kw = {"donate_argnums": (0, 1)} if donate else {}
     return jax.jit(step, **kw)
+
+
+def build_overlap_sharded_step(*args, **kwargs):
+    """Overlap-scheduled variant of :func:`build_group_sharded_step` for
+    block-form models (module docstring "Overlap scheduling"): bucketed
+    in-backward gradient sync, one-layer-ahead weight prefetch, ICI+DCN
+    striping. Thin alias of :func:`distributed.overlap.build_overlap_step`
+    (late import — overlap builds on this module's specs machinery)."""
+    from paddle_tpu.distributed import overlap
+    return overlap.build_overlap_step(*args, **kwargs)
 
 
 def group_sharded_parallel(params, optimizer, loss_fn, mesh: Mesh,

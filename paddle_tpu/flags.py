@@ -279,6 +279,21 @@ declare_env("PT_COMM_QUANT_PSUM", "1 selects the legacy psum wire for "
             "compressed dp sync (int8 payloads upcast to int32 on the "
             "wire — the tested parity reference, NOT a volume win).",
             default="0", owner="distributed/compression.py")
+declare_env("PT_COMM_BUCKET_MB", "Gradient-sync bucket budget in MB for "
+            "the overlap scheduler: backward partitions grad leaves "
+            "into ~this-many-MB buckets in reverse-layer order, one "
+            "quantized reduce-scatter per bucket launched as the layer's "
+            "grads appear.", default="4", owner="distributed/overlap.py")
+declare_env("PT_COMM_OVERLAP", "0 disables overlap scheduling in the "
+            "bucketed train step: collectives hoist to a tail sync "
+            "after the full backward (same math, bit-identical params "
+            "— the A/B baseline the train_overlap bench measures "
+            "against).", default="1", owner="distributed/overlap.py")
+declare_env("PT_COMM_STRIPE", "Link striping for large bucket payloads: "
+            "0 off; auto/1 splits per planner.stripe_plan into a "
+            "full-precision ICI stripe plus a quantized DCN stripe "
+            "launched concurrently; a float in (0,1) forces that DCN "
+            "fraction.", default="0", owner="distributed/overlap.py")
 
 # -- compilation / data / testing --
 declare_env("PT_COMPILE_CACHE_GUARD", "0 disables the persistent-compile-"

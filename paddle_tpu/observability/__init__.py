@@ -23,10 +23,14 @@ from paddle_tpu.observability.statsz import (StatszServer, start_statsz,
                                              stop_statsz)
 from paddle_tpu.observability.merge import (merge_trace_files,
                                             merge_rank_traces)
+from paddle_tpu.observability import comm
+from paddle_tpu.observability.comm import (exposed_time, step_overlap,
+                                           record_step_overlap)
 
 __all__ = ["trace", "span", "begin", "end", "complete", "instant",
            "StatszServer", "start_statsz", "stop_statsz",
-           "merge_trace_files", "merge_rank_traces", "init_from_env"]
+           "merge_trace_files", "merge_rank_traces", "init_from_env",
+           "comm", "exposed_time", "step_overlap", "record_step_overlap"]
 
 
 def init_from_env():

@@ -76,6 +76,21 @@ def test_bench_train_quant_comm_smoke():
     assert out.get("train_quant_comm_int8_wire_ratio", 0) >= 3.5, out
 
 
+def test_bench_train_overlap_smoke():
+    out = bench.bench_train_overlap(jax, jnp, PEAK, smoke=True)
+    for name in ("fp32_on", "fp32_off", "int8_on", "int8_off"):
+        assert out.get(f"train_overlap_{name}_step_ms", 0) > 0, out
+    # overlap on vs off must be trajectory-matched (same math, only the
+    # collective schedule moves)
+    assert abs(out.get("train_overlap_fp32_loss_delta", 1)) < 1e-5, out
+    assert abs(out.get("train_overlap_int8_loss_delta", 1)) < 1e-4, out
+    # the span-tracer accounting made it into the row, with real
+    # collective issue spans measured (multi-device conftest mesh)
+    assert 0.0 <= out["train_overlap_overlap_frac"] <= 1.0, out
+    assert out["train_overlap_comm_busy_s"] > 0, out
+    assert out["train_overlap_exposed_s"] >= 0, out
+
+
 def test_bench_train_sharded_stacked_smoke():
     out = bench.bench_train_sharded_stacked(jax, jnp, PEAK, smoke=True)
     assert out.get("train_sharded_stacked_per_layer_step_ms", 0) > 0, out
@@ -124,6 +139,7 @@ def test_bench_nonsmoke_cpu_guards():
     assert bench.bench_pp(jax, jnp, PEAK) == {}
     assert bench.bench_longctx(jax, jnp, PEAK) == {}
     assert bench.bench_train_sharded_stacked(jax, jnp, PEAK) == {}
+    assert bench.bench_train_overlap(jax, jnp, PEAK) == {}
 
 
 def test_split_params_contract():

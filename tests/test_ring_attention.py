@@ -81,6 +81,57 @@ def test_ring_inside_jitted_train_like_step():
     np.testing.assert_allclose(float(val), float(ref), atol=1e-5, rtol=1e-5)
 
 
+def test_replication_explicit():
+    """The shard_map wrapper disables jax 0.4.37's replication checker
+    (false positive on the causal ring's cond — see
+    sequence_parallel_attention). This asserts the property the checker
+    would have proven, explicitly: a replicated (out_specs P()) loss
+    reduced from the ring output is BIT-IDENTICAL on every device —
+    no rank's online-softmax ring diverged."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    topo = dist.init_mesh(sp=8)
+    q, k, v = _qkv(2, 64, 8, 16, seed=5)
+    spec = P(("dp", "fsdp"), "sp", "tp", None)
+
+    def body(q, k, v):
+        from paddle_tpu.distributed.ring_attention import ring_attention
+        o = ring_attention(q, k, v, "sp", causal=True)
+        return lax.psum(jnp.sum(o * o), "sp")
+
+    loss = jax.jit(jax.shard_map(
+        body, mesh=topo.mesh, in_specs=(spec, spec, spec),
+        out_specs=P(), check_vma=False))(q, k, v)
+    shards = [np.asarray(s.data) for s in loss.addressable_shards]
+    assert len(shards) == 8
+    for s in shards[1:]:
+        np.testing.assert_array_equal(s, shards[0])
+    # and the replicated value is the true global reduction
+    ref = float(jnp.sum(attention_reference(q, k, v, is_causal=True) ** 2))
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_grads_through_causal_ring_train_step():
+    """Regression for the dryrun phase-C signature: jax.grad through the
+    causal ring (the exact path the replication checker used to reject
+    with "mismatched replication types") must run and match the dense
+    reference."""
+    topo = dist.init_mesh(sp=8)
+    q, k, v = _qkv(1, 64, 2, 8, seed=6)
+
+    def loss_sp(q):
+        return jnp.mean(sequence_parallel_attention(
+            q, k, v, topo.mesh, causal=True) ** 2)
+
+    def loss_ref(q):
+        return jnp.mean(attention_reference(q, k, v, is_causal=True) ** 2)
+
+    g = jax.grad(loss_sp)(q)
+    g_ref = jax.grad(loss_ref)(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               atol=1e-5, rtol=1e-4)
+
+
 def test_ulysses_rejects_bad_heads():
     topo = dist.init_mesh(sp=8)
     q, k, v = _qkv(1, 64, 4, 8)  # 4 heads not divisible by sp=8

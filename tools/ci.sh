@@ -32,11 +32,19 @@
 #                          the PD_PREFIX repeated-system-prompt sweep —
 #                          fails if a warm shared-prefix submit() stops
 #                          hitting the radix cache
-#   tools/ci.sh comm       quantized-collective smoke: tiny 2-device
-#                          host-platform mesh runs the int8/fp8 wire —
-#                          convergence parity vs fp32, ≥3.5x bytes_wire
-#                          cut, stage-3 gather tolerance, and the
-#                          bitflipped-scale fail-loud guard
+#   tools/ci.sh comm       quantized-collective smoke: tiny host-platform
+#                          mesh runs the int8/fp8 wire — convergence
+#                          parity vs fp32, ≥3.5x bytes_wire cut, stage-3
+#                          gather tolerance, the bitflipped-scale
+#                          fail-loud guard, plus the overlap sweep below
+#   tools/ci.sh overlap    overlap-scheduler smoke: 4-device CPU sweep of
+#                          the bucketed train step — overlap on/off must
+#                          leave params BIT-identical after 3 steps, the
+#                          prefetch toggle inside a float-ulp envelope,
+#                          and the overlap-on lowering must carry >1
+#                          reduce-scatter (one per bucket, interleaved
+#                          into backward) instead of one fused tail
+#                          collective
 #   tools/ci.sh shard      sharded-stacked smoke: 4-device CPU mesh runs
 #                          the pre-stacked scan-over-layers train step
 #                          under fsdp×tp (loss parity vs per-layer,
@@ -83,8 +91,14 @@ fi
 
 if [[ "${1:-}" == "comm" ]]; then
     shift
-    # comm_smoke forces its own 2-device host platform before importing jax
+    # comm_smoke forces its own 4-device host platform before importing jax
     exec python tools/comm_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "overlap" ]]; then
+    shift
+    # just the ISSUE-11 overlap sweep (bit-parity + interleaved lowering)
+    exec python tools/comm_smoke.py --overlap "$@"
 fi
 
 if [[ "${1:-}" == "shard" ]]; then

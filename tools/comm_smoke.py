@@ -11,7 +11,13 @@ regressions:
   for int8 at block 256;
 - the stage-3 quantized weight all-gather reproduces the fp32 gather
   inside the per-block half-step bound;
-- a bitflipped block scale makes the step RAISE, not drift.
+- a bitflipped block scale makes the step RAISE, not drift;
+- (ISSUE 11, ``tools/ci.sh overlap`` / ``--overlap``) the overlap
+  scheduler's 4-device sweep: toggling overlap on/off (prefetch pinned)
+  leaves the parameters BIT-identical after 3 steps, the prefetch
+  toggle stays inside a float-ulp envelope, and the overlap-on lowered
+  HLO carries more than one reduce-scatter (one per bucket riding the
+  all-to-all wire) instead of a single fused tail collective.
 
 Prints one JSON line with the measured numbers.
 """
@@ -24,7 +30,7 @@ import sys
 # the caller's XLA_FLAGS said
 _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
           if "xla_force_host_platform_device_count" not in f]
-_flags.append("--xla_force_host_platform_device_count=2")
+_flags.append("--xla_force_host_platform_device_count=4")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -45,9 +51,12 @@ def main() -> int:
     from paddle_tpu.distributed import compression as C
     from paddle_tpu.testing import faults
 
-    assert len(jax.devices()) >= 2, jax.devices()
+    assert len(jax.devices()) >= 4, jax.devices()
     out = {"devices": len(jax.devices())}
-    topo = dist.init_mesh(dp=2, set_global=False)
+    # the quantized-wire checks keep their original 2-device dp mesh;
+    # the overlap sweep uses all 4 (its acceptance topology)
+    topo = dist.init_mesh(dp=2, devices=jax.devices()[:2],
+                          set_global=False)
 
     rs = np.random.RandomState(0)
     w_true = rs.randn(8, 4).astype(np.float32)
@@ -125,9 +134,74 @@ def main() -> int:
             out["bitflip_raises"] = True
     faults.clear()
 
+    out.update(overlap_sweep(emit=False))
     print(json.dumps({"comm_smoke": "ok", **out}))
     return 0
 
 
+def overlap_sweep(emit: bool = True) -> dict:
+    """ISSUE 11 acceptance sweep on the full 4-device mesh: overlap
+    on/off bit-parity after 3 steps, prefetch ulp envelope, and >1
+    reduce-scatter in the overlap-on lowered HLO."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu  # noqa: F401
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import overlap as OV
+
+    out = {}
+    topo = dist.init_mesh(fsdp=4, set_global=False)
+    params, stacked, emb, blk, lf = OV.mlp_block_model(n_layers=3)
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(16, 16), jnp.float32)
+    y = jnp.asarray(rs.randn(16, 8), jnp.float32)
+
+    def run(overlap, prefetch):
+        sp, st, step = OV.overlap_parallel(
+            dict(params), emb, blk, lf, optim.SGD(learning_rate=0.05),
+            topo.mesh, stacked, comm_quant="int8", overlap=overlap,
+            prefetch=prefetch, bucket_mb=1e-4)
+        lowered = step.lower(sp, st, x, y).as_text()
+        for _ in range(3):
+            sp, st, loss = step(sp, st, x, y)
+        return {k: np.asarray(v) for k, v in
+                jax.device_get(sp).items()}, float(loss), lowered
+
+    p_on, l_on, hlo_on = run(True, False)
+    p_off, l_off, _ = run(False, False)
+    # bit-parity: toggling overlap alone moves ONLY collective placement
+    for k in p_on:
+        assert np.array_equal(p_on[k], p_off[k]), \
+            f"overlap on/off params diverged at {k!r}"
+    out["overlap_bit_parity"] = True
+    out["overlap_on_loss"] = round(l_on, 6)
+    # >1 reduce-scatter in the lowered HLO: each bucket rides its own
+    # all-to-all exchange instead of one fused tail collective. Count
+    # only int8-PAYLOAD all_to_alls (each bucket also moves an fp32
+    # scales exchange, so a raw op count could pass with every bucket
+    # fused into one tail exchange). Lowered text is StableHLO
+    # ("all_to_all", one op per line, i8 element type in the signature).
+    import re
+    n_a2a = len([ln for ln in hlo_on.splitlines()
+                 if re.search(r"all[_-]to[_-]all", ln)
+                 and "xi8>" in ln])
+    out["overlap_on_hlo_int8_all_to_all"] = n_a2a
+    assert n_a2a > 1, f"expected >1 int8 reduce-scatter, HLO has {n_a2a}"
+    # prefetch toggle: float-ulp envelope (the double-buffered carry
+    # legitimately changes matmul layouts — see overlap.py docstring)
+    p_pf, l_pf, _ = run(True, True)
+    delta = max(float(np.max(np.abs(p_pf[k] - p_on[k]))) for k in p_on)
+    out["prefetch_max_delta"] = delta
+    assert delta <= 1e-6, f"prefetch toggle drifted {delta}"
+    if emit:   # standalone (--overlap) path prints its own one line
+        print(json.dumps({"overlap_sweep": "ok", **out}))
+    return out
+
+
 if __name__ == "__main__":
+    if "--overlap" in sys.argv[1:]:
+        overlap_sweep()
+        sys.exit(0)
     sys.exit(main())
