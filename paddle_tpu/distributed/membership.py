@@ -41,14 +41,31 @@ class ReplicaDirectory:
 
     def announce(self, rid: str, meta: Optional[dict] = None):
         """Register ``rid`` (idempotent for re-announce: metadata is
-        overwritten, the index gains at most one extra pointer)."""
+        overwritten, the index gains at most one extra pointer).
+
+        ``meta`` carries the replica's STATIC description — the router
+        reads it once per membership refresh. The serving fields the
+        disaggregated router places by: ``role`` (``prefill`` /
+        ``decode`` / ``both``), ``page`` (KV page size), ``max_bucket``
+        (largest prefill bucket — the router's bucket-fit screen),
+        ``slots``."""
         self.store.set(f"{self.ns}/meta/{rid}",
                        json.dumps(meta or {}))
         i = self.store.add(f"{self.ns}/n", 1)
         self.store.set(f"{self.ns}/idx/{i}", rid)
         self.heartbeat(rid)
 
-    def heartbeat(self, rid: str) -> int:
+    def heartbeat(self, rid: str, load: Optional[dict] = None) -> int:
+        """Bump the liveness counter; when ``load`` is given, refresh
+        the replica's gauge-style load fields FIRST (so an observer
+        that sees the new counter sees load at least that fresh).
+        Routing state therefore costs the router ONE store read per
+        replica per poll (:meth:`load`) — no per-request round trips.
+        The disaggregated router's fields: ``queued`` (admission queue
+        depth), ``free_slots``, ``free_pages``, ``kv_bytes``
+        (outstanding KV bytes across live slots)."""
+        if load is not None:
+            self.store.set(f"{self.ns}/load/{rid}", json.dumps(load))
         return self.store.add(f"{self.ns}/hb/{rid}", 1)
 
     # -- observer side ------------------------------------------------------
@@ -72,6 +89,15 @@ class ReplicaDirectory:
             except (TimeoutError, ValueError):
                 continue
         return out
+
+    def load(self, rid: str) -> Optional[dict]:
+        """The replica's last heartbeat-refreshed load gauges (one
+        store read), or None when it has never published any."""
+        try:
+            return json.loads(
+                self.store.get(f"{self.ns}/load/{rid}", timeout=0.05))
+        except (TimeoutError, ValueError):
+            return None
 
     def _counter(self, rid: str) -> Optional[int]:
         from paddle_tpu import native

@@ -253,6 +253,21 @@ declare_env("PT_SERVE_ROUTER_PORT", "TCPStore port for the multi-"
 declare_env("PT_SERVE_LOADGEN_SEED", "Deterministic load-generator "
             "seed — one knob pinning the exact SLO-bench/CI workload.",
             default="0", owner="serving/loadgen.py")
+declare_env("PT_KV_WIRE", "KV-page transfer wire format for "
+            "disaggregated prefill/decode serving and the fleet prefix "
+            "directory: int8 (default, block-scaled ~3.9x compression), "
+            "fp8, or fp32 (bit-identity opt-out — disaggregated decode "
+            "exactly matches same-replica serving).", default="int8",
+            owner="serving/kv_transfer.py")
+declare_env("PT_SERVE_ROLE", "This serving replica's role in a "
+            "disaggregated fleet: both (symmetric, default), prefill "
+            "(big-bucket prefill only, KV handed off over the wire), "
+            "decode (installs handoffs, deep decode occupancy).",
+            default="both", owner="serving/disagg.py")
+declare_env("PT_FLEET_PREFIX", "0 disables the fleet-wide prefix-cache "
+            "directory (publication, lookup, and the router's "
+            "pre-placement consult) — replicas fall back to local "
+            "radix caches only.", default="1", owner="serving/disagg.py")
 declare_env("PT_PAGED_FUSED", "0 disables the fused append+attend paged "
             "decode kernel, restoring the read-only-pool + one-scatter-"
             "per-token formulation (the parity reference).", default="1",

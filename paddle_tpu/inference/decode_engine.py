@@ -233,6 +233,18 @@ class ResilientScheduler:
         """Requests submitted but not yet assigned a slot."""
         return len(self._waiting)
 
+    @property
+    def kv_bytes(self) -> int:
+        """Outstanding KV bytes across live slots — the load gauge
+        role-aware routing places decode work by. Slot-contiguous
+        engines charge the full per-slot cache window per live slot;
+        the paged engine overrides with pages actually held."""
+        live = sum(r is not None for r in self._slot_req)
+        cfg = self.cfg
+        per_slot = (2 * cfg.n_layers * cfg.kv_heads * self.T
+                    * cfg.head_dim * np.dtype(self.kc.dtype).itemsize)
+        return live * per_slot
+
     def _on_evict(self, slot: int):
         self.active = self.active.at[slot].set(False)
 

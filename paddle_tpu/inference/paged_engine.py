@@ -89,6 +89,14 @@ from paddle_tpu.ops.pallas.paged_attention import (paged_append_attend,
 __all__ = ["PagedDecodeEngine"]
 
 
+class _HandoffRequest(Request):
+    """A request whose prefill happened on another replica: carries the
+    wire KV pages and the prefill-sampled first token until admission
+    installs them (``PagedDecodeEngine._admit_handoff``)."""
+
+    __slots__ = ("kv_first", "kv_pages", "kv_wire")
+
+
 class PagedDecodeEngine(ResilientScheduler):
     """Continuous-batching greedy generation over a paged KV pool.
 
@@ -114,7 +122,8 @@ class PagedDecodeEngine(ResilientScheduler):
                  buckets=(16, 32, 64, 128, 256, 512),
                  share_weights_with=None, inflight=None,
                  warmup: bool = False, fused: Optional[bool] = None,
-                 prefix: Optional[bool] = None):
+                 prefix: Optional[bool] = None,
+                 prefill_only: bool = False):
         from paddle_tpu import compile_cache
         from paddle_tpu.inference.decode_engine import (
             resolve_engine_weights)
@@ -159,6 +168,19 @@ class PagedDecodeEngine(ResilientScheduler):
                      if prefix is None else bool(prefix))
         self._prefix = (PrefixCache(self._alloc, self.page)
                         if prefix_on else None)
+        # disaggregated serving (docs/serving.md): a prefill-only
+        # engine admits + prefills but never activates decode — the
+        # finished pages leave via detach_handoff; fleet is an optional
+        # FleetPrefixDirectory (serving/disagg.py) consulted at
+        # admission when the local prefix cache misses
+        self.prefill_only = bool(prefill_only)
+        self.fleet = None
+        # pages whose KV arrived over a LOSSY wire (int8/fp8 handoff or
+        # fleet fetch): fine to serve and to share locally, but never
+        # re-published to the fleet under the original content digest —
+        # re-quantizing already-quantized pages would compound the
+        # half-step error without bound across hops
+        self._lossy_pids: set = set()
         self._tables: List[List[int]] = [[] for _ in range(self.S)]
         # slots evicted for non-finite logits: their pages are scrubbed
         # (zeroed) as they return to the free list (see _release)
@@ -201,6 +223,16 @@ class PagedDecodeEngine(ResilientScheduler):
     @property
     def free_pages(self) -> int:
         return self._alloc.free_pages
+
+    @property
+    def kv_bytes(self) -> int:
+        """Outstanding KV bytes (pages mapped by slots, both pools) —
+        the decode-placement load gauge the disaggregated router reads
+        from the heartbeat (membership.heartbeat(load=...))."""
+        per_page = (2 * self.cfg.n_layers * self.cfg.kv_heads
+                    * self.page * self.cfg.head_dim
+                    * np.dtype(self.kp.dtype).itemsize)
+        return sum(len(t) for t in self._tables) * per_page
 
     def _update_pool_gauges(self):
         from paddle_tpu import stats
@@ -254,6 +286,8 @@ class PagedDecodeEngine(ResilientScheduler):
             self._tainted.discard(slot)
             scrub.extend(tab)
         self._alloc.release(tab)
+        self._lossy_pids.difference_update(tab)
+        self._lossy_pids.difference_update(scrub)
         if scrub:
             self._scrub_pages(scrub)
         self._update_pool_gauges()
@@ -723,9 +757,18 @@ class PagedDecodeEngine(ResilientScheduler):
         page, so that page is copied to a private one (copy-on-write on
         the first partial page). Counters for the lookup land in
         ``_admit`` AFTER the reservation succeeds — a MemoryError-
-        retried admission must not double-count its hit tokens."""
+        retried admission must not double-count its hit tokens.
+
+        With a fleet directory attached, a LOCAL miss extends through
+        the fleet: pages another replica registered are fetched over
+        the KV wire, installed into private pages, ADOPTED into the
+        local cache (so the retry path and every later submit see them
+        as local hits), and the match continues — a prefix warm on any
+        replica skips that prefill here too."""
         chain = self._prefix.chain(prompt)
         matched = self._prefix.lookup(prompt, chain=chain)
+        if self.fleet is not None and len(matched) < len(chain):
+            matched.extend(self._fleet_extend(chain, len(matched)))
         n = len(prompt)
         sp, cow_src = 0, -1
         if matched and len(matched) * self.page >= n:
@@ -739,6 +782,123 @@ class PagedDecodeEngine(ResilientScheduler):
         if matched:
             self._table_dirty = True
         return sp, cow_src, chain
+
+    def attach_fleet(self, fleet):
+        """Wire a ``serving/disagg.FleetPrefixDirectory`` into this
+        engine: admission lookups extend through the fleet on a local
+        miss, newly-registered prefixes publish, and local
+        invalidation/reclaim withdraws fleet-wide (the prefix cache's
+        ``on_drop`` hook — BEFORE the freed page can be remapped, so no
+        sharer ever fetches a stale digest)."""
+        if self._prefix is None:
+            raise ValueError("fleet prefix directory needs the local "
+                             "prefix cache (PT_PAGED_PREFIX=1)")
+        self.fleet = fleet
+
+        def _drop(digest, pid):
+            fleet.withdraw(digest)
+            self._lossy_pids.discard(pid)
+
+        self._prefix.on_drop = _drop
+
+    def _alloc_one_page(self):
+        """One free page for a fleet-fetched prefix, reclaiming LRU
+        refcount-zero cache pages under pressure (same policy as
+        ``_reserve``); raises MemoryError when the pool is truly
+        full."""
+        tmp: List[int] = []
+        try:
+            self._alloc.reserve(tmp, self.page)
+        except MemoryError:
+            if self._prefix.reclaim(1) == 0:
+                raise
+            self._alloc.reserve(tmp, self.page)
+        return tmp[0]
+
+    def _fleet_extend(self, chain, start):
+        """Continue a local prefix match through the fleet directory:
+        fetch each next digest's page over the KV wire, install it into
+        a private page, adopt it into the local cache (ref'd for this
+        admission), stop at the first fleet miss / pool-full. Counters:
+        one ``serve/fleet_prefix_lookup`` per consulted admission,
+        ``serve/fleet_prefix_hit_tokens`` per page of prefill skipped
+        fleet-wide."""
+        from paddle_tpu import stats
+        got: List[int] = []
+        uploads: List[tuple] = []         # (pid, k_page, v_page)
+        stats.add("serve/fleet_prefix_lookup")
+        for digest in chain[start:]:
+            # a stale DESCENDANT may still be canonical locally (its
+            # parent was reclaimed; lookup broke at the hole): revive
+            # it instead of re-fetching — adopt would refuse it
+            pid = self._prefix.revive(digest)
+            if pid is not None:
+                got.append(pid)
+                continue
+            try:
+                res = self.fleet.fetch(digest)
+            except RuntimeError:
+                # the wire guard tripped on this fleet page (owner
+                # published before its own poison detection, or store
+                # corruption): expunge the entry so the fleet heals,
+                # and prefill this prefix cold — ONE request pays a
+                # cold prefill, the replica never dies of it
+                self.fleet.withdraw(digest, force=True)
+                res = None
+            except TimeoutError:
+                res = None              # store hiccup: treat as miss
+            if res is None:
+                break
+            k_page, v_page = res          # (L, 1, Hkv, page, D) host
+            try:
+                pid = self._alloc_one_page()
+            except MemoryError:
+                break                     # partial fleet hit is fine
+            self._prefix.adopt(digest, pid)
+            if self.fleet.wire != "fp32":
+                self._lossy_pids.add(pid)
+            got.append(pid)
+            uploads.append((pid, k_page, v_page))
+            stats.add("serve/fleet_prefix_hit_tokens", self.page)
+        if uploads:
+            # ONE batched pool update per pool for the whole fetch run
+            # (each .at[].set materializes a full pool copy — per-page
+            # updates would pay 2m copies for m pages)
+            L = self.cfg.n_layers
+            # ptlint: disable=PT001 -- uploads carries host ints and
+            # already-host page arrays; this builds an index upload
+            pids = np.asarray([u[0] for u in uploads], np.int32)
+            ids = (np.arange(L, dtype=np.int32)[:, None] * self.P
+                   + pids[None, :]).ravel()
+            ks = np.stack([u[1][:, 0] for u in uploads],
+                          axis=1).reshape(ids.size,
+                                          *uploads[0][1].shape[2:])
+            vs = np.stack([u[2][:, 0] for u in uploads],
+                          axis=1).reshape(ids.size,
+                                          *uploads[0][2].shape[2:])
+            self.kp = self.kp.at[ids].set(jnp.asarray(ks,
+                                                      self.kp.dtype))
+            self.vp = self.vp.at[ids].set(jnp.asarray(vs,
+                                                      self.vp.dtype))
+        return got
+
+    def _fleet_publish(self):
+        """Publish the pages the LAST ``register`` made newly canonical
+        to the fleet directory — content-addressed, so replicas racing
+        on the same prefix converge on first-writer-wins."""
+        newly = getattr(self._prefix, "last_registered", [])
+        for _i, digest, pid in newly:
+            if pid in self._lossy_pids:
+                continue
+            ids = (np.arange(self.cfg.n_layers, dtype=np.int32)
+                   * self.P + pid)
+            # ptlint: disable=PT001 -- deliberate device→host transfer:
+            # this IS the fleet KV-page publication (admission cadence,
+            # newly-registered pages only — never steady-state decode)
+            k = np.asarray(self.kp[ids])[:, None]
+            # ptlint: disable=PT001 -- same deliberate transfer (v pool)
+            v = np.asarray(self.vp[ids])[:, None]
+            self.fleet.publish(digest, k, v)
 
     def _corrupt_shared_pages(self, shared):
         """Payload fault site ``paged.shared_page``: with a matching
@@ -791,7 +951,11 @@ class PagedDecodeEngine(ResilientScheduler):
             if n >= self.page:
                 # register this prompt's full pages (private ones
                 # become canonical for future hits; already-cached
-                # digests skip)
+                # digests skip). NOTE: at this point the pages are
+                # still EMPTY for a cold prompt — the prefill dispatch
+                # below fills them; fleet publication therefore waits
+                # for the dispatched prefill (after the trace.span
+                # blocks), reading back only newly-canonical pages.
                 self._prefix.register(prompt, tab, chain=chain)
                 self._update_pool_gauges()
             # counters only once the reservation held — the
@@ -847,6 +1011,12 @@ class PagedDecodeEngine(ResilientScheduler):
                     self._head, self._stacked, self.kp, self.vp,
                     jnp.asarray(padded), jnp.int32(n),
                     jnp.asarray(segs))
+        if self.fleet is not None and self._prefix is not None \
+                and n >= self.page:
+            # the prefill dispatch that fills the registered pages is
+            # enqueued; publication reads them back (block_until_ready
+            # implicit in the host transfer) — newly-canonical only
+            self._fleet_publish()
         rem0 = req.max_new_tokens - 1
         eos0 = -1 if req.eos_id is None else int(req.eos_id)
         # a budget-of-one request (or one whose first token is eos)
@@ -855,13 +1025,19 @@ class PagedDecodeEngine(ResilientScheduler):
             rem0 > 0, jnp.logical_or(eos0 < 0, nxt != eos0))
         self.lengths = self.lengths.at[slot].set(n)
         self.last = self.last.at[slot].set(nxt)
-        self.active = self.active.at[slot].set(alive)
+        if self.prefill_only:
+            # a prefill replica never decodes: the slot stays bound
+            # (its pages leave via detach_handoff) but device-inactive,
+            # and the dispatch loop skips it (_disp_rem stays 0)
+            self.active = self.active.at[slot].set(False)
+        else:
+            self.active = self.active.at[slot].set(alive)
         self.remaining = self.remaining.at[slot].set(rem0)
         self.eos_ids = self.eos_ids.at[slot].set(eos0)
         self._slot_req[slot] = req
         self._host_len[slot] = n
         self._proj_len[slot] = n
-        self._disp_rem[slot] = rem0
+        self._disp_rem[slot] = 0 if self.prefill_only else rem0
         self._pending.append(_Inflight("prefill", [(slot, req)], nxt,
                                        time.perf_counter()))
 
@@ -877,6 +1053,165 @@ class PagedDecodeEngine(ResilientScheduler):
             self._release(slot)
             self.active = self.active.at[slot].set(False)
             self._obs_request_end(req)
+
+    # -- disaggregated handoff (docs/serving.md "Disaggregated serving") ----
+
+    def detach_handoff(self, req: Request):
+        """Extract a prefilled request's KV pages + decode state and
+        retire it WITHOUT decoding — the prefill replica's half of the
+        prefill→transfer→decode handoff. Requires ``prefill_only``
+        admission (the slot never decoded, so the pages hold exactly
+        the prompt's KV and the state is 'right after prefill'). Call
+        once ``req.tokens`` holds the prefill-sampled first token.
+
+        Returns ``(meta, k, v)``: ``meta`` carries everything
+        ``submit_handoff`` needs to reconstruct bit-identical device
+        state on the decode replica (prompt, first token, remaining
+        budget, eos), ``k``/``v`` are (L, npages, Hkv, page, D) host
+        arrays of the prompt's pages (tail rows past the prompt are
+        recycled-pool garbage — the wire codec zeroes them; decode
+        overwrites before reading either way)."""
+        if not self.prefill_only:
+            raise ValueError("detach_handoff needs a prefill_only "
+                             "engine (a decoding slot's pages are "
+                             "already past the prefill state)")
+        if req.failed:
+            raise ValueError(f"request failed before detach: {req.error}")
+        if not req.tokens:
+            raise ValueError("prefill not harvested yet — pump step() "
+                             "until req.tokens holds the first token")
+        self._drain()
+        try:
+            slot = self._slot_req.index(req)
+        except ValueError:
+            raise ValueError("request no longer holds a slot "
+                             "(budget-1 requests retire at harvest — "
+                             "publish their result directly)")
+        n = int(self._host_len[slot])
+        npg = (n + self.page - 1) // self.page
+        tab = list(self._tables[slot][:npg])
+        ids = (np.arange(self.cfg.n_layers, dtype=np.int32)[:, None]
+               * self.P + np.asarray(tab, np.int32)[None, :]).ravel()
+        L = self.cfg.n_layers
+        # ptlint: disable=PT001 -- deliberate device→host transfer: this
+        # IS the KV handoff payload leaving the prefill replica
+        k = np.asarray(self.kp[ids]).reshape(
+            L, npg, self.cfg.kv_heads, self.page, self.cfg.head_dim)
+        v = np.asarray(self.vp[ids]).reshape(
+            L, npg, self.cfg.kv_heads, self.page, self.cfg.head_dim)
+        meta = {"prompt": list(req.prompt), "n_tokens": n,
+                "first": int(req.tokens[0]),
+                "max_new_tokens": int(req.max_new_tokens),
+                "eos_id": req.eos_id}
+        # retire cleanly: registered prefix pages go warm (they stay
+        # published/fleet-canonical on this replica), private ones free
+        self._slot_req[slot] = None
+        self._release(slot)
+        req.done = True
+        self._obs_request_end(req)
+        return meta, k, v
+
+    def submit_handoff(self, meta: dict, k, v,
+                       deadline_s: Optional[float] = None) -> Request:
+        """Decode-replica half of the handoff: enqueue a request whose
+        prefill already happened elsewhere. Admission (when a slot
+        frees) installs the wire pages into this pool and reconstructs
+        the exact post-prefill device state, so decode continues
+        bit-for-bit where the prefill replica stopped (the fp32-wire
+        bit-identity contract); the prefill-sampled first token rides
+        the harvest queue like any local prefill's."""
+        import time
+        req = _HandoffRequest(
+            meta["prompt"], meta["max_new_tokens"], meta["eos_id"],
+            deadline=(None if deadline_s is None
+                      else time.monotonic() + deadline_s))
+        req.kv_first = int(meta["first"])
+        req.kv_pages = (np.asarray(k), np.asarray(v))
+        # the wire these pages crossed (senders stamp it into the
+        # handoff meta); absent → assume lossy, so the pages are never
+        # re-published under the original content digest
+        req.kv_wire = str(meta.get("wire", "lossy"))
+        # NOT check_request: its bucket cap is a PREFILL constraint,
+        # and a handoff never prefills here — decode replicas may
+        # legitimately run smaller buckets than the prefill tier.
+        # What must still hold: a non-empty prompt and a cache window
+        # that fits prompt + budget.
+        if len(req.prompt) < 1:
+            raise ValueError("empty prompt")
+        if len(req.prompt) + req.max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError("prompt + new tokens exceed max_seq_len")
+        # geometry screen HERE (ValueError a serve loop turns into a
+        # per-request result): a mismatched fleet config surfacing as a
+        # shape error inside a later engine.step() would kill the
+        # replica and every other in-flight request on it
+        cfg = self.cfg
+        want = (cfg.n_layers,
+                (len(req.prompt) + self.page - 1) // self.page,
+                cfg.kv_heads, self.page, cfg.head_dim)
+        for name, arr in (("k", req.kv_pages[0]), ("v",
+                                                   req.kv_pages[1])):
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"handoff {name} pages shaped {tuple(arr.shape)} "
+                    f"do not fit this engine's geometry {want} — "
+                    "prefill and decode replicas must share "
+                    "(n_layers, page_size, kv_heads, head_dim)")
+        self._waiting.append(req)
+        return req
+
+    def _admit_handoff(self, req: "_HandoffRequest", slot: int):
+        """Install transferred pages instead of prefilling: reserve,
+        upload the page rows, register the prompt's full pages locally
+        (future submits of the same prefix hit them — and publish to
+        the fleet like any registration), then reconstruct the device
+        state the prefill replica's ``_admit`` would have left."""
+        import time
+        n = len(req.prompt)
+        self._reserve(slot, n)
+        tab = self._tables[slot]
+        k, v = req.kv_pages
+        npg = k.shape[1]
+        L = self.cfg.n_layers
+        # ptlint: disable=PT001 -- tab is a host int list (slot table);
+        # this builds an index upload, never a device sync
+        tab_arr = np.asarray(tab[:npg], np.int32)
+        ids = (np.arange(L, dtype=np.int32)[:, None] * self.P
+               + tab_arr[None, :]).ravel()
+        self.kp = self.kp.at[ids].set(
+            jnp.asarray(k.reshape(ids.size, *k.shape[2:]),
+                        self.kp.dtype))
+        self.vp = self.vp.at[ids].set(
+            jnp.asarray(v.reshape(ids.size, *v.shape[2:]),
+                        self.vp.dtype))
+        req.kv_pages = None            # free the host copy
+        if req.kv_wire != "fp32":
+            self._lossy_pids.update(tab[:npg])
+        if self._prefix is not None and n >= self.page:
+            # ptlint: disable=PT001 -- req.prompt is a host int list
+            # (submit coerced it); this is an upload, never a sync
+            prompt = np.asarray(req.prompt, np.int32)
+            self._prefix.register(prompt, tab)
+            self._update_pool_gauges()
+            if self.fleet is not None:
+                self._fleet_publish()
+        nxt = req.kv_first
+        rem0 = req.max_new_tokens - 1
+        eos0 = -1 if req.eos_id is None else int(req.eos_id)
+        alive = rem0 > 0 and (eos0 < 0 or nxt != eos0)
+        self.lengths = self.lengths.at[slot].set(n)
+        self.last = self.last.at[slot].set(jnp.int32(nxt))
+        self.active = self.active.at[slot].set(bool(alive))
+        self.remaining = self.remaining.at[slot].set(rem0)
+        self.eos_ids = self.eos_ids.at[slot].set(eos0)
+        self._slot_req[slot] = req
+        self._host_len[slot] = n
+        self._proj_len[slot] = n
+        self._disp_rem[slot] = rem0
+        # the first token rides the harvest queue exactly like a local
+        # prefill's sampled token (replay does _emit(int(payload)))
+        self._pending.append(_Inflight("prefill", [(slot, req)],
+                                       np.int32(nxt),
+                                       time.perf_counter()))
 
     def step(self) -> int:
         import time
@@ -914,7 +1249,10 @@ class PagedDecodeEngine(ResilientScheduler):
                 return
             req = self._waiting.popleft()
             try:
-                self._admit(req, slot)
+                if isinstance(req, _HandoffRequest):
+                    self._admit_handoff(req, slot)
+                else:
+                    self._admit(req, slot)
             except MemoryError:
                 # not enough pages right now: return the partial
                 # reservation and requeue. Retired pages may be stuck

@@ -43,7 +43,23 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "chain_digests"]
+
+
+def chain_digests(tokens, page: int) -> List[bytes]:
+    """Cumulative digests of ``tokens``' FULL pages (one per page; the
+    trailing partial page has no digest — it is not shareable). The ONE
+    digest definition: the local radix cache, the fleet-wide directory
+    (serving/disagg.py), and the router's pre-placement consult must
+    agree byte-for-byte or cross-replica hits silently vanish."""
+    toks = np.asarray(tokens, np.int32)
+    out, digest = [], b"paged-prefix-v1"
+    for i in range(len(toks) // page):
+        h = hashlib.sha1(digest)
+        h.update(toks[i * page:(i + 1) * page].tobytes())
+        digest = h.digest()
+        out.append(digest)
+    return out
 
 
 class PrefixCache:
@@ -71,20 +87,22 @@ class PrefixCache:
         # their trie nodes are gone, and the last unref frees them to
         # the allocator instead of warming the LRU
         self._dead: set = set()
+        # fleet hook: called with (digest, pid) whenever a trie node is
+        # REMOVED (invalidate / reclaim) — the disaggregated serving
+        # layer withdraws the digest from the fleet-wide prefix
+        # directory here, so eviction/poison on the owning replica
+        # invalidates fleet-wide before any sharer can map a stale
+        # page (serving/disagg.py)
+        self.on_drop = None
 
     # -- chain hashing ------------------------------------------------------
 
     def chain(self, tokens) -> List[bytes]:
         """Cumulative digests of ``tokens``' FULL pages (one per page;
-        the trailing partial page has no digest — it is not shareable)."""
-        toks = np.asarray(tokens, np.int32)
-        out, digest = [], b"paged-prefix-v1"
-        for i in range(len(toks) // self.page):
-            h = hashlib.sha1(digest)
-            h.update(toks[i * self.page:(i + 1) * self.page].tobytes())
-            digest = h.digest()
-            out.append(digest)
-        return out
+        the trailing partial page has no digest — it is not
+        shareable). Delegates to module-level :func:`chain_digests` —
+        the shared definition the fleet directory and router reuse."""
+        return chain_digests(tokens, self.page)
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -161,6 +179,8 @@ class PrefixCache:
             # healthy page (the poisoned prompt re-submitted), a late
             # sharer's failure must not de-canonicalize the new copy
             self._nodes.pop(digest)
+            if self.on_drop is not None:
+                self.on_drop(digest, pid)
         if self._refs.get(pid, 0) == 0:
             # warm and unmapped: free immediately
             self._zero.pop(pid, None)
@@ -190,6 +210,38 @@ class PrefixCache:
             pids.append(pid)
         return pids
 
+    def revive(self, digest: bytes) -> Optional[int]:
+        """Ref-and-return the page canonical under ``digest``, or None.
+        The fleet-extend path uses this for STALE DESCENDANTS: reclaim
+        drops one node and leaves its children canonical-but-
+        unreachable (lookup breaks at the missing parent); when the
+        missing parents are refetched from the fleet, the surviving
+        child pages resume service locally — their KV is valid
+        regardless (the chain digest encodes the full left context),
+        and re-adopting them would be a KeyError."""
+        pid = self._nodes.get(digest)
+        if pid is None:
+            return None
+        self.ref(pid)
+        return pid
+
+    def adopt(self, digest: bytes, pid: int):
+        """Insert ONE already-populated page under ``digest`` with the
+        caller's mapping as its first ref — the fleet-fetch install
+        path: a page whose KV just arrived over the wire becomes
+        canonical locally so the admission that fetched it (and every
+        later submit of the same prefix) maps it like a local hit.
+        Refuses an occupied digest or an already-cached pid (the caller
+        checked the miss before paying the fetch)."""
+        if digest in self._nodes:
+            raise KeyError("digest already canonical")
+        if pid in self._bypid:
+            raise KeyError(f"page {pid} already cached")
+        self._nodes[digest] = pid
+        self._bypid[pid] = digest
+        self._refs[pid] = 1
+        self._n_shared += 1
+
     def register(self, tokens, table: List[int],
                  chain: Optional[List[bytes]] = None) -> int:
         """Insert ``tokens``' full pages (backed by ``table``'s leading
@@ -198,8 +250,12 @@ class PrefixCache:
         existing copy stays canonical and the caller's private
         duplicate is freed normally at retirement. Returns the number
         of pages newly registered (each gains the caller's mapping as
-        its first ref)."""
+        its first ref); the newly-canonical ``(index, digest, pid)``
+        triples land in ``last_registered`` for the fleet-publication
+        hook (serving/disagg.py publishes exactly the new ones — never
+        a re-upload per admission)."""
         added = 0
+        self.last_registered: List[Tuple[int, bytes, int]] = []
         for i, digest in enumerate(self.chain(tokens)
                                    if chain is None else chain):
             if digest in self._nodes:
@@ -211,6 +267,7 @@ class PrefixCache:
             self._bypid[pid] = digest
             self._refs[pid] = 1          # the registering slot's mapping
             self._n_shared += 1
+            self.last_registered.append((i, digest, pid))
             added += 1
         return added
 
@@ -227,6 +284,8 @@ class PrefixCache:
             digest = self._bypid.pop(pid)
             if self._nodes.get(digest) == pid:
                 del self._nodes[digest]
+                if self.on_drop is not None:
+                    self.on_drop(digest, pid)
             self._refs.pop(pid, None)
             self._alloc.release([pid])
             freed += 1

@@ -627,8 +627,15 @@ class GPT(Module):
         L = self.cfg.n_layers
         dense = all(self.blocks[i].moe is None for i in range(L))
         prestacked = getattr(self, "_stacked_blocks", None)
-        if prestacked is not None or (dense and L > 1
-                                      and _flag("scan_layers")):
+        use_scan = prestacked is not None or (dense and L > 1
+                                              and _flag("scan_layers"))
+        if use_scan and scan_partition_hazard():
+            # ≥3-axis mesh on this CPU build: the scanned backward
+            # miscompiles (see scan_partition_hazard) — unroll instead.
+            # merge_params bound per-layer views of a pre-stacked state
+            # onto self.blocks, so the unrolled loop serves both forms.
+            use_scan = False
+        if use_scan:
             # in-trace stacking copies every block weight (and its grad
             # transpose un-stacks) — ~2x block-param HBM the unrolled
             # loop never needed; a state built by
@@ -901,6 +908,33 @@ def stacked_partition_specs(stacked, template_blk, spec_fn=None):
     _, _, specs = stacked_block_specs(template_blk, spec_fn)
     sleaves, streedef = jax.tree_util.tree_flatten(stacked)
     return sleaves, streedef, specs
+
+
+def scan_partition_hazard() -> bool:
+    """True when the scan-over-stacked-layers forward must NOT be used
+    under the current global mesh: on this CPU XLA build (jax 0.4.37),
+    GSPMD partitioning of a ``lax.scan`` whose xs carry the stacked
+    block weights MISCOMPILES the backward once the mesh has three or
+    more nontrivial axes (dp×tp×fsdp). Bisect evidence (tracked as the
+    former standing tier-1 reds, test_gpt_model tp_fsdp /
+    test_bert tp_sharded parity): every 1- and 2-axis mesh is
+    BIT-exact against the single-device step, the 3-axis mesh is off
+    by ~1e-3 in the loss and ~0.1 absolute in the wte gradient, and
+    float64 ground truth sides with the dense program (grad error
+    2.7e-8 dense vs 0.117 sharded) — wrong math, not reduction-order
+    noise. Unrolling the layer loop restores bit-exactness; activation
+    constraints, the vocab-parallel embedding, and `_gathered_table`
+    were all ruled out. TPU backends keep the scan (the 1.3B compile
+    time depends on it, and the bug reproduces only on this CPU
+    build's partitioner)."""
+    import jax as _jax
+    if _jax.default_backend() != "cpu":
+        return False
+    from paddle_tpu.distributed.mesh import get_mesh
+    mesh = get_mesh()
+    if mesh is None:
+        return False
+    return sum(1 for v in mesh.shape.values() if v > 1) >= 3
 
 
 def _shard_stacked(stacked, template_blk, mesh, spec_fn=None):

@@ -12,7 +12,12 @@ from paddle_tpu.serving.scheduler import (FrontEnd, ServeRequest,
 from paddle_tpu.serving.loadgen import (Arrival, poisson_trace,
                                         from_trace, replay)
 from paddle_tpu.serving.router import Router, serve_replica, router_port
+from paddle_tpu.serving.disagg import (FleetPrefixDirectory,
+                                       serve_prefill_replica,
+                                       serve_decode_replica, serve_role)
 
 __all__ = ["FrontEnd", "ServeRequest", "dynamic_bucket",
            "projected_ttft", "Arrival", "poisson_trace", "from_trace",
-           "replay", "Router", "serve_replica", "router_port"]
+           "replay", "Router", "serve_replica", "router_port",
+           "FleetPrefixDirectory", "serve_prefill_replica",
+           "serve_decode_replica", "serve_role"]

@@ -1,0 +1,475 @@
+"""Disaggregated prefill/decode serving: roles, the fleet-wide prefix
+directory, and the replica serve loops (docs/serving.md
+"Disaggregated serving"; ROADMAP open item 2).
+
+Real fleets split replicas by phase: prefill is compute-bound (big
+buckets, shallow batches), decode is memory-bound (deep occupancy).
+Co-locating them throttles each replica's batch by whichever phase it
+happens to run. Here:
+
+- **prefill replicas** (``PagedDecodeEngine(prefill_only=True)``) run
+  bucketed prefill only; the finished pages leave over the block-scaled
+  KV wire (``serving/kv_transfer.py``) as a per-request handoff blob on
+  the router's store.
+- **decode replicas** install handoff pages
+  (``engine.submit_handoff``) and run the normal harvest pipeline —
+  on the fp32 wire the token stream is bit-identical to same-replica
+  serving.
+- the **fleet prefix directory** (:class:`FleetPrefixDirectory`) layers
+  `inference/prefix_cache.py`'s page-aligned sha1 chain digests over
+  the TCPStore: a replica publishes every newly-canonical prefix page
+  (content-addressed — racing replicas converge first-writer-wins),
+  any replica's admission extends a local miss through the fleet
+  (suffix-only prefill on a hit: ``serve/fleet_prefix_hit_tokens``),
+  and local invalidation (poison) or reclaim (eviction) WITHDRAWS the
+  digest fleet-wide via the prefix cache's ``on_drop`` hook before the
+  page can be remapped. A fetch re-validates the directory entry's
+  generation after reading the payload, so a withdraw racing a fetch
+  makes the fetch a miss — a sharer can never install a stale page.
+
+Placement lives in ``serving/router.py`` (role- and KV-bytes-aware);
+this module owns the per-replica halves.
+"""
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from paddle_tpu.distributed.membership import ReplicaDirectory
+from paddle_tpu.serving import kv_transfer
+
+__all__ = ["FleetPrefixDirectory", "serve_prefill_replica",
+           "serve_decode_replica", "serve_role", "replica_load",
+           "fleet_enabled"]
+
+
+def serve_role() -> str:
+    """This replica's serving role (``PT_SERVE_ROLE``):
+    ``both`` (default — symmetric serving, PR 9 behavior),
+    ``prefill``, or ``decode``."""
+    role = os.environ.get("PT_SERVE_ROLE", "both").strip().lower()
+    if role not in ("both", "prefill", "decode"):
+        raise ValueError(
+            f"PT_SERVE_ROLE must be both|prefill|decode, got {role!r}")
+    return role
+
+
+def fleet_enabled() -> bool:
+    """``PT_FLEET_PREFIX`` (default on): 0 disables fleet prefix
+    directory publication and lookup — replicas fall back to their
+    local radix caches only."""
+    return os.environ.get("PT_FLEET_PREFIX", "1") != "0"
+
+
+def replica_load(engine, role: str, queued: int = 0) -> dict:
+    """The gauge-style load fields a replica refreshes with its
+    heartbeat (one store write per beat, one read per router poll):
+    role-aware routing places prefill by ``queued`` + bucket fit and
+    decode by ``kv_bytes`` + ``free_pages``."""
+    from paddle_tpu import stats
+    return {
+        "role": role,
+        "queued": int(queued),
+        "free_slots": int(engine.free_slots),
+        "free_pages": int(getattr(engine, "free_pages", 0)),
+        "kv_bytes": int(getattr(engine, "kv_bytes", 0)),
+        # process-local fleet counters ride the heartbeat so the
+        # router/CI can assert cross-replica hits without scraping
+        # replica processes
+        "fleet_hit_tokens": int(stats.get(
+            "serve/fleet_prefix_hit_tokens", 0)),
+        "kv_transfer_bytes_wire": int(stats.get(
+            "serve/kv_transfer_bytes_wire", 0)),
+    }
+
+
+class FleetPrefixDirectory:
+    """Fleet-wide radix-digest directory over the router's TCPStore.
+
+    One instance per replica process (``rid`` identifies the owner for
+    withdraw bookkeeping). Content-addressed entries::
+
+        fleetpfx/e/<digest-hex>        -> JSON {rid, gen}   (written LAST)
+        fleetpfx/pg/<digest-hex>/<gen> -> KV-wire blob (chunked)
+        fleetpfx/g/<digest-hex>        -> generation counter
+        fleetpfx/l/<digest-hex>        -> fetch lease counter
+
+    The **refcount lease** protocol: a fetcher bumps the lease before
+    reading the payload and drops it after; a withdraw deletes the
+    ENTRY first (no new fetchers) and deletes the payload chunks only
+    at lease zero (an in-flight fetch finishes its read, then discards
+    when the entry re-check fails). Invalidation is therefore ordered
+    before any possible stale mapping without ever blocking the owner.
+    """
+
+    def __init__(self, store, rid: str, wire: Optional[str] = None,
+                 namespace: str = "fleetpfx"):
+        self.store = store
+        self.rid = rid
+        self.ns = namespace
+        self.wire = kv_transfer.wire_format(wire)
+        self._published: Dict[bytes, int] = {}   # digest -> gen (owner)
+
+    # -- keys ---------------------------------------------------------------
+
+    def _ekey(self, digest: bytes) -> str:
+        return f"{self.ns}/e/{digest.hex()}"
+
+    def _pkey(self, digest: bytes, gen: int) -> str:
+        return f"{self.ns}/pg/{digest.hex()}/{gen}"
+
+    # -- owner side ---------------------------------------------------------
+
+    def publish(self, digest: bytes, k: np.ndarray, v: np.ndarray):
+        """Publish one page's KV under its chain digest (k/v:
+        (L, 1, Hkv, page, D) host arrays). Content-addressed: an entry
+        already present (any owner) wins — identical prefix KV is
+        deterministic in the weights, so racing replicas publishing the
+        same digest carry the same page."""
+        from paddle_tpu import stats
+        if digest in self._published:
+            return
+        # no existence probe: a store.get miss blocks its full timeout
+        # on the admission hot path. Racing publishers write IDENTICAL
+        # content (pages are deterministic in the weights, and lossy-
+        # wire copies are never re-published), each under its own
+        # generation — the last entry write wins, fetchers re-validate
+        # the gen, and each publisher deletes only its own generation's
+        # chunks on withdraw
+        gen = self.store.add(f"{self.ns}/g/{digest.hex()}", 1)
+        page = k.shape[3]
+        header, blob = kv_transfer.encode_kv_pages(
+            k, v, n_tokens=page, wire=self.wire)
+        kv_transfer.publish_blob(self.store, self._pkey(digest, gen),
+                                 header, blob)
+        # entry LAST: a reader that sees it can fetch the whole payload
+        self.store.set(self._ekey(digest),
+                       json.dumps({"rid": self.rid, "gen": gen}))
+        self._published[digest] = gen
+        stats.add("serve/fleet_prefix_published")
+
+    def withdraw(self, digest: bytes, force: bool = False):
+        """Invalidate a digest fleet-wide (eviction/poison on the
+        owning replica; the prefix cache's ``on_drop`` hook lands
+        here). Non-owners no-op unless ``force`` — replica B dropping
+        its private ADOPTED copy must not nuke A's canonical entry.
+        The entry key is deleted only when it still carries THIS
+        replica's (rid, gen): a publish race that let another replica
+        overwrite the entry means ours lost — deleting the winner's
+        live entry would silently evict a valid warm prefix. Our own
+        generation's payload chunks are deleted at lease zero either
+        way; with a lease outstanding the DISCARDING fetcher deletes
+        them (see :meth:`fetch`)."""
+        from paddle_tpu import stats
+        gen = self._published.pop(digest, None)
+        if gen is None and not force:
+            return
+        try:
+            ent = None
+            try:
+                ent = json.loads(self.store.get(self._ekey(digest),
+                                                timeout=0.05))
+            except (TimeoutError, ValueError):
+                pass
+            if gen is None and ent is not None:   # force: current gen
+                gen = int(ent["gen"])
+            if ent is not None and (force or (
+                    ent.get("rid") == self.rid
+                    and int(ent.get("gen", -1)) == gen)):
+                self.store.delete_key(self._ekey(digest))
+            if gen is not None:
+                try:
+                    leases = int(self.store.add(
+                        f"{self.ns}/l/{digest.hex()}", 0))
+                except Exception:
+                    leases = 0
+                if leases <= 0:
+                    kv_transfer.delete_blob(self.store,
+                                            self._pkey(digest, gen))
+            stats.add("serve/fleet_prefix_withdrawn")
+        except Exception:
+            pass                        # withdraw is best-effort
+
+    # -- fetcher side -------------------------------------------------------
+
+    def lookup(self, digest: bytes) -> bool:
+        """Directory-only probe (no payload): is the digest published?
+        The router's pre-placement consult."""
+        try:
+            self.store.get(self._ekey(digest), timeout=0.02)
+            return True
+        except TimeoutError:
+            return False
+
+    def covered(self, chain) -> int:
+        """How many LEADING digests of ``chain`` the fleet covers."""
+        n = 0
+        for digest in chain:
+            if not self.lookup(digest):
+                break
+            n += 1
+        return n
+
+    def fetch(self, digest: bytes):
+        """Fetch one page's KV, or None on miss. The entry is re-read
+        AFTER the payload: if it vanished or changed generation
+        mid-fetch (a racing withdraw — eviction or poison on the
+        owner), the payload is DISCARDED — the invalidation wins, no
+        stale page can be mapped."""
+        from paddle_tpu import stats
+        key = self._ekey(digest)
+        try:
+            ent = json.loads(self.store.get(key, timeout=0.02))
+        except (TimeoutError, ValueError):
+            return None
+        gen = int(ent["gen"])
+        lease = f"{self.ns}/l/{digest.hex()}"
+        self.store.add(lease, 1)
+        t0 = time.perf_counter()
+        try:
+            header, blob = kv_transfer.fetch_blob(
+                self.store, self._pkey(digest, gen), timeout=2.0)
+        except TimeoutError:
+            self.store.add(lease, -1)
+            return None                 # withdrawn mid-fetch
+        leases = self.store.add(lease, -1)
+        try:
+            ent2 = json.loads(self.store.get(key, timeout=0.02))
+            stale = int(ent2["gen"]) != gen
+        except (TimeoutError, ValueError):
+            stale = True                # withdrawn mid-fetch: discard
+        if stale:
+            # the owner's withdraw skipped chunk deletion while our
+            # lease was out — the discarding fetcher cleans up, so a
+            # withdraw-during-fetch never leaks the payload
+            if leases <= 0:
+                kv_transfer.delete_blob(self.store,
+                                        self._pkey(digest, gen),
+                                        nchunks=int(header["nchunks"]))
+            return None
+        k, v = kv_transfer.decode_kv_pages(header, blob)
+        stats.observe("serve/kv_transfer_s", time.perf_counter() - t0)
+        return k, v
+
+
+# ---------------------------------------------------------------------------
+# Replica serve loops (the role-split halves of router.serve_replica)
+# ---------------------------------------------------------------------------
+
+def _mailbox_pump(store, rid, seen):
+    """The ONE mailbox idiom lives in router.py; re-exported here for
+    the role loops below."""
+    from paddle_tpu.serving.router import _mailbox_pump as pump
+    return pump(store, rid, seen)
+
+
+def _shutdown_requested(store) -> bool:
+    from paddle_tpu.serving.router import (
+        _shutdown_requested as probe)
+    return probe(store)
+
+
+def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
+                          max_idle_s: Optional[float] = None,
+                          load_refresh_s: float = 0.25):
+    """One prefill replica's loop: consume the mailbox, run big-bucket
+    prefill only, and for each finished prefill publish the KV handoff
+    blob (``serve/kv/<req_id>``) plus a ``prefill-done`` result — the
+    router then places the decode phase on a decode replica. Requests
+    whose whole budget was the first token (or that failed) publish
+    their terminal result directly.
+
+    ``engine`` must be a ``PagedDecodeEngine(prefill_only=True)``;
+    attach a :class:`FleetPrefixDirectory` first so every prefix this
+    replica prefills becomes a fleet-wide hit."""
+    from paddle_tpu.serving.router import _publish
+    if not getattr(engine, "prefill_only", False):
+        raise ValueError("serve_prefill_replica needs a "
+                         "prefill_only=True engine")
+    directory = ReplicaDirectory(store)
+    directory.announce(rid, {
+        "pid": os.getpid(), "slots": engine.S, "role": "prefill",
+        "page": engine.page, "max_bucket": engine.buckets[-1]})
+    seen = 0
+    open_reqs: Dict[str, object] = {}
+    idle_since = time.monotonic()
+    last_load = 0.0
+    while True:
+        now = time.monotonic()
+        if now - last_load >= load_refresh_s:
+            directory.heartbeat(rid, load=replica_load(
+                engine, "prefill", queued=engine.queued))
+            last_load = now
+        else:
+            directory.heartbeat(rid)
+        if _shutdown_requested(store) and not open_reqs:
+            return
+        seen, msgs = _mailbox_pump(store, rid, seen)
+        for msg in msgs:
+            try:
+                req = engine.submit(
+                    msg["prompt"],
+                    max_new_tokens=msg["max_new_tokens"],
+                    eos_id=msg["eos_id"],
+                    deadline_s=msg.get("deadline_s"))
+            except ValueError as e:
+                # infeasible request: fail AS A RESULT (router.serve_
+                # replica's cascade rationale)
+                _publish(store, rid, msg["id"], {
+                    "id": msg["id"], "tokens": [],
+                    "status": "rejected-invalid", "error": str(e),
+                    "replica": rid})
+                continue
+            open_reqs[msg["id"]] = req
+        if open_reqs:
+            engine.step()
+            idle_since = time.monotonic()
+        else:
+            if (max_idle_s is not None
+                    and time.monotonic() - idle_since > max_idle_s):
+                return
+            time.sleep(poll_s)
+        for req_id, req in list(open_reqs.items()):
+            if req.failed or req.done:
+                # deadline/poison eviction, or a budget-1 request that
+                # retired at harvest: terminal here, no decode phase
+                _publish(store, rid, req_id, {
+                    "id": req_id, "tokens": list(req.tokens),
+                    "status": ("failed" if req.failed else "done"),
+                    "error": req.error, "replica": rid})
+                del open_reqs[req_id]
+            elif req.tokens:
+                # prefill harvested: hand off to a decode replica
+                meta, k, v = engine.detach_handoff(req)
+                header, blob = kv_transfer.encode_kv_pages(
+                    k, v, n_tokens=meta["n_tokens"])
+                # stamp the wire into the handoff meta: the decode
+                # replica refuses to re-publish lossy-wire pages under
+                # the original content digest (quantization error must
+                # not compound across hops)
+                header["handoff"] = dict(meta, wire=header["wire"])
+                kv_transfer.publish_blob(store, f"serve/kv/{req_id}",
+                                         header, blob)
+                _publish(store, rid, req_id, {
+                    "id": req_id, "tokens": [],
+                    "status": "prefill-done", "error": None,
+                    "replica": rid})
+                del open_reqs[req_id]
+
+
+def serve_decode_replica(store, rid: str, frontend,
+                         fleet: Optional[FleetPrefixDirectory] = None,
+                         poll_s: float = 0.02,
+                         max_idle_s: Optional[float] = None,
+                         load_refresh_s: float = 0.25):
+    """One decode replica's loop: the PR 9 serve loop plus two
+    disaggregation duties — ``handoff`` mailbox messages install
+    transferred KV pages (``frontend.submit_handoff``), and the
+    engine's fleet directory (attach before calling) turns any
+    replica's warm prefix into a local suffix-only prefill. Plain
+    ``req`` messages serve end-to-end exactly as symmetric replicas
+    (the router's fallback when no prefill replica is alive)."""
+    from paddle_tpu import stats
+    from paddle_tpu.serving.router import _publish
+    engine = frontend.engine
+    directory = ReplicaDirectory(store)
+    directory.announce(rid, {
+        "pid": os.getpid(), "slots": engine.S, "role": "decode",
+        "page": getattr(engine, "page", 0),
+        "max_bucket": engine.buckets[-1]})
+    seen = 0
+    open_reqs: Dict[str, object] = {}
+    idle_since = time.monotonic()
+    last_load = 0.0
+    while True:
+        now = time.monotonic()
+        if now - last_load >= load_refresh_s:
+            directory.heartbeat(rid, load=replica_load(
+                engine, "decode",
+                queued=len(frontend._queue) + engine.queued))
+            last_load = now
+        else:
+            directory.heartbeat(rid)
+        if _shutdown_requested(store) and not open_reqs \
+                and not frontend.busy:
+            return
+        seen, msgs = _mailbox_pump(store, rid, seen)
+        for msg in msgs:
+            try:
+                if msg.get("kind") == "handoff":
+                    t0 = time.perf_counter()
+                    try:
+                        # bounded below dead_after-scale stalls, and
+                        # heartbeat immediately after either way — a
+                        # slow fetch must not get this healthy replica
+                        # death-swept
+                        header, blob = kv_transfer.fetch_blob(
+                            store, f"serve/kv/{msg['id']}",
+                            timeout=2.0)
+                    finally:
+                        directory.heartbeat(rid)
+                    k, v = kv_transfer.decode_kv_pages(header, blob)
+                    stats.observe("serve/kv_transfer_s",
+                                  time.perf_counter() - t0)
+                    req = frontend.submit_handoff(
+                        header["handoff"], k, v,
+                        deadline_s=msg.get("deadline_s"),
+                        req_id=msg["id"])
+                    # sole consumer: reclaim the blob's store memory
+                    # (a redelivered handoff after this point fails
+                    # the fetch -> handoff-failed -> router re-places
+                    # from scratch; at-least-once keeps it safe)
+                    kv_transfer.delete_blob(
+                        store, f"serve/kv/{msg['id']}",
+                        nchunks=int(header.get("nchunks", 0)))
+                else:
+                    req = frontend.submit(
+                        msg["prompt"],
+                        max_new_tokens=msg["max_new_tokens"],
+                        eos_id=msg.get("eos_id"),
+                        deadline_s=msg.get("deadline_s"),
+                        priority=msg.get("priority", 0),
+                        req_id=msg["id"])
+            except TimeoutError as e:
+                # the handoff blob is missing/incomplete (prefill
+                # replica died mid-transfer, store hiccup): publish the
+                # RETRYABLE status — the router re-places the request
+                # from scratch (re-prefill), never surfaces this as a
+                # client-visible rejection
+                _publish(store, rid, msg["id"], {
+                    "id": msg["id"], "tokens": [],
+                    "status": "handoff-failed", "error": str(e),
+                    "replica": rid})
+                continue
+            except (ValueError, RuntimeError) as e:
+                # infeasible request or the KV wire guard tripping:
+                # terminal, but AS A RESULT, never the replica
+                # (fail-loud per request, fleet stays up)
+                if msg.get("kind") == "handoff":
+                    # terminal failure consumes the blob too
+                    kv_transfer.delete_blob(store,
+                                            f"serve/kv/{msg['id']}")
+                _publish(store, rid, msg["id"], {
+                    "id": msg["id"], "tokens": [],
+                    "status": "rejected-invalid", "error": str(e),
+                    "replica": rid})
+                continue
+            open_reqs[msg["id"]] = req
+        if frontend.busy:
+            frontend.step()
+            idle_since = time.monotonic()
+        else:
+            if (max_idle_s is not None
+                    and time.monotonic() - idle_since > max_idle_s):
+                return
+            time.sleep(poll_s)
+        for req_id, req in list(open_reqs.items()):
+            if req.done:
+                _publish(store, rid, req_id, {
+                    "id": req_id, "tokens": list(req.tokens),
+                    "status": req.status, "error": req.error,
+                    "replica": rid})
+                del open_reqs[req_id]

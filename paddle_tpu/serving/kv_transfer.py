@@ -1,0 +1,287 @@
+"""KV-page wire transfer for disaggregated prefill/decode serving.
+
+The disaggregation split (docs/serving.md "Disaggregated serving"):
+prefill replicas run big-bucket prefill only and hand the finished KV
+pages to a decode replica, which installs them into its own pool and
+enters the normal harvest pipeline. This module is the WIRE between
+them: a host-side block-scaled codec for the page payload (riding the
+same block math as ``distributed/compression.quantize_blocks``), the
+chunked TCPStore publish/fetch protocol (the store's ``get`` caps one
+value at 1MB), and the handoff metadata that lets the decode replica
+reconstruct the request's exact device state (lengths / last token /
+budget / eos) so decode continues bit-for-bit where prefill stopped.
+
+Wire formats (``PT_KV_WIRE``, default ``int8``):
+
+- ``fp32``: the pool bytes verbatim — the bit-identity reference: a
+  request served prefill→transfer→decode produces the exact token
+  stream of same-replica serving (asserted by tests/test_serve_disagg
+  and the ``tools/ci.sh disagg`` smoke).
+- ``int8`` / ``fp8``: block-scaled (one fp32 scale per ``PT_COMM_BLOCK``
+  values, int8 ±127 / e4m3 ±448) — ~3.94x fewer wire bytes at the
+  default block, metered by ``serve/kv_transfer_bytes_wire`` vs
+  ``serve/kv_transfer_bytes_logical``. Per-element error is bounded by
+  the block's own half step (``amax_block / (2*qmax)``), the bound the
+  divergence test pins.
+
+**Fail-loud scale-integrity guard** (same contract as
+``collective.quant_payload``, PR 7): the header carries the
+pre-quantization global ``amax``; the decoder validates every block
+scale (finite, inside the amax envelope) and every dequantized value
+(finite, bounded) and RAISES on violation — a corrupted scale must
+never install plausible-looking KV. The fault site
+``kv_transfer.payload`` bitflips a scale (default) or payload byte
+between encode and publish; a flipped PAYLOAD byte remains a valid
+in-envelope code whose damage is bounded by its block scale — the
+guard's guarantee is scale integrity, not payload integrity.
+
+Everything here is host-side numpy — nothing traced, importable by the
+router process without touching a device.
+"""
+
+import io
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["wire_format", "encode_kv_pages", "decode_kv_pages",
+           "publish_blob", "fetch_blob", "delete_blob", "WIRE_FORMATS"]
+
+WIRE_FORMATS = ("fp32", "int8", "fp8")
+_FAULT_SITE = "kv_transfer.payload"
+# one store value must stay under native.TCPStore.get's 1MB buffer
+_CHUNK = 768 * 1024
+
+
+def wire_format(wire: Optional[str] = None) -> str:
+    """Resolve the KV wire format: explicit arg beats ``PT_KV_WIRE``
+    beats the int8 default. ``fp32`` is the bit-identity opt-out."""
+    w = wire or os.environ.get("PT_KV_WIRE", "int8").strip().lower()
+    if w in ("fp32", "none", "off", "raw"):
+        return "fp32"
+    if w not in WIRE_FORMATS:
+        raise ValueError(
+            f"PT_KV_WIRE must be one of {WIRE_FORMATS}, got {w!r}")
+    return w
+
+
+def _block() -> int:
+    return int(os.environ.get("PT_COMM_BLOCK", "256"))
+
+
+def _np_wire_dtype(wire: str):
+    if wire == "int8":
+        return np.dtype(np.int8), 127.0
+    from paddle_tpu import dtypes
+    return np.dtype(dtypes.float8_e4m3), 448.0
+
+
+def _quantize_np(flat: np.ndarray, wire: str, block: int):
+    """Host-side mirror of ``compression.quantize_blocks`` (same block
+    clamp for tiny tensors, same scale floor): fp32 1-D in, returns
+    (payload, scales (nb,1) fp32, n)."""
+    dt, qmax = _np_wire_dtype(wire)
+    n = flat.size
+    block = max(1, min(block, n))
+    nb = -(-n // block)
+    padded = np.zeros((nb * block,), np.float32)
+    padded[:n] = flat
+    blocks = padded.reshape(nb, block)
+    amax = np.max(np.abs(blocks), axis=1, keepdims=True)
+    scales = (amax / qmax + 1e-30).astype(np.float32)
+    if wire == "int8":
+        payload = np.clip(np.round(blocks / scales), -qmax,
+                          qmax).astype(dt)
+    else:
+        payload = (blocks / scales).astype(dt)
+    return payload, scales, n
+
+
+def _inject_fault(scales_bytes: bytes, payload_bytes: bytes):
+    """Fault site ``kv_transfer.payload``: a matching bitflip/truncate
+    rule corrupts the scale bytes (default target) or the payload bytes
+    between encode and the wire. Inert without a fault plan."""
+    from paddle_tpu.testing import faults
+    if not faults.enabled():
+        return scales_bytes, payload_bytes
+    for kw in faults.spec(_FAULT_SITE, actions=("bitflip",)):
+        off = int(kw.get("offset", 0))
+        bit = int(kw.get("bit", 30))
+        if str(kw.get("target", "scale")) == "payload":
+            b = bytearray(payload_bytes)
+            if b:
+                b[off % len(b)] ^= 1 << (bit % 8)
+            payload_bytes = bytes(b)
+        else:
+            b = bytearray(scales_bytes)
+            if b:
+                b[off % len(b)] ^= 1 << (bit % 8)
+            scales_bytes = bytes(b)
+    return scales_bytes, payload_bytes
+
+
+def encode_kv_pages(k: np.ndarray, v: np.ndarray, n_tokens: int,
+                    wire: Optional[str] = None,
+                    block: Optional[int] = None
+                    ) -> Tuple[dict, bytes]:
+    """Serialize one request's KV pages for the wire.
+
+    ``k``/``v``: (L, npages, Hkv, page, D) host arrays in the pool
+    dtype. Rows at positions >= ``n_tokens`` of the last page are
+    ZEROED first — they hold recycled-pool garbage the decode side must
+    not inherit (decode overwrites them before ever reading, so this
+    cannot change outputs; it keeps the wire deterministic and the
+    compression honest). Returns ``(header, blob)``; the header is
+    JSON-serializable and carries the scale-integrity envelope.
+    """
+    wire = wire_format(wire)
+    block = block if block is not None else _block()
+    L, npg, hkv, page, d = k.shape
+    # ALWAYS copy: the tail zeroing below is wire-local and must never
+    # mutate the caller's buffers (device views arrive read-only
+    # anyway; a writable caller array re-used after encode would
+    # otherwise lose its tail rows silently)
+    k = np.array(k, dtype=k.dtype, copy=True, order="C")
+    v = np.array(v, dtype=v.dtype, copy=True, order="C")
+    tail = int(n_tokens) % page
+    if npg and tail:
+        k[:, npg - 1, :, tail:, :] = 0
+        v[:, npg - 1, :, tail:, :] = 0
+    logical = k.nbytes + v.nbytes
+    header = {
+        "wire": wire, "block": int(block),
+        "pool_dtype": k.dtype.name, "shape": [L, npg, hkv, page, d],
+        "n_tokens": int(n_tokens), "bytes_logical": int(logical),
+    }
+    buf = io.BytesIO()
+    if wire == "fp32":
+        buf.write(k.tobytes())
+        buf.write(v.tobytes())
+        header["sections"] = [["k", k.nbytes], ["v", v.nbytes]]
+    else:
+        _, qmax = _np_wire_dtype(wire)
+        sections = []
+        amaxes = {}
+        for name, arr in (("k", k), ("v", v)):
+            flat = np.asarray(arr, np.float32).reshape(-1)
+            amaxes[name] = float(np.max(np.abs(flat))) if flat.size \
+                else 0.0
+            payload, scales, _ = _quantize_np(flat, wire, block)
+            sb, pb = _inject_fault(scales.tobytes(), payload.tobytes())
+            buf.write(pb)
+            buf.write(sb)
+            sections.append([name, len(pb), len(sb),
+                             int(payload.shape[1])])
+        header["sections"] = sections
+        header["amax"] = amaxes          # the guard envelope
+        header["qmax"] = qmax
+    blob = buf.getvalue()
+    header["bytes_wire"] = len(blob)
+    from paddle_tpu import stats
+    stats.add("serve/kv_transfer_bytes_logical", logical)
+    stats.add("serve/kv_transfer_bytes_wire", len(blob))
+    if len(blob):
+        stats.set_value("serve/kv_transfer_ratio", logical / len(blob))
+    return header, blob
+
+
+def decode_kv_pages(header: dict, blob: bytes,
+                    strict: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`encode_kv_pages` — returns (k, v) in the pool
+    dtype. On the quantized wire every block scale and every
+    dequantized value is validated against the header's amax envelope;
+    a violation raises RuntimeError (fail-loud: corrupted KV must never
+    install silently). ``strict=False`` returns NaN-poisoned pages
+    instead of raising (callers that prefer the engine's own
+    non-finite eviction to surface the failure)."""
+    wire = header["wire"]
+    L, npg, hkv, page, d = header["shape"]
+    shape = (L, npg, hkv, page, d)
+    dt = np.dtype(header["pool_dtype"])
+    n = int(np.prod(shape))
+    if wire == "fp32":
+        (kn, kb), (vn, vb) = header["sections"]
+        k = np.frombuffer(blob[:kb], dt).reshape(shape)
+        v = np.frombuffer(blob[kb:kb + vb], dt).reshape(shape)
+        return k.copy(), v.copy()
+    wdt, qmax = _np_wire_dtype(wire)
+    out = {}
+    off = 0
+    bad = None
+    for name, pb, sb, blk in header["sections"]:
+        payload = np.frombuffer(blob[off:off + pb], wdt)
+        off += pb
+        scales = np.frombuffer(blob[off:off + sb], np.float32)
+        off += sb
+        amax = float(header["amax"][name])
+        # scale integrity: finite, non-negative, inside the envelope
+        # the pre-quantization maxima allow (4x slack mirrors
+        # compression._wire_ok); a flipped high bit lands far outside
+        smax = amax / float(header["qmax"]) + 1e-6
+        if (not np.all(np.isfinite(scales)) or np.any(scales < 0)
+                or np.any(scales > 4.0 * smax + 1e-30)):
+            bad = f"corrupted block scale in {name!r} section"
+        with np.errstate(over="ignore"):
+            # a corrupted scale can overflow fp32 here — that is
+            # exactly what the envelope check below catches
+            deq = (payload.astype(np.float32).reshape(-1, blk)
+                   * scales.reshape(-1, 1)).reshape(-1)[:n]
+        if not np.all(np.isfinite(deq)) or (
+                deq.size and np.max(np.abs(deq)) > 4.0 * amax + 1e-6):
+            bad = bad or f"dequantized {name!r} outside amax envelope"
+        out[name] = deq.reshape(shape).astype(dt)
+    if bad is not None:
+        if strict:
+            raise RuntimeError(
+                f"KV wire failed scale-integrity validation ({bad}); "
+                f"fault site {_FAULT_SITE!r} — refusing to install "
+                "corrupted pages")
+        for name in out:
+            out[name] = np.full(shape, np.nan, dt)
+    return out["k"], out["v"]
+
+
+# ---------------------------------------------------------------------------
+# Chunked store transport (native TCPStore values cap at 1MB per get)
+# ---------------------------------------------------------------------------
+
+def publish_blob(store, key: str, header: dict, blob: bytes):
+    """Write ``header`` + ``blob`` under ``key`` on the store, blob
+    split into <1MB chunks. The meta key is written LAST so a reader
+    that sees it can fetch every chunk — a writer killed mid-transfer
+    leaves no meta key and therefore no torn read."""
+    nchunks = -(-len(blob) // _CHUNK) if blob else 0
+    for i in range(nchunks):
+        store.set(f"{key}/c{i}", blob[i * _CHUNK:(i + 1) * _CHUNK])
+    meta = dict(header, nchunks=nchunks)
+    store.set(f"{key}/meta", json.dumps(meta))
+
+
+def fetch_blob(store, key: str, timeout: float = 5.0
+               ) -> Tuple[dict, bytes]:
+    """Read back one published blob (meta + chunks). Raises
+    TimeoutError when the meta key is absent (transfer incomplete or
+    withdrawn)."""
+    meta = json.loads(store.get(f"{key}/meta", timeout=timeout))
+    parts = [store.get(f"{key}/c{i}", timeout=timeout)
+             for i in range(int(meta["nchunks"]))]
+    return meta, b"".join(parts)
+
+
+def delete_blob(store, key: str, nchunks: Optional[int] = None):
+    """Withdraw a published blob: the meta key FIRST (no new readers),
+    then the chunks."""
+    if nchunks is None:
+        try:
+            nchunks = int(json.loads(
+                store.get(f"{key}/meta", timeout=0.05))["nchunks"])
+        except Exception:
+            nchunks = 0
+    try:
+        store.delete_key(f"{key}/meta")
+        for i in range(int(nchunks)):
+            store.delete_key(f"{key}/c{i}")
+    except Exception:
+        pass

@@ -6,7 +6,16 @@ per replica, or any orchestrator) running a decode engine behind a
 :class:`~paddle_tpu.serving.scheduler.FrontEnd` and
 :func:`serve_replica`. The :class:`Router` lives in the API-facing
 process, hosts the TCPStore control plane (``PT_SERVE_ROUTER_PORT``),
-and moves requests with **least-outstanding-requests** placement.
+and places requests **role- and load-aware**: a symmetric fleet keeps
+least-outstanding-requests placement; a disaggregated fleet
+(serving/disagg.py — replicas announcing ``role`` prefill/decode)
+places the prefill phase by queue depth + bucket fit, moves each
+``prefill-done`` handoff to the decode replica with the least
+outstanding KV bytes / most free pages, consults the fleet prefix
+directory BEFORE placement (full coverage skips the prefill tier
+entirely), and degrades to symmetric placement when a role tier dies.
+Load gauges ride the replicas' heartbeats — one store read per
+replica per poll, never a per-request round trip.
 
 Wire protocol (all JSON on the shared store; the store lives in the
 router process, so results survive any replica's death):
@@ -77,6 +86,20 @@ class Router:
         # moment the counter progresses again; the extra redistribution
         # is harmless (at-least-once, first result wins)
         self._swept = set()
+        # disaggregated serving state: which phase each request is in
+        # ('serve' = whole request on one replica; 'prefill' = awaiting
+        # a prefill replica's handoff; 'decode' = handoff placed on a
+        # decode replica), plus the heartbeat-refreshed load gauges
+        # (one store read per replica per poll — never per request)
+        self._phase: Dict[str, str] = {}
+        self._loads: Dict[str, dict] = {}
+        self._loads_at = 0.0
+        self._t_submit: Dict[str, float] = {}    # req_id -> submit time
+        # requests whose RE-placement failed transiently (no capable
+        # replica alive at that instant): retried on every poll —
+        # a liveness blip must degrade to a delay, never crash poll()
+        self._unplaced: set = set()
+        self._fleet = None                       # lazy directory client
 
     # -- membership ---------------------------------------------------------
 
@@ -111,29 +134,186 @@ class Router:
             "id": req_id, "prompt": [int(t) for t in prompt],
             "max_new_tokens": int(max_new_tokens), "eos_id": eos_id,
             "deadline_s": deadline_s, "priority": int(priority)}
+        # router-local submit time: every (re-)placement charges the
+        # time already spent (queueing, prefill, transfer, re-routes)
+        # against the request's deadline budget, matching same-replica
+        # semantics where the clock starts once at submission
+        self._t_submit[req_id] = time.monotonic()
         self._place(req_id)
         stats.add("serve/router_requests")
         return req_id
 
+    def _remaining_deadline(self, req_id: str):
+        d = self._payload[req_id].get("deadline_s")
+        if d is None:
+            return None
+        t0 = getattr(self, "_t_submit", {}).get(req_id)
+        return d if t0 is None else d - (time.monotonic() - t0)
+
+    def _request_msg(self, req_id: str) -> dict:
+        return dict(self._payload[req_id], kind="req",
+                    deadline_s=self._remaining_deadline(req_id))
+
+    def _try_place(self, req_id: str):
+        """RE-placement that survives a transient no-capable-replica
+        window (poll's prefill-done/handoff-failed progression and the
+        death sweep land here): on failure the request parks in
+        ``_unplaced`` and every subsequent poll retries, so a
+        heartbeat blip delays the request instead of crashing the
+        router. ``submit()`` keeps the raising ``_place`` — the API
+        edge should fail loudly when there is truly no fleet."""
+        try:
+            self._place(req_id, wait_s=0.0)
+            self._unplaced.discard(req_id)
+        except RuntimeError:
+            self._unplaced.add(req_id)
+
+    # -- role-aware placement ------------------------------------------------
+
+    def _refresh_loads(self, min_interval_s: float = 0.2):
+        """One load read per known replica (the gauges ride the
+        heartbeat — membership.heartbeat(load=...)), throttled to the
+        replicas' own refresh cadence: a burst of submissions reuses
+        the cached gauges plus this router's in-flight counts —
+        requests never trigger their own store round trips."""
+        now = time.monotonic()
+        if now - self._loads_at < min_interval_s and self._loads:
+            return
+        self._loads_at = now
+        for rid in self.directory.members():
+            load = self.directory.load(rid)
+            if load is not None:
+                self._loads[rid] = load
+
+    def _alive_meta(self) -> Dict[str, dict]:
+        return {rid: m for rid, m in self.directory.members().items()
+                if self.directory.alive(rid, self.dead_after)}
+
+    def _fleet_covered(self, prompt, page: int) -> int:
+        """Pre-placement directory consult: how many leading FULL pages
+        of ``prompt`` the fleet prefix directory already holds."""
+        if not page:
+            return 0
+        from paddle_tpu.inference.prefix_cache import chain_digests
+        from paddle_tpu.serving.disagg import (FleetPrefixDirectory,
+                                               fleet_enabled)
+        if not fleet_enabled():
+            return 0
+        if self._fleet is None:
+            self._fleet = FleetPrefixDirectory(self.store, "router")
+        chain = chain_digests(prompt, page)
+        return self._fleet.covered(chain) * page
+
+    def _pick_prefill(self, alive: Dict[str, dict], prompt_len: int):
+        """Prefill placement: bucket fit first (the replica's largest
+        bucket must cover the prompt), then least queue depth (the
+        heartbeat gauge plus this router's own in-flight count)."""
+        fits = [rid for rid, m in alive.items()
+                if m.get("role") == "prefill"
+                and prompt_len <= m.get("max_bucket", 0)]
+        return min(fits, key=lambda r: (
+            self._loads.get(r, {}).get("queued", 0)
+            + self._outstanding.get(r, 0), r), default=None)
+
+    def _pick_decode(self, alive: Dict[str, dict]):
+        """Decode placement: least outstanding KV bytes, most free
+        pages (the memory-bound axis), router in-flight as tiebreak."""
+        ds = [rid for rid, m in alive.items()
+              if m.get("role") == "decode"]
+        return min(ds, key=lambda r: (
+            self._loads.get(r, {}).get("kv_bytes", 0),
+            -self._loads.get(r, {}).get("free_pages", 0),
+            self._outstanding.get(r, 0), r), default=None)
+
+    def _send(self, rid: str, req_id: str, msg: dict):
+        from paddle_tpu import stats
+        i = self.store.add(f"serve/mbox_n/{rid}", 1)
+        self.store.set(f"serve/mbox/{rid}/{i}", json.dumps(msg))
+        self._assigned[req_id] = rid
+        self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
+        stats.set_value("serve/router_outstanding",
+                        sum(self._outstanding.values()))
+
     def _place(self, req_id: str, wait_s: float = 2.0):
-        alive = self.replicas()
+        """Phase-aware placement. A symmetric fleet (no prefill-role
+        replicas) keeps PR 9's least-outstanding policy verbatim. A
+        disaggregated fleet places the prefill phase on a prefill
+        replica by queue depth + bucket fit, UNLESS the fleet prefix
+        directory already covers the prompt's full pages — then
+        prefill is skipped fleet-wide and the request goes straight to
+        a decode replica (which suffix-prefills locally from fleet
+        pages). Decode-phase placement (after ``prefill-done``) goes by
+        outstanding KV bytes + free pages. When a needed role has no
+        alive replica, the request falls back to whole-request serving
+        on whatever is alive — a dead prefill tier degrades to
+        symmetric serving, never to an outage."""
         deadline = time.monotonic() + wait_s
+        alive = self._alive_meta()
         while not alive and time.monotonic() < deadline:
             # a transient liveness blip (or replicas still announcing)
             # must not fail a submit outright
             time.sleep(0.05)
-            alive = self.replicas()
+            alive = self._alive_meta()
         if not alive:
             raise RuntimeError("no alive replicas to route to")
-        rid = alive[0]                   # least outstanding
-        i = self.store.add(f"serve/mbox_n/{rid}", 1)
-        self.store.set(f"serve/mbox/{rid}/{i}",
-                       json.dumps(self._payload[req_id]))
-        self._assigned[req_id] = rid
-        self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
-        from paddle_tpu import stats
-        stats.set_value("serve/router_outstanding",
-                        sum(self._outstanding.values()))
+        payload = self._payload[req_id]
+        phase = self._phase.get(req_id)
+        if phase in ("prefill", "serve"):
+            # re-placement (death sweep): a not-yet-handed-off request
+            # restarts from scratch wherever capacity is — a dead
+            # prefill replica's work re-enters the prefill pool, or
+            # degrades to whole-request serving below
+            phase = None
+        roles = {m.get("role", "both") for m in alive.values()}
+        if phase == "decode":
+            rid = self._pick_decode(alive)
+            if rid is not None:
+                self._send(rid, req_id, {
+                    "kind": "handoff", "id": req_id,
+                    "deadline_s": self._remaining_deadline(req_id)})
+                return
+            # no decode replica alive: fall through to whole-request
+            # placement (the handoff blob is abandoned; at-least-once)
+            phase = None
+        if phase is None and "prefill" in roles and "decode" in roles:
+            self._refresh_loads()
+            page = max((m.get("page", 0) for m in alive.values()
+                        if m.get("role") == "decode"), default=0)
+            covered = self._fleet_covered(payload["prompt"], page)
+            n = len(payload["prompt"])
+            if covered and n - covered < (page or n):
+                # every full page is fleet-warm: skip prefill entirely
+                rid = self._pick_decode(alive)
+                if rid is not None:
+                    from paddle_tpu import stats
+                    stats.add("serve/router_prefill_skipped")
+                    self._phase[req_id] = "serve"
+                    self._send(rid, req_id, self._request_msg(req_id))
+                    return
+            rid = self._pick_prefill(alive, n)
+            if rid is not None:
+                self._phase[req_id] = "prefill"
+                self._send(rid, req_id, self._request_msg(req_id))
+                return
+            # no fitting/alive prefill replica: serve whole on decode
+            rid = self._pick_decode(alive)
+            if rid is not None:
+                self._phase[req_id] = "serve"
+                self._send(rid, req_id, self._request_msg(req_id))
+                return
+        # symmetric fleet (or role fallback): least outstanding among
+        # replicas that can actually SERVE a whole request — a
+        # prefill-only replica would prefill and publish another
+        # handoff forever (livelock) if the decode tier is down
+        servers = [r for r in alive
+                   if alive[r].get("role", "both") != "prefill"]
+        if not servers:
+            raise RuntimeError(
+                "no decode-capable replica alive (prefill-only fleet)")
+        self._phase[req_id] = "serve"
+        rid = min(servers,
+                  key=lambda r: (self._outstanding.get(r, 0), r))
+        self._send(rid, req_id, self._request_msg(req_id))
 
     # -- completion / fault handling ----------------------------------------
 
@@ -145,6 +325,9 @@ class Router:
         entries beyond its per-replica cursor."""
         from paddle_tpu import native, stats
         fresh = {}
+        for req_id in list(self._unplaced):
+            if req_id not in self.results:
+                self._try_place(req_id)
         for rid in self.directory.members():
             try:
                 n = native.decode_counter(
@@ -166,6 +349,36 @@ class Router:
                 if req_id in self.results or req_id not in self._payload:
                     continue       # duplicate completion / foreign key
                 res = json.loads(raw)
+                if res.get("status") == "handoff-failed":
+                    # retryable: the decode replica could not fetch the
+                    # handoff blob (prefill replica died mid-transfer).
+                    # Re-place from scratch — the request re-enters the
+                    # prefill pool (or whole-request serving)
+                    owner = self._assigned.get(req_id)
+                    if owner is not None:
+                        self._outstanding[owner] = max(
+                            0, self._outstanding.get(owner, 0) - 1)
+                    self._phase[req_id] = "serve"
+                    self._try_place(req_id)
+                    stats.add("serve/router_handoff_retries")
+                    continue
+                if res.get("status") == "prefill-done":
+                    # NOT terminal: the prefill replica published the
+                    # KV handoff blob — place the decode phase on a
+                    # decode replica (by outstanding KV bytes + free
+                    # pages). Duplicate prefill-done entries (a death
+                    # sweep re-ran the prefill elsewhere) re-place the
+                    # decode phase; at-least-once, first final result
+                    # wins.
+                    owner = self._assigned.get(req_id)
+                    if owner is not None:
+                        self._outstanding[owner] = max(
+                            0, self._outstanding.get(owner, 0) - 1)
+                    self._phase[req_id] = "decode"
+                    self._refresh_loads()
+                    self._try_place(req_id)
+                    stats.add("serve/router_prefill_handoffs")
+                    continue
                 self.results[req_id] = res
                 fresh[req_id] = res
                 owner = self._assigned.get(req_id)
@@ -194,7 +407,7 @@ class Router:
             orphans = [q for q, r in self._assigned.items()
                        if r == rid and q not in self.results]
             for req_id in orphans:
-                self._place(req_id)
+                self._try_place(req_id)
             if orphans:
                 stats.add("serve/router_redistributed", len(orphans))
 
@@ -226,6 +439,33 @@ class Router:
             self.store.close()
 
 
+def _mailbox_pump(store, rid: str, seen: int):
+    """Drain new mailbox indices for ``rid`` (counter + indexed keys —
+    the ONE mailbox idiom every serve loop shares, including the
+    role-split loops in serving/disagg.py). Returns
+    ``(new_seen, [message dicts])``."""
+    from paddle_tpu import native
+    try:
+        n = native.decode_counter(
+            store.get(f"serve/mbox_n/{rid}", timeout=0.001))
+    except (TimeoutError, ValueError):
+        n = seen
+    out = []
+    while seen < n:
+        seen += 1
+        out.append(json.loads(store.get(f"serve/mbox/{rid}/{seen}",
+                                        timeout=5.0)))
+    return seen, out
+
+
+def _shutdown_requested(store) -> bool:
+    try:
+        store.get("serve/shutdown", timeout=0.001)
+        return True
+    except TimeoutError:
+        return False
+
+
 def _publish(store, rid: str, req_id: str, result: dict):
     """Write one terminal result AND append it to the replica's done
     index (``serve/done_n/<rid>`` counter + ``serve/done_idx/<rid>/<i>``
@@ -255,23 +495,12 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
     idle_since = time.monotonic()
     while True:
         directory.heartbeat(rid)
-        try:
-            store.get("serve/shutdown", timeout=0.001)
-            if not open_reqs and not frontend.busy:
-                return
-        except TimeoutError:
-            pass
+        if _shutdown_requested(store) and not open_reqs \
+                and not frontend.busy:
+            return
         # mailbox: consume any indices the router appended
-        try:
-            from paddle_tpu import native
-            n = native.decode_counter(
-                store.get(f"serve/mbox_n/{rid}", timeout=0.001))
-        except (TimeoutError, ValueError):
-            n = seen
-        while seen < n:
-            seen += 1
-            msg = json.loads(store.get(f"serve/mbox/{rid}/{seen}",
-                                       timeout=5.0))
+        seen, msgs = _mailbox_pump(store, rid, seen)
+        for msg in msgs:
             try:
                 req = frontend.submit(
                     msg["prompt"], max_new_tokens=msg["max_new_tokens"],

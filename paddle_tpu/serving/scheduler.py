@@ -234,6 +234,42 @@ class FrontEnd:
         stats.set_value("serve/queue_len", len(self._queue))
         return req
 
+    def submit_handoff(self, meta: dict, k, v,
+                       deadline_s: Optional[float] = None,
+                       req_id: Optional[str] = None,
+                       t_submit: Optional[float] = None) -> ServeRequest:
+        """Admit a request whose PREFILL already happened on another
+        replica (disaggregated serving, serving/disagg.py): the engine
+        installs the transferred KV pages when a slot frees and decode
+        continues from the handed-off state. Bypasses the admission
+        queue — admission control already ran where the request first
+        entered the fleet; streaming/on_token/retire hooks apply
+        exactly as for local requests."""
+        eng = self.engine
+        if not hasattr(eng, "submit_handoff"):
+            raise ValueError("engine has no KV-handoff support "
+                             "(paged engines only)")
+        ereq = eng.submit_handoff(meta, k, v, deadline_s=deadline_s)
+        self._seq += 1
+        sreq = ServeRequest(
+            req_id or f"req-{self._seq:06d}", list(meta["prompt"]),
+            int(meta["max_new_tokens"]), meta["eos_id"], 0,
+            (None if deadline_s is None
+             else time.monotonic() + float(deadline_s)),
+            self._seq, self)
+        sreq.status = "admitted"
+        sreq.engine_req = ereq
+        if t_submit is not None:
+            # same-process disaggregation (bench): TTFT counts from the
+            # ORIGINAL arrival, not the handoff install — perf_counter
+            # is only comparable within one process, so cross-process
+            # callers leave this unset
+            sreq.t_submit = t_submit
+        ereq.t_submit = sreq.t_submit
+        self._all.append(sreq)
+        self._by_engine_req[id(ereq)] = sreq
+        return sreq
+
     # -- engine hooks -------------------------------------------------------
 
     def _on_token(self, ereq, token: int):
@@ -283,11 +319,32 @@ class FrontEnd:
         req.error = reason
         stats.add(stat)
 
+    def _ttft_estimate(self, req: ServeRequest) -> float:
+        """The TTFT bar the hopeless screen judges ``req`` against.
+        Before ANY observation lands, the EMA seeds from
+        :func:`projected_ttft` of the smallest covering bucket — the
+        same analytic model the bucket policy trusts — instead of an
+        empty/zero estimate. Cold start therefore neither waves every
+        request through (the old ``ema is None`` bypass let a 1ms-
+        deadline request reach prefill and be evicted mid-flight, paid
+        device work) nor rejects reasonable deadlines spuriously (the
+        projection is a per-request lower-ish bound, not a loaded-
+        system percentile)."""
+        if self._ttft_ema is not None:
+            return self._ttft_ema
+        eng = self.engine
+        n = len(req.prompt)
+        bucket = next((b for b in eng.buckets if b >= n),
+                      eng.buckets[-1])
+        return projected_ttft(eng, n, bucket)
+
     def _admissible(self, req: ServeRequest) -> bool:
         """Deadline screen at the queue->engine boundary: queue wait
         already spent counts against the budget, and a budget below the
-        engine's observed TTFT (EMA) is hopeless — reject it here, for
-        free, instead of letting the engine evict it mid-decode."""
+        engine's observed TTFT (EMA; cold start seeds from the
+        analytic projection — see ``_ttft_estimate``) is hopeless —
+        reject it here, for free, instead of letting the engine evict
+        it mid-decode."""
         if req.deadline is None:
             return True
         headroom = req.deadline - time.monotonic()
@@ -295,12 +352,14 @@ class FrontEnd:
             self._reject(req, "deadline exceeded while queued",
                          "serve/queue_deadline_rejects")
             return False
-        if (self.hopeless_factor > 0 and self._ttft_ema is not None
-                and headroom < self.hopeless_factor * self._ttft_ema):
+        est = self._ttft_estimate(req)
+        if (self.hopeless_factor > 0
+                and headroom < self.hopeless_factor * est):
             self._reject(
                 req, f"deadline hopeless at admission: "
                      f"{headroom * 1e3:.0f}ms budget vs "
-                     f"~{self._ttft_ema * 1e3:.0f}ms observed TTFT",
+                     f"~{est * 1e3:.0f}ms "
+                     f"{'observed' if self._ttft_ema is not None else 'projected'} TTFT",
                 "serve/queue_hopeless_rejects")
             return False
         return True

@@ -1,0 +1,70 @@
+"""Disaggregated-serving replica worker for tests/test_serve_disagg.py
+and tools/disagg_smoke.py: one process = one replica, spawned through
+the real ``distributed/launch.py`` CLI, role picked by argv (or
+``PT_SERVE_ROLE``). Pins the CPU platform at module level — the
+launcher imports this before any jax backend initializes.
+
+Usage (as the launch CLI's training script):
+    python -m paddle_tpu.distributed.launch --nproc_per_node 1 \
+        tests/_disagg_worker.py STORE_PORT REPLICA_ID ROLE
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# one replica needs one device; conftest's 8-virtual-device XLA_FLAGS
+# would leak in through the environment and slow startup
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_model():
+    """The ONE model every replica (and the single-replica bit-identity
+    reference) builds — weights must agree bit-for-bit fleet-wide."""
+    import jax.numpy as jnp
+    from paddle_tpu.models import gpt
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=512, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    return gpt.GPT(cfg, seed=0)
+
+
+def main():
+    port = int(sys.argv[1])
+    rid = sys.argv[2]
+    role = sys.argv[3] if len(sys.argv) > 3 else \
+        os.environ.get("PT_SERVE_ROLE", "both")
+    from paddle_tpu import native
+    from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+    from paddle_tpu.serving import FrontEnd
+    from paddle_tpu.serving.disagg import (FleetPrefixDirectory,
+                                           serve_prefill_replica,
+                                           serve_decode_replica,
+                                           fleet_enabled)
+
+    model = build_model()
+    store = native.TCPStore("127.0.0.1", port)
+    try:
+        if role == "prefill":
+            eng = PagedDecodeEngine(model, n_pages=48, max_slots=2,
+                                    page_size=128, prefill_only=True)
+            if fleet_enabled():
+                eng.attach_fleet(FleetPrefixDirectory(store, rid))
+            serve_prefill_replica(store, rid, eng, max_idle_s=120.0)
+        else:
+            eng = PagedDecodeEngine(model, n_pages=48, max_slots=2,
+                                    page_size=128)
+            if fleet_enabled():
+                eng.attach_fleet(FleetPrefixDirectory(store, rid))
+            fe = FrontEnd(eng)
+            serve_decode_replica(store, rid, fe, max_idle_s=120.0)
+    finally:
+        store.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,26 @@ def test_bench_serve_smoke():
     assert out.get("serve_over2x_backfills", 0) > 0, out
 
 
+def test_bench_serve_disagg_smoke():
+    """Disaggregated-serving ladder row (ISSUE 12): both the symmetric
+    baseline and the prefill→wire→decode pair must serve the full
+    over-saturation workload, the KV transfer must actually compress
+    (≥3.5x on the int8 default), and the fleet prefix tail must hit
+    cross-replica."""
+    out = bench.bench_serve_disagg(jax, jnp, PEAK, smoke=True)
+    for label in ("symmetric", "disagg"):
+        assert out.get(
+            f"serve_disagg_{label}_goodput_tokens_per_sec", 0) > 0, out
+        assert out.get(
+            f"serve_disagg_{label}_completed_frac", 0) == 1.0, out
+        assert out.get(f"serve_disagg_{label}_p99_ttft_ms", 0) > 0, out
+    assert out.get("serve_disagg_kv_bytes_wire", 0) > 0, out
+    assert out.get("serve_disagg_kv_ratio") is not None
+    assert out["serve_disagg_kv_ratio"] >= 3.5, out
+    assert out.get("serve_disagg_kv_transfer_p99_ms", 0) > 0, out
+    assert out.get("serve_disagg_fleet_hit_tokens", 0) > 0, out
+
+
 def test_bench_train_quant_comm_smoke():
     out = bench.bench_train_quant_comm(jax, jnp, PEAK, smoke=True)
     assert out.get("train_quant_comm_fp32_step_ms", 0) > 0, out
@@ -140,6 +160,7 @@ def test_bench_nonsmoke_cpu_guards():
     assert bench.bench_longctx(jax, jnp, PEAK) == {}
     assert bench.bench_train_sharded_stacked(jax, jnp, PEAK) == {}
     assert bench.bench_train_overlap(jax, jnp, PEAK) == {}
+    assert bench.bench_serve_disagg(jax, jnp, PEAK) == {}
 
 
 def test_split_params_contract():

@@ -1,0 +1,729 @@
+"""Disaggregated prefill/decode serving (ISSUE 12): the KV-page wire
+(block-scaled int8/fp8, fp32 bit-identity opt-out, fail-loud scale
+guard), prefill→transfer→decode handoff through the paged engines, the
+fleet-wide prefix directory lifecycle (cross-replica hit, eviction /
+poison invalidation, mid-fetch withdraw race), the TTFT-EMA cold-start
+fix, and the role-aware router — in-process where possible, real
+replica processes (launch CLI) for the round-trip and the
+SIGKILL-mid-transfer acceptance case."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from paddle_tpu import native, stats
+from paddle_tpu.models import gpt
+from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+from paddle_tpu.serving import FrontEnd, kv_transfer as kt
+from paddle_tpu.serving.disagg import FleetPrefixDirectory
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_disagg_worker.py")
+
+
+def _model(seed=0):
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=512, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    return gpt.GPT(cfg, seed=seed)
+
+
+def _engine(model, **kw):
+    kw.setdefault("n_pages", 32)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", 128)
+    return PagedDecodeEngine(model, **kw)
+
+
+def _prefill_one(eng, prompt, max_new_tokens=12, eos_id=2):
+    """Run one prompt through a prefill_only engine to the detach
+    point."""
+    r = eng.submit(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id)
+    while not r.tokens and not r.done and not r.failed:
+        eng.step()
+    eng.drain()
+    return eng.detach_handoff(r)
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+def test_wire_codec_fp32_roundtrip_exact():
+    rs = np.random.RandomState(0)
+    k = rs.randn(2, 3, 4, 128, 8).astype(np.float32)
+    v = rs.randn(2, 3, 4, 128, 8).astype(np.float32)
+    h, blob = kt.encode_kv_pages(k.copy(), v.copy(), 300, wire="fp32")
+    k2, v2 = kt.decode_kv_pages(h, blob)
+    # tail rows past n_tokens are zeroed on the wire (recycled-pool
+    # garbage must not cross replicas); all real rows are bit-exact
+    kz, vz = k.copy(), v.copy()
+    kz[:, 2, :, 300 - 256:, :] = 0
+    vz[:, 2, :, 300 - 256:, :] = 0
+    assert np.array_equal(k2, kz) and np.array_equal(v2, vz)
+    assert h["bytes_wire"] == k.nbytes + v.nbytes
+
+
+@pytest.mark.parametrize("wire", ["int8", "fp8"])
+def test_wire_codec_quant_ratio_and_bound(wire):
+    rs = np.random.RandomState(1)
+    k = rs.randn(2, 2, 4, 128, 8).astype(np.float32)
+    v = rs.randn(2, 2, 4, 128, 8).astype(np.float32)
+    h, blob = kt.encode_kv_pages(k.copy(), v.copy(), 256, wire=wire)
+    kq, vq = kt.decode_kv_pages(h, blob)
+    ratio = h["bytes_logical"] / h["bytes_wire"]
+    assert ratio >= 3.5, ratio        # the acceptance floor
+    for a, b, name in ((k, kq, "k"), (v, vq, "v")):
+        if wire == "int8":
+            # per-element error ≤ the block half step; every block's
+            # scale is ≤ amax/qmax, so amax/(2*qmax) bounds all of it
+            bound = 0.5 * h["amax"][name] / h["qmax"] + 1e-6
+        else:
+            # e4m3 rounding is RELATIVE (3 mantissa bits): half an ulp
+            # is ≤ |v|/16, so amax/16 bounds the whole tensor
+            bound = h["amax"][name] / 16.0 + 1e-6
+        assert float(np.max(np.abs(a - b))) <= bound
+
+
+def test_wire_codec_store_chunking():
+    """Blobs larger than one store value round-trip through the
+    chunked publish/fetch protocol; delete removes every key."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        rs = np.random.RandomState(2)
+        k = rs.randn(2, 4, 4, 128, 8).astype(np.float32)
+        v = rs.randn(2, 4, 4, 128, 8).astype(np.float32)
+        h, blob = kt.encode_kv_pages(k, v, 512, wire="fp32")
+        kt.publish_blob(store, "t/kv", h, blob)
+        h2, blob2 = kt.fetch_blob(store, "t/kv")
+        assert blob2 == blob and h2["n_tokens"] == 512
+        kt.delete_blob(store, "t/kv")
+        with pytest.raises(TimeoutError):
+            kt.fetch_blob(store, "t/kv", timeout=0.05)
+    finally:
+        store.close()
+
+
+@pytest.mark.faults
+def test_wire_guard_bitflipped_scale_fails_loud():
+    """The acceptance contract: a flipped block-scale bit between
+    encode and the wire must fail the decode LOUDLY — corrupted KV
+    never installs as plausible pages."""
+    rs = np.random.RandomState(3)
+    k = rs.randn(2, 1, 4, 128, 8).astype(np.float32)
+    v = rs.randn(2, 1, 4, 128, 8).astype(np.float32)
+    # flip the exponent MSB of the first block scale (fp32 high byte,
+    # bit 6): the scale leaves the amax envelope by ~2^128
+    with faults.inject("kv_transfer.payload", "bitflip", offset=3,
+                       bit=6):
+        h, blob = kt.encode_kv_pages(k.copy(), v.copy(), 128,
+                                     wire="int8")
+    with pytest.raises(RuntimeError, match="scale-integrity"):
+        kt.decode_kv_pages(h, blob)
+    # strict=False: the poison surfaces as NaN pages (the engine's own
+    # non-finite eviction path) instead of a raise
+    kp, vp = kt.decode_kv_pages(h, blob, strict=False)
+    assert np.all(np.isnan(kp))
+
+
+@pytest.mark.faults
+def test_wire_guard_payload_flip_bounded_not_detected():
+    """A flipped PAYLOAD byte is a valid in-envelope code the guard
+    cannot distinguish — its damage is bounded by the block's own
+    scale (the PR 7 contract, same here)."""
+    rs = np.random.RandomState(4)
+    k = rs.randn(2, 1, 4, 128, 8).astype(np.float32)
+    v = rs.randn(2, 1, 4, 128, 8).astype(np.float32)
+    clean_h, clean = kt.encode_kv_pages(k.copy(), v.copy(), 128,
+                                        wire="int8")
+    with faults.inject("kv_transfer.payload", "bitflip", offset=7,
+                       bit=6, target="payload"):
+        h, blob = kt.encode_kv_pages(k.copy(), v.copy(), 128,
+                                     wire="int8")
+    assert blob != clean
+    k2, v2 = kt.decode_kv_pages(h, blob)      # no raise
+    kc, vc = kt.decode_kv_pages(clean_h, clean)
+    # damage bounded: one element moved, by at most 2*qmax*scale
+    diff = np.abs(k2.astype(np.float64) - kc.astype(np.float64))
+    assert np.count_nonzero(diff) <= 1
+    assert float(diff.max()) <= 2.0 * h["amax"]["k"] + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# prefill→transfer→decode handoff
+# ---------------------------------------------------------------------------
+
+def test_disagg_fp32_wire_bit_identical():
+    """Acceptance: decode output on a disaggregated request is
+    BIT-identical to same-replica serving with the fp32 KV wire —
+    across page-boundary prompt lengths, an eos stop, and a budget-1
+    request (which finishes on the prefill replica)."""
+    model = _model()
+    rs = np.random.RandomState(0)
+    prompts = [list(rs.randint(0, 96, size=n))
+               for n in (7, 128, 130, 256, 300)]
+
+    ref = _engine(model)
+    refs = [ref.submit(p, max_new_tokens=12, eos_id=2)
+            for p in prompts]
+    ref.run()
+
+    pe = _engine(model, prefill_only=True)
+    de = _engine(model)
+    outs = []
+    for p in prompts:
+        meta, k, v = _prefill_one(pe, p)
+        h, blob = kt.encode_kv_pages(k, v, meta["n_tokens"],
+                                     wire="fp32")
+        k2, v2 = kt.decode_kv_pages(h, blob)
+        outs.append(de.submit_handoff(meta, k2, v2))
+    de.run()
+    for a, b in zip(refs, outs):
+        assert a.tokens == b.tokens
+        assert b.error is None
+
+    # budget-1: retires at the prefill harvest; no handoff phase
+    pe2 = _engine(model, prefill_only=True)
+    r1 = pe2.submit(prompts[0], max_new_tokens=1)
+    while not r1.done:
+        pe2.step()
+    pe2.drain()
+    ref1 = _engine(model).submit(prompts[0], max_new_tokens=1)
+    e = _engine(model)
+    r2 = e.submit(prompts[0], max_new_tokens=1)
+    e.run()
+    assert r1.tokens == r2.tokens and len(r1.tokens) == 1
+
+
+def test_disagg_int8_wire_bounded_and_serves():
+    """The int8 wire: installed pool pages stay within the block
+    half-step of the exact pages, the transfer compresses ≥3.5x, and
+    decode completes the full budget."""
+    model = _model()
+    rs = np.random.RandomState(5)
+    prompt = list(rs.randint(0, 96, size=300))
+    pe = _engine(model, prefill_only=True)
+    meta, k, v = _prefill_one(pe, prompt)
+    h, blob = kt.encode_kv_pages(k.copy(), v.copy(),
+                                 meta["n_tokens"], wire="int8")
+    assert h["bytes_logical"] / h["bytes_wire"] >= 3.5
+    kq, vq = kt.decode_kv_pages(h, blob)
+    kz = k.copy()
+    kz[:, -1, :, 300 % 128:, :] = 0
+    assert float(np.max(np.abs(kq.astype(np.float32) - kz))) <= \
+        0.5 * h["amax"]["k"] / h["qmax"] + 1e-6
+    de = _engine(model)
+    r = de.submit_handoff(meta, kq, vq)
+    de.run()
+    assert r.error is None and len(r.tokens) == 12
+
+
+def test_handoff_rides_frontend_queue_and_streams():
+    """FrontEnd.submit_handoff: the handoff waits for a slot like any
+    admission, streams through on_token, and retires via on_retire."""
+    model = _model()
+    pe = _engine(model, prefill_only=True)
+    rs = np.random.RandomState(6)
+    prompt = list(rs.randint(0, 96, size=40))
+    meta, k, v = _prefill_one(pe, prompt, max_new_tokens=6)
+    fe = FrontEnd(_engine(model))
+    sreq = fe.submit_handoff(meta, k, v)
+    got = list(sreq.stream())
+    assert sreq.status == "done"
+    assert got == sreq.tokens and len(got) == 6
+
+
+# ---------------------------------------------------------------------------
+# fleet prefix directory lifecycle
+# ---------------------------------------------------------------------------
+
+def _fleet_pair(store, model):
+    a = _engine(model, max_slots=2)
+    a.attach_fleet(FleetPrefixDirectory(store, "A", wire="fp32"))
+    b = _engine(model, max_slots=2)
+    b.attach_fleet(FleetPrefixDirectory(store, "B", wire="fp32"))
+    return a, b
+
+
+def test_fleet_cross_replica_hit_serves_suffix_only():
+    """A warm prefix registered on replica A is hit from replica B:
+    B fetches A's published pages, prefills ONLY the suffix
+    (serve/fleet_prefix_hit_tokens > 0 and the local hit counter shows
+    the adopted pages), and produces A's exact tokens."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        model = _model()
+        a, b = _fleet_pair(store, model)
+        rs = np.random.RandomState(7)
+        prompt = list(rs.randint(0, 96, size=300))   # 2 full pages
+        stats.reset("serve/fleet")
+        ra = a.submit(prompt, max_new_tokens=8)
+        a.run()
+        assert stats.get("serve/fleet_prefix_published") == 2
+        stats.reset("serve/fleet")
+        stats.reset("serve/prefix_")
+        rb = b.submit(prompt, max_new_tokens=8)
+        b.run()
+        assert rb.tokens == ra.tokens
+        assert stats.get("serve/fleet_prefix_lookup") >= 1
+        assert stats.get("serve/fleet_prefix_hit_tokens") == 256
+        # the adopted pages made it a LOCAL suffix-only prefill
+        assert stats.get("serve/prefix_hit_tokens") == 256
+    finally:
+        store.close()
+
+
+def test_fleet_eviction_invalidates_fleet_wide():
+    """LRU reclaim on the owning replica withdraws the digests; a new
+    replica's lookup misses (cold prefill, same output)."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        model = _model()
+        a, b = _fleet_pair(store, model)
+        rs = np.random.RandomState(8)
+        prompt = list(rs.randint(0, 96, size=300))
+        ra = a.submit(prompt, max_new_tokens=8)
+        a.run()
+        w0 = stats.get("serve/fleet_prefix_withdrawn")
+        assert a._prefix.reclaim(100) == 2
+        assert stats.get("serve/fleet_prefix_withdrawn") - w0 == 2
+        stats.reset("serve/fleet_prefix_hit_tokens")
+        rb = b.submit(prompt, max_new_tokens=8)
+        b.run()
+        assert stats.get("serve/fleet_prefix_hit_tokens") == 0
+        assert rb.tokens == ra.tokens      # cold prefill, same math
+    finally:
+        store.close()
+
+
+@pytest.mark.faults
+def test_fleet_poison_invalidates_before_remap():
+    """Non-finite eviction on the owning replica drops the local trie
+    nodes AND withdraws fleet-wide — a later submit on another replica
+    must prefill cold (never map the poisoned pages)."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        model = _model()
+        a, b = _fleet_pair(store, model)
+        rs = np.random.RandomState(9)
+        prompt = list(rs.randint(0, 96, size=300))
+        ra = a.submit(prompt, max_new_tokens=8)
+        a.run()
+        assert stats.get("serve/fleet_prefix_published") >= 2
+        # second submit on A shares the pages, then goes non-finite
+        w0 = stats.get("serve/fleet_prefix_withdrawn")
+        with faults.inject("engine.poison_logits", "nan", slot=0):
+            r2 = a.submit(prompt, max_new_tokens=8)
+            a.run()
+        assert r2.failed
+        assert stats.get("serve/fleet_prefix_withdrawn") - w0 >= 2
+        stats.reset("serve/fleet_prefix_hit_tokens")
+        rb = b.submit(prompt, max_new_tokens=8)
+        b.run()
+        assert stats.get("serve/fleet_prefix_hit_tokens") == 0
+        assert rb.tokens == ra.tokens
+    finally:
+        store.close()
+
+
+def test_fleet_extend_revives_stale_descendant():
+    """Reclaim drops one trie node and leaves its CHILDREN canonical-
+    but-unreachable; when the missing parent is refetched from the
+    fleet, the surviving child page must resume service locally
+    (adopt on a still-canonical digest was a replica-killing
+    KeyError). Only the refetched parent counts as a fleet hit."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        model = _model()
+        a, b = _fleet_pair(store, model)
+        rs = np.random.RandomState(15)
+        prompt = list(rs.randint(0, 96, size=300))   # 2 full pages
+        ra = a.submit(prompt, max_new_tokens=8)
+        a.run()
+        rb = b.submit(prompt, max_new_tokens=8)      # adopts both
+        b.run()
+        # LRU-oldest refcount-zero page on B is the PARENT (table
+        # order); reclaiming exactly one leaves the child stale
+        assert b._prefix.reclaim(1) == 1
+        stats.reset("serve/fleet_prefix_hit_tokens")
+        r2 = b.submit(prompt, max_new_tokens=8)
+        b.run()
+        assert r2.tokens == ra.tokens == rb.tokens
+        # one page refetched from the fleet, one revived locally
+        assert stats.get("serve/fleet_prefix_hit_tokens") == 128
+        assert stats.get("serve/prefix_hit_tokens") >= 256
+    finally:
+        store.close()
+
+
+def test_fleet_fetch_discards_on_mid_fetch_withdraw(monkeypatch):
+    """The invalidation-vs-fetch race: a withdraw landing between the
+    payload read and the entry re-check makes the fetch a MISS — no
+    sharer can install a page whose invalidation already committed."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        owner = FleetPrefixDirectory(store, "own", wire="fp32")
+        reader = FleetPrefixDirectory(store, "rdr", wire="fp32")
+        k = np.zeros((2, 1, 4, 128, 8), np.float32)
+        digest = b"\x01" * 20
+        owner.publish(digest, k, k)
+        assert reader.fetch(digest) is not None
+        orig = kt.fetch_blob
+
+        def race(store_, key, timeout=5.0):
+            out = orig(store_, key, timeout=timeout)
+            owner.withdraw(digest)          # lands mid-fetch
+            return out
+
+        monkeypatch.setattr(kt, "fetch_blob", race)
+        assert reader.fetch(digest) is None
+    finally:
+        store.close()
+
+
+def test_fleet_lease_defers_chunk_delete():
+    """An outstanding fetch lease keeps the payload chunks readable
+    through a withdraw (the entry vanishes immediately — no NEW
+    fetchers — but the in-flight read completes before discarding)."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        owner = FleetPrefixDirectory(store, "own", wire="fp32")
+        k = np.zeros((2, 1, 4, 128, 8), np.float32)
+        digest = b"\x02" * 20
+        owner.publish(digest, k, k)
+        gen = owner._published[digest]
+        store.add(f"fleetpfx/l/{digest.hex()}", 1)   # fetcher mid-read
+        owner.withdraw(digest)
+        # entry gone, payload still readable for the leased reader
+        with pytest.raises(TimeoutError):
+            store.get(owner._ekey(digest), timeout=0.05)
+        kt.fetch_blob(store, owner._pkey(digest, gen), timeout=0.5)
+    finally:
+        store.close()
+
+
+def test_handoff_geometry_mismatch_rejected_at_submit():
+    """A handoff from a differently-configured fleet must fail at
+    submit time (ValueError the serve loop turns into a per-request
+    result) — NOT as a shape error inside a later engine.step() that
+    would kill the replica and its other in-flight requests."""
+    model = _model()
+    pe = _engine(model, prefill_only=True)
+    rs = np.random.RandomState(13)
+    meta, k, v = _prefill_one(pe, list(rs.randint(0, 96, size=40)))
+    de = _engine(model)
+    with pytest.raises(ValueError, match="geometry"):
+        de.submit_handoff(meta, k[:, :, :, :64, :], v[:, :, :, :64, :])
+    # the engine stays fully serviceable afterwards
+    r = de.submit_handoff(meta, k, v)
+    de.run()
+    assert r.error is None and len(r.tokens) == 12
+
+
+def test_lossy_wire_pages_never_republished():
+    """Pages installed from an int8/fp8 wire serve and share locally
+    but are NEVER re-published under the original content digest —
+    re-quantizing quantized KV would compound the half-step error
+    across hops without bound. fp32-wire pages stay publishable."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        model = _model()
+        pe = _engine(model, prefill_only=True)
+        rs = np.random.RandomState(14)
+        prompt = list(rs.randint(0, 96, size=300))
+        meta, k, v = _prefill_one(pe, prompt)
+        for wire, want_published in (("int8", 0), ("fp32", 2)):
+            h, blob = kt.encode_kv_pages(k.copy(), v.copy(),
+                                         meta["n_tokens"], wire=wire)
+            kq, vq = kt.decode_kv_pages(h, blob)
+            de = _engine(model)
+            de.attach_fleet(FleetPrefixDirectory(
+                store, f"dc-{wire}", wire=wire))
+            stats.reset("serve/fleet_prefix_published")
+            r = de.submit_handoff(dict(meta, wire=wire), kq, vq)
+            de.run()
+            assert r.error is None
+            assert stats.get("serve/fleet_prefix_published") == \
+                want_published, wire
+            # cleanup so the fp32 round starts from an empty directory
+            for dg in list(de.fleet._published):
+                de.fleet.withdraw(dg)
+    finally:
+        store.close()
+
+
+def test_handoff_failed_result_is_rerouted_not_terminal():
+    """A decode replica that cannot fetch the handoff blob publishes
+    'handoff-failed'; the router re-places the request from scratch
+    instead of surfacing a terminal rejection."""
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.router import _publish
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        router = Router(store=store)
+        d = router.directory
+        d.announce("p0", {"role": "prefill", "page": 128,
+                          "max_bucket": 512})
+        d.announce("d0", {"role": "decode", "page": 128,
+                          "max_bucket": 512})
+        router.directory.alive = lambda rid, dead_after=0: True
+        q = router.submit([1] * 200, max_new_tokens=4)
+        assert router._assigned[q] == "p0"
+        _publish(store, "p0", q, {"id": q, "tokens": [],
+                                  "status": "prefill-done",
+                                  "error": None, "replica": "p0"})
+        router.poll()
+        assert router._assigned[q] == "d0"
+        _publish(store, "d0", q, {"id": q, "tokens": [],
+                                  "status": "handoff-failed",
+                                  "error": "meta timed out",
+                                  "replica": "d0"})
+        router.poll()
+        # NOT terminal: re-placed (prefill tier again, from scratch)
+        assert q not in router.results
+        assert router._assigned[q] == "p0"
+        assert stats.get("serve/router_handoff_retries") >= 1
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# FrontEnd TTFT-EMA cold start (satellite)
+# ---------------------------------------------------------------------------
+
+def test_hopeless_cold_start_seeds_from_projection():
+    """Before any TTFT observation the hopeless screen judges against
+    projected_ttft of the smallest covering bucket: a generous
+    deadline is admitted (no spurious cold reject), an impossible one
+    is rejected for free (the old cold-start bypass let it reach
+    prefill and be evicted mid-flight)."""
+    from paddle_tpu.serving.scheduler import projected_ttft
+    model = _model()
+    # hopeless_factor scales the bar: 100x the cold projection
+    # (~0.26s here) lets the doomed deadline be generous enough
+    # (0.05s) that it cannot EXPIRE in the submit->feed gap under
+    # suite load — the hopeless screen, not the expiry sweep, must
+    # reject it (the distinction this satellite exists for)
+    fe = FrontEnd(_engine(model), hopeless_factor=100.0)
+    assert fe._ttft_ema is None
+    rs = np.random.RandomState(10)
+    prompt = list(rs.randint(0, 96, size=20))
+    # direction 1: generous deadline, cold -> served, never rejected
+    ok = fe.submit(prompt, max_new_tokens=4, deadline_s=30.0)
+    # direction 2: below the scaled projection, cold -> hopeless, zero
+    # device work (rejected at the queue->engine boundary)
+    floor = projected_ttft(fe.engine, 20, 32)
+    assert 0.05 < 100.0 * floor < 30.0
+    h0 = stats.get("serve/queue_hopeless_rejects")
+    bad = fe.submit(prompt, max_new_tokens=4, deadline_s=0.05)
+    fe.run()
+    assert ok.status == "done" and len(ok.tokens) == 4
+    assert bad.status == "rejected-deadline"
+    assert "projected TTFT" in bad.error
+    assert stats.get("serve/queue_hopeless_rejects") - h0 == 1
+    # once observations exist, the EMA takes over
+    assert fe._ttft_ema is not None
+    assert fe._ttft_estimate(ok) == fe._ttft_ema
+
+
+# ---------------------------------------------------------------------------
+# membership load gauges (satellite)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_refreshes_load_gauges():
+    from paddle_tpu.distributed.membership import ReplicaDirectory
+    from paddle_tpu.serving.disagg import replica_load
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        rep = ReplicaDirectory(store)
+        obs = ReplicaDirectory(store)
+        rep.announce("r0", {"role": "decode", "page": 128})
+        assert obs.load("r0") is None
+        eng = _engine(_model(), max_slots=2)
+        rep.heartbeat("r0", load=replica_load(eng, "decode", queued=3))
+        load = obs.load("r0")
+        assert load["role"] == "decode" and load["queued"] == 3
+        assert load["free_pages"] == 32 and load["kv_bytes"] == 0
+        r = eng.submit(list(range(1, 200)), max_new_tokens=4)
+        eng.step()
+        rep.heartbeat("r0", load=replica_load(eng, "decode"))
+        assert obs.load("r0")["kv_bytes"] > 0
+        eng.run()
+        assert r.tokens
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# role-aware router placement (in-process)
+# ---------------------------------------------------------------------------
+
+def test_router_role_aware_placement_and_handoff_phase():
+    """Placement policy without processes: prefill goes to the fitting
+    least-queued prefill replica; a prefill-done result moves the
+    request to the decode replica with the least outstanding KV bytes;
+    with no prefill replica the request falls back to whole-request
+    serving on a decode replica."""
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.router import _publish
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        router = Router(store=store)
+        d = router.directory
+        d.announce("p0", {"role": "prefill", "page": 128,
+                          "max_bucket": 512})
+        d.announce("p1", {"role": "prefill", "page": 128,
+                          "max_bucket": 128})
+        d.announce("d0", {"role": "decode", "page": 128,
+                          "max_bucket": 512})
+        d.announce("d1", {"role": "decode", "page": 128,
+                          "max_bucket": 512})
+        router.directory.alive = lambda rid, dead_after=0: True
+        d.heartbeat("p0", load={"role": "prefill", "queued": 5})
+        d.heartbeat("p1", load={"role": "prefill", "queued": 0})
+        d.heartbeat("d0", load={"role": "decode", "kv_bytes": 999,
+                                "free_pages": 10})
+        d.heartbeat("d1", load={"role": "decode", "kv_bytes": 1,
+                                "free_pages": 40})
+        # short prompt fits p1 (least queued); long prompt only p0
+        q_short = router.submit([1] * 50, max_new_tokens=4)
+        q_long = router.submit([1] * 200, max_new_tokens=4)
+        assert router._assigned[q_short] == "p1"
+        assert router._assigned[q_long] == "p0"
+        assert router._phase[q_short] == "prefill"
+        # prefill-done -> decode phase on the least-KV-bytes replica
+        _publish(store, "p1", q_short, {
+            "id": q_short, "tokens": [], "status": "prefill-done",
+            "error": None, "replica": "p1"})
+        router.poll()
+        assert router._phase[q_short] == "decode"
+        assert router._assigned[q_short] == "d1"
+        n = native.decode_counter(store.get("serve/mbox_n/d1"))
+        msg = json.loads(store.get(f"serve/mbox/d1/{n}"))
+        assert msg["kind"] == "handoff" and msg["id"] == q_short
+        assert stats.get("serve/router_prefill_handoffs") >= 1
+        # prefill tier gone -> whole-request fallback on decode
+        router.directory.alive = \
+            lambda rid, dead_after=0: rid.startswith("d")
+        q_fb = router.submit([1] * 50, max_new_tokens=4)
+        # fallback is the PR 9 least-outstanding policy: d0 has no
+        # router-tracked in-flight work, d1 holds the handoff
+        assert router._assigned[q_fb] == "d0"
+        assert router._phase[q_fb] == "serve"
+        n = native.decode_counter(store.get("serve/mbox_n/d0"))
+        msg = json.loads(store.get(f"serve/mbox/d0/{n}"))
+        assert msg["kind"] == "req" and msg["prompt"] == [1] * 50
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# real replica processes (launch CLI) — round trip + SIGKILL acceptance
+# ---------------------------------------------------------------------------
+
+pytestmark_proc = pytest.mark.skipif(
+    not native.is_available(), reason="native TCPStore unavailable")
+
+
+def _spawn(store_port, rid, role, launch_port, extra_env=None):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PT_KV_WIRE="fp32")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1",
+         "--master", f"127.0.0.1:{launch_port}",
+         WORKER, str(store_port), rid, role],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _cleanup(router, procs):
+    router.shutdown()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+    router.close()
+
+
+def _reference_tokens(prompts, budgets):
+    """Single-replica serving of the identical workload — the
+    bit-identity oracle (same model builder as the workers)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import _disagg_worker
+    eng = PagedDecodeEngine(_disagg_worker.build_model(), n_pages=48,
+                            max_slots=2, page_size=128)
+    fe = FrontEnd(eng)
+    reqs = [fe.submit(p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)]
+    fe.run()
+    return [r.tokens for r in reqs]
+
+
+@pytestmark_proc
+def test_disagg_router_round_trip_bit_identical():
+    """Acceptance: one prefill + one decode replica serve a mixed
+    workload through the role-aware router; every stream is
+    bit-identical to single-replica serving on the fp32 wire, and the
+    decode phase actually ran on the decode replica (handoffs
+    counted)."""
+    from paddle_tpu.serving import Router
+    stats.reset("serve/router")
+    router = Router(port=0, dead_after=15.0)
+    procs = [_spawn(router.store.port, "pf0", "prefill", 8895),
+             _spawn(router.store.port, "dc0", "decode", 8896)]
+    try:
+        router.wait_replicas(2, timeout=90)
+        rs = np.random.RandomState(11)
+        prompts = [list(rs.randint(0, 96, size=n))
+                   for n in (9, 40, 140, 260)]
+        budgets = [6, 5, 7, 6]
+        ids = [router.submit(p, max_new_tokens=b)
+               for p, b in zip(prompts, budgets)]
+        results = router.drain(timeout=180)
+        assert sorted(results) == sorted(ids)
+        assert all(results[q]["status"] == "done" for q in ids)
+        # decode ran on the decode replica
+        assert {results[q]["replica"] for q in ids} == {"dc0"}
+        assert stats.get("serve/router_prefill_handoffs") == len(ids)
+        got = [results[q]["tokens"] for q in ids]
+        assert got == _reference_tokens(prompts, budgets)
+    finally:
+        _cleanup(router, procs)
+
+
+@pytestmark_proc
+def test_disagg_prefill_death_reroutes_clean():
+    """Acceptance: SIGKILL the only prefill replica with requests
+    outstanding — every request id still completes (the router
+    degrades them to whole-request serving on the decode replica,
+    which stays clean), nothing lost."""
+    from paddle_tpu.serving import Router
+    stats.reset("serve/router")
+    router = Router(port=0, dead_after=2.5)
+    procs = [_spawn(router.store.port, "pf0", "prefill", 8897),
+             _spawn(router.store.port, "dc0", "decode", 8898)]
+    try:
+        router.wait_replicas(2, timeout=90)
+        rs = np.random.RandomState(12)
+        ids = [router.submit(list(rs.randint(0, 96, size=150)),
+                             max_new_tokens=16) for _ in range(8)]
+        victim_pid = router.directory.members()["pf0"]["pid"]
+        os.kill(victim_pid, signal.SIGKILL)
+        results = router.drain(timeout=180)
+        assert sorted(results) == sorted(ids)
+        assert all(r["status"] == "done" for r in results.values())
+        # everything that completed, completed on the survivor
+        assert {r["replica"] for r in results.values()} == {"dc0"}
+        assert stats.get("serve/router_redistributed") > 0
+    finally:
+        _cleanup(router, procs)

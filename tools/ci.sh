@@ -45,6 +45,13 @@
 #                          reduce-scatter (one per bucket, interleaved
 #                          into backward) instead of one fused tail
 #                          collective
+#   tools/ci.sh disagg     disaggregated-serving smoke: one prefill + one
+#                          decode replica (real processes via
+#                          distributed/launch.py) behind the role-aware
+#                          router — fixed-seed streams bit-identical to
+#                          single-replica serving on the fp32 KV wire,
+#                          fleet prefix-hit counter nonzero on a
+#                          repeated-system-prompt workload (~1 min)
 #   tools/ci.sh shard      sharded-stacked smoke: 4-device CPU mesh runs
 #                          the pre-stacked scan-over-layers train step
 #                          under fsdp×tp (loss parity vs per-layer,
@@ -99,6 +106,11 @@ if [[ "${1:-}" == "overlap" ]]; then
     shift
     # just the ISSUE-11 overlap sweep (bit-parity + interleaved lowering)
     exec python tools/comm_smoke.py --overlap "$@"
+fi
+
+if [[ "${1:-}" == "disagg" ]]; then
+    shift
+    exec python tools/disagg_smoke.py "$@"
 fi
 
 if [[ "${1:-}" == "shard" ]]; then
