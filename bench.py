@@ -1346,12 +1346,11 @@ def bench_serve_disagg(jax, jnp, peak, smoke=False):
         open_pf = []
 
         def submit(a):
+            # the prefill-only engine is role-tagged: its first-token
+            # observation lands in serve/prefill_s, never serve/ttft_s
+            # (the PR 12 t_first pre-mark workaround, retired) — the
+            # row's p99 TTFT stays end-to-end decode-side samples only
             r = pe.submit(a.prompt, max_new_tokens=a.max_new_tokens)
-            # pre-mark t_first so the PREFILL engine's harvest does not
-            # observe a prefill-only serve/ttft_s sample — the row's
-            # p99 TTFT must be end-to-end only (decode-side, re-anchored
-            # to this arrival), or the disagg number reads ~p98
-            r.t_first = time.perf_counter()
             rec = [r, None, time.perf_counter()]
             open_pf.append(rec)
             return rec
@@ -1406,6 +1405,10 @@ def bench_serve_disagg(jax, jnp, peak, smoke=False):
             snap.get("serve/ttft_s.p99", 0) * 1e3, 2)
         res[f"{pfx}_completed_frac"] = round(len(done) / n_req, 4)
         if label == "disagg":
+            # the prefill phase's own latency histogram (role-tagged
+            # metric — see serve/prefill_s in docs/observability.md)
+            res["serve_disagg_prefill_p99_ms"] = round(
+                snap.get("serve/prefill_s.p99", 0) * 1e3, 2)
             wire = _stats.get("serve/kv_transfer_bytes_wire")
             logical = _stats.get("serve/kv_transfer_bytes_logical")
             res["serve_disagg_kv_bytes_logical"] = int(logical)

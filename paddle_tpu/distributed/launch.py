@@ -74,6 +74,13 @@ def _merge_traces_on_exit():
         if out:
             print(f"[launch] merged rank traces -> {out}",
                   file=sys.stderr)
+        # serving traces carry per-request trace contexts (args.rid):
+        # also emit the stitched per-request timeline. A training job's
+        # traces have no rids — stitch_rank_traces then writes nothing
+        stitched = merge.stitch_rank_traces(tdir)
+        if stitched:
+            print(f"[launch] stitched request timeline -> {stitched}",
+                  file=sys.stderr)
     except Exception as e:
         print(f"[launch] trace merge failed: {e}", file=sys.stderr)
 

@@ -55,7 +55,8 @@ class ReplicaDirectory:
         self.store.set(f"{self.ns}/idx/{i}", rid)
         self.heartbeat(rid)
 
-    def heartbeat(self, rid: str, load: Optional[dict] = None) -> int:
+    def heartbeat(self, rid: str, load: Optional[dict] = None,
+                  stats: Optional[dict] = None) -> int:
         """Bump the liveness counter; when ``load`` is given, refresh
         the replica's gauge-style load fields FIRST (so an observer
         that sees the new counter sees load at least that fresh).
@@ -63,9 +64,17 @@ class ReplicaDirectory:
         replica per poll (:meth:`load`) — no per-request round trips.
         The disaggregated router's fields: ``queued`` (admission queue
         depth), ``free_slots``, ``free_pages``, ``kv_bytes``
-        (outstanding KV bytes across live slots)."""
+        (outstanding KV bytes across live slots).
+
+        ``stats`` attaches a full ``paddle_tpu.stats.export()``
+        snapshot the same way — the fleet telemetry plane
+        (``observability/fleet.FleetStats``) merges the latest export
+        per replica into the fleet-level /statsz, at the cost of one
+        more store write per refresh beat (never per request)."""
         if load is not None:
             self.store.set(f"{self.ns}/load/{rid}", json.dumps(load))
+        if stats is not None:
+            self.store.set(f"{self.ns}/stats/{rid}", json.dumps(stats))
         return self.store.add(f"{self.ns}/hb/{rid}", 1)
 
     # -- observer side ------------------------------------------------------
@@ -96,6 +105,16 @@ class ReplicaDirectory:
         try:
             return json.loads(
                 self.store.get(f"{self.ns}/load/{rid}", timeout=0.05))
+        except (TimeoutError, ValueError):
+            return None
+
+    def stats_export(self, rid: str) -> Optional[dict]:
+        """The replica's last heartbeat-attached ``stats.export()``
+        snapshot (one store read), or None when it never attached
+        one."""
+        try:
+            return json.loads(
+                self.store.get(f"{self.ns}/stats/{rid}", timeout=0.05))
         except (TimeoutError, ValueError):
             return None
 

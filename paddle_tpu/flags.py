@@ -230,6 +230,34 @@ declare_env("PT_TRACE_RING", "Trace ring-buffer capacity in events.",
 declare_env("PT_STATSZ_PORT", "Serve live /statsz snapshots on this port "
             "(launcher hands rank r port base+1+r).",
             owner="observability/statsz.py")
+declare_env("PT_TRACE_FLUSH_S", "Seconds between periodic atomic "
+            "rewrites of the (partial) trace file when tracing is "
+            "env-enabled — a SIGKILLed replica still leaves its last "
+            "flush on disk for stitching. 0 disables (atexit export "
+            "only).", default="5", owner="observability/trace.py")
+declare_env("PT_FLIGHT_RING", "Per-request flight recorder bound: how "
+            "many requests' event timelines stay resident (FIFO "
+            "eviction; each request keeps at most 64 events). 0 "
+            "disables recording entirely.", default="256",
+            owner="observability/flight.py")
+declare_env("PT_FLIGHT_DIR", "Directory terminal-failure flight "
+            "records dump into as flight_<rid>.json (falls back to "
+            "PT_TRACE_DIR; with neither set the record is one "
+            "structured stderr line).", owner="observability/flight.py")
+declare_env("PT_SLO_TTFT_P99_MS", "Fleet SLO target: merged p99 TTFT "
+            "in milliseconds. The fleet watch publishes the "
+            "fleet/slo_ttft_burn gauge (p99/target) and fires "
+            "fleet/alert_slo_ttft on the burn>1 edge. Unset disables.",
+            owner="observability/fleet.py")
+declare_env("PT_SLO_GOODPUT", "Fleet SLO target: goodput floor in "
+            "tokens/s summed over replicas (token-progress rate from "
+            "the heartbeat load gauges). fleet/alert_slo_goodput "
+            "fires while a busy fleet runs below it. Unset disables.",
+            owner="observability/fleet.py")
+declare_env("PT_SLO_QUEUE_AGE_S", "Runaway-queue detector threshold: "
+            "a replica whose oldest waiting request exceeds this age "
+            "raises fleet/alert_queue_age.", default="30",
+            owner="observability/fleet.py")
 
 # -- serving --
 declare_env("PT_SERVE_INFLIGHT", "Decode-engine pipeline depth: how many "

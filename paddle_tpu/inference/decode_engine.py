@@ -132,6 +132,21 @@ def resolve_engine_weights(model, share_weights_with):
     return cfg, head, stacked
 
 
+def _note_retrace(fn_name: str):
+    """Trace-time (re)trace counter: called at the TOP of the engines'
+    jitted bodies, so it runs exactly once per (re)trace and never per
+    step — the dynamic complement to ptlint PT002's static retrace
+    check. A rising ``compile/retrace/<fn>`` during steady-state
+    serving is the recompile leak PT002 can only catch structurally."""
+    from paddle_tpu import stats
+    # ptlint: disable=PT003 -- deliberate trace-time side effect: the
+    # counter must tick when tracing happens, exactly like the
+    # collective wrappers' issue-time byte counters (PR 7)
+    stats.add("compile/retrace")
+    # ptlint: disable=PT003 -- same deliberate trace-time counter
+    stats.add(f"compile/retrace/{fn_name}")
+
+
 class Request:
     """One in-flight generation request.
 
@@ -143,13 +158,26 @@ class Request:
 
     ``t_submit``/``t_first`` (perf_counter seconds, set by the engine)
     carry the serving-latency bookkeeping: TTFT = t_first - t_submit
-    lands in the ``serve/ttft_s`` histogram, and the completed request's
-    submit→done lifetime is recorded as a ``serve/request`` trace span."""
+    lands in the ``serve/ttft_s`` histogram (``serve/prefill_s`` on a
+    prefill-only engine — the role-tagged split), and the completed
+    request's submit→done lifetime is recorded as a ``serve/request``
+    trace span.
+
+    ``rid`` is the request's TRACE CONTEXT: the fleet-wide request id
+    minted at front-end/router admission and carried through mailbox
+    messages, handoff meta, and KV blobs. Every request-scoped span
+    attaches it as ``rid=`` so per-replica trace files stitch into one
+    cross-process timeline (observability/merge.stitch_trace_files);
+    the flight recorder keys its event ring on it too. None for bare
+    ``engine.submit()`` callers — spans then carry no rid and the
+    request does not stitch (nothing else degrades)."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_id", "tokens", "done",
-                 "deadline", "error", "t_submit", "t_first", "_obs_ended")
+                 "deadline", "error", "t_submit", "t_first",
+                 "_obs_ended", "rid")
 
-    def __init__(self, prompt, max_new_tokens, eos_id, deadline=None):
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline=None,
+                 rid=None):
         import time
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -161,6 +189,7 @@ class Request:
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
         self._obs_ended = False
+        self.rid = rid
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -222,6 +251,12 @@ class ResilientScheduler:
     on_token = None
     on_retire = None
     bucket_policy = None
+    # role-tagged first-token metric: a prefill-only engine's "first
+    # token" is the END of prefill, not a client-visible TTFT — it
+    # records serve/prefill_s instead (the paged ctor overrides), so
+    # fleet-merged serve/ttft_s holds ONLY decode-side end-to-end
+    # samples (the PR 12 bench pre-mark workaround, retired)
+    _ttft_metric = "serve/ttft_s"
 
     @property
     def free_slots(self) -> int:
@@ -251,6 +286,7 @@ class ResilientScheduler:
     def _fail(self, req: Request, reason: str, slot: Optional[int] = None,
               stat: str = "serve/deadline_evictions"):
         from paddle_tpu import stats
+        from paddle_tpu.observability import flight
         req.done = True
         req.error = reason
         if slot is not None:
@@ -258,6 +294,12 @@ class ResilientScheduler:
             self._on_evict(slot)
             self._disp_rem[slot] = 0
         stats.add(stat)
+        # terminal failure: dump the request's flight record NOW — the
+        # postmortem (which bucket, which evictions, which handoff
+        # hops) must not require a re-run under tracing
+        flight.record(req.rid, "evicted", reason=reason, stat=stat,
+                      slot=slot, tokens=len(req.tokens))
+        flight.dump(req.rid, reason)
         self._obs_request_end(req)
 
     # -- pipelined dispatch (shared by both engines) ------------------------
@@ -317,7 +359,10 @@ class ResilientScheduler:
     def _finish_dispatch(self, kind, live, payload):
         """Post-enqueue bookkeeping shared by both engines: charge the
         budget shadows, queue the in-flight record, stamp the gap
-        timer, publish the gauge."""
+        timer, publish the gauge and the per-path launch counters (the
+        launch-tax numbers ROADMAP item 1's r06 recapture needs
+        attributable on-chip: serve/dispatch_launches total plus
+        serve/dispatches/<kind>)."""
         import time
         from paddle_tpu import stats
         for s, _ in live:
@@ -325,6 +370,8 @@ class ResilientScheduler:
         self._pending.append(_Inflight(kind, live, payload,
                                        time.perf_counter()))
         self._t_disp_end = time.perf_counter()
+        stats.add("serve/dispatch_launches")
+        stats.add(f"serve/dispatches/{kind}")
         stats.set_value("serve/inflight", len(self._pending))
 
     def _pump(self, dispatched: bool):
@@ -414,12 +461,16 @@ class ResilientScheduler:
 
     # -- serving metrics (shared by both engines) ---------------------------
     def _obs_first_token(self, req: Request):
-        """Called at the request's FIRST generated token."""
+        """Called at the request's FIRST generated token. Role-tagged:
+        decode-capable engines record ``serve/ttft_s``; a prefill-only
+        engine records ``serve/prefill_s`` (its first token marks the
+        end of prefill, and a prefill-side sample in the TTFT histogram
+        would halve the fleet's effective p99)."""
         import time
         from paddle_tpu import stats
         if req.t_first is None:
             req.t_first = time.perf_counter()
-            stats.observe("serve/ttft_s", req.t_first - req.t_submit)
+            stats.observe(self._ttft_metric, req.t_first - req.t_submit)
 
     def _obs_request_end(self, req: Request):
         """Request left the engine (done or evicted): close its span —
@@ -434,13 +485,20 @@ class ResilientScheduler:
         if req._obs_ended:
             return
         req._obs_ended = True
+        now = time.perf_counter()
         if req.t_first is not None and len(req.tokens) > 1:
             stats.observe("serve/tpot_s",
-                          (time.perf_counter() - req.t_first)
-                          / (len(req.tokens) - 1))
+                          (now - req.t_first) / (len(req.tokens) - 1))
         trace.complete("serve/request", req.t_submit,
-                       prompt=len(req.prompt), tokens=len(req.tokens),
-                       error=req.error)
+                       rid=req.rid, prompt=len(req.prompt),
+                       tokens=len(req.tokens), error=req.error)
+        if (req.t_first is not None
+                and self._ttft_metric == "serve/ttft_s"):
+            # the request's DECODE phase (first token → end) as its own
+            # rid-tagged span: the stitched per-request lane's decode
+            # segment (prefill-only engines have no decode phase)
+            trace.complete("serve/decode", req.t_first, rid=req.rid,
+                           tokens=len(req.tokens))
         if self.on_retire is not None:
             self.on_retire(req)
 
@@ -796,6 +854,7 @@ class DecodeEngine(ResilientScheduler):
         inside one CUDA graph. Emits the (chunk, S) tokens, emit flags
         and non-finite flags PACKED into one int32 array so the lagged
         harvest pays exactly one device→host transfer."""
+        _note_retrace("decode_multi")
 
         def one(carry, _):
             kc, vc, lengths, last, active, remaining, rng = carry
@@ -886,6 +945,7 @@ class DecodeEngine(ResilientScheduler):
         Emits the (chunk, S, K) predictions, (chunk, S) accepted counts
         and non-finite flags packed into ONE (chunk, S, K+2) int32
         array — one transfer per lagged harvest."""
+        _note_retrace("decode_spec")
         K = self.spec_k
 
         def one(carry, _):
@@ -955,6 +1015,7 @@ class DecodeEngine(ResilientScheduler):
         recorded in the device history buffer (the speculative path
         drafts from it). Returns the sampled token as an extra output;
         the scheduler harvests it lag-one like any other dispatch."""
+        _note_retrace("decode_prefill")
         cfg = self.cfg
         L, bucket = cfg.n_layers, tokens.shape[1]
         sl = (L, 1, cfg.kv_heads, self.T, cfg.head_dim)
@@ -1024,16 +1085,20 @@ class DecodeEngine(ResilientScheduler):
 
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               req_id: Optional[str] = None) -> Request:
         """``deadline_s``: wall-time budget for this request (queue wait
         included). A request past its deadline is evicted alone — the
-        batch keeps serving its peers."""
+        batch keeps serving its peers. ``req_id`` is the fleet-wide
+        trace context (front-end/router request id) carried into every
+        request-scoped span and flight-recorder event."""
         import time
         prompt = list(np.asarray(prompt).reshape(-1))
         self.check_request(len(prompt), max_new_tokens)
         req = Request(prompt, max_new_tokens, eos_id,
                       deadline=(None if deadline_s is None
-                                else time.monotonic() + deadline_s))
+                                else time.monotonic() + deadline_s),
+                      rid=req_id)
         self._waiting.append(req)
         return req
 
@@ -1049,10 +1114,17 @@ class DecodeEngine(ResilientScheduler):
         token budget, interleaved with decode dispatches, so a long
         prompt no longer stalls live slots for its whole prefill."""
         import time
+        from paddle_tpu.observability import flight, trace
         slot = self._free_slot()
         if slot is None or not self._waiting:
             return False
         req = self._waiting.popleft()
+        # the queue-wait phase ends HERE: the stitched per-request lane
+        # derives queue-wait from submission to prefill start
+        trace.complete("serve/queue", req.t_submit, rid=req.rid,
+                       slot=slot)
+        flight.record(req.rid, "admit", slot=slot,
+                      prompt=len(req.prompt))
         self._slot_req[slot] = req      # reserve; decode skips it until
         self._disp_rem[slot] = 0        # the final chunk flips it live
         self._admitting.append({
@@ -1070,7 +1142,8 @@ class DecodeEngine(ResilientScheduler):
         the harvest queue as a 'prefill' record. Returns (bucket tokens
         consumed, finished)."""
         import time
-        from paddle_tpu.observability import trace
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight, trace
         req, slot = job["req"], job["slot"]
         prompt, start = job["prompt"], job["start"]
         total = len(prompt)
@@ -1097,7 +1170,12 @@ class DecodeEngine(ResilientScheduler):
         is_final = s0 + n >= total
         rem0 = req.max_new_tokens - 1
         eos0 = -1 if req.eos_id is None else int(req.eos_id)
-        with trace.span("serve/prefill", bucket=bucket, slot=slot):
+        stats.add("serve/dispatch_launches")
+        stats.add("serve/dispatches/prefill")
+        flight.record(req.rid, "prefill-chunk", bucket=bucket,
+                      start=int(s0), final=bool(is_final))
+        with trace.span("serve/prefill", bucket=bucket, slot=slot,
+                        rid=req.rid):
             (self.kc, self.vc, self.toks, self.lengths, self.last,
              self.active, self.remaining, self.eos_ids, self._rng,
              nxt) = self._prefill_fn(
@@ -1112,7 +1190,7 @@ class DecodeEngine(ResilientScheduler):
             self._pending.append(_Inflight("prefill", [(slot, req)], nxt,
                                            time.perf_counter()))
             trace.complete("serve/admit", job["t0"], slot=slot,
-                           prompt=total)
+                           prompt=total, rid=req.rid)
         return bucket, is_final
 
     def _advance_admissions(self):

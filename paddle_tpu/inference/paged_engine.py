@@ -80,7 +80,8 @@ from jax import lax
 from paddle_tpu.models import gpt as gpt_lib
 from paddle_tpu.inference.decode_engine import (Request,
                                                 ResilientScheduler,
-                                                _Inflight)
+                                                _Inflight,
+                                                _note_retrace)
 from paddle_tpu.inference.prefix_cache import PrefixCache
 from paddle_tpu.ops.pallas.decode_attention import fold_fresh_row
 from paddle_tpu.ops.pallas.paged_attention import (paged_append_attend,
@@ -174,6 +175,11 @@ class PagedDecodeEngine(ResilientScheduler):
         # FleetPrefixDirectory (serving/disagg.py) consulted at
         # admission when the local prefix cache misses
         self.prefill_only = bool(prefill_only)
+        if self.prefill_only:
+            # role-tagged first-token metric: this engine's "first
+            # token" marks the END of prefill, never a client TTFT —
+            # fleet-merged serve/ttft_s stays decode-side only
+            self._ttft_metric = "serve/prefill_s"
         self.fleet = None
         # pages whose KV arrived over a LOSSY wire (int8/fp8 handoff or
         # fleet fetch): fine to serve and to share locally, but never
@@ -469,6 +475,7 @@ class PagedDecodeEngine(ResilientScheduler):
         Tokens, emit flags and non-finite flags come back PACKED into
         one (3, chunk, S) int32 array — the lagged harvest pays exactly
         one device→host transfer."""
+        _note_retrace("paged_multi")
 
         def one(carry, _):
             kp, vp, lengths, last, active, remaining = carry
@@ -498,6 +505,7 @@ class PagedDecodeEngine(ResilientScheduler):
         src_start, run) per layer — page-run copies resolved host-side
         (statically shaped per bucket: n_seg = ceil(bucket/page) + 1,
         padded with run=0)."""
+        _note_retrace("paged_prefill")
         cfg = self.cfg
         x = jnp.take(head["wte"], tokens, axis=0)
         if head["wpe"] is not None:
@@ -598,6 +606,7 @@ class PagedDecodeEngine(ResilientScheduler):
         tokens: (1, bucket) suffix zero-padded; sp/true_n scalars
         (suffix = prompt[sp:true_n]); table_row: (max_pages,) this
         slot's UNFOLDED page table row."""
+        _note_retrace("paged_prefill_suffix")
         cfg = self.cfg
         bucket = tokens.shape[1]
         L = cfg.n_layers
@@ -703,13 +712,15 @@ class PagedDecodeEngine(ResilientScheduler):
 
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               req_id: Optional[str] = None) -> Request:
         import time
         prompt = list(np.asarray(prompt).reshape(-1))
         self.check_request(len(prompt), max_new_tokens)
         req = Request(prompt, max_new_tokens, eos_id,
                       deadline=(None if deadline_s is None
-                                else time.monotonic() + deadline_s))
+                                else time.monotonic() + deadline_s),
+                      rid=req_id)
         self._waiting.append(req)
         return req
 
@@ -937,7 +948,8 @@ class PagedDecodeEngine(ResilientScheduler):
         them. With the prefix cache on, the longest cached prefix's
         pages are mapped read-only and only the suffix is prefilled."""
         import time
-        from paddle_tpu.observability import trace
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight, trace
         # ptlint: disable=PT001 -- req.prompt is a host int list
         # (submit coerced it); this is an upload, never a sync
         prompt = np.asarray(req.prompt, np.int32)
@@ -966,6 +978,17 @@ class PagedDecodeEngine(ResilientScheduler):
                 stats.add("serve/prefix_hit_tokens", sp)
         self._corrupt_shared_pages(tab[:sp // self.page])
         bucket = next(b for b in self.buckets if b >= n - sp)
+        # observability lands only once the reservation HELD — the
+        # MemoryError-retried admission re-runs this whole method, and
+        # a duplicate serve/queue span would put phantom queue-wait
+        # intervals on the stitched per-request lane (same rationale as
+        # the prefix counters above)
+        trace.complete("serve/queue", req.t_submit, rid=req.rid,
+                       slot=slot)
+        stats.add("serve/dispatch_launches")
+        stats.add("serve/dispatches/prefill")
+        flight.record(req.rid, "admit", slot=slot, prompt=n,
+                      bucket=bucket, cached=sp)
         if sp:
             suffix = np.zeros((1, bucket), np.int32)
             suffix[0, :n - sp] = prompt[sp:]
@@ -985,7 +1008,7 @@ class PagedDecodeEngine(ResilientScheduler):
             row = np.zeros((mx,), np.int32)
             row[:len(tab)] = tab
             with trace.span("serve/admit", slot=slot, prompt=n,
-                            bucket=bucket, cached=sp):
+                            bucket=bucket, cached=sp, rid=req.rid):
                 self.kp, self.vp, nxt = self._prefill_sfx_fn(
                     self._head, self._stacked, self.kp, self.vp,
                     jnp.asarray(suffix), jnp.int32(sp), jnp.int32(n),
@@ -1006,7 +1029,7 @@ class PagedDecodeEngine(ResilientScheduler):
                 t += run
                 i += 1
             with trace.span("serve/admit", slot=slot, prompt=n,
-                            bucket=bucket, cached=0):
+                            bucket=bucket, cached=0, rid=req.rid):
                 self.kp, self.vp, nxt = self._prefill_fn(
                     self._head, self._stacked, self.kp, self.vp,
                     jnp.asarray(padded), jnp.int32(n),
@@ -1102,7 +1125,14 @@ class PagedDecodeEngine(ResilientScheduler):
         meta = {"prompt": list(req.prompt), "n_tokens": n,
                 "first": int(req.tokens[0]),
                 "max_new_tokens": int(req.max_new_tokens),
-                "eos_id": req.eos_id}
+                "eos_id": req.eos_id,
+                # trace context rides the handoff: the decode replica's
+                # spans for this request carry the SAME rid, so the
+                # per-replica trace files stitch into one timeline
+                "rid": req.rid}
+        from paddle_tpu.observability import flight
+        flight.record(req.rid, "handoff-detach", n_tokens=n,
+                      pages=npg)
         # retire cleanly: registered prefix pages go warm (they stay
         # published/fleet-canonical on this replica), private ones free
         self._slot_req[slot] = None
@@ -1124,7 +1154,8 @@ class PagedDecodeEngine(ResilientScheduler):
         req = _HandoffRequest(
             meta["prompt"], meta["max_new_tokens"], meta["eos_id"],
             deadline=(None if deadline_s is None
-                      else time.monotonic() + deadline_s))
+                      else time.monotonic() + deadline_s),
+            rid=meta.get("rid"))
         req.kv_first = int(meta["first"])
         req.kv_pages = (np.asarray(k), np.asarray(v))
         # the wire these pages crossed (senders stamp it into the
@@ -1166,7 +1197,10 @@ class PagedDecodeEngine(ResilientScheduler):
         the fleet like any registration), then reconstruct the device
         state the prefill replica's ``_admit`` would have left."""
         import time
+        from paddle_tpu.observability import flight
         n = len(req.prompt)
+        flight.record(req.rid, "handoff-install", n_tokens=n,
+                      slot=slot, wire=req.kv_wire)
         self._reserve(slot, n)
         tab = self._tables[slot]
         k, v = req.kv_pages

@@ -22,15 +22,23 @@ from paddle_tpu.observability.trace import (span, begin, end, complete,
 from paddle_tpu.observability.statsz import (StatszServer, start_statsz,
                                              stop_statsz)
 from paddle_tpu.observability.merge import (merge_trace_files,
-                                            merge_rank_traces)
+                                            merge_rank_traces,
+                                            stitch_trace_files,
+                                            stitch_rank_traces,
+                                            request_segments)
 from paddle_tpu.observability import comm
 from paddle_tpu.observability.comm import (exposed_time, step_overlap,
                                            record_step_overlap)
+from paddle_tpu.observability import flight
+from paddle_tpu.observability import runtime
 
 __all__ = ["trace", "span", "begin", "end", "complete", "instant",
            "StatszServer", "start_statsz", "stop_statsz",
-           "merge_trace_files", "merge_rank_traces", "init_from_env",
-           "comm", "exposed_time", "step_overlap", "record_step_overlap"]
+           "merge_trace_files", "merge_rank_traces",
+           "stitch_trace_files", "stitch_rank_traces",
+           "request_segments", "init_from_env",
+           "comm", "exposed_time", "step_overlap", "record_step_overlap",
+           "flight", "runtime"]
 
 
 def init_from_env():

@@ -1,0 +1,181 @@
+"""Per-request flight recorder: a bounded ring of request event
+timelines, auto-dumped on terminal failures (ISSUE 13 tentpole).
+
+Tracing answers "where did the time go" but must be switched on BEFORE
+the interesting request arrives; the flight recorder answers "what
+happened to THIS request" after the fact. Engines, the front-end, the
+router, and the disaggregation loops append cheap host-side events
+(admission verdict, bucket choice, placements, handoff hops, evictions,
+retries) keyed by request id; on a terminal failure — deadline
+eviction, non-finite poison, ``handoff-failed`` — the request's whole
+timeline is dumped as JSON, so a postmortem needs no re-run under
+tracing.
+
+Bounds: at most ``PT_FLIGHT_RING`` requests are tracked (FIFO — the
+oldest request's timeline is forgotten when a new one needs the slot;
+0 disables recording entirely) and at most ``MAX_EVENTS`` events are
+kept per request (oldest dropped first). Recording is one short lock
+around a deque append — safe inside the serving hot path.
+
+Dumps land as ``flight_<rid>.<pid>.json`` under ``PT_FLIGHT_DIR``
+(falling back to ``PT_TRACE_DIR``); with neither set, the record is
+emitted as ONE structured stderr line. Every dump ticks
+``serve/flight_dumps``. Each process keeps its OWN recorder — a fleet
+request's dump holds the events observed by the dumping process (the
+router's dump shows placements and retries, a replica's dump its
+admissions and evictions), which is why the pid is in the filename:
+router and replicas share the launch's dump dir, and both may dump
+the same rid."""
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+__all__ = ["FlightRecorder", "default_recorder", "record", "events",
+           "dump", "forget", "reset", "MAX_EVENTS"]
+
+_DEFAULT_RING = 256
+MAX_EVENTS = 64
+
+
+def _ring_from_env() -> int:
+    try:
+        return int(os.environ.get("PT_FLIGHT_RING", str(_DEFAULT_RING)))
+    except ValueError:
+        return _DEFAULT_RING
+
+
+def _dump_dir() -> Optional[str]:
+    return (os.environ.get("PT_FLIGHT_DIR")
+            or os.environ.get("PT_TRACE_DIR"))
+
+
+class FlightRecorder:
+    """Bounded per-request event ring. One module-level instance per
+    process (``default_recorder()``); tests may build their own."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 max_events: int = MAX_EVENTS):
+        self.capacity = (_ring_from_env() if capacity is None
+                         else int(capacity))
+        self.max_events = int(max_events)
+        self._lock = threading.Lock()
+        # rid -> deque[(wall_s, event, attrs)] — insertion order IS the
+        # FIFO eviction order (requests are tracked from first event)
+        self._reqs = collections.OrderedDict()
+        self.dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def record(self, rid, event: str, **attrs):
+        """Append one event to ``rid``'s timeline (no-op for rid=None
+        or a disabled recorder)."""
+        if rid is None or self.capacity <= 0:
+            return
+        t = time.time()
+        with self._lock:
+            dq = self._reqs.get(rid)
+            if dq is None:
+                while len(self._reqs) >= self.capacity:
+                    self._reqs.popitem(last=False)
+                    self.dropped += 1
+                dq = self._reqs[rid] = collections.deque(
+                    maxlen=self.max_events)
+            dq.append((t, event, attrs or None))
+
+    def events(self, rid):
+        """``rid``'s recorded timeline, oldest first, as JSON-able
+        dicts."""
+        with self._lock:
+            dq = self._reqs.get(rid)
+            rows = list(dq) if dq is not None else []
+        out = []
+        for t, event, attrs in rows:
+            row = {"t": t, "event": event}
+            if attrs:
+                row.update(attrs)
+            out.append(row)
+        return out
+
+    def forget(self, rid):
+        with self._lock:
+            self._reqs.pop(rid, None)
+
+    def reset(self, capacity: Optional[int] = None):
+        with self._lock:
+            self._reqs.clear()
+            self.dropped = 0
+            if capacity is not None:
+                self.capacity = int(capacity)
+
+    def dump(self, rid, reason: str) -> Optional[dict]:
+        """Serialize ``rid``'s timeline on a terminal failure. Returns
+        the record dict (None when nothing was tracked). Best-effort by
+        contract: a failing dump must never take the serving loop down
+        with it."""
+        evs = self.events(rid)
+        if not evs:
+            return None
+        rec = {"rid": rid, "reason": reason, "dumped_at": time.time(),
+               "pid": os.getpid(),
+               "rank": os.environ.get("PT_PROCESS_ID", "0"),
+               "events": evs}
+        from paddle_tpu import stats
+        stats.add("serve/flight_dumps")
+        try:
+            d = _dump_dir()
+            if d:
+                os.makedirs(d, exist_ok=True)
+                safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                               for c in str(rid))
+                # pid-suffixed: router and replicas share the dump dir
+                # (one PT_TRACE_DIR per launch) and each holds a
+                # DIFFERENT view of the same request — a bare
+                # flight_<rid>.json would let whichever process dumps
+                # last destroy the other's postmortem
+                path = os.path.join(
+                    d, f"flight_{safe}.{os.getpid()}.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(rec, f)
+                os.replace(tmp, path)
+                rec["path"] = path
+            else:
+                print("[flight] " + json.dumps(rec), file=sys.stderr,
+                      flush=True)
+        except Exception:
+            pass
+        return rec
+
+
+_DEFAULT = FlightRecorder()
+
+
+def default_recorder() -> FlightRecorder:
+    return _DEFAULT
+
+
+def record(rid, event: str, **attrs):
+    _DEFAULT.record(rid, event, **attrs)
+
+
+def events(rid):
+    return _DEFAULT.events(rid)
+
+
+def dump(rid, reason: str) -> Optional[dict]:
+    return _DEFAULT.dump(rid, reason)
+
+
+def forget(rid):
+    _DEFAULT.forget(rid)
+
+
+def reset(capacity: Optional[int] = None):
+    _DEFAULT.reset(capacity)

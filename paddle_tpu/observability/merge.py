@@ -1,4 +1,5 @@
-"""Merge per-rank Chrome-trace files into ONE Perfetto timeline.
+"""Merge per-rank Chrome-trace files into ONE Perfetto timeline —
+and STITCH per-replica traces into per-request lanes.
 
 Each rank exports ``trace_rank{N}.json`` with pid = rank (trace.py), so
 merging is: concatenate every rank's ``traceEvents``, keep exactly one
@@ -7,17 +8,44 @@ write a single valid Chrome-trace document — Perfetto shows one lane
 per rank, nested host spans inside each. The launcher calls this on
 exit when ``PT_TRACE_DIR`` is set; ``tools/trace_merge.py`` is the
 offline CLI for log dirs collected from multi-host jobs.
+
+**Stitch mode** (ISSUE 13): serving processes tag request-scoped spans
+with the request id (``args.rid`` — minted at router/front-end
+admission and carried through mailboxes, handoff meta, and KV blobs),
+and every process exports on the SAME wall-clock-rebased timeline
+(trace.py's perf→wall offset), so joining per-replica trace files
+recovers each request's cross-process story.
+:func:`stitch_trace_files` merges the files (one lane per FILE — a
+fleet of nproc=1 launches is all rank 0, so filenames, not pids, name
+the lanes) and adds a synthetic ``requests`` process with one thread
+lane per request showing the phase segments::
+
+    queue-wait → prefill → kv-transfer → decode → stream
+
+derived from span BOUNDARIES (:func:`request_segments`): queue-wait is
+client submission (``serve/route`` start) to prefill start
+(``serve/admit``), kv-transfer is prefill end to decode start
+(``serve/decode`` — covers encode, store transit, routing, fetch,
+install), stream is decode end to the router picking up the result.
+The segments therefore TILE the client-observed window — their sum
+equals the ``serve/route`` span up to clock-rebase error.
 """
 
 import glob
 import json
 import os
 import re
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["merge_trace_files", "merge_rank_traces", "MERGED_NAME"]
+__all__ = ["merge_trace_files", "merge_rank_traces",
+           "stitch_trace_files", "stitch_rank_traces",
+           "discover_trace_files", "request_segments", "MERGED_NAME",
+           "STITCHED_NAME", "REQUEST_SEGMENTS"]
 
 MERGED_NAME = "trace_merged.json"
+STITCHED_NAME = "trace_stitched.json"
+REQUEST_SEGMENTS = ("queue-wait", "prefill", "kv-transfer", "decode",
+                    "stream")
 _RANK_RE = re.compile(r"trace_rank(\d+)\.json$")
 
 
@@ -65,6 +93,142 @@ def merge_trace_files(paths: Sequence[str], out_path: str) -> str:
     return out_path
 
 
+def _rid_spans(events):
+    """rid -> [span events], spans sorted by start within each rid."""
+    by_rid: Dict[str, list] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        rid = (ev.get("args") or {}).get("rid")
+        if rid:
+            by_rid.setdefault(str(rid), []).append(ev)
+    for evs in by_rid.values():
+        evs.sort(key=lambda e: e.get("ts", 0.0))
+    return by_rid
+
+
+def request_segments(events) -> Dict[str, dict]:
+    """Derive each request's phase segments (µs timestamps/durations,
+    the Chrome-trace unit) from its rid-tagged spans:
+
+    - ``serve/route`` (router: submit → result pickup) anchors the
+      client-observed window;
+    - the EARLIEST ``serve/admit`` is the prefill phase (redistributed
+      re-executions keep their later admits on the raw lanes);
+    - the LATEST ``serve/decode`` is the decode phase (the one that
+      produced the final result);
+    - ``kv-transfer`` is the prefill-end → decode-start boundary gap,
+      emitted only when a ``serve/kv_transfer`` (or ``serve/kv_publish``)
+      span proves pages actually crossed the wire;
+    - ``stream`` is decode end → route end (result transit + pickup).
+
+    Returns ``{rid: {"segments": {name: (ts, dur)}, "client_us": dur
+    or None, "pids": [...]}}``. Segments whose boundaries invert under
+    cross-host clock skew clamp to zero duration rather than lie."""
+    out: Dict[str, dict] = {}
+    for rid, evs in sorted(_rid_spans(events).items()):
+        def first(name):
+            return next((e for e in evs if e["name"] == name), None)
+
+        def last(name):
+            hit = None
+            for e in evs:
+                if e["name"] == name:
+                    hit = e
+            return hit
+
+        route = first("serve/route")
+        admit = first("serve/admit")
+        decode = last("serve/decode")
+        moved_kv = any(e["name"] in ("serve/kv_transfer",
+                                     "serve/kv_publish") for e in evs)
+        segs: Dict[str, Tuple[float, float]] = {}
+        t0 = route["ts"] if route else None
+        if t0 is None:
+            q = first("serve/queue")
+            t0 = q["ts"] if q else (admit["ts"] if admit else None)
+        p_end = None
+        if admit is not None:
+            if t0 is not None:
+                segs["queue-wait"] = (t0, max(0.0, admit["ts"] - t0))
+            segs["prefill"] = (admit["ts"], admit.get("dur", 0.0))
+            p_end = admit["ts"] + admit.get("dur", 0.0)
+        d_end = p_end
+        if decode is not None:
+            d0 = decode["ts"]
+            if moved_kv and p_end is not None:
+                segs["kv-transfer"] = (p_end, max(0.0, d0 - p_end))
+            segs["decode"] = (d0, decode.get("dur", 0.0))
+            d_end = d0 + decode.get("dur", 0.0)
+        if route is not None and d_end is not None:
+            r_end = route["ts"] + route.get("dur", 0.0)
+            segs["stream"] = (d_end, max(0.0, r_end - d_end))
+        out[rid] = {"segments": segs,
+                    "client_us": route.get("dur") if route else None,
+                    "pids": sorted({e.get("pid", 0) for e in evs})}
+    return out
+
+
+def stitch_trace_files(paths: Sequence[str], out_path: str,
+                       requests_pid: int = 9999):
+    """Join per-replica trace files into ONE Perfetto timeline with a
+    per-request lane. Each input FILE becomes one process lane named
+    after the file (``trace_pf0.json`` → lane ``pf0``) — replica
+    processes launched with nproc_per_node=1 are all rank 0, so the
+    exported pids would collide. A synthetic ``requests`` process gets
+    one thread per stitched request carrying its phase segments
+    (:func:`request_segments`). Returns ``(out_path, summary)`` where
+    ``summary`` is the request_segments dict (durations in µs) for
+    programmatic assertions (the fleetobs smoke's 10% latency-sum
+    check)."""
+    events: List[dict] = []
+    meta: List[dict] = []
+    for i, path in enumerate(sorted(paths)):
+        stem = os.path.splitext(os.path.basename(path))[0]
+        if stem.startswith("trace_"):
+            stem = stem[len("trace_"):]
+        pid = 1000 + i
+        meta.extend([
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": stem}},
+            {"name": "process_sort_index", "ph": "M", "pid": pid,
+             "tid": 0, "args": {"sort_index": i + 1}},
+        ])
+        for ev in _load_events(path):
+            if ev.get("ph") == "M":
+                continue            # lanes are renamed per file
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+    summary = request_segments(events)
+    meta.append({"name": "process_name", "ph": "M", "pid": requests_pid,
+                 "tid": 0, "args": {"name": "requests"}})
+    meta.append({"name": "process_sort_index", "ph": "M",
+                 "pid": requests_pid, "tid": 0,
+                 "args": {"sort_index": 0}})
+    for idx, (rid, info) in enumerate(summary.items()):
+        tid = idx + 1
+        meta.append({"name": "thread_name", "ph": "M",
+                     "pid": requests_pid, "tid": tid,
+                     "args": {"name": rid}})
+        for seg, (ts, dur) in info["segments"].items():
+            events.append({"name": seg, "ph": "X", "cat": "request",
+                           "ts": ts, "dur": dur, "pid": requests_pid,
+                           "tid": tid, "args": {"rid": rid}})
+    doc = {"traceEvents": meta + events, "displayTimeUnit": "ms",
+           "otherData": {
+               "stitched_from": [os.path.basename(p)
+                                 for p in sorted(paths)],
+               "requests": len(summary)}}
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)),
+                exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+    return out_path, summary
+
+
 def merge_rank_traces(trace_dir: str,
                       out_path: Optional[str] = None) -> Optional[str]:
     """Merge every ``trace_rank*.json`` under ``trace_dir`` into
@@ -77,3 +241,48 @@ def merge_rank_traces(trace_dir: str,
         return None
     return merge_trace_files(
         paths, out_path or os.path.join(trace_dir, MERGED_NAME))
+
+
+def discover_trace_files(trace_dir: str) -> List[str]:
+    """Every stitchable ``trace_*.json`` under ``trace_dir`` — rank
+    files, replica files, the launcher lane — excluding previous
+    merge/stitch OUTPUTS (the one discovery rule; the CLI and the
+    launcher-exit stitch both use it)."""
+    skip = {MERGED_NAME, STITCHED_NAME}
+    return [p for p in sorted(
+                glob.glob(os.path.join(trace_dir, "trace_*.json")))
+            if os.path.basename(p) not in skip]
+
+
+def stitch_rank_traces(trace_dir: str,
+                       out_path: Optional[str] = None) -> Optional[str]:
+    """Stitch every ``trace_*.json`` under ``trace_dir`` (rank files,
+    replica files, the launcher lane — but not a previous merge/stitch
+    output) into ``trace_stitched.json``. Returns None — and leaves no
+    file — when no request-tagged spans exist to stitch (a training
+    job's trace dir, say): a cheap raw-text probe for the ``"rid"``
+    attr key skips the parse + renumber + write entirely for the
+    common rid-less case (the launcher exit hook runs this right after
+    the plain merge already paid one full load)."""
+    paths = discover_trace_files(trace_dir)
+    if not paths:
+        return None
+
+    def _maybe_rid(path):
+        try:
+            with open(path) as f:
+                return '"rid"' in f.read()
+        except OSError:
+            return False
+
+    if not any(_maybe_rid(p) for p in paths):
+        return None
+    out = out_path or os.path.join(trace_dir, STITCHED_NAME)
+    out, summary = stitch_trace_files(paths, out)
+    if not summary:
+        try:
+            os.remove(out)
+        except OSError:
+            pass
+        return None
+    return out

@@ -1,0 +1,93 @@
+"""Runtime telemetry: live/peak HBM gauges and per-executable memory
+footprints (ISSUE 13 tentpole, the on-chip numbers the r06 recapture
+needs attributable to a serving timeline).
+
+Two sources, one gauge namespace:
+
+- :func:`hbm_gauges` — the PJRT allocator's live/peak bytes
+  (``device.memory_stats()``): ``mem/hbm_bytes_in_use`` and
+  ``mem/hbm_peak_bytes`` summed over local devices, plus per-device
+  ``mem/hbm_bytes_in_use/d{N}`` when ``per_device=True``. Backends
+  without allocator stats (CPU) record nothing and return ``{}`` —
+  callers never need to guard.
+- :func:`memory_analysis_gauges` — a compiled executable's static
+  footprint (``compiled.memory_analysis()``): argument / output /
+  temp / generated-code bytes as ``mem/compiled_*_bytes`` gauges,
+  labelled per call site via ``name``.
+
+The compile/retrace COUNTERS (``compile/retrace`` and
+``compile/retrace/<fn>``) live with the engines' jitted bodies — a
+trace-time ``stats.add`` fires exactly once per (re)trace, which is
+the dynamic complement to ptlint PT002's static retrace check.
+"""
+
+from typing import Optional
+
+__all__ = ["hbm_gauges", "memory_analysis_gauges"]
+
+_MA_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes")
+
+
+def hbm_gauges(devices=None, per_device: bool = False) -> dict:
+    """Record the allocator's live/peak HBM bytes as gauges. Returns
+    the flat dict recorded (empty when the backend exposes no
+    ``memory_stats`` — host CPU)."""
+    from paddle_tpu import stats
+    if devices is None:
+        try:
+            import jax
+            devices = jax.local_devices()
+        except Exception:
+            return {}
+    live = peak = 0
+    seen = False
+    out = {}
+    for i, d in enumerate(devices):
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if not ms:
+            continue
+        seen = True
+        b = int(ms.get("bytes_in_use", 0))
+        p = int(ms.get("peak_bytes_in_use", ms.get("bytes_in_use", 0)))
+        live += b
+        peak += p
+        if per_device:
+            out[f"mem/hbm_bytes_in_use/d{i}"] = b
+            out[f"mem/hbm_peak_bytes/d{i}"] = p
+    if not seen:
+        return {}
+    out["mem/hbm_bytes_in_use"] = live
+    out["mem/hbm_peak_bytes"] = peak
+    for k, v in out.items():
+        stats.set_value(k, v)
+    return out
+
+
+def memory_analysis_gauges(compiled, name: Optional[str] = None) -> dict:
+    """Record a compiled executable's ``memory_analysis()`` sizes as
+    ``mem/compiled_<field>_bytes`` gauges (suffixed ``/<name>`` when
+    given). ``compiled`` is the result of ``jit(f).lower(...).compile()``
+    (or anything with a ``memory_analysis`` attr). Returns the recorded
+    dict; backends without the analysis record nothing."""
+    from paddle_tpu import stats
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    out = {}
+    sfx = f"/{name}" if name else ""
+    for field in _MA_FIELDS:
+        v = getattr(ma, field, None)
+        if v is None:
+            continue
+        key = field[:-len("_in_bytes")] if field.endswith("_in_bytes") \
+            else field
+        out[f"mem/compiled_{key}_bytes{sfx}"] = int(v)
+    for k, v in out.items():
+        stats.set_value(k, v)
+    return out

@@ -30,18 +30,29 @@ _server: Optional["StatszServer"] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
+    def _registry(self):
+        """The registry this server snapshots: the process default, or
+        the server's ``registry`` provider (a StatRegistry or a
+        callable returning one — the fleet /statsz serves a freshly
+        merged registry per scrape this way)."""
+        reg = getattr(self.server, "pt_registry", None)
+        if reg is None:
+            from paddle_tpu import stats
+            return stats.default_registry()
+        return reg() if callable(reg) else reg
+
     def do_GET(self):  # noqa: N802 (http.server contract)
-        from paddle_tpu import stats
+        reg = self._registry()
         u = urlparse(self.path)
         if u.path in ("/statsz", "/statsz/"):
             q = parse_qs(u.query)
             if q.get("flat"):
-                body = json.dumps(stats.snapshot())
+                body = json.dumps(reg.snapshot())
             else:
-                body = json.dumps(stats.export())
+                body = json.dumps(reg.export())
             ctype = "application/json"
         elif u.path == "/":
-            body = stats.table() + "\n"
+            body = reg.table() + "\n"
             ctype = "text/plain; charset=utf-8"
         else:
             self.send_error(404, "try /statsz or /")
@@ -59,10 +70,15 @@ class _Handler(BaseHTTPRequestHandler):
 
 class StatszServer:
     """ThreadingHTTPServer on a daemon thread; ``port=0`` binds an
-    ephemeral port (read ``.port`` after construction — tests use this)."""
+    ephemeral port (read ``.port`` after construction — tests use
+    this). ``registry`` overrides what is served: a StatRegistry, or a
+    zero-arg callable returning one evaluated per scrape (the fleet
+    /statsz serves ``FleetStats.merged`` through this)."""
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 registry=None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.pt_registry = registry
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

@@ -49,7 +49,7 @@ from typing import Optional
 
 __all__ = ["span", "begin", "end", "complete", "instant", "enable",
            "disable", "enabled", "export", "events", "clear",
-           "trace_file_from_env"]
+           "trace_file_from_env", "start_flush"]
 
 _DEFAULT_RING = 65536
 
@@ -268,11 +268,18 @@ def trace_file_from_env() -> Optional[str]:
     return None
 
 
+_EXPORT_LOCK = threading.Lock()
+
+
 def export(path: Optional[str] = None) -> Optional[str]:
     """Write the ring as Chrome-trace JSON (``{"traceEvents": [...]}``)
     that loads in Perfetto / chrome://tracing. Returns the path written
     (None when there is nowhere to write). pid = rank, tid = OS thread;
-    span/parent ids ride in ``args`` so tooling can rebuild the tree."""
+    span/parent ids ride in ``args`` so tooling can rebuild the tree.
+    Serialized by a module lock: the periodic flush thread and the
+    atexit/explicit export would otherwise truncate each other's
+    ``.tmp`` mid-write and rename interleaved bytes into the published
+    file — the atomic-rewrite guarantee holds only with one writer."""
     path = path or _TRACER.out_path or trace_file_from_env()
     if path is None:
         return None
@@ -303,21 +310,74 @@ def export(path: Optional[str] = None) -> Optional[str]:
         _TRACER._dropped_reported = dropped
     doc = {"traceEvents": out, "displayTimeUnit": "ms",
            "otherData": {"rank": rank, "dropped": dropped}}
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f)
-    os.replace(tmp, path)
+    with _EXPORT_LOCK:
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
     return path
+
+
+_FLUSH_THREAD = None
+
+
+def _flush_interval_from_env() -> float:
+    """Unset / empty / malformed all mean the documented DEFAULT (5s)
+    — only an explicit '0' (or negative) disables the flush. An empty
+    template variable must not silently switch off the hard-kill
+    trace-loss fix this knob exists for."""
+    raw = os.environ.get("PT_TRACE_FLUSH_S")
+    if raw is None or raw.strip() == "":
+        return 5.0
+    try:
+        return float(raw)
+    except ValueError:
+        return 5.0
+
+
+def start_flush(interval_s: Optional[float] = None):
+    """Periodic atomic rewrite of the (partial) trace file — the
+    trace-loss-on-hard-kill fix: the ring otherwise exports only via
+    atexit, so a SIGKILLed replica (exactly the interesting one) left
+    no trace at all. Every ``interval_s`` seconds (default
+    ``PT_TRACE_FLUSH_S``, 5s; <= 0 disables) the ring is exported via
+    the tmp-file + rename path, so readers always see a complete JSON
+    document and a hard kill loses at most one interval of spans.
+    Idempotent; the thread is a daemon and re-checks ``enabled`` every
+    tick, so ``disable()`` quiesces it."""
+    global _FLUSH_THREAD
+    iv = _flush_interval_from_env() if interval_s is None \
+        else float(interval_s)
+    if iv <= 0 or _FLUSH_THREAD is not None:
+        return None
+
+    def _loop():
+        while True:
+            time.sleep(iv)
+            if not _TRACER.enabled:
+                continue
+            try:
+                export()
+            except Exception:
+                pass
+
+    t = threading.Thread(target=_loop, name="pt-trace-flush",
+                         daemon=True)
+    t.start()
+    _FLUSH_THREAD = t
+    return t
 
 
 def _init_from_env():
     """PT_TRACE_DIR / PT_TRACE_FILE switch tracing on for this process;
-    the atexit hook exports what the ring holds. The output path is NOT
-    latched here: PT_PROCESS_ID may only be published after import
-    (env.init_parallel_env with an explicit process_id), so export()
-    re-resolves trace_file_from_env() at write time — every rank lands
-    on its own trace_rank{N}.json."""
+    the atexit hook exports what the ring holds and the periodic flush
+    (PT_TRACE_FLUSH_S) keeps a partial export on disk between
+    harvests. The output path is NOT latched here: PT_PROCESS_ID may
+    only be published after import (env.init_parallel_env with an
+    explicit process_id), so export() re-resolves trace_file_from_env()
+    at write time — every rank lands on its own trace_rank{N}.json."""
     if trace_file_from_env() is None:
         return
     try:
@@ -325,6 +385,7 @@ def _init_from_env():
     except ValueError:
         capacity = _DEFAULT_RING
     enable(capacity=capacity)
+    start_flush()
     import atexit
 
     def _dump():

@@ -64,18 +64,42 @@ def fleet_enabled() -> bool:
     return os.environ.get("PT_FLEET_PREFIX", "1") != "0"
 
 
-def replica_load(engine, role: str, queued: int = 0) -> dict:
+def queue_age_s(frontend=None, engine=None) -> float:
+    """Age (seconds) of the OLDEST waiting request — the runaway-queue
+    detector's per-replica gauge. Looks at the front-end admission
+    queue and/or the engine's own waiting deque (whichever the caller
+    has); 0.0 when nothing waits."""
+    now = time.perf_counter()
+    ages = [0.0]
+    if frontend is not None and frontend._queue:
+        ages.append(now - min(r.t_submit for r in frontend._queue))
+    eng = engine if engine is not None else (
+        frontend.engine if frontend is not None else None)
+    if eng is not None and eng._waiting:
+        ages.append(now - min(r.t_submit for r in eng._waiting))
+    return max(ages)
+
+
+def replica_load(engine, role: str, queued: int = 0,
+                 queue_age_s: float = 0.0) -> dict:
     """The gauge-style load fields a replica refreshes with its
     heartbeat (one store write per beat, one read per router poll):
     role-aware routing places prefill by ``queued`` + bucket fit and
-    decode by ``kv_bytes`` + ``free_pages``."""
+    decode by ``kv_bytes`` + ``free_pages``; the fleet anomaly watch
+    (observability/fleet) reads ``tokens`` (progress — a busy replica
+    whose counter freezes is stalled), ``busy_slots``, ``queue_age_s``
+    and ``total_pages``/``free_pages`` (pool exhaustion)."""
     from paddle_tpu import stats
     return {
         "role": role,
         "queued": int(queued),
         "free_slots": int(engine.free_slots),
+        "busy_slots": int(engine.S - engine.free_slots),
         "free_pages": int(getattr(engine, "free_pages", 0)),
+        "total_pages": int(getattr(engine, "P", 0)),
         "kv_bytes": int(getattr(engine, "kv_bytes", 0)),
+        "tokens": int(engine.tokens_emitted),
+        "queue_age_s": round(float(queue_age_s), 3),
         # process-local fleet counters ride the heartbeat so the
         # router/CI can assert cross-replica hits without scraping
         # replica processes
@@ -285,6 +309,8 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
     ``engine`` must be a ``PagedDecodeEngine(prefill_only=True)``;
     attach a :class:`FleetPrefixDirectory` first so every prefix this
     replica prefills becomes a fleet-wide hit."""
+    from paddle_tpu import stats
+    from paddle_tpu.observability import flight, runtime, trace
     from paddle_tpu.serving.router import _publish
     if not getattr(engine, "prefill_only", False):
         raise ValueError("serve_prefill_replica needs a "
@@ -300,8 +326,11 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
     while True:
         now = time.monotonic()
         if now - last_load >= load_refresh_s:
+            runtime.hbm_gauges()
             directory.heartbeat(rid, load=replica_load(
-                engine, "prefill", queued=engine.queued))
+                engine, "prefill", queued=engine.queued,
+                queue_age_s=queue_age_s(engine=engine)),
+                stats=stats.export())
             last_load = now
         else:
             directory.heartbeat(rid)
@@ -314,7 +343,8 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
                     msg["prompt"],
                     max_new_tokens=msg["max_new_tokens"],
                     eos_id=msg["eos_id"],
-                    deadline_s=msg.get("deadline_s"))
+                    deadline_s=msg.get("deadline_s"),
+                    req_id=msg["id"])
             except ValueError as e:
                 # infeasible request: fail AS A RESULT (router.serve_
                 # replica's cascade rationale)
@@ -343,9 +373,10 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
                 del open_reqs[req_id]
             elif req.tokens:
                 # prefill harvested: hand off to a decode replica
+                t0 = time.perf_counter()
                 meta, k, v = engine.detach_handoff(req)
                 header, blob = kv_transfer.encode_kv_pages(
-                    k, v, n_tokens=meta["n_tokens"])
+                    k, v, n_tokens=meta["n_tokens"], rid=req_id)
                 # stamp the wire into the handoff meta: the decode
                 # replica refuses to re-publish lossy-wire pages under
                 # the original content digest (quantization error must
@@ -353,6 +384,10 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
                 header["handoff"] = dict(meta, wire=header["wire"])
                 kv_transfer.publish_blob(store, f"serve/kv/{req_id}",
                                          header, blob)
+                trace.complete("serve/kv_publish", t0, rid=req_id,
+                               bytes=len(blob))
+                flight.record(req_id, "handoff-publish",
+                              bytes=len(blob), wire=header["wire"])
                 _publish(store, rid, req_id, {
                     "id": req_id, "tokens": [],
                     "status": "prefill-done", "error": None,
@@ -373,6 +408,7 @@ def serve_decode_replica(store, rid: str, frontend,
     ``req`` messages serve end-to-end exactly as symmetric replicas
     (the router's fallback when no prefill replica is alive)."""
     from paddle_tpu import stats
+    from paddle_tpu.observability import flight, runtime, trace
     from paddle_tpu.serving.router import _publish
     engine = frontend.engine
     directory = ReplicaDirectory(store)
@@ -387,9 +423,12 @@ def serve_decode_replica(store, rid: str, frontend,
     while True:
         now = time.monotonic()
         if now - last_load >= load_refresh_s:
+            runtime.hbm_gauges()
             directory.heartbeat(rid, load=replica_load(
                 engine, "decode",
-                queued=len(frontend._queue) + engine.queued))
+                queued=len(frontend._queue) + engine.queued,
+                queue_age_s=queue_age_s(frontend=frontend)),
+                stats=stats.export())
             last_load = now
         else:
             directory.heartbeat(rid)
@@ -414,6 +453,11 @@ def serve_decode_replica(store, rid: str, frontend,
                     k, v = kv_transfer.decode_kv_pages(header, blob)
                     stats.observe("serve/kv_transfer_s",
                                   time.perf_counter() - t0)
+                    trace.complete("serve/kv_transfer", t0,
+                                   rid=msg["id"], bytes=len(blob))
+                    flight.record(msg["id"], "handoff-fetch",
+                                  bytes=len(blob),
+                                  wire=header.get("wire"))
                     req = frontend.submit_handoff(
                         header["handoff"], k, v,
                         deadline_s=msg.get("deadline_s"),
@@ -439,6 +483,9 @@ def serve_decode_replica(store, rid: str, frontend,
                 # RETRYABLE status — the router re-places the request
                 # from scratch (re-prefill), never surfaces this as a
                 # client-visible rejection
+                flight.record(msg["id"], "handoff-failed",
+                              error=str(e))
+                flight.dump(msg["id"], "handoff-failed")
                 _publish(store, rid, msg["id"], {
                     "id": msg["id"], "tokens": [],
                     "status": "handoff-failed", "error": str(e),

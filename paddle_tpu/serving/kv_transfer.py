@@ -124,7 +124,8 @@ def _inject_fault(scales_bytes: bytes, payload_bytes: bytes):
 
 def encode_kv_pages(k: np.ndarray, v: np.ndarray, n_tokens: int,
                     wire: Optional[str] = None,
-                    block: Optional[int] = None
+                    block: Optional[int] = None,
+                    rid: Optional[str] = None
                     ) -> Tuple[dict, bytes]:
     """Serialize one request's KV pages for the wire.
 
@@ -134,7 +135,10 @@ def encode_kv_pages(k: np.ndarray, v: np.ndarray, n_tokens: int,
     not inherit (decode overwrites them before ever reading, so this
     cannot change outputs; it keeps the wire deterministic and the
     compression honest). Returns ``(header, blob)``; the header is
-    JSON-serializable and carries the scale-integrity envelope.
+    JSON-serializable and carries the scale-integrity envelope, plus
+    the request's trace context (``rid``) when given — the receiving
+    replica's spans for these pages stitch onto the same fleet-wide
+    request timeline.
     """
     wire = wire_format(wire)
     block = block if block is not None else _block()
@@ -155,6 +159,8 @@ def encode_kv_pages(k: np.ndarray, v: np.ndarray, n_tokens: int,
         "pool_dtype": k.dtype.name, "shape": [L, npg, hkv, page, d],
         "n_tokens": int(n_tokens), "bytes_logical": int(logical),
     }
+    if rid is not None:
+        header["rid"] = str(rid)
     buf = io.BytesIO()
     if wire == "fp32":
         buf.write(k.tobytes())

@@ -95,6 +95,16 @@ class Router:
         self._loads: Dict[str, dict] = {}
         self._loads_at = 0.0
         self._t_submit: Dict[str, float] = {}    # req_id -> submit time
+        # perf_counter twin of _t_submit: the serve/route span (the
+        # request's client-observed window on the stitched timeline)
+        # needs the tracer's clock, monotonic deadline math keeps its own
+        self._t_submit_pc: Dict[str, float] = {}
+        # fleet telemetry plane (observability/fleet.FleetStats):
+        # enable_fleet_stats attaches it; poll() pumps it at its own
+        # refresh cadence
+        self.fleet_stats = None
+        self._fleet_refresh_s = 1.0
+        self._fleet_at = 0.0
         # requests whose RE-placement failed transiently (no capable
         # replica alive at that instant): retried on every poll —
         # a liveness blip must degrade to a delay, never crash poll()
@@ -139,6 +149,10 @@ class Router:
         # against the request's deadline budget, matching same-replica
         # semantics where the clock starts once at submission
         self._t_submit[req_id] = time.monotonic()
+        self._t_submit_pc[req_id] = time.perf_counter()
+        from paddle_tpu.observability import flight
+        flight.record(req_id, "submit", prompt=len(prompt),
+                      budget=int(max_new_tokens), deadline_s=deadline_s)
         self._place(req_id)
         stats.add("serve/router_requests")
         return req_id
@@ -227,10 +241,14 @@ class Router:
 
     def _send(self, rid: str, req_id: str, msg: dict):
         from paddle_tpu import stats
+        from paddle_tpu.observability import flight
         i = self.store.add(f"serve/mbox_n/{rid}", 1)
         self.store.set(f"serve/mbox/{rid}/{i}", json.dumps(msg))
         self._assigned[req_id] = rid
         self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
+        flight.record(req_id, "place", replica=rid,
+                      phase=self._phase.get(req_id, "serve"),
+                      kind=msg.get("kind", "req"))
         stats.set_value("serve/router_outstanding",
                         sum(self._outstanding.values()))
 
@@ -324,6 +342,7 @@ class Router:
         done index (see ``_publish``), and the router fetches only the
         entries beyond its per-replica cursor."""
         from paddle_tpu import native, stats
+        from paddle_tpu.observability import flight, trace
         fresh = {}
         for req_id in list(self._unplaced):
             if req_id not in self.results:
@@ -358,6 +377,10 @@ class Router:
                     if owner is not None:
                         self._outstanding[owner] = max(
                             0, self._outstanding.get(owner, 0) - 1)
+                    flight.record(req_id, "handoff-failed",
+                                  replica=res.get("replica"),
+                                  error=res.get("error"))
+                    flight.dump(req_id, "handoff-failed")
                     self._phase[req_id] = "serve"
                     self._try_place(req_id)
                     stats.add("serve/router_handoff_retries")
@@ -375,12 +398,24 @@ class Router:
                         self._outstanding[owner] = max(
                             0, self._outstanding.get(owner, 0) - 1)
                     self._phase[req_id] = "decode"
+                    flight.record(req_id, "prefill-done",
+                                  replica=res.get("replica"))
                     self._refresh_loads()
                     self._try_place(req_id)
                     stats.add("serve/router_prefill_handoffs")
                     continue
                 self.results[req_id] = res
                 fresh[req_id] = res
+                # close the request's client-observed window on the
+                # stitched timeline (submit → result pickup)
+                t0 = self._t_submit_pc.pop(req_id, None)
+                if t0 is not None:
+                    trace.complete("serve/route", t0, rid=req_id,
+                                   status=res.get("status"),
+                                   replica=res.get("replica"))
+                flight.record(req_id, "result",
+                              status=res.get("status"),
+                              replica=res.get("replica"))
                 owner = self._assigned.get(req_id)
                 if owner is not None:
                     self._outstanding[owner] = max(
@@ -389,13 +424,38 @@ class Router:
         if fresh:
             stats.set_value("serve/router_outstanding",
                             sum(self._outstanding.values()))
+        if self.fleet_stats is not None:
+            now = time.monotonic()
+            if now - self._fleet_at >= self._fleet_refresh_s:
+                self._fleet_at = now
+                self.fleet_stats.poll()
         return fresh
+
+    def enable_fleet_stats(self, refresh_s: float = 1.0,
+                           stall_after_s: float = 5.0,
+                           jsonl_path: Optional[str] = None,
+                           statsz_port: Optional[int] = None):
+        """Attach the fleet telemetry plane (observability/fleet):
+        :meth:`poll` then refreshes per-replica exports, runs the
+        SLO/anomaly watch, and appends JSONL telemetry every
+        ``refresh_s``. ``statsz_port`` additionally serves the merged
+        fleet /statsz (0 = ephemeral; read ``.port`` off the returned
+        FleetStats' server). Returns the FleetStats."""
+        from paddle_tpu.observability.fleet import FleetStats
+        self.fleet_stats = FleetStats(
+            self.directory, dead_after=self.dead_after,
+            stall_after_s=stall_after_s, jsonl_path=jsonl_path)
+        self._fleet_refresh_s = float(refresh_s)
+        if statsz_port is not None:
+            self.fleet_stats.serve_statsz(statsz_port)
+        return self.fleet_stats
 
     def check_replicas(self):
         """Death sweep: redistribute every unfinished request assigned
         to a replica whose heartbeat stalled. Each death is swept once;
         a replica whose heartbeat resumes becomes routable again."""
         from paddle_tpu import stats
+        from paddle_tpu.observability import flight
         for rid in list(self.directory.members()):
             if self.directory.alive(rid, self.dead_after):
                 self._swept.discard(rid)
@@ -407,6 +467,7 @@ class Router:
             orphans = [q for q, r in self._assigned.items()
                        if r == rid and q not in self.results]
             for req_id in orphans:
+                flight.record(req_id, "redistribute", dead=rid)
                 self._try_place(req_id)
             if orphans:
                 stats.add("serve/router_redistributed", len(orphans))
@@ -478,23 +539,42 @@ def _publish(store, rid: str, req_id: str, result: dict):
 
 
 def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
-                  max_idle_s: Optional[float] = None):
+                  max_idle_s: Optional[float] = None,
+                  load_refresh_s: float = 0.25):
     """One replica's serve loop: announce, then consume the mailbox,
     pump the front-end, publish terminal results, heartbeat — until
     the shutdown key appears (or ``max_idle_s`` with nothing to do).
 
     ``frontend`` is a :class:`~paddle_tpu.serving.scheduler.FrontEnd`;
     all admission policy (deadline rejection, backfill, streaming)
-    applies per-replica exactly as single-process serving.
+    applies per-replica exactly as single-process serving. Every
+    ``load_refresh_s`` the heartbeat also carries the load gauges AND
+    a full ``stats.export()`` snapshot — the fleet telemetry plane's
+    feed (observability/fleet.FleetStats) — plus the live/peak HBM
+    gauges on backends that expose them.
     """
+    from paddle_tpu import stats
+    from paddle_tpu.observability import runtime
+    from paddle_tpu.serving.disagg import queue_age_s, replica_load
     directory = ReplicaDirectory(store)
     directory.announce(rid, {"pid": os.getpid(),
                              "slots": frontend.engine.S})
     seen = 0
     open_reqs: Dict[str, object] = {}
     idle_since = time.monotonic()
+    last_load = 0.0
     while True:
-        directory.heartbeat(rid)
+        now = time.monotonic()
+        if now - last_load >= load_refresh_s:
+            runtime.hbm_gauges()
+            directory.heartbeat(rid, load=replica_load(
+                frontend.engine, "both",
+                queued=len(frontend._queue) + frontend.engine.queued,
+                queue_age_s=queue_age_s(frontend=frontend)),
+                stats=stats.export())
+            last_load = now
+        else:
+            directory.heartbeat(rid)
         if _shutdown_requested(store) and not open_reqs \
                 and not frontend.busy:
             return

@@ -213,6 +213,7 @@ class FrontEnd:
                deadline_s: Optional[float] = None, priority: int = 0,
                req_id: Optional[str] = None) -> ServeRequest:
         from paddle_tpu import stats
+        from paddle_tpu.observability import flight
         prompt = [int(t) for t in prompt]
         # infeasible requests fail HERE, not from a later pump
         self.engine.check_request(len(prompt), int(max_new_tokens))
@@ -229,8 +230,13 @@ class FrontEnd:
             req.error = (f"admission queue full "
                          f"({self.queue_depth} waiting)")
             stats.add("serve/queue_rejects")
+            flight.record(req.id, "reject", reason="queue-full",
+                          depth=self.queue_depth)
             return req
         self._queue.append(req)
+        flight.record(req.id, "submit", prompt=len(prompt),
+                      budget=int(max_new_tokens), priority=int(priority),
+                      deadline_s=deadline_s)
         stats.set_value("serve/queue_len", len(self._queue))
         return req
 
@@ -259,6 +265,11 @@ class FrontEnd:
             self._seq, self)
         sreq.status = "admitted"
         sreq.engine_req = ereq
+        if ereq.rid is None:
+            ereq.rid = sreq.id     # local handoff (bench): no meta rid
+        from paddle_tpu.observability import flight
+        flight.record(sreq.id, "handoff-admitted",
+                      n_tokens=int(meta["n_tokens"]))
         if t_submit is not None:
             # same-process disaggregation (bench): TTFT counts from the
             # ORIGINAL arrival, not the handoff install — perf_counter
@@ -315,9 +326,11 @@ class FrontEnd:
 
     def _reject(self, req: ServeRequest, reason: str, stat: str):
         from paddle_tpu import stats
+        from paddle_tpu.observability import flight
         req.status = "rejected-deadline"
         req.error = reason
         stats.add(stat)
+        flight.record(req.id, "reject", reason=reason, stat=stat)
 
     def _ttft_estimate(self, req: ServeRequest) -> float:
         """The TTFT bar the hopeless screen judges ``req`` against.
@@ -384,7 +397,8 @@ class FrontEnd:
                 req.prompt, max_new_tokens=req.max_new_tokens,
                 eos_id=req.eos_id,
                 deadline_s=(None if req.deadline is None
-                            else req.deadline - time.monotonic()))
+                            else req.deadline - time.monotonic()),
+                req_id=req.id)
             # TTFT must count the front-end queue wait: re-anchor the
             # engine request's clock to the front-end submission
             ereq.t_submit = req.t_submit

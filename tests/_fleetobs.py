@@ -1,0 +1,37 @@
+"""Shared fleet-observability test plumbing (tests + tools/smokes):
+the per-replica trace-env translation the worker scripts run before
+importing paddle_tpu, and the flushed-trace-stitches assertion the
+SIGKILL acceptance tests share."""
+
+import json
+import os
+
+
+def adopt_replica_trace_env():
+    """Translate FLEETOBS_TRACE_FILE -> PT_TRACE_FILE. The test/smoke
+    sets the non-PT name in the launch env so ONLY the worker traces —
+    the launcher inherits the same env, and with PT_TRACE_FILE set its
+    own atexit export would clobber the worker's file. Must run BEFORE
+    ``import paddle_tpu`` (trace._init_from_env reads the env at
+    import)."""
+    tf = os.environ.get("FLEETOBS_TRACE_FILE")
+    if tf:
+        os.environ["PT_TRACE_FILE"] = tf
+
+
+def assert_flushed_trace_stitches(path, req_ids):
+    """The SIGKILLed replica's periodically-flushed trace file must
+    exist, be a complete (atomically rewritten) JSON document with
+    spans, and stitch by request id against the run's ids."""
+    from paddle_tpu.observability import merge
+    assert os.path.exists(path), \
+        f"SIGKILLed replica left no flushed trace file at {path}"
+    with open(path) as f:
+        doc = json.load(f)
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert spans, "flushed trace carries no spans"
+    summary = merge.request_segments(spans)
+    assert set(summary) & set(req_ids), \
+        "no request id from this run stitches out of the dead " \
+        "replica's flushed spans"
+    return summary

@@ -17,6 +17,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ["XLA_FLAGS"] = " ".join(
     f for f in os.environ.get("XLA_FLAGS", "").split()
     if "xla_force_host_platform_device_count" not in f)
+# per-replica trace file for the fleet-observability tests: translated
+# HERE (before the paddle_tpu import) so only the WORKER traces
+import _fleetobs
+_fleetobs.adopt_replica_trace_env()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))

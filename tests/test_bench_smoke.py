@@ -79,6 +79,10 @@ def test_bench_serve_disagg_smoke():
         assert out.get(
             f"serve_disagg_{label}_completed_frac", 0) == 1.0, out
         assert out.get(f"serve_disagg_{label}_p99_ttft_ms", 0) > 0, out
+    # role-tagged TTFT (ISSUE 13): the prefill engine's first-token
+    # samples land in their own serve/prefill_s histogram — present in
+    # the disagg row — and never pollute the end-to-end TTFT p99
+    assert out.get("serve_disagg_prefill_p99_ms", 0) > 0, out
     assert out.get("serve_disagg_kv_bytes_wire", 0) > 0, out
     assert out.get("serve_disagg_kv_ratio") is not None
     assert out["serve_disagg_kv_ratio"] >= 3.5, out

@@ -1,0 +1,574 @@
+"""Fleet observability plane (ISSUE 13): cross-process trace stitch,
+FleetStats merge vs single-registry ground truth, the SLO/anomaly
+watch (incl. a real SIGSTOP'd replica), the per-request flight
+recorder, and the trace-flush-on-hard-kill fix."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native, stats
+from paddle_tpu.observability import flight, merge, trace
+from paddle_tpu.observability.fleet import FleetStats
+from paddle_tpu.stats import StatRegistry, _Histogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_native = pytest.mark.skipif(not native.is_available(),
+                                  reason="native TCPStore unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.disable()
+    trace.clear()
+    flight.reset()
+    yield
+    trace.disable()
+    trace.clear()
+    flight.reset()
+    stats.reset()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_fifo_bound_and_event_cap():
+    rec = flight.FlightRecorder(capacity=2, max_events=3)
+    rec.record("a", "submit", x=1)
+    rec.record("b", "submit")
+    for i in range(5):
+        rec.record("b", f"e{i}")
+    rec.record("c", "submit")          # evicts the OLDEST request (a)
+    assert rec.events("a") == []
+    assert rec.dropped == 1
+    # per-request cap keeps only the newest max_events
+    assert [e["event"] for e in rec.events("b")] == ["e2", "e3", "e4"]
+    assert rec.events("c")[0]["event"] == "submit"
+    # capacity 0 disables recording entirely
+    off = flight.FlightRecorder(capacity=0)
+    off.record("x", "submit")
+    assert off.events("x") == [] and not off.enabled
+
+
+def test_flight_dump_writes_json_and_counts(tmp_path, monkeypatch):
+    monkeypatch.setenv("PT_FLIGHT_DIR", str(tmp_path))
+    flight.record("rq-9", "submit", prompt=4)
+    flight.record("rq-9", "evicted", reason="deadline")
+    rec = flight.dump("rq-9", "deadline exceeded")
+    # pid-suffixed: router and replicas share the dump dir and both
+    # may dump the SAME rid — their views must not clobber each other
+    assert rec["path"] == str(
+        tmp_path / f"flight_rq-9.{os.getpid()}.json")
+    on_disk = json.load(open(rec["path"]))
+    assert on_disk["reason"] == "deadline exceeded"
+    assert [e["event"] for e in on_disk["events"]] == ["submit",
+                                                       "evicted"]
+    assert stats.get("serve/flight_dumps") == 1
+    # nothing tracked -> no dump, no counter
+    assert flight.dump("unknown", "x") is None
+    assert stats.get("serve/flight_dumps") == 1
+
+
+def test_flight_dump_on_deadline_eviction_contains_handoff_hop(
+        tmp_path, monkeypatch):
+    """A handed-off request deadline-evicted on the decode side dumps a
+    flight record whose timeline still shows the handoff hop — the
+    postmortem needs no re-run under tracing."""
+    import jax.numpy as jnp
+    from paddle_tpu.models import gpt
+    from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+    from paddle_tpu.serving import FrontEnd
+    monkeypatch.setenv("PT_FLIGHT_DIR", str(tmp_path))
+    cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=512, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32)
+    model = gpt.GPT(cfg, seed=0)
+    rs = np.random.RandomState(3)
+    prompt = [int(x) for x in rs.randint(0, 96, size=150)]
+    pe = PagedDecodeEngine(model, n_pages=48, max_slots=2,
+                           prefill_only=True)
+    r = pe.submit(prompt, max_new_tokens=8, req_id="rq-hop")
+    while not r.tokens:
+        pe.step()
+    meta, k, v = pe.detach_handoff(r)
+    assert meta["rid"] == "rq-hop"     # trace context rides the meta
+    de = FrontEnd(PagedDecodeEngine(model, n_pages=48, max_slots=2))
+    sreq = de.submit_handoff(meta, k, v, deadline_s=1e-4,
+                             req_id="rq-hop")
+    time.sleep(0.02)                   # expire while queued
+    de.step()
+    assert sreq.done and sreq.failed, (sreq.status, sreq.error)
+    path = tmp_path / f"flight_rq-hop.{os.getpid()}.json"
+    assert path.exists(), "deadline eviction did not dump the record"
+    events = [e["event"] for e in json.load(open(path))["events"]]
+    assert "handoff-detach" in events, events
+    assert "handoff-admitted" in events, events
+    assert "evicted" in events, events
+
+
+# ---------------------------------------------------------------------------
+# FleetStats: merge + watch
+# ---------------------------------------------------------------------------
+
+def test_fleetstats_hist_merge_matches_union_ground_truth():
+    """Acceptance: the FleetStats-merged p99 TTFT equals the p99 of
+    the union of per-replica raw samples within one histogram bucket
+    (growth 2^1/4) — bucket-wise merge is exact, so it is EQUAL."""
+    rs = np.random.RandomState(0)
+    regs = [StatRegistry() for _ in range(3)]
+    truth = StatRegistry()
+    for i, reg in enumerate(regs):
+        for v in rs.lognormal(mean=-3.0 + i * 0.5, sigma=0.7,
+                              size=400):
+            reg.observe("serve/ttft_s", float(v))
+            truth.observe("serve/ttft_s", float(v))
+        reg.add("serve/queue_backfill", 10 * (i + 1))
+    fleet = FleetStats()
+    for i, reg in enumerate(regs):
+        fleet.ingest(f"r{i}", export=reg.export(rank=0))
+    merged = fleet.merged()
+    mh, th = (merged.histogram("serve/ttft_s"),
+              truth.histogram("serve/ttft_s"))
+    assert mh.count == th.count == 1200
+    for q in (50, 90, 99):
+        assert mh.percentile(q) == th.percentile(q)
+        # and the (weaker) acceptance bound: within one 2^1/4 bucket
+        assert (max(mh.percentile(q), 1e-12)
+                / max(th.percentile(q), 1e-12)) <= _Histogram.GROWTH
+    # counters sum; per-replica gauges namespace by rid
+    assert merged.get("serve/queue_backfill") == 60
+    # latest-export-wins: re-ingesting a newer snapshot REPLACES, so
+    # cumulative exports never double-count
+    regs[0].add("serve/queue_backfill", 5)
+    fleet.ingest("r0", export=regs[0].export(rank=0))
+    assert fleet.merged().get("serve/queue_backfill") == 65
+
+
+def test_fleetstats_stall_alert_edge_triggered_names_replica():
+    fleet = FleetStats(stall_after_s=5.0)
+    busy = {"queued": 1, "busy_slots": 1, "tokens": 100}
+    fleet.ingest("r0", load=dict(busy), alive=True, now=0.0)
+    assert fleet.watch(now=1.0) == []
+    # tokens frozen past the window while busy and alive -> one alert
+    fleet.ingest("r0", load=dict(busy), alive=True, now=6.0)
+    assert fleet.watch(now=6.0) == ["stalled_replica"]
+    assert "r0" in fleet.alerts[-1]["msg"]
+    assert stats.get("fleet/alert_stalled_replica") == 1
+    # edge-triggered: same incident never re-fires
+    assert fleet.watch(now=8.0) == []
+    assert stats.get("fleet/alert_stalled_replica") == 1
+    # progress clears the incident...
+    fleet.ingest("r0", load=dict(busy, tokens=150), now=9.0)
+    assert fleet.watch(now=9.0) == []
+    # ...and a NEW stall re-arms and fires again
+    fleet.ingest("r0", load=dict(busy, tokens=150), now=20.0)
+    assert fleet.watch(now=20.0) == ["stalled_replica"]
+    assert stats.get("fleet/alert_stalled_replica") == 2
+    # an IDLE replica with frozen tokens is not stalled
+    fleet2 = FleetStats(stall_after_s=1.0)
+    idle = {"queued": 0, "busy_slots": 0, "tokens": 7}
+    fleet2.ingest("r1", load=dict(idle), now=0.0)
+    fleet2.ingest("r1", load=dict(idle), now=10.0)
+    assert fleet2.watch(now=10.0) == []
+    # the stall-presence horizon always covers the stall window — a
+    # tight membership dead_after (Router's 2s default) must never
+    # make the stalled detector unfireable (a SIGSTOP'd replica stops
+    # heartbeating too)
+    f3 = FleetStats(dead_after=2.0, stall_after_s=5.0)
+    assert f3._stall_horizon > f3.stall_after_s
+    # a replica gone beyond even the stall horizon is DEAD (the death
+    # sweep's business), not stalled
+    f3.ingest("r9", load=dict(busy), alive=False, present=False,
+              now=0.0)
+    f3.ingest("r9", load=dict(busy), alive=False, present=False,
+              now=10.0)
+    assert f3.watch(now=10.0) == []
+    # idle→busy edge re-anchors the progress clock: a long-idle
+    # replica receiving its first request must NOT alert on the
+    # minutes-old frozen token counter — only stall_after of busy
+    # zero-progress counts
+    f4 = FleetStats(stall_after_s=5.0)
+    idle = {"queued": 0, "busy_slots": 0, "tokens": 42}
+    f4.ingest("r0", load=dict(idle), now=0.0)
+    f4.ingest("r0", load=dict(idle, queued=1, busy_slots=1), now=60.0)
+    assert f4.watch(now=60.0) == []           # just went busy
+    assert f4.watch(now=64.0) == []           # 4s busy < 5s window
+    f4.ingest("r0", load=dict(idle, queued=1, busy_slots=1), now=66.0)
+    assert f4.watch(now=66.0) == ["stalled_replica"]
+    # a dead replica's frozen queue_age/pool load never alerts, and a
+    # previously-active incident clears instead of sticking forever
+    f5 = FleetStats(slo={"queue_age_s": 1.0})
+    hot = {"queued": 3, "busy_slots": 1, "tokens": 1,
+           "queue_age_s": 9.0}
+    f5.ingest("rX", load=dict(hot), now=0.0)
+    assert "queue_age" in f5.watch(now=0.0)
+    f5.ingest("rX", load=dict(hot), alive=False, present=False,
+              now=5.0)
+    assert f5.watch(now=5.0) == []
+    assert not f5._active                     # incident cleared
+
+
+def test_fleetstats_queue_age_and_pool_alerts():
+    fleet = FleetStats(slo={"queue_age_s": 2.0})
+    fleet.ingest("r0", load={"queued": 3, "busy_slots": 1, "tokens": 1,
+                             "queue_age_s": 5.0}, now=0.0)
+    assert "queue_age" in fleet.watch(now=0.0)
+    assert stats.get("fleet/alert_queue_age") == 1
+    # pool exhaustion needs an actual paged pool (total_pages > 0)
+    fleet.ingest("r1", load={"queued": 2, "busy_slots": 1, "tokens": 1,
+                             "total_pages": 16, "free_pages": 0},
+                 now=0.1)
+    assert "pool_exhausted" in fleet.watch(now=0.2)
+    # a pageless (contiguous) engine reporting free_pages 0 never fires
+    fleet.ingest("r2", load={"queued": 2, "busy_slots": 1, "tokens": 1,
+                             "total_pages": 0, "free_pages": 0},
+                 now=0.3)
+    before = stats.get("fleet/alert_pool_exhausted")
+    fleet.watch(now=0.4)
+    assert stats.get("fleet/alert_pool_exhausted") == before
+
+
+def test_fleetstats_slo_ttft_burn_and_goodput():
+    fleet = FleetStats(slo={"ttft_p99_ms": 10.0, "goodput": 100.0})
+    reg = StatRegistry()
+    for _ in range(50):
+        reg.observe("serve/ttft_s", 0.05)      # 50ms >> 10ms target
+    busy = {"queued": 1, "busy_slots": 1}
+    fleet.ingest("r0", export=reg.export(rank=0),
+                 load=dict(busy, tokens=0), now=0.0)
+    fired = fleet.watch(now=0.0)
+    assert "slo_ttft" in fired
+    assert stats.get("fleet/slo_ttft_burn") > 1.0
+    assert stats.get("fleet/alert_slo_ttft") == 1
+    assert fleet.watch(now=0.5) == []          # edge
+    # goodput: 10 tokens over 2s = 5 tok/s < the 100 floor while busy
+    fleet.ingest("r0", load=dict(busy, tokens=10), now=2.0)
+    fired = fleet.watch(now=2.0)
+    assert "slo_goodput" in fired
+    assert 0 < stats.get("fleet/goodput_tokens_per_s") < 100.0
+    # WINDOWED burn: a recovered window (fast fresh samples) drops the
+    # burn below 1 and re-arms the edge — the lifetime-cumulative p99
+    # could never come back down after an incident
+    for _ in range(30):
+        reg.observe("serve/ttft_s", 0.001)
+    fleet.ingest("r0", export=reg.export(rank=0), now=3.0)
+    assert fleet.watch(now=3.0) == []
+    assert stats.get("fleet/slo_ttft_burn") < 1.0
+    # ...and a NEW degraded window fires a second alert
+    for _ in range(25):
+        reg.observe("serve/ttft_s", 0.08)
+    fleet.ingest("r0", export=reg.export(rank=0), now=4.0)
+    assert "slo_ttft" in fleet.watch(now=4.0)
+    assert stats.get("fleet/alert_slo_ttft") == 2
+    # a restarted replica's reset token counter clamps to zero
+    # contribution — never a negative fleet rate / spurious alert
+    fleet.ingest("r0", load=dict(busy, tokens=2), now=6.0)
+    fleet.watch(now=6.0)
+    assert stats.get("fleet/goodput_tokens_per_s") >= 0.0
+    # a restart also shrinks the merged TTFT census (the replica's
+    # cumulative export is replaced by a near-empty one): the window
+    # RE-ANCHORS instead of disarming on a negative delta, so the
+    # next degraded window still alerts
+    fresh = StatRegistry()
+    for _ in range(2):
+        fresh.observe("serve/ttft_s", 0.09)
+    fleet.ingest("r0", export=fresh.export(rank=0), now=7.0)
+    fleet.watch(now=7.0)               # census shrank: re-anchor
+    assert fleet._ttft_window[0] == 2
+    for _ in range(25):
+        fresh.observe("serve/ttft_s", 0.09)
+    fleet.ingest("r0", export=fresh.export(rank=0), now=8.0)
+    fleet.watch(now=8.0)               # post-restart window judged
+    assert fleet._ttft_window[0] == 27
+    assert stats.get("fleet/slo_ttft_burn") > 1.0
+
+
+def test_fleet_statsz_serves_merged_registry():
+    import urllib.request
+    reg = StatRegistry()
+    reg.add("serve/queue_backfill", 3)
+    reg.observe("serve/ttft_s", 0.01)
+    fleet = FleetStats()
+    fleet.ingest("r0", export=reg.export(rank=0))
+    srv = fleet.serve_statsz(0, host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/statsz", timeout=5) as r:
+            doc = json.load(r)
+        assert doc["counters"]["serve/queue_backfill"] == 3
+        assert doc["histograms"]["serve/ttft_s"]["count"] == 1
+        # the per-process default registry is NOT what this serves
+        assert "fleet_probe_counter" not in doc["counters"]
+        stats.add("fleet_probe_counter")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/statsz?flat=1",
+                timeout=5) as r:
+            flat = json.load(r)
+        assert "fleet_probe_counter" not in flat
+    finally:
+        fleet._statsz = None
+        srv.stop()
+
+
+def test_fleetstats_jsonl_telemetry(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    fleet = FleetStats(jsonl_path=path)
+    reg = StatRegistry()
+    reg.observe("serve/ttft_s", 0.02)
+    fleet.ingest("r0", export=reg.export(rank=0),
+                 load={"queued": 0, "busy_slots": 0, "tokens": 5})
+    fleet.append_jsonl()
+    fleet.append_jsonl()
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    assert lines[0]["loads"]["r0"]["tokens"] == 5
+    assert "serve/ttft_s.p99" in lines[0]["stats"]
+
+
+# ---------------------------------------------------------------------------
+# stitch: request segments from rid-tagged spans
+# ---------------------------------------------------------------------------
+
+def _mk_span(name, pid, ts, dur, rid="rq-1", **extra):
+    return {"name": name, "ph": "X", "ts": ts, "dur": dur, "pid": pid,
+            "tid": 1, "args": dict({"rid": rid}, **extra)}
+
+
+def test_request_segments_tile_the_route_span():
+    """Boundary-derived segments TILE the client window: queue-wait +
+    prefill + kv-transfer + decode + stream == serve/route exactly."""
+    evs = [
+        _mk_span("serve/route", 0, 1000.0, 900.0, status="done"),
+        _mk_span("serve/queue", 1, 1010.0, 180.0),
+        _mk_span("serve/admit", 1, 1200.0, 250.0),
+        _mk_span("serve/kv_publish", 1, 1430.0, 15.0),
+        _mk_span("serve/kv_transfer", 2, 1560.0, 30.0),
+        _mk_span("serve/decode", 2, 1600.0, 250.0),
+        # an unrelated request must not leak in
+        _mk_span("serve/admit", 1, 5000.0, 10.0, rid="rq-2"),
+    ]
+    summary = merge.request_segments(evs)
+    assert set(summary) == {"rq-1", "rq-2"}
+    segs = summary["rq-1"]["segments"]
+    assert set(segs) == set(merge.REQUEST_SEGMENTS)
+    assert segs["queue-wait"] == (1000.0, 200.0)
+    assert segs["prefill"] == (1200.0, 250.0)
+    assert segs["kv-transfer"] == (1450.0, 150.0)
+    assert segs["decode"] == (1600.0, 250.0)
+    assert segs["stream"] == (1850.0, 50.0)
+    total = sum(d for _, d in segs.values())
+    assert total == summary["rq-1"]["client_us"] == 900.0
+    assert summary["rq-1"]["pids"] == [0, 1, 2]
+    # no kv span -> no kv-transfer segment (same-replica request)
+    local = [e for e in evs[:3]] + [
+        _mk_span("serve/decode", 1, 1460.0, 300.0)]
+    segs2 = merge.request_segments(local)["rq-1"]["segments"]
+    assert "kv-transfer" not in segs2
+
+
+def test_stitch_trace_files_lanes_and_request_process(tmp_path):
+    def write(name, events):
+        p = tmp_path / name
+        with open(p, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        return str(p)
+
+    paths = [
+        write("trace_router.json",
+              [_mk_span("serve/route", 0, 100.0, 500.0)]),
+        write("trace_pf0.json",
+              [_mk_span("serve/admit", 0, 150.0, 100.0)]),
+        write("trace_dc0.json",
+              [_mk_span("serve/kv_transfer", 0, 270.0, 10.0),
+               _mk_span("serve/decode", 0, 300.0, 200.0)]),
+    ]
+    out, summary = merge.stitch_trace_files(
+        paths, str(tmp_path / "stitched.json"))
+    assert set(summary["rq-1"]["segments"]) == set(
+        merge.REQUEST_SEGMENTS)
+    doc = json.load(open(out))
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert lanes == {"router", "pf0", "dc0", "requests"}
+    req_events = [e for e in doc["traceEvents"]
+                  if e.get("ph") == "X" and e["pid"] == 9999]
+    assert {e["name"] for e in req_events} == set(
+        merge.REQUEST_SEGMENTS)
+    # colliding pids across files (all rank 0) got distinct lanes
+    pids = {e["pid"] for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e["pid"] != 9999}
+    assert len(pids) == 3
+
+
+def test_trace_flush_survives_sigkill(tmp_path):
+    """Satellite: the ring exports only via atexit, so a SIGKILL'd
+    process (exactly the interesting one) used to leave NO trace file —
+    the periodic flush keeps a partial, loadable export on disk."""
+    path = tmp_path / "trace_victim.json"
+    script = (
+        "import os, signal, time\n"
+        "from paddle_tpu.observability import trace\n"
+        "trace.complete('serve/decode', time.perf_counter() - 0.01,"
+        " rid='rq-k')\n"
+        "time.sleep(1.2)\n"
+        "os.kill(os.getpid(), signal.SIGKILL)  # atexit never runs\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PT_TRACE_FILE=str(path), PT_TRACE_FLUSH_S="0.2")
+    rc = subprocess.run([sys.executable, "-c", script], env=env,
+                        timeout=60).returncode
+    assert rc == -signal.SIGKILL
+    doc = json.load(open(path))       # atomic rewrite -> valid JSON
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert any(e["name"] == "serve/decode"
+               and e["args"].get("rid") == "rq-k" for e in spans)
+    # and the flushed file stitches
+    summary = merge.request_segments(spans)
+    assert "rq-k" in summary
+
+
+# ---------------------------------------------------------------------------
+# real launch-spawned replicas: cross-process stitch + SIGSTOP anomaly
+# ---------------------------------------------------------------------------
+
+def _spawn_disagg(store_port, rid, role, launch_port, trace_file=None):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PT_KV_WIRE="fp32")
+    if trace_file:
+        env["FLEETOBS_TRACE_FILE"] = trace_file
+        env["PT_TRACE_FLUSH_S"] = "0.25"
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1",
+         "--master", f"127.0.0.1:{launch_port}",
+         os.path.join(REPO, "tests", "_disagg_worker.py"),
+         str(store_port), rid, role],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _cleanup(router, procs):
+    router.shutdown()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+    router.close()
+
+
+@needs_native
+def test_cross_process_stitch_real_replicas(tmp_path):
+    """Acceptance: one request served through real launch-spawned
+    router+prefill+decode processes leaves spans in THREE trace files
+    that share its trace id and stitch into one ordered timeline (the
+    per-process wall-clock rebase makes the boundaries comparable)."""
+    from paddle_tpu.serving import Router
+    trace.enable(str(tmp_path / "trace_router.json"))
+    router = Router(port=0, dead_after=20.0)
+    procs = [
+        _spawn_disagg(router.store.port, "pf0", "prefill", 8905,
+                      str(tmp_path / "trace_pf0.json")),
+        _spawn_disagg(router.store.port, "dc0", "decode", 8906,
+                      str(tmp_path / "trace_dc0.json")),
+    ]
+    try:
+        router.wait_replicas(2, timeout=90)
+        rs = np.random.RandomState(5)
+        ids = [router.submit(list(rs.randint(0, 96, size=n)),
+                             max_new_tokens=8) for n in (150, 60)]
+        results = router.drain(timeout=180)
+        assert all(results[q]["status"] == "done" for q in ids)
+    finally:
+        _cleanup(router, procs)
+    trace.export()
+    trace.disable()
+    paths = [str(tmp_path / f"trace_{n}.json")
+             for n in ("router", "pf0", "dc0")]
+    for p in paths:
+        assert os.path.exists(p), p
+    out, summary = merge.stitch_trace_files(
+        paths, str(tmp_path / "stitched.json"))
+    stitched = {q: summary[q] for q in ids if q in summary}
+    assert stitched, summary.keys()
+    full = {q: i for q, i in stitched.items()
+            if {"queue-wait", "prefill", "kv-transfer",
+                "decode"} <= set(i["segments"])}
+    assert full, {q: sorted(i["segments"]) for q, i in stitched.items()}
+    for q, info in full.items():
+        # spans for ONE request came from all three processes
+        assert len(info["pids"]) >= 3, info
+        segs = info["segments"]
+        # ordered after clock rebase: queue-wait <= prefill <=
+        # kv-transfer <= decode <= stream starts
+        starts = [segs[s][0] for s in ("queue-wait", "prefill",
+                                       "kv-transfer", "decode",
+                                       "stream")]
+        assert starts == sorted(starts), segs
+
+
+@needs_native
+def test_anomaly_watch_flags_sigstop_replica():
+    """Acceptance: SIGSTOP a busy replica — the stalled-replica
+    detector fires within its window, exactly once, NAMING the
+    replica (its heartbeat is still inside the generous membership
+    dead_after, so the death sweep has not noticed)."""
+    from paddle_tpu.serving import Router
+    router = Router(port=0, dead_after=25.0)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--master", "127.0.0.1:8907",
+         os.path.join(REPO, "tests", "_serve_worker.py"),
+         str(router.store.port), "rep0"],
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        router.wait_replicas(1, timeout=90)
+        # enough queued decode work that the replica is mid-flight
+        # (and stays busy) whenever the SIGSTOP lands
+        rqs = [router.submit([1, 2, 3, 4, 5], max_new_tokens=80)
+               for _ in range(6)]
+        # wait for BUSY + TOKEN PROGRESS before arming the watch: the
+        # replica's first-request jit compile is itself a multi-second
+        # zero-progress stretch, and an alert fired for it would
+        # consume the edge the injected stall is supposed to trip
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            router.poll()
+            load = router.directory.load("rep0") or {}
+            if load.get("busy_slots", 0) > 0 and load.get("tokens",
+                                                          0) > 0:
+                break
+            time.sleep(0.05)
+        assert (router.directory.load("rep0") or {}).get("tokens",
+                                                         0) > 0
+        fleet = router.enable_fleet_stats(refresh_s=0.2,
+                                          stall_after_s=1.5)
+        fleet.poll()                  # seed progress state pre-stall
+        victim_pid = router.directory.members()["rep0"]["pid"]
+        os.kill(victim_pid, signal.SIGSTOP)
+        try:
+            fired = []
+            deadline = time.monotonic() + 12
+            while time.monotonic() < deadline and not fired:
+                fired = [a for a in fleet.poll()
+                         if a == "stalled_replica"]
+                time.sleep(0.2)
+        finally:
+            os.kill(victim_pid, signal.SIGCONT)
+        assert fired, "detector never flagged the SIGSTOP'd replica"
+        assert stats.get("fleet/alert_stalled_replica") == 1
+        msg = [a["msg"] for a in fleet.alerts
+               if a["kind"] == "stalled_replica"][0]
+        assert "rep0" in msg, msg
+        results = router.drain(timeout=120)
+        assert all(results[q]["status"] == "done" for q in rqs)
+    finally:
+        _cleanup(router, [proc])

@@ -702,21 +702,30 @@ def test_disagg_router_round_trip_bit_identical():
 
 
 @pytestmark_proc
-def test_disagg_prefill_death_reroutes_clean():
+def test_disagg_prefill_death_reroutes_clean(tmp_path):
     """Acceptance: SIGKILL the only prefill replica with requests
     outstanding — every request id still completes (the router
     degrades them to whole-request serving on the decode replica,
-    which stays clean), nothing lost."""
+    which stays clean), nothing lost. The victim runs TRACED with a
+    fast periodic flush (ISSUE 13): the dead prefill replica's last
+    flushed spans must survive the SIGKILL and still stitch by
+    request id."""
     from paddle_tpu.serving import Router
     stats.reset("serve/router")
+    victim_trace = str(tmp_path / "trace_pf0.json")
     router = Router(port=0, dead_after=2.5)
-    procs = [_spawn(router.store.port, "pf0", "prefill", 8897),
+    procs = [_spawn(router.store.port, "pf0", "prefill", 8897,
+                    extra_env={"FLEETOBS_TRACE_FILE": victim_trace,
+                               "PT_TRACE_FLUSH_S": "0.2"}),
              _spawn(router.store.port, "dc0", "decode", 8898)]
     try:
         router.wait_replicas(2, timeout=90)
         rs = np.random.RandomState(12)
         ids = [router.submit(list(rs.randint(0, 96, size=150)),
                              max_new_tokens=16) for _ in range(8)]
+        # let the victim admit (and flush) some prefills first — the
+        # SIGKILL-mid-prefill trace is what the flush exists to save
+        time.sleep(1.0)
         victim_pid = router.directory.members()["pf0"]["pid"]
         os.kill(victim_pid, signal.SIGKILL)
         results = router.drain(timeout=180)
@@ -727,3 +736,5 @@ def test_disagg_prefill_death_reroutes_clean():
         assert stats.get("serve/router_redistributed") > 0
     finally:
         _cleanup(router, procs)
+    from _fleetobs import assert_flushed_trace_stitches
+    assert_flushed_trace_stitches(victim_trace, ids)

@@ -23,11 +23,13 @@ pytestmark = pytest.mark.skipif(not native.is_available(),
                                 reason="native TCPStore unavailable")
 
 
-def _spawn_replica(store_port: int, rid: str, launch_port: int):
+def _spawn_replica(store_port: int, rid: str, launch_port: int,
+                   extra_env=None):
     """One replica process via the launch CLI (one launch per replica,
     nproc_per_node=1, so a fault-injected kill of one replica cannot
     take its peers' launcher down with it)."""
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.update(extra_env or {})
     return subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "1",
@@ -80,13 +82,20 @@ def test_router_round_trip_two_replicas():
         _cleanup(router, procs)
 
 
-def test_replica_death_redistributes_queued_work():
+def test_replica_death_redistributes_queued_work(tmp_path):
     """Acceptance: SIGKILL one replica with requests outstanding —
     every submitted request id still completes (redistributed to the
-    survivor), counted on serve/router_redistributed."""
+    survivor), counted on serve/router_redistributed. The victim runs
+    TRACED with a fast periodic flush (ISSUE 13): its last flushed
+    spans must survive the SIGKILL and still stitch by request id."""
     stats.reset("serve/router")
+    victim_trace = str(tmp_path / "trace_rep0.json")
     router = Router(port=0, dead_after=2.5)
-    procs = [_spawn_replica(router.store.port, f"rep{i}", 8885 + i)
+    procs = [_spawn_replica(
+                 router.store.port, f"rep{i}", 8885 + i,
+                 extra_env=({"FLEETOBS_TRACE_FILE": victim_trace,
+                             "PT_TRACE_FLUSH_S": "0.2"}
+                            if i == 0 else None))
              for i in range(2)]
     try:
         router.wait_replicas(2, timeout=90)
@@ -98,6 +107,9 @@ def test_replica_death_redistributes_queued_work():
         victim_reqs = [q for q, r in router._assigned.items()
                        if r == victim]
         assert victim_reqs, "least-outstanding never placed on rep0?"
+        # give the victim time to admit (and flush) before the kill —
+        # a SIGKILL mid-serve is exactly the case the flush exists for
+        time.sleep(1.0)
         pid = router.directory.members()[victim]["pid"]
         os.kill(pid, signal.SIGKILL)
         results = router.drain(timeout=120)
@@ -114,6 +126,10 @@ def test_replica_death_redistributes_queued_work():
         assert len(redone) <= stats.get("serve/router_redistributed")
     finally:
         _cleanup(router, procs)
+    # the SIGKILLed replica left a complete (atomically flushed) trace
+    # whose request-tagged spans still stitch
+    from _fleetobs import assert_flushed_trace_stitches
+    assert_flushed_trace_stitches(victim_trace, ids)
 
 
 def test_least_outstanding_placement_deterministic():

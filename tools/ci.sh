@@ -45,6 +45,13 @@
 #                          reduce-scatter (one per bucket, interleaved
 #                          into backward) instead of one fused tail
 #                          collective
+#   tools/ci.sh fleetobs   fleet-observability smoke: one prefill + one
+#                          decode replica (real processes) under load —
+#                          the stitched per-request timeline carries all
+#                          four segments summing to the client latency
+#                          within 10%, the fleet /statsz serves the
+#                          merged p99, and one injected SIGSTOP stall
+#                          raises exactly one alert (~1 min)
 #   tools/ci.sh disagg     disaggregated-serving smoke: one prefill + one
 #                          decode replica (real processes via
 #                          distributed/launch.py) behind the role-aware
@@ -111,6 +118,11 @@ fi
 if [[ "${1:-}" == "disagg" ]]; then
     shift
     exec python tools/disagg_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "fleetobs" ]]; then
+    shift
+    exec python tools/fleet_obs_smoke.py "$@"
 fi
 
 if [[ "${1:-}" == "shard" ]]; then

@@ -8,6 +8,16 @@ one directory, merge, and open the result at https://ui.perfetto.dev
 
     python tools/trace_merge.py LOGDIR                 # -> LOGDIR/trace_merged.json
     python tools/trace_merge.py -o out.json a.json b.json
+
+``--stitch`` joins per-REPLICA serving traces instead (ISSUE 13): one
+lane per input file, plus a synthetic ``requests`` process whose
+thread lanes show each request's cross-process phase segments
+(queue-wait → prefill → kv-transfer → decode → stream), derived from
+the ``args.rid`` trace context every serving span carries. Prints a
+per-request segment summary next to the output path.
+
+    python tools/trace_merge.py --stitch LOGDIR        # -> LOGDIR/trace_stitched.json
+    python tools/trace_merge.py --stitch -o out.json router.json pf0.json dc0.json
 """
 
 import argparse
@@ -28,12 +38,41 @@ def main(argv=None):
                         "containing them")
     p.add_argument("-o", "--out", default=None,
                    help="output path (default: trace_merged.json next "
-                        "to the inputs)")
+                        "to the inputs; trace_stitched.json with "
+                        "--stitch)")
+    p.add_argument("--stitch", action="store_true",
+                   help="stitch per-replica serving traces into "
+                        "per-request lanes (rid trace context) instead "
+                        "of a plain per-rank merge")
     args = p.parse_args(argv)
 
     from paddle_tpu.observability import merge
 
-    if len(args.inputs) == 1 and os.path.isdir(args.inputs[0]):
+    one_dir = len(args.inputs) == 1 and os.path.isdir(args.inputs[0])
+    if args.stitch:
+        inputs = (merge.discover_trace_files(args.inputs[0])
+                  if one_dir else args.inputs)
+        if not inputs:
+            print(f"no trace_*.json under {args.inputs[0]}",
+                  file=sys.stderr)
+            return 1
+        out, summary = merge.stitch_trace_files(
+            inputs,
+            args.out or os.path.join(
+                os.path.dirname(os.path.abspath(inputs[0])),
+                merge.STITCHED_NAME))
+        if not summary:
+            print("no rid-tagged spans to stitch (serving traces "
+                  "carry args.rid)", file=sys.stderr)
+            return 1
+        for rid, info in summary.items():
+            segs = " ".join(
+                f"{name}={dur / 1e3:.1f}ms"
+                for name, (_, dur) in info["segments"].items())
+            print(f"# {rid}: {segs}", file=sys.stderr)
+        print(out)
+        return 0
+    if one_dir:
         out = merge.merge_rank_traces(args.inputs[0], args.out)
         if out is None:
             print(f"no trace_rank*.json under {args.inputs[0]}",
