@@ -269,13 +269,14 @@ def main():
     # bench_serve runs after the decode/longctx headline rows: its four
     # warmup-compiled engines are not cheap, and a tight budget must
     # truncate the NEW row, not the established ladder
-    # bench_serve_disagg is the NEWEST row and runs LAST (PR 7/9/11
-    # budget-truncation rule): a tight budget truncates it, never the
-    # established ladder above it
+    # bench_serve_disagg then bench_fleet_churn are the newest rows and
+    # run LAST (PR 7/9/11/12 budget-truncation rule): a tight budget
+    # truncates them, never the established ladder above them
     for sub in (bench_bert, bench_resnet50, bench_ppyoloe, bench_pp,
                 bench_decode, bench_longctx, bench_serve,
                 bench_train_sharded_stacked, bench_train_quant_comm,
-                bench_train_overlap, bench_serve_disagg):
+                bench_train_overlap, bench_serve_disagg,
+                bench_fleet_churn):
         name = sub.__name__.replace("bench_", "")
         if only and name not in only:
             continue
@@ -1443,6 +1444,140 @@ def bench_serve_disagg(jax, jnp, peak, smoke=False):
                 _stats.get("serve/fleet_prefix_hit_tokens"))
         finally:
             store.close()
+    return res
+
+
+def bench_fleet_churn(jax, jnp, peak, smoke=False):
+    """Fleet-churn ladder row (ISSUE 14): the SAME Poisson workload
+    through a two-replica fleet in steady state vs under a scripted
+    KILL + SCALE event — one replica dies a third of the way in (its
+    unfinished requests redistribute to the survivor from scratch,
+    at-least-once), and a controller-style replacement joins at two
+    thirds (paying its cold engine build, the spawn cost a real
+    scale-up pays). Reports goodput, p99 TTFT, and completion for both
+    phases plus the churn/steady goodput ratio. Replicas are
+    in-process FrontEnds (scheduling + redistribution effects, no IPC
+    noise — the real-process controller path is tools/ci.sh elastic);
+    runs LAST in the ladder per the PR 7/9/11/12 newest-row truncation
+    rule."""
+    if jax.default_backend() in ("cpu",) and not smoke:
+        return {}
+    from paddle_tpu import stats as _stats
+    from paddle_tpu.inference.decode_engine import DecodeEngine
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import FrontEnd, loadgen
+
+    if smoke:
+        cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=160, d_model=32,
+                            n_layers=2, n_heads=4, dtype=jnp.float32)
+        slots, n_req, max_len = 2, 16, 96
+        prompt_len, new_tokens = (6, 40), (4, 10)
+    else:
+        cfg = gpt.gpt3_125m(max_seq_len=512)
+        slots, n_req, max_len = 8, 60, 320
+        prompt_len, new_tokens = (16, 200), (8, 48)
+    model = gpt.GPT(cfg, seed=0)
+    seed = loadgen.default_seed()
+    trace = None  # built after the capacity probe
+
+    def mk():
+        return FrontEnd(DecodeEngine(model, max_slots=slots,
+                                     max_len=max_len))
+
+    # capacity probe on ONE replica (closed loop): the offered rate is
+    # hardware-relative, the churn window saturates the lone survivor
+    _stats.reset("serve/")
+    fe = mk()
+    t0 = time.perf_counter()
+    for a in loadgen.poisson_trace(n_req, qps=1e9, seed=seed,
+                                   vocab=cfg.vocab_size,
+                                   prompt_len=prompt_len,
+                                   new_tokens=new_tokens):
+        fe.submit(a.prompt, max_new_tokens=a.max_new_tokens)
+    fe.run()
+    cap_rps = n_req / (time.perf_counter() - t0)
+    qps = max(0.1, 1.0 * cap_rps)   # two replicas run at ~50% load
+    trace = loadgen.poisson_trace(n_req, qps=qps, seed=seed,
+                                  vocab=cfg.vocab_size,
+                                  prompt_len=prompt_len,
+                                  new_tokens=new_tokens)
+    kill_at = trace[n_req // 3].t
+    replace_at = trace[(2 * n_req) // 3].t
+    res = {"fleet_churn_requests": n_req,
+           "fleet_churn_offered_qps": round(qps, 2),
+           "fleet_churn_capacity_rps": round(cap_rps, 2)}
+
+    def run(churn: bool):
+        fes = [mk(), mk()]
+        recs = []                     # [ServeRequest, replica idx, Arrival]
+        state = {"killed": False, "replaced": False, "redist": 0,
+                 "i": 0, "t0": time.perf_counter()}
+
+        def submit(a):
+            state["i"] += 1
+            cand = [k for k, f in enumerate(fes) if f is not None]
+            k = cand[state["i"] % len(cand)]
+            r = fes[k].submit(a.prompt,
+                              max_new_tokens=a.max_new_tokens)
+            recs.append([r, k, a])
+            return r
+
+        def pump():
+            t = time.perf_counter() - state["t0"]
+            if (churn and not state["killed"] and t > kill_at
+                    and any(k == 1 and not r.done
+                            for r, k, _a in recs)):
+                # the scripted kill — deferred past kill_at until the
+                # victim actually HOLDS unfinished work (a fast box
+                # could drain replica 1 between arrivals, and a kill
+                # that loses nothing measures nothing; round-robin
+                # keeps feeding it, so this fires within an arrival or
+                # two). Its in-progress work is LOST; the router-side
+                # at-least-once contract re-enters it on the survivor
+                # from scratch.
+                state["killed"] = True
+                fes[1] = None
+                for rec in recs:
+                    r, k, _a = rec
+                    if k == 1 and not r.done:
+                        rec[0] = fes[0].submit(
+                            _a.prompt,
+                            max_new_tokens=_a.max_new_tokens)
+                        rec[1] = 0
+                        state["redist"] += 1
+            if (churn and state["killed"] and not state["replaced"]
+                    and t > replace_at):
+                # the controller's replacement joins COLD (fresh
+                # engine build = the real scale-up actuation cost)
+                state["replaced"] = True
+                fes[1] = mk()
+            for f in fes:
+                if f is not None:
+                    f.step()
+
+        loadgen.replay(trace, submit=submit, pump=pump)
+        while any(not r.done for r, _k, _a in recs):
+            pump()
+        wall = time.perf_counter() - state["t0"]
+        done = [r for r, _k, _a in recs if r.status == "done"]
+        toks = sum(len(r.tokens) for r in done)
+        return (toks / wall, len(done), state["redist"])
+
+    for label, churn in (("steady", False), ("churn", True)):
+        _stats.reset("serve/")
+        goodput, n_done, redist = run(churn)
+        snap = _stats.snapshot("serve/")
+        pfx = f"fleet_churn_{label}"
+        res[f"{pfx}_goodput_tokens_per_sec"] = round(goodput, 1)
+        res[f"{pfx}_p99_ttft_ms"] = round(
+            snap.get("serve/ttft_s.p99", 0) * 1e3, 2)
+        res[f"{pfx}_completed_frac"] = round(n_done / n_req, 4)
+        if churn:
+            res["fleet_churn_redistributed"] = int(redist)
+    steady = res.get("fleet_churn_steady_goodput_tokens_per_sec")
+    churned = res.get("fleet_churn_churn_goodput_tokens_per_sec")
+    if steady:
+        res["fleet_churn_goodput_ratio"] = round(churned / steady, 3)
     return res
 
 

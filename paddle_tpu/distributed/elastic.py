@@ -72,8 +72,11 @@ class ElasticManager:
                 self.store.get(self._hb_key(r), timeout=self.ttl)
             except TimeoutError:
                 pass
-        # last observed (counter, local time of last progress) per rank
-        seen = {}
+        # progress-judged liveness core shared with ReplicaDirectory
+        # (distributed/liveness.py): counters progress, local clock
+        # judges
+        from paddle_tpu.distributed.liveness import ProgressJudge
+        judge = ProgressJudge()
         while not self._stop.is_set():
             now = time.monotonic()
             dead = []
@@ -81,15 +84,13 @@ class ElasticManager:
                 if r == self.rank:
                     continue
                 c = self._counter(r)
-                prev = seen.get(r)
-                if prev is None or (c is not None and c != prev[0]):
-                    seen[r] = (c, now)
+                if judge.update(r, c, now=now):
                     # heartbeat resumed → eligible for re-reporting if it
                     # dies again after a recovery (ADVICE r1)
                     if c is not None:
                         self._reported.discard(r)
                     continue
-                if now - prev[1] > self.ttl:
+                if judge.stalled_for(r, now=now) > self.ttl:
                     dead.append(r)
             fresh = [r for r in dead if r not in self._reported]
             if fresh and self.on_change is not None:

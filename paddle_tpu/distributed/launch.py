@@ -168,6 +168,11 @@ def _kill_group(procs):
         if p.poll() is None:
             try:
                 os.killpg(p.pid, signal.SIGTERM)
+                # mark launcher-inflicted SIGTERMs the same way as the
+                # SIGKILL escalation below: the reshape survivor count
+                # must distinguish a healthy group-kill casualty from a
+                # worker an EXTERNAL supervisor signaled (preemption)
+                p._pt_launcher_terminated = True
             except ProcessLookupError:
                 pass
     deadline = time.time() + 5
@@ -177,27 +182,53 @@ def _kill_group(procs):
         except subprocess.TimeoutExpired:
             try:
                 os.killpg(p.pid, signal.SIGKILL)
+                # mark the escalation: a LAUNCHER-inflicted SIGKILL (a
+                # healthy worker blocked past the SIGTERM grace, e.g.
+                # mid-collective) must not read as an external
+                # preemption to the reshape survivor count
+                p._pt_launcher_killed = True
             except ProcessLookupError:
+                pass
+            # reap the escalated child: without this its returncode
+            # stays None and the marker above is never consulted by
+            # the reshape classification (and the child stays a
+            # zombie until the Popen is collected)
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
                 pass
     for p in procs:
         if p._pt_logf:
             p._pt_logf.close()
 
 
-def _watch(procs, poll_s=0.2, should_abort=None):
+def _watch(procs, poll_s=0.2, should_abort=None, coalesce_s=0.0):
     """Block until all exit 0 (return 0) or any fails (kill rest, return
     its code). ≙ ControllerBase.watch (launch/controllers/controller.py:34).
     ``should_abort()`` (elastic): polled each tick; truthy → kill the
-    group and return REFORM_RC (another node asked for a re-form)."""
+    group and return REFORM_RC (another node asked for a re-form).
+    ``coalesce_s`` (reshape accounting): a preemption reclaims several
+    workers near-simultaneously, so after the first failure wait this
+    long for the co-failures to land before killing the group —
+    otherwise the group SIGTERM races a sibling's own exit and the
+    survivor count reads one casualty as healthy."""
     while True:
         alive = False
+        rc_fail = None
         for p in procs:
             rc = p.poll()
             if rc is None:
                 alive = True
-            elif rc != 0:
-                _kill_group(procs)
-                return rc
+            elif rc != 0 and rc_fail is None:
+                rc_fail = rc
+        if rc_fail is not None:
+            if coalesce_s > 0 and alive:
+                deadline = time.monotonic() + coalesce_s
+                while (time.monotonic() < deadline
+                       and any(p.poll() is None for p in procs)):
+                    time.sleep(0.05)
+            _kill_group(procs)
+            return rc_fail
         if not alive:
             return 0
         if should_abort is not None and should_abort():
@@ -234,6 +265,11 @@ def _launch_elastic(args):
     version = 0
     attempt = 0
     reform_seen = 0
+    # pure reshape requests (every local worker exited ELASTIC_EXIT_CODE)
+    # don't burn the restart budget, so a deterministically recurring
+    # re-form (e.g. a peer that wedges the same way every generation)
+    # needs its own bound or the launcher loops forever
+    pure_reforms = 0
     join_attempts = 0
     try:
         while True:
@@ -342,16 +378,28 @@ def _launch_elastic(args):
                 # local failure: shrink membership and ask the cluster to
                 # re-form. Only LOCAL failures consume the restart budget;
                 # a healthy node aborted by a peer's re-form request must
-                # not burn its own budget (it did nothing wrong).
-                attempt += 1
+                # not burn its own budget (it did nothing wrong). A worker
+                # exiting ELASTIC_EXIT_CODE is a SURVIVOR asking for a
+                # re-form (ElasticManager saw a remote peer die) — it is
+                # not a local failure and must not shrink the local count.
                 n_failed = sum(1 for p in procs
-                               if (p.returncode or 0) > 0)
-                n_local = n - max(1, n_failed)
-                reform_seen = store.add("elastic/reform", 1)
-                if n_local <= 0 and args.nnodes == 1:
-                    return rc
-                if attempt > args.max_restarts:
-                    return rc
+                               if (p.returncode or 0) > 0
+                               and p.returncode != ELASTIC_EXIT_CODE)
+                n_reshape = sum(1 for p in procs
+                                if p.returncode == ELASTIC_EXIT_CODE)
+                if n_failed == 0 and n_reshape > 0:
+                    pure_reforms += 1
+                    if pure_reforms > max(8, 4 * args.max_restarts):
+                        return rc
+                    reform_seen = store.add("elastic/reform", 1)
+                else:
+                    attempt += 1
+                    n_local = n - max(1, n_failed)
+                    reform_seen = store.add("elastic/reform", 1)
+                    if n_local <= 0 and args.nnodes == 1:
+                        return rc
+                    if attempt > args.max_restarts:
+                        return rc
             print(f"[launch] re-forming after rc={rc}; attempt "
                   f"{attempt}/{args.max_restarts}", file=sys.stderr)
     finally:
@@ -414,21 +462,85 @@ def launch(argv):
 
 def _launch_static(args):
     attempt = 0
+    nproc = args.nproc_per_node
+    # PT_ELASTIC_RESHAPE=1: the --max_restarts relaunch becomes a local
+    # RESHAPE — the group relaunches at the SURVIVING worker count (the
+    # failed workers removed) and every worker sees the NEW world size /
+    # membership through the standard PT_NUM_PROCESSES / PT_PROCESS_ID
+    # contract (until this knob, PT_RESTART_ATTEMPT was the relaunch
+    # path's only contract and the world size silently stayed stale).
+    # Training scripts built on fleet/elastic_train re-plan their mesh
+    # from the new size and restore_resharded onto it. Multi-node
+    # membership changes are the --elastic controller's job; this knob
+    # covers the single-node preemption (a worker OOM-killed or
+    # preempted) without a registry round.
+    reshape = (os.environ.get("PT_ELASTIC_RESHAPE", "0") != "0"
+               and args.nnodes == 1)
+    pure_reforms = 0
+    # relaunch generation, exported as PT_RESTART_ATTEMPT: EVERY
+    # relaunch — budget-burning failure or pure reshape re-form — must
+    # read as a resume to the workers ("attempt 1+ restores"), so this
+    # is decoupled from `attempt`, which only counts failures against
+    # --max_restarts
+    gen = 0
     while True:
         # PT_RESTART_ATTEMPT is the auto-resume contract: workers (re)started
         # by the same launcher see which attempt they are, so training
         # scripts unconditionally AutoCheckpoint.restore() and attempt 1+
         # resumes from the last VERIFIED checkpoint with no operator action
+        world = args.nnodes * nproc
         procs = [_spawn(args, i,
-                        extra_env={"PT_RESTART_ATTEMPT": str(attempt)})
-                 for i in range(args.nproc_per_node)]
-        rc = _watch(procs)
+                        rank=args.node_rank * nproc + i, world=world,
+                        extra_env={"PT_RESTART_ATTEMPT": str(gen)})
+                 for i in range(nproc)]
+        rc = _watch(procs, coalesce_s=1.0 if reshape else 0.0)
         if rc == 0:
             return 0
+        gen += 1
+        from paddle_tpu import stats
+        if reshape:
+            # shrink to the survivors: workers that exited on their own
+            # (rc > 0) or were signaled from OUTSIDE (preemption via
+            # SIGKILL or SIGTERM, crash via SIGSEGV/SIGABRT, ...) are
+            # the failures; workers the launcher itself SIGTERMed —
+            # or had to escalate to SIGKILL — during the group kill
+            # (both marked in _kill_group) were healthy casualties
+            # whatever code they exited with (a SIGTERM handler may
+            # clean up and sys.exit(1)). ELASTIC_EXIT_CODE exits are
+            # reshape REQUESTS, not failures (the requester rejoins
+            # the relaunch).
+            n_failed = sum(
+                1 for p in procs
+                if p.returncode != ELASTIC_EXIT_CODE
+                and (p.returncode or 0) != 0
+                and not getattr(p, "_pt_launcher_killed", False)
+                and not getattr(p, "_pt_launcher_terminated", False))
+            n_reshape = sum(1 for p in procs
+                            if p.returncode == ELASTIC_EXIT_CODE)
+            if n_failed == 0 and n_reshape > 0:
+                # pure reshape request: relaunch at the SAME size (the
+                # requesting survivors rejoin) and burn NO restart
+                # budget — nothing actually failed, matching the
+                # elastic path's accounting. Bounded separately so a
+                # deterministically recurring request can't loop the
+                # launcher forever.
+                pure_reforms += 1
+                if pure_reforms > max(8, 4 * args.max_restarts):
+                    return rc
+                print(f"[launch] re-forming same-size group after "
+                      f"reshape request (rc={rc})", file=sys.stderr)
+                stats.add("launch/restarts")
+                continue
+            new = max(1, nproc - max(1, n_failed))
+            if new != nproc:
+                stats.add("launch/reshapes")
+                stats.set_value("launch/world_size", args.nnodes * new)
+                print(f"[launch] reshaping local group {nproc}->{new} "
+                      f"workers after rc={rc}", file=sys.stderr)
+                nproc = new
         attempt += 1
         if attempt > args.max_restarts:
             return rc
-        from paddle_tpu import stats
         stats.add("launch/restarts")
         print(f"[launch] worker failed rc={rc}; restart "
               f"{attempt}/{args.max_restarts}", file=sys.stderr)

@@ -9,15 +9,25 @@ router learns who exists), *describable* (slots, pid, endpoint), and
 The store has no key-listing op, so announcements go through a counter
 index: ``announce`` bumps ``<ns>/n`` and writes ``<ns>/idx/<i>`` →
 replica id, plus ``<ns>/meta/<rid>`` with the JSON metadata. Liveness
-follows the elastic idiom (ADVICE r1): heartbeats are monotonically
-increasing counters (``store.add``), and a peer is dead when its
-counter stops *progressing* against the OBSERVER's local clock — wall
-clocks never cross the wire, so clock skew cannot fabricate a death.
+is the shared progress-judged core (``distributed/liveness.py``, the
+same one ``elastic.ElasticManager`` watches training peers with):
+heartbeats are monotonically increasing counters (``store.add``), and
+a peer is dead when its counter stops *progressing* against the
+OBSERVER's local clock — wall clocks never cross the wire, so clock
+skew cannot fabricate a death.
+
+Replicas additionally carry a LIFECYCLE STATE (``<ns>/state/<rid>``)
+for the fleet controller's drain protocol (docs/elastic.md): ``up``
+(default — routable), ``draining`` (the router stops placing new
+work; the replica finishes or hands back its in-flight requests),
+``drained`` (the replica finished its drain and is about to exit).
 """
 
 import json
 import time
 from typing import Dict, Optional
+
+from paddle_tpu.distributed.liveness import ProgressJudge
 
 __all__ = ["ReplicaDirectory"]
 
@@ -33,9 +43,9 @@ class ReplicaDirectory:
     def __init__(self, store, namespace: str = "serve"):
         self.store = store
         self.ns = namespace
-        # observer-local liveness state: rid -> (last counter, local
-        # monotonic time that counter last advanced)
-        self._seen: Dict[str, tuple] = {}
+        # observer-local liveness state: the shared progress-judged
+        # core (one bookkeeping implementation for elastic + serving)
+        self._judge = ProgressJudge()
 
     # -- replica side -------------------------------------------------------
 
@@ -51,6 +61,9 @@ class ReplicaDirectory:
         ``slots``."""
         self.store.set(f"{self.ns}/meta/{rid}",
                        json.dumps(meta or {}))
+        # seed the lifecycle state so state() hits on the first read —
+        # a missing key costs the full store get-with-wait timeout
+        self.store.set(f"{self.ns}/state/{rid}", "up")
         i = self.store.add(f"{self.ns}/n", 1)
         self.store.set(f"{self.ns}/idx/{i}", rid)
         self.heartbeat(rid)
@@ -134,10 +147,30 @@ class ReplicaDirectory:
         ``dead_after`` seconds without observed progress does."""
         now = time.monotonic()
         c = self._counter(rid)
-        prev = self._seen.get(rid)
-        if c is None and prev is None:
+        if c is None and not self._judge.has(rid):
             return False            # never seen a heartbeat at all
-        if prev is None or (c is not None and c != prev[0]):
-            self._seen[rid] = (c, now)
+        if self._judge.update(rid, c, now=now):
             return True
-        return now - prev[1] <= dead_after
+        return self._judge.stalled_for(rid, now=now) <= dead_after
+
+    # -- lifecycle state (drain protocol) -----------------------------------
+
+    def set_state(self, rid: str, state: str):
+        """Publish ``rid``'s lifecycle state: ``up`` (routable,
+        implicit default), ``draining`` (controller asked it to retire
+        — the router stops placing on it), ``drained`` (the replica
+        finished every in-flight request and is exiting)."""
+        if state not in ("up", "draining", "drained"):
+            raise ValueError(f"replica state must be up|draining|"
+                             f"drained, got {state!r}")
+        self.store.set(f"{self.ns}/state/{rid}", state)
+
+    def state(self, rid: str) -> str:
+        """``rid``'s last published lifecycle state (announce seeds
+        ``up``, so a registered replica's read always hits; ``up``
+        is also the fallback for a never-registered rid)."""
+        try:
+            return self.store.get(f"{self.ns}/state/{rid}",
+                                  timeout=0.02).decode()
+        except (TimeoutError, ValueError):
+            return "up"

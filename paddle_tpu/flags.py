@@ -218,6 +218,33 @@ declare_env("PT_INIT_DEADLINE", "Seconds init_parallel_env may spend in "
 declare_env("PT_RESTART_ATTEMPT", "Which auto-restart attempt this worker "
             "is (launch --max_restarts exports it; 0 = first run).",
             default="0", owner="distributed/launch.py")
+declare_env("PT_ELASTIC_RESHAPE", "1 turns the --max_restarts relaunch "
+            "into a local RESHAPE: the group relaunches at the "
+            "surviving worker count and workers see the NEW world size "
+            "/ membership via PT_NUM_PROCESSES / PT_PROCESS_ID "
+            "(fleet/elastic_train re-plans its mesh and "
+            "restore_resharded-resumes onto it). 0 keeps same-size "
+            "restarts.", default="0", owner="distributed/launch.py")
+
+# -- elastic fleet controller --
+declare_env("PT_FLEET_MIN_REPLICAS", "Per-tier serving replica floor: "
+            "the fleet controller heals back up to this many alive "
+            "replicas (bypassing policy and cooldown) when deaths or "
+            "drains drop a tier below it.", default="1",
+            owner="fleet/controller.py")
+declare_env("PT_FLEET_MAX_REPLICAS", "Per-tier serving replica "
+            "ceiling: scale-up clamps here no matter how hard the SLO "
+            "burns.", default="8", owner="fleet/controller.py")
+declare_env("PT_FLEET_COOLDOWN_S", "Seconds after any policy-driven "
+            "scale action before the controller takes the next one "
+            "(actuation latency must not read as an unanswered "
+            "signal). Healing below the floor ignores it.",
+            default="5", owner="fleet/controller.py")
+declare_env("PT_FLEET_DRAIN_GRACE_S", "How long a draining replica "
+            "may take to finish its in-flight requests before the "
+            "controller SIGKILLs it and the router's death sweep "
+            "redistributes the remainder.", default="10",
+            owner="fleet/controller.py")
 
 # -- observability --
 declare_env("PT_TRACE_DIR", "Enable tracing; rank traces land here as "

@@ -1,0 +1,34 @@
+"""Elastic fleet controller (ISSUE 14, ROADMAP item 3): SLO-driven
+autoscaling of serving replicas and preemption-tolerant training that
+reshapes its mesh.
+
+Two control loops close the fleet story on top of the detection planes
+that already exist:
+
+- **Serving autoscaler** (``controller.FleetController`` +
+  ``policy.TargetOccupancyPolicy``): consumes the router-side
+  ``FleetStats`` merged signals (queue depth/age, occupancy, windowed
+  TTFT burn, goodput floor, pool pages), spawns replicas through the
+  ``distributed/launch.py`` machinery (prefill/decode tiers scale
+  independently), heals SIGKILLed replicas, and retires surplus ones
+  via the graceful drain protocol (``ReplicaDirectory`` lifecycle
+  states — no request loss).
+- **Preemption-tolerant training** (``elastic_train.ElasticTrainer``):
+  membership change means *reshape and continue*, not crash — the
+  launcher re-forms at the surviving world size and
+  ``AutoCheckpoint.restore_resharded`` restores the newest VERIFIED
+  epoch onto the new topology's re-planned mesh.
+
+See docs/elastic.md for the drain state machine, the reshape
+sequence, and the chaos-run howto.
+"""
+
+from paddle_tpu.fleet.policy import (FleetSignals, ScalePolicy,
+                                     TargetOccupancyPolicy)
+from paddle_tpu.fleet.controller import (FleetController, TierSpec,
+                                         launch_spawn)
+from paddle_tpu.fleet.elastic_train import ElasticTrainer, plan_topology
+
+__all__ = ["FleetSignals", "ScalePolicy", "TargetOccupancyPolicy",
+           "FleetController", "TierSpec", "launch_spawn",
+           "ElasticTrainer", "plan_topology"]

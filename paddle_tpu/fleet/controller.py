@@ -1,0 +1,459 @@
+"""SLO-driven serving autoscaler: the actuation half of the fleet
+story (ISSUE 14 tentpole; docs/elastic.md).
+
+PR 13's ``FleetStats`` *detects* — SLO burn, stalled replicas, pool
+exhaustion — but nothing *acted* on the signals. The
+:class:`FleetController` closes the loop over a :class:`Router`:
+
+- **sense**: ``FleetStats.signals(role)`` condenses the heartbeat load
+  gauges + SLO watch per serving tier (prefill / decode / both — a
+  disaggregated fleet's tiers scale independently);
+- **decide**: a pluggable :class:`~paddle_tpu.fleet.policy.ScalePolicy`
+  per tier (default target-occupancy band with hysteresis), wrapped in
+  controller-level min/max clamps and a cooldown between actions;
+- **actuate**: scale-up spawns replica processes through the
+  ``distributed/launch.py`` machinery (``launch_spawn`` builds the
+  canonical one-launcher-per-replica command); scale-down retires via
+  the graceful **drain protocol** — ``Router.mark_draining`` flips the
+  directory state so no new placement lands, the replica finishes its
+  in-flight requests, publishes ``drained``, and exits; a replica that
+  overstays ``drain_grace_s`` is SIGKILLed and the router's death
+  sweep redistributes whatever it still held (at-least-once, first
+  result wins — zero request-id loss either way).
+
+Healing is scale-up's degenerate case: a SIGKILLed/dead replica drops
+out of the alive count, the tier falls below its floor, and the
+controller spawns a replacement on the next step — no policy or
+cooldown consultation, a hole in the fleet is never "in band".
+
+Every action is written to the flight recorder under the synthetic
+request id ``"fleet"`` (``scale-up`` / ``drain-start`` /
+``drain-complete`` / ``kill`` events with the policy's reason), so a
+postmortem dump answers WHY the fleet changed shape, and counted under
+``fleet/controller_*`` (docs/observability.md).
+"""
+
+import os
+import signal as _signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from paddle_tpu.fleet.policy import ScalePolicy, TargetOccupancyPolicy
+
+__all__ = ["FleetController", "TierSpec", "launch_spawn",
+           "fleet_min_replicas", "fleet_max_replicas",
+           "fleet_cooldown_s", "fleet_drain_grace_s"]
+
+
+def fleet_min_replicas() -> int:
+    """``PT_FLEET_MIN_REPLICAS`` (default 1): the per-tier floor the
+    controller heals back up to, bypassing policy and cooldown."""
+    return int(os.environ.get("PT_FLEET_MIN_REPLICAS", "1"))
+
+
+def fleet_max_replicas() -> int:
+    """``PT_FLEET_MAX_REPLICAS`` (default 8): the per-tier ceiling —
+    scale-up clamps here no matter how hard the SLO burns."""
+    return int(os.environ.get("PT_FLEET_MAX_REPLICAS", "8"))
+
+
+def fleet_cooldown_s() -> float:
+    """``PT_FLEET_COOLDOWN_S`` (default 5): seconds after any
+    policy-driven action before the next one — actuation latency
+    (spawn→announce, drain→exit) must not be mistaken for an
+    unanswered signal. Healing below the floor ignores it."""
+    return float(os.environ.get("PT_FLEET_COOLDOWN_S", "5"))
+
+
+def fleet_drain_grace_s() -> float:
+    """``PT_FLEET_DRAIN_GRACE_S`` (default 10): how long a draining
+    replica may take to finish its in-flight work before the
+    controller SIGKILLs it (the death sweep then redistributes)."""
+    return float(os.environ.get("PT_FLEET_DRAIN_GRACE_S", "10"))
+
+
+@dataclass
+class TierSpec:
+    """One serving tier's autoscaling envelope. ``role`` matches the
+    replicas' heartbeat ``role`` load field (``both`` for symmetric
+    ``serve_replica`` fleets, ``prefill``/``decode`` for the
+    disaggregated loops)."""
+    role: str = "both"
+    min_replicas: int = field(default_factory=fleet_min_replicas)
+    max_replicas: int = field(default_factory=fleet_max_replicas)
+    policy: Optional[ScalePolicy] = None
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"tier {self.role!r}: need 1 <= min <= max, got "
+                f"{self.min_replicas}..{self.max_replicas}")
+        if self.policy is None:
+            self.policy = TargetOccupancyPolicy()
+
+
+_spawn_seq = [0]
+
+
+def launch_spawn(script: str, store_port: int, extra_args=(),
+                 extra_env: Optional[dict] = None,
+                 pass_role: bool = True) -> Callable:
+    """Build the controller's ``spawn(role, rid)`` callable over the
+    ``distributed/launch.py`` CLI — one launcher per replica
+    (``--nproc_per_node 1``), so killing one replica can never take a
+    peer's launcher down with it. ``script`` is a replica worker whose
+    argv contract is ``STORE_PORT REPLICA_ID [ROLE] ...`` (the
+    tests/_serve_worker.py / _disagg_worker.py shape)."""
+    def spawn(role: str, rid: str):
+        _spawn_seq[0] += 1
+        # the master port is inert for nproc=1 serving workers (they
+        # never init jax.distributed) but must be unique per launcher
+        port = 8700 + (os.getpid() + _spawn_seq[0] * 7) % 1000
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        argv = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                "--nproc_per_node", "1",
+                "--master", f"127.0.0.1:{port}",
+                script, str(store_port), rid]
+        if pass_role:
+            argv.append(role)
+        argv.extend(extra_args)
+        return subprocess.Popen(argv, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+    return spawn
+
+
+class FleetController:
+    """Close the sense→decide→actuate loop over one :class:`Router`.
+
+        router = Router(...)
+        fleet = router.enable_fleet_stats(...)
+        ctl = FleetController(router, spawn=launch_spawn(worker, port),
+                              tiers=[TierSpec("both", 2, 4)])
+        while serving:
+            router.poll(); router.check_replicas(); ctl.step()
+
+    ``spawn(role, rid)`` must start a replica process that announces
+    exactly ``rid`` on the router's directory; the returned handle is
+    kept in :attr:`procs` (the caller owns reaping at shutdown —
+    the controller only ``poll()``s Popen-like handles to avoid
+    zombies). A spawned replica counts toward its tier until it
+    announces or ``spawn_timeout_s`` passes, so one heal never
+    double-spawns.
+    """
+
+    def __init__(self, router, spawn: Callable,
+                 tiers: Optional[List[TierSpec]] = None,
+                 fleet_stats=None,
+                 cooldown_s: Optional[float] = None,
+                 drain_grace_s: Optional[float] = None,
+                 spawn_timeout_s: float = 60.0,
+                 fleet_poll_s: float = 1.0):
+        self.router = router
+        self.spawn = spawn
+        self.tiers = list(tiers) if tiers else [TierSpec()]
+        roles = [t.role for t in self.tiers]
+        if len(set(roles)) != len(roles):
+            raise ValueError(f"duplicate tier roles: {roles}")
+        if fleet_stats is None:
+            from paddle_tpu.observability.fleet import FleetStats
+            fleet_stats = (router.fleet_stats
+                           or FleetStats(router.directory,
+                                         dead_after=router.dead_after))
+        self.fleet = fleet_stats
+        self.cooldown_s = (fleet_cooldown_s() if cooldown_s is None
+                           else float(cooldown_s))
+        self.drain_grace_s = (fleet_drain_grace_s()
+                              if drain_grace_s is None
+                              else float(drain_grace_s))
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.fleet_poll_s = float(fleet_poll_s)
+        self._fleet_at: Optional[float] = None
+        self.procs: List[object] = []            # every spawned handle
+        self._pending: Dict[str, tuple] = {}     # rid -> (role, t, h)
+        self._draining: Dict[str, float] = {}    # rid -> drain start
+        self._killed: set = set()
+        self._cooldown_until: Dict[str, float] = {}  # per tier role
+        self._spawn_fails: Dict[str, int] = {}       # consecutive, per role
+        self._heal_backoff: Dict[str, float] = {}    # per tier role
+        self._seq = 0
+        # the controller's timeline must outlive request churn in the
+        # flight ring — it is THE postmortem of why the fleet changed
+        from paddle_tpu.observability import flight
+        flight.pin("fleet")
+
+    # -- sensing ------------------------------------------------------------
+
+    def _members(self) -> Dict[str, dict]:
+        return self.router.directory.members()
+
+    def _tier_view(self, tier: TierSpec, members: Dict[str, dict],
+                   now: float):
+        """(routable alive rids, pending spawn count, draining rids)
+        for one tier."""
+        d = self.router.directory
+        alive = []
+        draining = set()
+        for rid, meta in members.items():
+            if meta.get("role", "both") != tier.role:
+                continue
+            if not d.alive(rid, self.router.dead_after):
+                continue
+            # lifecycle through the Router's TTL cache (mark_draining
+            # updates it synchronously) — not a raw store read per
+            # replica per controller step
+            if (rid in self._draining
+                    or self.router._replica_state(rid) != "up"):
+                draining.add(rid)
+                continue
+            alive.append(rid)
+            if self._pending.pop(rid, None):  # announced: spawn landed
+                self._spawn_fails[tier.role] = 0
+        pending = [rid for rid, (role, t, _h) in self._pending.items()
+                   if role == tier.role]
+        for rid in pending:
+            _role, t0, h = self._pending[rid]
+            # a spawn whose process exited before announcing is a
+            # failed spawn NOW — waiting out spawn_timeout_s would
+            # hold the tier below its floor with nothing coming
+            poll = getattr(h, "poll", None)
+            died = callable(poll) and poll() is not None
+            if died or now - t0 > self.spawn_timeout_s:
+                from paddle_tpu import stats
+                stats.add("fleet/controller_spawn_timeouts")
+                self._pending.pop(rid)
+                # exponential heal backoff: a worker that crashes on
+                # startup (bad argv, import error) otherwise gets
+                # re-spawned every controller step, forever
+                fails = self._spawn_fails.get(tier.role, 0) + 1
+                self._spawn_fails[tier.role] = fails
+                self._heal_backoff[tier.role] = now + min(
+                    30.0, 0.5 * (2 ** min(fails, 6)))
+        pending = [rid for rid, (role, _t, _h) in self._pending.items()
+                   if role == tier.role]
+        return sorted(alive), len(pending), draining
+
+    # -- actuation ----------------------------------------------------------
+
+    def _scale_up(self, tier: TierSpec, n: int, reason: str,
+                  now: float):
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight
+        for _ in range(n):
+            self._seq += 1
+            rid = f"ctl-{tier.role}-{self._seq}"
+            handle = self.spawn(tier.role, rid)
+            self.procs.append(handle)
+            self._pending[rid] = (tier.role, now, handle)
+            stats.add("fleet/controller_scale_ups")
+            flight.record("fleet", "scale-up", role=tier.role,
+                          replica=rid, reason=reason)
+            print(f"[fleet] scale-up {tier.role}: spawn {rid} "
+                  f"({reason})", file=sys.stderr, flush=True)
+
+    def _pick_victim(self, alive: List[str]):
+        """Drain the emptiest replica: least busy slots by its own load
+        gauge, then least router-outstanding — minimizes the work that
+        must finish (or redistribute) before the drain completes."""
+        d = self.router.directory
+
+        def key(rid):
+            load = d.load(rid) or {}
+            return (load.get("busy_slots", 0) + load.get("queued", 0),
+                    self.router._outstanding.get(rid, 0), rid)
+        return min(alive, key=key)
+
+    def _drain(self, tier: TierSpec, alive: List[str], n: int,
+               reason: str, now: float):
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight
+        pool = list(alive)
+        for _ in range(min(n, len(pool))):
+            victim = self._pick_victim(pool)
+            pool.remove(victim)
+            self.router.mark_draining(victim)
+            self._draining[victim] = now
+            stats.add("fleet/controller_scale_downs")
+            flight.record("fleet", "drain-start", role=tier.role,
+                          replica=victim, reason=reason)
+            print(f"[fleet] drain-start {victim} ({reason})",
+                  file=sys.stderr, flush=True)
+
+    def _watch_drains(self, members: Dict[str, dict], now: float):
+        """Advance every in-progress drain: ``drained`` (or death)
+        completes it; overstaying the grace window earns a SIGKILL —
+        the router's death sweep then redistributes whatever the
+        replica still held (at-least-once; the drain protocol loses no
+        request id either way)."""
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight
+        d = self.router.directory
+        for rid in list(self._draining):
+            t0 = self._draining[rid]
+            state = d.state(rid)
+            alive = d.alive(rid, self.router.dead_after)
+            if state == "drained" or not alive:
+                self._draining.pop(rid)
+                self._killed.discard(rid)
+                stats.add("fleet/controller_drains_completed")
+                flight.record("fleet", "drain-complete", replica=rid,
+                              graceful=(state == "drained"),
+                              elapsed_s=round(now - t0, 3))
+                continue
+            if now - t0 > self.drain_grace_s and rid not in self._killed:
+                pid = (members.get(rid) or {}).get("pid")
+                if pid:
+                    try:
+                        os.kill(int(pid), _signal.SIGKILL)
+                    except (OSError, ProcessLookupError):
+                        pass
+                self._killed.add(rid)
+                stats.add("fleet/controller_kills")
+                flight.record("fleet", "kill", replica=rid,
+                              after_s=round(now - t0, 3))
+                print(f"[fleet] drain grace exceeded: SIGKILL {rid}",
+                      file=sys.stderr, flush=True)
+
+    # -- the loop -----------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> dict:
+        """One sense→decide→actuate pass. Returns a per-tier summary
+        (alive/pending counts and the action taken) for callers that
+        log or assert on it."""
+        from paddle_tpu import stats
+        now = time.monotonic() if now is None else now
+        # throttle the full FleetStats pump (refresh + merge + watch
+        # deserializes every replica's export) to its own cadence —
+        # the controller's per-step needs (alive/pending/draining) read
+        # the directory directly, and signals() rides the last refresh.
+        # Mirrors Router.poll's fleet-stats throttle.
+        if self._fleet_at is None or \
+                now - self._fleet_at >= self.fleet_poll_s:
+            self._fleet_at = now
+            self.fleet.poll(now=now)
+        self._reap()
+        members = self._members()
+        self._watch_drains(members, now)
+        out = {}
+        for tier in self.tiers:
+            alive, pending, draining = self._tier_view(tier, members,
+                                                       now)
+            effective = len(alive) + pending
+            action = "hold"
+            # draining replicas still heartbeat but are not routable —
+            # counting their slots dilutes occupancy and suppresses a
+            # needed scale-up on the saturated routable remainder
+            sig = self.fleet.signals(tier.role, exclude=draining)
+            if effective < tier.min_replicas:
+                # healing: a hole in the fleet is never "in band" —
+                # no policy, no cooldown (but failed-spawn backoff
+                # applies: see _tier_view)
+                if now >= self._heal_backoff.get(tier.role, 0.0):
+                    self._scale_up(tier,
+                                   tier.min_replicas - effective,
+                                   f"below floor: {effective}/"
+                                   f"{tier.min_replicas} replicas",
+                                   now)
+                    action = "heal"
+            elif effective > tier.max_replicas:
+                # same floor guard as the policy path: pending spawns
+                # are not routable, so the drain count is capped by
+                # what the ALIVE fleet can give up above the floor
+                room = len(alive) - tier.min_replicas
+                if room > 0:
+                    self._drain(tier,
+                                alive,
+                                min(effective - tier.max_replicas,
+                                    room),
+                                f"above ceiling: {effective}/"
+                                f"{tier.max_replicas}", now)
+                    action = "drain"
+            elif now >= self._cooldown_until.get(tier.role, 0.0):
+                delta, reason = tier.policy.decide(sig, now=now)
+                if delta > 0 and effective < tier.max_replicas:
+                    # at the ceiling the vote is a silent no-op: no
+                    # cooldown, no policy reset — the summary must not
+                    # claim an actuation that never happened
+                    self._scale_up(
+                        tier, min(delta,
+                                  tier.max_replicas - effective),
+                        reason, now)
+                    tier.policy.reset()
+                    self._cooldown_until[tier.role] = \
+                        now + self.cooldown_s
+                    action = "scale-up"
+                elif delta < 0 and len(alive) > 0:
+                    # headroom counts only ALIVE replicas: a pending
+                    # spawn is not routable yet, and draining against
+                    # it would put the serving fleet below the floor
+                    # for the whole engine-build window
+                    room = len(alive) - tier.min_replicas
+                    if room > 0:
+                        self._drain(tier, alive, min(-delta, room),
+                                    reason, now)
+                        tier.policy.reset()
+                        self._cooldown_until[tier.role] = \
+                            now + self.cooldown_s
+                        action = "scale-down"
+            stats.set_value(f"fleet/controller_alive_{tier.role}",
+                            len(alive))
+            out[tier.role] = {"alive": len(alive), "pending": pending,
+                              "draining": len(self._draining),
+                              "action": action,
+                              "occupancy": sig["occupancy"]}
+        return out
+
+    def _reap(self):
+        """Opportunistically collect exited spawn handles and drop
+        them from :attr:`procs` (a long-lived controller on a churny
+        fleet otherwise accumulates dead handles without bound;
+        opaque handles without ``poll`` are kept for shutdown)."""
+        live = []
+        for h in self.procs:
+            poll = getattr(h, "poll", None)
+            rc = None
+            if callable(poll):
+                try:
+                    rc = poll()
+                except Exception:
+                    rc = None
+            if rc is None:
+                live.append(h)
+        self.procs[:] = live
+
+    def pump(self, duration_s: float, interval_s: float = 0.25,
+             extra: Optional[Callable] = None):
+        """Convenience loop for smokes/tests: poll the router, run the
+        death sweep, step the controller — every ``interval_s`` for
+        ``duration_s`` (``extra()`` is called each tick)."""
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            self.router.poll()
+            self.router.check_replicas()
+            self.step()
+            if extra is not None:
+                extra()
+            time.sleep(interval_s)
+
+    def shutdown(self, timeout: float = 30.0):
+        """Reap every spawned handle (call after ``router.shutdown()``
+        has asked the serve loops to exit)."""
+        deadline = time.monotonic() + timeout
+        for h in self.procs:
+            wait = getattr(h, "wait", None)
+            if not callable(wait):
+                continue
+            try:
+                h.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                kill = getattr(h, "kill", None)
+                if callable(kill):
+                    kill()
+                    try:
+                        h.wait(timeout=5)
+                    except Exception:
+                        pass

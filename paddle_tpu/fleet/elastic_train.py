@@ -1,0 +1,255 @@
+"""Preemption-tolerant training: membership change = reshape and
+continue, never crash-and-restart-at-the-old-size (ISSUE 14 tentpole,
+training half; docs/elastic.md).
+
+The pieces existed separately: PR 2's launcher auto-resumes, PR 8's
+``load_resharded`` moves a VERIFIED checkpoint between arbitrary
+meshes and layouts, PR 12 hardened the sharded step. This module wires
+them into ONE harness:
+
+- :func:`plan_topology` re-plans the fsdp×tp mesh for the CURRENT
+  device count (``planner.suggest_mesh`` when a model is given) — the
+  surviving topology gets a fresh plan, not the old mesh minus holes;
+- :class:`ElasticTrainer` builds fresh sharded state on that mesh,
+  ``AutoCheckpoint.restore_resharded``-resumes from the newest
+  VERIFIED epoch (checkpoint-coordinated across ranks by
+  ``last_verified_epoch``'s broadcast), runs the deterministic
+  per-epoch step loop, and — when an ``ElasticManager`` membership
+  callback reports a dead peer — exits with ``ELASTIC_EXIT_CODE`` at
+  the next epoch boundary so the launcher re-forms the store table at
+  the new world size. The relaunched generation replans, restores,
+  and continues: the loss trajectory is parity-pinned against an
+  uninterrupted run (same per-epoch data and rng).
+
+The launcher side of the contract: ``--elastic`` re-forms multi-node
+membership via the TCPStore registry; single-node ``--max_restarts``
+relaunches shrink to the surviving local worker count when
+``PT_ELASTIC_RESHAPE=1`` (distributed/launch.py), exporting the new
+``PT_NUM_PROCESSES``/``PT_PROCESS_ID`` to every worker.
+"""
+
+import json
+import os
+import sys
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["ElasticTrainer", "plan_topology", "synthetic_data"]
+
+
+def plan_topology(model=None, n_devices: Optional[int] = None,
+                  max_tp: int = 8):
+    """An fsdp×tp topology re-planned for the CURRENT device count —
+    the reshape half of an elastic resume. With ``model``, the planner
+    picks (dp, fsdp, tp) degrees by its memory/cost model
+    (``planner.suggest_mesh``); without, the whole device set becomes
+    one fsdp axis (parameter sharding with mesh-independent loss
+    semantics). Returns the initialized topology
+    (``distributed.init_mesh``)."""
+    import jax
+    from paddle_tpu import distributed as dist
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else int(n_devices)
+    devices = devices[:n]     # a reshape to fewer-than-all devices
+    # carves the leading prefix (the virtual-device test topology)
+    if model is not None:
+        from paddle_tpu.distributed import planner
+        degrees = planner.suggest_mesh(model, n, max_tp=max_tp)
+        degrees = {k: int(v) for k, v in degrees.items()
+                   if k in ("dp", "fsdp", "tp", "pp") and int(v) > 1}
+        if degrees:
+            return dist.init_mesh(devices=devices, **degrees)
+    return dist.init_mesh(fsdp=n, devices=devices)
+
+
+def synthetic_data(vocab_size: int, batch: int, seq_len: int):
+    """Deterministic per-epoch batch generator: epoch e's tokens and
+    rng depend only on ``e`` — every world size sees the SAME data, so
+    a reshaped trajectory stays comparable to an uninterrupted one."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def data_fn(epoch: int):
+        tokens = jnp.asarray(
+            np.random.RandomState(1000 + epoch).randint(
+                0, vocab_size, (batch, seq_len)), jnp.int32)
+        return tokens, jax.random.PRNGKey(epoch)
+    return data_fn
+
+
+class ElasticTrainer:
+    """Reshape-on-membership-change training loop.
+
+        trainer = ElasticTrainer(model, opt, ckpt_root,
+                                 data_fn=synthetic_data(V, B, T),
+                                 n_epochs=8)
+        records = trainer.run()     # resumes+reshapes transparently
+
+    Every epoch: ``step(params, opt_state, tokens, rng)`` then an
+    AutoCheckpoint save. On (re)start the harness replans the mesh for
+    the current device count, restores the newest VERIFIED epoch via
+    ``restore_resharded`` (surviving a mesh AND block-layout change),
+    and continues at ``epoch+1``. ``elastic_store`` wires an
+    ``ElasticManager`` peer watch: a dead peer requests a reshape,
+    honored at the next epoch boundary with ``ELASTIC_EXIT_CODE`` so
+    the launcher re-forms at the new world size (state is already on
+    disk — the save IS the coordination point).
+
+    ``init_fn(model, opt, mesh)`` / ``step_fn(model, opt, mesh)``
+    default to the GPT training factory; any model family with the
+    same (params, opt_state, tokens, rng) step contract plugs in.
+    """
+
+    def __init__(self, model, opt, ckpt_root: str, *, job_id="job",
+                 n_epochs: int = 8, keep: int = 3,
+                 data_fn: Optional[Callable] = None,
+                 mesh=None, init_fn: Optional[Callable] = None,
+                 step_fn: Optional[Callable] = None,
+                 on_epoch: Optional[Callable] = None,
+                 log_path: Optional[str] = None,
+                 elastic_store=None, rank: int = 0,
+                 world_size: int = 1, ttl: float = 10.0):
+        self.model = model
+        self.opt = opt
+        self.ckpt_root = ckpt_root
+        self.job_id = job_id
+        self.n_epochs = int(n_epochs)
+        self.keep = int(keep)
+        self.data_fn = data_fn
+        self.mesh = mesh
+        self.init_fn = init_fn
+        self.step_fn = step_fn
+        self.on_epoch = on_epoch
+        self.log_path = log_path
+        self.elastic_store = elastic_store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.ttl = float(ttl)
+        self._manager = None
+        self._dead_peers: Optional[list] = None
+
+    # -- membership ---------------------------------------------------------
+
+    def _on_membership_change(self, dead_ranks):
+        """ElasticManager callback (watcher thread): note the change;
+        the step loop honors it at the next epoch boundary — a reshape
+        exit mid-save would orphan the .tmp epoch dir for GC instead
+        of committing it."""
+        self._dead_peers = list(dead_ranks)
+
+    def _maybe_reshape_exit(self, epoch: int):
+        if self._dead_peers is None:
+            return
+        from paddle_tpu import stats
+        from paddle_tpu.distributed.launch import ELASTIC_EXIT_CODE
+        from paddle_tpu.observability import flight
+        stats.add("fleet/reshape_exits")
+        flight.record("fleet", "reshape", phase="exit",
+                      dead_peers=self._dead_peers, epoch=epoch,
+                      world=self.world_size)
+        print(f"[elastic_train] peers {self._dead_peers} died; "
+              f"exiting {ELASTIC_EXIT_CODE} for re-form after epoch "
+              f"{epoch}", file=sys.stderr, flush=True)
+        if self._manager is not None:
+            self._manager.stop()
+        raise SystemExit(ELASTIC_EXIT_CODE)
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self) -> List[dict]:
+        import jax.numpy as jnp
+        from paddle_tpu import stats
+        from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+        from paddle_tpu.models import gpt
+        from paddle_tpu.observability import flight
+
+        mesh_obj = self.mesh
+        if mesh_obj is None:
+            mesh_obj = plan_topology(self.model).mesh
+        elif hasattr(mesh_obj, "mesh"):      # a Topology was passed
+            mesh_obj = mesh_obj.mesh
+        init_fn = self.init_fn or gpt.init_train_state
+        step_fn = self.step_fn or gpt.build_train_step
+        params, opt_state = init_fn(self.model, self.opt, mesh_obj)
+        step = step_fn(self.model, self.opt, mesh_obj)
+        if self.data_fn is None:
+            cfg = getattr(self.model, "config", None)
+            if cfg is None:
+                raise ValueError("pass data_fn= (no model.config to "
+                                 "derive a synthetic batch from)")
+            self.data_fn = synthetic_data(cfg.vocab_size, 8,
+                                          cfg.max_seq_len)
+
+        world = int(os.environ.get("PT_NUM_PROCESSES", "1"))
+        # the topology's own device count (a reshape to fewer devices
+        # than the process exposes — the virtual-device test idiom —
+        # must still register as a reshape)
+        n_dev = int(mesh_obj.size)
+        ck = AutoCheckpoint(self.ckpt_root, job_id=self.job_id,
+                            keep=self.keep)
+        fresh = {"params": params, "opt": opt_state,
+                 "epoch": jnp.zeros((), jnp.int32),
+                 "world": jnp.asarray(n_dev, jnp.int32)}
+        state = ck.restore_resharded(fresh, mesh=mesh_obj)
+        if state is not None:
+            params, opt_state = state["params"], state["opt"]
+            start = int(state["epoch"]) + 1
+            saved_world = int(state.get("world", n_dev))
+            if saved_world != n_dev:
+                # the reshape landed: a checkpoint saved under another
+                # topology restored onto this one
+                stats.add("fleet/reshape_resumes")
+                flight.record("fleet", "reshape", phase="resume",
+                              from_devices=saved_world,
+                              to_devices=n_dev, epoch=start)
+                print(f"[elastic_train] reshaped {saved_world}->"
+                      f"{n_dev} devices; resuming at epoch {start}",
+                      file=sys.stderr, flush=True)
+        else:
+            start = 0
+        stats.set_value("fleet/train_world", world)
+
+        if self.elastic_store is not None and self.world_size > 1:
+            from paddle_tpu.distributed.elastic import ElasticManager
+            self._manager = ElasticManager(
+                self.elastic_store, self.rank, self.world_size,
+                ttl=self.ttl,
+                on_change=self._on_membership_change).start()
+
+        records: List[dict] = []
+        from paddle_tpu.testing import faults
+        try:
+            for epoch in range(start, self.n_epochs):
+                # the documented training-loop fault site: the chaos
+                # gate kills a trainer mid-step with
+                # PT_FAULTS="train.step:kill:after=N" and asserts the
+                # reshape path resumes it
+                faults.fire("train.step")
+                tokens, rng = self.data_fn(epoch)
+                t0 = time.perf_counter()
+                params, opt_state, loss = step(params, opt_state,
+                                               tokens, rng)
+                loss = float(loss)
+                stats.observe("fleet/train_epoch_s",
+                              time.perf_counter() - t0)
+                rec = {"epoch": epoch, "loss": loss, "world": world,
+                       "devices": n_dev}
+                records.append(rec)
+                if self.log_path:
+                    with open(self.log_path, "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+                ck.save({"params": params, "opt": opt_state,
+                         "epoch": jnp.asarray(epoch, jnp.int32),
+                         "world": jnp.asarray(n_dev, jnp.int32)},
+                        epoch)
+                if self.on_epoch is not None:
+                    self.on_epoch(rec)
+                # reshape request (dead peer): exit AFTER the save —
+                # the committed epoch is the coordination point the
+                # surviving generation restores from
+                self._maybe_reshape_exit(epoch)
+        finally:
+            if self._manager is not None:
+                self._manager.stop()
+        return records

@@ -1,0 +1,140 @@
+"""Pluggable autoscaling policy for the fleet controller.
+
+The controller (``fleet/controller.py``) separates *sensing* (the
+``FleetStats.signals`` condensed view of the heartbeat load gauges +
+SLO watch), *deciding* (this module), and *actuating* (spawn / drain).
+A policy sees one tier's signals per step and answers "how many
+replicas do you want added (+n) or retired (-n) right now" — min/max
+clamping, cooldown between actions, and dead-replica healing are the
+controller's job, so policies stay small pure-ish state machines.
+
+:class:`TargetOccupancyPolicy` is the default: a target-occupancy band
+with hysteresis. Scale-up pressure is any of: occupancy above the
+band, sustained queue age, a burning TTFT SLO window, or an exhausted
+page pool with work waiting. Scale-down requires the opposite to hold
+*continuously* for ``sustain_s`` (idle occupancy, empty queues) — a
+momentary lull between Poisson bursts must not flap the fleet.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+__all__ = ["FleetSignals", "ScalePolicy", "TargetOccupancyPolicy"]
+
+
+@dataclass
+class FleetSignals:
+    """Typed view of ``FleetStats.signals()`` for policy authors who
+    prefer attributes over dict keys (``from_dict`` accepts either)."""
+    n_alive: int = 0
+    queued: int = 0
+    busy_slots: int = 0
+    total_slots: int = 0
+    occupancy: float = 0.0
+    queue_age_s: float = 0.0
+    free_pages: int = 0
+    total_pages: int = 0
+    ttft_burn: float = 0.0
+    goodput: float = 0.0
+
+    @classmethod
+    def from_dict(cls, d) -> "FleetSignals":
+        if isinstance(d, cls):
+            return d
+        fields = cls.__dataclass_fields__
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+class ScalePolicy:
+    """Base policy: ``decide(signals, now)`` returns ``(delta,
+    reason)`` — +n to add replicas, -n to retire, 0 to hold. The
+    reason string lands in the controller's flight-recorder event so a
+    postmortem dump says WHY the fleet changed shape."""
+
+    def decide(self, sig, now: Optional[float] = None
+               ) -> Tuple[int, str]:
+        raise NotImplementedError
+
+    def reset(self):
+        """Forget sustained-condition anchors (controller calls this
+        after it actuates, so the next decision re-observes from
+        scratch instead of double-firing on the same stretch)."""
+
+
+class TargetOccupancyPolicy(ScalePolicy):
+    """Occupancy band with hysteresis + sustained-condition anchors.
+
+    Scale UP (+step) when, continuously for ``up_sustain_s``:
+      - slot occupancy > ``high``, or
+      - the oldest queued request is older than ``queue_age_s``, or
+      - the fleet TTFT window burns (``ttft_burn`` > ``burn_high``), or
+      - a paged tier has zero free pages with work queued.
+
+    Scale DOWN (-step) when, continuously for ``down_sustain_s``:
+      - occupancy < ``low`` AND nothing is queued anywhere.
+
+    Inside the band (or with mixed signals) the policy holds — that IS
+    the hysteresis: the band's width, not a single threshold, decides,
+    so a fleet hovering near one edge never flaps.
+    """
+
+    def __init__(self, low: float = 0.25, high: float = 0.85,
+                 queue_age_s: float = 5.0, burn_high: float = 1.0,
+                 up_sustain_s: float = 1.0, down_sustain_s: float = 5.0,
+                 step: int = 1):
+        if not 0.0 <= low < high <= 1.0:
+            raise ValueError(f"need 0 <= low < high <= 1, "
+                             f"got low={low} high={high}")
+        self.low = float(low)
+        self.high = float(high)
+        self.queue_age_s = float(queue_age_s)
+        self.burn_high = float(burn_high)
+        self.up_sustain_s = float(up_sustain_s)
+        self.down_sustain_s = float(down_sustain_s)
+        self.step = int(step)
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+
+    def _pressure(self, s: FleetSignals) -> Optional[str]:
+        if s.total_slots and s.occupancy > self.high:
+            return (f"occupancy {s.occupancy:.2f} > {self.high:.2f}"
+                    f" band")
+        if s.queue_age_s > self.queue_age_s:
+            return (f"queue age {s.queue_age_s:.1f}s > "
+                    f"{self.queue_age_s:.0f}s")
+        if s.ttft_burn > self.burn_high:
+            return f"TTFT SLO burn {s.ttft_burn:.2f} > 1"
+        if s.total_pages > 0 and s.free_pages <= 0 and s.queued > 0:
+            return f"page pool exhausted with {s.queued} queued"
+        return None
+
+    def decide(self, sig, now: Optional[float] = None
+               ) -> Tuple[int, str]:
+        now = time.monotonic() if now is None else now
+        s = FleetSignals.from_dict(sig)
+        up_reason = self._pressure(s)
+        idle = (s.total_slots > 0 and s.occupancy < self.low
+                and s.queued == 0 and s.queue_age_s == 0.0
+                and s.ttft_burn <= self.burn_high)
+        if up_reason is None:
+            self._up_since = None
+        elif self._up_since is None:
+            self._up_since = now
+        if not idle:
+            self._down_since = None
+        elif self._down_since is None:
+            self._down_since = now
+        if (self._up_since is not None
+                and now - self._up_since >= self.up_sustain_s):
+            return self.step, up_reason
+        if (self._down_since is not None
+                and now - self._down_since >= self.down_sustain_s):
+            return -self.step, (f"idle: occupancy {s.occupancy:.2f} < "
+                                f"{self.low:.2f} with empty queue for "
+                                f"{now - self._down_since:.1f}s")
+        return 0, ""
+
+    def reset(self):
+        self._up_since = None
+        self._down_since = None

@@ -38,7 +38,7 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Collection, Dict, List, Optional
 
 from paddle_tpu import stats as stats_lib
 
@@ -136,9 +136,15 @@ class FleetStats:
         liveness (defaults to ``alive``; refresh judges it with the
         longer ``_stall_horizon``)."""
         now = time.monotonic() if now is None else now
+        with self._lock:
+            self._ingest_locked(rid, export, load, alive, now, present)
+
+    def _ingest_locked(self, rid, export, load, alive, now, present):
+        # the lock covers every map signals() snapshots — a controller
+        # stepping on its own thread iterates them concurrently with
+        # the router-thread ingest
         if export is not None:
-            with self._lock:
-                self._exports[rid] = export
+            self._exports[rid] = export
         if load is not None:
             self._loads[rid] = load
             busy_now = (load.get("queued", 0) > 0
@@ -189,6 +195,54 @@ class FleetStats:
 
     def export(self) -> dict:
         return self.merged().export(rank=-1)
+
+    def signals(self, role: Optional[str] = None,
+                exclude: Collection[str] = ()) -> dict:
+        """The fleet controller's condensed input (fleet/controller.py):
+        one dict summarizing the PRESENT replicas' heartbeat load
+        gauges plus the watch's fleet-level SLO gauges. ``role``
+        restricts the view to one serving tier (``prefill`` /
+        ``decode`` / ``both``) so a disaggregated fleet's tiers scale
+        independently; None aggregates every present replica.
+        ``exclude`` drops named rids from the view (the controller
+        passes its draining set — those replicas still heartbeat but
+        take no new placements, so their slots are not capacity).
+
+        Keys: ``replicas`` (present rids, sorted), ``n_alive``,
+        ``queued``, ``busy_slots``/``total_slots``/``occupancy``,
+        ``queue_age_s`` (max over replicas), ``free_pages``/
+        ``total_pages``, ``ttft_burn`` (fleet/slo_ttft_burn gauge — 0
+        until a window is judged), ``goodput`` (fleet/
+        goodput_tokens_per_s gauge)."""
+        with self._lock:
+            loads = {rid: dict(l) for rid, l in self._loads.items()}
+            present = dict(self._present)
+        rids = sorted(
+            rid for rid, l in loads.items()
+            if present.get(rid)
+            and rid not in exclude
+            and (role is None or l.get("role", "both") == role))
+        busy = sum(loads[r].get("busy_slots", 0) for r in rids)
+        total = sum(loads[r].get("busy_slots", 0)
+                    + loads[r].get("free_slots", 0) for r in rids)
+        return {
+            "replicas": rids,
+            "n_alive": len(rids),
+            "queued": sum(loads[r].get("queued", 0) for r in rids),
+            "busy_slots": busy,
+            "total_slots": total,
+            "occupancy": (busy / total) if total else 0.0,
+            "queue_age_s": max(
+                [float(loads[r].get("queue_age_s", 0.0) or 0.0)
+                 for r in rids], default=0.0),
+            "free_pages": sum(loads[r].get("free_pages", 0)
+                              for r in rids),
+            "total_pages": sum(loads[r].get("total_pages", 0)
+                               for r in rids),
+            "ttft_burn": float(stats_lib.get("fleet/slo_ttft_burn", 0.0)),
+            "goodput": float(
+                stats_lib.get("fleet/goodput_tokens_per_s", 0.0)),
+        }
 
     def serve_statsz(self, port: int = 0, host: str = "0.0.0.0"):
         """Fleet-level /statsz: every scrape serves a freshly merged

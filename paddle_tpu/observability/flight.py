@@ -67,7 +67,16 @@ class FlightRecorder:
         # rid -> deque[(wall_s, event, attrs)] — insertion order IS the
         # FIFO eviction order (requests are tracked from first event)
         self._reqs = collections.OrderedDict()
+        self._pinned: set = set()
         self.dropped = 0
+
+    def pin(self, rid):
+        """Exempt ``rid`` from FIFO eviction. The fleet controller's
+        synthetic ``"fleet"`` timeline must survive request churn (a
+        postmortem needs the scale/drain history however many requests
+        came after it); per-rid events still cap at ``max_events``."""
+        with self._lock:
+            self._pinned.add(rid)
 
     @property
     def enabled(self) -> bool:
@@ -83,7 +92,11 @@ class FlightRecorder:
             dq = self._reqs.get(rid)
             if dq is None:
                 while len(self._reqs) >= self.capacity:
-                    self._reqs.popitem(last=False)
+                    victim = next((r for r in self._reqs
+                                   if r not in self._pinned), None)
+                    if victim is None:
+                        break
+                    del self._reqs[victim]
                     self.dropped += 1
                 dq = self._reqs[rid] = collections.deque(
                     maxlen=self.max_events)
@@ -159,6 +172,10 @@ _DEFAULT = FlightRecorder()
 
 def default_recorder() -> FlightRecorder:
     return _DEFAULT
+
+
+def pin(rid):
+    _DEFAULT.pin(rid)
 
 
 def record(rid, event: str, **attrs):

@@ -323,6 +323,7 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
     open_reqs: Dict[str, object] = {}
     idle_since = time.monotonic()
     last_load = 0.0
+    draining = False
     while True:
         now = time.monotonic()
         if now - last_load >= load_refresh_s:
@@ -332,10 +333,12 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
                 queue_age_s=queue_age_s(engine=engine)),
                 stats=stats.export())
             last_load = now
+            draining = draining or directory.state(rid) == "draining"
         else:
             directory.heartbeat(rid)
-        if _shutdown_requested(store) and not open_reqs:
-            return
+        # mailbox BEFORE the drain/shutdown exit checks: a request
+        # placed just before the drain decision must be consumed and
+        # finished here, not stranded for the death sweep
         seen, msgs = _mailbox_pump(store, rid, seen)
         for msg in msgs:
             try:
@@ -354,6 +357,13 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
                     "replica": rid})
                 continue
             open_reqs[msg["id"]] = req
+        if draining and not open_reqs:
+            # drain protocol: every accepted prefill finished (handed
+            # off or terminal) — publish drained and exit
+            directory.set_state(rid, "drained")
+            return
+        if _shutdown_requested(store) and not open_reqs:
+            return
         if open_reqs:
             engine.step()
             idle_since = time.monotonic()
@@ -420,6 +430,7 @@ def serve_decode_replica(store, rid: str, frontend,
     open_reqs: Dict[str, object] = {}
     idle_since = time.monotonic()
     last_load = 0.0
+    draining = False
     while True:
         now = time.monotonic()
         if now - last_load >= load_refresh_s:
@@ -430,11 +441,11 @@ def serve_decode_replica(store, rid: str, frontend,
                 queue_age_s=queue_age_s(frontend=frontend)),
                 stats=stats.export())
             last_load = now
+            draining = draining or directory.state(rid) == "draining"
         else:
             directory.heartbeat(rid)
-        if _shutdown_requested(store) and not open_reqs \
-                and not frontend.busy:
-            return
+        # mailbox BEFORE the drain/shutdown exit checks (rationale in
+        # serve_prefill_replica above)
         seen, msgs = _mailbox_pump(store, rid, seen)
         for msg in msgs:
             try:
@@ -505,6 +516,14 @@ def serve_decode_replica(store, rid: str, frontend,
                     "replica": rid})
                 continue
             open_reqs[msg["id"]] = req
+        if draining and not open_reqs and not frontend.busy:
+            # drain protocol: in-flight decodes finished, nothing
+            # queued — publish drained and exit
+            directory.set_state(rid, "drained")
+            return
+        if _shutdown_requested(store) and not open_reqs \
+                and not frontend.busy:
+            return
         if frontend.busy:
             frontend.step()
             idle_since = time.monotonic()

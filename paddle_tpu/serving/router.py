@@ -110,13 +110,43 @@ class Router:
         # a liveness blip must degrade to a delay, never crash poll()
         self._unplaced: set = set()
         self._fleet = None                       # lazy directory client
+        # drain protocol (fleet controller, docs/elastic.md): lifecycle
+        # states read at most every _state_ttl_s per replica — a
+        # submission burst reuses the cache instead of one store read
+        # per replica per placement. mark_draining() updates the cache
+        # in-process, so a controller sharing this router never races
+        # its own drain decision against a stale cache entry.
+        self._state_cache: Dict[str, tuple] = {}  # rid -> (state, t)
+        self._state_ttl_s = 0.25
 
     # -- membership ---------------------------------------------------------
 
+    def _replica_state(self, rid: str) -> str:
+        """Cached lifecycle state (drain protocol): ``up`` replicas are
+        routable, ``draining``/``drained`` ones never receive a NEW
+        placement (their in-flight work finishes where it is, or the
+        death sweep redistributes it once they exit)."""
+        now = time.monotonic()
+        ent = self._state_cache.get(rid)
+        if ent is None or now - ent[1] > self._state_ttl_s:
+            ent = (self.directory.state(rid), now)
+            self._state_cache[rid] = ent
+        return ent[0]
+
+    def mark_draining(self, rid: str):
+        """Start draining ``rid``: publish the state AND update the
+        local cache, so the very next placement in this process already
+        excludes it (the fleet controller shares the router process —
+        its drain decision must not race the cache TTL)."""
+        self.directory.set_state(rid, "draining")
+        self._state_cache[rid] = ("draining", time.monotonic())
+
     def replicas(self) -> List[str]:
-        """Alive replicas, least-outstanding first."""
+        """Alive ROUTABLE replicas (draining ones excluded),
+        least-outstanding first."""
         alive = [rid for rid in self.directory.members()
-                 if self.directory.alive(rid, self.dead_after)]
+                 if self.directory.alive(rid, self.dead_after)
+                 and self._replica_state(rid) == "up"]
         return sorted(alive,
                       key=lambda r: (self._outstanding.get(r, 0), r))
 
@@ -201,7 +231,8 @@ class Router:
 
     def _alive_meta(self) -> Dict[str, dict]:
         return {rid: m for rid, m in self.directory.members().items()
-                if self.directory.alive(rid, self.dead_after)}
+                if self.directory.alive(rid, self.dead_after)
+                and self._replica_state(rid) == "up"}
 
     def _fleet_covered(self, prompt, page: int) -> int:
         """Pre-placement directory consult: how many leading FULL pages
@@ -464,6 +495,10 @@ class Router:
                 continue
             self._swept.add(rid)
             self._outstanding.pop(rid, None)
+            # a controller-churned fleet mints a fresh rid per spawn:
+            # drop the dead replica's lifecycle-cache entry with the
+            # other per-rid state or the cache grows forever
+            self._state_cache.pop(rid, None)
             orphans = [q for q, r in self._assigned.items()
                        if r == rid and q not in self.results]
             for req_id in orphans:
@@ -552,10 +587,17 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
     a full ``stats.export()`` snapshot — the fleet telemetry plane's
     feed (observability/fleet.FleetStats) — plus the live/peak HBM
     gauges on backends that expose them.
+
+    Drain protocol (docs/elastic.md): once the directory state flips
+    to ``draining`` (the fleet controller retiring this replica), the
+    router has already stopped placing new work here — this loop keeps
+    consuming any mailbox entries placed BEFORE the drain, finishes
+    every in-flight request, publishes ``drained``, and exits.
     """
     from paddle_tpu import stats
     from paddle_tpu.observability import runtime
     from paddle_tpu.serving.disagg import queue_age_s, replica_load
+    from paddle_tpu.testing import faults
     directory = ReplicaDirectory(store)
     directory.announce(rid, {"pid": os.getpid(),
                              "slots": frontend.engine.S})
@@ -563,7 +605,12 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
     open_reqs: Dict[str, object] = {}
     idle_since = time.monotonic()
     last_load = 0.0
+    draining = False
     while True:
+        # chaos hook (testing/faults.py): PT_FAULTS="serve.loop:kill:
+        # after=N" SIGKILL-equivalently drops this replica mid-serve —
+        # the fleet controller must heal it with zero request-id loss
+        faults.fire("serve.loop")
         now = time.monotonic()
         if now - last_load >= load_refresh_s:
             runtime.hbm_gauges()
@@ -573,12 +620,14 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
                 queue_age_s=queue_age_s(frontend=frontend)),
                 stats=stats.export())
             last_load = now
+            draining = draining or directory.state(rid) == "draining"
         else:
             directory.heartbeat(rid)
-        if _shutdown_requested(store) and not open_reqs \
-                and not frontend.busy:
-            return
-        # mailbox: consume any indices the router appended
+        # mailbox BEFORE the drain/shutdown exit checks: a request the
+        # router placed just before the drain decision may still sit
+        # unconsumed here — exiting first would strand it until the
+        # death sweep, a dead_after-sized latency cliff on a request
+        # the drain protocol promises to finish
         seen, msgs = _mailbox_pump(store, rid, seen)
         for msg in msgs:
             try:
@@ -599,6 +648,12 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
                     "replica": rid})
                 continue
             open_reqs[msg["id"]] = req
+        if draining and not open_reqs and not frontend.busy:
+            directory.set_state(rid, "drained")
+            return
+        if _shutdown_requested(store) and not open_reqs \
+                and not frontend.busy:
+            return
         if frontend.busy:
             frontend.step()
             idle_since = time.monotonic()

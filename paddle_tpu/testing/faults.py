@@ -63,6 +63,11 @@ Sites currently wired into the runtime:
     ckpt.tmp_saved        AutoCheckpoint.save between shard write and
                           commit-rename (kill here orphans a .tmp dir)
     train.step            user training loops (see tests/_resume_worker.py)
+                          and fleet.ElasticTrainer's epoch loop (the
+                          chaos gate kills a trainer mid-step here)
+    serve.loop            router.serve_replica's loop head — kill here
+                          drops a serving replica mid-serve (the fleet
+                          controller's chaos/heal gate)
     engine.poison_logits  DecodeEngine / PagedDecodeEngine (slot_mask)
     paged.shared_page     prefix-cache shared KV pages (transform)
     collective.quant_payload

@@ -1,0 +1,105 @@
+"""Elastic-training worker for tests/test_fleet_controller.py and
+tools/elastic_smoke.py: a preemption-tolerant trainer driven through
+the real ``distributed/launch.py`` CLI.
+
+Rank 0 trains a tiny GPT through ``fleet.ElasticTrainer`` on a virtual
+host-platform mesh of PT_NUM_PROCESSES devices (the single-process
+stand-in for one-device-per-rank, same idiom as test_elastic_e2e);
+other ranks idle — except the ranks named in ``ET_DIE_RANKS`` while
+the world equals ``ET_DIE_WORLD``, which exit(3) as soon as the
+trainer has committed ``ET_DIE_AFTER_EPOCH``. With PT_ELASTIC_RESHAPE=1
+the launcher then relaunches the group at the surviving worker count
+and the trainer replans its mesh + restore_resharded-resumes.
+
+Usage (as the launch CLI's training script):
+    ET_DIE_RANKS=2,3 ET_DIE_WORLD=4 PT_ELASTIC_RESHAPE=1 \
+    python -m paddle_tpu.distributed.launch --nproc_per_node 4 \
+        --max_restarts 2 tests/_elastic_train_worker.py WORKDIR [EPOCHS]
+"""
+
+import json
+import os
+import sys
+import time
+
+rank = int(os.environ.get("PT_PROCESS_ID", "0"))
+world = int(os.environ.get("PT_NUM_PROCESSES", "1"))
+workdir = sys.argv[1]
+n_epochs = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+done_file = os.path.join(workdir, "done")
+job_dir = os.path.join(workdir, "ckpt", "job")
+
+die_ranks = {int(r) for r in
+             os.environ.get("ET_DIE_RANKS", "").split(",") if r}
+die_world = int(os.environ.get("ET_DIE_WORLD", "0"))
+die_after = int(os.environ.get("ET_DIE_AFTER_EPOCH", "1"))
+
+
+def _epoch_committed(epoch: int) -> bool:
+    d = os.path.join(job_dir, f"epoch_{epoch}")
+    return os.path.exists(os.path.join(d, "meta.json"))
+
+
+if rank != 0:
+    if rank in die_ranks and world == die_world:
+        # die (preemption stand-in) once the trainer has committed the
+        # trigger epoch — both die-ranks poll the same fs condition, so
+        # they exit together and the launcher reshapes in ONE relaunch.
+        # ET_DIE_SIGNAL=kill makes it a hard SIGKILL (the chaos gate's
+        # preemption shape) instead of a clean nonzero exit.
+        for _ in range(2400):
+            if _epoch_committed(die_after):
+                break
+            time.sleep(0.05)
+        if os.environ.get("ET_DIE_SIGNAL") == "kill":
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        os._exit(3)
+    while not os.path.exists(done_file):
+        time.sleep(0.2)
+    sys.exit(0)
+
+# ---- rank 0: ElasticTrainer on a <world>-device virtual mesh ----------
+os.environ["XLA_FLAGS"] = (
+    " ".join(f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f)
+    + f" --xla_force_host_platform_device_count={world}")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu import optimizer as optim  # noqa: E402
+from paddle_tpu.fleet import ElasticTrainer, plan_topology  # noqa: E402
+from paddle_tpu.fleet.elastic_train import synthetic_data  # noqa: E402
+from paddle_tpu.models import gpt  # noqa: E402
+from paddle_tpu.testing import faults  # noqa: E402
+
+# PT_FAULTS plumbing (the chaos gate kills the trainer mid-step with
+# train.step:kill): only rank 0 installs — the idle ranks must survive
+# to be SIGTERMed as healthy group members
+faults.install_from_env()
+
+cfg = gpt.GPTConfig(vocab_size=128, max_seq_len=16, d_model=32,
+                    n_layers=2, n_heads=2, dtype=jnp.float32)
+model = gpt.GPT(cfg, seed=0)
+opt = optim.SGD(learning_rate=0.05)
+
+epoch_sleep = float(os.environ.get("ET_EPOCH_SLEEP", "0.3"))
+
+trainer = ElasticTrainer(
+    model, opt, os.path.join(workdir, "ckpt"), job_id="job",
+    n_epochs=n_epochs, keep=3,
+    mesh=plan_topology(model, n_devices=world),
+    # batch 12 divides every reshape size in 4..1, so dp re-planning
+    # never strands a ragged batch shard
+    data_fn=synthetic_data(cfg.vocab_size, 12, cfg.max_seq_len),
+    log_path=os.path.join(workdir, "loss_log.jsonl"),
+    # pace the epochs so the die-ranks' exit lands mid-run, before the
+    # world-<die_world> generation can finish on its own
+    on_epoch=lambda rec: time.sleep(
+        epoch_sleep if world == die_world else 0.0))
+records = trainer.run()
+with open(os.path.join(workdir, f"records_w{world}.json"), "w") as f:
+    json.dump(records, f)
+open(done_file, "w").close()

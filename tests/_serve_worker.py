@@ -34,6 +34,11 @@ def main():
     from paddle_tpu.models import gpt
     from paddle_tpu.inference.decode_engine import DecodeEngine
     from paddle_tpu.serving import FrontEnd, serve_replica
+    from paddle_tpu.testing import faults
+
+    # PT_FAULTS plumbing (the fleet chaos tests kill a replica
+    # mid-serve with serve.loop:kill and assert the controller heals)
+    faults.install_from_env()
 
     cfg = gpt.GPTConfig(vocab_size=96, max_seq_len=128, d_model=32,
                         n_layers=2, n_heads=4, dtype=jnp.float32)

@@ -90,6 +90,22 @@ def test_bench_serve_disagg_smoke():
     assert out.get("serve_disagg_fleet_hit_tokens", 0) > 0, out
 
 
+def test_bench_fleet_churn_smoke():
+    """Fleet-churn ladder row (ISSUE 14): both phases must serve every
+    request (the kill's unfinished work redistributes, at-least-once),
+    the churn phase must actually have redistributed something, and
+    the goodput ratio must be computable."""
+    out = bench.bench_fleet_churn(jax, jnp, PEAK, smoke=True)
+    for label in ("steady", "churn"):
+        assert out.get(
+            f"fleet_churn_{label}_goodput_tokens_per_sec", 0) > 0, out
+        assert out.get(
+            f"fleet_churn_{label}_completed_frac", 0) == 1.0, out
+        assert out.get(f"fleet_churn_{label}_p99_ttft_ms", 0) > 0, out
+    assert out.get("fleet_churn_redistributed", 0) > 0, out
+    assert out.get("fleet_churn_goodput_ratio", 0) > 0, out
+
+
 def test_bench_train_quant_comm_smoke():
     out = bench.bench_train_quant_comm(jax, jnp, PEAK, smoke=True)
     assert out.get("train_quant_comm_fp32_step_ms", 0) > 0, out

@@ -1,0 +1,480 @@
+"""Elastic fleet controller (ISSUE 14): shared progress-judged
+liveness core, the drain protocol (a draining replica finishes every
+request id and never receives a new placement), the autoscaler's
+heal/scale decisions, and the preemption-tolerant reshape path
+(launch --max_restarts + PT_ELASTIC_RESHAPE resumes training on the
+surviving topology via restore_resharded, loss-trajectory parity
+pinned)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native, stats
+from paddle_tpu.distributed.liveness import ProgressJudge
+from paddle_tpu.distributed.membership import ReplicaDirectory
+from paddle_tpu.serving import Router
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_WORKER = os.path.join(REPO, "tests", "_serve_worker.py")
+TRAIN_WORKER = os.path.join(REPO, "tests", "_elastic_train_worker.py")
+
+pytestmark = pytest.mark.skipif(not native.is_available(),
+                                reason="native TCPStore unavailable")
+
+
+# ---------------------------------------------------------------------------
+# the shared liveness core
+# ---------------------------------------------------------------------------
+
+def test_progress_judge_core():
+    j = ProgressJudge()
+    assert not j.has("a")
+    assert j.stalled_for("a") is None
+    # first observation (even of None) counts as progress
+    assert j.update("a", 1, now=10.0)
+    assert not j.update("a", 1, now=11.0)          # frozen counter
+    assert j.stalled_for("a", now=12.0) == 2.0
+    assert j.update("a", 2, now=12.0)              # progressed
+    assert j.alive("a", ttl=1.0, now=12.5)
+    assert not j.alive("a", ttl=1.0, now=14.0)
+    # a None read never counts as progress, never resets the clock
+    assert not j.update("a", None, now=13.0)
+    assert j.stalled_for("a", now=13.0) == 1.0
+    j.forget("a")
+    assert not j.has("a")
+
+
+def test_replica_directory_uses_shared_core():
+    """The dedupe satellite: ReplicaDirectory's liveness bookkeeping
+    IS a ProgressJudge (one implementation, two public surfaces) and
+    the progress semantics survived the refactor."""
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        d = ReplicaDirectory(store)
+        assert isinstance(d._judge, ProgressJudge)
+        obs = ReplicaDirectory(store)
+        assert not obs.alive("ghost", dead_after=0.1)
+        d.announce("r0", {})
+        assert obs.alive("r0", dead_after=0.2)
+        time.sleep(0.3)
+        assert not obs.alive("r0", dead_after=0.2)  # stalled
+        d.heartbeat("r0")
+        assert obs.alive("r0", dead_after=0.2)      # resurrected
+    finally:
+        store.close()
+
+
+def test_elastic_manager_uses_shared_core():
+    """ElasticManager's peer watch runs on the same core: a peer whose
+    counter stops progressing is reported dead once; resumption
+    re-arms the report."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    deaths = []
+    mgr = None
+    try:
+        mgr = ElasticManager(store, rank=0, world_size=2, ttl=0.3,
+                             interval=0.05,
+                             on_change=lambda dead: deaths.append(dead))
+        store.add("elastic/hb/1", 1)      # peer 1 heartbeats once
+        mgr.start()
+        deadline = time.monotonic() + 5
+        while not deaths and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert deaths == [[1]], deaths
+    finally:
+        if mgr is not None:
+            mgr.stop()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle state + drain-aware routing
+# ---------------------------------------------------------------------------
+
+def test_replica_lifecycle_state():
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        d = ReplicaDirectory(store)
+        assert d.state("r0") == "up"          # never published = up
+        d.set_state("r0", "draining")
+        assert d.state("r0") == "draining"
+        d.set_state("r0", "drained")
+        assert d.state("r0") == "drained"
+        with pytest.raises(ValueError):
+            d.set_state("r0", "retired")
+    finally:
+        store.close()
+
+
+def test_router_never_places_on_draining_replica():
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        router = Router(store=store)
+        router.directory.announce("a", {})
+        router.directory.announce("b", {})
+        router.directory.alive = lambda rid, dead_after=0: True
+        ids = [router.submit([1, 2, 3], max_new_tokens=2)
+               for _ in range(2)]
+        assert {router._assigned[q] for q in ids} == {"a", "b"}
+        router.mark_draining("a")
+        assert "a" not in router.replicas()
+        more = [router.submit([1, 2, 3], max_new_tokens=2)
+                for _ in range(4)]
+        assert all(router._assigned[q] == "b" for q in more)
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+def _sig(**kw):
+    base = dict(n_alive=2, queued=0, busy_slots=0, total_slots=4,
+                occupancy=0.0, queue_age_s=0.0, free_pages=0,
+                total_pages=0, ttft_burn=0.0, goodput=0.0)
+    base.update(kw)
+    return base
+
+
+def test_target_occupancy_policy_hysteresis():
+    from paddle_tpu.fleet import TargetOccupancyPolicy
+    p = TargetOccupancyPolicy(low=0.25, high=0.85, up_sustain_s=1.0,
+                              down_sustain_s=5.0, queue_age_s=5.0)
+    # inside the band: hold forever
+    assert p.decide(_sig(occupancy=0.5, busy_slots=2), now=0.0)[0] == 0
+    assert p.decide(_sig(occupancy=0.5, busy_slots=2), now=99.0)[0] == 0
+    # pressure must SUSTAIN before firing
+    assert p.decide(_sig(occupancy=0.95, busy_slots=4), now=100.0)[0] == 0
+    delta, reason = p.decide(_sig(occupancy=0.95, busy_slots=4),
+                             now=101.5)
+    assert delta == 1 and "occupancy" in reason
+    # a blip back into the band resets the anchor
+    p.reset()
+    assert p.decide(_sig(occupancy=0.95, busy_slots=4), now=200.0)[0] == 0
+    assert p.decide(_sig(occupancy=0.5, busy_slots=2), now=200.5)[0] == 0
+    assert p.decide(_sig(occupancy=0.95, busy_slots=4), now=201.0)[0] == 0
+    # queue age and TTFT burn are scale-up pressure too
+    p2 = TargetOccupancyPolicy(up_sustain_s=0.0)
+    assert p2.decide(_sig(queue_age_s=9.0), now=0.0)[0] == 1
+    p2.reset()
+    assert p2.decide(_sig(ttft_burn=1.4), now=0.0)[0] == 1
+    p2.reset()
+    assert p2.decide(_sig(total_pages=8, free_pages=0, queued=3),
+                     now=0.0)[0] == 1
+    # scale-down needs a LONG idle stretch with empty queues
+    assert p.decide(_sig(occupancy=0.1, busy_slots=0), now=300.0)[0] == 0
+    assert p.decide(_sig(occupancy=0.1, busy_slots=0), now=304.0)[0] == 0
+    assert p.decide(_sig(occupancy=0.1, busy_slots=0),
+                    now=305.5)[0] == -1
+    # queued work vetoes idleness
+    p.reset()
+    assert p.decide(_sig(occupancy=0.1, queued=1), now=400.0)[0] == 0
+    assert p.decide(_sig(occupancy=0.1, queued=1), now=999.0)[0] == 0
+
+
+def test_fleet_signals_role_view():
+    from paddle_tpu.observability.fleet import FleetStats
+    fs = FleetStats(directory=None)
+    fs.ingest("pf0", load={"role": "prefill", "queued": 3,
+                           "busy_slots": 1, "free_slots": 1,
+                           "queue_age_s": 2.0, "tokens": 10})
+    fs.ingest("dc0", load={"role": "decode", "queued": 1,
+                           "busy_slots": 2, "free_slots": 0,
+                           "free_pages": 4, "total_pages": 16,
+                           "queue_age_s": 7.5, "tokens": 99})
+    fs.ingest("dead", load={"role": "decode", "queued": 9},
+              alive=False, present=False)
+    pf = fs.signals("prefill")
+    assert pf["n_alive"] == 1 and pf["queued"] == 3
+    assert pf["occupancy"] == 0.5
+    dc = fs.signals("decode")
+    assert dc["replicas"] == ["dc0"]      # dead replica excluded
+    assert dc["occupancy"] == 1.0 and dc["queue_age_s"] == 7.5
+    assert dc["free_pages"] == 4 and dc["total_pages"] == 16
+    both = fs.signals(None)
+    assert both["n_alive"] == 2 and both["queued"] == 4
+    assert both["total_slots"] == 4 and both["busy_slots"] == 3
+
+
+# ---------------------------------------------------------------------------
+# controller (in-process, fake spawn)
+# ---------------------------------------------------------------------------
+
+def test_controller_heals_below_floor_then_drains():
+    from paddle_tpu.fleet import FleetController, ScalePolicy, TierSpec
+    stats.reset("fleet/controller")
+    store = native.TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        router = Router(store=store, dead_after=30.0)
+        spawned = []
+
+        def spawn(role, rid):
+            spawned.append((role, rid))
+            return types.SimpleNamespace()
+
+        class Hold(ScalePolicy):
+            def __init__(self):
+                self.delta = 0
+
+            def decide(self, sig, now=None):
+                return self.delta, "forced"
+
+        policy = Hold()
+        ctl = FleetController(
+            router, spawn,
+            tiers=[TierSpec("both", min_replicas=1, max_replicas=2,
+                            policy=policy)],
+            cooldown_s=0.0, drain_grace_s=60.0)
+        # empty fleet: heal up to the floor, exactly once (the pending
+        # spawn counts until it announces — no double-spawn)
+        ctl.step()
+        assert len(spawned) == 1 and spawned[0][0] == "both"
+        ctl.step()
+        assert len(spawned) == 1
+        assert stats.get("fleet/controller_scale_ups") == 1
+        # the spawned replica announces -> alive, pending cleared
+        rid = spawned[0][1]
+        d = ReplicaDirectory(store)
+        d.announce(rid, {"pid": 0})
+        d.heartbeat(rid, load={"role": "both", "busy_slots": 0,
+                               "free_slots": 2, "tokens": 0})
+        out = ctl.step()
+        assert out["both"]["alive"] == 1 and out["both"]["pending"] == 0
+        # a second replica joins; forced scale-down drains ONE victim
+        d.announce("extra", {"pid": 0})
+        d.heartbeat("extra", load={"role": "both", "busy_slots": 1,
+                                   "free_slots": 1, "tokens": 5})
+        ctl.step()
+        policy.delta = -1
+        out = ctl.step()
+        assert out["both"]["action"] == "scale-down"
+        # victim is the emptier replica (rid: 0 busy slots)
+        assert d.state(rid) == "draining"
+        assert stats.get("fleet/controller_scale_downs") == 1
+        # while draining it is not routable and not counted alive
+        assert rid not in router.replicas()
+        policy.delta = 0
+        # the replica acks the drain -> drain-complete
+        d.set_state(rid, "drained")
+        ctl.step()
+        assert stats.get("fleet/controller_drains_completed") == 1
+        assert ctl._draining == {}
+        # flight recorder carries the controller's actions
+        from paddle_tpu.observability import flight
+        evs = [e["event"] for e in flight.events("fleet")]
+        assert ("scale-up" in evs and "drain-start" in evs
+                and "drain-complete" in evs), evs
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# drain protocol, real replica processes
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(store_port: int, rid: str, launch_port: int,
+                   extra_env=None):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1",
+         "--master", f"127.0.0.1:{launch_port}",
+         SERVE_WORKER, str(store_port), rid],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def test_drain_replica_completes_streams_no_new_placements():
+    """The drain acceptance: a draining replica under active streams
+    completes (or redistributes) EVERY request id, never receives a
+    new placement, publishes ``drained``, and its process exits on its
+    own — zero request-id loss, no SIGKILL needed on the happy path."""
+    stats.reset("serve/router")
+    router = Router(port=0, dead_after=15.0)
+    procs = [_spawn_replica(router.store.port, f"rep{i}", 8845 + i)
+             for i in range(2)]
+    try:
+        router.wait_replicas(2, timeout=90)
+        rs = np.random.RandomState(3)
+        ids = [router.submit(list(rs.randint(0, 96, size=9)),
+                             max_new_tokens=24) for _ in range(8)]
+        victim_reqs = [q for q, r in router._assigned.items()
+                       if r == "rep0"]
+        assert victim_reqs, "least-outstanding never placed on rep0?"
+        # drain rep0 while its streams are active
+        router.mark_draining("rep0")
+        post = [router.submit(list(rs.randint(0, 96, size=9)),
+                              max_new_tokens=6) for _ in range(6)]
+        assert all(router._assigned[q] == "rep1" for q in post), \
+            "a draining replica received a new placement"
+        results = router.drain(timeout=120)
+        assert sorted(results) == sorted(ids + post)
+        assert all(r["status"] == "done" for r in results.values())
+        # rep0's in-flight work finished ON rep0 (drain ≠ eviction)
+        assert any(results[q]["replica"] == "rep0"
+                   for q in victim_reqs)
+        # the replica published its drain and exited without shutdown
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (router.directory.state("rep0") == "drained"
+                    and procs[0].poll() is not None):
+                break
+            time.sleep(0.1)
+        assert router.directory.state("rep0") == "drained"
+        assert procs[0].poll() == 0, procs[0].poll()
+    finally:
+        router.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# reshape: launch-driven preemption tolerance (the training half)
+# ---------------------------------------------------------------------------
+
+def test_static_launch_reshape_resumes_resharded(tmp_path):
+    """Kill 2 of 4 workers mid-training under PT_ELASTIC_RESHAPE=1:
+    the launcher relaunches the group at the surviving count,
+    exporting the NEW world size (the env-contract satellite), and the
+    trainer replans its mesh + restore_resharded-resumes from the
+    newest VERIFIED epoch. The whole trajectory is parity-pinned
+    against an uninterrupted single-process reference run."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PT_ELASTIC_RESHAPE="1", ET_DIE_RANKS="2,3",
+               ET_DIE_WORLD="4", ET_DIE_AFTER_EPOCH="1",
+               PT_FLAGS_STATS_AT_EXIT="1")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--max_restarts", "2",
+         "--master", "127.0.0.1:7921", TRAIN_WORKER,
+         str(tmp_path), "6"],
+        env=env, capture_output=True, text=True, timeout=360)
+    assert r.returncode == 0, (r.returncode, r.stderr[-3000:])
+    assert "reshaping local group 4->2" in r.stderr, r.stderr[-2000:]
+    assert "reshaped 4->2 devices" in r.stderr, r.stderr[-2000:]
+
+    log = [json.loads(line) for line in
+           (tmp_path / "loss_log.jsonl").read_text().splitlines()]
+    worlds = [e["world"] for e in log]
+    assert set(worlds) == {4, 2}, worlds
+    v1 = [e for e in log if e["world"] == 4]
+    v2 = [e for e in log if e["world"] == 2]
+    # resumed one past the newest VERIFIED epoch — never from scratch
+    assert v2[0]["epoch"] == v1[-1]["epoch"] + 1 or \
+        v2[0]["epoch"] <= v1[-1]["epoch"]
+    assert max(e["epoch"] for e in log) == 5
+
+    # loss-trajectory parity: an uninterrupted reference run over the
+    # SAME per-epoch data (deterministic synthetic_data) on the final
+    # 2-device topology must match every logged epoch's loss
+    import jax.numpy as jnp
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.fleet import ElasticTrainer, plan_topology
+    from paddle_tpu.fleet.elastic_train import synthetic_data
+    from paddle_tpu.models import gpt
+    cfg = gpt.GPTConfig(vocab_size=128, max_seq_len=16, d_model=32,
+                        n_layers=2, n_heads=2, dtype=jnp.float32)
+    prev_topo = mesh_lib.get_topology()
+    try:
+        ref = ElasticTrainer(
+            gpt.GPT(cfg, seed=0), optim.SGD(learning_rate=0.05),
+            str(tmp_path / "ref_ckpt"), n_epochs=6,
+            mesh=plan_topology(gpt.GPT(cfg, seed=0), n_devices=2),
+            data_fn=synthetic_data(cfg.vocab_size, 12,
+                                   cfg.max_seq_len)).run()
+    finally:
+        mesh_lib.set_topology(prev_topo)
+    by_epoch = {e["epoch"]: e["loss"] for e in log}
+    for rec in ref:
+        assert abs(by_epoch[rec["epoch"]] - rec["loss"]) < 5e-3, (
+            rec, by_epoch)
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill a replica AND a trainer; the fleet self-heals
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_kill_replica_and_trainer_fleet_converges(tmp_path):
+    """The chaos gate (also run as tools/ci.sh elastic): under live
+    traffic a serving replica dies via the testing/faults.py
+    serve.loop kill site — the controller heals the fleet back to the
+    floor and every request id completes; separately a trainer is
+    killed mid-step via the train.step site and the reshape path
+    resumes it at the surviving world size."""
+    from paddle_tpu.fleet import (FleetController, TierSpec,
+                                  launch_spawn)
+    stats.reset("fleet/controller")
+    router = Router(port=0, dead_after=3.0)
+    # replica ctl-both-1 dies after ~150 serve-loop ticks (mid-traffic)
+    spawn = launch_spawn(SERVE_WORKER, router.store.port,
+                         pass_role=False)
+    first = {"env": {"PT_FAULTS": "serve.loop:kill:after=150"}}
+
+    def chaos_spawn(role, rid):
+        env = first.pop("env", None)
+        s = (launch_spawn(SERVE_WORKER, router.store.port,
+                          extra_env=env, pass_role=False)
+             if env else spawn)
+        return s(role, rid)
+
+    ctl = FleetController(
+        router, chaos_spawn,
+        tiers=[TierSpec("both", min_replicas=2, max_replicas=3)],
+        cooldown_s=1.0, drain_grace_s=10.0)
+    try:
+        ctl.step()                      # heal 0 -> 2 (first is doomed)
+        router.wait_replicas(2, timeout=120)
+        rs = np.random.RandomState(5)
+        ids = []
+
+        def feed():
+            if len(ids) < 30:
+                ids.append(router.submit(
+                    list(rs.randint(0, 96, size=8)),
+                    max_new_tokens=12))
+
+        ctl.pump(25.0, interval_s=0.2, extra=feed)
+        results = router.drain(timeout=180)
+        assert set(ids) <= set(results)
+        assert all(results[q]["status"] == "done" for q in ids)
+        # the doomed replica died and was replaced: ≥3 spawns total
+        # (2 heal + ≥1 replacement), and the fleet converged to ≥2
+        assert stats.get("fleet/controller_scale_ups") >= 3
+        assert len(router.replicas()) >= 2
+    finally:
+        router.shutdown()
+        ctl.shutdown()
+        router.close()
+
+    # -- trainer half: faults-killed mid-step, reshape resumes --------
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PT_ELASTIC_RESHAPE="1",
+               PT_FAULTS="train.step:kill:after=3")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--max_restarts", "2",
+         "--master", "127.0.0.1:7927", TRAIN_WORKER,
+         str(tmp_path), "6"],
+        env=env, capture_output=True, text=True, timeout=360)
+    assert r.returncode == 0, (r.returncode, r.stderr[-3000:])
+    log = [json.loads(line) for line in
+           (tmp_path / "loss_log.jsonl").read_text().splitlines()]
+    assert sorted({e["world"] for e in log}) == [3, 4]
+    assert max(e["epoch"] for e in log) == 5

@@ -721,12 +721,32 @@ def test_disagg_prefill_death_reroutes_clean(tmp_path):
     try:
         router.wait_replicas(2, timeout=90)
         rs = np.random.RandomState(12)
+        # wave A: served normally — proves the victim admitted (and
+        # flushed) request-tagged spans before dying, the thing the
+        # periodic flush exists to save
         ids = [router.submit(list(rs.randint(0, 96, size=150)),
-                             max_new_tokens=16) for _ in range(8)]
-        # let the victim admit (and flush) some prefills first — the
-        # SIGKILL-mid-prefill trace is what the flush exists to save
-        time.sleep(1.0)
+                             max_new_tokens=16) for _ in range(6)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            router.poll()
+            if stats.get("serve/router_prefill_handoffs") > 0:
+                break
+            time.sleep(0.02)
+        assert stats.get("serve/router_prefill_handoffs") > 0, \
+            "victim never prefilled anything"
+        time.sleep(0.4)       # one flush period past the admissions
+        # freeze the victim, then land wave B on it while its
+        # heartbeat still looks alive: those requests are GUARANTEED
+        # unfinished at the kill, so the death sweep always has
+        # orphans to redistribute. (The old fixed-sleep kill raced box
+        # speed: a fast victim finished every prefill before the
+        # SIGKILL landed and the sweep had nothing to redistribute.)
         victim_pid = router.directory.members()["pf0"]["pid"]
+        os.kill(victim_pid, signal.SIGSTOP)
+        ids += [router.submit(list(rs.randint(0, 96, size=150)),
+                              max_new_tokens=16) for _ in range(6)]
+        assert any(router._assigned[q] == "pf0" for q in ids), \
+            "no request was ever placed on the prefill replica"
         os.kill(victim_pid, signal.SIGKILL)
         results = router.drain(timeout=180)
         assert sorted(results) == sorted(ids)

@@ -59,6 +59,14 @@
 #                          single-replica serving on the fp32 KV wire,
 #                          fleet prefix-hit counter nonzero on a
 #                          repeated-system-prompt workload (~1 min)
+#   tools/ci.sh elastic    elastic-fleet smoke (~90s): the controller
+#                          spawns a 2-replica fleet under Poisson load,
+#                          a SIGKILLed replica is healed with zero
+#                          request-id loss and an idle drain retires the
+#                          surplus gracefully; then a 4->2 worker
+#                          reshape (PT_ELASTIC_RESHAPE) resumes training
+#                          from the newest VERIFIED epoch on the
+#                          re-planned mesh
 #   tools/ci.sh shard      sharded-stacked smoke: 4-device CPU mesh runs
 #                          the pre-stacked scan-over-layers train step
 #                          under fsdp×tp (loss parity vs per-layer,
@@ -123,6 +131,11 @@ fi
 if [[ "${1:-}" == "fleetobs" ]]; then
     shift
     exec python tools/fleet_obs_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "elastic" ]]; then
+    shift
+    exec python tools/elastic_smoke.py "$@"
 fi
 
 if [[ "${1:-}" == "shard" ]]; then
