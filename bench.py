@@ -16,6 +16,44 @@ import time
 
 import numpy as np
 
+#: bump when row names/semantics change incompatibly — bench_diff
+#: refuses (exit 2) to compare snapshots across schema versions
+BENCH_SCHEMA_VERSION = 1
+
+
+def _provenance(jax) -> dict:
+    """ISSUE 15 regression sentinel: stamp the snapshot with what
+    produced it — schema version, git rev, device fingerprint, the
+    flags-registry snapshot and PT_* env overrides, and the
+    compile-cache health (the r05 RESOURCE_EXHAUSTED that silently
+    killed rows is now a stamped field bench_diff can surface)."""
+    import os
+    import subprocess
+    from paddle_tpu import compile_cache, flags
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or None
+    except Exception:
+        rev = None
+    devs = jax.devices()
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "git_rev": rev,
+        "captured_unix_s": int(time.time()),
+        "device": {
+            "kind": getattr(devs[0], "device_kind", "unknown"),
+            "platform": jax.default_backend(),
+            "n_devices": len(devs),
+        },
+        "flags": flags.get_flags(),
+        "env_overrides": {k: v for k, v in sorted(os.environ.items())
+                          if k.startswith("PT_")},
+        "compile_cache": compile_cache.status(),
+    }
+
 
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
@@ -288,6 +326,12 @@ def main():
         except Exception as e:
             extra[sub.__name__ + "_error"] = str(e)[:120]
         mark(f"{sub.__name__} done")
+
+    try:
+        result["provenance"] = _provenance(jax)
+    except Exception as e:   # provenance must never cost the snapshot
+        result["provenance"] = {"schema_version": BENCH_SCHEMA_VERSION,
+                                "error": str(e)[:120]}
 
     print(json.dumps(result))
     return 0 if result["metric"] != "bench_failed" else 1
@@ -751,7 +795,8 @@ def bench_decode(jax, jnp, peak, smoke=False):
 
     def _time_engine(e, prompt_lens=None):
         """Warm (compiles + prefill), then time a drain of n_new2 tokens
-        per slot — admissions excluded. Returns (tok/s, dispatches)."""
+        per slot — admissions excluded. Returns (tok/s, dispatches,
+        tokens, wall_s)."""
         rs = np.random.RandomState(1)
         lens = prompt_lens or [s_pf] * slots
         prompts = [rs.randint(0, cfg.vocab_size, n) for n in lens]
@@ -766,11 +811,32 @@ def bench_decode(jax, jnp, peak, smoke=False):
         e.run()
         dt = time.perf_counter() - t0
         toks = sum(len(r.tokens) for r in reqs) - pre
-        return toks / dt, e.steps - d0
+        return toks / dt, e.steps - d0, toks, dt
+
+    def _prof_rows(e, key, tps, disp, toks, wall):
+        """ISSUE 15 device-time attribution per engine row: AOT
+        cost-analysis roofline + launch tax. Own try/except — the
+        timed row must survive a profiler failure."""
+        try:
+            from paddle_tpu.observability import devprof
+            cap = e.dispatch_cost(name=key)
+            aroof = devprof.roofline_tokens_per_sec(
+                cap, toks / max(1, disp))
+            res[f"{key}_flops_per_dispatch"] = cap.flops
+            res[f"{key}_hbm_bytes_per_dispatch"] = cap.hbm_bytes
+            if aroof > 0:
+                res[f"{key}_roofline_frac"] = round(
+                    devprof.record_roofline(key, tps, aroof), 4)
+            res[f"{key}_launch_tax_frac"] = round(
+                devprof.launch_tax_fraction(disp, wall, name=key), 4)
+            res[f"{key}_launches_per_token"] = round(
+                disp / max(1, toks), 4)
+        except Exception as ex:
+            res[f"{key}_prof_error"] = str(ex)[:120]
 
     try:
       if eng is not None and "engine" in sections:
-        tps, disp = _time_engine(eng)
+        tps, disp, toks, wall = _time_engine(eng)
         hbm = _hbm_gbps(jax.devices()[0])
         roof = decode_roofline_tokens_per_sec(
             cfg, slots, s_pf + n_new2 // 2, hbm)
@@ -778,6 +844,7 @@ def bench_decode(jax, jnp, peak, smoke=False):
         res["decode_engine_dispatches"] = disp  # timed run only
         res["decode_engine_vs_roofline"] = round(tps / roof, 4)
         res["decode_roofline_tokens_per_sec"] = round(roof, 1)
+        _prof_rows(eng, "decode_engine", tps, disp, toks, wall)
     except Exception as e:
         res["decode_engine_error"] = str(e)[:160]
 
@@ -793,7 +860,7 @@ def bench_decode(jax, jnp, peak, smoke=False):
         lens_lc = [128 if i % 2 == 0 else 896 for i in range(slots)]
         engL = DecodeEngine(None, max_slots=slots, max_len=1024,
                             steps_per_call=64, share_weights_with=donor)
-        tps, _ = _time_engine(engL, prompt_lens=lens_lc)
+        tps, _, _, _ = _time_engine(engL, prompt_lens=lens_lc)
         ctx_mean = sum(lens_lc) / slots + n_new2 // 2
         roof_lc = decode_roofline_tokens_per_sec(
             cfg, slots, ctx_mean, _hbm_gbps(jax.devices()[0]))
@@ -817,13 +884,14 @@ def bench_decode(jax, jnp, peak, smoke=False):
             None, n_pages=slots * ((s_pf + n_new2) // 128 + 1) + 2,
             max_slots=slots, steps_per_call=64,
             share_weights_with=(eng if eng is not None else eng2))
-        tps, _ = _time_engine(engP)
+        tps, disp, toks, wall = _time_engine(engP)
         res["decode_engine_paged_tokens_per_sec"] = round(tps, 1)
         if roof is None:
             roof = decode_roofline_tokens_per_sec(
                 cfg, slots, s_pf + n_new2 // 2,
                 _hbm_gbps(jax.devices()[0]))
         res["decode_engine_paged_vs_roofline"] = round(tps / roof, 4)
+        _prof_rows(engP, "decode_engine_paged", tps, disp, toks, wall)
         engP.kp = engP.vp = None
         del engP
     except Exception as e:
@@ -918,7 +986,7 @@ def bench_decode(jax, jnp, peak, smoke=False):
                             weight_dtype="int8")
         del eng
         eng = None
-        tps, _ = _time_engine(eng8)
+        tps, _, _, _ = _time_engine(eng8)
         if roof is None:
             roof = decode_roofline_tokens_per_sec(
                 cfg, slots, s_pf + n_new2 // 2,

@@ -7,6 +7,12 @@ docs/observability.md contract table is generated from. Undeclared reads
 are how knobs like ``PT_SERVE_INFLIGHT`` silently fork from their
 documentation.
 
+Tool namespaces registered with ``declare_tool_prefix`` (``PD_``,
+``FLEETOBS_``) are checked the same way: a ``PD_*`` read anywhere in
+the linted tree (``tools/`` is linted alongside the package) needs its
+own ``declare_env`` row. Names outside the registered namespaces
+(``HOME``, ``JAX_*``) stay out of contract.
+
 The declared set is parsed from the AST of the ``flags.py`` found in the
 linted tree (falling back to ``<root>/paddle_tpu/flags.py`` when linting
 a subtree), never imported — the linter stays jax-free.
@@ -20,24 +26,25 @@ from typing import Optional, Set, Tuple
 from paddle_tpu.analysis import callgraph
 from paddle_tpu.analysis.engine import Rule
 
-_PT_NAME_RE = re.compile(r"^PT_[A-Z0-9_]*$")
+_ENV_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
 
 
-def _declared_from_tree(tree) -> Tuple[Set[str], Set[str]]:
+def _declared_from_tree(tree) -> Tuple[Set[str], Set[str], Set[str]]:
     names: Set[str] = set()
     prefixes: Set[str] = set()
+    tool_prefixes: Set[str] = set()
+    target = {"declare_env": names, "declare_env_prefix": prefixes,
+              "declare_tool_prefix": tool_prefixes}
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         fname = callgraph.terminal_name(node.func)
-        if fname not in ("declare_env", "declare_env_prefix"):
+        if fname not in target:
             continue
         if node.args and isinstance(node.args[0], ast.Constant) \
                 and isinstance(node.args[0].value, str):
-            val = node.args[0].value
-            (prefixes if fname == "declare_env_prefix"
-             else names).add(val)
-    return names, prefixes
+            target[fname].add(node.args[0].value)
+    return names, prefixes, tool_prefixes
 
 
 def _env_name_of(node, ctx) -> Optional[Tuple[str, ast.AST]]:
@@ -47,7 +54,7 @@ def _env_name_of(node, ctx) -> Optional[Tuple[str, ast.AST]]:
     if isinstance(node, ast.Subscript):
         key = node.slice
         if (isinstance(key, ast.Constant) and isinstance(key.value, str)
-                and _PT_NAME_RE.match(key.value)
+                and _ENV_NAME_RE.match(key.value)
                 and _looks_env(node.value, ctx)):
             return key.value, node
         return None
@@ -56,7 +63,7 @@ def _env_name_of(node, ctx) -> Optional[Tuple[str, ast.AST]]:
         arg0 = node.args[0]
         if not (isinstance(arg0, ast.Constant)
                 and isinstance(arg0.value, str)
-                and _PT_NAME_RE.match(arg0.value)):
+                and _ENV_NAME_RE.match(arg0.value)):
             return None
         if isinstance(node.func, ast.Attribute):
             if node.func.attr == "getenv":
@@ -91,35 +98,46 @@ class EnvContractRule(Rule):
             return cached
         names: Set[str] = set(self.extra_declared)
         prefixes: Set[str] = set()
+        tools: Set[str] = set()
         found = False
         for f in project.files:
             if os.path.basename(f.relpath) == "flags.py":
-                n, p = _declared_from_tree(f.tree)
-                if n or p:
+                n, p, t = _declared_from_tree(f.tree)
+                if n or p or t:
                     found = True
                 names |= n
                 prefixes |= p
+                tools |= t
         if not found:
             # linting a subtree: pull the package registry off disk
             cand = os.path.join(project.root, "paddle_tpu", "flags.py")
             if os.path.exists(cand):
                 try:
                     with open(cand, "r", encoding="utf-8") as fh:
-                        n, p = _declared_from_tree(ast.parse(fh.read()))
+                        n, p, t = _declared_from_tree(
+                            ast.parse(fh.read()))
                     names |= n
                     prefixes |= p
+                    tools |= t
                 except (SyntaxError, OSError):
                     pass
+        # (names, prefixes) tuple shape is public-ish (tests unpack it);
+        # the checked tool namespaces cache separately
         project._pt005_declared = (names, prefixes)
+        project._pt005_tool_prefixes = tools
         return names, prefixes
 
     def check(self, ctx, project):
         names, prefixes = self._declared(project)
+        tools = getattr(project, "_pt005_tool_prefixes", set())
         for node in ast.walk(ctx.tree):
             hit = _env_name_of(node, ctx)
             if hit is None:
                 continue
             var, anchor = hit
+            if not (var.startswith("PT_")
+                    or any(var.startswith(t) for t in tools)):
+                continue   # outside every contract namespace
             if var in names or any(var.startswith(p) for p in prefixes):
                 continue
             yield self.finding(

@@ -8,14 +8,19 @@ but a serving process then prints one warning line per flaky entry
 healthy one. This module:
 
 - ``guard()`` — routes jax's per-entry compilation-cache failure
-  warnings into the stats registry (``serve/compile_cache_errors``),
-  printing only the FIRST occurrence; every other warning passes
-  through untouched. Installed idempotently by both decode engines at
-  construction.
+  warnings into the stats registry (``serve/compile_cache_errors``,
+  plus a per-exception-class counter and the
+  ``prof/compile_cache_disabled`` gauge), printing only the FIRST
+  occurrence; every other warning passes through untouched. Installed
+  idempotently by both decode engines at construction.
 - ``enable(cache_dir)`` — points jax at a persistent cache dir with a
   fallback: a missing config knob (older jax) or a broken dir counts
   into the same counter and returns False instead of raising — cold
   compiles are a slowdown, not an outage.
+- ``status()`` — {"disabled", "errors", "last_error_class"} for bench
+  provenance: the r05 RESOURCE_EXHAUSTED that silently killed the
+  bert/resnet/ppyoloe rows is now a stamped field on every BENCH
+  snapshot and a /statsz gauge, not a line lost in stderr.
 
 docs/serving.md documents the operator contract.
 """
@@ -25,14 +30,43 @@ import re
 import threading
 import warnings
 
-__all__ = ["guard", "enable"]
+__all__ = ["guard", "enable", "status"]
 
 # matches jax's "Error reading persistent compilation cache entry ..."
 # and "Error writing persistent compilation cache entry ..." warnings
 _MATCH = re.compile(r"persistent compilation cache", re.IGNORECASE)
+# the exception class jax embeds in the warning text ("...: JaxRuntimeError:
+# RESOURCE_EXHAUSTED: ..."); the class name is the triage key (a flaky
+# read vs a full disk vs a permission wall are different runbooks)
+_EXC_CLASS = re.compile(r"\b([A-Za-z_][A-Za-z0-9_]*(?:Error|Exception))\b")
 _lock = threading.Lock()
 _hook = None
 _printed = False
+_last_exc_class = None
+
+
+def _record_failure(exc_class: str):
+    """Count one cache failure: total + per-class counters, and latch
+    the ``prof/compile_cache_disabled`` gauge (the cache is degraded —
+    compiles fall back to cold — until an operator intervenes)."""
+    global _last_exc_class
+    from paddle_tpu import stats
+    _last_exc_class = exc_class
+    stats.add("serve/compile_cache_errors")
+    stats.add(f"serve/compile_cache_errors/{exc_class}")
+    stats.set_value("prof/compile_cache_disabled", 1.0)
+
+
+def status() -> dict:
+    """Provenance view of the cache's health this process: whether any
+    failure latched the disabled gauge, the total error count, and the
+    most recent exception class (None when healthy)."""
+    from paddle_tpu import stats
+    return {
+        "disabled": bool(stats.get("prof/compile_cache_disabled", 0)),
+        "errors": int(stats.get("serve/compile_cache_errors", 0)),
+        "last_error_class": _last_exc_class,
+    }
 
 
 def guard() -> None:
@@ -57,9 +91,10 @@ def guard() -> None:
         def _showwarning(message, category, filename, lineno,
                          file=None, line=None):
             global _printed
-            if _MATCH.search(str(message)):
-                from paddle_tpu import stats
-                stats.add("serve/compile_cache_errors")
+            text = str(message)
+            if _MATCH.search(text):
+                m = _EXC_CLASS.search(text)
+                _record_failure(m.group(1) if m else "unknown")
                 if _printed:
                     return
                 _printed = True
@@ -85,8 +120,7 @@ def enable(cache_dir, min_compile_secs: float = 1.0) -> bool:
                           float(min_compile_secs))
         return True
     except Exception as e:  # older jax without the knob / unusable dir
-        from paddle_tpu import stats
-        stats.add("serve/compile_cache_errors")
+        _record_failure(type(e).__name__)
         warnings.warn(f"compile cache unavailable ({e}); continuing "
                       f"with cold compiles")
         return False

@@ -37,13 +37,35 @@ class EnvVar:
 
 _ENV_REGISTRY: Dict[str, EnvVar] = {}
 _ENV_PREFIXES: Dict[str, EnvVar] = {}
+_TOOL_PREFIXES: Dict[str, EnvVar] = {}
+
+
+def declare_tool_prefix(prefix: str, help: str, owner: str = "") -> None:
+    """Bring a TOOL env namespace (e.g. ``PD_`` for profile_decode
+    report knobs) under the contract. Unlike ``declare_env_prefix``
+    this does NOT declare every name in the namespace — it widens the
+    checked set: once ``PD_`` is registered, ptlint PT005 flags any
+    ``PD_*`` read (in paddle_tpu/ *and* tools/) that lacks its own
+    ``declare_env`` entry, exactly like a ``PT_*`` read would be."""
+    if not prefix.endswith("_"):
+        raise ValueError(f"tool prefix must end with '_', got {prefix!r}")
+    _TOOL_PREFIXES[prefix] = EnvVar(prefix + "*", help, None, owner)
+
+
+def _in_contract_namespace(name: str) -> bool:
+    return name.startswith("PT_") or any(
+        name.startswith(p) for p in _TOOL_PREFIXES)
 
 
 def declare_env(name: str, help: str, default: Optional[str] = None,
                 owner: str = "") -> None:
-    """Register one PT_* environment variable in the contract."""
-    if not name.startswith("PT_"):
-        raise ValueError(f"env contract covers PT_* names, got {name!r}")
+    """Register one environment variable in the contract: a ``PT_*``
+    name, or a tool name under a ``declare_tool_prefix`` namespace
+    (register the prefix first)."""
+    if not _in_contract_namespace(name):
+        raise ValueError(
+            f"env contract covers PT_* and registered tool-prefix "
+            f"names ({sorted(_TOOL_PREFIXES)}), got {name!r}")
     _ENV_REGISTRY[name] = EnvVar(name, help, default, owner)
 
 
@@ -58,6 +80,11 @@ def env_registry() -> Dict[str, EnvVar]:
     out = dict(_ENV_REGISTRY)
     out.update({k + "*": v for k, v in _ENV_PREFIXES.items()})
     return out
+
+
+def tool_prefix_registry() -> Dict[str, EnvVar]:
+    """The registered tool env namespaces (``declare_tool_prefix``)."""
+    return dict(_TOOL_PREFIXES)
 
 
 def env_declared(name: str) -> bool:
@@ -285,6 +312,17 @@ declare_env("PT_SLO_QUEUE_AGE_S", "Runaway-queue detector threshold: "
             "a replica whose oldest waiting request exceeds this age "
             "raises fleet/alert_queue_age.", default="30",
             owner="observability/fleet.py")
+declare_env("PT_PROF_PEAK_FLOPS", "Device-profiler roofline override: "
+            "peak FLOP/s the prof/roofline_frac denominator uses "
+            "instead of the detected per-generation table entry.",
+            owner="observability/devprof.py")
+declare_env("PT_PROF_PEAK_HBM_GBPS", "Device-profiler roofline "
+            "override: peak HBM bandwidth in GB/s (detected table "
+            "entry otherwise).", owner="observability/devprof.py")
+declare_env("PT_PROF_LAUNCH_ITERS", "No-op launches timed by the "
+            "once-per-process launch-tax calibration (the median is "
+            "the per-dispatch overhead estimate).", default="64",
+            owner="observability/devprof.py")
 
 # -- serving --
 declare_env("PT_SERVE_INFLIGHT", "Decode-engine pipeline depth: how many "
@@ -365,6 +403,30 @@ declare_env("PT_COMM_STRIPE", "Link striping for large bucket payloads: "
             "launched concurrently; a float in (0,1) forces that DCN "
             "fraction.", default="0", owner="distributed/overlap.py")
 
+# -- bench / probe drivers (bench.py + tools/probe_bench.py) --
+declare_env("PT_DEVICE_TIMEOUT_S", "bench.py device-acquisition "
+            "watchdog: a wedged tunnel emits a bench_failed JSON line "
+            "after this long instead of hanging the driver.",
+            default="900", owner="bench.py")
+declare_env("PT_BENCH_BUDGET_S", "bench.py wall budget: sub-benches "
+            "past it are skipped with <name>_skipped rows (headline "
+            "metric secured first).", default="7200", owner="bench.py")
+declare_env("PT_BENCH_ONLY", "Comma-set of sub-benches to re-capture "
+            "(e.g. bert,decode) without paying the flagship compile.",
+            owner="bench.py")
+declare_env("PT_DECODE_SECTIONS", "Comma-set of bench_decode sections "
+            "(generate,int8,engine,engine_longctx,engine_paged,"
+            "engine_paged_prefix,engine_int8,spec).", owner="bench.py")
+declare_env("PT_PROBE_TIMEOUT_S", "Opportunistic-capture prober: "
+            "per-probe subprocess kill timeout.", default="150",
+            owner="tools/probe_bench.py")
+declare_env("PT_PROBE_INTERVAL_S", "Prober poll interval while the "
+            "device tunnel is down.", default="1200",
+            owner="tools/probe_bench.py")
+declare_env("PT_REBENCH_INTERVAL_S", "Prober re-bench cadence while "
+            "the tunnel stays up (full rows refresh this often).",
+            default="4800", owner="tools/probe_bench.py")
+
 # -- compilation / data / testing --
 declare_env("PT_COMPILE_CACHE_GUARD", "0 disables the persistent-compile-"
             "cache failure guard (compile_cache.guard).", default="1",
@@ -380,3 +442,38 @@ declare_env("PT_FAULTS", "Fault-injection plan: ';'-separated "
             owner="testing/faults.py")
 declare_env_prefix("PT_FLAGS_", "Per-flag override of any define_flag "
                    "entry, e.g. PT_FLAGS_SCAN_LAYERS=0.", owner="flags.py")
+
+# ---------------------------------------------------------------------------
+# Tool env namespaces (ISSUE 15 satellite): report/smoke knobs the
+# tools/ scripts read. declare_tool_prefix brings the NAMESPACE under
+# the PT005 contract (tools/ is linted like paddle_tpu/ is); each knob
+# still needs its own declare_env row below.
+# ---------------------------------------------------------------------------
+declare_tool_prefix("PD_", "profile_decode.py report knobs.",
+                    owner="tools/profile_decode.py")
+declare_tool_prefix("FLEETOBS_", "fleet-observability smoke/test "
+                    "worker handshake.", owner="tests/_fleetobs.py")
+
+declare_env("PD_SIZE", "profile_decode model size: 1p3b (default), "
+            "350m, or tiny (the CPU smoke).", default="1p3b",
+            owner="tools/profile_decode.py")
+declare_env("PD_SECTIONS", "Comma-set of profile_decode report "
+            "sections: engine, paged, prof.", default="engine,paged",
+            owner="tools/profile_decode.py")
+declare_env("PD_INFLIGHT", "Comma-list of pipeline depths to sweep "
+            "(e.g. 1,2,4); unset uses the engine default.",
+            owner="tools/profile_decode.py")
+declare_env("PD_SPEC", "1 adds the chunked speculative run on "
+            "repetitive prompts to the engine section.", default="0",
+            owner="tools/profile_decode.py")
+declare_env("PD_PREFIX", "1 adds the repeated-system-prompt cold/warm "
+            "radix-cache sweep (the ci.sh paged gate).", default="0",
+            owner="tools/profile_decode.py")
+declare_env("PD_LENGTHS", "Comma-list of prompt lengths the prof "
+            "section sweeps per decode path (default by model size; "
+            ">=3 lengths make the launch-tax-vs-length curve).",
+            owner="tools/profile_decode.py")
+declare_env("FLEETOBS_TRACE_FILE", "Per-replica trace path handed to "
+            "launch-spawned fleet workers; translated to PT_TRACE_FILE "
+            "at worker startup so the launcher's own atexit export "
+            "cannot clobber replica traces.", owner="tests/_fleetobs.py")

@@ -1388,6 +1388,28 @@ class DecodeEngine(ResilientScheduler):
     def num_active(self) -> int:
         return sum(r is not None for r in self._slot_req)
 
+    def dispatch_cost(self, name=None):
+        """ISSUE 15 roofline capture: AOT cost/memory analysis of ONE
+        decode dispatch at the CURRENT geometry — XLA's FLOPs and HBM
+        bytes for the exact program the serving loop launches (the
+        spec-verify program when ``speculative_k`` is set). Lowers
+        without executing, so donated buffers stay live; compilation
+        rides the jit cache on a warmed engine. Records ``prof/flops``
+        / ``prof/hbm_bytes`` / ``mem/compiled_*`` under ``name``
+        (default: the path name)."""
+        from paddle_tpu.observability import devprof
+        if self.spec_k:
+            return devprof.capture_jit(
+                self._verify_fn, self._head, self._stacked, self.kc,
+                self.vc, self.toks, self.lengths, self.last,
+                self.active, self.remaining, self.eos_ids,
+                self._poison_mask(), name=name or "spec")
+        return devprof.capture_jit(
+            self._multi_fn, self._head, self._stacked, self.kc,
+            self.vc, self.lengths, self.last, self.active,
+            self.remaining, self.eos_ids, self._rng,
+            self._poison_mask(), name=name or "decode")
+
 
 def decode_roofline_tokens_per_sec(cfg, batch: int, context: int,
                                    hbm_gbps: float,

@@ -1405,3 +1405,15 @@ class PagedDecodeEngine(ResilientScheduler):
         while self._waiting or any(r is not None for r in self._slot_req):
             self.step()
         self._drain()   # trailing no-op dispatches (see DecodeEngine.run)
+
+    def dispatch_cost(self, name=None):
+        """ISSUE 15 roofline capture for the paged path: AOT
+        cost/memory analysis of one paged decode dispatch (fused
+        append+attend when PT_PAGED_FUSED) at the current pool/table
+        geometry. See DecodeEngine.dispatch_cost."""
+        from paddle_tpu.observability import devprof
+        return devprof.capture_jit(
+            self._multi_fn, self._head, self._stacked, self.kp,
+            self.vp, self._table(), self.lengths, self.last,
+            self.active, self.remaining, self.eos_ids,
+            self._poison_mask(), name=name or "paged")

@@ -42,6 +42,44 @@ def test_cache_warnings_counted_and_printed_once(fresh_guard):
     assert stats.get("serve/compile_cache_errors") == 3
 
 
+def test_failure_records_class_and_disabled_gauge(fresh_guard):
+    """ISSUE 15 satellite: a cache failure is triageable from /statsz —
+    per-exception-class counter, the prof/compile_cache_disabled gauge
+    latched, and status() carries the class for bench provenance."""
+    stats.reset("serve/compile_cache_errors")
+    stats.reset("prof/compile_cache_disabled")
+    warnings.warn("Error reading persistent compilation cache entry "
+                  "for 'jit_x': JaxRuntimeError: RESOURCE_EXHAUSTED: "
+                  "TPU backend error (ResourceExhausted).")
+    assert stats.get("serve/compile_cache_errors") == 1
+    assert stats.get(
+        "serve/compile_cache_errors/JaxRuntimeError") == 1
+    assert stats.get("prof/compile_cache_disabled") == 1.0
+    st = compile_cache.status()
+    assert st["disabled"] and st["errors"] == 1
+    assert st["last_error_class"] == "JaxRuntimeError"
+    # a classless message still counts, under "unknown"
+    warnings.warn("Error writing persistent compilation cache entry "
+                  "for 'jit_y': disk full")
+    assert stats.get("serve/compile_cache_errors/unknown") == 1
+    assert compile_cache.status()["errors"] == 2
+
+
+def test_enable_failure_latches_gauge(fresh_guard, monkeypatch):
+    import jax
+    stats.reset("serve/compile_cache_errors")
+    stats.reset("prof/compile_cache_disabled")
+
+    def boom(*a, **k):
+        raise RuntimeError("cache backend unavailable")
+
+    monkeypatch.setattr(jax.config, "update", boom)
+    assert compile_cache.enable("/nonexistent/cache/dir") is False
+    assert stats.get("serve/compile_cache_errors/RuntimeError") == 1
+    assert stats.get("prof/compile_cache_disabled") == 1.0
+    assert compile_cache.status()["last_error_class"] == "RuntimeError"
+
+
 def test_guard_is_idempotent(fresh_guard):
     hook = warnings.showwarning
     compile_cache.guard()

@@ -275,6 +275,52 @@ def test_statsz_server_serves_live_snapshot():
         stop_statsz()
 
 
+def test_metricsz_prometheus_exposition_valid():
+    """ISSUE 15 satellite: /metricsz serves Prometheus text exposition
+    (0.0.4) of the live registry — every line a TYPE comment or a
+    ``name[{labels}] value`` sample, counters suffixed _total,
+    histograms as summaries with quantile samples."""
+    import re
+    from paddle_tpu.observability import StatszServer
+    stats.add("promz/hits", 2)
+    stats.set_value("promz/depth", 1.5)
+    for v in (0.1, 0.2, 0.4):
+        stats.observe("promz/lat_s", v)
+    with stats.default_registry().timer("promz/phase"):
+        pass
+    srv = StatszServer(0, host="127.0.0.1")
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metricsz"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+    finally:
+        srv.stop()
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"\})?'
+        r' (NaN|[+-]Inf|-?[0-9][0-9.e+-]*)$')
+    meta = re.compile(r'^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* '
+                      r'(counter|gauge|summary|histogram)$')
+    lines = body.strip().splitlines()
+    assert lines, "empty exposition"
+    for ln in lines:
+        assert sample.match(ln) or meta.match(ln), f"invalid line: {ln}"
+    assert "# TYPE pt_promz_hits_total counter" in lines
+    assert "pt_promz_hits_total 2.0" in lines
+    assert "# TYPE pt_promz_depth gauge" in lines
+    assert 'pt_promz_lat_s{quantile="0.5"}' in body
+    assert "pt_promz_lat_s_count 3.0" in lines
+    assert "pt_promz_phase_seconds_count 1.0" in lines
+    # a declared TYPE precedes every sample of its metric
+    typed = {ln.split()[2] for ln in lines if ln.startswith("# TYPE")}
+    for ln in lines:
+        if not ln.startswith("#"):
+            name = ln.split("{")[0].split(" ")[0]
+            base_ = re.sub(r"_(total|sum|count)$", "", name)
+            assert name in typed or base_ in typed, ln
+
+
 # -- trace merging ------------------------------------------------------------
 
 def _fake_rank_trace(tmp_path, rank, names):

@@ -377,6 +377,43 @@ def test_env_declared_agrees_with_linter(repo_findings):
     assert not flags.env_declared("PT_NOT_IN_THE_CONTRACT")
 
 
+def test_pt005_tool_prefix_namespace(tmp_path):
+    """declare_tool_prefix brings a tool namespace under contract: an
+    undeclared PD_* read is flagged, a declared one passes, and names
+    under UNregistered prefixes stay out of contract."""
+    findings = _lint(tmp_path, {
+        "flags.py": FLAGS_SRC + """
+def declare_tool_prefix(prefix, help="", owner=""):
+    pass
+
+declare_tool_prefix("PD_", "profile_decode knobs")
+declare_env("PD_SIZE", "model size")
+""",
+        "tool.py": """
+import os
+
+def knobs():
+    a = os.environ.get("PD_SIZE", "tiny")    # declared: clean
+    b = os.environ.get("PD_SECRET_KNOB")     # in-namespace, undeclared
+    c = os.getenv("FLEETOBS_ANY")            # namespace not registered
+    d = os.environ.get("HOME")               # out of contract
+    return a, b, c, d
+"""})
+    hits = [f for f in findings if f.rule == "PT005"]
+    assert len(hits) == 1 and "PD_SECRET_KNOB" in hits[0].message
+
+
+def test_pt005_tools_tree_registry_complete():
+    """tools/ is linted under the same contract (ci.sh lints
+    paddle_tpu AND tools): every PD_*/FLEETOBS_*/PT_* read there must
+    be declared — exercises the subtree fallback that pulls the
+    registry off paddle_tpu/flags.py."""
+    rules = [r for r in default_rules() if r.id == "PT005"]
+    project = load_project([os.path.join(REPO, "tools")], root=REPO)
+    findings = run(project, rules)
+    assert [f for f in findings if f.rule == "PT005"] == []
+
+
 # -- baseline round-trip -----------------------------------------------------
 
 def test_baseline_roundtrip(tmp_path):

@@ -67,6 +67,18 @@
 #                          reshape (PT_ELASTIC_RESHAPE) resumes training
 #                          from the newest VERIFIED epoch on the
 #                          re-planned mesh
+#   tools/ci.sh benchdiff  bench regression sentinel: the checked-in
+#                          BENCH_r05.json snapshot must self-diff
+#                          clean and bench_diff's synthetic 20% tok/s
+#                          regression must be caught by row name
+#                          (seconds; also part of the default gate)
+#   tools/ci.sh prof       device-time-attribution smoke (~1 min):
+#                          tiny-model CPU prompt-length sweep through
+#                          tools/profile_decode.py PD_SECTIONS=prof —
+#                          roofline capture must produce nonzero
+#                          flops/bytes per dispatch, the launch-tax
+#                          fraction must land in (0,1], and the
+#                          benchdiff sentinel must round-trip clean
 #   tools/ci.sh shard      sharded-stacked smoke: 4-device CPU mesh runs
 #                          the pre-stacked scan-over-layers train step
 #                          under fsdp×tp (loss parity vs per-layer,
@@ -81,7 +93,7 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
 if [[ "${1:-}" == "lint" ]]; then
     shift
-    exec python tools/ptlint.py paddle_tpu --error-on-new "$@"
+    exec python tools/ptlint.py paddle_tpu tools --error-on-new "$@"
 fi
 
 if [[ "${1:-}" == "faults" ]]; then
@@ -138,6 +150,19 @@ if [[ "${1:-}" == "elastic" ]]; then
     exec python tools/elastic_smoke.py "$@"
 fi
 
+if [[ "${1:-}" == "benchdiff" ]]; then
+    shift
+    python tools/bench_diff.py BENCH_r05.json BENCH_r05.json "$@"
+    exec python tools/bench_diff.py --selftest BENCH_r05.json
+fi
+
+if [[ "${1:-}" == "prof" ]]; then
+    shift
+    PD_SIZE=tiny PD_SECTIONS=prof python tools/profile_decode.py "$@"
+    python tools/bench_diff.py BENCH_r05.json BENCH_r05.json
+    exec python tools/bench_diff.py --selftest BENCH_r05.json
+fi
+
 if [[ "${1:-}" == "shard" ]]; then
     shift
     # the acceptance topology: a 4-device host-platform mesh (the tests
@@ -150,5 +175,10 @@ fi
 
 # lint gate runs BEFORE the test shards: a host-sync or env-contract
 # regression fails in seconds, not after a 30-minute suite
-python tools/ptlint.py paddle_tpu --error-on-new
+python tools/ptlint.py paddle_tpu tools --error-on-new
+# bench regression sentinel (ISSUE 15): the checked-in baseline
+# snapshot must self-diff clean and the synthetic-regression detector
+# must fire — seconds, and it guards every future BENCH comparison
+python tools/bench_diff.py BENCH_r05.json BENCH_r05.json
+python tools/bench_diff.py --selftest BENCH_r05.json
 python -m pytest tests/ -q --durations=15 "$@"

@@ -3,10 +3,13 @@
 Times the full continuous-batching engine loop against the HBM roofline
 across (slots, cache length, chunk) points — the knobs that matter for
 serving. PD_SIZE=350m for a smaller model; PD_SPEC=1 adds a chunked
-speculative run on repetitive prompts; PD_SECTIONS=engine,paged picks
-report sections; PD_PREFIX=1 adds the repeated-system-prompt sweep
-(cold vs warm radix-cache admission, asserted — the `tools/ci.sh
-paged` smoke gate).
+speculative run on repetitive prompts; PD_SECTIONS=engine,paged,prof
+picks report sections; PD_PREFIX=1 adds the repeated-system-prompt
+sweep (cold vs warm radix-cache admission, asserted — the `tools/ci.sh
+paged` smoke gate); PD_SECTIONS=prof runs the ISSUE 15 device-time
+attribution sweep (roofline fraction, launch tax, step decomposition
+per decode path across PD_LENGTHS prompt lengths — the `tools/ci.sh
+prof` gate).
 
 Measurement notes learned the hard way (r5):
 - On the tunneled PJRT backend ``jax.block_until_ready`` does NOT block;
@@ -234,6 +237,112 @@ def prefix_sweep(model, slots, shared_len, tail_len, n_new, chunk):
     del eng
 
 
+def _prof_run(eng, prompts, n_new):
+    """One timed drain for the prof section: warm on trie-disjoint
+    prompts of the same lengths, then measure tokens / wall /
+    dispatch-launch count / step decomposition over the timed window
+    (stats + trace ring reset at its start)."""
+    from paddle_tpu import stats
+    from paddle_tpu.observability import devprof, trace
+    rs = np.random.RandomState(99)
+    for p in prompts:
+        eng.submit(list(rs.randint(0, eng.cfg.vocab_size, len(p))),
+                   max_new_tokens=2)
+    eng.run()
+    stats.reset("serve/")
+    trace.clear(capacity=65536)
+    trace.enable()
+    reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+    t0 = time.perf_counter()
+    eng.run()
+    wall = time.perf_counter() - t0
+    toks = sum(len(r.tokens) for r in reqs)
+    launches = int(stats.get("serve/dispatch_launches", 0))
+    frac = devprof.step_fractions()
+    trace.disable()
+    trace.clear()
+    return toks, wall, launches, frac
+
+
+def prof_section(model, size):
+    """ISSUE 15 tentpole report: device-time attribution per decode
+    path (contiguous + paged) across a prompt-length sweep. Each row
+    prints measured tok/s vs the AOT cost-analysis roofline tok/s, the
+    roofline fraction, dispatch launches per token, and the launch-tax
+    fraction of token time — the 'one-pallas-launch-per-layer at short
+    lengths' hypothesis as a number. PD_LENGTHS overrides the sweep
+    (>=3 lengths keep the tax-vs-length curve readable). The asserts
+    are the `tools/ci.sh prof` smoke gate."""
+    from paddle_tpu.observability import devprof
+    from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+    cfg = model.cfg
+    tiny = size == "tiny"
+    default = "32,64,128" if tiny else "128,512,1024"
+    lengths = [int(x) for x in os.environ.get(
+        "PD_LENGTHS", default).split(",") if x.strip()]
+    slots, n_new = (4, 16) if tiny else (8, 64)
+    chunk = 4 if tiny else 32
+    page = 128
+    tax = devprof.launch_tax_s()
+    ptax = devprof.pallas_launch_tax_s()
+    line = f"launch tax: jit no-op {tax * 1e6:.0f}us/dispatch"
+    if ptax is not None:
+        line += (f", pallas no-op {ptax * 1e6:.0f}us/launch "
+                 f"(x{cfg.n_layers} layers/dispatch on the fused "
+                 f"paged path)")
+    print(line, flush=True)
+    rs = np.random.RandomState(13)
+    donor = None
+    for path in ("contiguous", "paged"):
+        for s_pf in lengths:
+            if path == "contiguous":
+                eng = DecodeEngine(
+                    model if donor is None else None, max_slots=slots,
+                    max_len=s_pf + n_new, steps_per_call=chunk,
+                    share_weights_with=donor)
+                if donor is None:
+                    donor = eng
+            else:
+                n_pages = slots * ((s_pf + n_new + page - 1) // page
+                                   + 1) + 4
+                eng = PagedDecodeEngine(
+                    None, n_pages=n_pages, max_slots=slots,
+                    page_size=page, steps_per_call=chunk,
+                    share_weights_with=donor)
+            prompts = [list(rs.randint(0, cfg.vocab_size, s_pf))
+                       for _ in range(slots)]
+            toks, wall, launches, frac = _prof_run(eng, prompts, n_new)
+            name = f"{path}_{s_pf}"
+            cap = eng.dispatch_cost(name=name)
+            aroof = devprof.roofline_tokens_per_sec(
+                cap, toks / max(1, launches))
+            rfrac = devprof.record_roofline(name, toks / wall, aroof)
+            lt = devprof.launch_tax_fraction(launches, wall, name=name)
+            print(f"prof {path} len={s_pf}: {toks / wall:.1f} tok/s "
+                  f"vs roofline {aroof:.1f} (frac {rfrac:.3f}) "
+                  f"launches/token={launches / max(1, toks):.3f} "
+                  f"launch_tax_frac={lt:.3f} "
+                  f"flops/dispatch={cap.flops:.3g} "
+                  f"hbm_bytes/dispatch={cap.hbm_bytes:.3g}",
+                  flush=True)
+            if frac:
+                print(f"  step split: device={frac['device_frac']:.0%} "
+                      f"queue={frac['queue_frac']:.0%} "
+                      f"host={frac['host_frac']:.0%}"
+                      + ("  [HOST-BOUND]" if frac["host_bound"]
+                         else ""), flush=True)
+            # `tools/ci.sh prof` gate: the capture must be real and the
+            # tax fraction a sane fraction of the wall
+            assert cap.flops > 0 and cap.hbm_bytes > 0, (
+                f"{name}: cost_analysis returned no flops/bytes")
+            assert 0 < lt <= 1.0, f"{name}: launch_tax_frac {lt}"
+            assert launches > 0 and toks > 0
+            if eng is not donor:
+                release_engine(eng)
+            del eng
+    release_engine(donor)
+
+
 def main():
     size = os.environ.get("PD_SIZE", "1p3b")
     cfg = (gpt.gpt3_1p3b(max_seq_len=2048) if size == "1p3b"
@@ -316,6 +425,9 @@ def main():
                          shared_len=256 if not tiny else 128,
                          tail_len=32, n_new=8 if tiny else 32,
                          chunk=chunk)
+
+    if "prof" in sections:
+        prof_section(model, size)
 
 
 if __name__ == "__main__":

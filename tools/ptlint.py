@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """ptlint CLI — lint the tree with paddle_tpu.analysis.
 
-    python tools/ptlint.py [paths ...]            # default: paddle_tpu
+    python tools/ptlint.py [paths ...]       # default: paddle_tpu tools
     python tools/ptlint.py paddle_tpu --stats     # findings per rule
     python tools/ptlint.py paddle_tpu --write-baseline
     python tools/ptlint.py paddle_tpu --error-on-new   # (the default)
@@ -70,7 +70,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     analysis = _load_analysis()
-    paths = args.paths or [os.path.join(ROOT, "paddle_tpu")]
+    paths = args.paths or [os.path.join(ROOT, "paddle_tpu"),
+                           os.path.join(ROOT, "tools")]
     project = analysis.load_project(paths, root=ROOT)
     parse_errors = list(getattr(project, "parse_errors", []))
     for rel, err in parse_errors:
