@@ -1098,6 +1098,12 @@ def bench_serve(jax, jnp, peak, smoke=False):
     toks = sum(len(r.tokens) for r in fe.results())
     cap_tps = toks / dt
     cap_rps = n_req / dt
+    # pump-denominated capacity twin (requests per engine step): the
+    # smoke rungs pace arrivals by PUMP COUNT (loadgen.replay_ticks),
+    # so the arrival/serve interleaving is a pure function of the
+    # trace — a loaded CI host can no longer bunch arrivals or starve
+    # the server between them (the PR 15 flake, de-flaked here)
+    cap_rpp = n_req / max(1, fe.engine.steps)
     res["serve_capacity_tokens_per_sec"] = round(cap_tps, 1)
     res["serve_capacity_rps"] = round(cap_rps, 2)
 
@@ -1107,19 +1113,25 @@ def bench_serve(jax, jnp, peak, smoke=False):
     # trickles singletons shows ~1/slots occupancy
     for label, frac in (("sub25", 0.25), ("sub75", 0.75),
                         ("over2x", 2.0)):
-        qps = max(0.1, frac * cap_rps)
+        qps = max(0.1, frac * (cap_rpp if smoke else cap_rps))
         trace = loadgen.poisson_trace(
             n_req, qps=qps, seed=seed, vocab=cfg.vocab_size,
             prompt_len=prompt_len, new_tokens=new_tokens)
         _stats.reset("serve/")
         fe = make_frontend()
         t0 = time.perf_counter()
-        reqs = loadgen.replay(
-            trace,
-            submit=lambda a: fe.submit(a.prompt,
-                                       max_new_tokens=a.max_new_tokens,
-                                       deadline_s=a.deadline_s),
-            pump=fe.step)
+
+        def _submit(a):
+            return fe.submit(a.prompt,
+                             max_new_tokens=a.max_new_tokens,
+                             deadline_s=a.deadline_s)
+        if smoke:
+            # tick-paced: trace seconds are PUMPS (qps above is
+            # requests-per-pump) — deterministic under suite load
+            reqs = loadgen.replay_ticks(trace, submit=_submit,
+                                        pump=fe.step)
+        else:
+            reqs = loadgen.replay(trace, submit=_submit, pump=fe.step)
         fe.run()
         wall = time.perf_counter() - t0
         snap = _stats.snapshot("serve/")
@@ -1646,6 +1658,124 @@ def bench_fleet_churn(jax, jnp, peak, smoke=False):
     churned = res.get("fleet_churn_churn_goodput_tokens_per_sec")
     if steady:
         res["fleet_churn_goodput_ratio"] = round(churned / steady, 3)
+
+    # -- drain-with-migration phase (ISSUE 16): same trace, but at
+    # kill_at replica 1 DRAINS — its in-flight requests migrate
+    # mid-decode to replica 0 over the fp32 KV wire instead of being
+    # lost (churn phase) or finished in place (PR 14 drains). The
+    # latency row is the time to empty the draining replica; the dip
+    # row is the goodput cost of the event vs steady state.
+    def run_drain():
+        from paddle_tpu.serving import kv_transfer
+        fes = [mk(), mk()]
+        recs = []
+        state = {"i": 0, "t0": time.perf_counter(), "drained": False,
+                 "migrated": 0, "drain_ms": 0.0}
+
+        def submit(a):
+            state["i"] += 1
+            k = (state["i"] % 2) if not state["drained"] else 0
+            r = fes[k].submit(a.prompt,
+                              max_new_tokens=a.max_new_tokens)
+            recs.append([r, k, a])
+            return r
+
+        def migrate_off():
+            td = time.perf_counter()
+            while True:
+                open_recs = [rec for rec in recs
+                             if rec[1] == 1 and not rec[0].done]
+                if not open_recs:
+                    break
+                progress = False
+                for rec in open_recs:
+                    got = fes[1].detach_migrate(rec[0])
+                    if got is None:
+                        continue
+                    if got["kv"]:
+                        meta = got["meta"]
+                        hdr, blob = kv_transfer.encode_kv_pages(
+                            got["k"], got["v"],
+                            n_tokens=meta["n_tokens"], wire="fp32")
+                        k2, v2 = kv_transfer.decode_kv_pages(hdr, blob)
+                        rec[0] = fes[0].submit_handoff(
+                            dict(meta, wire=hdr["wire"]), k2, v2)
+                    else:
+                        rec[0] = fes[0].submit(
+                            rec[2].prompt,
+                            max_new_tokens=rec[2].max_new_tokens)
+                    rec[1] = 0
+                    state["migrated"] += 1
+                    progress = True
+                if not progress:
+                    # mid-prefill stragglers: pump until they hold a
+                    # token (per-request fallback would finish them in
+                    # place; here they all become migratable)
+                    fes[1].step()
+            state["drain_ms"] = (time.perf_counter() - td) * 1e3
+            state["drained"] = True
+
+        def pump():
+            t = time.perf_counter() - state["t0"]
+            if not state["drained"] and t > kill_at:
+                migrate_off()
+            for k, f in enumerate(fes):
+                if k == 1 and state["drained"]:
+                    continue
+                f.step()
+
+        loadgen.replay(trace, submit=submit, pump=pump)
+        while any(not r.done for r, _k, _a in recs):
+            pump()
+        wall = time.perf_counter() - state["t0"]
+        done = [r for r, _k, _a in recs if r.status == "done"]
+        toks = sum(len(r.tokens) for r in done)
+        return (toks / wall, len(done), state["migrated"],
+                state["drain_ms"])
+
+    _stats.reset("serve/")
+    d_goodput, d_done, migrated, drain_ms = run_drain()
+    res["fleet_churn_drain_goodput_tokens_per_sec"] = round(d_goodput, 1)
+    res["fleet_churn_drain_completed_frac"] = round(d_done / n_req, 4)
+    res["fleet_churn_drain_migrated"] = int(migrated)
+    res["fleet_churn_drain_latency_ms"] = round(drain_ms, 2)
+    if steady:
+        res["fleet_churn_drain_goodput_dip_frac"] = round(
+            max(0.0, 1.0 - d_goodput / steady), 4)
+
+    # -- reshape wall-clock (ISSUE 16 tentpole axis): the SAME
+    # (mesh, layout) hop — fsdp4(stacked) → tp2(per-layer) — via the
+    # in-HBM redistribute pass vs the checkpoint round trip it
+    # replaces (save + load_resharded to/from disk)
+    if len(jax.devices()) >= 4:
+        import tempfile
+        from paddle_tpu import optimizer as optim
+        from paddle_tpu.distributed import checkpoint as ckpt
+        from paddle_tpu.distributed import mesh as mesh_lib
+        from paddle_tpu.distributed import redistribute as redist
+        opt = optim.AdamW(learning_rate=1e-3)
+        mesh_lib.set_topology(None)
+        topo_a = mesh_lib.init_mesh(fsdp=4, devices=jax.devices()[:4])
+        pa, sa = gpt.init_train_state(model, opt, topo_a.mesh,
+                                      stacked=True)
+        src = {"params": pa, "opt_state": sa}
+        mesh_lib.set_topology(None)
+        topo_b = mesh_lib.init_mesh(tp=2, devices=jax.devices()[:2])
+        pb, sb = gpt.init_train_state(model, opt, topo_b.mesh)
+        dst = {"params": pb, "opt_state": sb}
+        t0 = time.perf_counter()
+        moved = redist.redistribute(src, dst, mesh=topo_b.mesh)
+        jax.block_until_ready(moved)
+        res["fleet_churn_reshard_inplace_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        root = tempfile.mkdtemp()
+        t0 = time.perf_counter()
+        ckpt.save_state(src, f"{root}/r")
+        restored = ckpt.load_resharded(f"{root}/r", dst)
+        jax.block_until_ready(restored)
+        res["fleet_churn_reshard_ckpt_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        mesh_lib.set_topology(None)
     return res
 
 

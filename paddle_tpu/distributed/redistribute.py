@@ -1,0 +1,271 @@
+"""Live in-HBM array redistribution: elastic events in O(collective),
+not O(checkpoint) (ISSUE 16 tentpole, training half; docs/elastic.md).
+
+PR 14's ElasticTrainer pays a full disk round-trip per reshape — save,
+re-plan, ``load_resharded``. But when the surviving devices still hold
+the state, the redistribution is pure data movement: every (old
+mesh/layout → new mesh/layout) pair over the PR 8 SpecLayout
+vocabulary lowers to a schedule of all-to-all / all-gather / slice
+transfers that XLA executes device-to-device when :func:`redistribute`
+re-commits each live array to its target ``NamedSharding``
+(``jax.device_put`` compiles to the collective on TPU; on the CPU test
+meshes it is the same resharding engine minus the ICI). The
+stacked ↔ per-layer block-layout conversion rides along as pure
+reshapes (``stack`` / layer slicing), so the pass moves state between
+ANY two topologies ``init_train_state`` can produce — the same
+envelope ``load_resharded`` covers, bit-exactly, without touching
+disk.
+
+Contract with the checkpoint path (kept, never replaced):
+
+- **fallback**: any leaf the planner can't prove (missing source,
+  shape/layer gap), any injected fault at the ``redistribute.schedule``
+  site, and any post-transfer digest mismatch raises
+  :class:`RedistributeError` — the caller (``fleet/elastic_train.py``)
+  degrades to save → ``restore_resharded``, counted under
+  ``fleet/reshard_fallbacks``;
+- **oracle**: tests drive the same (mesh, layout) chain through both
+  paths and assert bit-identical leaves — the checkpoint path is the
+  ground truth the in-HBM path must match.
+
+``PT_RESHARD_VERIFY=1`` (default) digests every source leaf before the
+move and its target after, so in-transit corruption (the chaos gate's
+``bitflip``) degrades to the fallback loudly instead of training on
+silently corrupted state.
+"""
+
+import hashlib
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.checkpoint import (_canon_per_layer,
+                                               _sharding_of, name_leaves)
+
+__all__ = ["RedistributeError", "Transfer", "plan_redistribute",
+           "redistribute", "reshard_verify"]
+
+
+class RedistributeError(RuntimeError):
+    """The planner can't prove this redistribution (or verification
+    caught a corrupted transfer) — degrade to the checkpoint path."""
+
+
+def reshard_verify() -> bool:
+    """``PT_RESHARD_VERIFY`` (default 1): digest every leaf before and
+    after the move; a mismatch raises instead of returning corrupted
+    state. 0 trades the host round-trip for speed on trusted fabrics."""
+    return os.environ.get("PT_RESHARD_VERIFY", "1") != "0"
+
+
+@dataclass
+class Transfer:
+    """One planned leaf move: ``op`` is the collective class the
+    (src sharding → dst sharding) pair lowers to, ``layout`` the block
+    conversion riding along (``direct`` / ``stack`` / ``unstack``)."""
+    name: str
+    op: str          # local | replicate | all-gather | slice | all-to-all
+    layout: str      # direct | stack | unstack
+    shape: tuple
+    src: str
+    dst: str
+
+
+def _spec_desc(sharding) -> str:
+    if sharding is None:
+        return "uncommitted"
+    spec = getattr(sharding, "spec", None)
+    return str(spec) if spec is not None else type(sharding).__name__
+
+
+def _is_sharded(sharding) -> bool:
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return False
+    return any(p is not None for p in spec)
+
+
+def _classify(src_sh, dst_sh) -> str:
+    """The collective class a (src → dst) sharding pair lowers to —
+    the schedule row the tests and the flight recorder see."""
+    if _spec_desc(src_sh) == _spec_desc(dst_sh) and src_sh is not None \
+            and dst_sh is not None and \
+            getattr(src_sh, "device_set", 0) == getattr(dst_sh,
+                                                        "device_set", 1):
+        return "local"
+    src_p, dst_p = _is_sharded(src_sh), _is_sharded(dst_sh)
+    if not src_p and not dst_p:
+        return "replicate"
+    if src_p and not dst_p:
+        return "all-gather"
+    if not src_p and dst_p:
+        return "slice"
+    return "all-to-all"
+
+
+def _gather_sources(src_state):
+    """(direct leaves, per-layer groups keyed by stacked name)."""
+    src = name_leaves(src_state)
+    layers: Dict[str, Dict[int, str]] = {}
+    for n, v in src.items():
+        if not hasattr(v, "shape"):
+            continue
+        c = _canon_per_layer(n)
+        if c is not None:
+            layers.setdefault(c[0], {})[c[1]] = n
+    return src, layers
+
+
+def _resolve(name, leaf, src, src_layers):
+    """The source value + layout conversion for one target leaf, or a
+    RedistributeError naming the gap (the fallback trigger)."""
+    shape = tuple(leaf.shape)
+    direct = src.get(name)
+    if hasattr(direct, "shape"):
+        if tuple(direct.shape) != shape:
+            raise RedistributeError(
+                f"{name}: source shape {tuple(direct.shape)} != target "
+                f"shape {shape}")
+        return direct, "direct"
+    if name in src_layers:
+        # target stacked, source per-layer: stack the layer leaves
+        per = src_layers[name]
+        L = shape[0]
+        missing = [l for l in range(L) if l not in per]
+        if missing:
+            raise RedistributeError(
+                f"{name}: per-layer source lacks layers {missing} "
+                f"(have {sorted(per)})")
+        blk = src[per[0]]
+        if tuple(blk.shape) != shape[1:]:
+            raise RedistributeError(
+                f"{name}: per-layer source shape {tuple(blk.shape)} "
+                f"does not stack to {shape}")
+        return jnp.stack([src[per[l]] for l in range(L)]), "stack"
+    c = _canon_per_layer(name)
+    stacked = src.get(c[0]) if c else None
+    if hasattr(stacked, "shape"):
+        # target per-layer, source stacked: slice one layer out
+        if tuple(stacked.shape)[1:] != shape:
+            raise RedistributeError(
+                f"{name}: stacked source {tuple(stacked.shape)} does "
+                f"not slice to {shape}")
+        if not 0 <= c[1] < stacked.shape[0]:
+            raise RedistributeError(
+                f"{name}: stacked source lacks layer {c[1]}")
+        return stacked[c[1]], "unstack"
+    raise RedistributeError(
+        f"no source for target leaf {name!r} (neither direct, "
+        f"per-layer, nor stacked)")
+
+
+def plan_redistribute(src_state, dst_template,
+                      mesh=None) -> List[Transfer]:
+    """Lower the (src state → dst template) pair into its transfer
+    schedule WITHOUT moving anything — the provable-plan gate and the
+    tests' schedule-shape oracle. Raises :class:`RedistributeError`
+    when any target leaf has no provable source."""
+    src, src_layers = _gather_sources(src_state)
+    out: List[Transfer] = []
+    for name, leaf in name_leaves(dst_template).items():
+        if not hasattr(leaf, "shape"):
+            continue
+        value, layout = _resolve(name, leaf, src, src_layers)
+        src_sh = _sharding_of(value, None)
+        dst_sh = _sharding_of(leaf, mesh)
+        out.append(Transfer(name=name,
+                            op=_classify(src_sh, dst_sh),
+                            layout=layout, shape=tuple(leaf.shape),
+                            src=_spec_desc(src_sh),
+                            dst=_spec_desc(dst_sh)))
+    return out
+
+
+def _digest(host: np.ndarray) -> str:
+    return hashlib.sha256(
+        np.ascontiguousarray(host).tobytes()).hexdigest()
+
+
+def redistribute(src_state, dst_template, mesh=None,
+                 verify: Optional[bool] = None):
+    """Move ``src_state``'s live arrays onto ``dst_template``'s mesh,
+    shardings, and block layout — returns a new state pytree shaped
+    like ``dst_template``, bit-identical to what ``load_resharded``
+    would have produced from a checkpoint of ``src_state``.
+
+    ``mesh`` applies the ``restore_like`` normalization: template
+    leaves whose sharding does not span the whole mesh land
+    mesh-replicated (jit-created optimizer scalars).
+
+    Raises :class:`RedistributeError` (unprovable plan, digest
+    mismatch) or whatever the ``redistribute.schedule`` fault plan
+    injects — callers degrade to the checkpoint path on ANY failure;
+    a partial move never escapes (the source state stays intact).
+    """
+    from paddle_tpu.observability import flight
+    from paddle_tpu.testing import faults
+    if verify is None:
+        verify = reshard_verify()
+    # the documented reshard fault site: index 0 is the plan itself
+    # (raise/kill here = schedule never proved); indices 1.. are the
+    # per-leaf transfers in plan order (bitflip at index k corrupts
+    # leaf k-1 in transit — verification must veto it)
+    faults.fire("redistribute.schedule")
+    src, src_layers = _gather_sources(src_state)
+    leaves, treedef = jax.tree_util.tree_flatten(dst_template)
+    names = list(name_leaves(dst_template))
+    if len(names) != len(leaves):
+        raise RedistributeError(
+            "template names/leaves mismatch: the template carries "
+            "non-pytree leaves the walker saw")
+    ops: Dict[str, int] = {}
+    out = []
+    for name, leaf in zip(names, leaves):
+        if not hasattr(leaf, "shape"):
+            sv = src.get(name)
+            out.append(leaf if sv is None else sv)
+            continue
+        value, layout = _resolve(name, leaf, src, src_layers)
+        dst_sh = _sharding_of(leaf, mesh)
+        op = _classify(_sharding_of(value, None), dst_sh)
+        ops[op] = ops.get(op, 0) + 1
+        want = None
+        if verify or faults.enabled():
+            # ptlint: disable=PT001 -- deliberate device→host copy:
+            # the pre-move digest of the verification contract (and
+            # the chaos gate's in-transit corruption point)
+            host = np.asarray(value)
+            want = _digest(host)
+            if faults.enabled():
+                buf = faults.transform("redistribute.schedule",
+                                       host.tobytes())
+                value = np.frombuffer(buf, host.dtype).reshape(
+                    host.shape)
+        np_dtype = (leaf.dtype if isinstance(leaf.dtype, np.dtype)
+                    else np.dtype(str(leaf.dtype)))
+        if value.dtype != np_dtype:
+            value = value.astype(np_dtype)
+        if dst_sh is None:
+            moved = jnp.asarray(value)
+        else:
+            moved = jax.device_put(value, dst_sh)
+        if verify:
+            # ptlint: disable=PT001 -- the post-move digest: in-transit
+            # corruption degrades to the checkpoint fallback, loudly
+            got = _digest(np.asarray(moved))
+            if got != want:
+                raise RedistributeError(
+                    f"{name}: post-transfer digest mismatch "
+                    f"({got[:12]} != {want[:12]}) — in-transit "
+                    f"corruption, falling back to the checkpoint path")
+        out.append(moved)
+    flight.record("fleet", "reshard", phase="schedule",
+                  leaves=sum(ops.values()), ops=ops,
+                  verified=bool(verify))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -272,6 +272,28 @@ declare_env("PT_FLEET_DRAIN_GRACE_S", "How long a draining replica "
             "controller SIGKILLs it and the router's death sweep "
             "redistributes the remainder.", default="10",
             owner="fleet/controller.py")
+declare_env("PT_RESHARD_INPLACE", "1 (default) lets elastic reshape "
+            "events move live train state between (mesh, layout) "
+            "pairs in HBM via distributed/redistribute.py — "
+            "O(collective) instead of a checkpoint round trip. 0 "
+            "forces the save + load_resharded fallback path (also "
+            "taken automatically, loudly, when planning or transfer "
+            "fails).", default="1", owner="fleet/elastic_train.py")
+declare_env("PT_RESHARD_VERIFY", "1 (default) digests every leaf "
+            "before and after an in-HBM redistribute — a mismatch "
+            "(in-transit corruption) raises RedistributeError and the "
+            "reshape degrades to the checkpoint fallback, counted "
+            "under fleet/reshard_fallbacks, instead of training on "
+            "corrupted state. 0 trades the host round trip for speed "
+            "on trusted fabrics.", default="1",
+            owner="distributed/redistribute.py")
+declare_env("PT_DRAIN_MIGRATE", "1 (default) makes a draining serve "
+            "replica MIGRATE its in-flight decode requests to "
+            "survivors mid-decode (KV rows + token history over the "
+            "fp32 wire, byte-identical streams) instead of finishing "
+            "them in place; per-request failures fall back to "
+            "finish-in-place. 0 restores drain-by-completion.",
+            default="1", owner="serving/router.py")
 
 # -- observability --
 declare_env("PT_TRACE_DIR", "Enable tracing; rank traces land here as "

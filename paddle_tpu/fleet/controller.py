@@ -300,6 +300,10 @@ class FleetController:
                 self._draining.pop(rid)
                 self._killed.discard(rid)
                 stats.add("fleet/controller_drains_completed")
+                # drain latency hist: with PT_DRAIN_MIGRATE this is
+                # bounded by migration time, not the longest in-flight
+                # request (the bench_fleet_churn acceptance axis)
+                stats.observe("fleet/drain_latency_s", now - t0)
                 flight.record("fleet", "drain-complete", replica=rid,
                               graceful=(state == "drained"),
                               elapsed_s=round(now - t0, 3))

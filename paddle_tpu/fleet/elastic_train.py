@@ -128,6 +128,84 @@ class ElasticTrainer:
         self.ttl = float(ttl)
         self._manager = None
         self._dead_peers: Optional[list] = None
+        self._reshape_to: Optional[int] = None
+
+    # -- in-HBM reshape (ISSUE 16 tentpole) ---------------------------------
+
+    def request_reshape(self, n_devices: int):
+        """Ask the step loop to reshape THIS process's topology to
+        ``n_devices`` at the next epoch boundary (the virtual-device
+        idiom — elasticity within one process's device set). Honored
+        in HBM when ``PT_RESHARD_INPLACE`` allows (O(collective), no
+        disk round-trip), else via the save → ``restore_resharded``
+        checkpoint path; both resume the SAME trajectory (the epoch
+        just saved is the coordination point either way). Callable
+        from an ``on_epoch`` hook or another thread."""
+        self._reshape_to = int(n_devices)
+
+    def _reshape_inplace(self, target, mesh_obj, params, opt_state,
+                         init_fn, step_fn, ck, epoch):
+        """Execute a requested same-process reshape: re-plan the mesh,
+        redistribute the live state in HBM (fallback: restore the
+        epoch just committed), rebuild the step. Returns the new
+        (mesh, params, opt_state, step, n_dev)."""
+        import jax.numpy as jnp
+        from paddle_tpu import stats
+        from paddle_tpu.distributed import redistribute as redist
+        from paddle_tpu.observability import flight
+        n_dev = int(mesh_obj.size)
+        new_mesh = plan_topology(self.model, n_devices=target).mesh
+        p_t, s_t = init_fn(self.model, self.opt, new_mesh)
+        moved = None
+        if os.environ.get("PT_RESHARD_INPLACE", "1") != "0":
+            t0 = time.perf_counter()
+            try:
+                moved = redist.redistribute(
+                    {"params": params, "opt": opt_state},
+                    {"params": p_t, "opt": s_t}, mesh=new_mesh)
+                dt = time.perf_counter() - t0
+                stats.observe("fleet/reshard_inplace_s", dt)
+                flight.record("fleet", "reshard", phase="inplace",
+                              from_devices=n_dev, to_devices=target,
+                              epoch=epoch, seconds=round(dt, 4))
+                print(f"[elastic_train] in-HBM reshard {n_dev}->"
+                      f"{target} devices in {dt:.3f}s (epoch {epoch})",
+                      file=sys.stderr, flush=True)
+            except Exception as e:
+                # ANY redistribute failure — unprovable plan, injected
+                # chaos, digest mismatch — degrades to the verified
+                # checkpoint path, loudly
+                stats.add("fleet/reshard_fallbacks")
+                flight.record("fleet", "reshard", phase="fallback",
+                              from_devices=n_dev, to_devices=target,
+                              epoch=epoch, error=f"{type(e).__name__}: "
+                                                 f"{e}"[:200])
+                print(f"[elastic_train] in-HBM reshard failed "
+                      f"({type(e).__name__}: {e}); falling back to "
+                      f"checkpoint restore", file=sys.stderr,
+                      flush=True)
+                moved = None
+        if moved is not None:
+            params, opt_state = moved["params"], moved["opt"]
+        else:
+            fresh = {"params": p_t, "opt": s_t,
+                     "epoch": jnp.zeros((), jnp.int32),
+                     "world": jnp.asarray(target, jnp.int32)}
+            state = ck.restore_resharded(fresh, mesh=new_mesh)
+            if state is None:
+                raise RuntimeError(
+                    "reshape fallback found no verified checkpoint "
+                    "(the epoch-boundary save should have committed "
+                    "one)")
+            params, opt_state = state["params"], state["opt"]
+            flight.record("fleet", "reshard", phase="restore",
+                          from_devices=n_dev, to_devices=target,
+                          epoch=epoch)
+            print(f"[elastic_train] reshard {n_dev}->{target} via "
+                  f"checkpoint restore (epoch {epoch})",
+                  file=sys.stderr, flush=True)
+        step = step_fn(self.model, self.opt, new_mesh)
+        return new_mesh, params, opt_state, step, target
 
     # -- membership ---------------------------------------------------------
 
@@ -245,6 +323,19 @@ class ElasticTrainer:
                         epoch)
                 if self.on_epoch is not None:
                     self.on_epoch(rec)
+                # same-process reshape request: redistribute in HBM
+                # AFTER the save (the committed epoch is the fallback's
+                # restore point) and continue the loop on the new mesh
+                if self._reshape_to is not None and \
+                        int(self._reshape_to) != n_dev:
+                    target = int(self._reshape_to)
+                    self._reshape_to = None
+                    (mesh_obj, params, opt_state, step,
+                     n_dev) = self._reshape_inplace(
+                        target, mesh_obj, params, opt_state,
+                        init_fn, step_fn, ck, epoch)
+                else:
+                    self._reshape_to = None
                 # reshape request (dead peer): exit AFTER the save —
                 # the committed epoch is the coordination point the
                 # surviving generation restores from

@@ -219,6 +219,15 @@ class _Inflight:
         self.kind, self.live, self.payload, self.t = kind, live, payload, t
 
 
+class _HandoffRequest(Request):
+    """A request whose KV state was built on another replica (a drain
+    migration landing on a slot-contiguous engine): carries the wire
+    KV rows, the tokens generated so far, and the valid-row count
+    until admission installs them (``DecodeEngine._admit_handoff``)."""
+
+    __slots__ = ("kv_rows", "kv_tokens", "kv_ntok", "kv_wire")
+
+
 class ResilientScheduler:
     """Shared degradation bookkeeping for the serving engines: evict ONE
     request (deadline overrun or non-finite logits) without disturbing
@@ -1123,6 +1132,10 @@ class DecodeEngine(ResilientScheduler):
         # derives queue-wait from submission to prefill start
         trace.complete("serve/queue", req.t_submit, rid=req.rid,
                        slot=slot)
+        if isinstance(req, _HandoffRequest):
+            # no prefill to run: install the transferred rows directly
+            self._admit_handoff(req, slot)
+            return True
         flight.record(req.rid, "admit", slot=slot,
                       prompt=len(req.prompt))
         self._slot_req[slot] = req      # reserve; decode skips it until
@@ -1209,11 +1222,171 @@ class DecodeEngine(ResilientScheduler):
         while budget > 0:
             if not self._admitting and not self._admit_next():
                 return
+            if not self._admitting:
+                # handoff admission: rows installed directly, no
+                # prefill job to chunk — pull the next waiter
+                continue
             used, finished = self._dispatch_prefill_chunk(
                 self._admitting[0])
             budget -= used
             if finished:
                 self._admitting.popleft()
+
+    # -- mid-decode handoff (ISSUE 16 drain migration) ----------------------
+
+    def detach_handoff(self, req: Request):
+        """Extract an in-flight request's KV rows + decode state and
+        retire it locally WITHOUT finishing — the sending half of a
+        drain migration on a slot-contiguous engine. The pipeline
+        drains first, so rows ``[0, lengths)`` hold prompt +
+        generated[:-1] and ``meta["tokens"]`` carries every token
+        generated so far; the receiver re-emits the last one and
+        continues bit-for-bit (fp32 wire).
+
+        Returns ``(meta, k, v)`` with ``k``/``v`` presented as ONE
+        wire page of ``n_tokens`` rows — (L, 1, Hkv, n_tokens, D) —
+        so ``kv_transfer.encode_kv_pages`` and any ``submit_handoff``
+        (dense or paged with matching geometry) accept them."""
+        if req.failed:
+            raise ValueError(f"request failed before detach: "
+                             f"{req.error}")
+        if not req.tokens:
+            raise ValueError("no generated token yet — pump step() "
+                             "until the request holds one")
+        self._drain()
+        if req.done:
+            raise ValueError("request completed during drain — "
+                             "publish its result directly")
+        try:
+            slot = self._slot_req.index(req)
+        except ValueError:
+            raise ValueError("request no longer holds a slot")
+        # ptlint: disable=PT001 -- deliberate device→host sync: this IS
+        # the migration payload leaving the draining replica
+        n = int(self.lengths[slot])
+        if n != len(req.prompt) + len(req.tokens) - 1:
+            raise ValueError(
+                f"slot {slot} length {n} inconsistent with prompt "
+                f"{len(req.prompt)} + generated {len(req.tokens)} - 1")
+        # ptlint: disable=PT001 -- same deliberate payload transfer
+        rows_k = np.asarray(self.kc[:, slot, :, :n, :])
+        rows_v = np.asarray(self.vc[:, slot, :, :n, :])
+        k = rows_k[:, None]            # (L, 1, Hkv, n, D): one page
+        v = rows_v[:, None]
+        meta = {"prompt": list(req.prompt), "n_tokens": n,
+                "first": int(req.tokens[0]),
+                "tokens": [int(t) for t in req.tokens],
+                "max_new_tokens": int(req.max_new_tokens),
+                "eos_id": req.eos_id, "rid": req.rid}
+        from paddle_tpu.observability import flight
+        flight.record(req.rid, "handoff-detach", n_tokens=n,
+                      generated=len(req.tokens))
+        self._slot_req[slot] = None
+        self.active = self.active.at[slot].set(False)
+        self._disp_rem[slot] = 0
+        req.done = True
+        self._obs_request_end(req)
+        return meta, k, v
+
+    def submit_handoff(self, meta: dict, k, v,
+                       deadline_s: Optional[float] = None) -> Request:
+        """Receiving half of a migration: enqueue a request whose KV
+        rows were built elsewhere. Accepts any page layout — (L, npg,
+        Hkv, page, D) with ``npg*page >= n_tokens`` — so both dense
+        (one page) and paged senders with matching (L, Hkv, D)
+        geometry land here. Admission installs the rows and
+        reconstructs the exact sender-side device state; the last
+        sender-emitted token rides the harvest queue like a local
+        prefill's first token."""
+        import time
+        prompt = [int(t) for t in meta["prompt"]]
+        tokens = [int(t) for t in meta.get("tokens",
+                                           [meta["first"]])]
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if not tokens:
+            raise ValueError("handoff meta carries no tokens")
+        max_new = int(meta["max_new_tokens"])
+        if len(tokens) > max_new:
+            raise ValueError("handoff carries more generated tokens "
+                             "than its budget")
+        n = int(meta.get("n_tokens", len(prompt) + len(tokens) - 1))
+        if n != len(prompt) + len(tokens) - 1:
+            raise ValueError(
+                f"handoff meta inconsistent: n_tokens={n} != prompt "
+                f"{len(prompt)} + generated {len(tokens)} - 1")
+        if len(prompt) + max_new > self.T:
+            raise ValueError(
+                f"{len(prompt)} prompt + {max_new} new tokens exceed "
+                f"cache length {self.T}")
+        cfg = self.cfg
+        k, v = np.asarray(k), np.asarray(v)
+        for name, arr in (("k", k), ("v", v)):
+            ok = (arr.ndim == 5 and arr.shape[0] == cfg.n_layers
+                  and arr.shape[2] == cfg.kv_heads
+                  and arr.shape[4] == cfg.head_dim
+                  and arr.shape[1] * arr.shape[3] >= n)
+            if not ok:
+                raise ValueError(
+                    f"handoff {name} pages shaped {tuple(arr.shape)} "
+                    f"do not fit this engine's geometry (n_layers="
+                    f"{cfg.n_layers}, kv_heads={cfg.kv_heads}, "
+                    f"head_dim={cfg.head_dim}, rows>={n})")
+        req = _HandoffRequest(
+            prompt, max_new, meta["eos_id"],
+            deadline=(None if deadline_s is None
+                      else time.monotonic() + deadline_s),
+            rid=meta.get("rid"))
+        req.kv_tokens = tokens
+        req.kv_ntok = n
+        req.kv_wire = str(meta.get("wire", "lossy"))
+
+        def rows(arr):
+            L, npg, H, page, D = arr.shape
+            return arr.transpose(0, 2, 1, 3, 4).reshape(
+                L, H, npg * page, D)[:, :, :n, :]
+        req.kv_rows = (rows(k), rows(v))
+        self._waiting.append(req)
+        return req
+
+    def _admit_handoff(self, req: "_HandoffRequest", slot: int):
+        """Install migrated rows instead of prefilling, then
+        reconstruct the device state the sender's drained pipeline
+        held: rows [0, n) live, ``tokens[-1]`` pending as ``last``
+        (its KV is the next dispatch's write), token history row
+        rebuilt so on-device drafts see the same window."""
+        import time
+        from paddle_tpu.observability import flight
+        n = req.kv_ntok
+        flight.record(req.rid, "handoff-install", n_tokens=n,
+                      slot=slot, wire=req.kv_wire,
+                      generated=len(req.kv_tokens))
+        rows_k, rows_v = req.kv_rows
+        self.kc = self.kc.at[:, slot, :, :n, :].set(
+            jnp.asarray(rows_k, self.kc.dtype))
+        self.vc = self.vc.at[:, slot, :, :n, :].set(
+            jnp.asarray(rows_v, self.vc.dtype))
+        req.kv_rows = None             # free the host copy
+        seq = np.zeros((self.T,), np.int32)
+        hist = req.prompt + req.kv_tokens      # n + 1 tokens
+        seq[:len(hist)] = hist
+        # ptlint: disable=PT001 -- seq is a host-built row; upload only
+        self.toks = self.toks.at[slot].set(jnp.asarray(seq))
+        req.tokens = list(req.kv_tokens[:-1])
+        nxt = req.kv_tokens[-1]
+        rem0 = req.max_new_tokens - len(req.kv_tokens)
+        eos0 = -1 if req.eos_id is None else int(req.eos_id)
+        alive = rem0 > 0 and (eos0 < 0 or nxt != eos0)
+        self.lengths = self.lengths.at[slot].set(n)
+        self.last = self.last.at[slot].set(jnp.int32(nxt))
+        self.active = self.active.at[slot].set(bool(alive))
+        self.remaining = self.remaining.at[slot].set(rem0)
+        self.eos_ids = self.eos_ids.at[slot].set(eos0)
+        self._slot_req[slot] = req
+        self._disp_rem[slot] = rem0
+        self._pending.append(_Inflight("prefill", [(slot, req)],
+                                       np.int32(nxt),
+                                       time.perf_counter()))
 
     def _emit(self, slot: int, req: Request, token: int):
         req.tokens.append(token)

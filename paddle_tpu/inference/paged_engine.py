@@ -91,11 +91,14 @@ __all__ = ["PagedDecodeEngine"]
 
 
 class _HandoffRequest(Request):
-    """A request whose prefill happened on another replica: carries the
-    wire KV pages and the prefill-sampled first token until admission
-    installs them (``PagedDecodeEngine._admit_handoff``)."""
+    """A request whose KV state was built on another replica: carries
+    the wire pages, the tokens generated so far (one, right after
+    prefill; more when a draining replica migrated it mid-decode), and
+    the valid-row count until admission installs them
+    (``PagedDecodeEngine._admit_handoff``)."""
 
-    __slots__ = ("kv_first", "kv_pages", "kv_wire")
+    __slots__ = ("kv_first", "kv_pages", "kv_wire", "kv_tokens",
+                 "kv_ntok")
 
 
 class PagedDecodeEngine(ResilientScheduler):
@@ -1080,30 +1083,34 @@ class PagedDecodeEngine(ResilientScheduler):
     # -- disaggregated handoff (docs/serving.md "Disaggregated serving") ----
 
     def detach_handoff(self, req: Request):
-        """Extract a prefilled request's KV pages + decode state and
-        retire it WITHOUT decoding — the prefill replica's half of the
-        prefill→transfer→decode handoff. Requires ``prefill_only``
-        admission (the slot never decoded, so the pages hold exactly
-        the prompt's KV and the state is 'right after prefill'). Call
-        once ``req.tokens`` holds the prefill-sampled first token.
+        """Extract a request's KV pages + decode state and retire it
+        locally WITHOUT finishing — the sending half of both handoff
+        shapes. On a ``prefill_only`` engine the pages hold exactly the
+        prompt's KV (the classic prefill→transfer→decode handoff); on
+        a decode-capable engine the request may be MID-DECODE (a
+        draining replica migrating its in-flight work, ISSUE 16): the
+        pipeline drains first, so rows ``[0, lengths)`` hold prompt +
+        generated[:-1] and ``meta["tokens"]`` carries every token
+        generated so far — the receiver re-emits the last one and
+        continues bit-for-bit. Call once ``req.tokens`` is non-empty.
 
         Returns ``(meta, k, v)``: ``meta`` carries everything
         ``submit_handoff`` needs to reconstruct bit-identical device
-        state on the decode replica (prompt, first token, remaining
-        budget, eos), ``k``/``v`` are (L, npages, Hkv, page, D) host
-        arrays of the prompt's pages (tail rows past the prompt are
-        recycled-pool garbage — the wire codec zeroes them; decode
-        overwrites before reading either way)."""
-        if not self.prefill_only:
-            raise ValueError("detach_handoff needs a prefill_only "
-                             "engine (a decoding slot's pages are "
-                             "already past the prefill state)")
+        state on the receiving replica (prompt, tokens so far, valid
+        row count, remaining budget, eos), ``k``/``v`` are (L, npages,
+        Hkv, page, D) host arrays of the slot's pages (tail rows past
+        ``n_tokens`` are recycled-pool garbage — the wire codec zeroes
+        them; decode overwrites before reading either way)."""
         if req.failed:
             raise ValueError(f"request failed before detach: {req.error}")
         if not req.tokens:
             raise ValueError("prefill not harvested yet — pump step() "
                              "until req.tokens holds the first token")
         self._drain()
+        if req.done:
+            # the drain finished it (budget/eos landed in the pipeline)
+            raise ValueError("request completed during drain — publish "
+                             "its result directly")
         try:
             slot = self._slot_req.index(req)
         except ValueError:
@@ -1124,6 +1131,11 @@ class PagedDecodeEngine(ResilientScheduler):
             L, npg, self.cfg.kv_heads, self.page, self.cfg.head_dim)
         meta = {"prompt": list(req.prompt), "n_tokens": n,
                 "first": int(req.tokens[0]),
+                # full generated-so-far history: rows [0, n) hold
+                # prompt + tokens[:-1]; the receiver re-emits
+                # tokens[-1] (its KV is the next dispatch's write) —
+                # [first] right after prefill, longer mid-decode
+                "tokens": [int(t) for t in req.tokens],
                 "max_new_tokens": int(req.max_new_tokens),
                 "eos_id": req.eos_id,
                 # trace context rides the handoff: the decode replica's
@@ -1137,19 +1149,24 @@ class PagedDecodeEngine(ResilientScheduler):
         # published/fleet-canonical on this replica), private ones free
         self._slot_req[slot] = None
         self._release(slot)
+        # a mid-decode detach leaves a device-live slot behind:
+        # deactivate it so the next dispatch never decodes a ghost
+        self.active = self.active.at[slot].set(False)
+        self._disp_rem[slot] = 0
         req.done = True
         self._obs_request_end(req)
         return meta, k, v
 
     def submit_handoff(self, meta: dict, k, v,
                        deadline_s: Optional[float] = None) -> Request:
-        """Decode-replica half of the handoff: enqueue a request whose
-        prefill already happened elsewhere. Admission (when a slot
-        frees) installs the wire pages into this pool and reconstructs
-        the exact post-prefill device state, so decode continues
-        bit-for-bit where the prefill replica stopped (the fp32-wire
-        bit-identity contract); the prefill-sampled first token rides
-        the harvest queue like any local prefill's."""
+        """Receiving half of the handoff: enqueue a request whose KV
+        state was built elsewhere — right after prefill (the disagg
+        pipeline) or mid-decode (a drain migration). Admission (when a
+        slot frees) installs the wire pages into this pool and
+        reconstructs the exact sender-side device state, so decode
+        continues bit-for-bit where the sender stopped (the fp32-wire
+        bit-identity contract); the last sender-emitted token rides
+        the harvest queue like any local prefill's first token."""
         import time
         req = _HandoffRequest(
             meta["prompt"], meta["max_new_tokens"], meta["eos_id"],
@@ -1157,6 +1174,20 @@ class PagedDecodeEngine(ResilientScheduler):
                       else time.monotonic() + deadline_s),
             rid=meta.get("rid"))
         req.kv_first = int(meta["first"])
+        req.kv_tokens = [int(t) for t in
+                         meta.get("tokens", [meta["first"]])]
+        if not req.kv_tokens:
+            raise ValueError("handoff meta carries no tokens")
+        req.kv_ntok = int(meta.get(
+            "n_tokens", len(req.prompt) + len(req.kv_tokens) - 1))
+        if req.kv_ntok != len(req.prompt) + len(req.kv_tokens) - 1:
+            raise ValueError(
+                f"handoff meta inconsistent: n_tokens={req.kv_ntok} "
+                f"!= prompt {len(req.prompt)} + generated "
+                f"{len(req.kv_tokens)} - 1")
+        if len(req.kv_tokens) > req.max_new_tokens:
+            raise ValueError("handoff carries more generated tokens "
+                             "than its budget")
         req.kv_pages = (np.asarray(k), np.asarray(v))
         # the wire these pages crossed (senders stamp it into the
         # handoff meta); absent → assume lossy, so the pages are never
@@ -1176,17 +1207,38 @@ class PagedDecodeEngine(ResilientScheduler):
         # shape error inside a later engine.step() would kill the
         # replica and every other in-flight request on it
         cfg = self.cfg
-        want = (cfg.n_layers,
-                (len(req.prompt) + self.page - 1) // self.page,
-                cfg.kv_heads, self.page, cfg.head_dim)
+        n = req.kv_ntok
+        want_npg = (n + self.page - 1) // self.page
+        repacked = []
         for name, arr in (("k", req.kv_pages[0]), ("v",
                                                    req.kv_pages[1])):
-            if tuple(arr.shape) != want:
+            ok = (arr.ndim == 5 and arr.shape[0] == cfg.n_layers
+                  and arr.shape[2] == cfg.kv_heads
+                  and arr.shape[4] == cfg.head_dim
+                  and arr.shape[1] * arr.shape[3] >= n)
+            if not ok:
                 raise ValueError(
                     f"handoff {name} pages shaped {tuple(arr.shape)} "
-                    f"do not fit this engine's geometry {want} — "
-                    "prefill and decode replicas must share "
-                    "(n_layers, page_size, kv_heads, head_dim)")
+                    f"do not fit this engine's geometry "
+                    f"{(cfg.n_layers, want_npg, cfg.kv_heads, self.page, cfg.head_dim)}"
+                    " — prefill and decode replicas must share "
+                    "(n_layers, kv_heads, head_dim) and carry "
+                    "n_tokens rows")
+            if arr.shape[1] == want_npg and arr.shape[3] == self.page:
+                repacked.append(arr)
+                continue
+            # cross-geometry sender (different page size, or a dense
+            # engine's single page of exactly n rows): flatten to a
+            # row stream and repack into THIS pool's page size — the
+            # rows are identical, only the blocking differs
+            L, H, D = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+            rows = arr.transpose(0, 2, 1, 3, 4).reshape(
+                L, H, arr.shape[1] * arr.shape[3], D)[:, :, :n, :]
+            pad = np.zeros((L, H, want_npg * self.page, D), arr.dtype)
+            pad[:, :, :n, :] = rows
+            repacked.append(pad.reshape(
+                L, H, want_npg, self.page, D).transpose(0, 2, 1, 3, 4))
+        req.kv_pages = (repacked[0], repacked[1])
         self._waiting.append(req)
         return req
 
@@ -1198,9 +1250,10 @@ class PagedDecodeEngine(ResilientScheduler):
         state the prefill replica's ``_admit`` would have left."""
         import time
         from paddle_tpu.observability import flight
-        n = len(req.prompt)
+        n = req.kv_ntok
         flight.record(req.rid, "handoff-install", n_tokens=n,
-                      slot=slot, wire=req.kv_wire)
+                      slot=slot, wire=req.kv_wire,
+                      generated=len(req.kv_tokens))
         self._reserve(slot, n)
         tab = self._tables[slot]
         k, v = req.kv_pages
@@ -1220,7 +1273,11 @@ class PagedDecodeEngine(ResilientScheduler):
         req.kv_pages = None            # free the host copy
         if req.kv_wire != "fp32":
             self._lossy_pids.update(tab[:npg])
-        if self._prefix is not None and n >= self.page:
+        if self._prefix is not None and n >= self.page \
+                and n == len(req.prompt):
+            # prefix registration only for post-prefill handoffs: a
+            # migrated mid-decode slot's tail pages hold GENERATED
+            # rows, which must never become prompt-prefix canon
             # ptlint: disable=PT001 -- req.prompt is a host int list
             # (submit coerced it); this is an upload, never a sync
             prompt = np.asarray(req.prompt, np.int32)
@@ -1228,8 +1285,12 @@ class PagedDecodeEngine(ResilientScheduler):
             self._update_pool_gauges()
             if self.fleet is not None:
                 self._fleet_publish()
-        nxt = req.kv_first
-        rem0 = req.max_new_tokens - 1
+        # sender-side history replays locally: tokens[:-1] are already
+        # final (their KV sits in the installed rows); tokens[-1] is
+        # the pending one whose KV the next dispatch writes
+        req.tokens = list(req.kv_tokens[:-1])
+        nxt = req.kv_tokens[-1]
+        rem0 = req.max_new_tokens - len(req.kv_tokens)
         eos0 = -1 if req.eos_id is None else int(req.eos_id)
         alive = rem0 > 0 and (eos0 < 0 or nxt != eos0)
         self.lengths = self.lengths.at[slot].set(n)

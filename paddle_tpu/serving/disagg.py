@@ -419,7 +419,9 @@ def serve_decode_replica(store, rid: str, frontend,
     (the router's fallback when no prefill replica is alive)."""
     from paddle_tpu import stats
     from paddle_tpu.observability import flight, runtime, trace
-    from paddle_tpu.serving.router import _publish
+    from paddle_tpu.serving.router import (_migrate_open_requests,
+                                           _publish,
+                                           drain_migrate_enabled)
     engine = frontend.engine
     directory = ReplicaDirectory(store)
     directory.announce(rid, {
@@ -488,12 +490,15 @@ def serve_decode_replica(store, rid: str, frontend,
                         deadline_s=msg.get("deadline_s"),
                         priority=msg.get("priority", 0),
                         req_id=msg["id"])
-            except TimeoutError as e:
+            except (TimeoutError, RuntimeError) as e:
                 # the handoff blob is missing/incomplete (prefill
-                # replica died mid-transfer, store hiccup): publish the
+                # replica died mid-transfer, store hiccup) or failed
+                # the wire integrity guards (in-transit corruption —
+                # digest/scale-envelope mismatch): publish the
                 # RETRYABLE status — the router re-places the request
-                # from scratch (re-prefill), never surfaces this as a
-                # client-visible rejection
+                # from scratch (re-prefill / re-decode), never
+                # surfaces this as a client-visible rejection and
+                # never installs corrupted pages
                 flight.record(msg["id"], "handoff-failed",
                               error=str(e))
                 flight.dump(msg["id"], "handoff-failed")
@@ -502,8 +507,8 @@ def serve_decode_replica(store, rid: str, frontend,
                     "status": "handoff-failed", "error": str(e),
                     "replica": rid})
                 continue
-            except (ValueError, RuntimeError) as e:
-                # infeasible request or the KV wire guard tripping:
+            except ValueError as e:
+                # infeasible request (bad geometry, over-budget):
                 # terminal, but AS A RESULT, never the replica
                 # (fail-loud per request, fleet stays up)
                 if msg.get("kind") == "handoff":
@@ -516,6 +521,11 @@ def serve_decode_replica(store, rid: str, frontend,
                     "replica": rid})
                 continue
             open_reqs[msg["id"]] = req
+        if draining and open_reqs and drain_migrate_enabled():
+            # migrate in-flight decodes to surviving decode replicas
+            # (mid-decode KV handoff, fp32 wire — byte-identical
+            # streams) instead of finishing them here
+            _migrate_open_requests(store, rid, frontend, open_reqs)
         if draining and not open_reqs and not frontend.busy:
             # drain protocol: in-flight decodes finished, nothing
             # queued — publish drained and exit

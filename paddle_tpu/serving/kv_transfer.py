@@ -39,6 +39,7 @@ Everything here is host-side numpy — nothing traced, importable by the
 router process without touching a device.
 """
 
+import hashlib
 import io
 import json
 import os
@@ -185,6 +186,12 @@ def encode_kv_pages(k: np.ndarray, v: np.ndarray, n_tokens: int,
         header["qmax"] = qmax
     blob = buf.getvalue()
     header["bytes_wire"] = len(blob)
+    # whole-blob content digest: the fp32 wire has no quantization
+    # envelope to catch in-transit corruption (the quantized guard
+    # below is scale integrity, not payload integrity) — a migrated
+    # mid-decode handoff bitflipped on the wire must fail the fetch
+    # loudly, never install and silently fork the stream
+    header["sha256"] = hashlib.sha256(blob).hexdigest()
     from paddle_tpu import stats
     stats.add("serve/kv_transfer_bytes_logical", logical)
     stats.add("serve/kv_transfer_bytes_wire", len(blob))
@@ -207,6 +214,16 @@ def decode_kv_pages(header: dict, blob: bytes,
     shape = (L, npg, hkv, page, d)
     dt = np.dtype(header["pool_dtype"])
     n = int(np.prod(shape))
+    want = header.get("sha256")
+    if want is not None and hashlib.sha256(blob).hexdigest() != want:
+        # in-transit corruption (bitflip/truncate on ANY wire): the
+        # blob no longer matches what the sender encoded
+        if strict:
+            raise RuntimeError(
+                "KV blob failed content-digest validation — in-transit "
+                "corruption; refusing to install corrupted pages")
+        k = np.full(shape, np.nan, dt)
+        return k, k.copy()
     if wire == "fp32":
         (kn, kb), (vn, vb) = header["sections"]
         k = np.frombuffer(blob[:kb], dt).reshape(shape)

@@ -26,7 +26,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Arrival", "poisson_trace", "from_trace", "replay",
-           "default_seed"]
+           "replay_ticks", "default_seed"]
 
 
 def default_seed() -> int:
@@ -98,5 +98,28 @@ def replay(arrivals: Sequence[Arrival], submit: Callable,
                 pump()
             else:
                 time.sleep(0.001)
+        handles.append(submit(a))
+    return handles
+
+
+def replay_ticks(arrivals: Sequence[Arrival], submit: Callable,
+                 pump: Callable, tick_s: float = 1.0) -> list:
+    """Deterministic closed-clock replay (the CI-smoke de-flake
+    idiom): the clock advances a fixed ``tick_s`` virtual seconds per
+    ``pump()`` call instead of reading the wall clock, so a loaded
+    host (a concurrent test suite stealing the CPU between pumps)
+    can neither bunch the arrivals together nor starve the server of
+    pump calls between them — the interleaving of arrivals and serve
+    steps is a pure function of the trace. Offered load is therefore
+    expressed in pumps, not seconds: a trace generated at ``qps=q``
+    replayed at ``tick_s=1.0`` delivers ``q`` arrivals per pump call.
+    Wall-clock :func:`replay` stays the real SLO-bench pacing — this
+    one is for assertions that must hold under any machine load."""
+    handles = []
+    vt = 0.0
+    for a in arrivals:
+        while vt < a.t:
+            pump()
+            vt += tick_s
         handles.append(submit(a))
     return handles

@@ -262,9 +262,11 @@ class Router:
 
     def _pick_decode(self, alive: Dict[str, dict]):
         """Decode placement: least outstanding KV bytes, most free
-        pages (the memory-bound axis), router in-flight as tiebreak."""
+        pages (the memory-bound axis), router in-flight as tiebreak.
+        ``both``-role replicas qualify — a symmetric fleet must be
+        able to receive a draining peer's mid-decode handoffs."""
         ds = [rid for rid, m in alive.items()
-              if m.get("role") == "decode"]
+              if m.get("role", "both") in ("decode", "both")]
         return min(ds, key=lambda r: (
             self._loads.get(r, {}).get("kv_bytes", 0),
             -self._loads.get(r, {}).get("free_pages", 0),
@@ -415,6 +417,26 @@ class Router:
                     self._phase[req_id] = "serve"
                     self._try_place(req_id)
                     stats.add("serve/router_handoff_retries")
+                    continue
+                if res.get("status") == "migrated":
+                    # NOT terminal: a draining replica handed this
+                    # request off mid-flight. kv=True carries device
+                    # state (place the handoff blob on a decode-capable
+                    # survivor); kv=False was still queued there
+                    # (re-place from scratch). Either way the id stays
+                    # accounted until a real result lands.
+                    owner = self._assigned.get(req_id)
+                    if owner is not None:
+                        self._outstanding[owner] = max(
+                            0, self._outstanding.get(owner, 0) - 1)
+                    flight.record(req_id, "migrate",
+                                  replica=res.get("replica"),
+                                  kv=bool(res.get("kv")))
+                    self._phase[req_id] = (
+                        "decode" if res.get("kv") else "serve")
+                    self._refresh_loads()
+                    self._try_place(req_id)
+                    stats.add("serve/router_migrated")
                     continue
                 if res.get("status") == "prefill-done":
                     # NOT terminal: the prefill replica published the
@@ -573,6 +595,135 @@ def _publish(store, rid: str, req_id: str, result: dict):
     store.set(f"serve/done_idx/{rid}/{i}", req_id)
 
 
+def drain_migrate_enabled() -> bool:
+    """``PT_DRAIN_MIGRATE`` (default on): a draining replica migrates
+    its in-flight decode requests to survivors mid-decode instead of
+    finishing them in place — drain latency drops from longest-request
+    to migration time. 0 restores the PR 14 finish-in-place drain."""
+    return os.environ.get("PT_DRAIN_MIGRATE", "1") != "0"
+
+
+def _migrate_open_requests(store, rid: str, frontend, open_reqs):
+    """Drain migration, sending half (docs/elastic.md): try to move
+    every open request off this draining replica. Slot-holding
+    requests leave with their KV rows + token history over the fp32
+    wire (``serve/kv/<req_id>`` blob — the survivor continues
+    bit-for-bit); still-queued ones leave as bare ids (the router
+    re-places them from scratch). Either way the sender publishes a
+    NON-terminal ``migrated`` result the router turns into the next
+    placement, so no request id is ever lost.
+
+    Per-request fallback: any failure — the ``drain.migrate`` chaos
+    site firing, detach refusing (mid-prefill, completed during the
+    pipeline drain), blob publication dying — leaves THAT request
+    finishing in place (``serve/drain_migrate_failed``) while the rest
+    still migrate. Requests that could not move yet are retried every
+    loop iteration until the replica is empty."""
+    import time as _time
+    from paddle_tpu import stats
+    from paddle_tpu.observability import flight, trace
+    from paddle_tpu.serving import kv_transfer
+    from paddle_tpu.testing import faults
+    for req_id, sreq in list(open_reqs.items()):
+        if sreq.done:
+            continue                 # the generic publisher owns it
+        try:
+            faults.fire("drain.migrate")
+            got = frontend.detach_migrate(sreq)
+        except Exception as e:
+            # injected fault / detach failure: this request finishes
+            # in place — loudly, never silently corrupted
+            stats.add("serve/drain_migrate_failed")
+            flight.record(req_id, "migrate-failed", replica=rid,
+                          error=str(e))
+            continue
+        if got is None:
+            continue                 # can't move yet; retried next loop
+        try:
+            if got["kv"]:
+                meta = got["meta"]
+                t0 = _time.perf_counter()
+                # fp32 wire: migration must be bit-identical — a lossy
+                # wire would fork the stream at the migration boundary
+                header, blob = kv_transfer.encode_kv_pages(
+                    got["k"], got["v"], n_tokens=meta["n_tokens"],
+                    wire="fp32", rid=req_id)
+                header["handoff"] = dict(meta, wire=header["wire"])
+                if faults.enabled():
+                    # in-transit corruption point (chaos: bitflip /
+                    # truncate) — the receiver's digest check must turn
+                    # it into handoff-failed, never installed state
+                    blob = faults.transform("drain.migrate", blob)
+                kv_transfer.publish_blob(store, f"serve/kv/{req_id}",
+                                         header, blob)
+                trace.complete("serve/kv_publish", t0, rid=req_id,
+                               bytes=len(blob))
+                flight.record(req_id, "migrate-publish",
+                              bytes=len(blob),
+                              generated=len(meta["tokens"]))
+        except Exception as e:
+            # the request is already detached; a publish failure is
+            # still safe — the router's handoff-failed / re-place path
+            # re-executes it from scratch once the fetch times out
+            stats.add("serve/drain_migrate_failed")
+            flight.record(req_id, "migrate-failed", replica=rid,
+                          error=str(e))
+        stats.add("serve/drain_migrated")
+        flight.record("fleet", "migrate", request=req_id, replica=rid,
+                      kv=bool(got["kv"]))
+        _publish(store, rid, req_id, {
+            "id": req_id, "tokens": [], "status": "migrated",
+            "kv": bool(got["kv"]), "error": None, "replica": rid})
+        del open_reqs[req_id]
+
+
+def _install_handoff(store, rid: str, directory, frontend, msg):
+    """Receiving half of a KV handoff on a symmetric replica (the
+    disagg decode loop keeps its own copy): fetch the blob, decode the
+    pages, admit via ``frontend.submit_handoff``. Publishes
+    ``handoff-failed`` (retryable — the router re-places from scratch)
+    on a missing/corrupt blob, ``rejected-invalid`` (terminal) on an
+    infeasible request. Returns the admitted request or None."""
+    import time as _time
+    from paddle_tpu import stats
+    from paddle_tpu.observability import flight, trace
+    from paddle_tpu.serving import kv_transfer
+    req_id = msg["id"]
+    try:
+        t0 = _time.perf_counter()
+        try:
+            # bounded below dead_after-scale stalls, heartbeat after
+            # either way — a slow fetch must not get this healthy
+            # replica death-swept
+            header, blob = kv_transfer.fetch_blob(
+                store, f"serve/kv/{req_id}", timeout=2.0)
+        finally:
+            directory.heartbeat(rid)
+        k, v = kv_transfer.decode_kv_pages(header, blob)
+        stats.observe("serve/kv_transfer_s",
+                      _time.perf_counter() - t0)
+        trace.complete("serve/kv_transfer", t0, rid=req_id,
+                       bytes=len(blob))
+        flight.record(req_id, "handoff-fetch", bytes=len(blob),
+                      wire=header.get("wire"))
+        req = frontend.submit_handoff(
+            header["handoff"], k, v, deadline_s=msg.get("deadline_s"),
+            req_id=req_id)
+        kv_transfer.delete_blob(store, f"serve/kv/{req_id}",
+                                nchunks=int(header.get("nchunks", 0)))
+        return req
+    except (TimeoutError, ValueError, RuntimeError) as e:
+        # missing blob, digest mismatch (in-transit corruption), or an
+        # infeasible install: RETRYABLE — the router re-places the
+        # request from scratch; at-least-once keeps the id accounted
+        flight.record(req_id, "handoff-failed", error=str(e))
+        flight.dump(req_id, "handoff-failed")
+        _publish(store, rid, req_id, {
+            "id": req_id, "tokens": [], "status": "handoff-failed",
+            "error": str(e), "replica": rid})
+        return None
+
+
 def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
                   max_idle_s: Optional[float] = None,
                   load_refresh_s: float = 0.25):
@@ -591,8 +742,13 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
     Drain protocol (docs/elastic.md): once the directory state flips
     to ``draining`` (the fleet controller retiring this replica), the
     router has already stopped placing new work here — this loop keeps
-    consuming any mailbox entries placed BEFORE the drain, finishes
-    every in-flight request, publishes ``drained``, and exits.
+    consuming any mailbox entries placed BEFORE the drain, then (with
+    ``PT_DRAIN_MIGRATE``, default on) MIGRATES its in-flight requests
+    to survivors mid-decode (:func:`_migrate_open_requests` — KV rows
+    + token history over the fp32 wire, streams byte-identical),
+    finishes in place whatever could not move, publishes ``drained``,
+    and exits — drain latency is bounded by migration time, not the
+    longest in-flight request.
     """
     from paddle_tpu import stats
     from paddle_tpu.observability import runtime
@@ -630,6 +786,14 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
         # the drain protocol promises to finish
         seen, msgs = _mailbox_pump(store, rid, seen)
         for msg in msgs:
+            if msg.get("kind") == "handoff":
+                # a draining peer's mid-decode migration landing here
+                # (the router picked this replica as the survivor)
+                req = _install_handoff(store, rid, directory, frontend,
+                                       msg)
+                if req is not None:
+                    open_reqs[msg["id"]] = req
+                continue
             try:
                 req = frontend.submit(
                     msg["prompt"], max_new_tokens=msg["max_new_tokens"],
@@ -648,6 +812,11 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
                     "replica": rid})
                 continue
             open_reqs[msg["id"]] = req
+        if draining and open_reqs and drain_migrate_enabled():
+            # migrate in-flight work to survivors instead of finishing
+            # it here: drain latency becomes migration time, not
+            # longest-request time (per-request fallback inside)
+            _migrate_open_requests(store, rid, frontend, open_reqs)
         if draining and not open_reqs and not frontend.busy:
             directory.set_state(rid, "drained")
             return

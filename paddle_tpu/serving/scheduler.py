@@ -48,7 +48,8 @@ __all__ = ["FrontEnd", "ServeRequest", "dynamic_bucket",
            "projected_ttft"]
 
 # terminal statuses a ServeRequest can reach
-_TERMINAL = ("done", "failed", "rejected-queue-full", "rejected-deadline")
+_TERMINAL = ("done", "failed", "rejected-queue-full",
+             "rejected-deadline", "migrated")
 
 
 class ServeRequest:
@@ -56,10 +57,14 @@ class ServeRequest:
 
         queued -> admitted -> done | failed
         queued -> rejected-queue-full | rejected-deadline
+        queued | admitted -> migrated          (drain migration)
 
     ``rejected-*`` means the request never reached a prefill (no device
     work); ``failed`` means the engine evicted it after admission
     (deadline mid-decode, non-finite logits) — ``error`` says which.
+    ``migrated`` is terminal only LOCALLY: a draining replica handed
+    the request to a survivor (``detach_migrate``), which owns the
+    client-visible completion from then on.
     """
 
     __slots__ = ("id", "prompt", "max_new_tokens", "eos_id", "priority",
@@ -244,17 +249,20 @@ class FrontEnd:
                        deadline_s: Optional[float] = None,
                        req_id: Optional[str] = None,
                        t_submit: Optional[float] = None) -> ServeRequest:
-        """Admit a request whose PREFILL already happened on another
-        replica (disaggregated serving, serving/disagg.py): the engine
-        installs the transferred KV pages when a slot frees and decode
-        continues from the handed-off state. Bypasses the admission
+        """Admit a request whose KV state was built on another replica
+        — right after prefill (disaggregated serving, serving/disagg.py)
+        or mid-decode (a drain migration): the engine installs the
+        transferred KV pages when a slot frees and decode continues
+        bit-for-bit from the handed-off state. Bypasses the admission
         queue — admission control already ran where the request first
         entered the fleet; streaming/on_token/retire hooks apply
-        exactly as for local requests."""
+        exactly as for local requests. A mid-decode handoff's already-
+        final tokens (``meta["tokens"][:-1]``) pre-fill the stream
+        buffer, so the migrated request's token stream is byte-
+        identical to the unmigrated run."""
         eng = self.engine
         if not hasattr(eng, "submit_handoff"):
-            raise ValueError("engine has no KV-handoff support "
-                             "(paged engines only)")
+            raise ValueError("engine has no KV-handoff support")
         ereq = eng.submit_handoff(meta, k, v, deadline_s=deadline_s)
         self._seq += 1
         sreq = ServeRequest(
@@ -265,11 +273,19 @@ class FrontEnd:
             self._seq, self)
         sreq.status = "admitted"
         sreq.engine_req = ereq
+        # sender-side history: tokens[:-1] are already final (the
+        # engine re-emits tokens[-1] through the harvest, landing in
+        # _buf via _on_token like any locally generated token) — the
+        # post-prefill disagg case is the [first]-singleton instance,
+        # where this prepopulates nothing
+        sreq._buf = [int(t) for t in
+                     meta.get("tokens", [meta["first"]])[:-1]]
         if ereq.rid is None:
             ereq.rid = sreq.id     # local handoff (bench): no meta rid
         from paddle_tpu.observability import flight
         flight.record(sreq.id, "handoff-admitted",
-                      n_tokens=int(meta["n_tokens"]))
+                      n_tokens=int(meta["n_tokens"]),
+                      generated=len(sreq._buf) + 1)
         if t_submit is not None:
             # same-process disaggregation (bench): TTFT counts from the
             # ORIGINAL arrival, not the handoff install — perf_counter
@@ -280,6 +296,62 @@ class FrontEnd:
         self._all.append(sreq)
         self._by_engine_req[id(ereq)] = sreq
         return sreq
+
+    def detach_migrate(self, sreq: ServeRequest):
+        """Extract one open request for a drain migration (the sending
+        half; serving/router.py drives this for every open request on
+        a draining replica). Returns
+
+        - ``None`` — the request can't move right now (mid-prefill, no
+          token yet, or it completed while the pipeline drained):
+          finish it in place and retry/publish next loop iteration;
+        - ``{"kv": False}`` — it was still queued (front-end queue or
+          the engine's own staging deque), no device state to carry:
+          the router re-places it from scratch;
+        - ``{"kv": True, "meta":, "k":, "v":}`` — it held a slot
+          mid-decode: the engine detached its KV rows + token history
+          (``engine.detach_handoff``) for a survivor to continue
+          bit-for-bit.
+
+        On the non-None paths the request is locally terminal
+        (status ``migrated``) and already off every queue/slot."""
+        from paddle_tpu import stats
+        if sreq.done:
+            return None
+        if sreq.status == "queued":
+            try:
+                self._queue.remove(sreq)
+            except ValueError:
+                return None
+            sreq.status = "migrated"
+            stats.set_value("serve/queue_len", len(self._queue))
+            return {"kv": False}
+        ereq = sreq.engine_req
+        eng = self.engine
+        if ereq is None or not hasattr(eng, "detach_handoff"):
+            return None
+        if ereq in eng._waiting:
+            # staged ahead into the engine's queue: prefill never ran
+            eng._waiting.remove(ereq)
+            self._by_engine_req.pop(id(ereq), None)
+            sreq.status = "migrated"
+            return {"kv": False}
+        # harvest the pipeline FIRST, while the retire/token hooks are
+        # still wired: tokens landing here must reach sreq._buf, and a
+        # request that completes during the drain must retire normally
+        eng._drain()
+        if ereq.done or not ereq.tokens:
+            return None
+        # detach fires the retire hook path (_obs_request_end) — unhook
+        # first so the migrating request is not marked done
+        self._by_engine_req.pop(id(ereq), None)
+        try:
+            meta, k, v = eng.detach_handoff(ereq)
+        except ValueError:
+            self._by_engine_req[id(ereq)] = sreq
+            return None
+        sreq.status = "migrated"
+        return {"kv": True, "meta": meta, "k": k, "v": v}
 
     # -- engine hooks -------------------------------------------------------
 

@@ -76,6 +76,23 @@ Sites currently wired into the runtime:
                           consulted at TRACE time: the bitflip is baked
                           into the compiled step, so ``after=`` counts
                           traces, not executions
+    redistribute.schedule
+                          the in-HBM reshape pass
+                          (distributed/redistribute.py): ``fire`` at
+                          plan execution (kill/raise = a reshape that
+                          dies mid-collective), ``transform`` on each
+                          leaf's host buffer (bitflip/truncate that the
+                          PT_RESHARD_VERIFY digest must catch) — every
+                          action must degrade to the checkpoint
+                          fallback, never corrupt train state
+    drain.migrate         drain-time request migration
+                          (router._migrate_open_requests): ``fire``
+                          before each detach (kill/raise = a sender
+                          dying mid-drain), ``transform`` on the
+                          published KV blob (bitflip the wire digest
+                          must catch) — failures fall back to
+                          finish-in-place / handoff-failed re-place,
+                          never a lost or corrupted stream
 """
 
 import os

@@ -117,6 +117,25 @@ def test_new_rows_reported_never_failed():
     assert any(r == "brand_new_tokens_per_sec" for r, _ in v["added"])
 
 
+def test_goodput_dip_is_lower_is_better():
+    """The drain bench's goodput_dip_frac row embeds the "goodput"
+    fragment but measures a COST — a bigger dip must regress, a
+    smaller one improve (ISSUE 16 direction tagging)."""
+    assert bd.direction("fleet_churn_drain_goodput_dip_frac") == -1
+    v = bd.compare(_doc(fleet_churn_drain_goodput_dip_frac=0.10),
+                   _doc(fleet_churn_drain_goodput_dip_frac=0.40))
+    assert any(r == "fleet_churn_drain_goodput_dip_frac"
+               for r, _ in v["regressions"])
+    v = bd.compare(_doc(fleet_churn_drain_goodput_dip_frac=0.40),
+                   _doc(fleet_churn_drain_goodput_dip_frac=0.10))
+    assert v["regressions"] == []
+    # ...while plain goodput rows keep their higher-is-better sense
+    assert bd.direction("fleet_churn_drain_goodput_tokens_per_sec") == 1
+    # fault-path counters introduced by the live-reshard/drain paths
+    assert bd.direction("fleet_reshard_fallbacks") == -1
+    assert bd.direction("serve_drain_migrate_failed") == -1
+
+
 def test_noise_table_widens_p99():
     # 20% swing on a p99 row sits inside the 25% noise band...
     v = bd.compare(_doc(serve_p99_ttft_ms=100.0),

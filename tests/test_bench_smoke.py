@@ -104,6 +104,17 @@ def test_bench_fleet_churn_smoke():
         assert out.get(f"fleet_churn_{label}_p99_ttft_ms", 0) > 0, out
     assert out.get("fleet_churn_redistributed", 0) > 0, out
     assert out.get("fleet_churn_goodput_ratio", 0) > 0, out
+    # drain-with-migration phase (ISSUE 16): the drain must have moved
+    # live requests, completed everything, and bounded its latency
+    assert out.get("fleet_churn_drain_completed_frac", 0) == 1.0, out
+    assert out.get("fleet_churn_drain_migrated", 0) > 0, out
+    assert out.get("fleet_churn_drain_latency_ms", -1) >= 0, out
+    assert "fleet_churn_drain_goodput_dip_frac" in out, out
+    # reshape wall-clock rows (in-HBM vs checkpoint round trip) appear
+    # whenever >= 4 devices are visible (conftest forces 8 on CPU)
+    if len(jax.devices()) >= 4:
+        assert out.get("fleet_churn_reshard_inplace_ms", 0) > 0, out
+        assert out.get("fleet_churn_reshard_ckpt_ms", 0) > 0, out
 
 
 def test_bench_train_quant_comm_smoke():

@@ -1,0 +1,197 @@
+"""In-flight request migration for draining replicas (ISSUE 16): a
+slot-holding decode request detaches from one front-end
+(``detach_migrate``), crosses the fp32 KV wire, re-admits on a
+survivor (``submit_handoff``) and finishes with a stream
+byte-identical to uninterrupted serving — on both engines, across
+engine kinds, with zero request-id loss. Chaos at the documented
+``drain.migrate`` site must fall back to finish-in-place (sender) or
+``handoff-failed`` re-placement (receiver), never a corrupt stream."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu import stats
+from paddle_tpu.distributed.membership import ReplicaDirectory
+from paddle_tpu.inference.decode_engine import DecodeEngine
+from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+from paddle_tpu.models import gpt
+from paddle_tpu.serving import FrontEnd, kv_transfer
+from paddle_tpu.serving.router import (Router, _install_handoff,
+                                       _migrate_open_requests)
+from paddle_tpu.testing import faults
+
+CFG = gpt.GPTConfig(vocab_size=96, max_seq_len=256, d_model=32,
+                    n_layers=2, n_heads=4, dtype=jnp.float32)
+MODEL = gpt.GPT(CFG, seed=0)
+PROMPTS = [[int(x) for x in np.random.RandomState(7).randint(0, 96, n)]
+           for n in (7, 19, 33)]
+MAX_NEW = 24
+
+
+def _fe(kind):
+    if kind == "paged":
+        return FrontEnd(PagedDecodeEngine(MODEL, n_pages=12,
+                                          max_slots=4))
+    return FrontEnd(DecodeEngine(MODEL, max_slots=4, max_len=96))
+
+
+def _baseline(kind):
+    fe = _fe(kind)
+    reqs = [fe.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    fe.run()
+    return [list(r.tokens) for r in reqs]
+
+
+def _wire_roundtrip(got):
+    """The migration wire: fp32 encode -> decode, as the router ships
+    it (whole-blob digest verified on decode)."""
+    meta = got["meta"]
+    hdr, blob = kv_transfer.encode_kv_pages(
+        got["k"], got["v"], n_tokens=meta["n_tokens"], wire="fp32")
+    k, v = kv_transfer.decode_kv_pages(hdr, blob)
+    return dict(meta, wire=hdr["wire"]), k, v
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.mark.parametrize("src_kind,dst_kind", [
+    ("dense", "dense"), ("paged", "paged"),
+    ("dense", "paged"), ("paged", "dense")])
+def test_migrated_stream_byte_identity(src_kind, dst_kind):
+    """Mid-decode migration, all four engine pairings: every stream
+    finishes on the survivor byte-identical to uninterrupted serving
+    (fp32 wire + handoff re-emitting the sender's last token)."""
+    want = _baseline(src_kind)
+    src, dst = _fe(src_kind), _fe(dst_kind)
+    reqs = [src.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    while not all(r.tokens or r.done for r in reqs):
+        src.step()                       # mid-decode, tokens in flight
+    moved, migrated_kv = [], 0
+    for sreq in reqs:
+        got = src.detach_migrate(sreq)
+        while got is None and sreq.status != "done":
+            src.step()                   # mid-prefill: pump and retry
+            got = src.detach_migrate(sreq)
+        if got is None:
+            moved.append(sreq)           # finished before it could move
+            continue
+        assert sreq.status == "migrated"
+        if got["kv"]:
+            migrated_kv += 1
+            meta, k, v = _wire_roundtrip(got)
+            moved.append(dst.submit_handoff(meta, k, v))
+        else:
+            moved.append(dst.submit(sreq.prompt,
+                                    max_new_tokens=MAX_NEW))
+    assert migrated_kv > 0               # the interesting path ran
+    dst.run()
+    assert [list(r.tokens) for r in moved] == want
+
+
+def test_migrate_queued_and_completed_requests():
+    """The two non-KV detach outcomes: a still-queued request leaves as
+    a bare id ({'kv': False}); a completed one refuses to move (None)
+    and keeps its finished stream."""
+    fe = FrontEnd(DecodeEngine(MODEL, max_slots=2, max_len=96))
+    # 5 requests into 2 slots: one lands in the engine's staging
+    # deque, the tail stays in the front-end queue — BOTH leave as
+    # bare ids (no device state yet)
+    reqs = [fe.submit(p, max_new_tokens=MAX_NEW)
+            for p in (PROMPTS * 2)[:5]]
+    fe.step()
+    queued = next(r for r in reqs if r.status == "queued")
+    staged = next(r for r in reqs if r.status == "admitted"
+                  and r.engine_req in fe.engine._waiting)
+    for victim in (queued, staged):
+        got = fe.detach_migrate(victim)
+        assert got == {"kv": False} and victim.status == "migrated"
+    fe.run()
+    done = next(r for r in reqs if r.status == "done")
+    toks = list(done.tokens)
+    assert fe.detach_migrate(done) is None
+    assert list(done.tokens) == toks
+
+
+def test_drain_migrate_fault_falls_back_finish_in_place():
+    """The sending half under chaos: a raise at the ``drain.migrate``
+    site leaves every request finishing IN PLACE (the PR 14 drain),
+    counted on serve/drain_migrate_failed — zero id loss; with the
+    fault lifted the same loop migrates the remainder."""
+    stats.reset("serve/")
+    router = Router(port=0)
+    try:
+        store = router.store
+        fe = _fe("dense")
+        reqs = {f"r{i}": fe.submit(p, max_new_tokens=MAX_NEW)
+                for i, p in enumerate(PROMPTS)}
+        while not all(r.tokens for r in reqs.values()):
+            fe.step()                   # mid-decode: all hold slots
+        open_reqs = dict(reqs)
+        with faults.inject("drain.migrate", "raise"):
+            _migrate_open_requests(store, "rep0", fe, open_reqs)
+        # nothing moved, nothing lost — all three still finish here
+        assert set(open_reqs) == set(reqs)
+        assert stats.get("serve/drain_migrate_failed") == 3
+        # fault lifted: the retry loop empties the replica
+        while open_reqs:
+            _migrate_open_requests(store, "rep0", fe, open_reqs)
+            fe.step()
+        assert stats.get("serve/drain_migrated") == 3
+        for rid_, sreq in reqs.items():
+            assert sreq.status == "migrated"
+            res = json.loads(store.get(f"serve/done/{rid_}",
+                                       timeout=1.0))
+            assert res["status"] == "migrated" and res["kv"] is True
+            # ...and the published blob re-admits on a survivor,
+            # byte-identical to the no-drain baseline
+        want = _baseline("dense")
+        dst = _fe("dense")
+        directory = ReplicaDirectory(store)
+        directory.announce("rep1", {})
+        moved = [_install_handoff(store, "rep1", directory, dst,
+                                  {"id": rid_}) for rid_ in reqs]
+        assert all(m is not None for m in moved)
+        dst.run()
+        assert [list(m.tokens) for m in moved] == want
+    finally:
+        router.shutdown()
+
+
+def test_drain_migrate_bitflip_becomes_handoff_failed():
+    """In-transit corruption (bitflip at ``drain.migrate``): the
+    receiver's whole-blob digest check refuses the install and
+    publishes retryable ``handoff-failed`` — corrupted KV rows are
+    NEVER admitted."""
+    stats.reset("serve/")
+    router = Router(port=0)
+    try:
+        store = router.store
+        fe = _fe("dense")
+        sreq = fe.submit(PROMPTS[0], max_new_tokens=MAX_NEW)
+        while not sreq.tokens:
+            fe.step()
+        open_reqs = {"rx": sreq}
+        # fire() consumes index 0; transform() hits index 1
+        with faults.inject("drain.migrate", "bitflip", after=1):
+            _migrate_open_requests(store, "rep0", fe, open_reqs)
+        assert not open_reqs and sreq.status == "migrated"
+        dst = _fe("dense")
+        directory = ReplicaDirectory(store)
+        directory.announce("rep1", {})
+        assert _install_handoff(store, "rep1", directory, dst,
+                                {"id": "rx"}) is None
+        res = json.loads(store.get("serve/done/rx", timeout=1.0))
+        assert res["status"] == "handoff-failed"
+        assert "digest" in res["error"] or "corrupt" in res["error"]
+        assert int(np.asarray(dst.engine.active).sum()) == 0
+    finally:
+        router.shutdown()

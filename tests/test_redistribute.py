@@ -1,0 +1,237 @@
+"""Live in-HBM resharding (ISSUE 16): distributed/redistribute.py
+lowers (old mesh/layout -> new mesh/layout) pairs into transfer
+schedules executed on LIVE arrays — bit-identical to the checkpoint
+round trip (save -> load_resharded) it replaces, which stays wired as
+both the fallback and the parity oracle. Chaos at the
+``redistribute.schedule`` site must degrade loudly to that fallback,
+never corrupt train state."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import optimizer as optim
+from paddle_tpu import stats
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import mesh as mesh_lib
+from paddle_tpu.distributed import redistribute as redist
+from paddle_tpu.distributed.checkpoint import load_resharded, name_leaves
+from paddle_tpu.models import gpt
+from paddle_tpu.testing import faults
+
+
+def _cfg():
+    return gpt.GPTConfig(vocab_size=128, max_seq_len=16, d_model=32,
+                         n_layers=3, n_heads=2, dtype=jnp.float32)
+
+
+def _train_state(model, mesh, stacked, n_steps=1):
+    opt = optim.AdamW(learning_rate=1e-3, weight_decay=0.01)
+    params, opt_state = gpt.init_train_state(model, opt, mesh,
+                                             stacked=stacked)
+    step = gpt.build_train_step(model, opt, mesh)
+    toks = jnp.asarray(
+        np.random.RandomState(1).randint(0, 128, (4, 16)), jnp.int32)
+    for i in range(n_steps):
+        params, opt_state, _ = step(params, opt_state, toks,
+                                    jax.random.PRNGKey(i))
+    return {"params": params, "opt_state": opt_state}
+
+
+def _template(model, mesh, stacked):
+    opt = optim.AdamW(learning_rate=1e-3, weight_decay=0.01)
+    p, s = gpt.init_train_state(model, opt, mesh, stacked=stacked)
+    return {"params": p, "opt_state": s}
+
+
+def _leaves(state):
+    return {n: np.asarray(v) for n, v in name_leaves(state).items()
+            if hasattr(v, "shape")}
+
+
+def _assert_bitwise(state_a, state_b):
+    a, b = _leaves(state_a), _leaves(state_b)
+    assert set(a) == set(b), set(a) ^ set(b)
+    for n in a:
+        np.testing.assert_array_equal(a[n], b[n], err_msg=n)
+
+
+def _mesh(**kw):
+    n = 1
+    for v in kw.values():
+        n *= v
+    return mesh_lib.init_mesh(devices=jax.devices()[:n], **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_topology():
+    prev = mesh_lib.get_topology()
+    mesh_lib.set_topology(None)
+    faults.clear()
+    yield
+    faults.clear()
+    mesh_lib.set_topology(prev)
+
+
+def test_redistribute_chain_matches_checkpoint_oracle(tmp_path):
+    """fsdp4(stacked) -> tp2(per-layer) -> single-chip(stacked): every
+    hop moves the LIVE state in HBM; a parallel checkpoint round trip
+    of the same hop is the bit-parity oracle."""
+    model = gpt.GPT(_cfg(), seed=0)
+    topo_a = _mesh(fsdp=4)
+    state_a = _train_state(model, topo_a.mesh, stacked=True)
+    ckpt.save_state(state_a, str(tmp_path / "a"))
+
+    # hop 1: fsdp4 stacked -> tp2 per-layer
+    mesh_lib.set_topology(None)
+    topo_b = _mesh(tp=2)
+    plan = redist.plan_redistribute(state_a, _template(
+        model, topo_b.mesh, stacked=False), mesh=topo_b.mesh)
+    names = {t.name for t in plan}
+    assert any(t.op == "all-to-all" for t in plan), plan[:4]
+    assert any(t.layout == "unstack" for t in plan), plan[:4]
+    # every weight leaf of the target is covered by the schedule
+    tmpl = _template(model, topo_b.mesh, stacked=False)
+    assert names == set(_leaves(tmpl))
+
+    live_b = redist.redistribute(state_a, tmpl, mesh=topo_b.mesh)
+    oracle_b = load_resharded(str(tmp_path / "a"),
+                              _template(model, topo_b.mesh,
+                                        stacked=False))
+    _assert_bitwise(live_b, oracle_b)
+    # target shardings honored on the live path too
+    assert len(live_b["params"]["blocks.item_0.wqkv"]
+               .sharding.device_set) == 2
+    ckpt.save_state(oracle_b, str(tmp_path / "b"))
+
+    # hop 2: tp2 per-layer -> single-chip stacked, from the LIVE result
+    mesh_lib.set_topology(None)
+    live_c = redist.redistribute(live_b,
+                                 _template(model, None, stacked=True))
+    oracle_c = load_resharded(str(tmp_path / "b"),
+                              _template(model, None, stacked=True))
+    _assert_bitwise(live_c, oracle_c)
+    # the step counter rode the whole chain
+    assert int(live_c["opt_state"]["step"]) == int(
+        state_a["opt_state"]["step"])
+
+    # resumed training stays finite on the final layout
+    opt = optim.AdamW(learning_rate=1e-3, weight_decay=0.01)
+    gpt.init_train_state(model, opt, stacked=True)
+    step = gpt.build_train_step(model, opt)
+    toks = jnp.asarray(
+        np.random.RandomState(2).randint(0, 128, (4, 16)), jnp.int32)
+    _, _, loss = step(live_c["params"], live_c["opt_state"], toks,
+                      jax.random.PRNGKey(9))
+    assert np.isfinite(float(loss))
+
+
+def test_redistribute_per_layer_stacked_roundtrip():
+    """Pure layout conversion (no mesh): per-layer -> stacked ->
+    per-layer returns the original bits."""
+    model = gpt.GPT(_cfg(), seed=0)
+    state = _train_state(model, None, stacked=False)
+    stacked = redist.redistribute(state,
+                                  _template(model, None, stacked=True))
+    back = redist.redistribute(stacked,
+                               _template(model, None, stacked=False))
+    for name, v in _leaves(state).items():
+        np.testing.assert_array_equal(v, _leaves(back)[name],
+                                      err_msg=name)
+
+
+def test_plan_unprovable_source_raises():
+    """A source missing a layer is an unprovable plan: the planner (and
+    the mover) raise RedistributeError naming the gap — the caller's
+    cue to degrade to the checkpoint path."""
+    model = gpt.GPT(_cfg(), seed=0)
+    state = _train_state(model, None, stacked=False)
+    state["params"] = {k: v for k, v in state["params"].items()
+                      if not k.startswith("blocks.item_2.")}
+    state["opt_state"]["slots"] = {
+        k: v for k, v in state["opt_state"]["slots"].items()
+        if not k.startswith("blocks.item_2.")}
+    tmpl = _template(model, None, stacked=True)
+    with pytest.raises(redist.RedistributeError, match="lacks layers"):
+        redist.plan_redistribute(state, tmpl)
+    with pytest.raises(redist.RedistributeError, match="lacks layers"):
+        redist.redistribute(state, tmpl)
+
+
+def test_redistribute_chaos_raise_and_bitflip():
+    """Both fault shapes at the documented ``redistribute.schedule``
+    site fail LOUDLY: a raise at plan time surfaces as-is, an
+    in-transit bitflip trips the PT_RESHARD_VERIFY digest — and the
+    source state is intact after either failure."""
+    model = gpt.GPT(_cfg(), seed=0)
+    state = _train_state(model, None, stacked=True)
+    before = _leaves(state)
+    tmpl = _template(model, None, stacked=False)
+
+    with faults.inject("redistribute.schedule", "raise"):
+        with pytest.raises(TimeoutError):
+            redist.redistribute(state, tmpl)
+
+    # index 0 is the plan-time fire; leaf k is transform index k
+    with faults.inject("redistribute.schedule", "bitflip", after=1,
+                       count=1):
+        with pytest.raises(redist.RedistributeError,
+                           match="digest mismatch"):
+            redist.redistribute(state, tmpl)
+
+    for n, v in _leaves(state).items():
+        np.testing.assert_array_equal(v, before[n], err_msg=n)
+
+
+def _run_trainer(tmp_path, tag, n_epochs=4, reshape_at=1, target=2):
+    """One ElasticTrainer run on 4 virtual devices that requests a
+    same-process reshape to ``target`` devices after ``reshape_at``."""
+    from paddle_tpu.fleet import ElasticTrainer, plan_topology
+    from paddle_tpu.fleet.elastic_train import synthetic_data
+    cfg = gpt.GPTConfig(vocab_size=128, max_seq_len=16, d_model=32,
+                        n_layers=2, n_heads=2, dtype=jnp.float32)
+    trainer = ElasticTrainer(
+        gpt.GPT(cfg, seed=0), optim.SGD(learning_rate=0.05),
+        str(tmp_path / tag), n_epochs=n_epochs,
+        mesh=plan_topology(gpt.GPT(cfg, seed=0), n_devices=4),
+        data_fn=synthetic_data(cfg.vocab_size, 12, cfg.max_seq_len))
+    trainer.on_epoch = (
+        lambda rec: trainer.request_reshape(target)
+        if rec["epoch"] == reshape_at else None)
+    return trainer.run()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_elastic_reshape_inplace_parity_and_chaos_fallback(
+        tmp_path, monkeypatch):
+    """The tentpole acceptance: a cooperative 4 -> 2 reshape mid-run
+    via the in-HBM path produces the SAME loss trajectory as the
+    checkpoint path (both restart from the committed epoch,
+    bit-identical state); with chaos injected the trainer degrades to
+    the fallback — same trajectory, fleet/reshard_fallbacks counted."""
+    stats.reset("fleet/")
+    recs_inplace = _run_trainer(tmp_path, "inplace")
+    assert stats.get("fleet/reshard_fallbacks") == 0
+    assert stats.snapshot("fleet/").get(
+        "fleet/reshard_inplace_s.count", 0) >= 1
+    assert [r["devices"] for r in recs_inplace] == [4, 4, 2, 2]
+
+    stats.reset("fleet/")
+    monkeypatch.setenv("PT_RESHARD_INPLACE", "0")
+    recs_ckpt = _run_trainer(tmp_path, "ckpt")
+    monkeypatch.delenv("PT_RESHARD_INPLACE")
+    assert [r["devices"] for r in recs_ckpt] == [4, 4, 2, 2]
+    for a, b in zip(recs_inplace, recs_ckpt):
+        assert abs(a["loss"] - b["loss"]) < 1e-6, (a, b)
+
+    stats.reset("fleet/")
+    with faults.inject("redistribute.schedule", "raise"):
+        recs_chaos = _run_trainer(tmp_path, "chaos")
+    assert stats.get("fleet/reshard_fallbacks") >= 1
+    assert [r["devices"] for r in recs_chaos] == [4, 4, 2, 2]
+    for a, b in zip(recs_inplace, recs_chaos):
+        assert abs(a["loss"] - b["loss"]) < 1e-6, (a, b)

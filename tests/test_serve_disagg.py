@@ -413,17 +413,30 @@ def test_handoff_geometry_mismatch_rejected_at_submit():
     """A handoff from a differently-configured fleet must fail at
     submit time (ValueError the serve loop turns into a per-request
     result) — NOT as a shape error inside a later engine.step() that
-    would kill the replica and its other in-flight requests."""
+    would kill the replica and its other in-flight requests. A
+    mismatched PAGE SIZE alone is fine since the drain-migration work
+    (page-agnostic repack: the rows are identical, only the blocking
+    differs) — infeasible means wrong (n_layers, kv_heads, head_dim)
+    or fewer rows than ``n_tokens``."""
     model = _model()
     pe = _engine(model, prefill_only=True)
     rs = np.random.RandomState(13)
     meta, k, v = _prefill_one(pe, list(rs.randint(0, 96, size=40)))
     de = _engine(model)
     with pytest.raises(ValueError, match="geometry"):
-        de.submit_handoff(meta, k[:, :, :, :64, :], v[:, :, :, :64, :])
+        # 16 rows < n_tokens=40: the pages cannot hold the state
+        de.submit_handoff(meta, k[:, :, :, :16, :], v[:, :, :, :16, :])
+    with pytest.raises(ValueError, match="geometry"):
+        # kv_heads mismatch
+        de.submit_handoff(meta, k[:, :, :2], v[:, :, :2])
+    # a smaller sender page size holding every live row is ACCEPTED
+    # (repacked into this pool's blocking) and serves to completion
+    r2 = de.submit_handoff(dict(meta), k[:, :, :, :64, :],
+                           v[:, :, :, :64, :])
     # the engine stays fully serviceable afterwards
     r = de.submit_handoff(meta, k, v)
     de.run()
+    assert r2.error is None and len(r2.tokens) == 12
     assert r.error is None and len(r.tokens) == 12
 
 

@@ -69,6 +69,10 @@ _HIGHER = ("tokens_per_sec", "tok_s", "goodput", "mfu", "hw_util",
 _LOWER = ("_ms", "ttft", "tpot", "latency", "_tax_frac", "exposed_s",
           "peak_mb", "rejects", "evictions", "spawn_timeouts",
           "host_gap")
+# checked BEFORE _HIGHER: rows whose name embeds a higher-is-better
+# fragment but measure a cost (the drain bench's goodput_dip_frac
+# contains "goodput" yet a bigger dip is a worse drain)
+_LOWER_FIRST = ("goodput_dip", "fallbacks", "migrate_failed")
 
 
 def direction(row: str) -> int:
@@ -76,6 +80,9 @@ def direction(row: str) -> int:
     for frag in _INFO:
         if frag in low:
             return 0
+    for frag in _LOWER_FIRST:
+        if frag in low:
+            return -1
     for frag in _HIGHER:
         if frag in low:
             return 1

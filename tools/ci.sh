@@ -67,6 +67,14 @@
 #                          reshape (PT_ELASTIC_RESHAPE) resumes training
 #                          from the newest VERIFIED epoch on the
 #                          re-planned mesh
+#   tools/ci.sh reshard    live-reshard + drain-migration smoke (~2
+#                          min): an in-process 4->2 ElasticTrainer
+#                          reshape must move live state in HBM with a
+#                          loss trajectory identical to the
+#                          checkpoint-path control, and a drained
+#                          serving replica must MIGRATE its in-flight
+#                          decode requests to the survivor with zero id
+#                          loss and byte-identical streams
 #   tools/ci.sh benchdiff  bench regression sentinel: the checked-in
 #                          BENCH_r05.json snapshot must self-diff
 #                          clean and bench_diff's synthetic 20% tok/s
@@ -148,6 +156,11 @@ fi
 if [[ "${1:-}" == "elastic" ]]; then
     shift
     exec python tools/elastic_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "reshard" ]]; then
+    shift
+    exec python tools/reshard_smoke.py "$@"
 fi
 
 if [[ "${1:-}" == "benchdiff" ]]; then
