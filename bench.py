@@ -1743,6 +1743,103 @@ def bench_fleet_churn(jax, jnp, peak, smoke=False):
         res["fleet_churn_drain_goodput_dip_frac"] = round(
             max(0.0, 1.0 - d_goodput / steady), 4)
 
+    # -- router-failover phase (ISSUE 17): the same trace, but at
+    # kill_at the ROUTER's accounting dies (replicas survive) and a
+    # successor rebuilds it from the real FrontEnd-side RequestJournal
+    # (serving/scheduler.py). Recovery = journal replay + re-accepting
+    # results the replicas retained (first-result-wins, no re-serve;
+    # in-flight work keeps decoding and dedups replica-side). The
+    # recovery_s row tracks the client-visible placement gap; the
+    # republished row counts retained results the successor accepted
+    # without re-serving; the dip row is the goodput cost vs steady.
+    def run_failover():
+        import os as _os
+        import tempfile as _tf
+        from paddle_tpu.serving.scheduler import RequestJournal
+        path = _os.path.join(_tf.mkdtemp(prefix="pt-bench-ha-"),
+                             "requests.jsonl")
+        state = {"i": 0, "t0": time.perf_counter(), "failed": False,
+                 "recovery_s": 0.0, "republished": 0,
+                 "journal": RequestJournal(path)}
+        fes = [mk(), mk()]
+        recs = {}                 # req_id -> [req, arrival, journaled]
+        lagged = set()            # done ids awaiting the journal beat
+
+        def submit(a):
+            state["i"] += 1
+            req_id = f"rq-{state['i']:06d}"
+            state["journal"].append_submit(
+                {"id": req_id, "prompt": list(a.prompt),
+                 "max_new_tokens": a.max_new_tokens})
+            r = fes[state["i"] % 2].submit(
+                a.prompt, max_new_tokens=a.max_new_tokens)
+            recs[req_id] = [r, a, False]
+            return r
+
+        def pump():
+            t = time.perf_counter() - state["t0"]
+            if not state["failed"] and t > kill_at:
+                state["failed"] = True
+                t_rec = time.perf_counter()
+                state["journal"].close()
+                payloads, results = RequestJournal.replay(path)
+                state["journal"] = RequestJournal(path)   # successor
+                for q in payloads:
+                    if q in results:
+                        continue
+                    rec = recs[q]
+                    if rec[0].done:
+                        # the replica retained this terminal result;
+                        # the successor accepts it instead of
+                        # re-serving (first-result-wins)
+                        state["journal"].append_result(
+                            q, {"status": rec[0].status})
+                        rec[2] = True
+                        state["republished"] += 1
+                    # else: re-placed at-least-once; the replica
+                    # still decoding it dedups the replay, so the
+                    # request simply continues
+                lagged.clear()
+                state["recovery_s"] = time.perf_counter() - t_rec
+            # journal terminal results one pump-beat late — the lag a
+            # real router's poll cadence pays, and the window the
+            # republished row measures
+            for q in lagged:
+                rec = recs[q]
+                if not rec[2]:
+                    state["journal"].append_result(
+                        q, {"status": rec[0].status})
+                    rec[2] = True
+            lagged.clear()
+            for q, rec in recs.items():
+                if rec[0].done and not rec[2]:
+                    lagged.add(q)
+            for f in fes:
+                f.step()
+
+        loadgen.replay(trace, submit=submit, pump=pump)
+        while any(not rec[0].done for rec in recs.values()):
+            pump()
+        wall = time.perf_counter() - state["t0"]
+        state["journal"].close()
+        done = [rec[0] for rec in recs.values()
+                if rec[0].status == "done"]
+        toks = sum(len(r.tokens) for r in done)
+        return (toks / wall, len(done), state["recovery_s"],
+                state["republished"])
+
+    _stats.reset("serve/")
+    f_goodput, f_done, recovery_s, republished = run_failover()
+    res["fleet_churn_failover_goodput_tokens_per_sec"] = round(
+        f_goodput, 1)
+    res["fleet_churn_failover_completed_frac"] = round(
+        f_done / n_req, 4)
+    res["fleet_churn_failover_recovery_s"] = round(recovery_s, 4)
+    res["fleet_churn_failover_republished"] = int(republished)
+    if steady:
+        res["fleet_churn_failover_goodput_dip_frac"] = round(
+            max(0.0, 1.0 - f_goodput / steady), 4)
+
     # -- reshape wall-clock (ISSUE 16 tentpole axis): the SAME
     # (mesh, layout) hop — fsdp4(stacked) → tp2(per-layer) — via the
     # in-HBM redistribute pass vs the checkpoint round trip it

@@ -49,9 +49,19 @@ class ReplicaDirectory:
 
     # -- replica side -------------------------------------------------------
 
-    def announce(self, rid: str, meta: Optional[dict] = None):
+    def announce(self, rid: str, meta: Optional[dict] = None,
+                 retry: Optional["RetryPolicy"] = None,
+                 deadline: float = 15.0):
         """Register ``rid`` (idempotent for re-announce: metadata is
         overwritten, the index gains at most one extra pointer).
+
+        At fleet spawn a worker can reach this before the router's
+        store has finished binding, so the whole registration runs
+        under a `resilience.RetryPolicy` — a slow bind costs backoff,
+        not a dead-on-arrival worker (which the controller would then
+        heal-loop on). ``state`` is re-seeded ``up`` only when absent,
+        so a re-announce after a router failover cannot resurrect a
+        draining replica into the routable pool.
 
         ``meta`` carries the replica's STATIC description — the router
         reads it once per membership refresh. The serving fields the
@@ -59,14 +69,29 @@ class ReplicaDirectory:
         ``decode`` / ``both``), ``page`` (KV page size), ``max_bucket``
         (largest prefill bucket — the router's bucket-fit screen),
         ``slots``."""
-        self.store.set(f"{self.ns}/meta/{rid}",
-                       json.dumps(meta or {}))
-        # seed the lifecycle state so state() hits on the first read —
-        # a missing key costs the full store get-with-wait timeout
-        self.store.set(f"{self.ns}/state/{rid}", "up")
-        i = self.store.add(f"{self.ns}/n", 1)
-        self.store.set(f"{self.ns}/idx/{i}", rid)
-        self.heartbeat(rid)
+        from paddle_tpu.distributed import resilience
+
+        def register():
+            self.store.set(f"{self.ns}/meta/{rid}",
+                           json.dumps(meta or {}))
+            # seed the lifecycle state so state() hits on the first
+            # read — a missing key costs the full store get-with-wait
+            # timeout — but never clobber an existing (draining) state
+            try:
+                self.store.get(f"{self.ns}/state/{rid}", timeout=0.02)
+            except (TimeoutError, ValueError):
+                self.store.set(f"{self.ns}/state/{rid}", "up")
+            i = self.store.add(f"{self.ns}/n", 1)
+            self.store.set(f"{self.ns}/idx/{i}", rid)
+            self.heartbeat(rid)
+
+        pol = retry or resilience.RetryPolicy(
+            max_attempts=16, base_delay=0.05, max_delay=1.0,
+            deadline=deadline)
+        pol.run(register, op="membership.announce",
+                retry_on=(ConnectionError, OSError, RuntimeError,
+                          resilience.StorePartitioned),
+                deadline=resilience.Deadline(deadline))
 
     def heartbeat(self, rid: str, load: Optional[dict] = None,
                   stats: Optional[dict] = None) -> int:

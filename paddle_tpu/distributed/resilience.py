@@ -27,19 +27,30 @@ Every retry / timeout / stall increments a `resilience/*` counter in
 """
 
 import dataclasses
+import os
+import queue as _queue
 import random as _random
+import threading
 import time
 from typing import Callable, Optional, Tuple
 
 __all__ = ["Deadline", "DeadlineExceeded", "CollectiveStallError",
            "RetryPolicy", "with_deadline", "store_get", "store_set",
-           "CollectiveWatchdog", "DEFAULT_POLICY"]
+           "CollectiveWatchdog", "DEFAULT_POLICY",
+           "GuardedStore", "StorePartitioned", "store_retry_s"]
 
 
 class DeadlineExceeded(TimeoutError):
     """An operation (including all its retries) overran its absolute
     deadline. Subclasses TimeoutError so existing timeout handlers
     (p2p recv rollback, elastic liveness) treat it uniformly."""
+
+
+class StorePartitioned(ConnectionError):
+    """The control-plane store stopped answering for a whole retry
+    budget (router death, network partition, frozen server). Callers
+    in the serve loops treat this as "degrade, don't die": skip the
+    beat, buffer the result, keep decoding (docs/fleet-ha.md)."""
 
 
 class CollectiveStallError(RuntimeError):
@@ -299,3 +310,239 @@ class CollectiveWatchdog:
         raises `CollectiveStallError` otherwise."""
         with self.guard(op):
             pass
+
+
+def store_retry_s(default: float = 2.0) -> float:
+    """Per-op retry budget (seconds) for `GuardedStore` — how long a
+    single store operation keeps retrying transport errors before the
+    caller sees `StorePartitioned` and degrades to partition mode."""
+    try:
+        return max(0.1, float(os.environ.get("PT_STORE_RETRY_S", default)))
+    except ValueError:
+        return default
+
+
+class _OpStuck(Exception):
+    """Internal: the store op thread did not answer within the wait —
+    the server is frozen (SIGSTOP) or the network is black-holing.
+    Deliberately NOT in any retry_on tuple: retrying would just queue
+    more ops behind the stuck one."""
+
+
+class _KeyAbsent(Exception):
+    """Internal: wraps the native TimeoutError for a key that simply
+    isn't there yet. Builtin TimeoutError is an OSError subclass (3.10+)
+    so it would match the transport retry_on tuple — but key-absence is
+    normal control flow all over the serving protocol and must pass
+    through UNRETRIED, not burn the whole partition budget."""
+
+    def __init__(self, err: BaseException):
+        super().__init__(str(err))
+        self.err = err
+
+
+class GuardedStore:
+    """The one shared deadline-guarded store helper (ISSUE 17 satellite:
+    every serving/fleet store call site routes through here).
+
+    Wraps a raw `native.TCPStore` client so that:
+
+    - transient transport failures (ConnectionError / RuntimeError /
+      OSError / BrokenPipeError) are retried with backoff, bounded by
+      `PT_STORE_RETRY_S`; exhaustion raises `StorePartitioned`, which
+      serve loops treat as "degrade, don't die";
+    - `TimeoutError` from ``get``/``wait`` passes through UNRETRIED —
+      across the codebase it is the normal "key absent yet" signal, not
+      a failure;
+    - every op executes on a background pump thread with a caller-side
+      timed wait, so a *frozen* store server (SIGSTOP partition) cannot
+      wedge a serve loop inside a native call that has no timeout
+      (``add`` in particular) — the caller gets `StorePartitioned`
+      while the thread parks on the dead socket;
+    - the ``store.partition`` fault site is consulted once per attempt
+      (actions: ``drop``/``raise``/``delay`` — a ``count=N`` rule
+      partitions exactly N ops then heals);
+    - bytes moved through the store are metered
+      (``serve/store_bytes_in``/``_out``) so tests can assert the
+      socket KV transport keeps the store byte curve ~flat;
+    - `swap(new_raw)` atomically redirects to a different store
+      endpoint (router failover): a fresh pump thread is spun up so a
+      thread parked on the dead endpoint is simply abandoned.
+
+    Attribute reads not defined here (``host``, ``port``) fall through
+    to the raw store.
+    """
+
+    SITE = "store.partition"
+    _MAX_BACKLOG = 64       # refuse new ops when this many are queued
+
+    def __init__(self, raw, retry_s: Optional[float] = None,
+                 policy: Optional[RetryPolicy] = None):
+        if isinstance(raw, GuardedStore):      # idempotent wrap
+            raw = raw.raw
+        self.raw = raw
+        self.retry_s = store_retry_s() if retry_s is None else float(retry_s)
+        self.policy = policy or RetryPolicy(
+            max_attempts=64, base_delay=0.02, max_delay=0.25,
+            deadline=self.retry_s)
+        self._lock = threading.Lock()
+        self._gen = 0
+        self._spawn_pump()
+
+    # -- pump thread ----------------------------------------------------
+    def _spawn_pump(self):
+        self._queue = _queue.Queue()
+        self._gen += 1
+        t = threading.Thread(target=self._pump, args=(self._queue, self._gen),
+                             name=f"guarded-store-{self._gen}", daemon=True)
+        t.start()
+
+    def _pump(self, q, gen):
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            fn, box, done = item
+            try:
+                box.append(("ok", fn()))
+            except BaseException as e:          # noqa: BLE001 — relayed
+                box.append(("err", e))
+            done.set()
+            if gen != self._gen:                # abandoned after swap()
+                return
+
+    _GRACE_S = 0.3          # post-deadline re-check window (see below)
+
+    def _run_async(self, fn, wait: float):
+        with self._lock:
+            if self._queue.qsize() > self._MAX_BACKLOG:
+                raise StorePartitioned(
+                    f"store op backlog > {self._MAX_BACKLOG} "
+                    f"(server unresponsive)")
+            q, box, done = self._queue, [], threading.Event()
+            q.put((fn, box, done))
+        if not done.wait(wait):
+            # The deadline is wall-clock, so a process-wide freeze
+            # (SIGSTOP of a router that hosts its OWN store) ages the
+            # op while neither the pump nor the server ran a single
+            # instruction; on resume the op lands within milliseconds.
+            # One short grace re-check separates "we were suspended"
+            # from "the server is black-holing" — a real partition
+            # just reaches its verdict _GRACE_S later.
+            if not done.wait(self._GRACE_S):
+                raise _OpStuck(f"store op unanswered after {wait:.2f}s")
+        kind, val = box[0]
+        if kind == "err":
+            raise val
+        return val
+
+    # -- guarded op core ------------------------------------------------
+    def _guarded(self, fn, op: str, wait: float):
+        from paddle_tpu import stats
+        from paddle_tpu.testing import faults
+
+        def attempt():
+            if faults.fire(self.SITE) == "drop":
+                raise ConnectionError(
+                    f"store partitioned (injected) at {op}")
+            try:
+                return self._run_async(fn, wait)
+            except DeadlineExceeded:
+                raise
+            except TimeoutError as e:
+                # key-absent (get/wait): builtin TimeoutError ⊂ OSError,
+                # so without the wrapper it would be retried as a
+                # transport error for the whole partition budget
+                raise _KeyAbsent(e) from e
+
+        try:
+            return self.policy.run(
+                attempt, op=op,
+                retry_on=(ConnectionError, OSError, RuntimeError,
+                          BrokenPipeError),
+                deadline=Deadline(self.retry_s))
+        except _KeyAbsent as e:
+            raise e.err         # key-absent — normal control flow
+        except DeadlineExceeded as e:       # retry budget burned by failures
+            stats.add("resilience/store_partitions")
+            raise StorePartitioned(f"store unreachable at {op}: {e}") from e
+        except (_OpStuck, ConnectionError, OSError, RuntimeError) as e:
+            stats.add("resilience/store_partitions")
+            raise StorePartitioned(f"store unreachable at {op}: {e}") from e
+
+    # -- TCPStore surface ----------------------------------------------
+    def get(self, key: str, timeout: float = 30.0) -> bytes:
+        from paddle_tpu import stats
+        out = self._guarded(
+            lambda: self.raw.get(key, timeout=timeout),
+            f"store.get({key})", wait=timeout + max(1.0, self.retry_s))
+        stats.add("serve/store_bytes_in", len(out))
+        return out
+
+    def set(self, key: str, value) -> None:
+        from paddle_tpu import stats
+        v = value.encode() if isinstance(value, str) else bytes(value)
+        stats.add("serve/store_bytes_out", len(v))
+        self._guarded(lambda: self.raw.set(key, v),
+                      f"store.set({key})", wait=max(1.0, self.retry_s))
+
+    def add(self, key: str, amount: int) -> int:
+        # native ptts_add has NO timeout — the pump thread is what makes
+        # this safe to call against a frozen server.
+        return self._guarded(lambda: self.raw.add(key, amount),
+                             f"store.add({key})", wait=max(1.0, self.retry_s))
+
+    def probe(self, key: str, wait: float = 0.3):
+        """Single-attempt liveness read of a counter key: ``add(key, 0)``
+        returns the current counter without bumping it. NEVER retried —
+        this is the router-liveness probe (`RouterLink`) and its whole
+        job is to answer "is the store reachable RIGHT NOW" in bounded
+        time; backoff belongs to the caller's state machine. Returns the
+        counter int, or None on any failure (unreachable, stuck, fault
+        injection)."""
+        from paddle_tpu.testing import faults
+        if faults.fire(self.SITE) == "drop":
+            return None
+        try:
+            return self._run_async(lambda: self.raw.add(key, 0), wait)
+        except BaseException:       # noqa: BLE001 — probe is best-effort
+            return None
+
+    def delete_key(self, key: str) -> bool:
+        return self._guarded(lambda: self.raw.delete_key(key),
+                             f"store.delete({key})",
+                             wait=max(1.0, self.retry_s))
+
+    def wait(self, keys, timeout: float = 30.0) -> None:
+        self._guarded(lambda: self.raw.wait(keys, timeout=timeout),
+                      "store.wait", wait=timeout + max(1.0, self.retry_s))
+
+    def close(self) -> None:
+        try:
+            self._queue.put(None)
+        except Exception:
+            pass
+        self.raw.close()
+
+    # -- failover -------------------------------------------------------
+    def swap(self, new_raw) -> None:
+        """Redirect every future op to ``new_raw`` (a fresh TCPStore
+        client on the new router generation's endpoint). The old pump
+        thread — possibly parked on the dead endpoint — is abandoned."""
+        from paddle_tpu import stats
+        if isinstance(new_raw, GuardedStore):
+            new_raw = new_raw.raw
+        with self._lock:
+            old = self.raw
+            self.raw = new_raw
+            self._spawn_pump()
+        stats.add("resilience/store_swaps")
+        try:
+            old.close()
+        except Exception:
+            pass
+
+    def __getattr__(self, name):
+        if name == "raw":
+            raise AttributeError(name)
+        return getattr(self.raw, name)

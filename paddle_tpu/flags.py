@@ -379,6 +379,35 @@ declare_env("PT_SERVE_ROLE", "This serving replica's role in a "
             "(big-bucket prefill only, KV handed off over the wire), "
             "decode (installs handoffs, deep decode occupancy).",
             default="both", owner="serving/disagg.py")
+declare_env("PT_STORE_RETRY_S", "Per-op retry budget (seconds) for the "
+            "guarded control-plane store client (GuardedStore): a store "
+            "op failing for longer than this raises StorePartitioned "
+            "and the replica degrades to partition mode — buffered "
+            "results, missed heartbeats, decode keeps stepping.",
+            default="2.0", owner="distributed/resilience.py")
+declare_env("PT_KV_TRANSPORT", "Data plane for KV handoff/migration "
+            "blobs in disaggregated serving: socket (default, direct "
+            "replica-to-replica P2P — the TCPStore carries only "
+            "membership/directory/results) or store (PR 16 TCPStore "
+            "chunked-blob path, the fallback when the native P2P "
+            "endpoint is unavailable).", default="socket",
+            owner="serving/kv_transfer.py")
+declare_env("PT_SERVE_HOST", "Host address replicas advertise for "
+            "their socket KV-transport endpoint (kv_ep locators).",
+            default="127.0.0.1", owner="serving/kv_transfer.py")
+declare_env("PT_ROUTER_ENDPOINT_FILE", "Path of the router endpoint "
+            "file ({host, port, gen, pid} JSON, atomically replaced): "
+            "each router generation writes gen+1 here and replica "
+            "RouterLinks watch it to dial the successor store after a "
+            "router death. Unset disables cross-generation failover "
+            "(single-generation PR 10 behavior).",
+            owner="serving/router.py")
+declare_env("PT_ROUTER_STANDBY", "1 makes the RouterSupervisor keep a "
+            "warm standby router process (imports paid, waiting on a "
+            "promotion token file) so failover costs store-bind + "
+            "journal-replay only; 0 (default) cold-spawns the "
+            "successor on death.", default="0",
+            owner="fleet/controller.py")
 declare_env("PT_FLEET_PREFIX", "0 disables the fleet-wide prefix-cache "
             "directory (publication, lookup, and the router's "
             "pre-placement consult) — replicas fall back to local "

@@ -43,7 +43,8 @@ from typing import Callable, Dict, List, Optional
 
 from paddle_tpu.fleet.policy import ScalePolicy, TargetOccupancyPolicy
 
-__all__ = ["FleetController", "TierSpec", "launch_spawn",
+__all__ = ["FleetController", "TierSpec", "RouterSupervisor",
+           "launch_spawn", "router_standby_enabled",
            "fleet_min_replicas", "fleet_max_replicas",
            "fleet_cooldown_s", "fleet_drain_grace_s"]
 
@@ -66,6 +67,15 @@ def fleet_cooldown_s() -> float:
     (spawn→announce, drain→exit) must not be mistaken for an
     unanswered signal. Healing below the floor ignores it."""
     return float(os.environ.get("PT_FLEET_COOLDOWN_S", "5"))
+
+
+def router_standby_enabled() -> bool:
+    """``PT_ROUTER_STANDBY`` (default off): the `RouterSupervisor`
+    keeps a WARM standby router process (already imported, waiting on
+    its start token) so failover skips interpreter+import startup —
+    recovery time becomes store-bind + journal-replay. Off, the
+    supervisor cold-spawns the successor on demand."""
+    return os.environ.get("PT_ROUTER_STANDBY", "0") != "0"
 
 
 def fleet_drain_grace_s() -> float:
@@ -461,3 +471,111 @@ class FleetController:
                         h.wait(timeout=5)
                     except Exception:
                         pass
+
+
+class RouterSupervisor:
+    """Keep exactly one live router generation (docs/fleet-ha.md).
+
+    The router process is the control plane's single point of failure:
+    it hosts the TCPStore and the placement state. The failover design
+    (ISSUE 17 tentpole) makes that state RECONSTRUCTIBLE — the request
+    journal holds the intake, the replicas hold results and membership
+    — so the supervisor's whole job is to notice the router died and
+    start the next generation. The successor binds a fresh store,
+    writes the endpoint file at ``gen+1`` (``Router.__init__``),
+    replays the journal (``Router.recover``), and the replicas'
+    `RouterLink`s reconnect and republish.
+
+    ``spawn_router(token_file)`` must start a router-hosting process:
+    with ``token_file=None`` it starts serving immediately; with a path
+    it must WAIT for that file to appear first (the warm-standby
+    contract, ``PT_ROUTER_STANDBY``: the interpreter and imports are
+    already paid for, so promotion costs store-bind + journal-replay
+    only — the supervisor promotes by creating the token file).
+
+        sup = RouterSupervisor(spawn_router, handle=first_router_proc)
+        while serving:
+            sup.step()          # respawns/promotes if the router died
+    """
+
+    def __init__(self, spawn_router: Callable, handle=None,
+                 standby: Optional[bool] = None,
+                 restart_backoff_s: float = 0.5,
+                 max_restarts: int = 8,
+                 token_dir: Optional[str] = None):
+        import tempfile
+        self.spawn_router = spawn_router
+        self.handle = handle if handle is not None \
+            else spawn_router(None)
+        self.standby_enabled = router_standby_enabled() \
+            if standby is None else bool(standby)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self._token_dir = token_dir or tempfile.mkdtemp(
+            prefix="pt-router-standby-")
+        self._standby = None            # (handle, token_path)
+        self._next_restart_ok = 0.0
+        from paddle_tpu.observability import flight
+        flight.pin("fleet")
+
+    def _arm_standby(self):
+        token = os.path.join(self._token_dir,
+                             f"standby-{self.restarts}.go")
+        self._standby = (self.spawn_router(token), token)
+
+    def _router_dead(self) -> bool:
+        poll = getattr(self.handle, "poll", None)
+        return callable(poll) and poll() is not None
+
+    def step(self, now: Optional[float] = None) -> bool:
+        """Returns True when a failover was performed this step."""
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight
+        now = time.monotonic() if now is None else now
+        if self.standby_enabled and self._standby is None:
+            self._arm_standby()
+        if not self._router_dead():
+            return False
+        if now < self._next_restart_ok:
+            return False            # backoff between rapid deaths
+        if self.restarts >= self.max_restarts:
+            raise RuntimeError(
+                f"router died {self.restarts} times — refusing to "
+                f"restart again (crash loop)")
+        self.restarts += 1
+        self._next_restart_ok = now + self.restart_backoff_s
+        stats.add("fleet/router_restarts")
+        if self._standby is not None:
+            handle, token = self._standby
+            self._standby = None
+            # promotion = creating the token file the standby waits on
+            with open(token, "w", encoding="utf-8") as f:
+                f.write("go\n")
+            self.handle = handle
+            flight.record("fleet", "router-promote",
+                          generation=self.restarts + 1)
+            print(f"[fleet] router died: promoted warm standby "
+                  f"(restart {self.restarts})", file=sys.stderr,
+                  flush=True)
+        else:
+            self.handle = self.spawn_router(None)
+            flight.record("fleet", "router-respawn",
+                          generation=self.restarts + 1)
+            print(f"[fleet] router died: cold-spawned successor "
+                  f"(restart {self.restarts})", file=sys.stderr,
+                  flush=True)
+        return True
+
+    def shutdown(self, timeout: float = 10.0):
+        """Kill the live router and any armed standby."""
+        for h in [self.handle] + (
+                [self._standby[0]] if self._standby else []):
+            kill = getattr(h, "kill", None)
+            if callable(kill):
+                try:
+                    kill()
+                    h.wait(timeout=timeout)
+                except Exception:
+                    pass
+        self._standby = None

@@ -914,6 +914,33 @@ class PagedDecodeEngine(ResilientScheduler):
             v = np.asarray(self.vp[ids])[:, None]
             self.fleet.publish(digest, k, v)
 
+    def fleet_republish(self) -> int:
+        """Re-publish every live prefix page to the fleet directory —
+        the router-failover recovery hook (`serving.router.
+        ReplicaSession`): a NEW router generation's store starts empty,
+        so without this the fleet-wide prefix warmth this replica
+        accumulated would silently vanish. The caller clears the
+        directory's published-set first (``fleet.reset_published()``);
+        lossy-wire adopted pages stay excluded exactly as in
+        `_fleet_publish`. Returns the number of pages re-published."""
+        if self.fleet is None:
+            return 0
+        n = 0
+        for digest, pid in list(self._prefix._nodes.items()):
+            if pid in self._lossy_pids:
+                continue
+            ids = (np.arange(self.cfg.n_layers, dtype=np.int32)
+                   * self.P + pid)
+            # ptlint: disable=PT001 -- deliberate device→host transfer:
+            # failover re-publication of the live radix cache (once per
+            # router generation — never steady-state decode)
+            k = np.asarray(self.kp[ids])[:, None]
+            # ptlint: disable=PT001 -- same deliberate transfer (v pool)
+            v = np.asarray(self.vp[ids])[:, None]
+            self.fleet.publish(digest, k, v)
+            n += 1
+        return n
+
     def _corrupt_shared_pages(self, shared):
         """Payload fault site ``paged.shared_page``: with a matching
         nan/bitflip rule installed, corrupt the FIRST shared page this

@@ -38,6 +38,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from paddle_tpu.distributed import resilience
 from paddle_tpu.distributed.membership import ReplicaDirectory
 from paddle_tpu.serving import kv_transfer
 
@@ -163,17 +164,36 @@ class FleetPrefixDirectory:
         # generation — the last entry write wins, fetchers re-validate
         # the gen, and each publisher deletes only its own generation's
         # chunks on withdraw
-        gen = self.store.add(f"{self.ns}/g/{digest.hex()}", 1)
-        page = k.shape[3]
-        header, blob = kv_transfer.encode_kv_pages(
-            k, v, n_tokens=page, wire=self.wire)
-        kv_transfer.publish_blob(self.store, self._pkey(digest, gen),
-                                 header, blob)
-        # entry LAST: a reader that sees it can fetch the whole payload
-        self.store.set(self._ekey(digest),
-                       json.dumps({"rid": self.rid, "gen": gen}))
+        try:
+            gen = self.store.add(f"{self.ns}/g/{digest.hex()}", 1)
+            page = k.shape[3]
+            header, blob = kv_transfer.encode_kv_pages(
+                k, v, n_tokens=page, wire=self.wire)
+            kv_transfer.publish_blob(self.store,
+                                     self._pkey(digest, gen),
+                                     header, blob)
+            # entry LAST: a reader that sees it can fetch the payload
+            self.store.set(self._ekey(digest),
+                           json.dumps({"rid": self.rid, "gen": gen}))
+        except resilience.StorePartitioned:
+            # publication is warmth, not correctness: a partitioned
+            # store skips it (NOT marked published — a later admission
+            # or the failover republish hook retries)
+            stats.add("serve/fleet_prefix_publish_skipped")
+            return
         self._published[digest] = gen
         stats.add("serve/fleet_prefix_published")
+
+    def reset_published(self):
+        """Forget what this replica has published — the router-failover
+        recovery hook (`router.ReplicaSession._recover`). A NEW router
+        generation's store starts empty, so every digest in
+        ``_published`` is a stale memory: left in place it would make
+        :meth:`publish` skip re-publication forever and the fleet would
+        silently lose this replica's warm prefixes. The engine's
+        ``fleet_republish`` walks the live radix cache and re-publishes
+        through the now-cleared set."""
+        self._published.clear()
 
     def withdraw(self, digest: bytes, force: bool = False):
         """Invalidate a digest fleet-wide (eviction/poison on the
@@ -225,7 +245,7 @@ class FleetPrefixDirectory:
         try:
             self.store.get(self._ekey(digest), timeout=0.02)
             return True
-        except TimeoutError:
+        except (TimeoutError, resilience.StorePartitioned):
             return False
 
     def covered(self, chain) -> int:
@@ -247,23 +267,28 @@ class FleetPrefixDirectory:
         key = self._ekey(digest)
         try:
             ent = json.loads(self.store.get(key, timeout=0.02))
-        except (TimeoutError, ValueError):
+        except (TimeoutError, ValueError,
+                resilience.StorePartitioned):
             return None
         gen = int(ent["gen"])
         lease = f"{self.ns}/l/{digest.hex()}"
-        self.store.add(lease, 1)
-        t0 = time.perf_counter()
         try:
-            header, blob = kv_transfer.fetch_blob(
-                self.store, self._pkey(digest, gen), timeout=2.0)
-        except TimeoutError:
-            self.store.add(lease, -1)
-            return None                 # withdrawn mid-fetch
-        leases = self.store.add(lease, -1)
+            self.store.add(lease, 1)
+            t0 = time.perf_counter()
+            try:
+                header, blob = kv_transfer.fetch_blob(
+                    self.store, self._pkey(digest, gen), timeout=2.0)
+            except TimeoutError:
+                self.store.add(lease, -1)
+                return None             # withdrawn mid-fetch
+            leases = self.store.add(lease, -1)
+        except resilience.StorePartitioned:
+            return None                 # partition mid-fetch: a miss
         try:
             ent2 = json.loads(self.store.get(key, timeout=0.02))
             stale = int(ent2["gen"]) != gen
-        except (TimeoutError, ValueError):
+        except (TimeoutError, ValueError,
+                resilience.StorePartitioned):
             stale = True                # withdrawn mid-fetch: discard
         if stale:
             # the owner's withdraw skipped chunk deletion while our
@@ -311,36 +336,41 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
     replica prefills becomes a fleet-wide hit."""
     from paddle_tpu import stats
     from paddle_tpu.observability import flight, runtime, trace
-    from paddle_tpu.serving.router import _publish
+    from paddle_tpu.serving.router import ReplicaSession
     if not getattr(engine, "prefill_only", False):
         raise ValueError("serve_prefill_replica needs a "
                          "prefill_only=True engine")
-    directory = ReplicaDirectory(store)
-    directory.announce(rid, {
-        "pid": os.getpid(), "slots": engine.S, "role": "prefill",
-        "page": engine.page, "max_bucket": engine.buckets[-1]})
-    seen = 0
+    sess = ReplicaSession(
+        store, rid,
+        meta={"pid": os.getpid(), "slots": engine.S, "role": "prefill",
+              "page": engine.page, "max_bucket": engine.buckets[-1]},
+        transport=kv_transfer.maybe_transport(),
+        engine=engine, fleet=getattr(engine, "fleet", None))
+    sess.announce()
     open_reqs: Dict[str, object] = {}
     idle_since = time.monotonic()
     last_load = 0.0
     draining = False
     while True:
+        sess.maintain()
+        sess.pump_transport()
         now = time.monotonic()
         if now - last_load >= load_refresh_s:
             runtime.hbm_gauges()
-            directory.heartbeat(rid, load=replica_load(
+            sess.heartbeat(load=replica_load(
                 engine, "prefill", queued=engine.queued,
                 queue_age_s=queue_age_s(engine=engine)),
-                stats=stats.export())
+                stats_export=stats.export())
             last_load = now
-            draining = draining or directory.state(rid) == "draining"
+            draining = draining or sess.lifecycle() == "draining"
         else:
-            directory.heartbeat(rid)
+            sess.heartbeat()
         # mailbox BEFORE the drain/shutdown exit checks: a request
         # placed just before the drain decision must be consumed and
         # finished here, not stranded for the death sweep
-        seen, msgs = _mailbox_pump(store, rid, seen)
-        for msg in msgs:
+        for msg in sess.pump_mailbox():
+            if msg.get("id") in open_reqs:
+                continue        # duplicate re-place of in-flight work
             try:
                 req = engine.submit(
                     msg["prompt"],
@@ -351,7 +381,7 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
             except ValueError as e:
                 # infeasible request: fail AS A RESULT (router.serve_
                 # replica's cascade rationale)
-                _publish(store, rid, msg["id"], {
+                sess.publish(msg["id"], {
                     "id": msg["id"], "tokens": [],
                     "status": "rejected-invalid", "error": str(e),
                     "replica": rid})
@@ -360,23 +390,32 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
         if draining and not open_reqs:
             # drain protocol: every accepted prefill finished (handed
             # off or terminal) — publish drained and exit
-            directory.set_state(rid, "drained")
+            sess.set_state("drained")
+            sess.close()
             return
-        if _shutdown_requested(store) and not open_reqs:
+        if sess.shutdown_requested() and not open_reqs:
+            sess.close()
             return
         if open_reqs:
+            # in-flight prefill keeps computing through a partition —
+            # degrade, never die (tentpole 2)
             engine.step()
             idle_since = time.monotonic()
         else:
+            if sess.partitioned:
+                # never idle-exit into a partition: the router may be
+                # mid-failover and about to re-place work here
+                idle_since = time.monotonic()
             if (max_idle_s is not None
                     and time.monotonic() - idle_since > max_idle_s):
+                sess.close()
                 return
             time.sleep(poll_s)
         for req_id, req in list(open_reqs.items()):
             if req.failed or req.done:
                 # deadline/poison eviction, or a budget-1 request that
                 # retired at harvest: terminal here, no decode phase
-                _publish(store, rid, req_id, {
+                sess.publish(req_id, {
                     "id": req_id, "tokens": list(req.tokens),
                     "status": ("failed" if req.failed else "done"),
                     "error": req.error, "replica": rid})
@@ -392,16 +431,26 @@ def serve_prefill_replica(store, rid: str, engine, poll_s: float = 0.02,
                 # the original content digest (quantization error must
                 # not compound across hops)
                 header["handoff"] = dict(meta, wire=header["wire"])
-                kv_transfer.publish_blob(store, f"serve/kv/{req_id}",
-                                         header, blob)
+                try:
+                    kv_ep = kv_transfer.send_handoff(
+                        sess.store, sess.transport, f"serve/kv/{req_id}",
+                        header, blob)
+                except resilience.StorePartitioned as e:
+                    # blob publication lost to the partition: still
+                    # emit prefill-done (buffered) — the decode fetch
+                    # misses, handoff-failed re-places from scratch
+                    sess.link.note_partition(e)
+                    kv_ep = None
                 trace.complete("serve/kv_publish", t0, rid=req_id,
                                bytes=len(blob))
                 flight.record(req_id, "handoff-publish",
-                              bytes=len(blob), wire=header["wire"])
-                _publish(store, rid, req_id, {
+                              bytes=len(blob), wire=header["wire"],
+                              plane=("socket" if kv_ep else "store"))
+                sess.publish(req_id, {
                     "id": req_id, "tokens": [],
                     "status": "prefill-done", "error": None,
-                    "replica": rid})
+                    "kv_ep": kv_ep, "replica": rid},
+                    terminal=False)
                 del open_reqs[req_id]
 
 
@@ -419,50 +468,59 @@ def serve_decode_replica(store, rid: str, frontend,
     (the router's fallback when no prefill replica is alive)."""
     from paddle_tpu import stats
     from paddle_tpu.observability import flight, runtime, trace
-    from paddle_tpu.serving.router import (_migrate_open_requests,
-                                           _publish,
+    from paddle_tpu.serving.router import (ReplicaSession,
+                                           _migrate_open_requests,
                                            drain_migrate_enabled)
     engine = frontend.engine
-    directory = ReplicaDirectory(store)
-    directory.announce(rid, {
-        "pid": os.getpid(), "slots": engine.S, "role": "decode",
-        "page": getattr(engine, "page", 0),
-        "max_bucket": engine.buckets[-1]})
-    seen = 0
+    sess = ReplicaSession(
+        store, rid,
+        meta={"pid": os.getpid(), "slots": engine.S, "role": "decode",
+              "page": getattr(engine, "page", 0),
+              "max_bucket": engine.buckets[-1]},
+        transport=kv_transfer.maybe_transport(),
+        engine=engine,
+        fleet=fleet if fleet is not None
+        else getattr(engine, "fleet", None))
+    sess.announce()
     open_reqs: Dict[str, object] = {}
     idle_since = time.monotonic()
     last_load = 0.0
     draining = False
     while True:
+        sess.maintain()
+        sess.pump_transport()
         now = time.monotonic()
         if now - last_load >= load_refresh_s:
             runtime.hbm_gauges()
-            directory.heartbeat(rid, load=replica_load(
+            sess.heartbeat(load=replica_load(
                 engine, "decode",
                 queued=len(frontend._queue) + engine.queued,
                 queue_age_s=queue_age_s(frontend=frontend)),
-                stats=stats.export())
+                stats_export=stats.export())
             last_load = now
-            draining = draining or directory.state(rid) == "draining"
+            draining = draining or sess.lifecycle() == "draining"
         else:
-            directory.heartbeat(rid)
+            sess.heartbeat()
         # mailbox BEFORE the drain/shutdown exit checks (rationale in
         # serve_prefill_replica above)
-        seen, msgs = _mailbox_pump(store, rid, seen)
-        for msg in msgs:
+        for msg in sess.pump_mailbox():
+            if msg.get("id") in open_reqs:
+                continue        # duplicate re-place of in-flight work
             try:
                 if msg.get("kind") == "handoff":
                     t0 = time.perf_counter()
+                    kv_ep = msg.get("kv_ep")
                     try:
                         # bounded below dead_after-scale stalls, and
                         # heartbeat immediately after either way — a
                         # slow fetch must not get this healthy replica
                         # death-swept
-                        header, blob = kv_transfer.fetch_blob(
-                            store, f"serve/kv/{msg['id']}",
+                        header, blob = kv_transfer.fetch_handoff(
+                            sess.store, sess.transport,
+                            f"serve/kv/{msg['id']}", kv_ep=kv_ep,
                             timeout=2.0)
                     finally:
-                        directory.heartbeat(rid)
+                        sess.heartbeat()
                     k, v = kv_transfer.decode_kv_pages(header, blob)
                     stats.observe("serve/kv_transfer_s",
                                   time.perf_counter() - t0)
@@ -470,17 +528,20 @@ def serve_decode_replica(store, rid: str, frontend,
                                    rid=msg["id"], bytes=len(blob))
                     flight.record(msg["id"], "handoff-fetch",
                                   bytes=len(blob),
-                                  wire=header.get("wire"))
+                                  wire=header.get("wire"),
+                                  plane=("socket" if kv_ep
+                                         else "store"))
                     req = frontend.submit_handoff(
                         header["handoff"], k, v,
                         deadline_s=msg.get("deadline_s"),
                         req_id=msg["id"])
-                    # sole consumer: reclaim the blob's store memory
+                    # sole consumer: reclaim the blob's memory
                     # (a redelivered handoff after this point fails
                     # the fetch -> handoff-failed -> router re-places
                     # from scratch; at-least-once keeps it safe)
-                    kv_transfer.delete_blob(
-                        store, f"serve/kv/{msg['id']}",
+                    kv_transfer.delete_handoff(
+                        sess.store, sess.transport,
+                        f"serve/kv/{msg['id']}", kv_ep=kv_ep,
                         nchunks=int(header.get("nchunks", 0)))
                 else:
                     req = frontend.submit(
@@ -490,22 +551,23 @@ def serve_decode_replica(store, rid: str, frontend,
                         deadline_s=msg.get("deadline_s"),
                         priority=msg.get("priority", 0),
                         req_id=msg["id"])
-            except (TimeoutError, RuntimeError) as e:
+            except (TimeoutError, RuntimeError,
+                    resilience.StorePartitioned) as e:
                 # the handoff blob is missing/incomplete (prefill
-                # replica died mid-transfer, store hiccup) or failed
-                # the wire integrity guards (in-transit corruption —
-                # digest/scale-envelope mismatch): publish the
-                # RETRYABLE status — the router re-places the request
-                # from scratch (re-prefill / re-decode), never
-                # surfaces this as a client-visible rejection and
-                # never installs corrupted pages
+                # replica died mid-transfer, store hiccup, partition
+                # mid-fetch) or failed the wire integrity guards
+                # (in-transit corruption — digest/scale-envelope
+                # mismatch): publish the RETRYABLE status — the router
+                # re-places the request from scratch (re-prefill /
+                # re-decode), never surfaces this as a client-visible
+                # rejection and never installs corrupted pages
                 flight.record(msg["id"], "handoff-failed",
                               error=str(e))
                 flight.dump(msg["id"], "handoff-failed")
-                _publish(store, rid, msg["id"], {
+                sess.publish(msg["id"], {
                     "id": msg["id"], "tokens": [],
                     "status": "handoff-failed", "error": str(e),
-                    "replica": rid})
+                    "replica": rid}, terminal=False)
                 continue
             except ValueError as e:
                 # infeasible request (bad geometry, over-budget):
@@ -513,9 +575,11 @@ def serve_decode_replica(store, rid: str, frontend,
                 # (fail-loud per request, fleet stays up)
                 if msg.get("kind") == "handoff":
                     # terminal failure consumes the blob too
-                    kv_transfer.delete_blob(store,
-                                            f"serve/kv/{msg['id']}")
-                _publish(store, rid, msg["id"], {
+                    kv_transfer.delete_handoff(
+                        sess.store, sess.transport,
+                        f"serve/kv/{msg['id']}",
+                        kv_ep=msg.get("kv_ep"))
+                sess.publish(msg["id"], {
                     "id": msg["id"], "tokens": [],
                     "status": "rejected-invalid", "error": str(e),
                     "replica": rid})
@@ -525,26 +589,36 @@ def serve_decode_replica(store, rid: str, frontend,
             # migrate in-flight decodes to surviving decode replicas
             # (mid-decode KV handoff, fp32 wire — byte-identical
             # streams) instead of finishing them here
-            _migrate_open_requests(store, rid, frontend, open_reqs)
+            _migrate_open_requests(sess.store, rid, frontend, open_reqs,
+                                   sess=sess)
         if draining and not open_reqs and not frontend.busy:
             # drain protocol: in-flight decodes finished, nothing
             # queued — publish drained and exit
-            directory.set_state(rid, "drained")
+            sess.set_state("drained")
+            sess.close()
             return
-        if _shutdown_requested(store) and not open_reqs \
+        if sess.shutdown_requested() and not open_reqs \
                 and not frontend.busy:
+            sess.close()
             return
         if frontend.busy:
+            # in-flight decode continues straight through a partition —
+            # degrade, never die (tentpole 2)
             frontend.step()
             idle_since = time.monotonic()
         else:
+            if sess.partitioned:
+                # never idle-exit into a partition: the router may be
+                # mid-failover and about to re-place work here
+                idle_since = time.monotonic()
             if (max_idle_s is not None
                     and time.monotonic() - idle_since > max_idle_s):
+                sess.close()
                 return
             time.sleep(poll_s)
         for req_id, req in list(open_reqs.items()):
             if req.done:
-                _publish(store, rid, req_id, {
+                sess.publish(req_id, {
                     "id": req_id, "tokens": list(req.tokens),
                     "status": req.status, "error": req.error,
                     "replica": rid})

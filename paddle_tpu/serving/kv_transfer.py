@@ -39,16 +39,22 @@ Everything here is host-side numpy — nothing traced, importable by the
 router process without touching a device.
 """
 
+import collections
 import hashlib
 import io
 import json
 import os
+import struct
+import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
 
 __all__ = ["wire_format", "encode_kv_pages", "decode_kv_pages",
-           "publish_blob", "fetch_blob", "delete_blob", "WIRE_FORMATS"]
+           "publish_blob", "fetch_blob", "delete_blob", "WIRE_FORMATS",
+           "kv_transport", "maybe_transport", "KVTransport",
+           "send_handoff", "fetch_handoff", "delete_handoff"]
 
 WIRE_FORMATS = ("fp32", "int8", "fp8")
 _FAULT_SITE = "kv_transfer.payload"
@@ -308,3 +314,276 @@ def delete_blob(store, key: str, nchunks: Optional[int] = None):
             store.delete_key(f"{key}/c{i}")
     except Exception:
         pass
+
+
+# ---------------------------------------------------------------------------
+# Store-bypassing socket transport (ISSUE 17 tentpole 3)
+# ---------------------------------------------------------------------------
+#
+# On the default ``PT_KV_TRANSPORT=socket`` plane, handoff / migration
+# blobs move over direct replica-to-replica ``native.P2PEndpoint``
+# sockets instead of 1MB store chunks: the sender keeps each encoded
+# blob in a bounded outbox and answers tag-addressed FETCH requests;
+# the router forwards only the sender's ``[host, port]`` locator in the
+# handoff message. The store keeps membership + directory + small
+# results only, so a router failover never re-hosts KV bytes and the
+# single-store byte ceiling is gone (``serve/kv_transport_bytes_store``
+# stays ~flat while ``_socket`` grows). The codec — and with it the
+# sha256 digest + scale-integrity guard — is exactly the store path's:
+# only the carrier changes.
+#
+# Wire format, one framed P2P message per direction (docs/fleet-ha.md):
+#
+#   control (tag 0, JSON):  {"op": "fetch", "key", "host", "port", "tag"}
+#                           {"op": "del",   "key"}
+#   reply  (requester tag): u64 header_len (big-endian) + header JSON +
+#                           blob; header_len == 0 encodes a MISS (the
+#                           requester raises TimeoutError — the same
+#                           retryable signal as an absent store meta
+#                           key, so the router's handoff-failed
+#                           re-place path applies unchanged).
+
+_CTRL_TAG = 0
+
+
+def kv_transport(mode: Optional[str] = None) -> str:
+    """Resolve the KV data plane: ``socket`` (default — direct
+    replica-to-replica P2P) or ``store`` (the PR 13 chunked TCPStore
+    path, also the automatic fallback when the native lib is absent).
+    Must agree fleet-wide: a store-mode receiver cannot fetch a
+    socket-mode sender's blob (it degrades to handoff-failed
+    re-placement, not corruption)."""
+    m = (mode or os.environ.get("PT_KV_TRANSPORT", "socket")) \
+        .strip().lower()
+    if m not in ("socket", "store"):
+        raise ValueError(
+            f"PT_KV_TRANSPORT must be socket|store, got {m!r}")
+    return m
+
+
+def serve_host() -> str:
+    """The host peers dial this replica's KV endpoint on
+    (``PT_SERVE_HOST``, default loopback — single-host fleets)."""
+    return os.environ.get("PT_SERVE_HOST", "127.0.0.1")
+
+
+def maybe_transport(mode: Optional[str] = None) -> Optional["KVTransport"]:
+    """A `KVTransport` when the socket plane is selected and the native
+    lib is present; None otherwise (callers then use the store path)."""
+    from paddle_tpu import native
+    if kv_transport(mode) != "socket" or not native.is_available():
+        return None
+    try:
+        return KVTransport()
+    except Exception:
+        return None             # no listen socket → degrade to store
+
+
+class KVTransport:
+    """One replica's end of the socket KV data plane: a
+    ``native.P2PEndpoint`` (ephemeral port), a bounded blob outbox, and
+    the fetch/del control protocol above.
+
+    A daemon pump thread answers peers' control messages so fetches
+    are served even while the owning serve loop is deep inside a long
+    ``engine.step()`` (a jax bucket compile can park the loop for
+    seconds — a peer's 2s fetch must not starve meanwhile). Every
+    endpoint/outbox touch is serialized by one lock; the serve loop's
+    :meth:`pump` call is kept as a no-cost assist, and :meth:`fetch`
+    still pumps while it waits so two replicas fetching from each
+    other (migration storms) cannot deadlock even without the
+    thread."""
+
+    MAX_OUTBOX = 32             # evicted blobs degrade to handoff-failed
+
+    def __init__(self, port: int = 0):
+        from paddle_tpu import native
+        self.ep = native.P2PEndpoint(port)
+        self.host = serve_host()
+        self.port = self.ep.port
+        self.outbox = collections.OrderedDict()   # key -> (header, blob)
+        self._tag = 1 << 32     # reply tags; 0 is the control tag
+        self._lock = threading.RLock()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._pump_loop, name=f"kv-transport-{self.port}",
+            daemon=True)
+        self._thread.start()
+
+    def locator(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    # -- sender side ----------------------------------------------------
+    def offer(self, key: str, header: dict, blob: bytes):
+        from paddle_tpu import stats
+        with self._lock:
+            self.outbox[key] = (dict(header, nchunks=0), bytes(blob))
+            self.outbox.move_to_end(key)
+            while len(self.outbox) > self.MAX_OUTBOX:
+                self.outbox.popitem(last=False)
+                stats.add("serve/kv_transport_evicted")
+        stats.add("serve/kv_transport_offers")
+
+    def withdraw(self, key: str):
+        with self._lock:
+            self.outbox.pop(key, None)
+
+    def _pump_loop(self):
+        while not self._closed:
+            try:
+                n = self.pump()
+            except Exception:
+                n = 0           # a poisoned ctrl frame never kills it
+            time.sleep(0.002 if n else 0.02)
+
+    def pump(self, budget: int = 8) -> int:
+        """Answer up to ``budget`` queued control messages (non-
+        blocking); returns how many were handled. A reply the requester
+        can no longer receive is dropped — it times out and the router
+        re-places."""
+        from paddle_tpu import stats
+        handled = 0
+        for _ in range(budget):
+            with self._lock:
+                if self._closed:
+                    return handled
+                try:
+                    raw = self.ep.recv(_CTRL_TAG, timeout=0.0)
+                except TimeoutError:
+                    return handled
+                except RuntimeError:
+                    continue
+                handled += 1
+                try:
+                    msg = json.loads(raw)
+                except ValueError:
+                    continue
+                if msg.get("op") == "del":
+                    self.outbox.pop(msg.get("key"), None)
+                    continue
+                if msg.get("op") != "fetch":
+                    continue
+                ent = self.outbox.get(msg.get("key"))
+                if ent is None:
+                    payload = struct.pack(">Q", 0)
+                    stats.add("serve/kv_transport_misses")
+                else:
+                    header, blob = ent
+                    hj = json.dumps(header).encode()
+                    payload = struct.pack(">Q", len(hj)) + hj + blob
+                    stats.add("serve/kv_transport_bytes_socket",
+                              len(blob))
+                try:
+                    self.ep.send(msg["host"], int(msg["port"]),
+                                 int(msg["tag"]), payload)
+                except (ConnectionError, BrokenPipeError, KeyError,
+                        ValueError, TypeError):
+                    pass
+        return handled
+
+    # -- receiver side --------------------------------------------------
+    def fetch(self, host: str, port: int, key: str,
+              timeout: float = 5.0) -> Tuple[dict, bytes]:
+        """Fetch ``key`` from the owner at ``host:port``. Raises
+        TimeoutError on an unreachable/evicted/absent blob — the same
+        retryable contract as :func:`fetch_blob`."""
+        from paddle_tpu import stats
+        with self._lock:
+            self._tag += 1
+            tag = self._tag
+        ctrl = json.dumps({"op": "fetch", "key": key, "host": self.host,
+                           "port": self.port, "tag": tag}).encode()
+        try:
+            with self._lock:
+                self.ep.send(host, int(port), _CTRL_TAG, ctrl)
+        except (ConnectionError, BrokenPipeError) as e:
+            raise TimeoutError(
+                f"kv socket fetch({key}): owner {host}:{port} "
+                f"unreachable: {e}") from e
+        deadline = time.monotonic() + timeout
+        while True:
+            self.pump()         # keep answering peers while we wait
+            try:
+                with self._lock:
+                    reply = self.ep.recv(tag, timeout=0.05)
+                break
+            except TimeoutError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"kv socket fetch({key}) from {host}:{port} "
+                        f"timed out after {timeout}s")
+        hlen = struct.unpack(">Q", reply[:8])[0]
+        if hlen == 0:
+            raise TimeoutError(
+                f"kv socket fetch({key}): blob absent at owner "
+                f"{host}:{port} (withdrawn or evicted)")
+        header = json.loads(reply[8:8 + hlen])
+        blob = reply[8 + hlen:]
+        stats.add("serve/kv_transport_bytes_socket", len(blob))
+        return header, blob
+
+    def delete(self, host: str, port: int, key: str):
+        """Best-effort del notice to the owner (fire-and-forget)."""
+        try:
+            with self._lock:
+                self.ep.send(host, int(port), _CTRL_TAG,
+                             json.dumps({"op": "del",
+                                         "key": key}).encode())
+        except (ConnectionError, BrokenPipeError):
+            pass
+
+    def close(self):
+        self._closed = True
+        with self._lock:
+            self.outbox.clear()
+            try:
+                self.ep.close()
+            except Exception:
+                pass
+
+
+# -- transport-forking handoff helpers (the serve loops' one entry) ---------
+
+def send_handoff(store, transport: Optional[KVTransport], key: str,
+                 header: dict, blob: bytes):
+    """Publish a handoff/migration blob on the configured data plane.
+    Returns the locator the router forwards to the receiving replica:
+    ``[host, port]`` = fetch over the socket plane from the owner,
+    ``None`` = the chunked store path."""
+    from paddle_tpu import stats
+    if transport is not None:
+        transport.offer(key, header, blob)
+        return list(transport.locator())
+    stats.add("serve/kv_transport_bytes_store", len(blob))
+    publish_blob(store, key, header, blob)
+    return None
+
+
+def fetch_handoff(store, transport: Optional[KVTransport], key: str,
+                  kv_ep=None, timeout: float = 5.0) -> Tuple[dict, bytes]:
+    """Fetch a handoff blob from wherever ``kv_ep`` says it lives.
+    Raises TimeoutError (retryable — router re-places) when absent on
+    either plane, including the mixed-config case of a socket locator
+    with no local transport."""
+    from paddle_tpu import stats
+    if kv_ep:
+        if transport is None:
+            raise TimeoutError(
+                f"handoff {key} lives on the socket plane at "
+                f"{kv_ep[0]}:{kv_ep[1]} but this replica has no "
+                f"transport (PT_KV_TRANSPORT mismatch)")
+        return transport.fetch(kv_ep[0], int(kv_ep[1]), key,
+                               timeout=timeout)
+    header, blob = fetch_blob(store, key, timeout=timeout)
+    stats.add("serve/kv_transport_bytes_store", len(blob))
+    return header, blob
+
+
+def delete_handoff(store, transport: Optional[KVTransport], key: str,
+                   kv_ep=None, nchunks: Optional[int] = None):
+    """Withdraw an installed handoff blob from its plane."""
+    if kv_ep:
+        if transport is not None:
+            transport.delete(kv_ep[0], int(kv_ep[1]), key)
+        return
+    delete_blob(store, key, nchunks=nchunks)

@@ -37,17 +37,376 @@ router process, so results survive any replica's death):
 import json
 import os
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from paddle_tpu.distributed import resilience
+from paddle_tpu.distributed.liveness import ProgressJudge
 from paddle_tpu.distributed.membership import ReplicaDirectory
 
-__all__ = ["Router", "serve_replica", "router_port"]
+__all__ = ["Router", "serve_replica", "router_port", "RouterLink",
+           "ReplicaSession", "write_endpoint_file", "read_endpoint_file"]
 
 
 def router_port() -> int:
     """The router control-plane TCPStore port
     (``PT_SERVE_ROUTER_PORT``)."""
     return int(os.environ.get("PT_SERVE_ROUTER_PORT", "8997"))
+
+
+# ---------------------------------------------------------------------------
+# Router failover plumbing (ISSUE 17, docs/fleet-ha.md)
+# ---------------------------------------------------------------------------
+
+_ROUTER_HB_KEY = "serve/router_hb"
+
+
+def write_endpoint_file(path: str, host: str, port: int, gen: int,
+                        pid: Optional[int] = None):
+    """Atomically publish a router generation's store endpoint:
+    ``{"host", "port", "gen", "pid"}`` via tmp-file + rename, so a
+    replica polling the file never reads a torn record. Each new
+    router generation writes ``gen = prior + 1``."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"host": host, "port": int(port), "gen": int(gen),
+                   "pid": int(pid if pid is not None else os.getpid())},
+                  f)
+    os.replace(tmp, path)
+
+
+def read_endpoint_file(path: Optional[str]) -> Optional[dict]:
+    """The current endpoint record, or None (absent / torn / no path)."""
+    if not path:
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class RouterLink:
+    """A replica's view of the control plane ACROSS router generations.
+
+    Wraps the store connection in a `resilience.GuardedStore` and runs
+    the reconnect state machine (docs/fleet-ha.md):
+
+    - router liveness is judged on the ``serve/router_hb`` counter the
+      router bumps every poll, through the shared
+      `liveness.ProgressJudge` — counter progress vs THIS process's
+      monotonic clock, never a wall clock;
+    - a failed/stuck store op flips the link ``partitioned``
+      (`note_partition`); while partitioned the serve loops skip
+      control-plane IO, buffer results, and keep decoding;
+    - :meth:`check` (throttled) watches the endpoint file for a new
+      router generation — on one it dials the fresh store and swaps it
+      into the GuardedStore (``reconnected``); otherwise it probes the
+      current store (``healed`` when a same-generation partition
+      clears).
+    """
+
+    def __init__(self, store, endpoint_file: Optional[str] = None,
+                 router_dead_after: float = 5.0):
+        self.store = store if isinstance(store, resilience.GuardedStore) \
+            else resilience.GuardedStore(store)
+        self.endpoint_file = endpoint_file if endpoint_file is not None \
+            else (os.environ.get("PT_ROUTER_ENDPOINT_FILE") or None)
+        ep = read_endpoint_file(self.endpoint_file)
+        self.generation = int(ep["gen"]) if ep else 0
+        self.router_dead_after = float(router_dead_after)
+        self.partitioned = False
+        self._judge = ProgressJudge()
+        self._last_check = 0.0
+
+    def note_partition(self, err=None):
+        """A store op just failed its whole retry budget: enter
+        partition mode (flight-recorded once per transition)."""
+        if not self.partitioned:
+            from paddle_tpu import stats
+            from paddle_tpu.observability import flight
+            stats.add("serve/link_partitions")
+            flight.record("link", "partition",
+                          gen=self.generation,
+                          error=(str(err) if err else None))
+        self.partitioned = True
+
+    def router_alive(self) -> bool:
+        """True while the router's liveness counter keeps progressing
+        (fed by :meth:`check`'s probes)."""
+        if not self._judge.has("router"):
+            return False
+        stalled = self._judge.stalled_for("router")
+        return stalled is not None and stalled <= self.router_dead_after
+
+    def _fresh_endpoint(self) -> Optional[dict]:
+        ep = read_endpoint_file(self.endpoint_file)
+        if ep and int(ep.get("gen", 0)) > self.generation:
+            return ep
+        return None
+
+    def _reconnect(self, ep: dict) -> str:
+        from paddle_tpu import native, stats
+        from paddle_tpu.observability import flight
+        try:
+            raw = native.TCPStore(ep["host"], int(ep["port"]),
+                                  is_master=False)
+        except (ConnectionError, OSError, RuntimeError):
+            # endpoint published but not accepting yet (standby still
+            # binding): stay in the current state, retry next check
+            return "partitioned" if self.partitioned else "ok"
+        self.store.swap(raw)
+        self.generation = int(ep["gen"])
+        self.partitioned = False
+        self._judge.forget("router")
+        stats.add("serve/link_reconnects")
+        flight.record("link", "reconnect", gen=self.generation)
+        return "reconnected"
+
+    def check(self, min_interval_s: float = 0.25) -> str:
+        """Advance the state machine (call once per loop iteration;
+        internally throttled). Returns ``ok`` | ``partitioned`` |
+        ``healed`` | ``reconnected`` — the two transition states fire
+        exactly once so the caller can run its recovery actions."""
+        now = time.monotonic()
+        if now - self._last_check < min_interval_s:
+            return "partitioned" if self.partitioned else "ok"
+        self._last_check = now
+        ep = self._fresh_endpoint()
+        if ep is not None:
+            st = self._reconnect(ep)
+            if st == "reconnected":
+                return st
+        val = self.store.probe(_ROUTER_HB_KEY)
+        if val is None:
+            self.note_partition()
+            return "partitioned"
+        self._judge.update("router", val, now=now)
+        if self.partitioned:
+            from paddle_tpu import stats
+            from paddle_tpu.observability import flight
+            self.partitioned = False
+            stats.add("serve/link_heals")
+            flight.record("link", "heal", gen=self.generation)
+            return "healed"
+        return "ok"
+
+
+class ReplicaSession:
+    """Shared replica-side control-plane state for the three serve
+    loops (`serve_replica` + the disagg role loops): guarded store IO
+    that degrades instead of raising, the reconnect recovery actions,
+    a bounded flight-recorded result buffer, and (optionally) this
+    replica's socket KV transport endpoint.
+
+    Partition contract (tentpole 2): every method that touches the
+    store catches `resilience.StorePartitioned`, flips the link into
+    partition mode, and returns something inert — a store blip costs
+    missed heartbeats and buffered results, never replica suicide, and
+    in-flight decode keeps stepping.
+
+    Reconnect contract (tentpole 1): on a new router generation the
+    session re-announces membership + lifecycle state, restarts the
+    mailbox cursor at zero, re-publishes every RETAINED terminal result
+    (the new router answers journal-recovered ids from them —
+    first-result-wins), and re-publishes fleet prefix pages via the
+    engine's ``fleet_republish`` hook.
+    """
+
+    RESULT_RETAIN = 256
+
+    def __init__(self, store, rid: str, meta: dict, transport=None,
+                 endpoint_file: Optional[str] = None, engine=None,
+                 fleet=None):
+        self.link = store if isinstance(store, RouterLink) \
+            else RouterLink(store, endpoint_file=endpoint_file)
+        self.store = self.link.store
+        self.rid = rid
+        self.meta = dict(meta)
+        self.transport = transport
+        if transport is not None:
+            self.meta["kv_ep"] = list(transport.locator())
+        self.engine = engine
+        self.fleet = fleet
+        if fleet is not None:
+            # route the prefix directory through the SAME guarded store
+            # client: its ops degrade on partition and automatically
+            # follow swap() to the next router generation's endpoint
+            fleet.store = self.store
+        self.directory = ReplicaDirectory(self.store)
+        self.seen = 0
+        self.state = "up"               # local lifecycle mirror
+        self._results: "OrderedDict[str, dict]" = OrderedDict()
+        self._pending: Dict[str, dict] = {}   # buffered during partition
+
+    @property
+    def partitioned(self) -> bool:
+        return self.link.partitioned
+
+    def close(self):
+        if self.transport is not None:
+            self.transport.close()
+
+    # -- control-plane IO (degrading) -----------------------------------
+
+    def announce(self):
+        self.directory.announce(self.rid, self.meta)
+
+    def heartbeat(self, load: Optional[dict] = None,
+                  stats_export: Optional[dict] = None):
+        if self.link.partitioned:
+            return
+        try:
+            self.directory.heartbeat(self.rid, load=load,
+                                     stats=stats_export)
+        except resilience.StorePartitioned as e:
+            self.link.note_partition(e)
+
+    def lifecycle(self) -> str:
+        """The directory's lifecycle state for this replica (the local
+        mirror while partitioned — a partition must not un-drain)."""
+        if self.link.partitioned:
+            return self.state
+        try:
+            s = self.directory.state(self.rid)
+        except resilience.StorePartitioned as e:
+            self.link.note_partition(e)
+            return self.state
+        if s != "up" or self.state == "up":
+            self.state = s
+        return self.state
+
+    def set_state(self, state: str):
+        self.state = state
+        if self.link.partitioned:
+            return
+        try:
+            self.directory.set_state(self.rid, state)
+        except resilience.StorePartitioned as e:
+            self.link.note_partition(e)
+
+    def shutdown_requested(self) -> bool:
+        if self.link.partitioned:
+            return False
+        try:
+            return _shutdown_requested(self.store)
+        except resilience.StorePartitioned as e:
+            self.link.note_partition(e)
+            return False
+
+    def pump_mailbox(self) -> List[dict]:
+        """Drain new mailbox messages. Duplicates of already-finished
+        requests (a journal-recovered router re-placing at-least-once)
+        are answered from the retained results instead of re-serving."""
+        if self.link.partitioned:
+            return []
+        try:
+            self.seen, msgs = _mailbox_pump(self.store, self.rid,
+                                            self.seen)
+        except resilience.StorePartitioned as e:
+            self.link.note_partition(e)
+            return []
+        out = []
+        for msg in msgs:
+            req_id = msg.get("id")
+            if req_id is not None and req_id in self._results:
+                from paddle_tpu import stats
+                stats.add("serve/dup_replays_answered")
+                self.publish(req_id, self._results[req_id])
+                continue
+            out.append(msg)
+        return out
+
+    def pump_transport(self, budget: int = 8):
+        if self.transport is not None:
+            self.transport.pump(budget)
+
+    # -- results (buffered through partitions) --------------------------
+
+    def publish(self, req_id: str, result: dict, terminal: bool = True):
+        """Publish a result; a partition buffers it (bounded,
+        flight-recorded) for the flush on heal/reconnect. ``terminal``
+        results are additionally RETAINED for duplicate-replay answers
+        and re-publication to a new router generation."""
+        if terminal:
+            self._results[req_id] = result
+            self._results.move_to_end(req_id)
+            while len(self._results) > self.RESULT_RETAIN:
+                old, _ = self._results.popitem(last=False)
+                self._pending.pop(old, None)
+        if self.link.partitioned:
+            self._buffer(req_id, result)
+            return
+        try:
+            _publish(self.store, self.rid, req_id, result)
+            self._pending.pop(req_id, None)
+        except resilience.StorePartitioned as e:
+            self.link.note_partition(e)
+            self._buffer(req_id, result)
+
+    def _buffer(self, req_id: str, result: dict):
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight
+        self._pending[req_id] = result
+        stats.add("serve/results_buffered")
+        flight.record(req_id, "result-buffered", replica=self.rid,
+                      pending=len(self._pending))
+
+    # -- the per-iteration state pump -----------------------------------
+
+    def maintain(self) -> str:
+        """Advance the link state machine and run the matching recovery
+        actions; returns the link status for the loop's bookkeeping."""
+        st = self.link.check()
+        if st == "reconnected":
+            self._recover(new_generation=True)
+        elif st == "healed":
+            self._recover(new_generation=False)
+        return st
+
+    def _recover(self, new_generation: bool):
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight
+        try:
+            if new_generation:
+                # fresh store: re-announce membership + lifecycle,
+                # restart the mailbox cursor, drop stale TRANSIENT
+                # buffered statuses (the new router re-places journaled
+                # outstanding work from scratch anyway), and republish
+                # every retained terminal result + the prefix pages
+                self.seen = 0
+                self.directory.announce(self.rid, self.meta)
+                if self.state != "up":
+                    self.directory.set_state(self.rid, self.state)
+                self._pending = {q: r for q, r in self._pending.items()
+                                 if q in self._results}
+                republish = dict(self._results)
+                republish.update(self._pending)
+                if self.fleet is not None:
+                    try:
+                        self.fleet.reset_published()
+                    except Exception:
+                        pass
+                if self.engine is not None and hasattr(
+                        self.engine, "fleet_republish"):
+                    try:
+                        self.engine.fleet_republish()
+                    except Exception:
+                        pass
+            else:
+                republish = dict(self._pending)
+            n = 0
+            for req_id, res in republish.items():
+                _publish(self.store, self.rid, req_id, res)
+                self._pending.pop(req_id, None)
+                n += 1
+            if n:
+                stats.add("serve/results_republished", n)
+            flight.record(self.rid, "link-recovered",
+                          new_generation=new_generation, republished=n,
+                          gen=self.link.generation)
+        except resilience.StorePartitioned as e:
+            self.link.note_partition(e)
 
 
 class Router:
@@ -62,7 +421,9 @@ class Router:
     """
 
     def __init__(self, store=None, host: str = "127.0.0.1",
-                 port: Optional[int] = None, dead_after: float = 2.0):
+                 port: Optional[int] = None, dead_after: float = 2.0,
+                 endpoint_file: Optional[str] = None,
+                 journal=None):
         if store is None:
             from paddle_tpu import native
             store = native.TCPStore(
@@ -71,8 +432,29 @@ class Router:
             self._owns_store = True
         else:
             self._owns_store = False
-        self.store = store
-        self.directory = ReplicaDirectory(store)
+        # every router store op rides the ONE shared deadline-guarded
+        # helper (GuardedStore): transient transport errors retry with
+        # backoff, a dead store surfaces as StorePartitioned instead of
+        # a raw socket error deep inside poll()
+        self.store = resilience.GuardedStore(store)
+        # failover plumbing (docs/fleet-ha.md): the endpoint file
+        # advertises THIS generation's store to reconnecting replicas;
+        # the journal makes the intake reconstructible (recover())
+        self.endpoint_file = endpoint_file if endpoint_file is not None \
+            else (os.environ.get("PT_ROUTER_ENDPOINT_FILE") or None)
+        self.generation = 1
+        if self.endpoint_file and self._owns_store:
+            prior = read_endpoint_file(self.endpoint_file)
+            self.generation = (int(prior["gen"]) if prior else 0) + 1
+            write_endpoint_file(self.endpoint_file, host=host,
+                                port=self.store.port,
+                                gen=self.generation)
+        self.journal = None
+        if journal is not None:
+            from paddle_tpu.serving.scheduler import RequestJournal
+            self.journal = journal if isinstance(journal, RequestJournal) \
+                else RequestJournal(journal)
+        self.directory = ReplicaDirectory(self.store)
         self.dead_after = float(dead_after)
         self._seq = 0
         self._payload: Dict[str, dict] = {}      # req_id -> request json
@@ -118,6 +500,9 @@ class Router:
         # its own drain decision against a stale cache entry.
         self._state_cache: Dict[str, tuple] = {}  # rid -> (state, t)
         self._state_ttl_s = 0.25
+        # socket-plane handoff locators: req_id -> [host, port] of the
+        # replica whose outbox holds the blob (None = store plane)
+        self._kv_src: Dict[str, Optional[list]] = {}
 
     # -- membership ---------------------------------------------------------
 
@@ -161,6 +546,49 @@ class Router:
             f"only {len(self.replicas())}/{n} replicas announced "
             f"within {timeout}s")
 
+    # -- failover recovery --------------------------------------------------
+
+    def recover(self) -> int:
+        """Rebuild this (fresh) router generation's state from the
+        request journal: journaled submits without a terminal result
+        re-enter placement (parked in ``_unplaced`` until replicas
+        reconnect — poll() retries them every call); journaled results
+        are final (first-result-wins across generations — a replica
+        re-publishing the same id later is deduped exactly like a
+        same-generation duplicate). Returns the number of outstanding
+        requests re-queued. Deadline budgets restart at recovery time:
+        the journal records no clocks, and a stricter restart would
+        time out work the failover itself delayed."""
+        from paddle_tpu import stats
+        from paddle_tpu.observability import flight
+        from paddle_tpu.serving.scheduler import RequestJournal
+        if self.journal is None:
+            return 0
+        payloads, results = RequestJournal.replay(self.journal.path)
+        now = time.monotonic()
+        pc = time.perf_counter()
+        n_out = 0
+        for req_id, payload in payloads.items():
+            self._payload.setdefault(req_id, payload)
+            try:
+                self._seq = max(self._seq,
+                                int(req_id.rsplit("-", 1)[1]))
+            except (ValueError, IndexError):
+                pass
+            if req_id in results or req_id in self.results:
+                continue
+            n_out += 1
+            self._t_submit.setdefault(req_id, now)
+            self._t_submit_pc.setdefault(req_id, pc)
+            self._phase[req_id] = "serve"   # re-place from scratch
+            self._unplaced.add(req_id)
+            flight.record(req_id, "journal-recover",
+                          gen=self.generation)
+        for req_id, res in results.items():
+            self.results.setdefault(req_id, res)
+        stats.add("serve/router_recovered", n_out)
+        return n_out
+
     # -- placement ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -183,6 +611,10 @@ class Router:
         from paddle_tpu.observability import flight
         flight.record(req_id, "submit", prompt=len(prompt),
                       budget=int(max_new_tokens), deadline_s=deadline_s)
+        if self.journal is not None:
+            # journal BEFORE placement: an accepted id must survive a
+            # router SIGKILL even if the placement write never lands
+            self.journal.append_submit(self._payload[req_id])
         self._place(req_id)
         stats.add("serve/router_requests")
         return req_id
@@ -321,7 +753,10 @@ class Router:
             if rid is not None:
                 self._send(rid, req_id, {
                     "kind": "handoff", "id": req_id,
-                    "deadline_s": self._remaining_deadline(req_id)})
+                    "deadline_s": self._remaining_deadline(req_id),
+                    # socket-plane locator: where the sender's outbox
+                    # holds the blob (None = chunked store fetch)
+                    "kv_ep": self._kv_src.get(req_id)})
                 return
             # no decode replica alive: fall through to whole-request
             # placement (the handoff blob is abandoned; at-least-once)
@@ -376,6 +811,14 @@ class Router:
         entries beyond its per-replica cursor."""
         from paddle_tpu import native, stats
         from paddle_tpu.observability import flight, trace
+        from paddle_tpu.testing import faults
+        # chaos hook: PT_FAULTS="router.die:kill:after=N" drops the
+        # coordinator mid-traffic — failover (endpoint file + journal
+        # recovery) must preserve every request id
+        faults.fire("router.die")
+        # router-liveness counter: replicas' RouterLinks judge progress
+        # on this (ProgressJudge) to tell a dead router from a slow one
+        self.store.add(_ROUTER_HB_KEY, 1)
         fresh = {}
         for req_id in list(self._unplaced):
             if req_id not in self.results:
@@ -415,6 +858,7 @@ class Router:
                                   error=res.get("error"))
                     flight.dump(req_id, "handoff-failed")
                     self._phase[req_id] = "serve"
+                    self._kv_src.pop(req_id, None)   # blob unusable
                     self._try_place(req_id)
                     stats.add("serve/router_handoff_retries")
                     continue
@@ -434,6 +878,8 @@ class Router:
                                   kv=bool(res.get("kv")))
                     self._phase[req_id] = (
                         "decode" if res.get("kv") else "serve")
+                    if res.get("kv"):
+                        self._kv_src[req_id] = res.get("kv_ep")
                     self._refresh_loads()
                     self._try_place(req_id)
                     stats.add("serve/router_migrated")
@@ -451,6 +897,7 @@ class Router:
                         self._outstanding[owner] = max(
                             0, self._outstanding.get(owner, 0) - 1)
                     self._phase[req_id] = "decode"
+                    self._kv_src[req_id] = res.get("kv_ep")
                     flight.record(req_id, "prefill-done",
                                   replica=res.get("replica"))
                     self._refresh_loads()
@@ -459,6 +906,9 @@ class Router:
                     continue
                 self.results[req_id] = res
                 fresh[req_id] = res
+                self._kv_src.pop(req_id, None)
+                if self.journal is not None:
+                    self.journal.append_result(req_id, res)
                 # close the request's client-observed window on the
                 # stitched timeline (submit → result pickup)
                 t0 = self._t_submit_pc.pop(req_id, None)
@@ -553,6 +1003,8 @@ class Router:
             pass
 
     def close(self):
+        if self.journal is not None:
+            self.journal.close()
         if self._owns_store:
             self.store.close()
 
@@ -603,15 +1055,21 @@ def drain_migrate_enabled() -> bool:
     return os.environ.get("PT_DRAIN_MIGRATE", "1") != "0"
 
 
-def _migrate_open_requests(store, rid: str, frontend, open_reqs):
+def _migrate_open_requests(store, rid: str, frontend, open_reqs,
+                           sess: Optional[ReplicaSession] = None):
     """Drain migration, sending half (docs/elastic.md): try to move
     every open request off this draining replica. Slot-holding
     requests leave with their KV rows + token history over the fp32
-    wire (``serve/kv/<req_id>`` blob — the survivor continues
-    bit-for-bit); still-queued ones leave as bare ids (the router
-    re-places them from scratch). Either way the sender publishes a
-    NON-terminal ``migrated`` result the router turns into the next
-    placement, so no request id is ever lost.
+    wire (the ``serve/kv/<req_id>`` blob on the configured data plane —
+    the survivor continues bit-for-bit); still-queued ones leave as
+    bare ids (the router re-places them from scratch). Either way the
+    sender publishes a NON-terminal ``migrated`` result the router
+    turns into the next placement, so no request id is ever lost.
+
+    With a `ReplicaSession` the blob rides the socket KV plane (the
+    ``migrated`` result carries the sender's ``kv_ep`` locator) and
+    the result publication degrades through partitions; without one
+    (in-process tests) the PR 16 store path is unchanged.
 
     Per-request fallback: any failure — the ``drain.migrate`` chaos
     site firing, detach refusing (mid-prefill, completed during the
@@ -624,6 +1082,7 @@ def _migrate_open_requests(store, rid: str, frontend, open_reqs):
     from paddle_tpu.observability import flight, trace
     from paddle_tpu.serving import kv_transfer
     from paddle_tpu.testing import faults
+    transport = sess.transport if sess is not None else None
     for req_id, sreq in list(open_reqs.items()):
         if sreq.done:
             continue                 # the generic publisher owns it
@@ -639,6 +1098,7 @@ def _migrate_open_requests(store, rid: str, frontend, open_reqs):
             continue
         if got is None:
             continue                 # can't move yet; retried next loop
+        kv_ep = None
         try:
             if got["kv"]:
                 meta = got["meta"]
@@ -654,13 +1114,14 @@ def _migrate_open_requests(store, rid: str, frontend, open_reqs):
                     # truncate) — the receiver's digest check must turn
                     # it into handoff-failed, never installed state
                     blob = faults.transform("drain.migrate", blob)
-                kv_transfer.publish_blob(store, f"serve/kv/{req_id}",
-                                         header, blob)
+                kv_ep = kv_transfer.send_handoff(
+                    store, transport, f"serve/kv/{req_id}", header, blob)
                 trace.complete("serve/kv_publish", t0, rid=req_id,
                                bytes=len(blob))
                 flight.record(req_id, "migrate-publish",
                               bytes=len(blob),
-                              generated=len(meta["tokens"]))
+                              generated=len(meta["tokens"]),
+                              plane=("socket" if kv_ep else "store"))
         except Exception as e:
             # the request is already detached; a publish failure is
             # still safe — the router's handoff-failed / re-place path
@@ -671,56 +1132,76 @@ def _migrate_open_requests(store, rid: str, frontend, open_reqs):
         stats.add("serve/drain_migrated")
         flight.record("fleet", "migrate", request=req_id, replica=rid,
                       kv=bool(got["kv"]))
-        _publish(store, rid, req_id, {
-            "id": req_id, "tokens": [], "status": "migrated",
-            "kv": bool(got["kv"]), "error": None, "replica": rid})
+        result = {"id": req_id, "tokens": [], "status": "migrated",
+                  "kv": bool(got["kv"]), "kv_ep": kv_ep,
+                  "error": None, "replica": rid}
+        if sess is not None:
+            sess.publish(req_id, result, terminal=False)
+        else:
+            _publish(store, rid, req_id, result)
         del open_reqs[req_id]
 
 
-def _install_handoff(store, rid: str, directory, frontend, msg):
+def _install_handoff(store, rid: str, directory, frontend, msg,
+                     sess: Optional[ReplicaSession] = None):
     """Receiving half of a KV handoff on a symmetric replica (the
-    disagg decode loop keeps its own copy): fetch the blob, decode the
-    pages, admit via ``frontend.submit_handoff``. Publishes
-    ``handoff-failed`` (retryable — the router re-places from scratch)
-    on a missing/corrupt blob, ``rejected-invalid`` (terminal) on an
+    disagg decode loop keeps its own copy): fetch the blob from
+    whichever data plane the message's ``kv_ep`` locator names (socket
+    outbox / chunked store), decode the pages, admit via
+    ``frontend.submit_handoff``. Publishes ``handoff-failed``
+    (retryable — the router re-places from scratch) on a
+    missing/corrupt blob, ``rejected-invalid`` (terminal) on an
     infeasible request. Returns the admitted request or None."""
     import time as _time
     from paddle_tpu import stats
     from paddle_tpu.observability import flight, trace
     from paddle_tpu.serving import kv_transfer
     req_id = msg["id"]
+    kv_ep = msg.get("kv_ep")
+    transport = sess.transport if sess is not None else None
     try:
         t0 = _time.perf_counter()
         try:
             # bounded below dead_after-scale stalls, heartbeat after
             # either way — a slow fetch must not get this healthy
             # replica death-swept
-            header, blob = kv_transfer.fetch_blob(
-                store, f"serve/kv/{req_id}", timeout=2.0)
+            header, blob = kv_transfer.fetch_handoff(
+                store, transport, f"serve/kv/{req_id}", kv_ep=kv_ep,
+                timeout=2.0)
         finally:
-            directory.heartbeat(rid)
+            if sess is not None:
+                sess.heartbeat()
+            else:
+                directory.heartbeat(rid)
         k, v = kv_transfer.decode_kv_pages(header, blob)
         stats.observe("serve/kv_transfer_s",
                       _time.perf_counter() - t0)
         trace.complete("serve/kv_transfer", t0, rid=req_id,
                        bytes=len(blob))
         flight.record(req_id, "handoff-fetch", bytes=len(blob),
-                      wire=header.get("wire"))
+                      wire=header.get("wire"),
+                      plane=("socket" if kv_ep else "store"))
         req = frontend.submit_handoff(
             header["handoff"], k, v, deadline_s=msg.get("deadline_s"),
             req_id=req_id)
-        kv_transfer.delete_blob(store, f"serve/kv/{req_id}",
-                                nchunks=int(header.get("nchunks", 0)))
+        kv_transfer.delete_handoff(store, transport,
+                                   f"serve/kv/{req_id}", kv_ep=kv_ep,
+                                   nchunks=int(header.get("nchunks", 0)))
         return req
-    except (TimeoutError, ValueError, RuntimeError) as e:
-        # missing blob, digest mismatch (in-transit corruption), or an
-        # infeasible install: RETRYABLE — the router re-places the
-        # request from scratch; at-least-once keeps the id accounted
+    except (TimeoutError, ValueError, RuntimeError,
+            resilience.StorePartitioned) as e:
+        # missing blob, digest mismatch (in-transit corruption), a
+        # partitioned store mid-fetch, or an infeasible install:
+        # RETRYABLE — the router re-places the request from scratch;
+        # at-least-once keeps the id accounted
         flight.record(req_id, "handoff-failed", error=str(e))
         flight.dump(req_id, "handoff-failed")
-        _publish(store, rid, req_id, {
-            "id": req_id, "tokens": [], "status": "handoff-failed",
-            "error": str(e), "replica": rid})
+        result = {"id": req_id, "tokens": [], "status": "handoff-failed",
+                  "error": str(e), "replica": rid}
+        if sess is not None:
+            sess.publish(req_id, result, terminal=False)
+        else:
+            _publish(store, rid, req_id, result)
         return None
 
 
@@ -752,12 +1233,16 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
     """
     from paddle_tpu import stats
     from paddle_tpu.observability import runtime
+    from paddle_tpu.serving import kv_transfer
     from paddle_tpu.serving.disagg import queue_age_s, replica_load
     from paddle_tpu.testing import faults
-    directory = ReplicaDirectory(store)
-    directory.announce(rid, {"pid": os.getpid(),
-                             "slots": frontend.engine.S})
-    seen = 0
+    sess = ReplicaSession(
+        store, rid,
+        meta={"pid": os.getpid(), "slots": frontend.engine.S},
+        transport=kv_transfer.maybe_transport(),
+        engine=frontend.engine,
+        fleet=getattr(frontend.engine, "fleet", None))
+    sess.announce()
     open_reqs: Dict[str, object] = {}
     idle_since = time.monotonic()
     last_load = 0.0
@@ -767,30 +1252,36 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
         # after=N" SIGKILL-equivalently drops this replica mid-serve —
         # the fleet controller must heal it with zero request-id loss
         faults.fire("serve.loop")
+        # partition / failover state machine: probes the router's
+        # liveness counter, watches the endpoint file, and on a new
+        # router generation re-announces + republishes buffered results
+        sess.maintain()
+        sess.pump_transport()
         now = time.monotonic()
         if now - last_load >= load_refresh_s:
             runtime.hbm_gauges()
-            directory.heartbeat(rid, load=replica_load(
+            sess.heartbeat(load=replica_load(
                 frontend.engine, "both",
                 queued=len(frontend._queue) + frontend.engine.queued,
                 queue_age_s=queue_age_s(frontend=frontend)),
-                stats=stats.export())
+                stats_export=stats.export())
             last_load = now
-            draining = draining or directory.state(rid) == "draining"
+            draining = draining or sess.lifecycle() == "draining"
         else:
-            directory.heartbeat(rid)
+            sess.heartbeat()
         # mailbox BEFORE the drain/shutdown exit checks: a request the
         # router placed just before the drain decision may still sit
         # unconsumed here — exiting first would strand it until the
         # death sweep, a dead_after-sized latency cliff on a request
         # the drain protocol promises to finish
-        seen, msgs = _mailbox_pump(store, rid, seen)
-        for msg in msgs:
+        for msg in sess.pump_mailbox():
+            if msg.get("id") in open_reqs:
+                continue        # duplicate re-place of in-flight work
             if msg.get("kind") == "handoff":
                 # a draining peer's mid-decode migration landing here
                 # (the router picked this replica as the survivor)
-                req = _install_handoff(store, rid, directory, frontend,
-                                       msg)
+                req = _install_handoff(sess.store, rid, sess.directory,
+                                       frontend, msg, sess=sess)
                 if req is not None:
                     open_reqs[msg["id"]] = req
                 continue
@@ -806,7 +1297,7 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
                 # the router would redistribute the same poison payload
                 # to the next replica, and one bad client request would
                 # cascade through the whole fleet
-                _publish(store, rid, msg["id"], {
+                sess.publish(msg["id"], {
                     "id": msg["id"], "tokens": [],
                     "status": "rejected-invalid", "error": str(e),
                     "replica": rid})
@@ -816,24 +1307,34 @@ def serve_replica(store, rid: str, frontend, poll_s: float = 0.02,
             # migrate in-flight work to survivors instead of finishing
             # it here: drain latency becomes migration time, not
             # longest-request time (per-request fallback inside)
-            _migrate_open_requests(store, rid, frontend, open_reqs)
+            _migrate_open_requests(sess.store, rid, frontend, open_reqs,
+                                   sess=sess)
         if draining and not open_reqs and not frontend.busy:
-            directory.set_state(rid, "drained")
+            sess.set_state("drained")
+            sess.close()
             return
-        if _shutdown_requested(store) and not open_reqs \
+        if sess.shutdown_requested() and not open_reqs \
                 and not frontend.busy:
+            sess.close()
             return
         if frontend.busy:
+            # in-flight decode continues straight through a partition —
+            # the whole point of degrading instead of dying
             frontend.step()
             idle_since = time.monotonic()
         else:
+            if sess.partitioned:
+                # never idle-exit into a partition: the router may be
+                # mid-failover and about to re-place work here
+                idle_since = time.monotonic()
             if (max_idle_s is not None
                     and time.monotonic() - idle_since > max_idle_s):
+                sess.close()
                 return
             time.sleep(poll_s)
         for req_id, req in list(open_reqs.items()):
             if req.done:
-                _publish(store, rid, req_id, {
+                sess.publish(req_id, {
                     "id": req_id, "tokens": list(req.tokens),
                     "status": req.status, "error": req.error,
                     "replica": rid})

@@ -45,7 +45,7 @@ import time
 from typing import Iterator, List, Optional
 
 __all__ = ["FrontEnd", "ServeRequest", "dynamic_bucket",
-           "projected_ttft"]
+           "projected_ttft", "RequestJournal"]
 
 # terminal statuses a ServeRequest can reach
 _TERMINAL = ("done", "failed", "rejected-queue-full",
@@ -532,3 +532,78 @@ class FrontEnd:
 
     def results(self) -> List[ServeRequest]:
         return list(self._all)
+
+
+class RequestJournal:
+    """FrontEnd-side request journal: the durable half of router
+    failover (docs/fleet-ha.md).
+
+    The router's in-memory placement state is disposable — replicas
+    hold the real work — but the *intake* is not: a request accepted
+    from a client must survive the router process. The journal is an
+    append-only JSONL file the submitting side writes before placement
+    and after every terminal result::
+
+        {"kind": "submit", "id": "rq-000007", "prompt": [...], ...}
+        {"kind": "result", "id": "rq-000007", "result": {...}}
+
+    A restarted router replays it (:meth:`replay`): payloads without a
+    terminal result are re-placed (at-least-once — the PR 9
+    redistribution idiom across router generations; first result wins),
+    payloads with one are already answered. ``flush()`` after every
+    append puts records in the OS page cache, which survives a router
+    SIGKILL (the failure this protects against); host crashes are the
+    checkpoint layer's problem, not the serving plane's.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a", encoding="utf-8")
+
+    def append_submit(self, payload: dict) -> None:
+        import json
+        rec = {"kind": "submit"}
+        rec.update(payload)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def append_result(self, req_id: str, result: dict) -> None:
+        import json
+        self._f.write(json.dumps(
+            {"kind": "result", "id": req_id, "result": result}) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def replay(path: str):
+        """Parse a journal → ``(payloads, results)``: ``payloads`` maps
+        req_id → the original submit payload (journal bookkeeping keys
+        stripped), ``results`` maps req_id → its terminal result. A
+        torn final line (SIGKILL mid-append) is skipped — every
+        *complete* record before it is intact."""
+        import json
+        payloads, results = {}, {}
+        try:
+            f = open(path, "r", encoding="utf-8")
+        except OSError:
+            return payloads, results
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue        # torn tail record
+                if rec.get("kind") == "submit" and "id" in rec:
+                    p = {k: v for k, v in rec.items() if k != "kind"}
+                    payloads[rec["id"]] = p
+                elif rec.get("kind") == "result" and "id" in rec:
+                    results[rec["id"]] = rec.get("result") or {}
+        return payloads, results

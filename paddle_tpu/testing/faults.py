@@ -93,6 +93,17 @@ Sites currently wired into the runtime:
                           must catch) — failures fall back to
                           finish-in-place / handoff-failed re-place,
                           never a lost or corrupted stream
+    store.partition       resilience.GuardedStore — consulted once per
+                          op *attempt* (drop/raise = the op fails as if
+                          the store were unreachable; a ``count=N`` rule
+                          partitions N consecutive ops then heals;
+                          delay = a slow store). Serve loops must
+                          degrade to buffered results + missed
+                          heartbeats, never replica suicide
+    router.die            Router.poll head (kill = the coordinator
+                          SIGKILLs itself mid-traffic; failover +
+                          journal recovery must preserve every
+                          request id — docs/fleet-ha.md)
 """
 
 import os

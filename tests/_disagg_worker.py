@@ -50,6 +50,12 @@ def main():
                                            serve_prefill_replica,
                                            serve_decode_replica,
                                            fleet_enabled)
+    from paddle_tpu.testing import faults
+
+    # PT_FAULTS plumbing (the store-partition chaos tests drop this
+    # replica's control-plane ops mid-handoff and assert it degrades
+    # instead of dying)
+    faults.install_from_env()
 
     model = build_model()
     store = native.TCPStore("127.0.0.1", port)

@@ -1,0 +1,109 @@
+"""Router-hosting worker for the failover acceptance tests
+(tests/test_router_failover.py) and tools/ha_smoke.py: one process =
+one router GENERATION. The driver SIGKILLs/SIGSTOPs this process and
+spawns a successor pointed at the SAME --endpoint-file and --journal;
+the successor recovers the intake from the journal, re-places
+outstanding work, finishes the deterministic workload, and writes the
+final results JSON atomically to --results.
+
+The workload is regenerated from --seed every generation (submission
+order IS the request-id sequence), so a successor resumes submitting
+exactly where the journal's high-water mark says the dead generation
+stopped — request id ``rq-%06d`` maps to the same prompt in every
+generation.
+
+Usage:
+    python tests/_router_worker.py --endpoint-file EP --journal J \
+        --results OUT [--workload N] [--replicas K] [--seed S] \
+        [--max-new T] [--interval-ms MS] [--wait-file TOKEN] \
+        [--no-shutdown]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def workload_prompts(seed: int, n: int, vocab: int = 90):
+    """The deterministic workload: prompt i is the same in every
+    process that asks for (seed, n) — the control run, every router
+    generation, and the test's own expectations."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab,
+                         size=int(rng.integers(4, 12))).tolist()
+            for _ in range(n)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--endpoint-file", required=True)
+    ap.add_argument("--journal", required=True)
+    ap.add_argument("--results", required=True)
+    ap.add_argument("--workload", type=int, default=12)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--interval-ms", type=float, default=120.0)
+    ap.add_argument("--drain-timeout", type=float, default=150.0)
+    ap.add_argument("--wait-file", default=None,
+                    help="warm-standby contract: block until this "
+                         "token file exists before binding the store")
+    ap.add_argument("--no-shutdown", action="store_true",
+                    help="leave the replicas running on exit")
+    args = ap.parse_args()
+
+    if args.wait_file:
+        while not os.path.exists(args.wait_file):
+            time.sleep(0.02)
+
+    from paddle_tpu.serving import Router
+
+    router = Router(port=0, dead_after=15.0,
+                    endpoint_file=args.endpoint_file,
+                    journal=args.journal)
+    recovered = router.recover()
+    try:
+        router.wait_replicas(args.replicas, timeout=90.0)
+        prompts = workload_prompts(args.seed, args.workload)
+        # the journal restored _seq to the dead generation's high-water
+        # mark — resume the submission schedule from there
+        for i in range(router._seq, args.workload):
+            router.submit(prompts[i], max_new_tokens=args.max_new)
+            router.poll()
+            time.sleep(args.interval_ms / 1000.0)
+        results = router.drain(timeout=args.drain_timeout)
+        out = {"generation": router.generation,
+               "recovered": recovered,
+               "results": results}
+        tmp = f"{args.results}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(out, f)
+        os.replace(tmp, args.results)
+        if not args.no_shutdown:
+            router.shutdown()
+            # hold the store open until every replica has seen the
+            # shutdown key and drained — closing immediately would
+            # strand them in partition mode waiting on a successor
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    states = [router.directory.state(rid)
+                              for rid in router.directory.members()]
+                except Exception:
+                    break
+                if all(s != "up" for s in states):
+                    break
+                time.sleep(0.1)
+    finally:
+        router.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -136,6 +136,28 @@ def test_goodput_dip_is_lower_is_better():
     assert bd.direction("serve_drain_migrate_failed") == -1
 
 
+def test_failover_rows_direction_tagged():
+    """The router-failover bench rows (ISSUE 17): recovery time is a
+    cost, republished-result counts are informational (they scale
+    with where the kill lands, not with quality), and the failover
+    goodput/dip rows inherit the drain phase's tagging."""
+    assert bd.direction("fleet_churn_failover_recovery_s") == -1
+    v = bd.compare(_doc(fleet_churn_failover_recovery_s=0.01),
+                   _doc(fleet_churn_failover_recovery_s=0.50))
+    assert any(r == "fleet_churn_failover_recovery_s"
+               for r, _ in v["regressions"])
+    v = bd.compare(_doc(fleet_churn_failover_recovery_s=0.50),
+                   _doc(fleet_churn_failover_recovery_s=0.01))
+    assert v["regressions"] == []
+    assert bd.direction("fleet_churn_failover_republished") == 0
+    v = bd.compare(_doc(fleet_churn_failover_republished=6),
+                   _doc(fleet_churn_failover_republished=0))
+    assert v["regressions"] == []
+    assert bd.direction(
+        "fleet_churn_failover_goodput_tokens_per_sec") == 1
+    assert bd.direction("fleet_churn_failover_goodput_dip_frac") == -1
+
+
 def test_noise_table_widens_p99():
     # 20% swing on a p99 row sits inside the 25% noise band...
     v = bd.compare(_doc(serve_p99_ttft_ms=100.0),

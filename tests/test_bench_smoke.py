@@ -110,6 +110,15 @@ def test_bench_fleet_churn_smoke():
     assert out.get("fleet_churn_drain_migrated", 0) > 0, out
     assert out.get("fleet_churn_drain_latency_ms", -1) >= 0, out
     assert "fleet_churn_drain_goodput_dip_frac" in out, out
+    # router-failover phase (ISSUE 17): journal replay must complete
+    # every request (zero id loss through the simulated router death)
+    # with a measurable, bounded recovery
+    assert out.get("fleet_churn_failover_completed_frac", 0) == 1.0, out
+    assert out.get("fleet_churn_failover_goodput_tokens_per_sec",
+                   0) > 0, out
+    assert out.get("fleet_churn_failover_recovery_s", -1) >= 0, out
+    assert out.get("fleet_churn_failover_republished", -1) >= 0, out
+    assert "fleet_churn_failover_goodput_dip_frac" in out, out
     # reshape wall-clock rows (in-HBM vs checkpoint round trip) appear
     # whenever >= 4 devices are visible (conftest forces 8 on CPU)
     if len(jax.devices()) >= 4:

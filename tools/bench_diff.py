@@ -60,7 +60,7 @@ NOISE_TABLE = (
 # launches-per-token attribution carries tokens_per_...).
 _INFO = ("schema", "vs_baseline", "provenance", "skipped",
          "loss_delta", "launches_per_token", "autotune", "cache_hit",
-         "scan_layers", "captured_unix")
+         "scan_layers", "captured_unix", "republished")
 _HIGHER = ("tokens_per_sec", "tok_s", "goodput", "mfu", "hw_util",
            "tokens_per_step", "agreement", "cosine", "hit_rate",
            "hit_tokens", "roofline_frac", "vs_roofline",
@@ -68,7 +68,7 @@ _HIGHER = ("tokens_per_sec", "tok_s", "goodput", "mfu", "hw_util",
            "completed", "ips")
 _LOWER = ("_ms", "ttft", "tpot", "latency", "_tax_frac", "exposed_s",
           "peak_mb", "rejects", "evictions", "spawn_timeouts",
-          "host_gap")
+          "host_gap", "recovery_s")
 # checked BEFORE _HIGHER: rows whose name embeds a higher-is-better
 # fragment but measure a cost (the drain bench's goodput_dip_frac
 # contains "goodput" yet a bigger dip is a worse drain)

@@ -59,6 +59,13 @@
 #                          single-replica serving on the fp32 KV wire,
 #                          fleet prefix-hit counter nonzero on a
 #                          repeated-system-prompt workload (~1 min)
+#   tools/ci.sh ha         control-plane HA smoke (~1 min): SIGKILL
+#                          the router mid-traffic — the successor
+#                          generation replays the request journal, the
+#                          replicas reconnect via the endpoint file,
+#                          and the client sees every request id with
+#                          streams byte-identical to an undisturbed
+#                          control fleet
 #   tools/ci.sh elastic    elastic-fleet smoke (~90s): the controller
 #                          spawns a 2-replica fleet under Poisson load,
 #                          a SIGKILLed replica is healed with zero
@@ -151,6 +158,11 @@ fi
 if [[ "${1:-}" == "fleetobs" ]]; then
     shift
     exec python tools/fleet_obs_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "ha" ]]; then
+    shift
+    exec python tools/ha_smoke.py "$@"
 fi
 
 if [[ "${1:-}" == "elastic" ]]; then
