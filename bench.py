@@ -307,14 +307,15 @@ def main():
     # bench_serve runs after the decode/longctx headline rows: its four
     # warmup-compiled engines are not cheap, and a tight budget must
     # truncate the NEW row, not the established ladder
-    # bench_serve_disagg then bench_fleet_churn are the newest rows and
-    # run LAST (PR 7/9/11/12 budget-truncation rule): a tight budget
-    # truncates them, never the established ladder above them
+    # bench_serve_disagg, bench_fleet_churn, then bench_train_numerics
+    # are the newest rows and run LAST (PR 7/9/11/12 budget-truncation
+    # rule): a tight budget truncates them, never the established
+    # ladder above them
     for sub in (bench_bert, bench_resnet50, bench_ppyoloe, bench_pp,
                 bench_decode, bench_longctx, bench_serve,
                 bench_train_sharded_stacked, bench_train_quant_comm,
                 bench_train_overlap, bench_serve_disagg,
-                bench_fleet_churn):
+                bench_fleet_churn, bench_train_numerics):
         name = sub.__name__.replace("bench_", "")
         if only and name not in only:
             continue
@@ -1343,6 +1344,89 @@ def bench_train_overlap(jax, jnp, peak, smoke=False):
             if not was:
                 trace.disable()
     finally:
+        mesh_lib.set_topology(prev_topo)
+    return res
+
+
+def bench_train_numerics(jax, jnp, peak, smoke=False):
+    """Training-numerics observability row (ISSUE 18): the SAME
+    overlap block-model step with the in-graph stats pack disabled /
+    every step / every 16 steps. The timed loop at EVERY>0 includes
+    the host harvest (one packed-vector transfer + decode per sampled
+    step) — the honest end-to-end cost of running instrumented. The
+    EVERY=1 overhead fraction vs the uninstrumented build is the
+    headline (acceptance: <5% on the tiny smoke shape)."""
+    n_dev = len(jax.devices())
+    if jax.default_backend() in ("cpu",) and not smoke:
+        return {}
+    if n_dev < 2 and not smoke:
+        return {}
+    import os
+    from paddle_tpu import optimizer as optim
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.distributed import overlap as OV
+    from paddle_tpu.observability import numerics as nm
+
+    steps, warmup = (8, 2) if smoke else (20, 3)
+    L, d, hidden, batch = ((3, 16, 32, 8) if smoke or n_dev <= 8
+                           else (16, 1024, 4096, 256))
+    params, stacked, emb, blk, lf = OV.mlp_block_model(L, d, hidden)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(batch, d), jnp.float32)
+    y = jnp.asarray(rs.randn(batch, 8), jnp.float32)
+
+    res = {"train_numerics_devices": n_dev,
+           "train_numerics_shape": f"L{L}xd{d}xh{hidden}"}
+    prev_topo = mesh_lib.get_topology()
+    prev_env = os.environ.get("PT_NUMERICS_EVERY")
+    try:
+        topo = mesh_lib.init_mesh(fsdp=max(1, n_dev), set_global=False)
+        for every, name in ((0, "off"), (1, "every1"),
+                            (16, "every16")):
+            os.environ["PT_NUMERICS_EVERY"] = str(every)
+            try:
+                sp, st, step = OV.overlap_parallel(
+                    dict(params), emb, blk, lf,
+                    optim.SGD(learning_rate=1e-2), topo.mesh, stacked,
+                    comm_quant="int8")
+                mon = nm.Monitor.for_step(step) if every else None
+
+                def run(n, sp, st, base=0):
+                    loss = None
+                    for i in range(n):
+                        out = step(sp, st, x, y)
+                        (sp, st, loss), packed = nm.split_out(out)
+                        if mon is not None:
+                            mon.ingest(packed, step=base + i)
+                    return sp, st, loss
+
+                sp, st, loss = run(warmup, sp, st)
+                _sync(loss)
+                t0 = time.perf_counter()
+                sp, st, loss = run(steps, sp, st, base=warmup)
+                _sync(loss)
+                dt = (time.perf_counter() - t0) / steps
+                res[f"train_numerics_{name}_step_ms"] = round(
+                    dt * 1e3, 2)
+                res[f"train_numerics_{name}_loss"] = round(
+                    float(loss), 5)
+            except Exception as e:  # one cadence must not erase the rest
+                res[f"train_numerics_{name}_error"] = str(e)[:120]
+        off = res.get("train_numerics_off_step_ms")
+        on = res.get("train_numerics_every1_step_ms")
+        if off and on is not None:
+            res["train_numerics_overhead_frac"] = round(
+                (on - off) / off, 4)
+        # parity guard: the stats never feed back into the update
+        l_off = res.get("train_numerics_off_loss")
+        l_on = res.get("train_numerics_every1_loss")
+        if l_off is not None and l_on is not None:
+            res["train_numerics_loss_delta"] = round(l_on - l_off, 6)
+    finally:
+        if prev_env is None:
+            os.environ.pop("PT_NUMERICS_EVERY", None)
+        else:
+            os.environ["PT_NUMERICS_EVERY"] = prev_env
         mesh_lib.set_topology(prev_topo)
     return res
 

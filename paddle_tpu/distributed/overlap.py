@@ -225,10 +225,24 @@ def build_overlap_step(embed_fn: Callable, block_fn: Callable,
     ``prefetch`` routes the gathered weights through the scan carry,
     whose buffer layout legitimately changes the matmuls' FMA order —
     parity there is float-ulp-level, pinned by the smoke's tolerance.
+
+    With PT_NUMERICS_EVERY > 0 at build time the step additionally
+    returns one packed ``observability.numerics`` vector: per-layer
+    grad families harvested as extra backward-scan ys (read AFTER the
+    ``layer_bwd`` barrier, so the pinned subgraphs are untouched),
+    per-bucket quantization-error rows derived from the error-feedback
+    algebra (``new_ef`` IS the wire error exactly), and the NaN
+    provenance header. The ``train.grad_poison`` fault site corrupts
+    one layer's grad slice inside the scan body for localization
+    drills. The compiled step exposes ``.numerics_layout`` for
+    :class:`numerics.Monitor`.
     """
     from paddle_tpu.distributed import collective as coll
     from paddle_tpu.distributed import compression
+    from paddle_tpu.observability import numerics as _nm
     mesh, axis, level = specs.mesh, specs.axis, specs.level
+    num_on = _nm.enabled()
+    num_box = _nm.LayoutBox()
     stacked = tuple(stacked_keys)
     if not stacked:
         raise ValueError("build_overlap_step needs at least one stacked "
@@ -279,6 +293,7 @@ def build_overlap_step(embed_fn: Callable, block_fn: Callable,
     def per_rank(params, opt_state, *batch):
         idx = lax.axis_index(axis)
         opt_state = dict(opt_state)
+        step_count = opt_state["step"]
         ef = jax.tree_util.tree_map(lambda x: x[0],
                                     opt_state.pop("comm_ef"))
         ok = jnp.bool_(True)
@@ -379,6 +394,37 @@ def build_overlap_step(embed_fn: Callable, block_fn: Callable,
                 okk = okk & o
             return outs_s, outs_e, okk
 
+        n_qrow = max(1, len(buckets))
+        num_ev = max(1, _nm.every())
+        num_want = ((jnp.asarray(step_count) % num_ev) == 0) \
+            if num_on else None
+
+        def _layer_stats(dw, ef_l, new_e):
+            """Numerics raws for ONE layer: the (F,5) grad-family rows
+            over the stacked leaves plus one (n_bucket,3) quant-error
+            row per grad bucket. At cadence >1 the whole side
+            computation sits under a lax.cond on the step counter, so
+            off-cadence steps pay nothing and emit zeros."""
+            def live(_):
+                dm = {k: _dmean(dw[k].astype(jnp.float32))
+                      for k in stacked}
+                fr = jnp.stack([_nm.leaf_raw(dm[k]) for k in stacked])
+                if buckets:
+                    qr = jnp.stack([_nm.quant_raw(
+                        [dm[k] for k in b], [ef_l[k] for k in b],
+                        [new_e[k] for k in b]) for b in buckets])
+                else:
+                    qr = jnp.zeros((n_qrow, 3), jnp.float32)
+                return fr, qr
+
+            if num_ev <= 1:
+                return live(0)
+            return lax.cond(
+                num_want, live,
+                lambda _: (jnp.zeros((len(stacked), len(_nm.COLS)),
+                                     jnp.float32),
+                           jnp.zeros((n_qrow, 3), jnp.float32)), 0)
+
         def layer_fwd(w, x):
             """One layer's forward between optimization_barriers: the
             compute subgraph is then identical whichever schedule
@@ -452,37 +498,46 @@ def build_overlap_step(embed_fn: Callable, block_fn: Callable,
 
             def bbody(carry, xsl):
                 dx, w, okk = carry
-                x_l, sh_prev, vm_prev, ef_l = xsl
+                x_l, sh_prev, vm_prev, ef_l, l_i = xsl
                 w_prev, o = gather_layer(sh_prev, vm_prev)
                 dw, dx_in = layer_bwd(w, x_l, dx)
+                dw = _nm.poison_layer_slice(dw, l_i, step_count)
                 sh_g, new_e, o2 = bucket_sync(dw, ef_l)
                 raw = {k: _dmean(dw[k].astype(jnp.float32))
                        for k in raw_blk}
-                return (dx_in, w_prev, okk & o & o2), (sh_g, new_e, raw)
+                ys = (sh_g, new_e, raw)
+                if num_on:
+                    ys += (_layer_stats(dw, ef_l, new_e),)
+                return (dx_in, w_prev, okk & o & o2), ys
 
-            (dx0, _, ok), (sh_rev, efo_rev, raw_rev) = lax.scan(
+            (dx0, _, ok), bys = lax.scan(
                 bbody, (dxN, wl, ok),
                 (rev(acts),
                  {k: jnp.roll(rev(blk[k]), -1, axis=0) for k in stacked},
-                 jnp.roll(rev(wmax_blk), -1, axis=0), ef_rev))
+                 jnp.roll(rev(wmax_blk), -1, axis=0), ef_rev,
+                 rev(jnp.arange(L))))
         elif overlap:
             # in-body bucket sync without the double-buffered weight
             # carry: each body re-gathers its own layer, then launches
             # that layer's grad buckets right after the vjp
             def bbody(carry, xsl):
                 dx, okk = carry
-                x_l, sh_l, vm_l, ef_l = xsl
+                x_l, sh_l, vm_l, ef_l, l_i = xsl
                 w, o = gather_layer(sh_l, vm_l)
                 dw, dx_in = layer_bwd(w, x_l, dx)
+                dw = _nm.poison_layer_slice(dw, l_i, step_count)
                 sh_g, new_e, o2 = bucket_sync(dw, ef_l)
                 raw = {k: _dmean(dw[k].astype(jnp.float32))
                        for k in raw_blk}
-                return (dx_in, okk & o & o2), (sh_g, new_e, raw)
+                ys = (sh_g, new_e, raw)
+                if num_on:
+                    ys += (_layer_stats(dw, ef_l, new_e),)
+                return (dx_in, okk & o & o2), ys
 
-            (dx0, ok), (sh_rev, efo_rev, raw_rev) = lax.scan(
+            (dx0, ok), bys = lax.scan(
                 bbody, (dxN, ok),
                 (rev(acts), {k: rev(blk[k]) for k in stacked},
-                 rev(wmax_blk), ef_rev))
+                 rev(wmax_blk), ef_rev, rev(jnp.arange(L))))
         else:
             # tail-sync baseline: the SAME per-layer math with every
             # collective hoisted out of the compute scan — backward
@@ -492,26 +547,31 @@ def build_overlap_step(embed_fn: Callable, block_fn: Callable,
             # differs)
             def bbody(carry, xsl):
                 dx, okk = carry
-                x_l, sh_l, vm_l = xsl
+                x_l, sh_l, vm_l, l_i = xsl
                 w, o = gather_layer(sh_l, vm_l)
                 dw, dx_in = layer_bwd(w, x_l, dx)
+                dw = _nm.poison_layer_slice(dw, l_i, step_count)
                 return (dx_in, okk & o), dw
 
             (dx0, ok), dw_rev = lax.scan(
                 bbody, (dxN, ok),
                 (rev(acts), {k: rev(blk[k]) for k in stacked},
-                 rev(wmax_blk)))
+                 rev(wmax_blk), rev(jnp.arange(L))))
 
             def tail(okk, xsl):
                 dw_l, ef_l = xsl
                 sh_g, new_e, o2 = bucket_sync(dw_l, ef_l)
                 raw = {k: _dmean(dw_l[k].astype(jnp.float32))
                        for k in raw_blk}
-                return okk & o2, (sh_g, new_e, raw)
+                ys = (sh_g, new_e, raw)
+                if num_on:
+                    ys += (_layer_stats(dw_l, ef_l, new_e),)
+                return okk & o2, ys
 
-            ok, (sh_rev, efo_rev, raw_rev) = lax.scan(
-                tail, ok, (dw_rev, ef_rev))
+            ok, bys = lax.scan(tail, ok, (dw_rev, ef_rev))
 
+        sh_rev, efo_rev, raw_rev = bys[0], bys[1], bys[2]
+        num_blk = bys[3] if num_on else None
         sh_blk = {k: rev(v) for k, v in sh_rev.items()}
         new_ef_blk = {k: rev(v) for k, v in efo_rev.items()}
         raw_g = {k: rev(v) for k, v in raw_rev.items()}
@@ -524,6 +584,7 @@ def build_overlap_step(embed_fn: Callable, block_fn: Callable,
             [(k, 4 * int(np.prod(nb[k].shape))) for k in nb_rs],
             bucket_budget, reverse=True)
         shard_g, new_ef = dict(sh_blk), dict(new_ef_blk)
+        nb_q_src = []
         if nb_buckets:
             dmeaned = {k: _dmean(dnb[k].astype(jnp.float32))
                        for k in nb_rs}
@@ -541,6 +602,12 @@ def build_overlap_step(embed_fn: Callable, block_fn: Callable,
                 shard_g.update(sh)
                 new_ef.update(ne)
                 ok = ok & o
+                if num_on:
+                    # raw refs only — the quant_raw reductions run
+                    # inside the cadence-gated pack below
+                    nb_q_src.append(([dmeaned[k] for k in b],
+                                     [ef[k] for k in b],
+                                     [ne[k] for k in b]))
         for k in nb:
             if k not in sdim:
                 shard_g[k] = _dmean(lax.pmean(
@@ -559,27 +626,59 @@ def build_overlap_step(embed_fn: Callable, block_fn: Callable,
                     params[k], idx * d, d, axis=sdim[k])
             else:
                 shard_p[k] = params[k]
-        return _sharded_update_tail(optimizer, opt_state, shard_p,
-                                    shard_g, new_ef, ok, loss,
-                                    level=level, axis=axis, sdim=sdim,
-                                    dmean=_dmean)
+        out_p, out_s, out_loss = _sharded_update_tail(
+            optimizer, opt_state, shard_p, shard_g, new_ef, ok, loss,
+            level=level, axis=axis, sdim=sdim, dmean=_dmean)
+        if not num_on:
+            return out_p, out_s, out_loss
+
+        def build():
+            pk = _nm.Packer()
+            fr = rev(num_blk[0])                          # (L, F, 5)
+            for i, k in enumerate(stacked):
+                pk.family(f"grad/{k}", fr[:, i, :],
+                          int(np.prod(blk[k].shape[1:])) or 1)
+            pool = [_dmean(dnb[k].astype(jnp.float32)) for k in nb]
+            if pool:
+                pk.family("grad/(rest)", _nm.pooled_raw(pool),
+                          sum(int(np.prod(nb[k].shape)) for k in nb))
+            # per-bucket quant rows: sum the per-layer raws over the
+            # layer axis, then the exact cross-rank reduction
+            pk.quant("blk", lax.psum(jnp.sum(num_blk[1], axis=0),
+                                     axis))
+            if nb_q_src:
+                pk.quant("nb", lax.psum(jnp.stack(
+                    [_nm.quant_raw(g, e, n) for g, e, n in nb_q_src]),
+                    axis))
+            packed = pk.pack(loss=out_loss, box=num_box)
+            packed = lax.pmean(packed, axis)
+            if data_axis:
+                packed = lax.pmean(packed, data_axis)
+            return packed
+
+        packed = _nm.cond_every(step_count, num_ev, build)
+        return out_p, out_s, out_loss, packed
 
     ef_spec = {k: P(axis) for k in specs.param}
     state_spec = {"step": P(), "slots": dict(specs.opt_slot),
                   "comm_ef": ef_spec}
     batch_spec = P(data_axis) if data_axis else P()
 
+    out_tail = (P(), P()) if num_on else (P(),)
+
     def step(params, opt_state, *batch):
         smapped = shard_map(
             per_rank, mesh=mesh,
             in_specs=(dict(specs.param), state_spec)
             + (batch_spec,) * len(batch),
-            out_specs=(dict(specs.param), state_spec, P()),
+            out_specs=(dict(specs.param), state_spec) + out_tail,
             check_vma=False)
         return smapped(params, opt_state, *batch)
 
     kw = {"donate_argnums": (0, 1)} if donate else {}
-    return jax.jit(step, **kw)
+    fn = jax.jit(step, **kw)
+    fn.numerics_layout = num_box
+    return fn
 
 
 def overlap_parallel(params: Dict[str, jax.Array], embed_fn: Callable,

@@ -189,7 +189,8 @@ def init_group_sharded_state(params, optimizer, specs: GroupShardedSpecs):
 def build_group_sharded_step(loss_fn, optimizer, specs: GroupShardedSpecs,
                              donate: bool = True,
                              comm_quant: Optional[str] = None,
-                             comm_block: Optional[int] = None):
+                             comm_block: Optional[int] = None,
+                             stacked_keys=None):
     """Jitted train step under the group-sharded policy.
 
     loss_fn(params, *batch) -> scalar. The grad constraint is what turns the
@@ -211,26 +212,45 @@ def build_group_sharded_step(loss_fn, optimizer, specs: GroupShardedSpecs,
     state with :func:`init_group_sharded_state` + :func:`attach_comm_ef`,
     or use the one-call :func:`group_sharded_parallel`, whose own
     ``comm_quant=None`` default DOES auto-resolve and attaches it).
+
+    ``stacked_keys`` (ISSUE 18) names the param entries carrying a
+    leading layer axis so the numerics plane (PT_NUMERICS_EVERY > 0)
+    attributes its per-layer grad stats / NaN provenance to layers;
+    when numerics is enabled the step returns a 4th output — the
+    packed stats vector.
     """
+    from paddle_tpu.observability import numerics as _nm
     policy = _resolve_policy(comm_quant, specs, optimizer)
     if policy is not None:
         return _build_quantized_comm_step(loss_fn, optimizer, specs,
-                                          policy, comm_block, donate)
+                                          policy, comm_block, donate,
+                                          stacked_keys=stacked_keys)
     mesh = specs.mesh
+    num_on = _nm.enabled()
+    num_box = _nm.LayoutBox()
 
     def step(params, opt_state, *batch):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, *batch))(params)
+        grads = _nm.poison_grads(grads, stacked_keys,
+                                 step_count=opt_state["step"])
         grads = _constrain_tree(grads, specs.grad, mesh)
         new_p, new_s = optimizer.update(grads, opt_state, params)
         new_p = _constrain_tree(new_p, specs.param, mesh)
         new_s = {"step": new_s["step"],
                  "slots": _constrain_tree(new_s["slots"], specs.opt_slot,
                                           mesh)}
+        if num_on:
+            packed = _nm.capture_step(
+                grads, loss=loss, step_count=opt_state["step"],
+                stacked_keys=stacked_keys, box=num_box)
+            return new_p, new_s, loss, packed
         return new_p, new_s, loss
 
     kw = {"donate_argnums": (0, 1)} if donate else {}
-    return jax.jit(step, **kw)
+    fn = jax.jit(step, **kw)
+    fn.numerics_layout = num_box
+    return fn
 
 
 def _resolve_policy(comm_quant: Optional[str], specs: GroupShardedSpecs,
@@ -336,7 +356,7 @@ def _sharded_update_tail(optimizer, opt_state, shard_p, shard_g, new_ef,
 
 def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
                                method: str, block: Optional[int],
-                               donate: bool):
+                               donate: bool, stacked_keys=None):
     """The explicit shard_map formulation with a narrow wire: stage-3
     pre-forward param gather = quantize → all-gather → dequant; gradient
     reduce-scatter = block-quantized all-to-all + local dequant-mean with
@@ -346,7 +366,10 @@ def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
     fp32 pmean over dp BEFORE the quantized reduce-scatter — quantizing
     the dp leg itself is ``build_compressed_dp_step``'s job."""
     from paddle_tpu.distributed import compression
+    from paddle_tpu.observability import numerics as _nm
     mesh, axis, level = specs.mesh, specs.axis, specs.level
+    num_on = _nm.enabled()
+    num_box = _nm.LayoutBox()
     reason = _quant_unsupported_reason(optimizer, specs)
     if reason is not None:
         raise ValueError(f"comm_quant={method!r}: {reason}")
@@ -386,6 +409,8 @@ def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
                 full[k] = p
         loss, grads = jax.value_and_grad(
             lambda q: loss_fn(q, *batch))(full)
+        grads = _nm.poison_grads(grads, stacked_keys,
+                                 step_count=opt_state["step"])
         rs_keys = [k for k in grads if k in sdim]
         dmeaned = {k: _dmean(grads[k]) for k in rs_keys}
         gmax = dict(zip(rs_keys, lax.pmax(jnp.stack(
@@ -410,16 +435,45 @@ def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
                                               axis))
                 new_ef[k] = ef[k]
                 shard_p[k] = params[k]
-        return _sharded_update_tail(optimizer, opt_state, shard_p,
-                                    shard_g, new_ef, ok, loss,
-                                    level=level, axis=axis, sdim=sdim,
-                                    dmean=_dmean)
+        step_count = opt_state["step"]
+        out_p, out_s, out_loss = _sharded_update_tail(
+            optimizer, opt_state, shard_p, shard_g, new_ef, ok, loss,
+            level=level, axis=axis, sdim=sdim, dmean=_dmean)
+        if not num_on:
+            return out_p, out_s, out_loss
+
+        # ISSUE 18 numerics capture: reads the dp-meaned grads and the
+        # codec's residuals AFTER the wire already consumed them — the
+        # update math above is dataflow-identical with capture on. The
+        # final pmean replicates the small packed vector across the
+        # mesh so it can leave the shard_map under P().
+        def build():
+            pk = _nm.Packer()
+            gstats = {k: dmeaned[k] if k in dmeaned
+                      else _dmean(grads[k]) for k in grads}
+            _nm.add_grad_tree(pk, gstats, stacked_keys)
+            if rs_keys:
+                rows = jnp.stack([_nm.quant_raw(
+                    [dmeaned[k]], [ef[k]], [new_ef[k]])
+                    for k in rs_keys])
+                rows = lax.psum(rows, axis)
+                for i, k in enumerate(rs_keys):
+                    pk.quant(f"rs/{k}", rows[i][None])
+            packed = pk.pack(loss=out_loss, box=num_box)
+            packed = lax.pmean(packed, axis)
+            if data_axis:
+                packed = lax.pmean(packed, data_axis)
+            return packed
+
+        packed = _nm.cond_every(step_count, max(1, _nm.every()), build)
+        return out_p, out_s, out_loss, packed
 
     ef_spec = {k: P(axis) for k in specs.param}
     state_spec = {"step": P(), "slots": dict(specs.opt_slot),
                   "comm_ef": ef_spec}
 
     batch_spec = P(data_axis) if data_axis else P()
+    out_tail = (P(), P()) if num_on else (P(),)
 
     def step(params, opt_state, *batch):
         # shard_map built per batch arity (jit retraces per arity anyway)
@@ -427,12 +481,14 @@ def _build_quantized_comm_step(loss_fn, optimizer, specs: GroupShardedSpecs,
             per_rank, mesh=mesh,
             in_specs=(dict(specs.param), state_spec)
             + (batch_spec,) * len(batch),
-            out_specs=(dict(specs.param), state_spec, P()),
+            out_specs=(dict(specs.param), state_spec) + out_tail,
             check_vma=False)
         return smapped(params, opt_state, *batch)
 
     kw = {"donate_argnums": (0, 1)} if donate else {}
-    return jax.jit(step, **kw)
+    fn = jax.jit(step, **kw)
+    fn.numerics_layout = num_box
+    return fn
 
 
 def build_overlap_sharded_step(*args, **kwargs):
@@ -449,7 +505,8 @@ def group_sharded_parallel(params, optimizer, loss_fn, mesh: Mesh,
                            level: str = "p_g_os", axis: str = "fsdp",
                            rules: Optional[Callable[[str], P]] = None,
                            comm_quant: Optional[str] = None,
-                           comm_block: Optional[int] = None):
+                           comm_block: Optional[int] = None,
+                           stacked_keys=None):
     """One-call API ≙ paddle.distributed.sharding.group_sharded_parallel
     (group_sharded.py: level "os" / "os_g" / "p_g_os").
 
@@ -472,5 +529,6 @@ def group_sharded_parallel(params, optimizer, loss_fn, mesh: Mesh,
         opt_state = attach_comm_ef(full_params, opt_state, specs)
     step = build_group_sharded_step(loss_fn, optimizer, specs,
                                     comm_quant=policy,
-                                    comm_block=comm_block)
+                                    comm_block=comm_block,
+                                    stacked_keys=stacked_keys)
     return params, opt_state, step

@@ -320,6 +320,36 @@ declare_env("PT_FLIGHT_DIR", "Directory terminal-failure flight "
             "records dump into as flight_<rid>.json (falls back to "
             "PT_TRACE_DIR; with neither set the record is one "
             "structured stderr line).", owner="observability/flight.py")
+declare_env("PT_NUMERICS_EVERY", "Training-numerics capture cadence: "
+            "compute the in-graph tensor-stat pack every N optimizer "
+            "steps (one packed device vector per sampled step). 0 "
+            "(default) builds the step without the stats subgraph "
+            "entirely.", default="0",
+            owner="observability/numerics.py")
+declare_env("PT_NUMERICS_RING", "Numerics flight-recorder bound: how "
+            "many decoded snapshots stay resident for the "
+            "detector-triggered dump.", default="64",
+            owner="observability/numerics.py")
+declare_env("PT_NUMERICS_DIR", "Directory numerics alert dumps land "
+            "in as numerics_<step>.<pid>.json (falls back to "
+            "PT_FLIGHT_DIR then PT_TRACE_DIR; with none set the dump "
+            "is one structured stderr line).",
+            owner="observability/numerics.py")
+declare_env("PT_NUMERICS_WINDOW", "Numerics watch history window "
+            "(samples) for the median/MAD spike detectors.",
+            default="32", owner="observability/numerics.py")
+declare_env("PT_NUMERICS_Z", "Numerics watch robust z-score "
+            "threshold: loss/grad-norm spikes fire when the value "
+            "exceeds median + z*(1.4826*MAD) over the window.",
+            default="6.0", owner="observability/numerics.py")
+declare_env("PT_NUMERICS_OVERFLOW", "Numerics watch overflow "
+            "threshold: alert when any family's dtype-overflow "
+            "fraction (|x| above 90% of finfo.max) exceeds this.",
+            default="0.01", owner="observability/numerics.py")
+declare_env("PT_NUMERICS_EF", "Numerics watch error-feedback runaway "
+            "threshold: alert when any bucket's EF-to-grad magnitude "
+            "ratio exceeds this.", default="8.0",
+            owner="observability/numerics.py")
 declare_env("PT_SLO_TTFT_P99_MS", "Fleet SLO target: merged p99 TTFT "
             "in milliseconds. The fleet watch publishes the "
             "fleet/slo_ttft_burn gauge (p99/target) and fires "

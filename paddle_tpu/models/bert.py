@@ -409,7 +409,16 @@ def build_pretrain_step(model: BertForPretraining, optimizer,
     set, the step sorts masked positions first and computes the vocab head
     only on those M slots (slots beyond the actual masked count carry the
     ignore label and contribute nothing). Equal loss, ~85% fewer
-    vocab-head FLOPs at 15% masking."""
+    vocab-head FLOPs at 15% masking.
+
+    With PT_NUMERICS_EVERY > 0 (ISSUE 18) the step returns a 4th
+    output: the packed numerics vector — per-layer grad and
+    param-update stats over the ``*_stacked_layers`` axis plus the NaN
+    provenance header — at the configured cadence."""
+    from paddle_tpu.observability import numerics as _nm
+    num_on = _nm.enabled()
+    num_box = _nm.LayoutBox()
+
     def step(params, opt_state, tokens, type_ids, attn_mask, mlm_labels,
              nsp_labels, rng):
         pos = labels = None
@@ -427,11 +436,21 @@ def build_pretrain_step(model: BertForPretraining, optimizer,
                 mlm_logits, nsp_logits,
                 mlm_labels if labels is None else labels, nsp_labels)
         loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = _nm.poison_grads(grads, step_count=opt_state["step"])
         new_params, new_state = optimizer.update(grads, opt_state, params)
+        if num_on:
+            updates = jax.tree_util.tree_map(
+                lambda n, o: n - o, new_params, params)
+            packed = _nm.capture_step(
+                grads, loss=loss, updates=updates,
+                step_count=opt_state["step"], box=num_box)
+            return new_params, new_state, loss, packed
         return new_params, new_state, loss
 
     kw = {"donate_argnums": (0, 1)} if donate else {}
-    return jax.jit(step, **kw)
+    fn = jax.jit(step, **kw)
+    fn.numerics_layout = num_box
+    return fn
 
 
 def _trunk_of(model) -> (Bert, str):

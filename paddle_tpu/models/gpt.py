@@ -1143,7 +1143,17 @@ def build_train_step(model: GPT, optimizer, mesh: Optional[Mesh] = None,
     the activation constraints inside the model — XLA inserts all
     collectives (SURVEY §5.8 mapping). ≙ the reference's
     HybridParallelOptimizer.step + EagerReducer allreduce path.
+
+    With PT_NUMERICS_EVERY > 0 (ISSUE 18) the step returns a 4th
+    output: the packed numerics vector — per-layer grad AND
+    param-update stats over the stacked layer axis plus the NaN
+    provenance header — at the configured cadence. Capture reads the
+    grads/updates the step already computed, so it cannot perturb the
+    update math.
     """
+    from paddle_tpu.observability import numerics as _nm
+    num_on = _nm.enabled()
+    num_box = _nm.LayoutBox()
 
     def step(params, opt_state, tokens, rng):
         def loss_fn(p):
@@ -1157,13 +1167,23 @@ def build_train_step(model: GPT, optimizer, mesh: Optional[Mesh] = None,
             return fused_lm_loss(m, tokens, rng_key=rng)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = _nm.poison_grads(grads, step_count=opt_state["step"])
         new_params, new_state = optimizer.update(grads, opt_state, params)
+        if num_on:
+            updates = jax.tree_util.tree_map(
+                lambda n, o: n - o, new_params, params)
+            packed = _nm.capture_step(
+                grads, loss=loss, updates=updates,
+                step_count=opt_state["step"], box=num_box)
+            return new_params, new_state, loss, packed
         return new_params, new_state, loss
 
     kw = {}
     if donate:
         kw["donate_argnums"] = (0, 1)
-    return jax.jit(step, **kw)
+    fn = jax.jit(step, **kw)
+    fn.numerics_layout = num_box
+    return fn
 
 
 def register_stacked_decay_mask(optimizer, template_blk, n_layers: int,

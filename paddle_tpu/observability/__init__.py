@@ -32,6 +32,7 @@ from paddle_tpu.observability.comm import (exposed_time, step_overlap,
 from paddle_tpu.observability import flight
 from paddle_tpu.observability import runtime
 from paddle_tpu.observability import devprof
+from paddle_tpu.observability import numerics
 
 __all__ = ["trace", "span", "begin", "end", "complete", "instant",
            "StatszServer", "start_statsz", "stop_statsz",
@@ -39,7 +40,7 @@ __all__ = ["trace", "span", "begin", "end", "complete", "instant",
            "stitch_trace_files", "stitch_rank_traces",
            "request_segments", "init_from_env",
            "comm", "exposed_time", "step_overlap", "record_step_overlap",
-           "flight", "runtime", "devprof"]
+           "flight", "runtime", "devprof", "numerics"]
 
 
 def init_from_env():

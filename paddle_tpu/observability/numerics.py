@@ -1,0 +1,817 @@
+"""Training-numerics observability plane (ISSUE 18).
+
+The repo quantizes nearly every wire byte (int8/fp8 gradient
+reduce-scatters, bucketed overlap collectives, lossy KV pages) on the
+strength of fixed-seed parity tests; this module turns those one-shot
+claims into *continuously measured* gauges and gives non-finite
+failures a provenance better than "the loss is NaN":
+
+- **in-graph capture**: cheap per-leaf / per-layer summaries (rms,
+  amax, non-finite count, dtype overflow/underflow fraction) computed
+  INSIDE the jitted train step and concatenated into ONE small f32
+  device vector, so a sampled step costs the host exactly one packed
+  transfer — the same packed-harvest invariant the serving engines
+  live by and ptlint PT001 machine-checks.
+- **NaN provenance**: a layer-major argmax reduction over the
+  per-layer non-finite counts, captured in the same vector — the host
+  learns *first bad layer + leaf family*, not just "something broke".
+- **cadence**: ``PT_NUMERICS_EVERY`` (0=off). At 1 every step is
+  sampled; at k>1 the whole stats subgraph sits behind a
+  ``lax.cond`` on the optimizer step counter, so off-cadence steps
+  skip both the device compute and the host transfer.
+- **host plane**: :class:`Monitor` unpacks the vector, records ``num/``
+  gauges into the stats registry (→ /statsz + /metricsz for free),
+  feeds :class:`NumericsWatch` (edge-triggered detectors à la
+  FleetStats) and a bounded :class:`NumericsRecorder` ring that
+  auto-dumps its last-N snapshots (flight-recorder idiom,
+  pid-suffixed) when a detector fires.
+
+Bit-parity contract: capture only *reads* values after they exit the
+pinned (``optimization_barrier``) subgraphs of the overlap/quantized
+step builders — it never feeds anything back into the update math, so
+enabling numerics cannot move a single bit of the parameters.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu import stats as stats_lib
+
+__all__ = [
+    "COLS", "QCOLS", "FAULT_SITE",
+    "every", "enabled", "ring_capacity",
+    "leaf_raw", "stacked_raw", "pooled_raw", "quant_raw",
+    "Packer", "Layout", "LayoutBox", "cond_every", "capture_step",
+    "add_grad_tree", "grad_families",
+    "poison_grads", "poison_layer_slice",
+    "Monitor", "NumericsWatch", "NumericsRecorder", "split_out",
+]
+
+# raw per-layer columns carried on device; everything host-facing
+# (rms, fractions) derives from these so cross-layer/cross-rank
+# reductions stay exact sums/maxes
+COLS = ("sumsq", "amax", "nonfinite", "overflow", "underflow")
+NCOL = len(COLS)
+# raw per-bucket quantization columns: residual/orig/grad sum-squares
+QCOLS = ("err_ss", "orig_ss", "grad_ss")
+NQCOL = len(QCOLS)
+# packed-vector header: [tag, loss, nonfinite_total, first_bad_layer,
+# first_bad_family]; tag==1.0 marks a computed (on-cadence) sample —
+# the lax.cond zero branch leaves it 0.0 so the host can tell
+HEADER = ("tag", "loss", "nonfinite", "first_bad_layer",
+          "first_bad_family")
+NHDR = len(HEADER)
+
+FAULT_SITE = "train.grad_poison"
+
+
+# -- knobs (declared in flags.py; PT005) -------------------------------------
+
+def every() -> int:
+    """PT_NUMERICS_EVERY: sample every k-th step; 0 disables capture
+    entirely (the step builders emit their unchanged 3-tuple)."""
+    try:
+        return max(0, int(os.environ.get("PT_NUMERICS_EVERY", "0") or 0))
+    except ValueError:
+        return 0
+
+
+def enabled() -> bool:
+    return every() > 0
+
+
+def ring_capacity() -> int:
+    try:
+        return max(1, int(os.environ.get("PT_NUMERICS_RING", "64")))
+    except ValueError:
+        return 64
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _dump_dir() -> Optional[str]:
+    return (os.environ.get("PT_NUMERICS_DIR")
+            or os.environ.get("PT_FLIGHT_DIR")
+            or os.environ.get("PT_TRACE_DIR"))
+
+
+# -- in-graph raw summaries ---------------------------------------------------
+
+def _limits(dtype) -> Tuple[float, float]:
+    """(overflow threshold, underflow threshold) for a float dtype —
+    |x| beyond 90% of finfo.max counts as overflow-at-risk, nonzero
+    |x| under finfo.tiny counts as underflow (subnormal)."""
+    try:
+        fi = jnp.finfo(dtype)
+        # ptlint: disable=PT001 -- finfo bounds are static dtype metadata
+        return 0.9 * float(fi.max), float(fi.tiny)
+    except ValueError:          # integer leaf — no float range to watch
+        return float("inf"), 0.0
+
+
+def leaf_raw(x) -> jnp.ndarray:
+    """(NCOL,) raw summary of one whole tensor."""
+    return stacked_raw(jnp.reshape(x, (1, -1)))[0]
+
+
+def stacked_raw(x) -> jnp.ndarray:
+    """(L, NCOL) raw summary of a stacked leaf with leading layer dim —
+    the PR 8 scan-over-layers axis — reducing over all other dims."""
+    hi, lo = _limits(x.dtype)
+    xf = jnp.asarray(x).astype(jnp.float32)
+    axes = tuple(range(1, xf.ndim))
+    fin = jnp.isfinite(xf)
+    ax = jnp.where(fin, jnp.abs(xf), 0.0)
+    # nonzero-magnitude test on the BITS: XLA CPU flushes subnormals
+    # in float compares (1e-40 > 0 is False there), which would hide
+    # exactly the values the underflow column exists to count
+    nz = (lax.bitcast_convert_type(xf, jnp.uint32) << 1) != 0
+    one = jnp.float32(1.0)
+    return jnp.stack([
+        jnp.sum(jnp.where(fin, xf * xf, 0.0), axis=axes),
+        jnp.max(ax, axis=axes) if axes else ax,
+        jnp.sum(jnp.where(fin, 0.0, one), axis=axes),
+        jnp.sum(jnp.where(fin & (ax >= hi), one, 0.0), axis=axes),
+        jnp.sum(jnp.where(fin & nz & (ax < lo), one, 0.0),
+                axis=axes),
+    ], axis=-1)
+
+
+def pooled_raw(leaves: Sequence[Any]) -> jnp.ndarray:
+    """(1, NCOL) raw summary pooling several tensors into one family
+    (used for the non-stacked remainder so the packed vector stays
+    small on models with many scalar leaves)."""
+    rows = jnp.stack([leaf_raw(x) for x in leaves])        # (n, NCOL)
+    return jnp.stack([rows[:, 0].sum(), rows[:, 1].max(),
+                      rows[:, 2].sum(), rows[:, 3].sum(),
+                      rows[:, 4].sum()])[None]
+
+
+def quant_raw(grads: Sequence[Any], ef_in: Sequence[Any],
+              ef_out: Sequence[Any]) -> jnp.ndarray:
+    """(NQCOL,) raw quantization-error sums for one bucket / leaf
+    group. The codec's residual algebra gives ``new_ef = orig − own``
+    exactly (orig = grad + carried ef), so
+
+    - relative wire error  rms(dequant−orig)/rms(orig) = √(err/orig)
+    - EF magnitude drift   rms(new_ef)/rms(grad)       = √(err/grad)
+
+    both derive host-side from these three sums; an fp32 wire yields
+    err_ss ≡ 0."""
+    def _ss(xs):
+        t = jnp.float32(0.0)
+        for x in xs:
+            xf = jnp.asarray(x).astype(jnp.float32)
+            t = t + jnp.sum(xf * xf)
+        return t
+    orig = _ss([jnp.asarray(g).astype(jnp.float32)
+                + jnp.asarray(e).astype(jnp.float32)
+                for g, e in zip(grads, ef_in)])
+    return jnp.stack([_ss(ef_out), orig, _ss(grads)])
+
+
+# -- pytree naming / stacked-entry discovery ----------------------------------
+
+def _key_str(part) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(part, attr):
+            return str(getattr(part, attr))
+    return str(part)
+
+
+def _path_name(path) -> str:
+    return ".".join(_key_str(p) for p in path)
+
+
+def _stacked_key_set(tree, stacked_keys=None):
+    """Top-level keys whose subtree leaves carry a leading layer dim.
+    Explicit list wins; otherwise auto-detect the PR 8 pre-stacked
+    entries (gpt ``_stacked_blocks``, bert ``*_stacked_layers``)."""
+    if stacked_keys is not None:
+        return set(stacked_keys)
+    if isinstance(tree, dict):
+        return {k for k in tree if isinstance(k, str)
+                and (k == "_stacked_blocks"
+                     or k.endswith("_stacked_layers"))}
+    return set()
+
+
+def grad_families(grads, stacked_keys=None):
+    """Split a grad pytree into ([(name, stacked leaf)], [(name,
+    plain leaf)]) — stacked leaves are per-layer families."""
+    skeys = _stacked_key_set(grads, stacked_keys)
+    flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+    stacked, plain = [], []
+    for path, leaf in flat:
+        name = _path_name(path)
+        if path and _key_str(path[0]) in skeys and jnp.ndim(leaf) >= 1:
+            stacked.append((name, leaf))
+        else:
+            plain.append((name, leaf))
+    return stacked, plain
+
+
+def add_grad_tree(pk: "Packer", grads, stacked_keys=None,
+                  prefix: str = "grad/"):
+    """Add one pytree to a :class:`Packer`: every stacked leaf becomes
+    a per-layer family, the remainder pools into ``<prefix>(rest)``."""
+    stacked, plain = grad_families(grads, stacked_keys)
+    for name, leaf in stacked:
+        per_layer = int(np.prod(leaf.shape[1:])) if leaf.ndim > 1 else 1
+        pk.family(prefix + name, stacked_raw(leaf), per_layer)
+    if plain:
+        total = int(sum(int(np.prod(np.shape(l)) or 1)
+                        for _, l in plain))
+        pk.family(prefix + "(rest)",
+                  pooled_raw([l for _, l in plain]), total)
+
+
+# -- the packed vector --------------------------------------------------------
+
+class Layout:
+    """Host-side schema of one packed vector: family/bucket names and
+    shapes are static per compilation, so the single harvested array
+    decodes without any further device traffic."""
+
+    def __init__(self, families, quants, scalars):
+        self.families = list(families)   # (name, L, per-layer count)
+        self.quants = list(quants)       # (name, n_buckets)
+        self.scalars = list(scalars)     # names
+        self.size = (NHDR
+                     + sum(L * NCOL for _, L, _ in self.families)
+                     + sum(b * NQCOL for _, b in self.quants)
+                     + len(self.scalars))
+
+    def family_names(self) -> List[str]:
+        return [n for n, _, _ in self.families]
+
+    def unpack(self, arr) -> Optional[dict]:
+        """Decode one harvested vector into a JSON-ready snapshot.
+        Returns None for an off-cadence (zeroed) sample."""
+        a = np.asarray(arr, dtype=np.float64).reshape(-1)
+        if a.shape[0] != self.size:
+            raise ValueError(
+                f"packed size {a.shape[0]} != layout {self.size}")
+        if a[0] != 1.0:
+            return None
+        snap: Dict[str, Any] = {
+            "loss": float(a[1]),
+            "nonfinite": float(a[2]),
+            "first_bad_layer": int(a[3]),
+            "first_bad_family": int(a[4]),
+        }
+        names = self.family_names()
+        fam_idx = snap["first_bad_family"]
+        snap["first_bad_family_name"] = (
+            names[fam_idx] if 0 <= fam_idx < len(names) else None)
+        off = NHDR
+        fams: Dict[str, Any] = {}
+        g_ss = g_n = u_ss = u_n = 0.0
+        g_amax = u_amax = over_max = under_max = 0.0
+        for name, L, cnt in self.families:
+            blk = a[off:off + L * NCOL].reshape(L, NCOL)
+            off += L * NCOL
+            cnt = max(1, cnt)
+            fams[name] = {
+                "rms": [float(math.sqrt(max(v, 0.0) / cnt))
+                        for v in blk[:, 0]],
+                "amax": [float(v) for v in blk[:, 1]],
+                "nonfinite": [float(v) for v in blk[:, 2]],
+                "overflow_frac": [float(v / cnt) for v in blk[:, 3]],
+                "underflow_frac": [float(v / cnt) for v in blk[:, 4]],
+            }
+            over_max = max(over_max, max(fams[name]["overflow_frac"]))
+            under_max = max(under_max,
+                            max(fams[name]["underflow_frac"]))
+            if name.startswith("upd/"):
+                u_ss += float(blk[:, 0].sum()); u_n += cnt * L
+                u_amax = max(u_amax, float(blk[:, 1].max()))
+            else:
+                g_ss += float(blk[:, 0].sum()); g_n += cnt * L
+                g_amax = max(g_amax, float(blk[:, 1].max()))
+        quants: Dict[str, Any] = {}
+        rel_all: List[float] = []
+        ef_all: List[float] = []
+        for name, b in self.quants:
+            blk = a[off:off + b * NQCOL].reshape(b, NQCOL)
+            off += b * NQCOL
+            rel = [float(math.sqrt(max(e, 0.0) / max(o, 1e-30)))
+                   for e, o in zip(blk[:, 0], blk[:, 1])]
+            efr = [float(math.sqrt(max(e, 0.0) / max(g, 1e-30)))
+                   for e, g in zip(blk[:, 0], blk[:, 2])]
+            quants[name] = {"rel_err": rel, "ef_ratio": efr}
+            rel_all += rel
+            ef_all += efr
+        scalars = {n: float(a[off + i])
+                   for i, n in enumerate(self.scalars)}
+        snap.update({
+            "families": fams,
+            "quant": quants,
+            "scalars": scalars,
+            "grad_rms": float(math.sqrt(g_ss / g_n)) if g_n else 0.0,
+            "grad_amax": g_amax,
+            "update_rms": (float(math.sqrt(u_ss / u_n))
+                           if u_n else None),
+            "overflow_frac_max": over_max,
+            "underflow_frac_max": under_max,
+            "quant_rel_err_max": max(rel_all) if rel_all else None,
+            "quant_rel_err_mean": (float(np.mean(rel_all))
+                                   if rel_all else None),
+            "ef_ratio_max": max(ef_all) if ef_all else None,
+        })
+        return snap
+
+
+class LayoutBox:
+    """Mutable slot a step builder hangs off its compiled step
+    (``step.numerics_layout``); :meth:`Packer.pack` fills it as a
+    trace-time host side effect, so :class:`Monitor` can decode
+    harvests without the builder threading the layout around."""
+
+    def __init__(self):
+        self.layout: Optional[Layout] = None
+
+
+class Packer:
+    """Trace-time accumulator for the one-per-step packed vector."""
+
+    def __init__(self):
+        self._fams: List[Tuple[str, int, int]] = []
+        self._fraw: List[jnp.ndarray] = []
+        self._quants: List[Tuple[str, int]] = []
+        self._qraw: List[jnp.ndarray] = []
+        self._scalars: List[str] = []
+        self._sraw: List[jnp.ndarray] = []
+
+    def family(self, name: str, raw, per_layer_count: int):
+        raw = jnp.asarray(raw)
+        if raw.ndim != 2 or raw.shape[1] != NCOL:
+            raise ValueError(f"family raw must be (L,{NCOL}), "
+                             f"got {raw.shape}")
+        # ptlint: disable=PT001,PT003 -- static shape; the Packer is a
+        # per-trace accumulator, discarded with the trace
+        self._fams.append((str(name), int(raw.shape[0]),
+                           # ptlint: disable=PT001 -- host int
+                           int(per_layer_count)))
+        # ptlint: disable=PT003 -- same per-trace accumulator
+        self._fraw.append(raw.astype(jnp.float32))
+
+    def leaf(self, name: str, x):
+        self.family(name, leaf_raw(x)[None],
+                    int(np.prod(np.shape(x)) or 1))
+
+    def quant(self, name: str, raw):
+        raw = jnp.asarray(raw)
+        if raw.ndim != 2 or raw.shape[1] != NQCOL:
+            raise ValueError(f"quant raw must be (B,{NQCOL}), "
+                             f"got {raw.shape}")
+        self._quants.append((str(name), int(raw.shape[0])))
+        self._qraw.append(raw.astype(jnp.float32))
+
+    def scalar(self, name: str, val):
+        self._scalars.append(str(name))
+        self._sraw.append(jnp.asarray(val).astype(jnp.float32)
+                          .reshape(()))
+
+    def layout(self) -> Layout:
+        return Layout(self._fams, self._quants, self._scalars)
+
+    def pack(self, loss=None, box: Optional[LayoutBox] = None
+             ) -> jnp.ndarray:
+        """Concatenate header + every family/bucket/scalar into the
+        single f32 vector. The provenance header reduces the per-layer
+        non-finite counts layer-major, so the FIRST bad layer wins and
+        ties break toward the earlier-registered family."""
+        F = len(self._fams)
+        if F:
+            lmax = max(L for _, L, _ in self._fams)
+            cols = [jnp.pad(r[:, 2] > 0, (0, lmax - r.shape[0]))
+                    for r in self._fraw]
+            bad = jnp.stack(cols)                       # (F, lmax)
+            flat = bad.T.reshape(-1)                    # layer-major
+            any_bad = jnp.any(flat)
+            first = jnp.argmax(flat)
+            first_layer = jnp.where(any_bad, first // F, -1)
+            first_fam = jnp.where(any_bad, first % F, -1)
+            nft = sum(jnp.sum(r[:, 2]) for r in self._fraw)
+        else:
+            first_layer = first_fam = jnp.int32(-1)
+            nft = jnp.float32(0.0)
+        lossv = (jnp.asarray(loss).astype(jnp.float32).reshape(())
+                 if loss is not None else jnp.float32(jnp.nan))
+        header = jnp.stack([jnp.float32(1.0), lossv,
+                            jnp.asarray(nft, jnp.float32),
+                            first_layer.astype(jnp.float32),
+                            first_fam.astype(jnp.float32)])
+        parts = [header]
+        parts += [r.reshape(-1) for r in self._fraw]
+        parts += [q.reshape(-1) for q in self._qraw]
+        parts += [s.reshape(1) for s in self._sraw]
+        packed = jnp.concatenate(parts).astype(jnp.float32)
+        if box is not None:
+            box.layout = self.layout()
+        return packed
+
+
+def cond_every(step_count, every_k: int, build):
+    """Gate ``build()`` (→ packed vector) behind the cadence: at
+    every_k>1 the stats subgraph runs under ``lax.cond`` keyed on the
+    optimizer step counter and off-cadence steps produce a zeroed
+    vector (tag 0.0) without evaluating the stats at all."""
+    # ptlint: disable=PT001 -- every_k is a host int (env cadence knob)
+    if step_count is None or int(every_k) <= 1:
+        return build()
+    shape = jax.eval_shape(build)
+    # ptlint: disable=PT001 -- same host int
+    pred = (jnp.asarray(step_count) % int(every_k)) == 0
+    return lax.cond(pred, build,
+                    lambda: jnp.zeros(shape.shape, shape.dtype))
+
+
+def capture_step(grads, *, loss=None, updates=None, step_count=None,
+                 stacked_keys=None, box: Optional[LayoutBox] = None
+                 ) -> jnp.ndarray:
+    """One-call in-graph capture for a plain (jit/GSPMD) train step:
+    per-layer grad families (+ optional param-update deltas) packed at
+    the PT_NUMERICS_EVERY cadence."""
+    def build():
+        pk = Packer()
+        add_grad_tree(pk, grads, stacked_keys)
+        if updates is not None:
+            add_grad_tree(pk, updates, stacked_keys, prefix="upd/")
+        return pk.pack(loss=loss, box=box)
+    return cond_every(step_count, max(1, every()), build)
+
+
+def split_out(out):
+    """Split a step's return into ((params, state, loss), packed|None)
+    — builders append the packed vector only when numerics is enabled,
+    so callers stay compatible with both shapes."""
+    if isinstance(out, (tuple, list)) and len(out) == 4:
+        return tuple(out[:3]), out[3]
+    return tuple(out), None
+
+
+# -- fault injection: train.grad_poison ---------------------------------------
+
+def _corrupt_flat(flat, pos, action: str, bit: int):
+    """Corrupt one element of a flattened leaf: action 'nan' plants a
+    NaN, 'bitflip' XORs an exponent bit (default 30 → a huge-but-
+    finite value that trips the amax/overflow detectors instead)."""
+    tgt = flat[pos].astype(jnp.float32)
+    if action == "bitflip":
+        bits = lax.bitcast_convert_type(tgt, jnp.uint32)
+        bad = lax.bitcast_convert_type(
+            bits ^ jnp.uint32(1 << (bit % 32)), jnp.float32)
+    else:
+        bad = jnp.float32(jnp.nan)
+    return flat.at[pos].set(bad.astype(flat.dtype))
+
+
+def _poison_rules():
+    from paddle_tpu.testing import faults
+    if not faults.enabled():
+        return []
+    return faults.spec(FAULT_SITE, actions=("nan", "bitflip"))
+
+
+def _rule_gate(kw, step_count):
+    """Optional in-graph step gate: kw ``step=k`` scopes the (trace-
+    time-armed) corruption to one optimizer step — how the smoke run
+    scripts a MID-run poison with a single compilation."""
+    if "step" in kw and step_count is not None:
+        # ptlint: disable=PT001 -- rule kwargs are host strings
+        return jnp.asarray(step_count) == int(kw["step"])
+    return None
+
+
+def poison_grads(grads, stacked_keys=None, step_count=None):
+    """Fault site ``train.grad_poison``: inject a NaN/bitflip into one
+    layer's gradient IN-GRAPH, before any comm/update consumes it.
+    Consulted at trace time (the rule arms per compilation, like the
+    wire-fault site); rule kwargs:
+
+    - ``layer=k``  which layer of the stacked leaf (default 0)
+    - ``key=sub``  substring selecting the leaf family (default: the
+      first stacked family)
+    - ``step=s``   corrupt only when the optimizer step counter == s
+    - ``bit=b``    exponent bit for action ``bitflip`` (default 30)
+    """
+    rules = _poison_rules()
+    if not rules:
+        return grads
+    flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+    names = [_path_name(p) for p, _ in flat]
+    skeys = _stacked_key_set(grads, stacked_keys)
+    is_stacked = [bool(p and _key_str(p[0]) in skeys
+                       and jnp.ndim(leaf) >= 1)
+                  for p, leaf in flat]
+    vals = [leaf for _, leaf in flat]
+    for kw in rules:
+        key = str(kw.get("key", ""))
+        order = ([i for i in range(len(names)) if is_stacked[i]]
+                 + [i for i in range(len(names)) if not is_stacked[i]])
+        idx = next((i for i in order if key in names[i]), None)
+        if idx is None:
+            continue
+        x = vals[idx]
+        gate = _rule_gate(kw, step_count)
+        action = str(kw.get("action", "nan"))
+        bit = int(kw.get("bit", 30))
+        if is_stacked[idx]:
+            layer = int(kw.get("layer", 0)) % int(x.shape[0])
+            f2 = x.reshape(x.shape[0], -1)
+            bad = _corrupt_flat(f2, (layer, 0), action, bit)
+        else:
+            f2 = x.reshape(-1)
+            bad = _corrupt_flat(f2, 0, action, bit)
+        if gate is not None:
+            bad = jnp.where(gate, bad, f2)
+        vals[idx] = bad.reshape(x.shape)
+    return jax.tree_util.tree_unflatten(treedef, vals)
+
+
+def poison_layer_slice(dw: Dict[str, Any], layer_index,
+                       step_count=None) -> Dict[str, Any]:
+    """Per-layer variant for in-backward scan bodies (the overlap
+    step): ``dw`` holds ONE layer's grad slices and ``layer_index`` is
+    the traced layer id, so the corruption is a ``where`` on the rule's
+    static target layer — the scan body stays uniform."""
+    rules = _poison_rules()
+    if not rules:
+        return dw
+    out = dict(dw)
+    for kw in rules:
+        key = str(kw.get("key", ""))
+        name = next((k for k in out if key in k), None)
+        if name is None:
+            continue
+        layer = int(kw.get("layer", 0))
+        gate = jnp.asarray(layer_index) == layer
+        sgate = _rule_gate(kw, step_count)
+        if sgate is not None:
+            gate = jnp.logical_and(gate, sgate)
+        x = out[name]
+        flat = x.reshape(-1)
+        bad = _corrupt_flat(flat, 0, str(kw.get("action", "nan")),
+                            int(kw.get("bit", 30)))
+        out[name] = jnp.where(gate, bad, flat).reshape(x.shape)
+    return out
+
+
+# -- host plane: recorder / watch / monitor -----------------------------------
+
+class NumericsRecorder:
+    """Bounded ring of the last-N decoded snapshots; on demand dumps
+    them as pid-suffixed atomic JSON (flight-recorder idiom) so the
+    steps LEADING INTO a spike survive the postmortem."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._ring: deque = deque(maxlen=capacity or ring_capacity())
+
+    def append(self, snap: dict):
+        # ptlint: disable=PT003 -- host-plane ring, never traced
+        self._ring.append(snap)
+
+    def snapshots(self) -> List[dict]:
+        return list(self._ring)
+
+    def __len__(self):
+        return len(self._ring)
+
+    def dump(self, reason: str, step=None) -> Optional[dict]:
+        if not self._ring:
+            return None
+        rec = {"reason": str(reason),
+               "step": int(step) if step is not None else None,
+               "dumped_at": time.time(), "pid": os.getpid(),
+               "rank": os.environ.get("PT_PROCESS_ID", "0"),
+               "snapshots": list(self._ring)}
+        stats_lib.add("num/dumps")
+        try:
+            d = _dump_dir()
+            if d:
+                os.makedirs(d, exist_ok=True)
+                tag = rec["step"] if rec["step"] is not None else "na"
+                # pid-suffixed: every rank of a launch shares the dump
+                # dir but holds a different view of the blow-up
+                path = os.path.join(
+                    d, f"numerics_{tag}.{os.getpid()}.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(rec, f)
+                os.replace(tmp, path)
+                rec["path"] = path
+            else:
+                print("[numerics] " + json.dumps(rec),
+                      file=sys.stderr, flush=True)
+        except Exception:
+            pass
+        return rec
+
+
+class NumericsWatch:
+    """Edge-triggered numerics detectors (FleetStats alert idiom: one
+    counter tick + one stderr line per incident; re-fires only after
+    the condition clears):
+
+    - ``nonfinite``      any non-finite grad/update element; the alert
+      names the first bad layer + leaf family from the in-graph
+      provenance reduction
+    - ``loss_spike``     loss z-score vs windowed median/MAD
+    - ``grad_explosion`` grad-rms z-score vs windowed median/MAD
+    - ``overflow``       max per-family dtype-overflow fraction
+    - ``ef_runaway``     error-feedback drift ratio rms(ef)/rms(grad)
+
+    Any firing detector auto-dumps the recorder ring."""
+
+    def __init__(self, window: Optional[int] = None,
+                 z: Optional[float] = None,
+                 overflow_frac: Optional[float] = None,
+                 ef_ratio: Optional[float] = None,
+                 recorder: Optional[NumericsRecorder] = None):
+        self.window = int(window
+                          or _env_float("PT_NUMERICS_WINDOW", 32))
+        self.z = float(z or _env_float("PT_NUMERICS_Z", 6.0))
+        self.overflow_frac = float(
+            overflow_frac or _env_float("PT_NUMERICS_OVERFLOW", 0.01))
+        self.ef_ratio = float(
+            ef_ratio or _env_float("PT_NUMERICS_EF", 8.0))
+        self.recorder = recorder
+        self._loss_hist: deque = deque(maxlen=self.window)
+        self._grad_hist: deque = deque(maxlen=self.window)
+        self._active: set = set()
+        self.alerts: List[dict] = []
+
+    # FleetStats edge-trigger idiom
+    def _fire(self, kind: str, key, msg: str) -> bool:
+        if key in self._active:
+            return False
+        self._active.add(key)
+        stats_lib.add(f"num/alert_{kind}")
+        self.alerts.append({"t": time.time(), "kind": kind,
+                            "msg": msg})
+        print(f"[numerics] ALERT {kind}: {msg}", file=sys.stderr,
+              flush=True)
+        return True
+
+    def _clear(self, key):
+        self._active.discard(key)
+
+    def _spiked(self, hist, value: float) -> bool:
+        """One-sided robust z-score: value above median + z·(1.4826·
+        MAD) with a relative MAD floor so a flat history can't make
+        every wiggle a spike."""
+        if len(hist) < max(4, self.window // 4):
+            return False
+        med = float(np.median(hist))
+        mad = float(np.median(np.abs(np.asarray(hist) - med)))
+        sigma = 1.4826 * mad + 0.05 * abs(med) + 1e-12
+        return value > med + self.z * sigma
+
+    def observe(self, snap: dict) -> List[str]:
+        """Run every detector over one snapshot; returns the kinds
+        that fired ON THIS CALL (edge transitions only)."""
+        fired: List[str] = []
+        step = snap.get("step")
+
+        def fire(kind, msg):
+            if self._fire(kind, (kind,), msg):
+                fired.append(kind)
+
+        loss = snap.get("loss")
+        nonfinite = (snap.get("nonfinite", 0) or 0) > 0 or (
+            loss is not None and not math.isfinite(loss))
+        if nonfinite:
+            fam = snap.get("first_bad_family_name")
+            fire("nonfinite",
+                 f"non-finite at step {step}: layer "
+                 f"{snap.get('first_bad_layer')} family {fam}")
+        else:
+            self._clear(("nonfinite",))
+
+        if loss is not None and math.isfinite(loss):
+            if self._spiked(self._loss_hist, loss):
+                fire("loss_spike",
+                     f"loss {loss:.6g} spiked vs window median "
+                     f"{float(np.median(self._loss_hist)):.6g} "
+                     f"at step {step}")
+            else:
+                self._clear(("loss_spike",))
+            self._loss_hist.append(loss)
+
+        grms = snap.get("grad_rms")
+        if grms is not None and math.isfinite(grms):
+            if self._spiked(self._grad_hist, grms):
+                fire("grad_explosion",
+                     f"grad rms {grms:.6g} exploded vs window median "
+                     f"{float(np.median(self._grad_hist)):.6g} "
+                     f"at step {step}")
+            else:
+                self._clear(("grad_explosion",))
+            self._grad_hist.append(grms)
+
+        over = snap.get("overflow_frac_max") or 0.0
+        if over > self.overflow_frac:
+            fire("overflow", f"dtype overflow fraction {over:.4g} > "
+                 f"{self.overflow_frac:.4g} at step {step}")
+        else:
+            self._clear(("overflow",))
+
+        efr = snap.get("ef_ratio_max")
+        if efr is not None and efr > self.ef_ratio:
+            fire("ef_runaway", f"error-feedback drift {efr:.4g} > "
+                 f"{self.ef_ratio:.4g} at step {step}")
+        else:
+            self._clear(("ef_runaway",))
+
+        if fired and self.recorder is not None:
+            self.recorder.dump(",".join(fired), step=step)
+        return fired
+
+
+class Monitor:
+    """Host endpoint of the capture plane. Per sampled step it pays
+    exactly ONE device→host transfer (``np.asarray`` on the packed
+    vector — outside any jit scope, PT001-clean), decodes it with the
+    builder's :class:`Layout`, records ``num/`` gauges, and feeds the
+    watch + recorder."""
+
+    def __init__(self, layout=None, every_k: Optional[int] = None,
+                 watch: Optional[NumericsWatch] = None,
+                 recorder: Optional[NumericsRecorder] = None):
+        self._layout_src = layout
+        self.every = int(every() if every_k is None else every_k)
+        self.recorder = (recorder if recorder is not None
+                         else NumericsRecorder())
+        self.watch = (watch if watch is not None
+                      else NumericsWatch(recorder=self.recorder))
+        self.samples = 0
+
+    @classmethod
+    def for_step(cls, step_fn, **kw) -> "Monitor":
+        """Bind to a builder-produced step (reads the LayoutBox the
+        builder hung off it)."""
+        return cls(layout=getattr(step_fn, "numerics_layout", None),
+                   **kw)
+
+    def _layout(self) -> Optional[Layout]:
+        src = self._layout_src
+        if isinstance(src, LayoutBox):
+            return src.layout
+        return src
+
+    def due(self, step: int) -> bool:
+        return self.every > 0 and (int(step) % self.every) == 0
+
+    def ingest(self, packed, step: int) -> Optional[dict]:
+        """Harvest one sampled step. Off-cadence calls return None
+        without touching the device array (no transfer)."""
+        if packed is None or not self.due(step):
+            return None
+        lay = self._layout()
+        if lay is None:
+            return None
+        snap = lay.unpack(np.asarray(packed))   # the ONE transfer
+        if snap is None:                        # in-graph cond said no
+            return None
+        snap["step"] = int(step)
+        self.samples += 1
+        self._gauges(snap)
+        self.recorder.append(snap)
+        snap["alerts"] = self.watch.observe(snap)
+        return snap
+
+    def _gauges(self, snap: dict):
+        stats_lib.add("num/samples")
+        sv = stats_lib.set_value
+        if math.isfinite(snap["loss"]):
+            sv("num/loss", snap["loss"])
+        sv("num/nonfinite", snap["nonfinite"])
+        sv("num/first_bad_layer", snap["first_bad_layer"])
+        sv("num/grad_rms", snap["grad_rms"])
+        sv("num/grad_amax", snap["grad_amax"])
+        sv("num/overflow_frac", snap["overflow_frac_max"])
+        sv("num/underflow_frac", snap["underflow_frac_max"])
+        if snap.get("update_rms") is not None:
+            sv("num/update_rms", snap["update_rms"])
+        if snap.get("quant_rel_err_mean") is not None:
+            sv("num/quant_rel_err", snap["quant_rel_err_mean"])
+            sv("num/quant_rel_err_max", snap["quant_rel_err_max"])
+        if snap.get("ef_ratio_max") is not None:
+            sv("num/ef_drift", snap["ef_ratio_max"])

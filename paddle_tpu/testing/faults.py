@@ -104,6 +104,16 @@ Sites currently wired into the runtime:
                           SIGKILLs itself mid-traffic; failover +
                           journal recovery must preserve every
                           request id — docs/fleet-ha.md)
+    train.grad_poison     in-graph gradient corruption
+                          (observability/numerics.py, :func:`spec`) —
+                          nan/bitflip one leaf's grads inside the
+                          jitted train step; ``layer=L`` targets one
+                          layer of a stacked block (overlap scan body
+                          or the (L, ...) leaf slice), ``key=`` substring
+                          selects the leaf, ``step=S`` bakes an in-graph
+                          step-counter gate (one compile, fires at
+                          optimizer step S) — the localization drill the
+                          numerics provenance header must name
 """
 
 import os

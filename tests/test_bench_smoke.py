@@ -151,6 +151,15 @@ def test_bench_train_overlap_smoke():
     assert out["train_overlap_exposed_s"] >= 0, out
 
 
+def test_bench_train_numerics_smoke():
+    out = bench.bench_train_numerics(jax, jnp, PEAK, smoke=True)
+    for name in ("off", "every1", "every16"):
+        assert out.get(f"train_numerics_{name}_step_ms", 0) > 0, out
+    assert "train_numerics_overhead_frac" in out, out
+    # parity: the in-graph stats never feed back into the update
+    assert abs(out.get("train_numerics_loss_delta", 1)) < 1e-6, out
+
+
 def test_bench_train_sharded_stacked_smoke():
     out = bench.bench_train_sharded_stacked(jax, jnp, PEAK, smoke=True)
     assert out.get("train_sharded_stacked_per_layer_step_ms", 0) > 0, out
@@ -201,6 +210,7 @@ def test_bench_nonsmoke_cpu_guards():
     assert bench.bench_train_sharded_stacked(jax, jnp, PEAK) == {}
     assert bench.bench_train_overlap(jax, jnp, PEAK) == {}
     assert bench.bench_serve_disagg(jax, jnp, PEAK) == {}
+    assert bench.bench_train_numerics(jax, jnp, PEAK) == {}
 
 
 def test_split_params_contract():

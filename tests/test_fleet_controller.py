@@ -298,10 +298,20 @@ def test_drain_replica_completes_streams_no_new_placements():
     """The drain acceptance: a draining replica under active streams
     completes (or redistributes) EVERY request id, never receives a
     new placement, publishes ``drained``, and its process exits on its
-    own — zero request-id loss, no SIGKILL needed on the happy path."""
+    own — zero request-id loss, no SIGKILL needed on the happy path.
+
+    This test pins the PR 14 FINISH-IN-PLACE drain contract (the
+    "finished ON rep0" assert below), so the replicas opt out of the
+    PR 16 ``PT_DRAIN_MIGRATE`` default — under migration rep0 hands
+    its streams to rep1 and the assert can never hold (and the
+    sender's KV endpoint may already be gone by the time the survivor
+    fetches, demoting the handoff to a from-scratch re-place). The
+    migrate path has its own acceptance: test_reshard.py and
+    ``tools/ci.sh reshard``."""
     stats.reset("serve/router")
     router = Router(port=0, dead_after=15.0)
-    procs = [_spawn_replica(router.store.port, f"rep{i}", 8845 + i)
+    procs = [_spawn_replica(router.store.port, f"rep{i}", 8845 + i,
+                            extra_env={"PT_DRAIN_MIGRATE": "0"})
              for i in range(2)]
     try:
         router.wait_replicas(2, timeout=90)

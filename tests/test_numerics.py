@@ -1,0 +1,402 @@
+"""Training-numerics observability plane (ISSUE 18): in-graph tensor
+stats, NaN provenance, quantization-error drift watch.
+
+The load-bearing assertions:
+
+- ONE packed f32 vector per sampled step (`Layout.size` elements), with
+  the cadence cond zeroing off-cadence steps in-graph;
+- planted `train.grad_poison` faults localize — the provenance header
+  names the planted layer AND leaf family — on the plain sharded step
+  (PR 7 builder) and the overlap-scheduled step (PR 11 builder);
+- quantization-error gauges follow the wire: ~0 on fp32, within the
+  block half-step bound on int8, nonzero on fp8 — and survive the
+  overlap on/off scan restructure bit-identically;
+- parity stays bitwise with numerics ENABLED: the stats ride outside
+  the pinned subgraphs;
+- detector auto-dump: the flight-recorder file holds pre-spike
+  snapshots from before the planted step.
+"""
+
+import glob
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed as dist
+from paddle_tpu import optimizer as optim
+from paddle_tpu import stats
+from paddle_tpu.distributed import overlap as OV
+from paddle_tpu.distributed.sharding import (
+    attach_comm_ef, build_group_sharded_step, init_group_sharded_state)
+from paddle_tpu.observability import numerics as nm
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture
+def fsdp_mesh():
+    topo = dist.init_mesh(fsdp=4, devices=jax.devices()[:4],
+                          set_global=False)
+    yield topo
+    from paddle_tpu.distributed import mesh as mesh_lib
+    mesh_lib.set_topology(None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_stats():
+    stats.reset("num/")
+    yield
+    stats.reset("num/")
+
+
+def _batch(seed=0, b=16, d=16, k=8):
+    rs = np.random.RandomState(seed)
+    return (jnp.asarray(rs.randn(b, d), jnp.float32),
+            jnp.asarray(rs.randn(b, k), jnp.float32))
+
+
+def _ov_step(mesh, **kw):
+    params, stacked, emb, blk, lf = OV.mlp_block_model(n_layers=3)
+    kw.setdefault("bucket_mb", 1e-4)
+    return OV.overlap_parallel(
+        dict(params), emb, blk, lf, optim.SGD(learning_rate=0.05),
+        mesh, stacked, **kw)
+
+
+def _flat_step(mesh, comm_quant=None):
+    """PR 7 builder (build_group_sharded_step) on the same stacked
+    model, numerics keyed to the stacked leaves."""
+    params, stacked, emb, blk, lf = OV.mlp_block_model(n_layers=3)
+    specs = OV.overlap_group_specs(dict(params), mesh, stacked)
+
+    def flat_loss(p, xb, yb):
+        h = emb(p, xb, yb)
+        for l in range(3):
+            h = blk({k: p[k][l] for k in stacked}, h)
+        return lf(p, h, xb, yb)
+
+    opt = optim.SGD(learning_rate=0.05)
+    sp, st = init_group_sharded_state(dict(params), opt, specs)
+    if comm_quant:
+        st = attach_comm_ef(dict(params), st, specs)
+    step = build_group_sharded_step(flat_loss, opt, specs,
+                                    comm_quant=comm_quant,
+                                    stacked_keys=stacked)
+    return sp, st, step
+
+
+def _drive(step, sp, st, batch, n, monitor=None):
+    snaps = []
+    for i in range(n):
+        out = step(sp, st, *batch)
+        (sp, st, loss), packed = nm.split_out(out)
+        if monitor is not None:
+            snaps.append(monitor.ingest(packed, step=i))
+    return sp, st, snaps
+
+
+# -- packed layout / provenance / cadence (engine-light) ---------------------
+
+def test_packer_layout_roundtrip():
+    pk = nm.Packer()
+    g = jnp.asarray(np.linspace(-1.0, 1.0, 24), jnp.float32)
+    pk.family("grad/blk", nm.stacked_raw(g.reshape(3, 8)), 8)
+    pk.leaf("grad/(rest)", g)
+    pk.quant("rs", jnp.asarray([[0.04, 4.0, 4.0]], jnp.float32))
+    pk.scalar("extra", 7.0)
+    packed = pk.pack(loss=0.5)
+    lay = pk.layout()
+    assert packed.shape == (lay.size,)
+    snap = lay.unpack(np.asarray(packed))
+    assert snap["loss"] == pytest.approx(0.5)
+    assert snap["first_bad_layer"] == -1
+    assert set(snap["families"]) == {"grad/blk", "grad/(rest)"}
+    want = math.sqrt(float(jnp.sum(g[:8] ** 2)) / 8)
+    assert snap["families"]["grad/blk"]["rms"][0] == pytest.approx(
+        want, rel=1e-5)
+    assert snap["quant"]["rs"]["rel_err"][0] == pytest.approx(0.1)
+    assert snap["quant"]["rs"]["ef_ratio"][0] == pytest.approx(0.1)
+    assert snap["scalars"]["extra"] == pytest.approx(7.0)
+
+
+def test_provenance_first_bad_is_layer_major():
+    """A NaN in (layer 1, family B) beats (layer 2, family A): the
+    argmax runs layer-major so the EARLIEST bad layer wins, ties
+    breaking toward the earlier-registered family."""
+    pk = nm.Packer()
+    a = np.zeros((3, 4), np.float32)
+    b = np.zeros((3, 4), np.float32)
+    a[2, 0] = np.nan
+    b[1, 0] = np.nan
+    pk.family("grad/a", nm.stacked_raw(jnp.asarray(a)), 4)
+    pk.family("grad/b", nm.stacked_raw(jnp.asarray(b)), 4)
+    snap = pk.layout().unpack(np.asarray(pk.pack(loss=1.0)))
+    assert snap["first_bad_layer"] == 1
+    assert snap["first_bad_family_name"] == "grad/b"
+    assert snap["nonfinite"] == 2.0
+
+
+def test_cond_every_zeroes_off_cadence_steps():
+    def make(step_count):
+        return nm.cond_every(
+            step_count, 4,
+            lambda: jnp.arange(1.0, 6.0, dtype=jnp.float32))
+
+    f = jax.jit(make)
+    assert np.asarray(f(jnp.int32(0)))[0] == 1.0
+    assert np.all(np.asarray(f(jnp.int32(3))) == 0.0)
+    assert np.asarray(f(jnp.int32(8)))[0] == 1.0
+
+
+def test_split_out_shapes():
+    assert nm.split_out((1, 2, 3)) == ((1, 2, 3), None)
+    assert nm.split_out((1, 2, 3, "pk")) == ((1, 2, 3), "pk")
+
+
+def test_dtype_overflow_underflow_fractions():
+    x = jnp.asarray([3.3e38, 1.0, 1e-40, 0.0],
+                    jnp.float32).reshape(1, 4)
+    raw = np.asarray(nm.stacked_raw(x))
+    assert raw[0, 3] == 1.0      # one overflow-at-risk value
+    assert raw[0, 4] == 1.0      # one subnormal (0.0 doesn't count)
+
+
+# -- watch detectors / recorder (host plane) ---------------------------------
+
+def _snap(loss=1.0, grad_rms=0.1, nonfinite=0.0, overflow=0.0,
+          ef=None, step=0):
+    return {"loss": loss, "nonfinite": nonfinite, "grad_rms": grad_rms,
+            "first_bad_layer": -1, "first_bad_family_name": None,
+            "overflow_frac_max": overflow, "ef_ratio_max": ef,
+            "quant_rel_err_max": None, "families": {}, "quant": {},
+            "step": step}
+
+
+def test_watch_loss_spike_edge_triggered(capsys):
+    w = nm.NumericsWatch(window=8, z=6.0)
+    for i in range(8):
+        assert w.observe(_snap(loss=1.0 + 0.01 * (i % 3), step=i)) == []
+    assert "loss_spike" in w.observe(_snap(loss=50.0, step=8))
+    # still high: no re-fire (edge-triggered)
+    assert w.observe(_snap(loss=50.0, step=9)) == []
+    err = capsys.readouterr().err
+    assert err.count("ALERT loss_spike") == 1
+
+
+def test_watch_overflow_and_ef_runaway():
+    w = nm.NumericsWatch(window=4)
+    assert "overflow" in w.observe(_snap(overflow=0.5))
+    assert "ef_runaway" in w.observe(_snap(ef=99.0, step=1))
+
+
+def test_watch_nonfinite_names_layer_and_family():
+    w = nm.NumericsWatch()
+    s = _snap(nonfinite=3.0)
+    s["first_bad_layer"] = 2
+    s["first_bad_family_name"] = "grad/blocks.w2"
+    assert "nonfinite" in w.observe(s)
+    assert stats.get("num/alert_nonfinite") == 1
+
+
+def test_recorder_ring_and_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("PT_NUMERICS_DIR", str(tmp_path))
+    rec = nm.NumericsRecorder(capacity=2)
+    for i in range(4):
+        rec.append(_snap(step=i))
+    assert len(rec) == 2
+    rec.dump("test_reason", step=3)
+    files = glob.glob(str(tmp_path / "numerics_3.*.json"))
+    assert len(files) == 1
+    doc = json.loads(open(files[0]).read())
+    assert doc["reason"] == "test_reason"
+    assert [s["step"] for s in doc["snapshots"]] == [2, 3]
+
+
+# -- plain sharded (PR 7) builder --------------------------------------------
+
+def test_flat_step_numerics_families_and_parity(fsdp_mesh, monkeypatch):
+    """The PR 7 builder with numerics ENABLED: per-layer families over
+    the stacked leaves, one packed vector, and the SAME parameters as
+    the numerics-off build (stats never feed back)."""
+    batch = _batch()
+    sp0, st0, step0 = _flat_step(fsdp_mesh.mesh)
+    sp0, st0, _ = _drive(step0, sp0, st0, batch, 3)
+
+    monkeypatch.setenv("PT_NUMERICS_EVERY", "1")
+    sp, st, step = _flat_step(fsdp_mesh.mesh)
+    mon = nm.Monitor.for_step(step)
+    sp, st, snaps = _drive(step, sp, st, batch, 3, monitor=mon)
+    for k in sp0:
+        np.testing.assert_array_equal(np.asarray(sp0[k]),
+                                      np.asarray(sp[k]), err_msg=k)
+    snap = snaps[-1]
+    fams = snap["families"]
+    for k in ("grad/blocks.w1", "grad/blocks.b1", "grad/blocks.w2"):
+        assert len(fams[k]["rms"]) == 3, k
+        assert all(v > 0 for v in fams[k]["rms"]), k
+    assert snap["first_bad_layer"] == -1
+    assert snap["grad_rms"] > 0
+
+
+def test_flat_step_localizes_planted_fault(fsdp_mesh, monkeypatch):
+    monkeypatch.setenv("PT_NUMERICS_EVERY", "1")
+    with faults.inject("train.grad_poison", "nan", layer=1,
+                       key="blocks.w1"):
+        sp, st, step = _flat_step(fsdp_mesh.mesh, comm_quant="int8")
+        mon = nm.Monitor.for_step(step)
+        _, _, snaps = _drive(step, sp, st, _batch(), 1, monitor=mon)
+    snap = snaps[0]
+    assert snap["first_bad_layer"] == 1
+    assert snap["first_bad_family_name"] == "grad/blocks.w1"
+    assert "nonfinite" in snap["alerts"]
+
+
+# -- overlap (PR 11) builder -------------------------------------------------
+
+def test_overlap_numerics_parity_and_quant_gauges(fsdp_mesh,
+                                                  monkeypatch):
+    """Numerics ENABLED on the overlap step: overlap on/off stays
+    BIT-identical (params AND the packed vector — the stats read the
+    same barriered grads), fp32 reports ~0 wire error, int8 a nonzero
+    error within the block half-step bound."""
+    batch = _batch()
+    monkeypatch.setenv("PT_NUMERICS_EVERY", "1")
+    packs = {}
+    for on in (True, False):
+        sp, st, step = _ov_step(fsdp_mesh.mesh, comm_quant="int8",
+                                overlap=on, prefetch=False)
+        out = step(sp, st, *batch)
+        (sp2, _, _), packed = nm.split_out(out)
+        packs[on] = (jax.device_get(sp2), np.asarray(packed),
+                     nm.Monitor.for_step(step).ingest(packed, 0))
+    for k in packs[True][0]:
+        np.testing.assert_array_equal(packs[True][0][k],
+                                      packs[False][0][k], err_msg=k)
+    np.testing.assert_array_equal(packs[True][1], packs[False][1])
+
+    snap = packs[True][2]
+    rel = snap["quant"]["blk"]["rel_err"]
+    assert all(r > 0 for r in rel)
+    # block half-step bound: per element |q(x)-x| <= amax/(2*127) with
+    # the per-layer family amax bounding every block's scale source
+    fams = snap["families"]
+    params, stacked, *_ = OV.mlp_block_model(n_layers=3)
+    specs = OV.overlap_group_specs(dict(params), fsdp_mesh.mesh,
+                                   stacked)
+    sdim = OV._shard_dims(specs)
+    rs = [k for k in stacked if k in sdim]
+    buckets = OV.partition_buckets(
+        [(k, 4 * int(np.prod(params[k].shape[1:]))) for k in rs],
+        bucket_mb=1e-4, reverse=True)
+    assert len(rel) == len(buckets)
+    for row, b in zip(rel, buckets):
+        num = den = 0.0
+        for k in b:
+            n = int(np.prod(params[k].shape[1:]))
+            f = fams[f"grad/{k}"]
+            num += sum(n * (a / 254.0) ** 2 for a in f["amax"])
+            den += sum(n * r * r for r in f["rms"])
+        assert row <= math.sqrt(num / den) * 1.05 + 1e-9, (b, row)
+
+    # fp32 wire: exactly-representable exchange, error ~0
+    sp, st, step = _ov_step(fsdp_mesh.mesh, comm_quant=None)
+    out = step(sp, st, *batch)
+    snap32 = nm.Monitor.for_step(step).ingest(out[3], 0)
+    assert snap32["quant_rel_err_max"] < 1e-7
+    # fp8 wire: nonzero, bounded
+    sp, st, step = _ov_step(fsdp_mesh.mesh, comm_quant="fp8")
+    out = step(sp, st, *batch)
+    snap8 = nm.Monitor.for_step(step).ingest(out[3], 0)
+    assert 0 < snap8["quant_rel_err_max"] < 0.2
+
+
+def test_overlap_cadence_only_sampled_steps(fsdp_mesh, monkeypatch):
+    monkeypatch.setenv("PT_NUMERICS_EVERY", "2")
+    sp, st, step = _ov_step(fsdp_mesh.mesh, comm_quant="int8")
+    mon = nm.Monitor.for_step(step)
+    _, _, snaps = _drive(step, sp, st, _batch(), 4, monitor=mon)
+    assert [s is not None for s in snaps] == [True, False, True, False]
+
+
+def test_overlap_localizes_planted_fault_with_autodump(fsdp_mesh,
+                                                       monkeypatch,
+                                                       tmp_path):
+    """ACCEPTANCE: a scripted mid-run poison (step=2 rule, ONE compile)
+    on the overlap/quantized builder is localized by the provenance
+    header, fires exactly one nonfinite alert, and the auto-dumped
+    flight record holds the CLEAN pre-spike snapshots."""
+    monkeypatch.setenv("PT_NUMERICS_EVERY", "1")
+    monkeypatch.setenv("PT_NUMERICS_DIR", str(tmp_path))
+    with faults.inject("train.grad_poison", "nan", layer=2,
+                       key="blocks.w2", step=2):
+        sp, st, step = _ov_step(fsdp_mesh.mesh, comm_quant="int8")
+        mon = nm.Monitor.for_step(step)
+        sp, st, snaps = _drive(step, sp, st, _batch(), 4, monitor=mon)
+    # steps 0/1 clean; step 2 carries the plant (step 3 legitimately
+    # cascades — NaN grads poisoned the update, like a real blow-up)
+    assert [s["nonfinite"] > 0 for s in snaps[:3]] == [False, False,
+                                                       True]
+    bad = snaps[2]
+    assert bad["first_bad_layer"] == 2
+    assert bad["first_bad_family_name"] == "grad/blocks.w2"
+    assert bad["alerts"] == ["nonfinite"]
+    # edge-triggered: the step-3 cascade does NOT re-fire
+    assert snaps[3]["alerts"] == []
+    assert stats.get("num/alert_nonfinite") == 1
+    files = glob.glob(str(tmp_path / "numerics_2.*.json"))
+    assert len(files) == 1
+    doc = json.loads(open(files[0]).read())
+    assert doc["reason"] == "nonfinite"
+    pre = [s for s in doc["snapshots"] if s["step"] < 2]
+    assert len(pre) == 2 and all(s["nonfinite"] == 0 for s in pre)
+
+
+def test_overlap_tail_sync_build_localizes_too(fsdp_mesh, monkeypatch):
+    """The poison site lives in the backward scan body of EVERY
+    schedule variant — the tail-sync baseline localizes the same."""
+    monkeypatch.setenv("PT_NUMERICS_EVERY", "1")
+    with faults.inject("train.grad_poison", "nan", layer=0,
+                       key="blocks.b1"):
+        sp, st, step = _ov_step(fsdp_mesh.mesh, comm_quant="int8",
+                                overlap=False, prefetch=False)
+        mon = nm.Monitor.for_step(step)
+        _, _, snaps = _drive(step, sp, st, _batch(), 1, monitor=mon)
+    assert snaps[0]["first_bad_layer"] == 0
+    assert snaps[0]["first_bad_family_name"] == "grad/blocks.b1"
+
+
+# -- model steps (gpt) -------------------------------------------------------
+
+@pytest.mark.slow
+def test_gpt_step_numerics_and_localization(monkeypatch):
+    from paddle_tpu.models import gpt
+    topo = dist.init_mesh(dp=2, fsdp=2, devices=jax.devices()[:4],
+                          set_global=False)
+    try:
+        monkeypatch.setenv("PT_NUMERICS_EVERY", "1")
+        cfg = gpt.gpt_tiny(max_seq_len=16, dtype=jnp.float32)
+        model = gpt.GPT(cfg, seed=0)
+        opt = optim.AdamW(learning_rate=1e-3)
+        tokens = jnp.zeros((4, 16), jnp.int32)
+        rng = jax.random.PRNGKey(0)
+        with faults.inject("train.grad_poison", "nan", layer=1,
+                           key="_stacked_blocks"):
+            params, opt_state = gpt.init_train_state(model, opt,
+                                                     topo.mesh,
+                                                     stacked=True)
+            step = gpt.build_train_step(model, opt, topo.mesh,
+                                        donate=False)
+            mon = nm.Monitor.for_step(step)
+            out = step(params, opt_state, tokens, rng)
+            (_, _, _), packed = nm.split_out(out)
+            snap = mon.ingest(packed, 0)
+        assert snap["first_bad_layer"] == 1
+        assert "_stacked_blocks" in snap["first_bad_family_name"]
+        assert snap["update_rms"] is not None
+    finally:
+        from paddle_tpu.distributed import mesh as mesh_lib
+        mesh_lib.set_topology(None)

@@ -68,7 +68,7 @@ _HIGHER = ("tokens_per_sec", "tok_s", "goodput", "mfu", "hw_util",
            "completed", "ips")
 _LOWER = ("_ms", "ttft", "tpot", "latency", "_tax_frac", "exposed_s",
           "peak_mb", "rejects", "evictions", "spawn_timeouts",
-          "host_gap", "recovery_s")
+          "host_gap", "recovery_s", "overhead_frac")
 # checked BEFORE _HIGHER: rows whose name embeds a higher-is-better
 # fragment but measure a cost (the drain bench's goodput_dip_frac
 # contains "goodput" yet a bigger dip is a worse drain)

@@ -82,6 +82,14 @@
 #                          serving replica must MIGRATE its in-flight
 #                          decode requests to the survivor with zero id
 #                          loss and byte-identical streams
+#   tools/ci.sh numerics   training-numerics smoke (~1 min): tiny CPU
+#                          train run with a scripted mid-run grad
+#                          poison (PT_FAULTS step= rule) — the
+#                          provenance header must name the planted
+#                          layer + leaf family, EXACTLY one
+#                          num/alert_nonfinite fires, and the
+#                          auto-dumped flight record holds the clean
+#                          pre-spike snapshots
 #   tools/ci.sh benchdiff  bench regression sentinel: the checked-in
 #                          BENCH_r05.json snapshot must self-diff
 #                          clean and bench_diff's synthetic 20% tok/s
@@ -173,6 +181,11 @@ fi
 if [[ "${1:-}" == "reshard" ]]; then
     shift
     exec python tools/reshard_smoke.py "$@"
+fi
+
+if [[ "${1:-}" == "numerics" ]]; then
+    shift
+    exec python tools/numerics_smoke.py "$@"
 fi
 
 if [[ "${1:-}" == "benchdiff" ]]; then

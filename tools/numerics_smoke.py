@@ -1,0 +1,119 @@
+"""Training-numerics smoke (tools/ci.sh numerics, ISSUE 18): a tiny
+CPU train run with a SCRIPTED mid-run gradient poison, end to end
+through the whole numerics plane (~1 min):
+
+- the overlap/quantized train step builds with PT_NUMERICS_EVERY=1 and
+  returns ONE packed stats vector per step (one host transfer each);
+- PT_FAULTS="train.grad_poison:nan:layer=1,key=blocks.w2,step=6" arms
+  the in-graph poison through the ENV path (one compilation — the
+  step gate is traced, not re-armed per step);
+- steps 0..5 harvest clean; step 6's provenance header names the
+  planted layer AND leaf family; EXACTLY one num/alert_nonfinite
+  fires (the step-7 NaN cascade must not re-fire the edge trigger);
+- the auto-dumped flight record (PT_NUMERICS_DIR) holds the clean
+  pre-spike snapshots;
+- the quantization-error gauges are live (int8 wire: nonzero rel_err)
+  and the num/ registry keys are set for /statsz export.
+
+Exit 0 + "NUMERICS SMOKE OK" on success; any divergence asserts.
+"""
+import glob
+import json
+import math
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ["PT_NUMERICS_EVERY"] = "1"
+DUMP_DIR = tempfile.mkdtemp(prefix="numerics_smoke_")
+os.environ["PT_NUMERICS_DIR"] = DUMP_DIR
+PLANT_STEP, PLANT_LAYER, PLANT_KEY = 6, 1, "blocks.w2"
+os.environ["PT_FAULTS"] = (
+    f"train.grad_poison:nan:layer={PLANT_LAYER},key={PLANT_KEY},"
+    f"step={PLANT_STEP}")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu import optimizer as optim  # noqa: E402
+from paddle_tpu import stats  # noqa: E402
+from paddle_tpu.distributed import mesh as mesh_lib  # noqa: E402
+from paddle_tpu.distributed import overlap as OV  # noqa: E402
+from paddle_tpu.observability import numerics as nm  # noqa: E402
+from paddle_tpu.testing import faults  # noqa: E402
+
+
+def main():
+    n_rules = faults.install_from_env()
+    assert n_rules == 1, f"PT_FAULTS installed {n_rules} rules"
+
+    topo = mesh_lib.init_mesh(fsdp=4, devices=jax.devices()[:4],
+                              set_global=False)
+    params, stacked, emb, blk, lf = OV.mlp_block_model(n_layers=3)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(16, 16), jnp.float32)
+    y = jnp.asarray(rs.randn(16, 8), jnp.float32)
+    sp, st, step = OV.overlap_parallel(
+        dict(params), emb, blk, lf, optim.SGD(learning_rate=0.05),
+        topo.mesh, stacked, comm_quant="int8", bucket_mb=1e-4)
+    mon = nm.Monitor.for_step(step)
+
+    snaps = []
+    for i in range(10):
+        out = step(sp, st, x, y)
+        (sp, st, loss), packed = nm.split_out(out)
+        snaps.append(mon.ingest(packed, step=i))
+
+    # -- pre-spike steps harvested clean, one transfer each -------------
+    pre = snaps[:PLANT_STEP]
+    assert all(s is not None for s in pre), "missed samples"
+    assert all(s["nonfinite"] == 0 for s in pre), "early nonfinite"
+    assert all(math.isfinite(s["loss"]) for s in pre)
+    assert all(s["quant_rel_err_max"] > 0 for s in pre), \
+        "int8 wire must show nonzero quantization error"
+
+    # -- the plant localizes: layer AND leaf family ---------------------
+    bad = snaps[PLANT_STEP]
+    assert bad["nonfinite"] > 0, "plant did not fire"
+    assert bad["first_bad_layer"] == PLANT_LAYER, bad["first_bad_layer"]
+    assert bad["first_bad_family_name"] == f"grad/{PLANT_KEY}", \
+        bad["first_bad_family_name"]
+    assert bad["alerts"] == ["nonfinite"]
+
+    # -- EXACTLY one alert: the NaN cascade must not re-fire ------------
+    assert stats.get("num/alert_nonfinite") == 1, \
+        stats.get("num/alert_nonfinite")
+    assert all(s["alerts"] == [] for s in snaps[PLANT_STEP + 1:])
+
+    # -- auto-dump holds the clean pre-spike history --------------------
+    files = glob.glob(os.path.join(DUMP_DIR,
+                                   f"numerics_{PLANT_STEP}.*.json"))
+    assert len(files) == 1, files
+    doc = json.loads(open(files[0]).read())
+    assert doc["reason"] == "nonfinite"
+    pre_dumped = [s for s in doc["snapshots"] if s["step"] < PLANT_STEP]
+    assert len(pre_dumped) >= 3, len(pre_dumped)
+    assert all(s["nonfinite"] == 0 for s in pre_dumped)
+
+    # -- the registry carries the num/ plane for /statsz ----------------
+    snap = stats.snapshot(prefix="num/")
+    for key in ("num/loss", "num/grad_rms", "num/quant_rel_err",
+                "num/first_bad_layer", "num/samples", "num/dumps"):
+        assert key in snap, (key, sorted(snap))
+
+    print(f"plant step={PLANT_STEP} -> layer={bad['first_bad_layer']} "
+          f"family={bad['first_bad_family_name']}; "
+          f"alerts={stats.get('num/alert_nonfinite')}; "
+          f"dump={os.path.basename(files[0])} "
+          f"({len(pre_dumped)} pre-spike snapshots)")
+    print("NUMERICS SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()
