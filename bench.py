@@ -688,7 +688,7 @@ def bench_decode(jax, jnp, peak, smoke=False):
     sections = {s.strip() for s in os.environ.get(
         "PT_DECODE_SECTIONS",
         "generate,int8,engine,engine_longctx,engine_paged,"
-        "engine_paged_prefix,engine_int8,spec").split(",")}
+        "engine_paged_prefix,engine_int8,spec,spec_paged").split(",")}
     b, s0, new = (2, 8, 4) if smoke else (8, 128, 64)
     res = {"decode_batch": b, "decode_prefill": s0, "decode_new": new}
     tokens = jnp.asarray(
@@ -830,8 +830,22 @@ def bench_decode(jax, jnp, peak, smoke=False):
                     devprof.record_roofline(key, tps, aroof), 4)
             res[f"{key}_launch_tax_frac"] = round(
                 devprof.launch_tax_fraction(disp, wall, name=key), 4)
-            res[f"{key}_launches_per_token"] = round(
-                disp / max(1, toks), 4)
+            # kernel launches per generated token (ISSUE 19): pallas
+            # launches in the dispatch program (scan-trip weighted,
+            # counted from the jaxpr without executing) — the
+            # single-dispatch megakernel claim as a LOWER-direction
+            # ladder row, with the per-step count alongside (mega
+            # paged step = 2: layer-folded kernel + sampling epilogue)
+            try:
+                fn, fargs = e.dispatch_fn_args()
+                lpc = devprof.count_pallas_launches(fn, *fargs)
+                res[f"{key}_launches_per_step"] = round(
+                    lpc / max(1, e.chunk), 2)
+                res[f"{key}_launches_per_token"] = round(
+                    lpc * disp / max(1, toks), 4)
+            except AttributeError:  # engine without dispatch_fn_args
+                res[f"{key}_launches_per_token"] = round(
+                    disp / max(1, toks), 4)
         except Exception as ex:
             res[f"{key}_prof_error"] = str(ex)[:120]
 
@@ -1041,6 +1055,52 @@ def bench_decode(jax, jnp, peak, smoke=False):
             res["decode_spec_vs_roofline"] = round(toks2 / sdt / roof, 4)
     except Exception as e:
         res["decode_spec_error"] = str(e)[:160]
+
+    # speculative decoding on the PAGED engine (ISSUE 19): the same
+    # repetition-heavy workload, but drafts + verify + acceptance ride
+    # the single-dispatch megakernel program — launches_per_step is
+    # the guard that spec verify stays at 2 launches (vs O(layers)).
+    # This row died in r05 (RESOURCE_EXHAUSTED killed the engine build
+    # and the old suite had no paged-spec row to notice); it is now
+    # guarded by name in tools/bench_diff.py.
+    try:
+      if "spec_paged" in sections and eng2 is not None:
+        from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+        n_spec = n_new2 if not smoke else 12
+        need = s_pf + n_spec + spec_k
+        engS = PagedDecodeEngine(
+            None, n_pages=slots * (need // 128 + 2) + 2,
+            max_slots=slots, steps_per_call=2 if smoke else 16,
+            speculative_k=spec_k, share_weights_with=eng2)
+        rs = np.random.RandomState(2)
+        loops = [list(rs.randint(0, cfg.vocab_size, 8))
+                 for _ in range(slots)]
+        sp_prompts = [(lp * (s_pf // 8 + 1))[:s_pf] for lp in loops]
+        for p in sp_prompts:  # warm (compiles + prefix registration)
+            engS.submit(p, max_new_tokens=2)
+        engS.run()
+        reqs3 = [engS.submit(p, max_new_tokens=n_spec)
+                 for p in sp_prompts]
+        engS.step()
+        pre3 = sum(len(r.tokens) for r in reqs3)
+        s0s = engS.steps
+        t0 = time.perf_counter()
+        engS.run()
+        sdt = time.perf_counter() - t0
+        disp3 = engS.steps - s0s
+        toks3 = sum(len(r.tokens) for r in reqs3) - pre3
+        res["decode_spec_paged_tokens_per_sec"] = round(toks3 / sdt, 1)
+        res["decode_spec_paged_tokens_per_step"] = round(
+            toks3 / max(1, disp3 * engS.chunk), 2)
+        if roof:
+            res["decode_spec_paged_vs_roofline"] = round(
+                toks3 / sdt / roof, 4)
+        _prof_rows(engS, "decode_spec_paged", toks3 / sdt, disp3,
+                   toks3, sdt)
+        engS.kp = engS.vp = None
+        del engS
+    except Exception as e:
+        res["decode_spec_paged_error"] = str(e)[:160]
     return res
 
 

@@ -454,6 +454,16 @@ declare_env("PT_PAGED_TUNE", "1 runs paged-kernel autotuning "
             "(pages_per_program, head_block) from the engine "
             "constructor, before any trace picks up the config.",
             default="0", owner="inference/paged_engine.py")
+declare_env("PT_PAGED_MEGA", "0 disables the single-dispatch decode "
+            "megakernel (layer-folded layers + fused sampling "
+            "epilogue, 2 launches/step), falling back to the per-layer "
+            "fused path (one paged launch per layer — the bit-parity "
+            "reference).", default="1",
+            owner="inference/paged_engine.py")
+declare_env("PT_SERVE_ENGINE", "Default serving engine for the "
+            "front-end/bench ladder: 'paged' (default) or 'contiguous' "
+            "(the slot-contiguous DecodeEngine kept behind this flag).",
+            default="paged", owner="inference/factory.py")
 
 # -- cross-chip communication --
 declare_env("PT_COMM_QUANT", "Wire format for the quantized gradient/"
@@ -497,7 +507,8 @@ declare_env("PT_BENCH_ONLY", "Comma-set of sub-benches to re-capture "
             owner="bench.py")
 declare_env("PT_DECODE_SECTIONS", "Comma-set of bench_decode sections "
             "(generate,int8,engine,engine_longctx,engine_paged,"
-            "engine_paged_prefix,engine_int8,spec).", owner="bench.py")
+            "engine_paged_prefix,engine_int8,spec,spec_paged).",
+            owner="bench.py")
 declare_env("PT_PROBE_TIMEOUT_S", "Opportunistic-capture prober: "
             "per-probe subprocess kill timeout.", default="150",
             owner="tools/probe_bench.py")
@@ -539,8 +550,9 @@ declare_env("PD_SIZE", "profile_decode model size: 1p3b (default), "
             "350m, or tiny (the CPU smoke).", default="1p3b",
             owner="tools/profile_decode.py")
 declare_env("PD_SECTIONS", "Comma-set of profile_decode report "
-            "sections: engine, paged, prof.", default="engine,paged",
-            owner="tools/profile_decode.py")
+            "sections: engine, paged, prof, mega (launches/step "
+            "accounting for the single-dispatch megakernel).",
+            default="engine,paged", owner="tools/profile_decode.py")
 declare_env("PD_INFLIGHT", "Comma-list of pipeline depths to sweep "
             "(e.g. 1,2,4); unset uses the engine default.",
             owner="tools/profile_decode.py")

@@ -26,12 +26,14 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Config", "Predictor", "create_predictor", "DynamicBatcher",
-           "DecodeEngine", "PagedDecodeEngine",
-           "decode_roofline_tokens_per_sec"]
+           "DecodeEngine", "PagedDecodeEngine", "make_engine",
+           "default_engine_kind", "decode_roofline_tokens_per_sec"]
 
 from paddle_tpu.inference.decode_engine import (  # noqa: E402
     DecodeEngine, decode_roofline_tokens_per_sec)
 from paddle_tpu.inference.paged_engine import PagedDecodeEngine
+from paddle_tpu.inference.factory import (  # noqa: E402
+    default_engine_kind, make_engine)
 
 
 class Config:

@@ -147,6 +147,64 @@ def _note_retrace(fn_name: str):
     stats.add(f"compile/retrace/{fn_name}")
 
 
+def prompt_lookup_draft(toks, lengths, last, K):
+    """On-device prompt-lookup drafts, shared by both engines'
+    speculative paths: continuation of the most recent earlier
+    occurrence of the trailing bigram in the slot's own history — no
+    draft model, no host sync. ``toks[s, i]`` is token i for
+    i <= lengths[s] (history length lengths+1, pending token at index
+    lengths). Returns cand (S, K) with cand[:, 0] = last. Slots
+    without a match draft zeros (they still verify+accept the one
+    correction token, exactly like the host-draft version)."""
+    S, T = toks.shape
+    idx = jnp.arange(T)[None, :]
+    a = jnp.take_along_axis(
+        toks, jnp.maximum(lengths - 1, 0)[:, None], axis=1)[:, 0]
+    nxt_t = jnp.concatenate(
+        [toks[:, 1:], jnp.zeros((S, 1), jnp.int32)], axis=1)
+    ok = ((toks == a[:, None]) & (nxt_t == last[:, None])
+          & (idx <= (lengths - 2)[:, None]))
+    has = jnp.any(ok, axis=1)
+    i_best = jnp.argmax(jnp.where(ok, idx, -1), axis=1)
+    offs = (i_best + 2)[:, None] + jnp.arange(K - 1)[None, :]
+    vals = jnp.take_along_axis(toks, jnp.clip(offs, 0, T - 1), axis=1)
+    valid = offs <= lengths[:, None]   # within history [0, lengths]
+    tail = jnp.where(has[:, None] & valid, vals, 0)
+    return jnp.concatenate([last[:, None], tail], axis=1)
+
+
+def spec_accept(pred, n_acc, bad, active, remaining, eos, last):
+    """Shared greedy-speculative acceptance: turn one verify's
+    predictions (S, K), accepted-prefix counts and non-finite flags
+    into the per-slot emitted-token count ``n_eff`` (0..K, after eos
+    and budget truncation), the advanced ``last`` token, the
+    active-masked ``bad`` flag and the per-slot emitted-eos flag. The
+    caller charges ``remaining``/``lengths`` by n_eff and recomputes
+    ``active`` — identical math on the contiguous and paged
+    engines (the lossless-acceptance contract lives here once)."""
+    K = pred.shape[1]
+    # inactive slots keep computing from stale state inside the chunk;
+    # a non-finite there must not retroactively fail a request that
+    # already completed (same mask as the plain-path _one_token)
+    bad = bad & active
+    n_raw = jnp.where(bad, 0, n_acc + 1)
+    # eos truncation: keep tokens up to and including the first eos
+    # among the accepted run
+    j = jnp.arange(K)[None, :]
+    is_eos = ((pred == eos[:, None]) & (eos >= 0)[:, None]
+              & (j < n_raw[:, None]))
+    any_eos = jnp.any(is_eos, axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1)
+    n_eff = jnp.where(any_eos, first_eos + 1, n_raw)
+    n_eff = jnp.minimum(n_eff, remaining)
+    n_eff = jnp.where(active, n_eff, 0)
+    new_last = jnp.take_along_axis(
+        pred, jnp.maximum(n_eff - 1, 0)[:, None], axis=1)[:, 0]
+    last = jnp.where(n_eff > 0, new_last, last)
+    emitted_eos = any_eos & (first_eos < n_eff)
+    return n_eff, last, bad, emitted_eos
+
+
 class Request:
     """One in-flight generation request.
 
@@ -260,6 +318,10 @@ class ResilientScheduler:
     on_token = None
     on_retire = None
     bucket_policy = None
+    # speculative depth (0 = off): engines that support speculative
+    # decode set this in their ctor; the shared replay unpacks 'spec'
+    # records (chunk, S, K+2) by it
+    spec_k = 0
     # role-tagged first-token metric: a prefill-only engine's "first
     # token" is the END of prefill, not a client-visible TTFT — it
     # records serve/prefill_s instead (the paged ctor overrides), so
@@ -425,12 +487,13 @@ class ResilientScheduler:
 
     def _replay(self, rec, arr) -> int:
         """Apply one harvested dispatch's packed results to its live
-        snapshot ('prefill' and 'decode' records; the speculative kind
-        is DecodeEngine-only and overrides). Requests retired or
-        evicted since the dispatch are skipped — the device had already
-        deactivated their slots, so their flags in ``arr`` are all
-        False. Engines customize via ``_apply_token`` (what one emitted
-        token does) and ``_after_replay`` (post-loop retirement)."""
+        snapshot ('prefill', 'decode' and 'spec' records — both engines
+        dispatch the same record kinds, so the replay lives here once).
+        Requests retired or evicted since the dispatch are skipped —
+        the device had already deactivated their slots, so their flags
+        in ``arr`` are all False. Engines customize via ``_apply_token``
+        (what one emitted token does) and ``_after_replay`` (post-loop
+        retirement)."""
         if rec.kind == "prefill":
             slot, req = rec.live[0]
             if not req.done and self._slot_req[slot] is req:
@@ -438,6 +501,8 @@ class ResilientScheduler:
                 self._emit(slot, req, int(arr))
             self._resync_budgets(rec.live)
             return 0
+        if rec.kind == "spec":
+            return self._replay_spec(rec, arr)
         toks = arr[0]
         flags = arr[1].astype(bool)
         bads = arr[2].astype(bool)
@@ -450,6 +515,28 @@ class ResilientScheduler:
                     self._apply_token(slot, req, int(toks[j, slot]))
                     total += 1
             if bads[:, slot].any() and not req.done:
+                self._fail(req, "non-finite logits", slot=slot,
+                           stat="serve/nonfinite_evictions")
+        self._after_replay(rec)
+        self._resync_budgets(rec.live)
+        return total
+
+    def _replay_spec(self, rec, arr) -> int:
+        """Speculative records unpack (chunk, S, K+2): K predictions,
+        the accepted count n_eff, the non-finite flag — the first
+        n_eff predictions of each chunk step are the emitted tokens."""
+        K = self.spec_k
+        preds, effs = arr[..., :K], arr[..., K]
+        bads = arr[..., K + 1].astype(bool)
+        total = 0
+        for slot, req in rec.live:
+            if req.done or self._slot_req[slot] is not req:
+                continue
+            for j in range(self.chunk):
+                for t in range(int(effs[j, slot])):
+                    self._apply_token(slot, req, int(preds[j, slot, t]))
+                    total += 1
+            if bads[:, slot].any():
                 self._fail(req, "non-finite logits", slot=slot,
                            stat="serve/nonfinite_evictions")
         self._after_replay(rec)
@@ -919,28 +1006,10 @@ class DecodeEngine(ResilientScheduler):
         return kc, vc, pred, n_acc, bad
 
     def _draft_device(self, toks, lengths, last):
-        """On-device prompt-lookup drafts: continuation of the most
-        recent earlier occurrence of the trailing bigram in the slot's
-        own history — no draft model, no host sync. toks[s, i] is token
-        i for i <= lengths[s] (history length lengths+1, pending token
-        at index lengths). Returns cand (S, K) with cand[:, 0] = last.
-        Slots without a match draft zeros (they still verify+accept the
-        one correction token, exactly like the host-draft version)."""
-        S, K, T = self.S, self.spec_k, self.T
-        idx = jnp.arange(T)[None, :]
-        a = jnp.take_along_axis(
-            toks, jnp.maximum(lengths - 1, 0)[:, None], axis=1)[:, 0]
-        nxt_t = jnp.concatenate(
-            [toks[:, 1:], jnp.zeros((S, 1), jnp.int32)], axis=1)
-        ok = ((toks == a[:, None]) & (nxt_t == last[:, None])
-              & (idx <= (lengths - 2)[:, None]))
-        has = jnp.any(ok, axis=1)
-        i_best = jnp.argmax(jnp.where(ok, idx, -1), axis=1)
-        offs = (i_best + 2)[:, None] + jnp.arange(K - 1)[None, :]
-        vals = jnp.take_along_axis(toks, jnp.clip(offs, 0, T - 1), axis=1)
-        valid = offs <= lengths[:, None]   # within history [0, lengths]
-        tail = jnp.where(has[:, None] & valid, vals, 0)
-        return jnp.concatenate([last[:, None], tail], axis=1)
+        """On-device prompt-lookup drafts — the shared module-level
+        `prompt_lookup_draft` at this engine's K (the paged engine's
+        speculative path drafts through the same helper)."""
+        return prompt_lookup_draft(toks, lengths, last, self.spec_k)
 
     def _spec_multi_impl(self, head, stacked, kc, vc, toks, lengths,
                          last, active, remaining, eos, poison):
@@ -962,24 +1031,8 @@ class DecodeEngine(ResilientScheduler):
             cand = self._draft_device(toks, lengths, last)
             kc, vc, pred, n_acc, bad = self._verify_impl(
                 head, stacked, kc, vc, lengths, cand, active, poison)
-            # inactive slots keep computing from stale state inside the
-            # chunk; a non-finite there must not retroactively fail a
-            # request that already completed (same mask as _one_token)
-            bad = bad & active
-            n_raw = jnp.where(bad, 0, n_acc + 1)
-            # eos truncation: keep tokens up to and including the first
-            # eos among the accepted run
-            j = jnp.arange(K)[None, :]
-            is_eos = ((pred == eos[:, None]) & (eos >= 0)[:, None]
-                      & (j < n_raw[:, None]))
-            any_eos = jnp.any(is_eos, axis=1)
-            first_eos = jnp.argmax(is_eos, axis=1)
-            n_eff = jnp.where(any_eos, first_eos + 1, n_raw)
-            n_eff = jnp.minimum(n_eff, remaining)
-            n_eff = jnp.where(active, n_eff, 0)
-            new_last = jnp.take_along_axis(
-                pred, jnp.maximum(n_eff - 1, 0)[:, None], axis=1)[:, 0]
-            last = jnp.where(n_eff > 0, new_last, last)
+            n_eff, last, bad, emitted_eos = spec_accept(
+                pred, n_acc, bad, active, remaining, eos, last)
             # history append: pred[j] is the token at absolute position
             # lengths+1+j. All K values are written (garbage beyond
             # n_eff is overwritten by the next step's window or masked
@@ -997,7 +1050,6 @@ class DecodeEngine(ResilientScheduler):
                     toks, jnp.where(active[s], pred[s:s + 1], old), win)
             remaining = remaining - n_eff
             lengths = lengths + n_eff
-            emitted_eos = any_eos & (first_eos < n_eff)
             active = active & ~bad & ~emitted_eos & (remaining > 0)
             return (kc, vc, toks, lengths, last, active, remaining), \
                 (pred, n_eff, bad)
@@ -1476,29 +1528,6 @@ class DecodeEngine(ResilientScheduler):
                 self._disp_rem[slot] = 0
                 self._obs_request_end(req)
 
-    def _replay(self, rec, arr) -> int:
-        """Speculative records unpack (chunk, S, K+2); everything else
-        (prefill/decode) is the shared base replay."""
-        if rec.kind != "spec":
-            return super()._replay(rec, arr)
-        K = self.spec_k
-        preds, effs = arr[..., :K], arr[..., K]
-        bads = arr[..., K + 1].astype(bool)
-        total = 0
-        for slot, req in rec.live:
-            if req.done or self._slot_req[slot] is not req:
-                continue
-            for j in range(self.chunk):
-                for t in range(int(effs[j, slot])):
-                    self._apply_token(slot, req, int(preds[j, slot, t]))
-                    total += 1
-            if bads[:, slot].any():
-                self._fail(req, "non-finite logits", slot=slot,
-                           stat="serve/nonfinite_evictions")
-        self._after_replay(rec)
-        self._resync_budgets(rec.live)
-        return total
-
     def _apply_token(self, slot: int, req: Request, token: int):
         # the FIRST generated token always rides a 'prefill' record
         # (_emit), so TTFT needs no check here — only the stream hook
@@ -1582,6 +1611,22 @@ class DecodeEngine(ResilientScheduler):
             self.vc, self.lengths, self.last, self.active,
             self.remaining, self.eos_ids, self._rng,
             self._poison_mask(), name=name or "decode")
+
+    def dispatch_fn_args(self):
+        """The jitted decode dispatch and the exact argument tuple the
+        serving loop calls it with (the spec-verify program when
+        ``speculative_k`` is set) — for launch accounting
+        (``devprof.count_pallas_launches`` /
+        ``count_hlo_custom_calls``) without executing anything."""
+        if self.spec_k:
+            return (self._verify_fn,
+                    (self._head, self._stacked, self.kc, self.vc,
+                     self.toks, self.lengths, self.last, self.active,
+                     self.remaining, self.eos_ids, self._poison_mask()))
+        return (self._multi_fn,
+                (self._head, self._stacked, self.kc, self.vc,
+                 self.lengths, self.last, self.active, self.remaining,
+                 self.eos_ids, self._rng, self._poison_mask()))
 
 
 def decode_roofline_tokens_per_sec(cfg, batch: int, context: int,

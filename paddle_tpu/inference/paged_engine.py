@@ -81,9 +81,14 @@ from paddle_tpu.models import gpt as gpt_lib
 from paddle_tpu.inference.decode_engine import (Request,
                                                 ResilientScheduler,
                                                 _Inflight,
-                                                _note_retrace)
+                                                _note_retrace,
+                                                prompt_lookup_draft,
+                                                spec_accept)
 from paddle_tpu.inference.prefix_cache import PrefixCache
 from paddle_tpu.ops.pallas.decode_attention import fold_fresh_row
+from paddle_tpu.ops.pallas.decode_megakernel import (_WEIGHT_ORDER,
+                                                     mega_decode_layers,
+                                                     mega_logits_sample)
 from paddle_tpu.ops.pallas.paged_attention import (paged_append_attend,
                                                    paged_decode_attention)
 
@@ -127,7 +132,9 @@ class PagedDecodeEngine(ResilientScheduler):
                  share_weights_with=None, inflight=None,
                  warmup: bool = False, fused: Optional[bool] = None,
                  prefix: Optional[bool] = None,
-                 prefill_only: bool = False):
+                 prefill_only: bool = False,
+                 mega: Optional[bool] = None,
+                 speculative_k: int = 0):
         from paddle_tpu import compile_cache
         from paddle_tpu.inference.decode_engine import (
             resolve_engine_weights)
@@ -168,6 +175,22 @@ class PagedDecodeEngine(ResilientScheduler):
         # parity reference the fused path is tested against)
         self.fused = (os.environ.get("PT_PAGED_FUSED", "1") != "0"
                       if fused is None else bool(fused))
+        # single-dispatch decode (docs/serving.md "Single-dispatch
+        # decode"): the layer-folded megakernel + fused sampling
+        # epilogue collapse each decode step to TWO kernel launches
+        # (vs one paged launch per layer). Requires the fused path —
+        # the per-layer fused kernel stays as the bit-parity reference
+        # (PT_PAGED_MEGA=0 or mega=False falls back to it).
+        self.mega = ((os.environ.get("PT_PAGED_MEGA", "1") != "0"
+                      if mega is None else bool(mega)) and self.fused)
+        # speculative decode rides the paged step (r05 retired the
+        # contiguous-only row): drafts come from the shared on-device
+        # prompt-lookup helper, and with mega on, verify/accept run as
+        # the SAME single-dispatch program at K rows per slot
+        self.spec_k = int(speculative_k)
+        if self.spec_k and self.spec_k < 2:
+            raise ValueError("speculative_k must be >= 2 (one input "
+                             "token + at least one candidate)")
         prefix_on = (os.environ.get("PT_PAGED_PREFIX", "1") != "0"
                      if prefix is None else bool(prefix))
         self._prefix = (PrefixCache(self._alloc, self.page)
@@ -201,6 +224,11 @@ class PagedDecodeEngine(ResilientScheduler):
         # admission) — pipelined dispatches need no host marshalling
         self.remaining = jnp.zeros((self.S,), jnp.int32)
         self.eos_ids = jnp.full((self.S,), -1, jnp.int32)
+        # device-side token history (prompt + generated) feeding the
+        # on-device prompt-lookup drafts — speculative only (the plain
+        # paged step never reads it)
+        self.toks = (jnp.zeros((self.S, cfg.max_seq_len), jnp.int32)
+                     if self.spec_k else None)
         self._slot_req: List[Optional[Request]] = [None] * self.S
         self._waiting: collections.deque = collections.deque()
         self.steps = 0
@@ -210,6 +238,10 @@ class PagedDecodeEngine(ResilientScheduler):
         self._prefill_sfx_fn = jax.jit(self._prefill_suffix_impl,
                                        donate_argnums=(2, 3))
         self._multi_fn = jax.jit(self._multi_impl, donate_argnums=(2, 3))
+        # table (arg 4) is NEVER donated: the cached device copy
+        # (_table_dev) is reused across dispatches
+        self._verify_fn = jax.jit(self._spec_multi_impl,
+                                  donate_argnums=(2, 3, 5))
         self._init_pipeline(inflight)
         # host shadows for page reservation: _host_len is the harvested
         # (exact) device length; _proj_len an upper bound including
@@ -336,9 +368,22 @@ class PagedDecodeEngine(ResilientScheduler):
             % self.P)
         lengths = jnp.full((self.S,), max(1, cfg.max_seq_len // 2),
                            jnp.int32)
-        return tune_paged_attention(q, self.kp, self.vp, table, lengths,
-                                    fused=self.fused, iters=iters,
-                                    candidates=candidates)
+        res = tune_paged_attention(q, self.kp, self.vp, table, lengths,
+                                   fused=self.fused, iters=iters,
+                                   candidates=candidates)
+        if self.mega:
+            # the megakernel's sampling epilogue has its own knob (the
+            # vocab-tile width) keyed on the FOLDED geometry
+            from paddle_tpu.ops.pallas.decode_megakernel import (
+                tune_mega_epilogue)
+            head = self._head
+            x = jnp.zeros((self.S, cfg.d_model), cfg.dtype)
+            w = (head["wte"].T if head["lm_head"] is None
+                 else head["lm_head"])
+            tune_mega_epilogue(x, head["lnf_scale"], head["lnf_bias"],
+                               w, layers=cfg.n_layers, page=self.page,
+                               iters=iters)
+        return res
 
     def _table_array(self) -> jnp.ndarray:
         """(S, max_pages) padded page table at a FIXED width
@@ -470,6 +515,51 @@ class PagedDecodeEngine(ResilientScheduler):
         lengths = lengths + (active & ~bad).astype(jnp.int32)
         return kp, vp, lengths, nxt, bad
 
+    def _mega_rows(self, head, stacked, kp, vp, table, pos, row_slot,
+                   row_write, tokens, poison_rows):
+        """One megakernel pass over a flat row batch: embed ``tokens``
+        at ``pos``, run every layer + the fused final-norm → logits →
+        greedy-sampling epilogue as TWO kernel launches total, and
+        return the sampled token + non-finite flag per row. The plain
+        step is one row per slot; the speculative verify is K rows per
+        slot through the SAME program (write-then-attend is causal:
+        row t's attention bound pos+1 masks rows t' > t)."""
+        cfg = self.cfg
+        x = jnp.take(head["wte"], tokens, axis=0)
+        if head["wpe"] is not None:
+            x = x + jnp.take(head["wpe"], pos, axis=0)
+        weights = {n: getattr(stacked, n) for n in _WEIGHT_ORDER}
+        x, kp, vp = mega_decode_layers(
+            x, weights, kp, vp, table, pos, row_slot, row_write,
+            page=self.page, n_pages=self.P, n_heads=cfg.n_heads,
+            kv_heads=cfg.kv_heads, head_dim=cfg.head_dim,
+            rope=cfg.rope, rope_theta=cfg.rope_theta,
+            scale=1.0 / math.sqrt(cfg.head_dim))
+        w = (head["wte"].T if head["lm_head"] is None
+             else head["lm_head"])
+        tok, nf = mega_logits_sample(
+            x, head["lnf_scale"], head["lnf_bias"], w, poison_rows,
+            layers=cfg.n_layers, page=self.page)
+        return kp, vp, tok, nf
+
+    def _one_token_mega(self, head, stacked, kp, vp, table, lengths,
+                        last, active, poison):
+        """Single-dispatch variant of `_one_token` (``PT_PAGED_MEGA``):
+        same signature, same greedy stream, ≤2 kernel launches. The
+        per-layer fused path above stays as the bit-parity reference
+        (token streams identical; pool rows agree to last-ulp — the
+        megakernel folds the fresh KV row in page order while the
+        per-layer kernel folds it after all pages, so layer>=1 rows
+        may differ in the final bit of the accumulation)."""
+        kp, vp, tok, nf = self._mega_rows(
+            head, stacked, kp, vp, table, lengths,
+            jnp.arange(self.S, dtype=jnp.int32),
+            active.astype(jnp.int32), last, poison)
+        bad = active & (nf > 0)
+        nxt = jnp.where(active & ~bad, tok, last)
+        lengths = lengths + (active & ~bad).astype(jnp.int32)
+        return kp, vp, lengths, nxt, bad
+
     def _multi_impl(self, head, stacked, kp, vp, table, lengths, last,
                     active, remaining, eos, poison):
         """``chunk`` decode steps in one dispatch, per-slot eos/budget/
@@ -479,10 +569,11 @@ class PagedDecodeEngine(ResilientScheduler):
         one (3, chunk, S) int32 array — the lagged harvest pays exactly
         one device→host transfer."""
         _note_retrace("paged_multi")
+        one_tok = self._one_token_mega if self.mega else self._one_token
 
         def one(carry, _):
             kp, vp, lengths, last, active, remaining = carry
-            kp, vp, lengths, nxt, bad = self._one_token(
+            kp, vp, lengths, nxt, bad = one_tok(
                 head, stacked, kp, vp, table, lengths, last, active,
                 poison)
             emit = active & ~bad
@@ -498,6 +589,114 @@ class PagedDecodeEngine(ResilientScheduler):
         packed = jnp.stack([toks, flags.astype(jnp.int32),
                             bads.astype(jnp.int32)])
         return kp, vp, lengths, last, active, remaining, packed
+
+    def _verify_paged(self, head, stacked, kp, vp, table, lengths,
+                      cand, active, poison):
+        """One speculative verify over the page pool: K candidate
+        tokens per slot in one pass. With mega on, the K rows per slot
+        ride the SAME single-dispatch megakernel program as the plain
+        step (flat (S*K) row batch, per-row position/slot); otherwise
+        a per-layer XLA reference (batched pool scatter + the paged
+        read kernel at one query row per candidate) — the parity
+        target the mega verify is tested against. Returns the model's
+        predictions (S, K), the accepted-prefix length n_acc (0..K-1)
+        and the per-slot non-finite flag, exactly like
+        `DecodeEngine._verify_impl`."""
+        S, K = cand.shape
+        cfg = self.cfg
+        pos = lengths[:, None] + jnp.arange(K)              # (S, K)
+        if self.mega:
+            kp, vp, tok, nf = self._mega_rows(
+                head, stacked, kp, vp, table, pos.reshape(-1),
+                jnp.repeat(jnp.arange(S, dtype=jnp.int32), K),
+                jnp.repeat(active.astype(jnp.int32), K),
+                cand.reshape(-1), jnp.repeat(poison, K))
+            pred = tok.reshape(S, K)
+            bad = jnp.any((nf > 0).reshape(S, K), axis=1)
+        else:
+            x = jnp.take(head["wte"], cand, axis=0)
+            if head["wpe"] is not None:
+                x = x + jnp.take(head["wpe"], pos, axis=0)
+            scale = 1.0 / math.sqrt(cfg.head_dim)
+            mx = table.shape[1]
+            pidx = jnp.minimum(pos // self.page, mx - 1)
+            pages = jnp.take_along_axis(table, pidx, axis=1)
+            offs = (pos % self.page).reshape(-1)
+            lens_t = (pos + 1).reshape(-1)
+
+            def layer(carry, blk_i):
+                x, kp, vp = carry
+                blk, i = blk_i
+                q, k, v = blk._qkv(x, lengths)
+                rows = jnp.where(active[:, None], i * self.P + pages,
+                                 self._scratch).reshape(-1)
+                kp = kp.at[rows, :, offs, :].set(
+                    k.reshape(S * K, cfg.kv_heads,
+                              cfg.head_dim).astype(kp.dtype))
+                vp = vp.at[rows, :, offs, :].set(
+                    v.reshape(S * K, cfg.kv_heads,
+                              cfg.head_dim).astype(vp.dtype))
+                o = paged_decode_attention(
+                    q.reshape(S * K, cfg.n_heads,
+                              cfg.head_dim).astype(kp.dtype),
+                    kp, vp, jnp.repeat(i * self.P + table, K, axis=0),
+                    lens_t, scale=scale)
+                attn = o.astype(x.dtype).reshape(x.shape)
+                return (blk._block_tail(x, attn), kp, vp), None
+
+            (x, kp, vp), _ = lax.scan(
+                layer, (x, kp, vp), (stacked, jnp.arange(cfg.n_layers)))
+            logits = self._lm_head(head, x).astype(jnp.float32)
+            logits = jnp.where(poison[:, None, None], jnp.nan, logits)
+            bad = ~jnp.all(jnp.isfinite(logits), axis=(1, 2))
+            pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        match = jnp.cumprod(
+            (cand[:, 1:] == pred[:, :-1]).astype(jnp.int32), axis=1)
+        n_acc = jnp.sum(match, axis=1)                      # 0..K-1
+        return kp, vp, pred, n_acc, bad
+
+    def _spec_multi_impl(self, head, stacked, kp, vp, table, toks,
+                         lengths, last, active, remaining, eos, poison):
+        """``chunk`` speculative steps in ONE dispatch over the page
+        pool — draft on device (shared prompt-lookup helper), verify K
+        candidates per slot, accept via the shared greedy-acceptance
+        helper, early-stop per slot on eos/budget. Pages for the whole
+        chunk (chunk * K rows) are reserved before the dispatch.
+        Packed output (chunk, S, K+2) matches `DecodeEngine`'s spec
+        records — the shared scheduler replay applies both."""
+        _note_retrace("paged_spec")
+        K = self.spec_k
+
+        def one(carry, _):
+            kp, vp, toks, lengths, last, active, remaining = carry
+            cand = prompt_lookup_draft(toks, lengths, last, K)
+            kp, vp, pred, n_acc, bad = self._verify_paged(
+                head, stacked, kp, vp, table, lengths, cand, active,
+                poison)
+            n_eff, last, bad, emitted_eos = spec_accept(
+                pred, n_acc, bad, active, remaining, eos, last)
+            # history append (same DUS-window idiom as DecodeEngine's
+            # spec chunk: garbage beyond n_eff is overwritten or masked
+            # by lengths on read; inactive slots rewrite their window)
+            for s in range(self.S):
+                win = (s, lengths[s] + 1)
+                old = lax.dynamic_slice(toks, win, (1, K))
+                toks = lax.dynamic_update_slice(
+                    toks, jnp.where(active[s], pred[s:s + 1], old), win)
+            remaining = remaining - n_eff
+            lengths = lengths + n_eff
+            active = active & ~bad & ~emitted_eos & (remaining > 0)
+            return (kp, vp, toks, lengths, last, active, remaining), \
+                (pred, n_eff, bad)
+
+        (kp, vp, toks, lengths, last, active, remaining), \
+            (preds, effs, bads) \
+            = lax.scan(one, (kp, vp, toks, lengths, last, active,
+                             remaining), None, length=self.chunk)
+        packed = jnp.concatenate(
+            [preds, effs[..., None], bads[..., None].astype(jnp.int32)],
+            axis=-1)
+        return kp, vp, toks, lengths, last, active, remaining, packed
 
     def _prefill_impl(self, head, stacked, kp, vp, tokens, true_len,
                       write_segments):
@@ -712,6 +911,14 @@ class PagedDecodeEngine(ResilientScheduler):
                 f"longer prompts")
         if prompt_len + max_new_tokens > self.cfg.max_seq_len:
             raise ValueError("prompt + new tokens exceed max_seq_len")
+        if self.spec_k and (prompt_len + max_new_tokens
+                            + self.spec_k - 1 > self.cfg.max_seq_len):
+            # the last accepted token's verify window wrote K-1 rows
+            # past it — those positions must exist in the page table
+            raise ValueError(
+                f"prompt + new tokens + speculative window "
+                f"({prompt_len}+{max_new_tokens}+{self.spec_k - 1}) "
+                f"exceed max_seq_len {self.cfg.max_seq_len}")
 
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_id: Optional[int] = None,
@@ -1076,6 +1283,12 @@ class PagedDecodeEngine(ResilientScheduler):
         # never activates — the device analog of _emit retiring it
         alive = jnp.logical_and(
             rem0 > 0, jnp.logical_or(eos0 < 0, nxt != eos0))
+        if self.spec_k:
+            # seed the prompt-lookup history: prompt rows [0, n), the
+            # pending sampled token at index n (both uploads — nxt
+            # stays on device)
+            self.toks = self.toks.at[slot, :n].set(jnp.asarray(prompt))
+            self.toks = self.toks.at[slot, n].set(nxt)
         self.lengths = self.lengths.at[slot].set(n)
         self.last = self.last.at[slot].set(nxt)
         if self.prefill_only:
@@ -1320,6 +1533,14 @@ class PagedDecodeEngine(ResilientScheduler):
         rem0 = req.max_new_tokens - len(req.kv_tokens)
         eos0 = -1 if req.eos_id is None else int(req.eos_id)
         alive = rem0 > 0 and (eos0 < 0 or nxt != eos0)
+        if self.spec_k:
+            # reconstruct the drafting history the sender would hold:
+            # prompt + generated[:-1] in rows [0, n), pending token at n
+            hist = np.zeros((self.cfg.max_seq_len,), np.int32)
+            hist[:len(req.prompt)] = req.prompt
+            hist[len(req.prompt):n] = req.kv_tokens[:-1]
+            hist[n] = nxt
+            self.toks = self.toks.at[slot].set(jnp.asarray(hist))
         self.lengths = self.lengths.at[slot].set(n)
         self.last = self.last.at[slot].set(jnp.int32(nxt))
         self.active = self.active.at[slot].set(bool(alive))
@@ -1393,14 +1614,29 @@ class PagedDecodeEngine(ResilientScheduler):
                         f"{len(req.prompt)} tokens")
                 return
 
+    @property
+    def _disp_span(self) -> int:
+        """Worst-case per-slot length growth of one decode dispatch:
+        ``chunk`` tokens plain, ``chunk * K`` rows speculative (every
+        chunk step WRITES K rows at lengths..lengths+K-1 even when
+        fewer are accepted)."""
+        return self.chunk * max(1, self.spec_k)
+
     def _reserve_chunk(self, live):
         """Reserve pages for one chunk per live slot against the
         PROJECTED length (host shadow + in-flight growth), capped at
         the request's true maximum (prompt + budget) so projection
-        slack never demands pages the request cannot use."""
+        slack never demands pages the request cannot use. Speculative
+        dispatches write K rows per step, and the final accepted
+        token's verify window pokes up to K-1 rows past the cap — the
+        cap stretches by K-1 (check_request guarantees those positions
+        exist in the fixed-width table)."""
         for slot, req in live:
             cap = len(req.prompt) + req.max_new_tokens
-            need = min(int(self._proj_len[slot]) + self.chunk + 1, cap)
+            if self.spec_k:
+                cap += self.spec_k - 1
+            need = min(int(self._proj_len[slot]) + self._disp_span + 1,
+                       cap)
             self._reserve(slot, need)
 
     def _dispatch_decode(self) -> bool:
@@ -1427,16 +1663,30 @@ class PagedDecodeEngine(ResilientScheduler):
             self._reserve_chunk(live)
         self.steps += 1
         self._obs_host_gap()
-        with trace.span("serve/dispatch", kind="paged", chunk=self.chunk,
-                        inflight=len(self._pending)):
-            (self.kp, self.vp, self.lengths, self.last, self.active,
-             self.remaining, packed) = self._multi_fn(
-                self._head, self._stacked, self.kp, self.vp,
-                self._table(), self.lengths, self.last, self.active,
-                self.remaining, self.eos_ids, self._poison_mask())
+        if self.spec_k:
+            with trace.span("serve/dispatch", kind="paged_spec",
+                            k=self.spec_k, chunk=self.chunk,
+                            inflight=len(self._pending)):
+                (self.kp, self.vp, self.toks, self.lengths, self.last,
+                 self.active, self.remaining, packed) = self._verify_fn(
+                    self._head, self._stacked, self.kp, self.vp,
+                    self._table(), self.toks, self.lengths, self.last,
+                    self.active, self.remaining, self.eos_ids,
+                    self._poison_mask())
+            kind = "spec"
+        else:
+            with trace.span("serve/dispatch", kind="paged",
+                            chunk=self.chunk,
+                            inflight=len(self._pending)):
+                (self.kp, self.vp, self.lengths, self.last, self.active,
+                 self.remaining, packed) = self._multi_fn(
+                    self._head, self._stacked, self.kp, self.vp,
+                    self._table(), self.lengths, self.last, self.active,
+                    self.remaining, self.eos_ids, self._poison_mask())
+            kind = "decode"
         for s, _ in live:
-            self._proj_len[s] += self.chunk
-        self._finish_dispatch("decode", live, packed)
+            self._proj_len[s] += self._disp_span
+        self._finish_dispatch(kind, live, packed)
         return True
 
     def _resync_budgets(self, live, cover=None):
@@ -1447,7 +1697,8 @@ class PagedDecodeEngine(ResilientScheduler):
             if req.done or self._slot_req[slot] is not req:
                 continue
             self._proj_len[slot] = (self._host_len[slot]
-                                    + self.chunk * cover.get(slot, 0))
+                                    + self._disp_span
+                                    * cover.get(slot, 0))
 
     def _apply_token(self, slot, req, token):
         """Harvested token (shared base replay): emit — which retires
@@ -1482,10 +1733,17 @@ class PagedDecodeEngine(ResilientScheduler):
                     jnp.zeros((1, b), jnp.int32), jnp.int32(0),
                     jnp.int32(1), jnp.asarray(sfx_segs), jnp.int32(-1),
                     jnp.int32(-1), jnp.zeros((mx,), jnp.int32))
-        out = self._multi_fn(
-            self._head, self._stacked, kp, vp, self._table(),
-            self.lengths, self.last, self.active, self.remaining,
-            self.eos_ids, jnp.zeros((self.S,), bool))
+        if self.spec_k:
+            out = self._verify_fn(
+                self._head, self._stacked, kp, vp, self._table(),
+                jnp.zeros_like(self.toks), self.lengths, self.last,
+                self.active, self.remaining, self.eos_ids,
+                jnp.zeros((self.S,), bool))
+        else:
+            out = self._multi_fn(
+                self._head, self._stacked, kp, vp, self._table(),
+                self.lengths, self.last, self.active, self.remaining,
+                self.eos_ids, jnp.zeros((self.S,), bool))
         jax.block_until_ready(out)
         stats.observe("serve/warmup_s", time.perf_counter() - t0)
 
@@ -1496,12 +1754,34 @@ class PagedDecodeEngine(ResilientScheduler):
 
     def dispatch_cost(self, name=None):
         """ISSUE 15 roofline capture for the paged path: AOT
-        cost/memory analysis of one paged decode dispatch (fused
-        append+attend when PT_PAGED_FUSED) at the current pool/table
-        geometry. See DecodeEngine.dispatch_cost."""
+        cost/memory analysis of one paged decode dispatch (megakernel
+        when PT_PAGED_MEGA, fused append+attend when PT_PAGED_FUSED,
+        the speculative verify program when ``speculative_k``) at the
+        current pool/table geometry. See DecodeEngine.dispatch_cost."""
         from paddle_tpu.observability import devprof
+        if self.spec_k:
+            return devprof.capture_jit(
+                self._verify_fn, self._head, self._stacked, self.kp,
+                self.vp, self._table(), self.toks, self.lengths,
+                self.last, self.active, self.remaining, self.eos_ids,
+                self._poison_mask(), name=name or "paged_spec")
         return devprof.capture_jit(
             self._multi_fn, self._head, self._stacked, self.kp,
             self.vp, self._table(), self.lengths, self.last,
             self.active, self.remaining, self.eos_ids,
             self._poison_mask(), name=name or "paged")
+
+    def dispatch_fn_args(self):
+        """The decode dispatch's (jitted fn, args) at the current
+        geometry — what `tools/profile_decode.py`'s launches/step
+        section lowers to count kernel launches without executing."""
+        if self.spec_k:
+            return (self._verify_fn,
+                    (self._head, self._stacked, self.kp, self.vp,
+                     self._table(), self.toks, self.lengths, self.last,
+                     self.active, self.remaining, self.eos_ids,
+                     self._poison_mask()))
+        return (self._multi_fn,
+                (self._head, self._stacked, self.kp, self.vp,
+                 self._table(), self.lengths, self.last, self.active,
+                 self.remaining, self.eos_ids, self._poison_mask()))

@@ -48,7 +48,8 @@ from paddle_tpu.observability import comm
 __all__ = ["CostCapture", "capture_jit", "peak_specs",
            "roofline_tokens_per_sec", "record_roofline",
            "launch_tax_s", "pallas_launch_tax_s", "launch_tax_fraction",
-           "step_fractions"]
+           "step_fractions", "count_pallas_launches",
+           "count_hlo_custom_calls"]
 
 
 # ---------------------------------------------------------------------------
@@ -132,6 +133,57 @@ def capture_jit(jfn, *args, name: Optional[str] = None,
         except Exception:
             pass
     return cap
+
+
+def count_pallas_launches(fn, *args, **kwargs) -> int:
+    """Kernel launches per CALL of ``fn``, from its jaxpr: every
+    ``pallas_call`` equation counts once (a multi-step grid is still
+    ONE launch), weighted by the trip count of enclosing ``scan``s —
+    so a chunked decode dispatch reports chunk × launches-per-step.
+    Backend-independent (interpret-mode pallas_calls count the same),
+    which is what lets the CPU suite assert the single-dispatch
+    contract the ISSUE 19 megakernel exists for. ``while`` bodies
+    count once (trip count unknown — a lower bound); ``cond`` branches
+    count at the worst case."""
+    import jax
+
+    def walk(jaxpr, mult):
+        n = 0
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim == "pallas_call":
+                n += mult
+            elif prim == "scan":
+                n += walk(eqn.params["jaxpr"].jaxpr,
+                          mult * int(eqn.params["length"]))
+            elif prim == "while":
+                n += walk(eqn.params["cond_jaxpr"].jaxpr, mult)
+                n += walk(eqn.params["body_jaxpr"].jaxpr, mult)
+            elif prim == "cond":
+                n += max((walk(b.jaxpr, mult)
+                          for b in eqn.params["branches"]), default=0)
+            else:
+                for key in ("jaxpr", "call_jaxpr"):
+                    sub = eqn.params.get(key)
+                    if sub is not None:
+                        n += walk(getattr(sub, "jaxpr", sub), mult)
+        return n
+
+    return walk(jax.make_jaxpr(fn)(*args, **kwargs).jaxpr, 1)
+
+
+def count_hlo_custom_calls(jfn, *args, **kwargs) -> Optional[int]:
+    """Custom-call count from the AOT-COMPILED HLO of ``jfn`` (a
+    ``jax.jit`` callable) — on TPU every pallas kernel lowers to one
+    ``tpu_custom_call``, so this is launches-per-call as the runtime
+    sees them. Interpret-mode pallas (CPU) lowers to plain HLO, so the
+    count reads 0 there — pair with `count_pallas_launches` for a
+    backend-independent number. None when lowering fails."""
+    try:
+        txt = jfn.lower(*args, **kwargs).compile().as_text()
+    except Exception:
+        return None
+    return txt.count("custom-call")
 
 
 def roofline_tokens_per_sec(cap: CostCapture, tokens_per_call: float,

@@ -136,6 +136,37 @@ def test_goodput_dip_is_lower_is_better():
     assert bd.direction("serve_drain_migrate_failed") == -1
 
 
+def test_launch_rows_are_lower_is_better():
+    """The kernel-launch accounting rows (ISSUE 19): launches per
+    token/step guard the single-dispatch megakernel — MORE launches is
+    a regression (a fall back to one-launch-per-layer), fewer is the
+    win. The row name must not be swallowed by the higher-is-better
+    token fragments."""
+    assert bd.direction("decode_engine_paged_launches_per_token") == -1
+    assert bd.direction("decode_spec_paged_launches_per_step") == -1
+    v = bd.compare(_doc(decode_engine_paged_launches_per_step=2.0),
+                   _doc(decode_engine_paged_launches_per_step=24.0))
+    assert any(r == "decode_engine_paged_launches_per_step"
+               for r, _ in v["regressions"])
+    v = bd.compare(_doc(decode_engine_paged_launches_per_step=24.0),
+                   _doc(decode_engine_paged_launches_per_step=2.0))
+    assert v["regressions"] == []
+
+
+def test_spec_paged_row_death_guarded_by_name():
+    """The revived paged-spec bench row must die LOUDLY: a vanished
+    decode_spec_paged_* row with its section error marker (the r05
+    RESOURCE_EXHAUSTED signature) is a named regression, never a
+    silent drop."""
+    base = _doc(decode_spec_paged_tokens_per_sec=900.0)
+    new = _doc()
+    new["extra"]["decode_spec_paged_error"] = "RESOURCE_EXHAUSTED: oom"
+    v = bd.compare(base, new)
+    hits = [(r, d) for r, d in v["regressions"]
+            if r == "decode_spec_paged_tokens_per_sec"]
+    assert hits and "RESOURCE_EXHAUSTED" in hits[0][1]
+
+
 def test_failover_rows_direction_tagged():
     """The router-failover bench rows (ISSUE 17): recovery time is a
     cost, republished-result counts are informational (they scale

@@ -41,6 +41,14 @@ def test_bench_decode_smoke():
     # ...and so must the speculative path (its own try/except means a
     # regression would otherwise vanish silently)
     assert out.get("decode_spec_tokens_per_step", 0) > 0, out
+    # paged-spec row revived on the megakernel path (ISSUE 19) — the
+    # r05 row death must fail here first, and the verify program must
+    # hold the single-dispatch bound (2 pallas launches per step)
+    assert out.get("decode_spec_paged_tokens_per_step", 0) > 0, out
+    assert 0 < out.get("decode_spec_paged_launches_per_step", 99) <= 2, \
+        out
+    # kernel-launch ladder row present on the engine path too
+    assert "decode_engine_launches_per_token" in out, out
 
 
 def test_bench_serve_smoke():

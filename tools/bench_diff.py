@@ -56,10 +56,9 @@ NOISE_TABLE = (
 # direction classification: +1 = higher is better, -1 = lower is
 # better, 0 = informational (config echo / identity — never a failure).
 # _INFO wins first: it exists only for rows a generic fragment below
-# would otherwise misclassify (autotune sweep timings carry _ms, the
-# launches-per-token attribution carries tokens_per_...).
+# would otherwise misclassify (autotune sweep timings carry _ms).
 _INFO = ("schema", "vs_baseline", "provenance", "skipped",
-         "loss_delta", "launches_per_token", "autotune", "cache_hit",
+         "loss_delta", "autotune", "cache_hit",
          "scan_layers", "captured_unix", "republished")
 _HIGHER = ("tokens_per_sec", "tok_s", "goodput", "mfu", "hw_util",
            "tokens_per_step", "agreement", "cosine", "hit_rate",
@@ -71,8 +70,11 @@ _LOWER = ("_ms", "ttft", "tpot", "latency", "_tax_frac", "exposed_s",
           "host_gap", "recovery_s", "overhead_frac")
 # checked BEFORE _HIGHER: rows whose name embeds a higher-is-better
 # fragment but measure a cost (the drain bench's goodput_dip_frac
-# contains "goodput" yet a bigger dip is a worse drain)
-_LOWER_FIRST = ("goodput_dip", "fallbacks", "migrate_failed")
+# contains "goodput" yet a bigger dip is a worse drain; the kernel
+# launch accounting — launches_per_token / launches_per_step, the
+# single-dispatch megakernel guard — regresses UP, ISSUE 19)
+_LOWER_FIRST = ("goodput_dip", "fallbacks", "migrate_failed",
+                "launches_per_")
 
 
 def direction(row: str) -> int:

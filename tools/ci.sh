@@ -95,6 +95,15 @@
 #                          clean and bench_diff's synthetic 20% tok/s
 #                          regression must be caught by row name
 #                          (seconds; also part of the default gate)
+#   tools/ci.sh mega       single-dispatch-decode smoke (~1 min):
+#                          tiny-model CPU run of profile_decode's
+#                          PD_SECTIONS=mega launches/step report — the
+#                          paged megakernel (plain AND speculative)
+#                          must step in <= 2 pallas launches while the
+#                          per-layer reference pays one per layer,
+#                          counted from the dispatch program's jaxpr
+#                          plus the AOT HLO custom-call count and the
+#                          serve/dispatch_launches window delta
 #   tools/ci.sh prof       device-time-attribution smoke (~1 min):
 #                          tiny-model CPU prompt-length sweep through
 #                          tools/profile_decode.py PD_SECTIONS=prof —
@@ -192,6 +201,12 @@ if [[ "${1:-}" == "benchdiff" ]]; then
     shift
     python tools/bench_diff.py BENCH_r05.json BENCH_r05.json "$@"
     exec python tools/bench_diff.py --selftest BENCH_r05.json
+fi
+
+if [[ "${1:-}" == "mega" ]]; then
+    shift
+    PD_SIZE=tiny PD_SECTIONS=mega \
+        exec python tools/profile_decode.py "$@"
 fi
 
 if [[ "${1:-}" == "prof" ]]; then

@@ -24,8 +24,8 @@ import jax.numpy as jnp  # noqa: E402
 
 from paddle_tpu import stats  # noqa: E402
 from paddle_tpu.models import gpt  # noqa: E402
-from paddle_tpu.inference.decode_engine import DecodeEngine  # noqa: E402
-from paddle_tpu.inference.paged_engine import PagedDecodeEngine  # noqa: E402
+from paddle_tpu.inference import (  # noqa: E402
+    DecodeEngine, default_engine_kind, make_engine)
 from paddle_tpu.serving import FrontEnd, loadgen  # noqa: E402
 
 SLOTS = 4
@@ -38,12 +38,16 @@ def _model():
 
 
 def _engines(model):
+    # the front-end ladder builds through the factory: paged is the
+    # serving default (PT_SERVE_ENGINE), contiguous kept behind the flag
+    assert default_engine_kind() == "paged", "serving default changed"
     return {
-        "contiguous": lambda: DecodeEngine(model, max_slots=SLOTS,
-                                           max_len=96, steps_per_call=2),
-        "paged": lambda: PagedDecodeEngine(model, n_pages=40,
-                                           max_slots=SLOTS,
-                                           steps_per_call=2),
+        "contiguous": lambda: make_engine(model, "contiguous",
+                                          max_slots=SLOTS, max_len=96,
+                                          steps_per_call=2),
+        "paged": lambda: make_engine(model, n_pages=40,
+                                     max_slots=SLOTS,
+                                     steps_per_call=2),
     }
 
 

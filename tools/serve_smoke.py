@@ -3,7 +3,7 @@ pipelined decode UNDER FAULT INJECTION on CPU and prove, end to end,
 
 - byte-identical survivor streams at in-flight depth 1 vs 3 on the
   plain, chunked and speculative paths (contiguous engine) and the
-  paged engine;
+  paged engine, plain and speculative (single-dispatch megakernel);
 - a nan-poisoned request is evicted alone, at harvest, on every path;
 - a queued deadline_s=0 request is evicted without touching peers;
 - the pipeline actually pipelines (serve/host_gap_s samples recorded,
@@ -23,8 +23,8 @@ import jax.numpy as jnp  # noqa: E402
 
 from paddle_tpu import stats  # noqa: E402
 from paddle_tpu.models import gpt  # noqa: E402
+from paddle_tpu.inference import make_engine  # noqa: E402
 from paddle_tpu.inference.decode_engine import DecodeEngine  # noqa: E402
-from paddle_tpu.inference.paged_engine import PagedDecodeEngine  # noqa: E402
 from paddle_tpu.testing import faults  # noqa: E402
 
 
@@ -76,9 +76,13 @@ def main():
         "speculative": lambda d: DecodeEngine(
             model, max_slots=3, max_len=128, speculative_k=3,
             steps_per_call=2, inflight=d),
-        "paged": lambda d: PagedDecodeEngine(
+        # the serving default (factory → paged, megakernel step)
+        "paged": lambda d: make_engine(
             model, n_pages=24, max_slots=3, steps_per_call=2,
             inflight=d),
+        "paged_spec": lambda d: make_engine(
+            model, n_pages=24, max_slots=3, steps_per_call=2,
+            speculative_k=3, inflight=d),
     }
     for name, make in cases.items():
         base = _serve(make, 1)
