@@ -9,7 +9,17 @@ PT002   jit retrace & recompile hazards
 PT003   side effects (stats/trace/faults, mutation) in traced code
 PT004   rank-divergent collective ordering (static deadlock)
 PT005   PT_* env vars missing from the flags.py contract registry
+PT006   pallas launch over the static VMEM budget (ptgeom)
+PT007   blocked operand tiled off the (sublane, 128) grid (ptgeom)
+PT008   ANY-pool aliasing contract violations (ptgeom)
+PT009   grid blocking that re-reads an operand >=2x (ptgeom)
 ======  =====================================================
+
+PT006–PT009 consume kernel geometry harvested at trace time by
+``paddle_tpu.analysis.kernelmodel`` (``jax.eval_shape`` + a
+``pl.pallas_call`` interception shim); run them with
+``python tools/ptgeom.py`` (jax required, unlike ptlint). On a plain
+ptlint run they see no ``project.geom_specs`` and stay silent.
 
 Library use::
 

@@ -17,6 +17,13 @@ Three things are computed in one pass per file:
   decorators, and one level of wrapper nesting like
   ``jax.jit(checkify.checkify(fn))``).
 
+Bare-name uses are first run through local reference aliases
+(``step = self._traced`` makes a later ``jit(step)`` resolve to
+``_traced``, NOT to every function named ``step``) — the one spot where
+precision beats over-approximation, because a false jit root drags a
+host-only method into trace scope and produces false PT001/PT003
+findings on it.
+
 Reachability (`reachable`) walks call edges plus the
 parent→nested-function edge: a ``def one(carry, _)`` defined inside a
 jitted body executes at trace time even though it is only ever *passed*
@@ -74,7 +81,7 @@ def iter_own_nodes(func_node: ast.AST) -> Iterable[ast.AST]:
 
 class FunctionInfo:
     __slots__ = ("ctx", "node", "name", "qual", "cls", "parent",
-                 "children", "calls", "lineno")
+                 "children", "calls", "aliases", "lineno")
 
     def __init__(self, ctx, node, name, qual, cls, parent):
         self.ctx = ctx            # FileContext
@@ -87,6 +94,10 @@ class FunctionInfo:
         # typed call edges: (base, name) — base '' for bare names,
         # 'self'/'cls', a module alias, or '<expr>' (see resolve_edge)
         self.calls: Set[tuple] = set()
+        # local reference aliases: ``step = self._traced`` records
+        # {'step': ('self', '_traced')} so later uses of the bare name
+        # resolve to the real target, not every same-named definition
+        self.aliases: Dict[str, tuple] = {}
         self.lineno = getattr(node, "lineno", 1)
 
     def __repr__(self):
@@ -166,6 +177,45 @@ class _FileVisitor(ast.NodeVisitor):
                 f"{mod}.{alias.name}" if mod else alias.name)
         self.generic_visit(node)
 
+    # -- aliases ------------------------------------------------------------
+    def visit_Assign(self, node):
+        """Record ``name = self.method`` / ``name = module.fn`` /
+        ``name = other_name`` reference aliases so a later bare-name
+        use (a call, or being handed to jax.jit) resolves to the REAL
+        target instead of smearing over every same-named definition —
+        the ``step = self._traced; jit(step)`` pattern must not mark an
+        unrelated host-side ``step`` method as a jit root."""
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, (ast.Name, ast.Attribute))):
+            edge = self._call_edge(node.value)
+            if edge is not None and edge != ("", node.targets[0].id):
+                if self.fn_stack:
+                    self.fn_stack[-1].aliases[
+                        node.targets[0].id] = edge
+                else:
+                    self.graph.module_aliases.setdefault(
+                        self.ctx.relpath, {})[
+                        node.targets[0].id] = edge
+        self.generic_visit(node)
+
+    def _translate(self, edge, depth: int = 0):
+        """Follow bare-name aliases (innermost scope first, then module
+        level) to the edge they actually reference; depth-capped for
+        alias chains."""
+        base, name = edge
+        if base != "" or depth > 4:
+            return edge
+        for fn in reversed(self.fn_stack):
+            tgt = fn.aliases.get(name)
+            if tgt is not None:
+                return self._translate(tgt, depth + 1)
+        tgt = self.graph.module_aliases.get(
+            self.ctx.relpath, {}).get(name)
+        if tgt is not None:
+            return self._translate(tgt, depth + 1)
+        return edge
+
     # -- calls --------------------------------------------------------------
     @staticmethod
     def _call_edge(func):
@@ -183,6 +233,8 @@ class _FileVisitor(ast.NodeVisitor):
 
     def visit_Call(self, node):
         edge = self._call_edge(node.func)
+        if edge:
+            edge = self._translate(edge)
         if edge and self.fn_stack:
             self.fn_stack[-1].calls.add(edge)
         if edge and edge[1] in JIT_ROOT_NAMES:
@@ -200,11 +252,10 @@ class _FileVisitor(ast.NodeVisitor):
         if isinstance(expr, ast.Lambda):
             self.graph._pending_lambda_roots.append(expr)
         elif isinstance(expr, (ast.Name, ast.Attribute)):
-            edge = self._call_edge(expr if isinstance(expr, ast.Name)
-                                   else expr)
+            edge = self._call_edge(expr)
             if edge:
                 self.graph._pending_name_roots.append(
-                    (self.ctx.relpath,) + edge)
+                    (self.ctx.relpath,) + self._translate(edge))
         elif isinstance(expr, ast.Call):
             # jax.jit(checkify.checkify(fn)) — descend one wrapper level
             for a in expr.args:
@@ -230,6 +281,8 @@ class CallGraph:
         self.by_file: Dict[str, List[FunctionInfo]] = {}
         self.by_node: Dict[ast.AST, FunctionInfo] = {}
         self.imports: Dict[str, Dict[str, str]] = {}
+        # module-level reference aliases per file (see visit_Assign)
+        self.module_aliases: Dict[str, Dict[str, tuple]] = {}
         self._pending_name_roots = []
         self._pending_lambda_roots = []
         for ctx in files:

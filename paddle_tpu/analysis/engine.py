@@ -189,12 +189,15 @@ def load_project(paths: Sequence[str],
 def default_rules() -> List[Rule]:
     from paddle_tpu.analysis import (rules_collectives, rules_env,
                                      rules_host_sync, rules_retrace,
-                                     rules_side_effects)
+                                     rules_side_effects, rules_tpu)
     return [rules_host_sync.HostSyncRule(),
             rules_retrace.RetraceHazardRule(),
             rules_side_effects.TracedSideEffectRule(),
             rules_collectives.CollectiveOrderRule(),
-            rules_env.EnvContractRule()]
+            rules_env.EnvContractRule(),
+            # geometry rules: no-ops unless project.geom_specs is
+            # attached (tools/ptgeom.py harvests it)
+            *rules_tpu.geom_rules()]
 
 
 def run(project: Project,

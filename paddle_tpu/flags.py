@@ -527,6 +527,11 @@ declare_env("PT_XLA_CACHE_DIR", "Persistent XLA compilation cache "
             "directory (compile_cache.enable).", owner="compile_cache.py")
 declare_env("PT_AUTOTUNE_CACHE", "Kernel autotuner cache file path.",
             owner="ops/autotune.py")
+declare_env("PT_VMEM_BUDGET_MB", "Static per-core VMEM budget (MiB) "
+            "the ptgeom PT006 rule and autotune's geometry guard "
+            "check pallas launches against; a fixed 0.5 MiB compiler "
+            "reserve is subtracted.", default="16",
+            owner="analysis/kernelmodel.py")
 declare_env("PT_DATA_DIR", "Root directory for bundled datasets.",
             owner="vision/datasets.py")
 declare_env("PT_FAULTS", "Fault-injection plan: ';'-separated "
@@ -545,6 +550,8 @@ declare_tool_prefix("PD_", "profile_decode.py report knobs.",
                     owner="tools/profile_decode.py")
 declare_tool_prefix("FLEETOBS_", "fleet-observability smoke/test "
                     "worker handshake.", owner="tests/_fleetobs.py")
+declare_tool_prefix("PTGEOM_", "ptgeom.py kernel-geometry sweep "
+                    "knobs.", owner="tools/ptgeom.py")
 
 declare_env("PD_SIZE", "profile_decode model size: 1p3b (default), "
             "350m, or tiny (the CPU smoke).", default="1p3b",
@@ -566,6 +573,10 @@ declare_env("PD_LENGTHS", "Comma-list of prompt lengths the prof "
             "section sweeps per decode path (default by model size; "
             ">=3 lengths make the launch-tax-vs-length curve).",
             owner="tools/profile_decode.py")
+declare_env("PTGEOM_GEOMS", "Comma-set of ladder geometries the "
+            "ptgeom sweep drives (tiny,350m,r06); unset sweeps all "
+            "(tools/ptgeom.py --geoms overrides).",
+            owner="tools/ptgeom.py")
 declare_env("FLEETOBS_TRACE_FILE", "Per-replica trace path handed to "
             "launch-spawned fleet workers; translated to PT_TRACE_FILE "
             "at worker startup so the launcher's own atexit export "

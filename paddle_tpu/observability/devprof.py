@@ -45,7 +45,7 @@ from typing import Dict, Optional, Tuple
 
 from paddle_tpu.observability import comm
 
-__all__ = ["CostCapture", "capture_jit", "peak_specs",
+__all__ = ["CostCapture", "capture_jit", "peak_specs", "hbm_seconds",
            "roofline_tokens_per_sec", "record_roofline",
            "launch_tax_s", "pallas_launch_tax_s", "launch_tax_fraction",
            "step_fractions", "count_pallas_launches",
@@ -78,6 +78,15 @@ def peak_specs(device=None) -> Tuple[float, float]:
         flops = det_f if flops is None else flops
         bw = det_b if bw is None else bw
     return flops, bw
+
+
+def hbm_seconds(nbytes: float, device=None) -> float:
+    """Analytic seconds to move ``nbytes`` through HBM at the device's
+    peak bandwidth — the roofline price tag ptgeom's PT009 attaches to
+    redundant refetch traffic. Raises when no device/override is
+    available (callers guard; static analysis must stay device-free)."""
+    _, bw = peak_specs(device)
+    return float(nbytes) / bw
 
 
 # ---------------------------------------------------------------------------

@@ -88,25 +88,39 @@ def get_cache() -> AutotuneCache:
 
 def tune(kernel: str, key: str, candidates: Sequence,
          build_and_run: Callable, warmup: int = 1, iters: int = 3,
-         cache: Optional[AutotuneCache] = None):
+         cache: Optional[AutotuneCache] = None,
+         geom_check: Optional[Callable] = None):
     """Measure every candidate config and cache the argmin
     (≙ auto_tune_base.h TuneBase::PickBestKernel).
 
     ``build_and_run(config)`` must execute the kernel end-to-end on the
     real shapes and block until the result is ready. Configs that raise
     (e.g. a block shape Mosaic rejects for this dtype) are skipped.
-    Returns (best_config, {config: seconds}); the winner lands in the
-    cache keyed by ``key``.
+    ``geom_check(config)`` (ISSUE 20) is consulted FIRST: a truthy
+    return is a static refusal reason (e.g. ptgeom's PT006 VMEM budget)
+    and the candidate is skipped without ever being built or timed —
+    chip-time sweeps stop burning iterations on geometries that cannot
+    fit. Returns (best_config, {config: seconds}); the winner lands in
+    the cache keyed by ``key``.
     """
     cache = cache or get_cache()
     hit = cache.get(key)
     if hit is not None:
         return hit, {}
     timings: Dict = {}
+    refused: Dict = {}
     last_exc = None
     for config in candidates:
         ckey = tuple(config) if isinstance(config, (list, tuple)) \
             else config
+        if geom_check is not None:
+            try:
+                reason = geom_check(config)
+            except Exception:  # a broken guard must not block tuning
+                reason = None
+            if reason:
+                refused[ckey] = str(reason)
+                continue
         try:
             build_and_run(config)  # compile + first run
             for _ in range(warmup):
@@ -119,8 +133,12 @@ def tune(kernel: str, key: str, candidates: Sequence,
             last_exc = e
             continue
     if not timings:
+        detail = ""
+        if refused:
+            detail = "; geometry-refused: " + "; ".join(
+                f"{k}: {v}" for k, v in refused.items())
         raise ValueError(f"autotune({kernel}): every candidate failed "
-                         f"for key {key}") from last_exc
+                         f"for key {key}{detail}") from last_exc
     best = min(timings, key=timings.get)
     cache.put(key, best)
     return best, timings

@@ -273,3 +273,33 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
     m = ml[:, :group, 0].reshape(b, hq)
     l = ml[:, :group, 1].reshape(b, hq)
     return o, m, l
+
+
+def ptgeom_cases():
+    """Geometry registry for tools/ptgeom.py (ISSUE 20): bench-ladder
+    cache shapes x the block_k sweep, under jax.eval_shape."""
+    from paddle_tpu.analysis import kernelmodel as km
+
+    def case(geom, block_k):
+        p = km.LADDER[geom]
+        d = p["dm"] // p["heads"]
+        T = max(p["seq"], _LANES)
+        B = 8
+        q = km.sds((B, p["heads"], d), p["dtype"])
+        kc = km.sds((B, p["kv_heads"], T, d), p["dtype"])
+        ln = km.sds((B,), "int32")
+
+        def run():
+            import jax as _jax
+            _jax.eval_shape(
+                lambda q, kc, vc, ln: decode_attention(
+                    q, kc, vc, ln, block_k=block_k),
+                q, kc, kc, ln)
+        return km.GeomCase(kernel="decode_attention", geometry=geom,
+                           config=f"bk{block_k}", run=run)
+
+    cases = [case("tiny", 512)]
+    for geom in ("350m", "r06"):
+        for bk in (256, 512, 1024):
+            cases.append(case(geom, bk))
+    return cases

@@ -100,7 +100,16 @@ def _resolve_vb(vb, dm, vocab, dtype, layers, page):
     # ptlint: disable=PT001 -- vb is a static Python config knob
     # (autotune-cache hit or explicit kwarg), never a device value
     vb = max(_LANES, int(vb) // _LANES * _LANES)
-    return vb
+    # PT006 clamp (ISSUE 20): the epilogue streams a double-buffered
+    # (dm, vb) weight slab through VMEM — cap vb so that slab can never
+    # exceed half the static budget (the other half covers the hidden
+    # state, accumulators, and the packed output), no matter what the
+    # autotune cache or an explicit kwarg asked for at large vocab.
+    from paddle_tpu.analysis.kernelmodel import (itemsize,
+                                                 vmem_budget_bytes)
+    cap = vmem_budget_bytes() // 2 // (2 * dm * itemsize(dtype))
+    cap = max(_LANES, cap // _LANES * _LANES)
+    return min(vb, cap)
 
 
 def _const_map(n):
@@ -347,6 +356,13 @@ def mega_decode_layers(x, weights, k_pages, v_pages, page_table,
             pltpu.VMEM((gp, _LANES), jnp.float32),
         ],
     )
+    # ptlint: disable=PT006 -- the layer fold streams each layer's FULL
+    # weight slab per grid step (~96 MiB/layer at r06 scale, ~12x the
+    # 16 MiB core budget double-buffered; see docs/serving.md for the
+    # measured fractions): over budget BY CONSTRUCTION until the stack
+    # is dm-tiled. Kept deliberate — the r06 recapture (ROADMAP item 1)
+    # measures whether Mosaic's windowing absorbs it; ptgeom's table
+    # keeps the number visible per geometry either way.
     return pl.pallas_call(
         functools.partial(_mega_kernel, wnames=wnames, L=L, B=B, dm=dm,
                           hq=hq, hkv=hkv, d=d, page=page, P=P, mx=mx,
@@ -493,5 +509,89 @@ def tune_mega_epilogue(x, lnf_scale, lnf_bias, w, *, layers=0, page=0,
                 return tok.sum() + nf.sum()
             jitted[vb] = jax.jit(fn)
         int(jitted[vb](x, w))  # sync — timing must see the kernel end
+
+    def geom_check(vb):
+        # refuse before spending chip time: a candidate the PT006
+        # budget clamp would coerce is a duplicate of the clamped
+        # width, and an over-budget harvest can never fit
+        from paddle_tpu.analysis import kernelmodel as km
+        rvb = _resolve_vb(int(vb), x.shape[1], vocab, x.dtype, layers,
+                          page)
+        if rvb != int(vb):
+            return (f"vb={int(vb)} infeasible: PT006 VMEM budget "
+                    f"clamps the epilogue tile to {rvb}")
+
+        def dry():
+            jax.eval_shape(
+                lambda x, s, b, w, p: mega_logits_sample(
+                    x, s, b, w, p, vb=int(vb), layers=layers,
+                    page=page),
+                x, jnp.asarray(lnf_scale), jnp.asarray(lnf_bias), w,
+                poison)
+        return km.budget_reason(dry)
+
     return at.tune("paged_mega", key, candidates, build_and_run,
-                   iters=iters)
+                   iters=iters, geom_check=geom_check)
+
+
+def ptgeom_cases():
+    """Geometry registry for tools/ptgeom.py (ISSUE 20): drive both
+    megakernel launches under ``jax.eval_shape`` across the bench
+    ladder and the epilogue's autotune vb candidates, so PT006-PT009
+    can price every launch without executing a kernel."""
+    from paddle_tpu.analysis import kernelmodel as km
+
+    def stack_case(geom, L=None):
+        p = km.LADDER[geom]
+        dm, hq, hkv = p["dm"], p["heads"], p["kv_heads"]
+        d = dm // hq
+        dt = p["dtype"]
+        layers = p["layers"] if L is None else L
+        page = p["page"]
+        P = max(1, p["seq"] // page)
+        B = 8
+        weights = {
+            "ln1_scale": km.sds((layers, dm), dt),
+            "ln1_bias": km.sds((layers, dm), dt),
+            "wqkv": km.sds((layers, dm, (hq + 2 * hkv) * d), dt),
+            "wo": km.sds((layers, hq * d, dm), dt),
+            "ln2_scale": km.sds((layers, dm), dt),
+            "ln2_bias": km.sds((layers, dm), dt),
+            "wup": km.sds((layers, dm, 4 * dm), dt),
+            "wdown": km.sds((layers, 4 * dm, dm), dt),
+        }
+        x = km.sds((B, dm), dt)
+        pool = km.sds((layers * P + 1, hkv, page, d), dt)
+        table = km.sds((B, P), "int32")
+        rows = km.sds((B,), "int32")
+
+        def run():
+            jax.eval_shape(
+                functools.partial(mega_decode_layers, page=page,
+                                  n_pages=P, n_heads=hq,
+                                  kv_heads=hkv, head_dim=d),
+                x, weights, pool, pool, table, rows, rows, rows)
+        return km.GeomCase(kernel="mega_decode_layers", geometry=geom,
+                           config=f"L{layers}.page{page}", run=run)
+
+    def epi_case(geom, vb):
+        p = km.LADDER[geom]
+        dm, vocab, dt = p["dm"], p["vocab"], p["dtype"]
+        B = 8
+        x = km.sds((B, dm), dt)
+        vec = km.sds((dm,), dt)
+        w = km.sds((dm, vocab), dt)
+        pois = km.sds((B,), "int32")
+
+        def run():
+            jax.eval_shape(
+                functools.partial(mega_logits_sample, vb=vb),
+                x, vec, vec, w, pois)
+        return km.GeomCase(kernel="mega_logits_sample", geometry=geom,
+                           config=f"vb{vb}", run=run)
+
+    cases = [stack_case(g) for g in ("tiny", "350m", "r06")]
+    for g in ("tiny", "350m", "r06"):
+        for vb in (256, 512, 2048):
+            cases.append(epi_case(g, vb))
+    return cases

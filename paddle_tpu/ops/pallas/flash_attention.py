@@ -207,6 +207,9 @@ def _fa_forward(q, k, v, kvlen, seed, bias, causal, scale, block_q, block_k,
         in_specs.append(_bias_spec(bias_sq1, block_q, block_k, g,
                                    grid_ij=True))
         args.append(bias)
+    # ptlint: disable=PT009 -- flash forward streams the FULL K/V per
+    # query block by construction (online softmax): the seq/block_q
+    # re-read is the O(block) -memory tradeoff the kernel exists for.
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
@@ -386,6 +389,9 @@ def _fa_backward(q, k, v, kvlen, seed, bias, out, lse, do, causal, scale,
     args += [lse, delta]
     # dk/dv are produced per *query* head (b over B*Hq) and group-summed
     # below for GQA
+    # ptlint: disable=PT009 -- dk/dv re-streams every Q/dO/LSE row
+    # block per K/V tile (flash backward recomputation); inherent to
+    # the tiling, not a blocking bug.
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, **kw),
         grid=(bh, nk, nq),
@@ -422,6 +428,8 @@ def _fa_backward(q, k, v, kvlen, seed, bias, out, lse, do, causal, scale,
         args2.append(bias)
     in_specs2 += [rowspec2, rowspec2]
     args2 += [lse, delta]
+    # ptlint: disable=PT009 -- dq re-streams the FULL K/V per query
+    # block, mirroring the forward's online-softmax walk.
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **kw),
         grid=(bh, nq, nk),
@@ -536,8 +544,24 @@ def tune_flash_attention(q, k, v, causal=False, scale=None, kv_lens=None,
         leaf = _jax.tree_util.tree_leaves(out)[0]
         float(leaf.reshape(-1)[0] if leaf.ndim else leaf)  # sync
 
+    def geom_check(cfg):
+        # static PT006 refusal (ISSUE 20): never compile/time a block
+        # pair whose VMEM residency cannot fit
+        from paddle_tpu.analysis import kernelmodel as km
+        bq, bk = cfg
+
+        def dry():
+            _jax.eval_shape(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=causal, scale=scale,
+                    kv_lens=kv_lens, bias=bias, dropout_p=dropout_p,
+                    dropout_seed=dropout_seed, block_q=bq,
+                    block_k=bk),
+                q, k, v)
+        return km.budget_reason(dry)
+
     return at.tune("flash_attention", key, candidates, build_and_run,
-                   iters=iters)
+                   iters=iters, geom_check=geom_check)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, kv_lens=None,
@@ -651,3 +675,40 @@ def flash_attention(q, k, v, causal=False, scale=None, kv_lens=None,
                   bool(interpret))
     out = out3[:, :sq, :].reshape(b, h_q, sq, d)
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def ptgeom_cases():
+    """Geometry registry for tools/ptgeom.py (ISSUE 20): the bench
+    ladder x the autotune block-candidate space, forward and backward,
+    driven under jax.eval_shape (nothing executes)."""
+    from paddle_tpu.analysis import kernelmodel as km
+
+    def case(geom, bq, bk, bwd=False):
+        p = km.LADDER[geom]
+        d = p["dm"] // p["heads"]
+        q = km.sds((1, p["seq"], p["heads"], d), p["dtype"])
+
+        def run():
+            import jax as _jax
+
+            def fwd(q, k, v):
+                o = flash_attention(q, k, v, causal=True, block_q=bq,
+                                    block_k=bk)
+                return jnp.sum(o.astype(jnp.float32))
+
+            fn = _jax.grad(fwd, argnums=(0, 1, 2)) if bwd else (
+                lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                block_q=bq,
+                                                block_k=bk))
+            _jax.eval_shape(fn, q, q, q)
+        return km.GeomCase(
+            kernel="flash_attention", geometry=geom,
+            config=f"bq{bq}.bk{bk}" + (".bwd" if bwd else ""), run=run)
+
+    cases = [case("tiny", 256, 512)]
+    for geom in ("350m", "r06"):
+        for bq, bk in ((128, 128), (256, 512), (512, 512),
+                       (1024, 512)):
+            cases.append(case(geom, bq, bk))
+        cases.append(case(geom, 256, 512, bwd=True))
+    return cases

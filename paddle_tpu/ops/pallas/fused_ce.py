@@ -146,6 +146,10 @@ def _fwd(x, w, lab2, block_n, block_v, interpret):
     n, d = x.shape
     V = w.shape[0]
     grid = (n // block_n, V // block_v)
+    # ptlint: disable=PT009 -- the fused head never materializes the
+    # (n, V) logits: every row block walks ALL vocab tiles (online
+    # softmax), so w is re-read n/block_n times by design — that HBM
+    # traffic is what buys the O(block) logit memory.
     loss, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_v=block_v),
         grid=grid,
@@ -169,6 +173,8 @@ def _bwd(x, w, lab2, lse, g2, block_n, block_v, interpret):
     n, d = x.shape
     V = w.shape[0]
     row = _row_spec(block_n)
+    # ptlint: disable=PT009 -- dx rebuilds softmax tiles from scratch:
+    # w is re-streamed per row block exactly like the forward walk.
     dx = pl.pallas_call(
         functools.partial(_dx_kernel, block_v=block_v),
         grid=(n // block_n, V // block_v),
@@ -187,6 +193,9 @@ def _bwd(x, w, lab2, lse, g2, block_n, block_v, interpret):
     )(lab2, g2, x, w, lse)
 
     rowT = pl.BlockSpec((block_n, _LANES), lambda j, i: (i, 0))
+    # ptlint: disable=PT009 -- dw walks every row block per vocab tile
+    # (the transposed online-softmax recomputation); x re-reads scale
+    # with V/block_v, inherent to not materializing logits.
     dw = pl.pallas_call(
         functools.partial(_dw_kernel, block_v=block_v),
         grid=(V // block_v, n // block_n),
@@ -264,3 +273,38 @@ def fused_softmax_cross_entropy(x, w, labels, block_n: int = 128,
     # ptlint: disable=PT001 -- interpret is a static Python flag
     loss = _fused_ce(x, w, lab2, bn, bv, bool(interpret))
     return loss[:n] if n_pad else loss
+
+
+def ptgeom_cases():
+    """Geometry registry for tools/ptgeom.py (ISSUE 20): head shapes
+    from the bench ladder x logit-tile candidates, forward and
+    backward, under jax.eval_shape."""
+    from paddle_tpu.analysis import kernelmodel as km
+
+    def case(geom, bn, bv, bwd=False):
+        p = km.LADDER[geom]
+        n = 64 if geom == "tiny" else 2048
+        x = km.sds((n, p["dm"]), p["dtype"])
+        w = km.sds((p["vocab"], p["dm"]), p["dtype"])
+        lab = km.sds((n,), "int32")
+
+        def run():
+            import jax as _jax
+
+            def loss(x, w, lab):
+                l = fused_softmax_cross_entropy(x, w, lab, block_n=bn,
+                                                block_v=bv)
+                return jnp.sum(jnp.asarray(l, jnp.float32))
+
+            fn = _jax.grad(loss, argnums=(0, 1)) if bwd else loss
+            _jax.eval_shape(fn, x, w, lab)
+        return km.GeomCase(
+            kernel="fused_ce", geometry=geom,
+            config=f"bn{bn}.bv{bv}" + (".bwd" if bwd else ""), run=run)
+
+    cases = [case("tiny", 128, 512)]
+    for geom in ("350m", "r06"):
+        for bn, bv in ((128, 512), (256, 512)):
+            cases.append(case(geom, bn, bv))
+        cases.append(case(geom, 128, 512, bwd=True))
+    return cases

@@ -240,3 +240,22 @@ def fused_layer_norm(x, gamma, beta, residual=None, bias=None,
                        res2, seed, float(epsilon), float(dropout_p),
                        bool(interpret))
     return y.reshape(shape), pre.reshape(shape)
+
+
+def ptgeom_cases():
+    """Geometry registry for tools/ptgeom.py (ISSUE 20); the pallas
+    path needs n % 128 == 0, so only the 350m/r06 rungs apply."""
+    from paddle_tpu.analysis import kernelmodel as km
+
+    def case(geom):
+        p = km.LADDER[geom]
+        x = km.sds((2048, p["dm"]), p["dtype"])
+        g = km.sds((p["dm"],), p["dtype"])
+
+        def run():
+            import jax as _jax
+            _jax.eval_shape(fused_layer_norm, x, g, g)
+        return km.GeomCase(kernel="fused_layer_norm", geometry=geom,
+                           config="bm-auto", run=run)
+
+    return [case("350m"), case("r06")]

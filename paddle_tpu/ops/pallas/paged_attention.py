@@ -572,3 +572,50 @@ class PagedKVCache:
             lens[i] = self.lengths[s]
         return (jnp.asarray(table), jnp.asarray(lens),
                 self.kp[layer], self.vp[layer])
+
+
+def ptgeom_cases():
+    """Geometry registry for tools/ptgeom.py (ISSUE 20): plain and
+    fused paged decode across the (pages_per_program, head_block)
+    autotune space, under jax.eval_shape."""
+    from paddle_tpu.analysis import kernelmodel as km
+
+    def case(geom, ppp, hb, fused):
+        p = km.LADDER[geom]
+        d = p["dm"] // p["heads"]
+        hkv = p["kv_heads"]
+        page = p["page"]
+        B = 8
+        mx = max(1, p["seq"] // page)
+        q = km.sds((B, p["heads"], d), p["dtype"])
+        pool = km.sds((B * mx + 1, hkv, page, d), p["dtype"])
+        table = km.sds((B, mx), "int32")
+        vec = km.sds((B,), "int32")
+        row = km.sds((B, hkv, d), p["dtype"])
+
+        def run():
+            import jax as _jax
+            if fused:
+                _jax.eval_shape(
+                    lambda q, kp, vp, kr, vr, tab, wp, ln:
+                    paged_append_attend(q, kp, vp, kr, vr, tab, wp,
+                                        ln, pages_per_program=ppp,
+                                        head_block=hb),
+                    q, pool, pool, row, row, table, vec, vec)
+            else:
+                _jax.eval_shape(
+                    lambda q, kp, vp, tab, ln: paged_decode_attention(
+                        q, kp, vp, tab, ln, pages_per_program=ppp,
+                        head_block=hb),
+                    q, pool, pool, table, vec)
+        tag = "fused" if fused else "plain"
+        return km.GeomCase(kernel=f"paged_{tag}", geometry=geom,
+                           config=f"ppp{ppp}.hb{hb}", run=run)
+
+    cases = [case("tiny", 1, 1, True)]
+    for geom in ("350m", "r06"):
+        for ppp, hb in ((1, 1), (2, 2), (4, 4)):
+            cases.append(case(geom, ppp, hb, False))
+        for ppp, hb in ((1, 1), (2, 2)):
+            cases.append(case(geom, ppp, hb, True))
+    return cases

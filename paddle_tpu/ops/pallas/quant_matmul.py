@@ -84,6 +84,10 @@ def int8_matmul(x, q, scale, block_m: int = 256, block_n: int = 512,
     if n_p != n:
         scale = jnp.pad(scale, ((0, 0), (0, n_p - n)))
 
+    # ptlint: disable=PT009 -- K-blocked matmul: x re-reads once per N
+    # tile and q once per M tile — the classic blocked-GEMM streaming
+    # pattern; re-read factor is bounded by the block_n/block_m sweep
+    # the autotuner already prices in wall time.
     out = pl.pallas_call(
         _kernel,
         grid=(m_p // block_m, n_p // block_n, k_p // block_k),
@@ -100,3 +104,34 @@ def int8_matmul(x, q, scale, block_m: int = 256, block_n: int = 512,
         interpret=interpret,
     )(x2, q, scale)
     return out[:m, :n].reshape(*lead, n)
+
+
+def ptgeom_cases():
+    """Geometry registry for tools/ptgeom.py (ISSUE 20): the MLP-width
+    int8 matmul at train-like and decode-like M, under
+    jax.eval_shape."""
+    from paddle_tpu.analysis import kernelmodel as km
+
+    def case(geom, m, bm, bn, bk):
+        p = km.LADDER[geom]
+        kdim = p["dm"]
+        n = 4 * p["dm"]
+        x = km.sds((m, kdim), p["dtype"])
+        qm = km.sds((kdim, n), "int8")
+        sc = km.sds((n,), "float32")
+
+        def run():
+            import jax as _jax
+            _jax.eval_shape(
+                lambda x, qm, sc: int8_matmul(
+                    x, qm, sc, block_m=bm, block_n=bn, block_k=bk),
+                x, qm, sc)
+        return km.GeomCase(kernel="int8_matmul", geometry=geom,
+                           config=f"m{m}.bm{bm}.bn{bn}.bk{bk}",
+                           run=run)
+
+    cases = []
+    for geom in ("350m", "r06"):
+        cases.append(case(geom, 2048, 256, 512, 512))
+        cases.append(case(geom, 8, 256, 512, 512))
+    return cases

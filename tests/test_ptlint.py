@@ -507,3 +507,73 @@ def test_cli_parse_error_exits_2(tmp_path):
     r = subprocess.run([sys.executable, cli, str(broken), "--no-error"],
                        cwd=REPO, capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- callgraph alias resolution (ISSUE 20 satellite) -------------------------
+
+def test_alias_does_not_smear_jit_root(tmp_path):
+    """``step = self._traced; jax.jit(step)`` must root _traced — NOT
+    an unrelated host-side method that happens to be named ``step``
+    (the PR 19 false positive)."""
+    findings = _lint(tmp_path, {"mod.py": """
+import jax
+import jax.numpy as jnp
+
+
+class Engine:
+    def _traced(self, x):
+        return jnp.sum(x)
+
+    def build(self):
+        step = self._traced
+        return jax.jit(step)
+
+
+class Host:
+    def step(self, x):
+        return jnp.sum(x).item()
+"""})
+    assert "PT001" not in _rules_hit(findings)
+
+
+def test_alias_target_still_enters_jit_scope(tmp_path):
+    """Positive control: the alias TARGET is the jit root, so a host
+    sync inside it is still flagged."""
+    findings = _lint(tmp_path, {"mod.py": """
+import jax
+import jax.numpy as jnp
+
+
+class Engine:
+    def _traced(self, x):
+        return jnp.sum(x).item()
+
+    def build(self):
+        step = self._traced
+        return jax.jit(step)
+"""})
+    assert any(f.rule == "PT001" and f.symbol.endswith("_traced")
+               for f in findings)
+
+
+def test_module_level_alias_resolves(tmp_path):
+    """``run = _impl`` at module level: jitting the alias roots _impl,
+    and a same-named function elsewhere in the file stays host code."""
+    findings = _lint(tmp_path, {"mod.py": """
+import jax
+import jax.numpy as jnp
+
+
+def _impl(x):
+    return jnp.sum(x).item()
+
+
+run = _impl
+traced = jax.jit(run)
+
+
+def run_report(x):
+    pass
+"""})
+    assert any(f.rule == "PT001" and f.symbol.endswith("_impl")
+               for f in findings)

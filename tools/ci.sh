@@ -95,6 +95,12 @@
 #                          clean and bench_diff's synthetic 20% tok/s
 #                          regression must be caught by row name
 #                          (seconds; also part of the default gate)
+#   tools/ci.sh geom       kernel-geometry gate (ISSUE 20): sweep every
+#                          registered Pallas launch at the bench ladder
+#                          under jax.eval_shape (CPU, no execution) and
+#                          fail on any non-baselined PT006–PT009
+#                          finding — a kernel whose worst autotune
+#                          geometry stops fitting VMEM fails in seconds
 #   tools/ci.sh mega       single-dispatch-decode smoke (~1 min):
 #                          tiny-model CPU run of profile_decode's
 #                          PD_SECTIONS=mega launches/step report — the
@@ -203,8 +209,17 @@ if [[ "${1:-}" == "benchdiff" ]]; then
     exec python tools/bench_diff.py --selftest BENCH_r05.json
 fi
 
+if [[ "${1:-}" == "geom" ]]; then
+    shift
+    exec python tools/ptgeom.py --error-on-new --stats "$@"
+fi
+
 if [[ "${1:-}" == "mega" ]]; then
     shift
+    # the megakernel's VMEM geometry is statically gated before the
+    # runtime smoke: an over-budget slab/tile fails here by name
+    python tools/ptgeom.py --error-on-new \
+        --kernels mega_decode_layers,mega_logits_sample
     PD_SIZE=tiny PD_SECTIONS=mega \
         exec python tools/profile_decode.py "$@"
 fi
